@@ -1,0 +1,240 @@
+"""paddle.amp — auto mixed precision (≙ python/paddle/amp/auto_cast.py:1018,
+grad_scaler.py:657).
+
+TPU-first: bf16 is the native mixed-precision dtype (MXU computes bf16 ×
+bf16 → fp32); no loss scaling is numerically required for bf16, but
+GradScaler implements real dynamic scaling for fp16 parity. O1 casts
+whitelist-op inputs at dispatch (hook in core/dispatch.op_call); O2 casts
+parameters wholesale (decorate/Layer.bfloat16)."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.dispatch import no_grad
+from ..core.tensor import Tensor
+
+_tls = threading.local()
+
+# ops cast to low precision in O1 (matmul/conv ride the MXU)
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv2d_transpose", "einsum", "scaled_dot_product_attention", "addmm",
+}
+# ops kept in fp32 in O1 (reductions / losses / norms / exp-family)
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",
+    "softmax", "log_softmax", "cross_entropy", "mse_loss", "l1_loss",
+    "binary_cross_entropy", "bce_with_logits", "kl_div", "mean", "sum",
+    "layer_norm", "batch_norm", "group_norm", "instance_norm", "rms_norm",
+    "cumsum", "logsumexp", "norm", "cosine_similarity",
+}
+
+
+class AmpState:
+    __slots__ = ("enable", "level", "dtype", "custom_white", "custom_black")
+
+    def __init__(self, enable, level, dtype, custom_white, custom_black):
+        self.enable = enable
+        self.level = level
+        self.dtype = dtype
+        self.custom_white = custom_white or set()
+        self.custom_black = custom_black or set()
+
+
+def amp_state() -> AmpState | None:
+    return getattr(_tls, "amp", None)
+
+
+def amp_dtype_for(opname) -> "np.dtype | None":
+    """Consulted by op_call: returns target compute dtype for this op, or None."""
+    st = amp_state()
+    if st is None or not st.enable:
+        return None
+    if st.level == "O2":
+        if opname in BLACK_LIST or opname in st.custom_black:
+            return dtypes.float32
+        return st.dtype
+    # O1
+    if opname in st.custom_black or (opname in BLACK_LIST and opname not in st.custom_white):
+        return dtypes.float32
+    if opname in WHITE_LIST or opname in st.custom_white:
+        return st.dtype
+    return None
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    old = amp_state()
+    _tls.amp = AmpState(enable, level, dtypes.convert_dtype(dtype),
+                        set(custom_white_list or ()), set(custom_black_list or ()))
+    try:
+        yield
+    finally:
+        _tls.amp = old
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to the AMP dtype (paddle amp.decorate)."""
+    if level == "O2":
+        ms = models if isinstance(models, (list, tuple)) else [models]
+        for m in ms:
+            m._to_dtype(dtypes.convert_dtype(dtype))
+            for norm_layer in m.sublayers(include_self=True):
+                # keep norms' params in fp32 (paddle keeps BN fp32 in O2)
+                if type(norm_layer).__name__.startswith(("BatchNorm", "LayerNorm")):
+                    for p in norm_layer._parameters.values():
+                        if p is not None:
+                            p._assign_raw(p._data.astype(jnp.float32))
+        if optimizers is not None and hasattr(optimizers, "_multi_precision"):
+            optimizers._multi_precision = master_weight is not False
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (≙ amp/grad_scaler.py:657). The scale lives in a
+    Tensor so compiled train steps thread it through as an input/output."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = Tensor(jnp.asarray(init_loss_scaling, jnp.float32), _internal=True)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good = Tensor(jnp.asarray(0, jnp.int32), _internal=True)
+        self._bad = Tensor(jnp.asarray(0, jnp.int32), _internal=True)
+        self._found_inf = Tensor(jnp.asarray(False), _internal=True)
+
+    def is_enable(self):
+        return self._enable
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        from ..ops import multiply
+
+        return multiply(var, Tensor(self._scale._data.astype(var._data.dtype),
+                                    _internal=True))
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        with no_grad():
+            inv = 1.0 / self._scale._data
+            found = jnp.asarray(False)
+            for p in optimizer._parameters:
+                if p.grad is not None:
+                    g = p.grad._data.astype(jnp.float32) * inv
+                    found = found | jnp.any(~jnp.isfinite(g))
+                    p.grad._assign_raw(g.astype(p.grad._data.dtype))
+            self._found_inf._assign_raw(found)
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        # conditional step: skip update when inf/nan found. Under trace this
+        # becomes a jnp.where on every updated buffer via the mask trick.
+        if not isinstance(self._found_inf._data, jnp.ndarray) or \
+                not hasattr(self._found_inf._data, "aval"):
+            pass
+        found = bool(self._found_inf._data) if not _is_tracer(self._found_inf._data) \
+            else None
+        if found is None:
+            # traced: mask the update by zeroing grads on overflow
+            with no_grad():
+                for p in optimizer._parameters:
+                    if p.grad is not None:
+                        p.grad._assign_raw(jnp.where(self._found_inf._data,
+                                                     jnp.zeros_like(p.grad._data),
+                                                     p.grad._data))
+            optimizer.step()
+        elif not found:
+            optimizer.step()
+
+    def update(self):
+        if not self._enable or not self._dynamic:
+            return
+        with no_grad():
+            found = self._found_inf._data
+            good = jnp.where(found, 0, self._good._data + 1)
+            bad = jnp.where(found, self._bad._data + 1, 0)
+            scale = self._scale._data
+            scale = jnp.where(bad >= self._decr_every, scale * self._decr_ratio, scale)
+            bad = jnp.where(bad >= self._decr_every, 0, bad)
+            scale = jnp.where(good >= self._incr_every, scale * self._incr_ratio, scale)
+            good = jnp.where(good >= self._incr_every, 0, good)
+            self._scale._assign_raw(jnp.maximum(scale, 1.0))
+            self._good._assign_raw(good)
+            self._bad._assign_raw(bad)
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def get_loss_scaling(self):
+        return Tensor(self._scale._data, _internal=True)
+
+    def set_init_loss_scaling(self, v):
+        self._scale._assign_raw(jnp.asarray(v, jnp.float32))
+
+    def state_dict(self):
+        return {"scale": np.asarray(self._scale._data),
+                "incr_ratio": self._incr_ratio, "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every,
+                "decr_every_n_nan_or_inf": self._decr_every}
+
+    def load_state_dict(self, state):
+        self._scale._assign_raw(jnp.asarray(state["scale"], jnp.float32))
+
+
+def _is_tracer(x):
+    import jax
+
+    return isinstance(x, jax.core.Tracer)
+
+
+class debugging:
+    """≙ paddle.amp.debugging — per-op NaN/Inf scan toggles."""
+
+    class TensorCheckerConfig:
+        def __init__(self, enable=True, debug_mode=None, **kw):
+            self.enable = enable
+
+    @staticmethod
+    def enable_tensor_checker(config):
+        from ..core.flags import set_flags
+
+        set_flags({"FLAGS_check_nan_inf": bool(config.enable)})
+
+    @staticmethod
+    def disable_tensor_checker():
+        from ..core.flags import set_flags
+
+        set_flags({"FLAGS_check_nan_inf": False})
+
+    @staticmethod
+    def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+        import jax.numpy as jnp
+
+        bad = bool(jnp.any(~jnp.isfinite(tensor._data)))
+        if bad:
+            raise FloatingPointError(f"NaN/Inf in {op_type}:{var_name}")
+        return tensor

@@ -1,0 +1,124 @@
+"""paddle_tpu.autograd — backward(), no_grad, PyLayer
+(≙ python/paddle/autograd; engine is core/engine.py)."""
+from __future__ import annotations
+
+import jax
+
+from ..core.dispatch import no_grad, enable_grad, set_grad_enabled, op_call
+from ..core.engine import grad, run_backward
+from ..core.tensor import Tensor
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        run_backward(t, g, retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def set_materialize_grads(self, v):
+        self.materialize_grads = v
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd function (≙ python/paddle/autograd/py_layer.py).
+
+    Subclass with @staticmethod forward(ctx, *args) and backward(ctx, *grads).
+    The backward runs user Python eagerly — it is recorded on the tape as an
+    opaque node, so it composes with the rest of the graph.
+    """
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core.dispatch import GradNode, grad_enabled
+
+        ctx = PyLayerContext()
+        outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        diff_inputs = [t for t in tensor_inputs if not t.stop_gradient]
+        if not grad_enabled() or not diff_inputs:
+            return outs
+
+        out_avals = [(tuple(o.shape), o.dtype) for o in out_list]
+
+        def vjp_fn(cot):
+            cots = (cot,) if single else cot
+            cot_tensors = tuple(
+                Tensor(c, _internal=True) if not isinstance(c, Tensor) else c for c in cots
+            )
+            gin = cls.backward(ctx, *cot_tensors)
+            gin = (gin,) if isinstance(gin, Tensor) or gin is None else tuple(gin)
+            out = []
+            for g in gin[: len(diff_inputs)]:
+                out.append(g._data if isinstance(g, Tensor) else g)
+            return tuple(out)
+
+        node = GradNode(vjp_fn, diff_inputs, out_avals, single, cls.__name__)
+        for i, o in enumerate(out_list):
+            if isinstance(o, Tensor):
+                o._node = node
+                o._out_idx = i
+                o.stop_gradient = False
+        return outs
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+
+def hessian(func, xs, batch_axis=None):
+    """Dense hessian via jax.hessian over raw buffers (functional API)."""
+    xs_is_seq = isinstance(xs, (list, tuple))
+    arrs = [x._data for x in (xs if xs_is_seq else [xs])]
+
+    def f(*a):
+        t = [Tensor(ai, _internal=True, stop_gradient=False) for ai in a]
+        out = func(*t) if xs_is_seq else func(t[0])
+        return out._data if isinstance(out, Tensor) else out
+
+    h = jax.hessian(f, argnums=tuple(range(len(arrs))))(*arrs)
+    import jax.tree_util as jtu
+
+    return jtu.tree_map(lambda a: Tensor(a, _internal=True), h)
+
+
+def jacobian(func, xs, batch_axis=None):
+    xs_is_seq = isinstance(xs, (list, tuple))
+    arrs = [x._data for x in (xs if xs_is_seq else [xs])]
+
+    def f(*a):
+        t = [Tensor(ai, _internal=True, stop_gradient=False) for ai in a]
+        out = func(*t) if xs_is_seq else func(t[0])
+        return out._data if isinstance(out, Tensor) else out
+
+    j = jax.jacrev(f, argnums=tuple(range(len(arrs))))(*arrs)
+    import jax.tree_util as jtu
+
+    return jtu.tree_map(lambda a: Tensor(a, _internal=True), j)

@@ -1,0 +1,5 @@
+from . import dtype, device, flags
+from .tensor import Tensor, Parameter, to_tensor
+from .dispatch import no_grad, enable_grad, set_grad_enabled, op_call, grad_enabled
+from .engine import run_backward, grad
+from .rng import seed, get_rng_state, set_rng_state

@@ -1,0 +1,231 @@
+"""Op dispatch: the single funnel every eager op goes through.
+
+Reference parity: this is the TPU-native analog of the generated
+`<op>_ad_func` + KernelFactory dispatch chain
+(/root/reference/paddle/fluid/eager/auto_code_generator/generator/eager_gen.py,
+/root/reference/paddle/phi/core/kernel_factory.h:326). Instead of a kernel
+registry keyed by (name, backend, layout, dtype), every op is a pure jax
+function; XLA is the kernel zoo. Autograd recording happens here: when any
+floating input requires grad, the forward runs through jax.vjp and the
+returned vjp closure (holding residuals on-device) becomes the GradNode —
+the analog of TensorWrapper-saved inputs
+(/root/reference/paddle/fluid/eager/tensor_wrapper.h:39).
+
+The same funnel implements `to_static` capture: an active TraceContext is
+notified of every concrete-valued Tensor read (a "capture", i.e. a free
+variable of the traced program: parameters, optimizer state, RNG key) and
+every in-place mutation (a program output to write back).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+
+from . import dtype as dtypes
+from .flags import flag
+
+_tls = threading.local()
+
+
+# ---------------------------------------------------------------- grad mode
+def grad_enabled() -> bool:
+    return getattr(_tls, "grad_enabled", True)
+
+
+@contextlib.contextmanager
+def set_grad_enabled(mode: bool):
+    old = grad_enabled()
+    _tls.grad_enabled = mode
+    try:
+        yield
+    finally:
+        _tls.grad_enabled = old
+
+
+class no_grad(contextlib.ContextDecorator):
+    """paddle.no_grad: usable as context manager and decorator."""
+
+    def __enter__(self):
+        self._old = grad_enabled()
+        _tls.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _tls.grad_enabled = self._old
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._old = grad_enabled()
+        _tls.grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _tls.grad_enabled = self._old
+        return False
+
+
+# ---------------------------------------------------------------- tracing
+class TraceContext:
+    """Active while paddle_tpu.jit.to_static discovers/retraces a program.
+
+    phase == "discover": eager run; concrete Tensors read by ops are recorded
+    as program inputs, in-place writes as program outputs.
+    phase == "trace": running under jax.jit; captured Tensors carry tracers in
+    ._data (bound by the jit wrapper), so ops Just Work.
+    """
+
+    def __init__(self, phase: str):
+        self.phase = phase
+        self.captures: dict[int, Any] = {}  # id(tensor) -> tensor (ordered)
+        self.mutated: dict[int, Any] = {}
+
+    def on_read(self, tensor):
+        if self.phase == "discover" and not isinstance(tensor._data, jax.core.Tracer):
+            self.captures.setdefault(id(tensor), tensor)
+
+    def on_mutate(self, tensor):
+        self.mutated.setdefault(id(tensor), tensor)
+
+
+def current_trace() -> TraceContext | None:
+    return getattr(_tls, "trace_ctx", None)
+
+
+@contextlib.contextmanager
+def trace_context(ctx: TraceContext):
+    old = current_trace()
+    _tls.trace_ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _tls.trace_ctx = old
+
+
+# ---------------------------------------------------------------- autograd tape
+class GradNode:
+    """One recorded op on the tape (≙ GradNodeBase, grad_node_info.h:197)."""
+
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "single_out", "name", "__weakref__")
+
+    def __init__(self, vjp_fn, inputs, out_avals, single_out, name):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # list[Tensor] — differentiable inputs, positional
+        self.out_avals = out_avals  # list[(shape, dtype)]
+        self.single_out = single_out
+        self.name = name
+
+
+_amp_dtype_for = None
+
+
+def _is_tensor(x) -> bool:
+    from .tensor import Tensor
+
+    return isinstance(x, Tensor)
+
+
+def _check_nan_inf(name, arrs):
+    import jax.numpy as jnp
+
+    for a in arrs:
+        if dtypes.is_floating_point(a.dtype) and not isinstance(a, jax.core.Tracer):
+            if bool(jnp.any(~jnp.isfinite(a))):
+                raise FloatingPointError(f"Operator '{name}' output contains NaN/Inf")
+
+
+def op_call(fn: Callable, *args, name: str | None = None, n_diff: int | None = None):
+    """Run pure jax function `fn` over mixed Tensor/raw args, recording autograd.
+
+    Args after position `n_diff` (when given) are never differentiated —
+    use for index/shape/flag operands. Returns Tensor or tuple[Tensor].
+    """
+    from .tensor import Tensor
+
+    name = name or getattr(fn, "__name__", "op")
+    trace = current_trace()
+
+    datas = []
+    for a in args:
+        if _is_tensor(a):
+            if trace is not None:
+                trace.on_read(a)
+            datas.append(a._data)
+        else:
+            datas.append(a)
+
+    # AMP O1/O2 input casting (paddle: amp_auto_cast.h logic inlined in ad_funcs)
+    global _amp_dtype_for
+    if _amp_dtype_for is None:
+        from ..amp import amp_dtype_for as _adf
+
+        _amp_dtype_for = _adf
+    target = _amp_dtype_for(name)
+    if target is not None:
+        # cast inside the differentiated fn so vjp returns grads in the
+        # original param dtype (cast is part of the recorded graph)
+        inner_fn = fn
+
+        def fn(*vals):  # noqa: F811
+            vals = [
+                v.astype(target)
+                if hasattr(v, "dtype") and dtypes.is_floating_point(v.dtype)
+                and v.dtype != target else v
+                for v in vals
+            ]
+            return inner_fn(*vals)
+
+    limit = len(args) if n_diff is None else n_diff
+    diff_idx = []
+    if grad_enabled():
+        for i, a in enumerate(args[:limit]):
+            if _is_tensor(a) and not a.stop_gradient and dtypes.is_floating_point(a.dtype):
+                diff_idx.append(i)
+
+    if not diff_idx:
+        out = fn(*datas)
+        return _wrap_outputs(out, None, name)
+
+    if len(diff_idx) == len(datas):
+        primal_fn = fn
+        diff_vals = datas
+    else:
+        def primal_fn(*dvals):
+            vals = list(datas)
+            for i, v in zip(diff_idx, dvals):
+                vals[i] = v
+            return fn(*vals)
+
+        diff_vals = [datas[i] for i in diff_idx]
+
+    out, vjp_fn = jax.vjp(primal_fn, *diff_vals)
+
+    single = not isinstance(out, (tuple, list))
+    outs = [out] if single else list(out)
+    avals = [(o.shape, o.dtype) for o in outs]
+    node = GradNode(vjp_fn, [args[i] for i in diff_idx], avals, single, name)
+    return _wrap_outputs(out, node, name)
+
+
+def _wrap_outputs(out, node, name):
+    from .tensor import Tensor
+
+    if flag("FLAGS_check_nan_inf"):
+        flat = [out] if not isinstance(out, (tuple, list)) else list(out)
+        _check_nan_inf(name, [o for o in flat if hasattr(o, "dtype")])
+
+    def mk(o, idx):
+        t = Tensor(o, stop_gradient=node is None, _internal=True)
+        if node is not None:
+            t._node = node
+            t._out_idx = idx
+        return t
+
+    if not isinstance(out, (tuple, list)):
+        return mk(out, 0)
+    return tuple(mk(o, i) for i, o in enumerate(out))

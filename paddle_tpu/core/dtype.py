@@ -1,0 +1,97 @@
+"""Dtype surface mirroring paddle's dtype API on top of numpy/jax dtypes.
+
+Reference parity: paddle exposes paddle.float32 etc. as DataType enum values
+(/root/reference/python/paddle/framework/dtype.py). Here dtypes ARE numpy dtypes
+(what jax consumes natively) so no conversion layer is needed on the hot path.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+bool_ = np.dtype(np.bool_)
+uint8 = np.dtype(np.uint8)
+int8 = np.dtype(np.int8)
+int16 = np.dtype(np.int16)
+int32 = np.dtype(np.int32)
+int64 = np.dtype(np.int64)
+float16 = np.dtype(np.float16)
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+float32 = np.dtype(np.float32)
+float64 = np.dtype(np.float64)
+complex64 = np.dtype(np.complex64)
+complex128 = np.dtype(np.complex128)
+float8_e4m3fn = np.dtype(ml_dtypes.float8_e4m3fn)
+float8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+
+_STR2DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+    "fp16": float16,
+    "bf16": bfloat16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+_COMPLEX = {complex64, complex128}
+_INTEGER = {uint8, int8, int16, int32, int64}
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize str / np.dtype / jnp scalar type / paddle-style name to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _STR2DTYPE[dtype]
+        except KeyError:
+            return np.dtype(dtype)
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name
+
+
+def is_floating_point(dtype) -> bool:
+    return convert_dtype(dtype) in _FLOATING
+
+
+def is_complex(dtype) -> bool:
+    return convert_dtype(dtype) in _COMPLEX
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in _INTEGER or d == bool_
+
+
+# paddle.get_default_dtype / set_default_dtype
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    _default_dtype = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def promote_types(a, b):
+    return jnp.promote_types(a, b)

@@ -1,0 +1,153 @@
+"""Reverse-mode autograd engine: reverse-topological walk over GradNodes.
+
+Reference parity: egr::RunBackward (paddle/fluid/eager/backward.cc:105) —
+in-degree counted over the reachable subgraph, queue-driven, with
+GradTensorHolder-style accumulation and per-tensor hooks. Cotangents for
+non-differentiable (integer) op outputs use jax's float0 convention.
+"""
+from __future__ import annotations
+
+from collections import defaultdict, deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from .dispatch import GradNode, no_grad
+from .tensor import Tensor
+
+
+def _zero_cotangent(shape, dtype):
+    if dtypes.is_floating_point(dtype) or dtypes.is_complex(dtype):
+        return jnp.zeros(shape, dtype)
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def _accum(slot, value):
+    return value if slot is None else slot + value
+
+
+def run_backward(root: Tensor, grad_tensor=None, retain_graph: bool = False):
+    if root.stop_gradient:
+        raise RuntimeError(
+            "Tensor.backward() on a tensor with stop_gradient=True — nothing to do"
+        )
+    if grad_tensor is None:
+        if root.size != 1:
+            raise RuntimeError(
+                f"grad must be provided for non-scalar backward root (shape {root.shape})"
+            )
+        seed = jnp.ones(root._data.shape, root._data.dtype)
+    else:
+        seed = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    if root._node is None:
+        root._grad = Tensor(_accum(root._grad._data if root._grad else None, seed), _internal=True)
+        return
+
+    # -- collect reachable graph + consumer counts
+    root_node = root._node
+    nodes: set[int] = set()
+    consumers: dict[int, int] = defaultdict(int)  # id(node) -> #edges from reachable consumers
+    stack = [root_node]
+    node_by_id: dict[int, GradNode] = {}
+    while stack:
+        n = stack.pop()
+        if id(n) in nodes:
+            continue
+        nodes.add(id(n))
+        node_by_id[id(n)] = n
+        for t in n.inputs:
+            pn = t._node
+            if pn is not None:
+                consumers[id(pn)] += 1
+                if id(pn) not in nodes:
+                    stack.append(pn)
+
+    pending: dict[int, list] = {
+        nid: [None] * len(node_by_id[nid].out_avals) for nid in nodes
+    }
+    pending[id(root_node)][root._out_idx] = _accum(
+        pending[id(root_node)][root._out_idx], seed
+    )
+    remaining = dict(consumers)
+
+    queue = deque()
+    if remaining.get(id(root_node), 0) == 0:
+        queue.append(root_node)
+
+    with no_grad():
+        while queue:
+            node = queue.popleft()
+            outs = pending.pop(id(node))
+            cots = [
+                g if g is not None else _zero_cotangent(shape, dt)
+                for g, (shape, dt) in zip(outs, node.out_avals)
+            ]
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    "Trying to backward through the graph a second time; "
+                    "call backward(retain_graph=True) the first time."
+                )
+            cot = cots[0] if node.single_out else tuple(cots)
+            in_grads = node.vjp_fn(cot)
+            if not retain_graph:
+                node.vjp_fn = None
+            for t, g in zip(node.inputs, in_grads):
+                if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
+                    continue
+                for hook in t._hooks:
+                    out = hook(Tensor(g, _internal=True))
+                    if out is not None:
+                        g = out._data if isinstance(out, Tensor) else out
+                pn = t._node
+                if pn is None:
+                    if not t.stop_gradient:
+                        t._grad = Tensor(
+                            _accum(t._grad._data if t._grad else None, g), _internal=True
+                        )
+                else:
+                    if t._retain_grads:
+                        t._grad = Tensor(
+                            _accum(t._grad._data if t._grad else None, g), _internal=True
+                        )
+                    if id(pn) in pending:
+                        pending[id(pn)][t._out_idx] = _accum(pending[id(pn)][t._out_idx], g)
+                        remaining[id(pn)] -= 1
+                        if remaining[id(pn)] == 0:
+                            queue.append(pn)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False,
+         allow_unused=False):
+    """paddle.grad — functional gradient of outputs w.r.t. inputs.
+
+    create_graph is not yet supported (single-level tape); double grad goes
+    through paddle_tpu.incubate.autograd jax transforms instead.
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use paddle_tpu.incubate.autograd (jax.grad composition)"
+        )
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+
+    saved = [(t._grad, t._retain_grads) for t in inputs]
+    for t in inputs:
+        t._grad = None
+        t._retain_grads = True
+    try:
+        for o, go in zip(outputs, grad_outputs):
+            run_backward(o, go, retain_graph=True if len(outputs) > 1 else retain_graph)
+        result = []
+        for t in inputs:
+            if t._grad is None and not allow_unused:
+                raise RuntimeError(f"input {t.name} unused in graph (allow_unused=False)")
+            result.append(t._grad)
+    finally:
+        for t, (g, r) in zip(inputs, saved):
+            t._grad, t._retain_grads = g, r
+    return result

@@ -1,0 +1,70 @@
+"""Global flag registry.
+
+Reference parity: paddle's gflags-compatible registry (paddle/common/flags.h:38,
+flags.cc: 187 PHI_DEFINE_EXPORTED_* definitions) exposed through
+paddle.set_flags/get_flags and FLAGS_* env vars. Same surface here; flags also
+seed from the environment at import.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+_REGISTRY: dict[str, dict[str, Any]] = {}
+
+
+def define_flag(name: str, default, doc: str = ""):
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    env = os.environ.get(name)
+    value = default
+    if env is not None:
+        value = _parse(env, default)
+    _REGISTRY[name] = {"value": value, "default": default, "doc": doc}
+    return value
+
+
+def _parse(text: str, default):
+    if isinstance(default, bool):
+        return text.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(text)
+    if isinstance(default, float):
+        return float(text)
+    return text
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        if not k.startswith("FLAGS_"):
+            k = "FLAGS_" + k
+        if k not in _REGISTRY:
+            _REGISTRY[k] = {"value": v, "default": v, "doc": "(ad-hoc)"}
+        else:
+            _REGISTRY[k]["value"] = v
+
+
+def get_flags(flags) -> dict:
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        key = k if k.startswith("FLAGS_") else "FLAGS_" + k
+        out[k] = _REGISTRY[key]["value"]
+    return out
+
+
+def flag(name: str):
+    key = name if name.startswith("FLAGS_") else "FLAGS_" + name
+    return _REGISTRY[key]["value"]
+
+
+# Core flags (subset of reference's 187; grows as subsystems land).
+define_flag("FLAGS_check_nan_inf", False, "scan every op output for nan/inf")
+define_flag("FLAGS_use_compiled_eager", False, "jit-compile per-op eager dispatch")
+define_flag("FLAGS_eager_cache_size", 4096, "per-op executable cache entries")
+define_flag("FLAGS_to_static_donate", True, "donate captured buffers in to_static")
+define_flag("FLAGS_log_level", 0, "VLOG-style verbosity")
+define_flag("FLAGS_cudnn_deterministic", False, "parity shim; XLA is deterministic")
+define_flag("FLAGS_embedding_deterministic", False, "parity shim")
+define_flag("FLAGS_allocator_strategy", "xla", "parity shim; XLA owns allocation")

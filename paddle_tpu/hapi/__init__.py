@@ -1,0 +1,3 @@
+class Model:  # placeholder until hapi lands
+    def __init__(self, *a, **k):
+        raise NotImplementedError("hapi.Model: landing later this round")

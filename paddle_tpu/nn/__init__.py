@@ -1,0 +1,59 @@
+"""paddle_tpu.nn (≙ python/paddle/nn)."""
+from . import functional
+from . import initializer
+from .layer_base import Layer
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+from .layer.container import LayerDict, LayerList, ParameterList, Sequential
+from .layer.common import (
+    AlphaDropout, Bilinear, CosineSimilarity, Dropout, Dropout2D, Dropout3D,
+    Embedding, Flatten, Identity, Linear, Pad1D, Pad2D, Pad3D, PixelShuffle,
+    PixelUnshuffle, Unflatten, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
+    ZeroPad2D,
+)
+from .layer.conv import Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose
+from .layer.norm import (
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm, InstanceNorm1D,
+    InstanceNorm2D, InstanceNorm3D, LayerNorm, LocalResponseNorm, RMSNorm,
+    SpectralNorm, SyncBatchNorm,
+)
+from .layer.pooling import (
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+    AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    MaxPool1D, MaxPool2D, MaxPool3D,
+)
+from .layer.activation import (
+    CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh, LeakyReLU,
+    LogSigmoid, LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6, RReLU, SELU, SiLU,
+    Sigmoid, Softmax, Softplus, Softshrink, Softsign, Swish, Tanh, Tanhshrink,
+    ThresholdedReLU,
+)
+from .layer.loss import (
+    BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss, CrossEntropyLoss,
+    HingeEmbeddingLoss, KLDivLoss, L1Loss, MarginRankingLoss, MSELoss, NLLLoss,
+    SmoothL1Loss, TripletMarginLoss,
+)
+from .layer.transformer import (
+    MultiHeadAttention, Transformer, TransformerDecoder, TransformerDecoderLayer,
+    TransformerEncoder, TransformerEncoderLayer,
+)
+from .layer.rnn import GRU, GRUCell, LSTM, LSTMCell, SimpleRNN
+
+from ..core.tensor import Parameter
+
+
+class ParamAttr:
+    """≙ paddle.ParamAttr — bundle of name/initializer/lr/regularizer/trainable."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+def utils_weight_norm(*a, **k):
+    raise NotImplementedError("weight_norm: planned")

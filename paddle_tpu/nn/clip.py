@@ -1,0 +1,95 @@
+"""Gradient clipping (≙ python/paddle/nn/clip.py). Applied by optimizers to
+(param, grad) lists before update; one fused jnp expression so XLA emits a
+single kernel chain per step."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import no_grad
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, params_grads):
+        with no_grad():
+            out = []
+            for p, g in params_grads:
+                if g is None:
+                    out.append((p, g))
+                    continue
+                out.append((p, Tensor(jnp.clip(g._data, self.min, self.max), _internal=True)))
+            return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        with no_grad():
+            out = []
+            for p, g in params_grads:
+                if g is None:
+                    out.append((p, g))
+                    continue
+                n = jnp.sqrt(jnp.sum(jnp.square(g._data.astype(jnp.float32))))
+                scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+                out.append((p, Tensor((g._data * scale).astype(g._data.dtype), _internal=True)))
+            return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        with no_grad():
+            grads = [g for _, g in params_grads if g is not None]
+            if not grads:
+                return params_grads
+            sq = sum(jnp.sum(jnp.square(g._data.astype(jnp.float32))) for g in grads)
+            gnorm = jnp.sqrt(sq)
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+            out = []
+            for p, g in params_grads:
+                if g is None:
+                    out.append((p, g))
+                else:
+                    out.append((p, Tensor((g._data * scale.astype(jnp.float32)).astype(
+                        g._data.dtype), _internal=True)))
+            return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple))
+                          else [parameters]) if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()), _internal=True)
+    with no_grad():
+        if norm_type == float("inf"):
+            total = jnp.max(jnp.stack([jnp.max(jnp.abs(p.grad._data)) for p in params]))
+        else:
+            total = jnp.power(sum(jnp.sum(jnp.power(jnp.abs(p.grad._data.astype(jnp.float32)),
+                                                    norm_type)) for p in params),
+                              1.0 / norm_type)
+        scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-12), 1.0)
+        for p in params:
+            p.grad._assign_raw((p.grad._data * scale).astype(p.grad._data.dtype))
+    return Tensor(total, _internal=True)
+
+
+def clip_grad_value_(parameters, clip_value):
+    params = parameters if isinstance(parameters, (list, tuple)) else [parameters]
+    with no_grad():
+        for p in params:
+            if p.grad is not None:
+                p.grad._assign_raw(jnp.clip(p.grad._data, -clip_value, clip_value))

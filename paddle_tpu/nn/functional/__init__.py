@@ -1,0 +1,1073 @@
+"""paddle_tpu.nn.functional (≙ python/paddle/nn/functional).
+
+Every function is a jnp/lax composition through op_call, so XLA fuses them;
+attention has a Pallas fast path (paddle_tpu/ops/pallas_ops.py) on real TPU.
+"""
+from __future__ import annotations
+
+import math as _pymath
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtype as dtypes
+from ...core.dispatch import op_call
+from ...core.rng import next_key
+from ...core.tensor import Tensor
+from ...ops._helpers import norm_axis
+
+# ------------------------------------------------------------------ activations
+def relu(x, name=None):
+    return op_call(jax.nn.relu, x, name="relu")
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._assign_raw(out._data)
+    x._node, x._out_idx = out._node, out._out_idx
+    return x
+
+
+def relu6(x, name=None):
+    return op_call(jax.nn.relu6, x, name="relu6")
+
+
+def gelu(x, approximate=False, name=None):
+    return op_call(lambda a: jax.nn.gelu(a, approximate=approximate), x, name="gelu")
+
+
+def silu(x, name=None):
+    return op_call(jax.nn.silu, x, name="silu")
+
+
+swish = silu
+
+
+def mish(x, name=None):
+    return op_call(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x, name="mish")
+
+
+def sigmoid(x, name=None):
+    return op_call(jax.nn.sigmoid, x, name="sigmoid")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return op_call(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x, name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return op_call(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x, name="hardswish")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return op_call(lambda a: jnp.clip(a, min, max), x, name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return op_call(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x, name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return op_call(
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)),
+        x, name="softshrink")
+
+
+def tanhshrink(x, name=None):
+    return op_call(lambda a: a - jnp.tanh(a), x, name="tanhshrink")
+
+
+def elu(x, alpha=1.0, name=None):
+    return op_call(lambda a: jax.nn.elu(a, alpha), x, name="elu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return op_call(lambda a: jax.nn.celu(a, alpha), x, name="celu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return op_call(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x, name="selu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return op_call(lambda a: jax.nn.leaky_relu(a, negative_slope), x, name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+            shape = [1] * a.ndim
+            shape[ch_axis] = -1
+            wb = w.reshape(shape)
+        return jnp.where(a > 0, a, wb * a)
+
+    return op_call(f, x, weight, name="prelu")
+
+
+def rrelu(x, lower=0.125, upper=0.3333333, training=False, name=None):
+    if training:
+        k = next_key()
+        return op_call(
+            lambda a: jnp.where(a >= 0, a,
+                                a * jax.random.uniform(k, a.shape, a.dtype, lower, upper)),
+            x, name="rrelu")
+    mid = (lower + upper) / 2
+    return op_call(lambda a: jnp.where(a >= 0, a, a * mid), x, name="rrelu")
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    from ...ops.math import softplus as _sp
+
+    return _sp(x, beta, threshold)
+
+
+def softsign(x, name=None):
+    return op_call(jax.nn.soft_sign, x, name="softsign")
+
+
+def tanh(x, name=None):
+    return op_call(jnp.tanh, x, name="tanh")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            a = a.astype(dtypes.convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+
+    return op_call(f, x, name="softmax")
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis, dtype)
+    x._assign_raw(out._data)
+    x._node, x._out_idx = out._node, out._out_idx
+    return x
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            a = a.astype(dtypes.convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return op_call(f, x, name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    k = next_key()
+
+    def f(a):
+        g = -jnp.log(-jnp.log(jax.random.uniform(k, a.shape, a.dtype) + 1e-20) + 1e-20)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y).at[
+                tuple(jnp.indices(y.shape)[i] if i != axis % y.ndim else
+                      jnp.broadcast_to(idx, y.shape) for i in range(y.ndim))
+            ].set(0)
+            onehot = jax.nn.one_hot(jnp.squeeze(idx, axis), y.shape[axis], axis=axis,
+                                    dtype=y.dtype)
+            y = onehot + jax.lax.stop_gradient(-y) + y  # straight-through
+        return y
+
+    return op_call(f, x, name="gumbel_softmax")
+
+
+def glu(x, axis=-1, name=None):
+    def f(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+
+    return op_call(f, x, name="glu")
+
+
+def swiglu(x, y=None, name=None):
+    """Fused SwiGLU (≙ paddle.incubate.nn.functional.swiglu)."""
+    if y is None:
+        def f(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+
+        return op_call(f, x, name="swiglu")
+    return op_call(lambda a, b: jax.nn.silu(a) * b, x, y, name="swiglu")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        newshape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(newshape), axis=ax + 1)
+
+    return op_call(f, x, name="maxout")
+
+
+# ------------------------------------------------------------------ linear/embed
+def linear(x, weight, bias=None, name=None):
+    """x @ W (+ b). Paddle weight layout: [in, out] (tensor.h matmul semantics)."""
+    if bias is None:
+        return op_call(lambda a, w: a @ w, x, weight, name="linear")
+    return op_call(lambda a, w, b: a @ w + b, x, weight, bias, name="linear")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(w, idx):
+        out = jnp.take(w, idx.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return op_call(f, weight, x, name="embedding", n_diff=1)
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.manipulation import one_hot as _oh
+
+    return _oh(x, num_classes)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *bias_):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bias_:
+            out = out + bias_[0]
+        return out
+
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return op_call(f, *args, name="bilinear")
+
+
+# ------------------------------------------------------------------ dropout
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x
+    k = next_key()
+
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in [ax % a.ndim for ax in axes] else 1
+                     for i, s in enumerate(a.shape)]
+        keep = jax.random.bernoulli(k, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return op_call(f, x, name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    k = next_key()
+    alpha = -1.7580993408473766
+
+    def f(a):
+        keep = jax.random.bernoulli(k, 1.0 - p, a.shape)
+        q = 1.0 - p
+        an = (q + alpha ** 2 * q * p) ** -0.5
+        bn = -an * alpha * p
+        return (jnp.where(keep, a, alpha) * an + bn).astype(a.dtype)
+
+    return op_call(f, x, name="alpha_dropout")
+
+
+# ------------------------------------------------------------------ normalization
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    nshape = (normalized_shape,) if isinstance(normalized_shape, int) else tuple(normalized_shape)
+    axes = tuple(range(-len(nshape), 0))
+
+    def f(a, *wb):
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return op_call(f, *args, name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """≙ paddle.incubate.nn.functional.fused_rms_norm — XLA fuses this chain."""
+
+    def f(a, *w):
+        var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        return out * w[0] if w else out
+
+    args = [x] + ([weight] if weight is not None else [])
+    return op_call(f, *args, name="rms_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None,
+               name=None):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    red_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        def f(a, *wb):
+            m = jnp.mean(a, axis=red_axes)
+            v = jnp.var(a, axis=red_axes)
+            return _bn_apply(a, m, v, wb, ch_axis, epsilon), m, v
+
+        args = [x] + [t for t in (weight, bias) if t is not None]
+        out, m, v = op_call(f, *args, name="batch_norm")
+        # update running stats in-place (paddle momentum convention)
+        from ...core.dispatch import no_grad
+
+        with no_grad():
+            n = int(np.prod([x.shape[i] for i in red_axes]))
+            unbiased = v * (n / max(n - 1, 1))
+            running_mean._assign_raw(running_mean._data * momentum + m._data * (1 - momentum))
+            running_var._assign_raw(running_var._data * momentum + unbiased._data * (1 - momentum))
+        return out
+
+    def f(a, rm, rv, *wb):
+        return _bn_apply(a, rm, rv, wb, ch_axis, epsilon)
+
+    args = [x, running_mean, running_var] + [t for t in (weight, bias) if t is not None]
+    return op_call(f, *args, name="batch_norm")
+
+
+def _bn_apply(a, m, v, wb, ch_axis, epsilon):
+    shape = [1] * a.ndim
+    shape[ch_axis] = -1
+    out = (a - m.reshape(shape)) * jax.lax.rsqrt(v.reshape(shape) + epsilon)
+    if len(wb) >= 1:
+        out = out * wb[0].reshape(shape)
+    if len(wb) >= 2:
+        out = out + wb[1].reshape(shape)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
+                  name=None):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    red_axes = tuple(i for i in range(2, x.ndim)) if ch_axis == 1 else tuple(
+        i for i in range(1, x.ndim - 1))
+
+    def f(a, *wb):
+        m = jnp.mean(a, axis=red_axes, keepdims=True)
+        v = jnp.var(a, axis=red_axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + eps)
+        shape = [1] * a.ndim
+        shape[ch_axis] = -1
+        if len(wb) >= 1:
+            out = out * wb[0].reshape(shape)
+        if len(wb) >= 2:
+            out = out + wb[1].reshape(shape)
+        return out
+
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return op_call(f, *args, name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW",
+               name=None):
+    def f(a, *wb):
+        if data_format == "NHWC":
+            a = jnp.moveaxis(a, -1, 1)
+        n, c = a.shape[0], a.shape[1]
+        g = num_groups
+        rest = a.shape[2:]
+        ag = a.reshape((n, g, c // g) + rest)
+        axes = tuple(range(2, ag.ndim))
+        m = jnp.mean(ag, axis=axes, keepdims=True)
+        v = jnp.var(ag, axis=axes, keepdims=True)
+        out = ((ag - m) * jax.lax.rsqrt(v + epsilon)).reshape(a.shape)
+        shape = [1, -1] + [1] * (a.ndim - 2)
+        if len(wb) >= 1:
+            out = out * wb[0].reshape(shape)
+        if len(wb) >= 2:
+            out = out + wb[1].reshape(shape)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return op_call(f, *args, name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
+                        name=None):
+    def f(a):
+        ch = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq = jnp.square(a)
+        pad = [(0, 0)] * a.ndim
+        pad[ch] = (size // 2, (size - 1) // 2)
+        sqp = jnp.pad(sq, pad)
+        win = sum(jax.lax.slice_in_dim(sqp, i, i + a.shape[ch], axis=ch)
+                  for i in range(size))
+        return a / jnp.power(k + alpha * win / size * size, beta) * 1.0
+
+    def f2(a):
+        ch = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq = jnp.square(a)
+        pad = [(0, 0)] * a.ndim
+        pad[ch] = (size // 2, (size - 1) // 2)
+        sqp = jnp.pad(sq, pad)
+        win = sum(jax.lax.slice_in_dim(sqp, i, i + a.shape[ch], axis=ch)
+                  for i in range(size))
+        div = jnp.power(k + alpha * win, beta)
+        return a / div
+
+    return op_call(f2, x, name="local_response_norm")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        n = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis, keepdims=True), 1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+
+    return op_call(f, x, name="normalize")
+
+
+# ------------------------------------------------------------------ conv / pool
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, nd,
+             name="conv"):
+    strides = _pair(stride, nd)
+    dil = _pair(dilation, nd)
+    if isinstance(padding, str):
+        pad = padding.upper()
+        if pad == "SAME":
+            pad = "SAME"
+        elif pad == "VALID":
+            pad = "VALID"
+    else:
+        p = _pair(padding, nd) if not (isinstance(padding, (list, tuple)) and
+                                       isinstance(padding[0], (list, tuple))) else padding
+        if isinstance(p[0], (list, tuple)):
+            pad = [tuple(pp) for pp in p]
+        elif len(p) == nd:
+            pad = [(pp, pp) for pp in p]
+        else:  # len == 2*nd
+            pad = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+
+    chars = "DHW"[3 - nd:]
+    if data_format in ("NCHW", "NCDHW", "NCL"):
+        dn_in = "NC" + chars
+    else:
+        dn_in = "N" + chars + "C"
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (dn_in, "OI" + chars, dn_in))
+
+    def f(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad, rhs_dilation=dil,
+            dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=None)
+        if b:
+            shape = [1] * out.ndim
+            ch_axis = 1 if dn_in.startswith("NC") else out.ndim - 1
+            shape[ch_axis] = -1
+            out = out + b[0].reshape(shape)
+        return out
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return op_call(f, *args, name=name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 1,
+                    "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 2,
+                    "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 3,
+                    "conv3d")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCHW", output_size=None,
+                     name=None):
+    strides = _pair(stride, 2)
+    p = _pair(padding, 2)
+    dil = _pair(dilation, 2)
+
+    def f(a, w, *b):
+        # weight layout [in, out/groups, kh, kw] (paddle conv_transpose)
+        wt = jnp.swapaxes(w, 0, 1)  # -> [out/groups, in, kh, kw]
+        wt = jnp.flip(wt, axis=(-2, -1))
+        kh, kw = w.shape[-2], w.shape[-1]
+        pad_h = dil[0] * (kh - 1) - p[0]
+        pad_w = dil[1] * (kw - 1) - p[1]
+        dn = jax.lax.conv_dimension_numbers(
+            a.shape, wt.shape, ("NCHW", "OIHW", "NCHW"))
+        out = jax.lax.conv_general_dilated(
+            a, wt, window_strides=(1, 1),
+            padding=[(pad_h, pad_h + output_padding), (pad_w, pad_w + output_padding)],
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups)
+        if b:
+            out = out + b[0].reshape(1, -1, 1, 1)
+        return out
+
+    if data_format == "NHWC":
+        from ...ops.manipulation import transpose as _tp
+
+        x = _tp(x, [0, 3, 1, 2])
+        out = conv2d_transpose(x, weight, bias, stride, padding, output_padding,
+                               groups, dilation, "NCHW", output_size)
+        return _tp(out, [0, 2, 3, 1])
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return op_call(f, *args, name="conv2d_transpose")
+
+
+def _pool(x, kernel, stride, padding, nd, kind, data_format, ceil_mode=False,
+          exclusive=True, count_include_pad=False):
+    ks = _pair(kernel, nd)
+    st = _pair(stride if stride is not None else kernel, nd)
+    pd = _pair(padding, nd)
+    spatial_first = 2 if data_format.startswith("NC") else 1
+
+    window = [1] * x.ndim
+    strides = [1] * x.ndim
+    pads = [(0, 0)] * x.ndim
+    for i in range(nd):
+        window[spatial_first + i] = ks[i]
+        strides[spatial_first + i] = st[i]
+        pads[spatial_first + i] = (pd[i], pd[i])
+
+    def f(a):
+        if kind == "max":
+            init = -jnp.inf if dtypes.is_floating_point(a.dtype) else jnp.iinfo(a.dtype).min
+            return jax.lax.reduce_window(a, init, jax.lax.max, window, strides, pads)
+        s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
+        if exclusive and any(p[0] or p[1] for p in pads):
+            ones = jnp.ones_like(a)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+            return s / cnt
+        return s / float(np.prod(ks))
+
+    return op_call(f, x, name=f"{kind}_pool{nd}d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "max", data_format, ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "max", data_format, ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "max", data_format, ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", data_format, ceil_mode,
+                 exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", data_format, ceil_mode,
+                 exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", data_format, ceil_mode,
+                 exclusive)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg", "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "max", "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "max", "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "max", "NCDHW")
+
+
+def _adaptive_pool(x, output_size, nd, kind, data_format):
+    out_sz = _pair(output_size, nd)
+    spatial_first = 2 if data_format.startswith("NC") else 1
+
+    def f(a):
+        out = a
+        for i in range(nd):
+            ax = spatial_first + i
+            in_s = a.shape[ax]
+            o = out_sz[i] if out_sz[i] is not None else in_s
+            if in_s % o == 0:
+                k = in_s // o
+                shape = out.shape[:ax] + (o, k) + out.shape[ax + 1:]
+                r = out.reshape(shape)
+                out = jnp.max(r, axis=ax + 1) if kind == "max" else jnp.mean(r, axis=ax + 1)
+            else:
+                # general: gather windows per output index
+                starts = (np.arange(o) * in_s) // o
+                ends = ((np.arange(o) + 1) * in_s + o - 1) // o
+                slices = []
+                for s, e in zip(starts, ends):
+                    w = jax.lax.slice_in_dim(out, int(s), int(e), axis=ax)
+                    red = jnp.max(w, axis=ax, keepdims=True) if kind == "max" else \
+                        jnp.mean(w, axis=ax, keepdims=True)
+                    slices.append(red)
+                out = jnp.concatenate(slices, axis=ax)
+        return out
+
+    return op_call(f, x, name=f"adaptive_{kind}_pool{nd}d")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = _pair(kernel_sizes, 2)
+    st = _pair(strides, 2)
+    pd = _pair(paddings, 2)
+    dl = _pair(dilations, 2)
+
+    def f(a):
+        n, c, h, w = a.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=ks, window_strides=st,
+            padding=[(pd[0], pd[0]), (pd[1], pd[1])], rhs_dilation=dl,
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                a.shape, (1, 1) + ks, ("NCHW", "OIHW", "NCHW")))
+        # [N, C*kh*kw, oh, ow] -> [N, C*kh*kw, L]
+        return patches.reshape(n, c * ks[0] * ks[1], -1)
+
+    return op_call(f, x, name="unfold")
+
+
+# ------------------------------------------------------------------ padding / resize
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...ops.manipulation import pad as _pad
+
+    if isinstance(pad, (list, tuple)) and len(pad) == 2 * (x.ndim - 2) and x.ndim >= 3:
+        # paddle nn.functional.pad: pads innermost spatial dims, given
+        # [d_front, d_back, ..., w_left, w_right] for NC* layouts (reversed pairs)
+        nd = x.ndim - 2
+        pairs = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(nd)]
+        pairs = pairs[::-1] if data_format.startswith("NC") else pairs[::-1]
+        width = [(0, 0), (0, 0)] + pairs[::-1] if data_format.startswith("NC") else \
+            [(0, 0)] + pairs[::-1] + [(0, 0)]
+        flat = [v for pr in width for v in pr]
+        return _pad(x, flat, mode=mode, value=value)
+    return _pad(x, pad, mode=mode, value=value)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    def f(a):
+        nchw = data_format.startswith("NC")
+        if not nchw:
+            a = jnp.moveaxis(a, -1, 1)
+        spatial = a.shape[2:]
+        if size is not None:
+            out_sz = _pair(size, len(spatial))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+                [scale_factor] * len(spatial)
+            out_sz = tuple(int(s * f_) for s, f_ in zip(spatial, sf))
+        m = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        out = jax.image.resize(a, a.shape[:2] + out_sz, method=m)
+        if not nchw:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return op_call(f, x, name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        out = a.reshape(n, c // (r * r), r, r, h, w)
+        out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+        return out.reshape(n, c // (r * r), h * r, w * r)
+
+    return op_call(f, x, name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        out = a.reshape(n, c, h // r, r, w // r, r)
+        out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+        return out.reshape(n, c * r * r, h // r, w // r)
+
+    return op_call(f, x, name="pixel_unshuffle")
+
+
+# ------------------------------------------------------------------ losses
+def mse_loss(input, label, reduction="mean", name=None):
+    def f(a, b):
+        d = jnp.square(a - b)
+        return _reduce(d, reduction)
+
+    return op_call(f, input, label, name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return op_call(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label,
+                   name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta) * delta
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return op_call(f, input, label, name="smooth_l1_loss")
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    def f(logits, lab, *w):
+        lp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
+            jnp.maximum(logits, 1e-30))
+        if soft_label or (lab.ndim == logits.ndim and lab.shape == logits.shape):
+            tgt = lab
+            if label_smoothing:
+                n = logits.shape[axis]
+                tgt = tgt * (1 - label_smoothing) + label_smoothing / n
+            loss = -jnp.sum(tgt * lp, axis=axis)
+            return _reduce(loss, reduction)
+        li = lab
+        if li.ndim == logits.ndim:
+            li = jnp.squeeze(li, axis=axis)
+        li32 = li.astype(jnp.int32)
+        picked = jnp.take_along_axis(lp, jnp.expand_dims(li32, axis), axis=axis)
+        picked = jnp.squeeze(picked, axis=axis)
+        if label_smoothing:
+            n = logits.shape[axis]
+            smooth = jnp.mean(lp, axis=axis)
+            loss = -(1 - label_smoothing) * picked - label_smoothing * smooth
+        else:
+            loss = -picked
+        valid = li != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if w:
+            wt = jnp.take(w[0], jnp.clip(li32, 0, w[0].shape[0] - 1))
+            loss = loss * jnp.where(valid, wt, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(valid, wt, 0.0)), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return op_call(f, *args, name="cross_entropy", n_diff=1)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1,
+                               name=None):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    from ...ops.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    return cross_entropy(input, label, weight=weight, ignore_index=ignore_index,
+                         reduction=reduction, use_softmax=False, soft_label=False)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(a, b, *w):
+        eps = 1e-12
+        loss = -(b * jnp.log(jnp.maximum(a, eps)) +
+                 (1 - b) * jnp.log(jnp.maximum(1 - a, eps)))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return op_call(f, *args, name="binary_cross_entropy", n_diff=1)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, b, *rest):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]
+            i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        mx = jnp.maximum(z, 0)
+        base = mx - z * b + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pw is not None:
+            logsig = -jax.nn.softplus(-z)
+            log1msig = -jax.nn.softplus(z)
+            base = -(pw * b * logsig + (1 - b) * log1msig)
+        if w is not None:
+            base = base * w
+        return _reduce(base, reduction)
+
+    args = [logit, label] + [t for t in (weight, pos_weight) if t is not None]
+    return op_call(f, *args, name="bce_with_logits", n_diff=1)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(lp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - lp)
+        else:
+            loss = t * (jnp.log(jnp.maximum(t, 1e-12)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+
+    return op_call(f, input, label, name="kl_div")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.maximum(jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis), eps)
+        return num / den
+
+    return op_call(f, x1, x2, name="cosine_similarity")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean", name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return op_call(f, input1, input2, label, name="cosine_embedding_loss", n_diff=2)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return op_call(
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+        input, other, label, name="margin_ranking_loss", n_diff=2)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos) + epsilon, p), -1), 1 / p)
+        dn = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg) + epsilon, p), -1), 1 / p)
+        if swap:
+            dsn = jnp.power(jnp.sum(jnp.power(jnp.abs(pos - neg) + epsilon, p), -1), 1 / p)
+            dn = jnp.minimum(dn, dsn)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return op_call(f, input, positive, negative, name="triplet_margin_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return op_call(
+        lambda a, y: _reduce(jnp.where(y == 1, a, jnp.maximum(0.0, margin - a)), reduction),
+        input, label, name="hinge_embedding_loss", n_diff=1)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        pt = p * y + (1 - p) * (1 - y)
+        at = alpha * y + (1 - alpha) * (1 - y)
+        loss = at * jnp.power(1 - pt, gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return op_call(f, *args, name="sigmoid_focal_loss", n_diff=1)
+
+
+def square_error_cost(input, label, name=None):
+    return op_call(lambda a, b: jnp.square(a - b), input, label, name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return op_call(
+        lambda a, b: -b * jnp.log(a + epsilon) - (1 - b) * jnp.log(1 - a + epsilon),
+        input, label, name="log_loss")
+
+
+def ctc_loss(*args, **kwargs):
+    raise NotImplementedError("ctc_loss: planned (optax.ctc_loss wrapper)")
+
+
+# ------------------------------------------------------------------ attention
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """≙ paddle.nn.functional.scaled_dot_product_attention
+    (nn/functional/flash_attention.py:1139). Layout: [B, S, H, D] like paddle.
+    Uses the Pallas flash kernel on real TPU when available, else the XLA path
+    (which XLA fuses well on TPU)."""
+    from . import attention as _att
+
+    return _att.scaled_dot_product_attention(query, key, value, attn_mask,
+                                             dropout_p, is_causal, training)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    out = scaled_dot_product_attention(query, key, value, None, dropout, causal,
+                                       training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+# ------------------------------------------------------------------ embeddings/rope
+def rotary_position_embedding(q, k, cos, sin, name=None):
+    """≙ paddle.incubate.nn.functional.fused_rotary_position_embedding."""
+
+    def rot(a, c, s):
+        a1, a2 = jnp.split(a, 2, axis=-1)
+        rotated = jnp.concatenate([-a2, a1], axis=-1)
+        return a * c + rotated * s
+
+    qo = op_call(lambda a, c, s: rot(a, c, s), q, cos, sin, name="rope", n_diff=1)
+    ko = op_call(lambda a, c, s: rot(a, c, s), k, cos, sin, name="rope", n_diff=1)
+    return qo, ko
+
+
+# ------------------------------------------------------------------ misc
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(lab, *pd):
+        n = lab.shape[-1]
+        if pd:
+            return (1 - epsilon) * lab + epsilon * pd[0]
+        return (1 - epsilon) * lab + epsilon / n
+
+    args = [label] + ([prior_dist] if prior_dist is not None else [])
+    return op_call(f, *args, name="label_smooth")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def f(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                                 v[:, :-1, fold:2 * fold]], axis=1)
+        rest = v[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+
+    return op_call(f, x, name="temporal_shift")
+
+
+def linear_compat(x, weight, bias=None, name=None):
+    return linear(x, weight, bias)
+
+
+def embedding_renorm_(*a, **k):
+    raise NotImplementedError
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    def f(l):
+        m = maxlen or int(jnp.max(l))
+        return (jnp.arange(m)[None, :] < l[..., None]).astype(dtypes.convert_dtype(dtype))
+
+    return op_call(f, lengths, name="sequence_mask", n_diff=0)
+
+
+def class_center_sample(*a, **k):
+    raise NotImplementedError("class_center_sample: planned")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    def f(a, p, lab):
+        sim = a @ p.T
+        n = a.shape[0]
+        tgt = (lab[:, None] == lab[None, :]).astype(a.dtype)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        loss_ce = jnp.mean(jnp.sum(-tgt * jax.nn.log_softmax(sim, -1), -1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, -1)) + jnp.mean(jnp.sum(p * p, -1))) / 2
+        return loss_ce + reg
+
+    return op_call(f, anchor, positive, labels, name="npair_loss", n_diff=2)

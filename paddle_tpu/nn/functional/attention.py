@@ -1,0 +1,76 @@
+"""Attention kernels (≙ phi/kernels/fusion flash attention,
+nn/functional/flash_attention.py:358-1139).
+
+Layout convention follows paddle flash_attention: [batch, seqlen, heads, head_dim].
+Two paths:
+  - XLA path: jnp composition; XLA's TPU fusion handles the softmax(QK^T)V chain.
+  - Pallas path: tiled flash kernel (paddle_tpu/ops/pallas_attention.py) used on
+    real TPU for long sequences.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op_call
+from ...core.rng import next_key
+from ...core.tensor import Tensor
+
+
+def _xla_sdpa(q, k, v, mask, dropout_p, is_causal, dropout_key):
+    # q,k,v: [B, S, H, D] -> compute in [B, H, S, D]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    d = qt.shape[-1]
+    # GQA: broadcast kv heads if fewer than q heads
+    if kt.shape[1] != qt.shape[1]:
+        rep = qt.shape[1] // kt.shape[1]
+        kt = jnp.repeat(kt, rep, axis=1)
+        vt = jnp.repeat(vt, rep, axis=1)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / math.sqrt(d)
+    if is_causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        else:
+            scores = scores + mask
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(qt.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(probs.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)  # back to [B, S, H, D]
+
+
+def _use_pallas(q):
+    try:
+        from ...ops import pallas_attention
+
+        dev = jax.devices()[0].platform
+        return dev in ("tpu",) and q.shape[1] >= 128 and q.shape[-1] in (64, 128, 256)
+    except Exception:
+        return False
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True):
+    dk = next_key() if (dropout_p > 0.0 and training) else None
+    p = dropout_p if training else 0.0
+
+    if attn_mask is None and p == 0.0 and _use_pallas(query):
+        from ...ops.pallas_attention import flash_attention_op
+
+        return flash_attention_op(query, key, value, is_causal)
+
+    def f(q, k, v, *m):
+        return _xla_sdpa(q, k, v, m[0] if m else None, p, is_causal, dk)
+
+    args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
+    return op_call(f, *args, name="scaled_dot_product_attention", n_diff=3)

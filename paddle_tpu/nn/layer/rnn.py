@@ -1,0 +1,220 @@
+"""Recurrent layers via lax.scan (≙ python/paddle/nn/layer/rnn.py).
+
+TPU-first: the whole sequence loop is a single lax.scan — XLA compiles one
+fused loop body instead of per-step dispatch.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op_call
+from ...core.tensor import Tensor
+from ...ops.creation import zeros
+from ..initializer import Uniform
+from ..layer_base import Layer
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}[mode]
+        self._all_weights = []
+        std = 1.0 / math.sqrt(hidden_size)
+        for layer in range(num_layers):
+            for d in range(self.bidirect):
+                in_sz = input_size if layer == 0 else hidden_size * self.bidirect
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                w_ih = self.create_parameter((gate_mult * hidden_size, in_sz),
+                                             default_initializer=Uniform(-std, std))
+                w_hh = self.create_parameter((gate_mult * hidden_size, hidden_size),
+                                             default_initializer=Uniform(-std, std))
+                b_ih = self.create_parameter((gate_mult * hidden_size,), is_bias=True,
+                                             default_initializer=Uniform(-std, std))
+                b_hh = self.create_parameter((gate_mult * hidden_size,), is_bias=True,
+                                             default_initializer=Uniform(-std, std))
+                self.add_parameter(f"weight_ih{sfx}", w_ih)
+                self.add_parameter(f"weight_hh{sfx}", w_hh)
+                self.add_parameter(f"bias_ih{sfx}", b_ih)
+                self.add_parameter(f"bias_hh{sfx}", b_hh)
+                self._all_weights.append((f"weight_ih{sfx}", f"weight_hh{sfx}",
+                                          f"bias_ih{sfx}", f"bias_hh{sfx}"))
+
+    def _cell(self, mode):
+        if mode == "LSTM":
+            def step(carry, x_t, w_ih, w_hh, b_ih, b_hh):
+                h, c = carry
+                g = x_t @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+                i, f, gg, o = jnp.split(g, 4, axis=-1)
+                i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+                gg = jnp.tanh(gg)
+                c2 = f * c + i * gg
+                h2 = o * jnp.tanh(c2)
+                return (h2, c2), h2
+        elif mode == "GRU":
+            def step(carry, x_t, w_ih, w_hh, b_ih, b_hh):
+                h = carry[0]
+                gi = x_t @ w_ih.T + b_ih
+                gh = h @ w_hh.T + b_hh
+                ir, iz, inn = jnp.split(gi, 3, axis=-1)
+                hr, hz, hn = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                n = jnp.tanh(inn + r * hn)
+                h2 = (1 - z) * n + z * h
+                return (h2,), h2
+        else:
+            act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+
+            def step(carry, x_t, w_ih, w_hh, b_ih, b_hh):
+                h = carry[0]
+                h2 = act(x_t @ w_ih.T + b_ih + h @ w_hh.T + b_hh)
+                return (h2,), h2
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = self.mode
+        has_cell = mode == "LSTM"
+        step = self._cell(mode)
+        weights = [tuple(getattr(self, n) for n in names) for names in self._all_weights]
+
+        def run(x, *flat_w):
+            # x: [B, T, C] (or [T, B, C] if time_major)
+            if self.time_major:
+                xt = x
+            else:
+                xt = jnp.swapaxes(x, 0, 1)  # [T, B, C]
+            b = xt.shape[1]
+            wi = iter(flat_w)
+            layer_in = xt
+            last_h, last_c = [], []
+            for layer in range(self.num_layers):
+                outs_dir = []
+                for d in range(self.bidirect):
+                    w_ih, w_hh, b_ih, b_hh = next(wi), next(wi), next(wi), next(wi)
+                    h0 = jnp.zeros((b, self.hidden_size), x.dtype)
+                    carry = (h0, jnp.zeros_like(h0)) if has_cell else (h0,)
+                    seq = jnp.flip(layer_in, 0) if d == 1 else layer_in
+
+                    def body(c, xt_):
+                        return step(c, xt_, w_ih, w_hh, b_ih, b_hh)
+
+                    carry, ys = jax.lax.scan(body, carry, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    outs_dir.append(ys)
+                    last_h.append(carry[0])
+                    if has_cell:
+                        last_c.append(carry[1])
+                layer_in = jnp.concatenate(outs_dir, axis=-1) if self.bidirect == 2 \
+                    else outs_dir[0]
+            out = layer_in if self.time_major else jnp.swapaxes(layer_in, 0, 1)
+            hs = jnp.stack(last_h)
+            if has_cell:
+                return out, hs, jnp.stack(last_c)
+            return out, hs
+
+        flat = [w for ws in weights for w in ws]
+        res = op_call(run, inputs, *flat, name=mode.lower())
+        if has_cell:
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kw):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter((4 * hidden_size, input_size),
+                                               default_initializer=Uniform(-std, std))
+        self.weight_hh = self.create_parameter((4 * hidden_size, hidden_size),
+                                               default_initializer=Uniform(-std, std))
+        self.bias_ih = self.create_parameter((4 * hidden_size,), is_bias=True)
+        self.bias_hh = self.create_parameter((4 * hidden_size,), is_bias=True)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            b = inputs.shape[0]
+            states = (zeros([b, self.hidden_size], dtype=inputs.dtype),
+                      zeros([b, self.hidden_size], dtype=inputs.dtype))
+        h, c = states
+
+        def f(x, hh, cc, wi, wh, bi, bh):
+            g = x @ wi.T + bi + hh @ wh.T + bh
+            i, fo, gg, o = jnp.split(g, 4, axis=-1)
+            i, fo, o = jax.nn.sigmoid(i), jax.nn.sigmoid(fo), jax.nn.sigmoid(o)
+            c2 = fo * cc + i * jnp.tanh(gg)
+            h2 = o * jnp.tanh(c2)
+            return h2, c2
+
+        h2, c2 = op_call(f, inputs, h, c, self.weight_ih, self.weight_hh,
+                         self.bias_ih, self.bias_hh, name="lstm_cell")
+        return h2, (h2, c2)
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter((3 * hidden_size, input_size),
+                                               default_initializer=Uniform(-std, std))
+        self.weight_hh = self.create_parameter((3 * hidden_size, hidden_size),
+                                               default_initializer=Uniform(-std, std))
+        self.bias_ih = self.create_parameter((3 * hidden_size,), is_bias=True)
+        self.bias_hh = self.create_parameter((3 * hidden_size,), is_bias=True)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = zeros([inputs.shape[0], self.hidden_size], dtype=inputs.dtype)
+        h = states
+
+        def f(x, hh, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = hh @ wh.T + bh
+            ir, iz, inn = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(inn + r * hn)
+            return (1 - z) * n + z * hh
+
+        h2 = op_call(f, inputs, h, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh, name="gru_cell")
+        return h2, h2

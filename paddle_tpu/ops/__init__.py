@@ -1,0 +1,207 @@
+"""paddle_tpu.ops — the functional op library (≙ python/paddle/tensor/*).
+
+Importing this module also attaches operator methods to Tensor (the analog of
+the generated pybind tensor methods in eager_method.cc / eager_op_function.cc).
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.dispatch import op_call
+from ..core.tensor import Tensor, to_tensor
+
+from .math import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+
+from . import math as _math
+from . import creation as _creation
+from . import reduction as _reduction
+from . import manipulation as _manip
+from . import linalg as _linalg
+from . import random as _random
+from ._helpers import raw
+
+
+# ---------------------------------------------------------------- getitem/setitem
+def _norm_index(item):
+    """Convert a paddle-style index into a jax-compatible one; returns
+    (index, tensor_operands) where tensor indices stay live for tracing."""
+    if not isinstance(item, tuple):
+        item = (item,)
+    out = []
+    for it in item:
+        if isinstance(it, Tensor):
+            if it.dtype == dtypes.bool_:
+                out.append(np.asarray(it._data))  # bool mask: eager materialize
+            else:
+                out.append(it._data)
+        elif isinstance(it, (list, np.ndarray)):
+            out.append(np.asarray(it))
+        else:
+            out.append(it)
+    return tuple(out)
+
+
+def getitem(x, item):
+    idx = _norm_index(item)
+    return op_call(lambda a: a[idx], x, name="getitem")
+
+
+def setitem(x, item, value):
+    idx = _norm_index(item)
+    v = value._data if isinstance(value, Tensor) else value
+    x._assign_raw(x._data.at[idx].set(v))
+    return x
+
+
+def _tensor_to(x, *args, **kwargs):
+    """Tensor.to(device|dtype|tensor)."""
+    from ..core.device import CPUPlace, Place, TPUPlace
+
+    dtype = kwargs.get("dtype")
+    device = kwargs.get("device")
+    for a in args:
+        if isinstance(a, str):
+            if a in dtypes._STR2DTYPE or a in ("float64", "int32"):
+                dtype = a
+            else:
+                device = a
+        elif isinstance(a, (np.dtype, type)):
+            dtype = a
+        elif isinstance(a, Place):
+            device = a
+        elif isinstance(a, Tensor):
+            dtype = a.dtype
+    out = x
+    if dtype is not None:
+        out = cast(out, dtype)
+    if device is not None:
+        place = device if isinstance(device, Place) else (
+            CPUPlace() if str(device).startswith("cpu") else TPUPlace())
+        data = jax.device_put(out._data, place.jax_device)
+        t = Tensor(data, _internal=True, stop_gradient=out.stop_gradient)
+        t._node, t._out_idx = out._node, out._out_idx
+        out = t
+    return out
+
+
+# ---------------------------------------------------------------- dunder wiring
+def _swap(fn):
+    return lambda self, other: fn(_ensure(other, self), self)
+
+
+def _ensure(v, ref):
+    return v if isinstance(v, Tensor) else Tensor(
+        v, dtype=ref.dtype if isinstance(v, (int, float)) and not isinstance(v, bool)
+        and dtypes.is_floating_point(ref.dtype) else None)
+
+
+_METHODS = {
+    "__add__": lambda s, o: add(s, _ensure(o, s)),
+    "__radd__": lambda s, o: add(_ensure(o, s), s),
+    "__sub__": lambda s, o: subtract(s, _ensure(o, s)),
+    "__rsub__": lambda s, o: subtract(_ensure(o, s), s),
+    "__mul__": lambda s, o: multiply(s, _ensure(o, s)),
+    "__rmul__": lambda s, o: multiply(_ensure(o, s), s),
+    "__truediv__": lambda s, o: divide(s, _ensure(o, s)),
+    "__rtruediv__": lambda s, o: divide(_ensure(o, s), s),
+    "__floordiv__": lambda s, o: floor_divide(s, _ensure(o, s)),
+    "__rfloordiv__": lambda s, o: floor_divide(_ensure(o, s), s),
+    "__mod__": lambda s, o: mod(s, _ensure(o, s)),
+    "__rmod__": lambda s, o: mod(_ensure(o, s), s),
+    "__pow__": lambda s, o: pow(s, _ensure(o, s)),
+    "__rpow__": lambda s, o: pow(_ensure(o, s), s),
+    "__matmul__": lambda s, o: matmul(s, o),
+    "__rmatmul__": lambda s, o: matmul(o, s),
+    "__neg__": lambda s: neg(s),
+    "__abs__": lambda s: abs(s),
+    "__invert__": lambda s: logical_not(s) if s.dtype == dtypes.bool_ else bitwise_not(s),
+    "__eq__": lambda s, o: equal(s, _ensure(o, s)),
+    "__ne__": lambda s, o: not_equal(s, _ensure(o, s)),
+    "__lt__": lambda s, o: less_than(s, _ensure(o, s)),
+    "__le__": lambda s, o: less_equal(s, _ensure(o, s)),
+    "__gt__": lambda s, o: greater_than(s, _ensure(o, s)),
+    "__ge__": lambda s, o: greater_equal(s, _ensure(o, s)),
+    "__and__": lambda s, o: logical_and(s, _ensure(o, s)) if s.dtype == dtypes.bool_ else bitwise_and(s, _ensure(o, s)),
+    "__or__": lambda s, o: logical_or(s, _ensure(o, s)) if s.dtype == dtypes.bool_ else bitwise_or(s, _ensure(o, s)),
+    "__xor__": lambda s, o: logical_xor(s, _ensure(o, s)) if s.dtype == dtypes.bool_ else bitwise_xor(s, _ensure(o, s)),
+    "__getitem__": getitem,
+    "__setitem__": setitem,
+}
+
+for _n, _f in _METHODS.items():
+    setattr(Tensor, _n, _f)
+
+# attach functional ops as tensor methods (paddle exposes ~all of these)
+_METHOD_SOURCES = [_math, _creation, _reduction, _manip, _linalg, _random]
+_SKIP = {"zeros", "ones", "full", "empty", "arange", "linspace", "logspace", "eye",
+         "meshgrid", "to_tensor", "rand", "randn", "randint", "randperm", "tril_indices",
+         "triu_indices", "create_parameter", "scatter_nd", "uniform", "gaussian",
+         "standard_normal", "log_normal", "normal"}
+
+for _mod in _METHOD_SOURCES:
+    for _n in dir(_mod):
+        if _n.startswith("_") or _n in _SKIP:
+            continue
+        _f = getattr(_mod, _n)
+        if callable(_f) and not isinstance(_f, type) and not hasattr(Tensor, _n):
+            setattr(Tensor, _n, _f)
+
+# paddle-name aliases on Tensor
+Tensor.add_n = staticmethod(lambda xs: add_n(xs))
+Tensor.mean_all = lambda self: mean(self)
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    return op_call(lambda *arrs: builtins.sum(arrs[1:], arrs[0]), *list(inputs), name="add_n")
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, jnp.int64), _internal=True)
+
+
+def shape(x):
+    return Tensor(jnp.asarray(x.shape, jnp.int32), _internal=True)
+
+
+def rank(x):
+    return Tensor(jnp.asarray(x.ndim, jnp.int32), _internal=True)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_floating_point(x):
+    return dtypes.is_floating_point(x.dtype)
+
+
+def is_complex(x):
+    return dtypes.is_complex(x.dtype)
+
+
+def is_integer(x):
+    return dtypes.is_integer(x.dtype)
+
+
+def iinfo(dtype):
+    return np.iinfo(dtypes.convert_dtype(dtype))
+
+
+def finfo(dtype):
+    return jnp.finfo(dtypes.convert_dtype(dtype))
+
+
+Tensor.numel_t = numel
+setattr(Tensor, "astype", lambda self, dt: cast(self, dt))

@@ -1,0 +1,75 @@
+"""Shared op-definition helpers."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import op_call
+from ..core.tensor import Tensor
+
+
+def ensure_tensor(x, ref: Tensor | None = None):
+    if isinstance(x, Tensor):
+        return x
+    dtype = None
+    if ref is not None and isinstance(x, (int, float, bool)) and not isinstance(x, bool):
+        # scalar operand adopts the tensor operand's dtype family (paddle promotion)
+        dtype = ref.dtype
+    return Tensor(x, dtype=dtype)
+
+
+def raw(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def unary(jfn, opname):
+    def op(x, name=None):
+        return op_call(jfn, x, name=opname)
+
+    op.__name__ = opname
+    return op
+
+
+def binary(jfn, opname):
+    def op(x, y, name=None):
+        return op_call(jfn, x, y, name=opname)
+
+    op.__name__ = opname
+    return op
+
+
+def logical(jfn, opname):
+    """Comparison/logical op: never differentiated (bool/int output)."""
+
+    def op(x, y=None, name=None):
+        if y is None:
+            return op_call(jfn, x, name=opname, n_diff=0)
+        return op_call(jfn, x, y, name=opname, n_diff=0)
+
+    op.__name__ = opname
+    return op
+
+
+def norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def inplace_variant(fn):
+    """Build the paddle `op_`(in-place) from the functional op."""
+
+    def op_(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x._assign_raw(out._data)
+        # in-place on a graph-recorded tensor keeps the new node (paddle semantics)
+        x._node = out._node
+        x._out_idx = out._out_idx
+        x.stop_gradient = x.stop_gradient and out.stop_gradient
+        return x
+
+    op_.__name__ = fn.__name__ + "_"
+    return op_

@@ -1,0 +1,194 @@
+"""Tensor creation ops (≙ python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.dispatch import op_call
+from ..core.tensor import Tensor, to_tensor
+from ._helpers import raw
+
+
+def _dt(dtype):
+    return dtypes.convert_dtype(dtype) if dtype is not None else dtypes.get_default_dtype()
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(raw(s)) if not isinstance(s, int) else s for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)), _internal=True)
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)), _internal=True)
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None and isinstance(fill_value, bool):
+        dtype = dtypes.bool_
+    elif dtype is None and isinstance(fill_value, int):
+        dtype = dtypes.int64
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)), _internal=True)
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype, name)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return op_call(lambda a: jnp.zeros_like(a, dtype=dtypes.convert_dtype(dtype)), x,
+                   name="zeros_like", n_diff=0)
+
+
+def ones_like(x, dtype=None, name=None):
+    return op_call(lambda a: jnp.ones_like(a, dtype=dtypes.convert_dtype(dtype)), x,
+                   name="ones_like", n_diff=0)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return op_call(lambda a: jnp.full_like(a, fill_value, dtype=dtypes.convert_dtype(dtype)),
+                   x, name="full_like", n_diff=0)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype, name)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = (v.item() if isinstance(v, Tensor) else v for v in (start, end, step))
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = dtypes.int64 if all(
+            isinstance(v, (int, np.integer)) for v in (start, end, step)) else dtypes.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtypes.convert_dtype(dtype)), _internal=True)
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start, stop = (v.item() if isinstance(v, Tensor) else v for v in (start, stop))
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)), _internal=True)
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(raw(start), raw(stop), int(num), base=base, dtype=_dt(dtype)),
+                  _internal=True)
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), num_columns and int(num_columns), dtype=_dt(dtype)),
+                  _internal=True)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(a, bool), k=offset)
+                out = jnp.where(mask, out, padding_value)
+            return out
+        return jnp.diagonal(a, offset=offset)
+
+    return op_call(f, x, name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return op_call(lambda a: jnp.diagflat(a, k=offset), x, name="diagflat")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(a)
+        src = list(range(out.ndim))
+        d1 = dim1 % out.ndim
+        d2 = dim2 % out.ndim
+        perm = [d for d in src if d not in (out.ndim - 2, out.ndim - 1)]
+        # place last two dims at dim1/dim2
+        res = []
+        it = iter(perm)
+        for d in range(out.ndim):
+            if d == d1:
+                res.append(out.ndim - 2)
+            elif d == d2:
+                res.append(out.ndim - 1)
+            else:
+                res.append(next(it))
+        return jnp.transpose(out, res) if res != src else out
+
+    return op_call(f, x, name="diag_embed")
+
+
+def tril(x, diagonal=0, name=None):
+    return op_call(lambda a: jnp.tril(a, k=diagonal), x, name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return op_call(lambda a: jnp.triu(a, k=diagonal), x, name="triu")
+
+
+def tril_indices(row, col, offset=0, dtype="int64", name=None):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]).astype(dtypes.convert_dtype(dtype)), _internal=True)
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    r, c = jnp.triu_indices(row, k=offset, m=col or row)
+    return Tensor(jnp.stack([r, c]).astype(dtypes.convert_dtype(dtype)), _internal=True)
+
+
+def meshgrid(*args, name=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    outs = jnp.meshgrid(*[raw(a) for a in args], indexing="ij")
+    return [Tensor(o, _internal=True) for o in outs]
+
+
+def assign(x, output=None, name=None):
+    out = op_call(lambda a: a + 0 if hasattr(a, "dtype") else jnp.asarray(a), x, name="assign") \
+        if isinstance(x, Tensor) else Tensor(x)
+    if output is not None:
+        output._assign_raw(out._data)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def complex(real, imag, name=None):
+    return op_call(jax.lax.complex, real, imag, name="complex")
+
+
+def polar(abs_, angle, name=None):
+    return op_call(lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)),
+                   abs_, angle, name="polar")
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..core.tensor import Parameter
+
+    if default_initializer is not None:
+        t = default_initializer(shape, dtype)
+        data = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+    else:
+        data = jnp.zeros(_shape(shape), dtypes.convert_dtype(dtype)) if is_bias else \
+            jax.random.normal(jax.random.PRNGKey(0), _shape(shape)).astype(
+                dtypes.convert_dtype(dtype)) * 0.02
+    return Parameter(data, _internal=True)

@@ -1,0 +1,254 @@
+"""Linear algebra ops (≙ python/paddle/tensor/linalg.py; kernels: phi blas/
+lapack paths). matmul rides the MXU; paddle_tpu.linalg namespace re-exports."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op_call
+from ..core.tensor import Tensor
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return op_call(f, x, y, name="matmul")
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return op_call(jnp.matmul, x, y, name="bmm")
+
+
+def mv(x, vec, name=None):
+    return op_call(jnp.matmul, x, vec, name="mv")
+
+
+def dot(x, y, name=None):
+    return op_call(lambda a, b: jnp.sum(a * b, axis=-1), x, y, name="dot")
+
+
+def inner(x, y, name=None):
+    return op_call(jnp.inner, x, y, name="inner")
+
+
+def outer(x, y, name=None):
+    return op_call(lambda a, b: jnp.outer(a, b), x, y, name="outer")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return op_call(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y, name="addmm")
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+
+    return op_call(f, x, y, name="cross")
+
+
+def einsum(equation, *operands, name=None):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return op_call(lambda *arrs: jnp.einsum(equation, *arrs), *operands, name="einsum")
+
+
+def multi_dot(x, name=None):
+    return op_call(lambda *arrs: jnp.linalg.multi_dot(arrs), *list(x), name="multi_dot")
+
+
+def kron(x, y, name=None):
+    return op_call(jnp.kron, x, y, name="kron")
+
+
+# ---- decompositions / solvers (jnp.linalg; CPU fallback where XLA lacks TPU impl)
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2).conj() if upper else l
+
+    return op_call(f, x, name="cholesky")
+
+
+def qr(x, mode="reduced", name=None):
+    return op_call(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x, name="qr")
+
+
+def svd(x, full_matrices=False, name=None):
+    def f(a):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2).conj()
+
+    return op_call(f, x, name="svd")
+
+
+def svdvals(x, name=None):
+    return op_call(lambda a: jnp.linalg.svd(a, compute_uv=False), x, name="svdvals")
+
+
+def eig(x, name=None):
+    def f(a):
+        w, v = jnp.linalg.eig(a)
+        return w, v
+
+    return op_call(f, x, name="eig", n_diff=0)
+
+
+def eigh(x, UPLO="L", name=None):
+    return op_call(lambda a: tuple(jnp.linalg.eigh(a, symmetrize_input=True)), x, name="eigh")
+
+
+def eigvals(x, name=None):
+    return op_call(jnp.linalg.eigvals, x, name="eigvals", n_diff=0)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return op_call(jnp.linalg.eigvalsh, x, name="eigvalsh")
+
+
+def inverse(x, name=None):
+    return op_call(jnp.linalg.inv, x, name="inverse")
+
+
+inv = inverse
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return op_call(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x, name="pinv")
+
+
+def det(x, name=None):
+    return op_call(jnp.linalg.det, x, name="det")
+
+
+def slogdet(x, name=None):
+    return op_call(lambda a: tuple(jnp.linalg.slogdet(a)), x, name="slogdet")
+
+
+def solve(x, y, name=None):
+    return op_call(jnp.linalg.solve, x, y, name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+
+    return op_call(f, x, y, name="triangular_solve")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+
+    return op_call(f, x, y, name="cholesky_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+
+    return op_call(f, x, y, name="lstsq", n_diff=0)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, (piv + 1).astype(jnp.int32)
+
+    out = op_call(f, x, name="lu", n_diff=0)
+    if get_infos:
+        from .creation import zeros
+
+        return out[0], out[1], zeros([1], dtype="int32")
+    return out
+
+
+def matrix_power(x, n, name=None):
+    return op_call(lambda a: jnp.linalg.matrix_power(a, n), x, name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return op_call(lambda a: jnp.linalg.matrix_rank(a, tol=tol), x, name="matrix_rank", n_diff=0)
+
+
+def cond(x, p=None, name=None):
+    return op_call(lambda a: jnp.linalg.cond(a, p=p), x, name="cond", n_diff=0)
+
+
+def matrix_transpose(x, name=None):
+    return op_call(lambda a: jnp.swapaxes(a, -1, -2), x, name="matrix_transpose")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return op_call(lambda a: jnp.corrcoef(a, rowvar=rowvar), x, name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return op_call(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0),
+                   x, name="cov")
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.broadcast_to(jnp.eye(m, dtype=a.dtype), a.shape[:-2] + (m, m))
+
+        def body(i, q):
+            v = jnp.where(jnp.arange(m)[:, None] >= i, a[..., :, i:i + 1], 0.0)
+            v = v.at[..., i, 0].set(1.0) if v.ndim == 2 else v
+            h = jnp.eye(m, dtype=a.dtype) - t[..., i][..., None, None] * (v @ jnp.swapaxes(v, -1, -2))
+            return q @ h
+
+        q = eye
+        for i in range(n):
+            q = body(i, q)
+        return q[..., :, :n]
+
+    return op_call(f, x, tau, name="householder_product")
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    from .reduction import norm as _n
+
+    return _n(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def vector_norm(x, p=2, axis=None, keepdim=False, name=None):
+    from .reduction import norm as _n
+
+    return _n(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    from .reduction import norm as _n
+
+    return _n(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    raise NotImplementedError("histogramdd: planned")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def f(a):
+        if center:
+            a = a - a.mean(axis=-2, keepdims=True)
+        u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+        k = q or min(a.shape[-2:])
+        return u[..., :k], s[..., :k], jnp.swapaxes(vh, -1, -2)[..., :k]
+
+    return op_call(f, x, name="pca_lowrank", n_diff=0)

@@ -1,0 +1,610 @@
+"""Shape/layout/indexing ops (≙ python/paddle/tensor/manipulation.py).
+
+TPU note: all of these lower to XLA reshape/transpose/gather/scatter/dynamic
+-slice which are free or fused on TPU when shapes are static; nothing here
+materializes host-side.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.dispatch import op_call
+from ..core.tensor import Tensor
+from ._helpers import inplace_variant, norm_axis, raw
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.tolist())
+    out = []
+    for s in shape:
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+def cast(x, dtype, name=None):
+    dt = dtypes.convert_dtype(dtype)
+    if x.dtype == dt:
+        return x
+    if dtypes.is_floating_point(dt):
+        return op_call(lambda a: a.astype(dt), x, name="cast")
+    return op_call(lambda a: a.astype(dt), x, name="cast", n_diff=0)
+
+
+def reshape(x, shape, name=None):
+    shp = _static_shape(shape)
+    return op_call(lambda a: jnp.reshape(a, shp), x, name="reshape")
+
+
+def transpose(x, perm, name=None):
+    perm = [int(p) for p in perm]
+    return op_call(lambda a: jnp.transpose(a, perm), x, name="transpose")
+
+
+def t(x, name=None):
+    def f(a):
+        return a.T if a.ndim >= 2 else a
+
+    return op_call(f, x, name="t")
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        if nd == 0:
+            return a.reshape(1)
+        s0 = start_axis % nd
+        s1 = stop_axis % nd
+        newshape = a.shape[:s0] + (-1,) + a.shape[s1 + 1:]
+        return a.reshape(newshape)
+
+    return op_call(f, x, name="flatten")
+
+
+def squeeze(x, axis=None, name=None):
+    ax = norm_axis(axis)
+
+    def f(a):
+        if ax is None:
+            return jnp.squeeze(a)
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        axes = tuple(a_ % a.ndim for a_ in axes if a.shape[a_ % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+
+    return op_call(f, x, name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    ax = norm_axis(axis)
+
+    def f(a):
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        for a_ in sorted(a_ % (a.ndim + 1) for a_ in axes):
+            a = jnp.expand_dims(a, a_)
+        return a
+
+    return op_call(f, x, name="unsqueeze")
+
+
+def concat(x, axis=0, name=None):
+    tensors = list(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return op_call(lambda *arrs: jnp.concatenate(arrs, axis=ax), *tensors, name="concat")
+
+
+def stack(x, axis=0, name=None):
+    return op_call(lambda *arrs: jnp.stack(arrs, axis=axis), *list(x), name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        n_unknown = builtins.sum(1 for s in sizes if s < 0)
+        if n_unknown:
+            known = builtins.sum(s for s in sizes if s >= 0)
+            sizes = [s if s >= 0 else dim - known for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1])
+    outs = []
+    for off, sz in zip(offsets, sizes):
+        outs.append(op_call(lambda a, o=int(off), s=int(sz): jax.lax.slice_in_dim(a, o, o + s, axis=ax),
+                            x, name="split"))
+    return outs
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+    return [op_call(lambda a, i=i: jnp.take(a, i, axis=axis), x, name="unbind")
+            for i in range(n)]
+
+
+def tile(x, repeat_times, name=None):
+    reps = _static_shape(repeat_times)
+    return op_call(lambda a: jnp.tile(a, reps), x, name="tile")
+
+
+def expand(x, shape, name=None):
+    shp = _static_shape(shape)
+
+    def f(a):
+        target = list(shp)
+        # -1 keeps original dim
+        off = len(target) - a.ndim
+        for i in range(len(target)):
+            if target[i] == -1:
+                target[i] = a.shape[i - off]
+        return jnp.broadcast_to(a, target)
+
+    return op_call(f, x, name="expand")
+
+
+def expand_as(x, y, name=None):
+    return op_call(lambda a, b: jnp.broadcast_to(a, b.shape), x, y, name="expand_as", n_diff=1)
+
+
+broadcast_to = expand
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(t.shape) for t in inputs]
+    target = np.broadcast_shapes(*shapes)
+    return [op_call(lambda a: jnp.broadcast_to(a, target), t, name="broadcast_tensors")
+            for t in inputs]
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def flip(x, axis, name=None):
+    ax = norm_axis(axis)
+    return op_call(lambda a: jnp.flip(a, axis=ax), x, name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return op_call(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x, name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    ax = norm_axis(axis)
+    sh = tuple(shifts) if isinstance(shifts, (list, tuple)) else int(raw(shifts)) if not isinstance(shifts, int) else shifts
+    return op_call(lambda a: jnp.roll(a, sh, axis=ax), x, name="roll")
+
+
+def moveaxis(x, source, destination, name=None):
+    return op_call(lambda a: jnp.moveaxis(a, source, destination), x, name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return op_call(lambda a: jnp.swapaxes(a, axis0, axis1), x, name="swapaxes")
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return op_call(lambda a, i: jnp.take(a, i.astype(jnp.int32), axis=ax),
+                   x, index, name="gather", n_diff=1)
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        flat = idx.reshape(-1, k)
+        out = a[tuple(flat[:, i] for i in range(k))]
+        return out.reshape(idx.shape[:-1] + a.shape[k:])
+
+    return op_call(f, x, index, name="gather_nd", n_diff=1)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, idx, upd):
+        idx = idx.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return a.at[idx].set(upd)
+        return a.at[idx].add(upd)
+
+    return op_call(f, x, index, updates, name="scatter", n_diff=3)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._assign_raw(out._data)
+    x._node, x._out_idx = out._node, out._out_idx
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, idx, upd):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        flat = idx.reshape(-1, k)
+        updf = upd.reshape((-1,) + a.shape[k:])
+        return a.at[tuple(flat[:, i] for i in range(k))].add(updf)
+
+    return op_call(f, x, index, updates, name="scatter_nd_add", n_diff=3)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    z = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(z, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index, name=None):
+    def f(a, idx):
+        return jnp.take_along_axis(a, idx.astype(jnp.int32), axis=1)
+
+    return op_call(f, x, index, name="index_sample", n_diff=1)
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, idx, v):
+        am = jnp.moveaxis(a, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        out = am.at[idx.astype(jnp.int32)].add(vm)
+        return jnp.moveaxis(out, 0, axis)
+
+    return op_call(f, x, index, value, name="index_add", n_diff=3)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idxs = tuple(raw(i) for i in indices)
+
+    def f(a, v):
+        if accumulate:
+            return a.at[idxs].add(v)
+        return a.at[idxs].set(v)
+
+    return op_call(f, x, value, name="index_put", n_diff=2)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    def f(a, idx):
+        return jnp.take_along_axis(a, idx.astype(jnp.int32), axis=axis)
+
+    return op_call(f, arr, indices, name="take_along_axis", n_diff=1)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    def f(a, idx, v):
+        idx = idx.astype(jnp.int32)
+        if not isinstance(v, jnp.ndarray) or v.ndim == 0:
+            v = jnp.broadcast_to(v, idx.shape).astype(a.dtype)
+        at = _along_axis_at(a, idx, axis)
+        if reduce == "assign":
+            return at.set(v)
+        if reduce in ("add", "sum"):
+            return at.add(v)
+        if reduce in ("mul", "multiply"):
+            return at.multiply(v)
+        if reduce == "amax":
+            return at.max(v)
+        if reduce == "amin":
+            return at.min(v)
+        raise ValueError(reduce)
+
+    if isinstance(values, Tensor):
+        return op_call(f, arr, indices, values, name="put_along_axis", n_diff=3)
+    return op_call(lambda a, i: f(a, i, values), arr, indices, name="put_along_axis", n_diff=1)
+
+
+def _along_axis_at(a, idx, axis):
+    axis = axis % a.ndim
+    ii = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+    ii[axis] = idx
+    return a.at[tuple(ii)]
+
+
+def take(x, index, mode="raise", name=None):
+    def f(a, idx):
+        flat = a.reshape(-1)
+        i = idx.astype(jnp.int32)
+        if mode == "wrap":
+            i = jnp.mod(i, flat.shape[0])
+        elif mode == "clip":
+            i = jnp.clip(i, 0, flat.shape[0] - 1)
+        else:
+            i = jnp.where(i < 0, i + flat.shape[0], i)
+        return flat[i]
+
+    return op_call(f, x, index, name="take", n_diff=1)
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: eager-only op (documented; same limit as XLA)
+    data = np.asarray(x._data)[np.asarray(raw(mask))]
+    return Tensor(jnp.asarray(data), _internal=True)
+
+
+def masked_fill(x, mask, value, name=None):
+    v = raw(value) if isinstance(value, Tensor) else value
+    return op_call(lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a), x, mask,
+                   name="masked_fill", n_diff=1)
+
+
+def masked_scatter(x, mask, value, name=None):
+    data = np.asarray(x._data).copy()
+    m = np.asarray(raw(mask))
+    vals = np.asarray(raw(value)).reshape(-1)
+    data[m] = vals[: int(m.sum())]
+    return Tensor(jnp.asarray(data), _internal=True)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return op_call(lambda c, a, b: jnp.where(c, a, b), condition, x, y,
+                   name="where", n_diff=3)
+
+
+def nonzero(x, as_tuple=False, name=None):
+    idx = np.nonzero(np.asarray(raw(x)))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i), _internal=True) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1)), _internal=True)
+
+
+def slice(input, axes, starts, ends, name=None):
+    def f(a):
+        out = a
+        for ax, s, e in zip(axes, starts, ends):
+            s = int(raw(s)) if not isinstance(s, int) else s
+            e = int(raw(e)) if not isinstance(e, int) else e
+            dim = out.shape[ax]
+            s = builtins.max(s + dim, 0) if s < 0 else builtins.min(s, dim)
+            e = builtins.max(e + dim, 0) if e < 0 else builtins.min(e, dim)
+            out = jax.lax.slice_in_dim(out, s, e, axis=ax)
+        return out
+
+    return op_call(f, input, name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(int(raw(s)), int(raw(e)), int(raw(st)))
+        return a[tuple(idx)]
+
+    return op_call(f, x, name="strided_slice")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    padv = _static_shape(pad)
+
+    def f(a):
+        nd = a.ndim
+        if len(padv) == 2 * nd:
+            width = [(padv[2 * i], padv[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle convention: pad applies to last len(pad)//2 dims, reversed pairs
+            k = len(padv) // 2
+            width = [(0, 0)] * (nd - k) + [
+                (padv[2 * i], padv[2 * i + 1]) for i in range(k)
+            ]
+        if mode == "constant":
+            return jnp.pad(a, width, constant_values=value)
+        jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        return jnp.pad(a, width, mode=jmode)
+
+    return op_call(f, x, name="pad")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats._data)
+        data = np.repeat(np.asarray(x._data), reps, axis=axis)
+        return Tensor(jnp.asarray(data), _internal=True)
+    return op_call(lambda a: jnp.repeat(a, repeats, axis=axis), x, name="repeat_interleave")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    a = np.asarray(raw(x))
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        res = (res,)
+    outs = [Tensor(jnp.asarray(r), _internal=True) for r in res]
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    a = np.asarray(raw(x))
+    if axis is None:
+        a = a.reshape(-1)
+        keep = np.concatenate([[True], a[1:] != a[:-1]])
+        out = a[keep]
+        outs = [Tensor(jnp.asarray(out), _internal=True)]
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            outs.append(Tensor(jnp.asarray(inv), _internal=True))
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            cnt = np.diff(np.append(idx, a.size))
+            outs.append(Tensor(jnp.asarray(cnt), _internal=True))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError("unique_consecutive with axis")
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        out = jnp.sort(a, axis=axis, stable=True)
+        return jnp.flip(out, axis=axis) if descending else out
+
+    return op_call(f, x, name="sort")
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=axis, stable=True)
+        return jnp.flip(idx, axis=axis).astype(jnp.int64) if descending else idx.astype(jnp.int64)
+
+    return op_call(f, x, name="argsort", n_diff=0)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def f(seq, v):
+        side = "right" if right else "left"
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, v, side=side)
+        else:
+            out = jax.vmap(lambda s, vv: jnp.searchsorted(s, vv, side=side))(
+                seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1])
+            ).reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return op_call(f, sorted_sequence, values, name="searchsorted", n_diff=0)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def one_hot(x, num_classes, name=None):
+    return op_call(lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32), x,
+                   name="one_hot", n_diff=0)
+
+
+def tensordot(x, y, axes=2, name=None):
+    def f(a, b):
+        ax = axes
+        if isinstance(ax, (list, tuple)):
+            ax = tuple(tuple(int(i) for i in part) if isinstance(part, (list, tuple)) else int(part)
+                       for part in ax)
+        return jnp.tensordot(a, b, axes=ax)
+
+    return op_call(f, x, y, name="tensordot")
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    def f(a):
+        flat = a.reshape(-1)
+        idx = np.zeros(tuple(shape), dtype=np.int64) + offset
+        for d, (s, st) in enumerate(zip(shape, stride)):
+            r = np.arange(s) * st
+            idx += r.reshape([-1 if i == d else 1 for i in range(len(shape))])
+        return flat[jnp.asarray(idx)]
+
+    return op_call(f, x, name="as_strided")
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    dt = dtypes.convert_dtype(shape_or_dtype)
+    return op_call(lambda a: jax.lax.bitcast_convert_type(a, dt), x, name="view", n_diff=0)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def unfold(x, axis, size, step, name=None):
+    def f(a):
+        dim = a.shape[axis]
+        n = (dim - size) // step + 1
+        starts = jnp.arange(n) * step
+        idx = starts[:, None] + jnp.arange(size)[None, :]
+        out = jnp.take(a, idx.reshape(-1), axis=axis)
+        am = jnp.moveaxis(out, axis, 0).reshape((n, size) + tuple(
+            s for i, s in enumerate(a.shape) if i != axis % a.ndim))
+        # paddle returns windows appended as last dim, original axis replaced by n
+        am = jnp.moveaxis(am, 0, axis)  # (..., n at axis, size first)
+        return jnp.moveaxis(am, 1 if axis != 0 else 1, a.ndim)
+
+    return op_call(f, x, name="unfold")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shp = _static_shape(shape)
+    offs = _static_shape(offsets) if offsets is not None else (0,) * len(shp)
+
+    def f(a):
+        idx = tuple(builtins.slice(o, o + (s if s != -1 else a.shape[i] - o))
+                    for i, (o, s) in enumerate(zip(offs, shp)))
+        return a[idx]
+
+    return op_call(f, x, name="crop")
+
+
+def atleast_1d(*xs, name=None):
+    outs = [op_call(jnp.atleast_1d, x, name="atleast_1d") for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*xs, name=None):
+    outs = [op_call(jnp.atleast_2d, x, name="atleast_2d") for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*xs, name=None):
+    outs = [op_call(jnp.atleast_3d, x, name="atleast_3d") for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def hsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=2)
+
+
+def hstack(x, name=None):
+    return concat(x, axis=1 if x[0].ndim > 1 else 0)
+
+
+def vstack(x, name=None):
+    xs = [unsqueeze(t, 0) if t.ndim == 1 else t for t in x]
+    return concat(xs, axis=0)
+
+
+def dstack(x, name=None):
+    xs = [reshape(t, list(t.shape) + [1]) if t.ndim <= 2 else t for t in x]
+    return concat(xs, axis=2)
+
+
+def column_stack(x, name=None):
+    xs = [unsqueeze(t, 1) if t.ndim == 1 else t for t in x]
+    return concat(xs, axis=1)
+
+
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+def number_of_elements(x):
+    return x.size
+
+
+# in-place variants
+reshape_ = inplace_variant(reshape)
+squeeze_ = inplace_variant(squeeze)
+unsqueeze_ = inplace_variant(unsqueeze)
+flatten_ = inplace_variant(flatten)
+transpose_ = inplace_variant(transpose)
+cast_ = inplace_variant(cast)

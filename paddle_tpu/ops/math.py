@@ -1,0 +1,218 @@
+"""Elementwise & scalar math ops (≙ paddle/phi/kernels elementwise + activation
+kernels; python surface python/paddle/tensor/math.py). All are jnp/lax
+compositions — XLA fuses chains of these into single kernels on TPU."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op_call
+from ..core.tensor import Tensor
+from ._helpers import binary, ensure_tensor, inplace_variant, logical, norm_axis, unary
+
+_UNARY = {
+    "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log, "log2": jnp.log2,
+    "log10": jnp.log10, "log1p": jnp.log1p, "sqrt": jnp.sqrt,
+    "rsqrt": jax.lax.rsqrt, "square": jnp.square, "abs": jnp.abs,
+    "neg": jnp.negative, "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
+    "ceil": jnp.ceil, "floor": jnp.floor, "round": jnp.round,
+    "trunc": jnp.trunc, "frac": lambda x: x - jnp.trunc(x),
+    "sign": jnp.sign, "sigmoid": jax.nn.sigmoid,
+    "reciprocal": jnp.reciprocal, "erf": jax.lax.erf,
+    "erfinv": jax.lax.erf_inv, "lgamma": jax.lax.lgamma,
+    "digamma": jax.lax.digamma, "i0": lambda x: jnp.i0(x),
+    "rad2deg": jnp.rad2deg, "deg2rad": jnp.deg2rad,
+    "angle": jnp.angle, "conj": jnp.conj, "real": jnp.real, "imag": jnp.imag,
+    "exponential_": None,  # placeholder, removed below
+}
+del _UNARY["exponential_"]
+
+_BINARY = {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "divide": jnp.divide, "floor_divide": jnp.floor_divide,
+    "mod": jnp.mod, "remainder": jnp.mod, "floor_mod": jnp.mod,
+    "pow": jnp.power, "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "fmax": jnp.fmax, "fmin": jnp.fmin, "atan2": jnp.arctan2,
+    "heaviside": jnp.heaviside, "hypot": jnp.hypot,
+    "copysign": jnp.copysign, "nextafter": jnp.nextafter,
+    "logaddexp": jnp.logaddexp, "ldexp": lambda x, y: x * (2.0 ** y),
+}
+
+_LOGICAL_BIN = {
+    "equal": jnp.equal, "not_equal": jnp.not_equal,
+    "less_than": jnp.less, "less_equal": jnp.less_equal,
+    "greater_than": jnp.greater, "greater_equal": jnp.greater_equal,
+    "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+    "bitwise_and": jnp.bitwise_and, "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "gcd": jnp.gcd, "lcm": jnp.lcm,
+    "left_shift": jnp.left_shift, "right_shift": jnp.right_shift,
+}
+
+_LOGICAL_UN = {
+    "logical_not": jnp.logical_not, "bitwise_not": jnp.bitwise_not,
+    "isnan": jnp.isnan, "isinf": jnp.isinf, "isfinite": jnp.isfinite,
+    "signbit": jnp.signbit,
+}
+
+for _n, _f in _UNARY.items():
+    globals()[_n] = unary(_f, _n)
+for _n, _f in _BINARY.items():
+    globals()[_n] = binary(_f, _n)
+for _n, _f in _LOGICAL_BIN.items():
+    globals()[_n] = logical(_f, _n)
+for _n, _f in _LOGICAL_UN.items():
+    globals()[_n] = logical(_f, _n)
+
+# common aliases
+tanh_ = inplace_variant(globals()["tanh"])
+negative = globals()["neg"]
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = scale, bias
+    if bias_after_scale:
+        out = op_call(lambda a: a * s + b, x, name="scale")
+    else:
+        out = op_call(lambda a: (a + b) * s, x, name="scale")
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min._data if isinstance(min, Tensor) else min
+    hi = max._data if isinstance(max, Tensor) else max
+    return op_call(lambda a: jnp.clip(a, lo, hi), x, name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return op_call(lambda a, b, w: a + w * (b - a), x, y, weight, name="lerp")
+    return op_call(lambda a, b: a + weight * (b - a), x, y, name="lerp")
+
+
+def logit(x, eps=None, name=None):
+    def f(a):
+        z = jnp.clip(a, eps, 1 - eps) if eps else a
+        return jnp.log(z / (1 - z))
+
+    return op_call(f, x, name="logit")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return op_call(lambda a: scale_b * jnp.tanh(scale_a * a), x, name="stanh")
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return op_call(
+        lambda a: jnp.where(a * beta > threshold, a, jax.nn.softplus(a * beta) / beta),
+        x, name="softplus")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return op_call(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+                   x, name="nan_to_num")
+
+
+def increment(x, value=1.0, name=None):
+    x._assign_raw(x._data + value)
+    return x
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    ax = norm_axis(axis)
+
+    def f(a):
+        if ax is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=dtype)
+        return jnp.cumsum(a, axis=ax, dtype=dtype)
+
+    return op_call(f, x, name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    ax = norm_axis(dim)
+    return op_call(lambda a: jnp.cumprod(a.reshape(-1) if ax is None else a,
+                                         axis=0 if ax is None else ax, dtype=dtype),
+                   x, name="cumprod")
+
+
+def _scan_minmax(a, axis, is_max, dtype):
+    n = a.shape[axis]
+    shape = [1] * a.ndim
+    shape[axis] = -1
+    idx0 = jnp.broadcast_to(jnp.arange(n).reshape(shape), a.shape)
+
+    def comb(l, r):
+        lv, li = l
+        rv, ri = r
+        take_r = (rv >= lv) if is_max else (rv <= lv)
+        return jnp.where(take_r, rv, lv), jnp.where(take_r, ri, li)
+
+    v, i = jax.lax.associative_scan(comb, (a, idx0), axis=axis)
+    return v, i.astype(dtype)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        a2 = a.reshape(-1) if axis is None else a
+        return _scan_minmax(a2, 0 if axis is None else int(axis), True, dtype)
+
+    return op_call(f, x, name="cummax")
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        a2 = a.reshape(-1) if axis is None else a
+        return _scan_minmax(a2, 0 if axis is None else int(axis), False, dtype)
+
+    return op_call(f, x, name="cummin")
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    ax = norm_axis(axis)
+
+    def f(a):
+        a2 = a.reshape(-1) if ax is None else a
+        axx = 0 if ax is None else ax
+
+        def comb(l, r):
+            return jnp.logaddexp(l, r)
+
+        return jax.lax.associative_scan(comb, a2, axis=axx)
+
+    return op_call(f, x, name="logcumsumexp")
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return op_call(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                   x, y, name="isclose", n_diff=0)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return op_call(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                   x, y, name="allclose", n_diff=0)
+
+
+def equal_all(x, y, name=None):
+    return op_call(lambda a, b: jnp.array_equal(a, b), x, y, name="equal_all", n_diff=0)
+
+
+def multiplex(inputs, index, name=None):
+    def f(idx, *arrs):
+        stacked = jnp.stack(arrs)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (arrs[0].ndim - 1))).astype(jnp.int32), axis=0
+        )[0]
+
+    return op_call(lambda *a: f(a[0], *a[1:]), index, *inputs, name="multiplex", n_diff=0)
+
+
+# in-place variants (paddle `op_` convention)
+for _n in ("add", "subtract", "multiply", "divide", "clip", "scale", "exp",
+           "sqrt", "reciprocal", "round", "ceil", "floor", "sigmoid"):
+    globals()[_n + "_"] = inplace_variant(globals()[_n])

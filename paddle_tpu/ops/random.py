@@ -1,0 +1,135 @@
+"""Random ops threaded through the global trace-aware PRNG key
+(≙ python/paddle/tensor/random.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.dispatch import op_call
+from ..core.rng import next_key
+from ..core.tensor import Tensor
+from .creation import _dt, _shape
+
+
+def _mk(data):
+    return Tensor(data, _internal=True)
+
+
+def rand(shape, dtype=None, name=None):
+    return _mk(jax.random.uniform(next_key(), _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return _mk(jax.random.normal(next_key(), _shape(shape), _dt(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    return _mk(jax.random.uniform(next_key(), _shape(shape), _dt(dtype),
+                                  minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._assign_raw(jax.random.uniform(next_key(), tuple(x.shape), x._data.dtype,
+                                     minval=min, maxval=max))
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return _mk(jax.random.normal(next_key(), shp) * s + m)
+    return _mk(jax.random.normal(next_key(), _shape(shape)) * std + mean)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._assign_raw(jax.random.normal(next_key(), tuple(x.shape), x._data.dtype) * std + mean)
+    return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    return _mk(jax.random.normal(next_key(), _shape(shape), _dt(dtype)) * std + mean)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return _mk(jax.random.randint(next_key(), _shape(shape), low, high,
+                                  dtypes.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = dtypes.convert_dtype(dtype) if dtype else x.dtype
+    return _mk(jax.random.randint(next_key(), tuple(x.shape), low, high, dt))
+
+
+def randperm(n, dtype="int64", name=None):
+    return _mk(jax.random.permutation(next_key(), n).astype(dtypes.convert_dtype(dtype)))
+
+
+def shuffle(x, axis=0, name=None):
+    return op_call(lambda a, k: jax.random.permutation(k, a, axis=axis, independent=False),
+                   x, next_key(), name="shuffle", n_diff=1)
+
+
+def bernoulli(x, name=None):
+    return op_call(lambda a, k: jax.random.bernoulli(k, a).astype(a.dtype),
+                   x, next_key(), name="bernoulli", n_diff=0)
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x._assign_raw(jax.random.bernoulli(next_key(), p, tuple(x.shape)).astype(x._data.dtype))
+    return x
+
+
+def poisson(x, name=None):
+    return op_call(lambda a, k: jax.random.poisson(k, a).astype(a.dtype),
+                   x, next_key(), name="poisson", n_diff=0)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    def f(a, k):
+        logits = jnp.log(jnp.maximum(a, 1e-30))
+        if a.ndim == 1:
+            return jax.random.choice(k, a.shape[0], (num_samples,),
+                                     replace=replacement, p=a / a.sum()).astype(jnp.int64)
+        keys = jax.random.split(k, a.shape[0])
+        return jax.vmap(lambda kk, p: jax.random.choice(
+            kk, a.shape[-1], (num_samples,), replace=replacement, p=p / p.sum()))(
+            keys, a).astype(jnp.int64)
+
+    return op_call(f, x, next_key(), name="multinomial", n_diff=0)
+
+
+def rand_like(x, dtype=None, name=None):
+    dt = dtypes.convert_dtype(dtype) if dtype else x.dtype
+    return _mk(jax.random.uniform(next_key(), tuple(x.shape), dt))
+
+
+def randn_like(x, dtype=None, name=None):
+    dt = dtypes.convert_dtype(dtype) if dtype else x.dtype
+    return _mk(jax.random.normal(next_key(), tuple(x.shape), dt))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._assign_raw(jax.random.exponential(next_key(), tuple(x.shape), x._data.dtype) / lam)
+    return x
+
+
+def binomial(count, prob, name=None):
+    def f(n, p, k):
+        return jax.random.binomial(k, n, p).astype(jnp.int64)
+
+    return op_call(f, count, prob, next_key(), name="binomial", n_diff=0)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    return _mk(jnp.exp(jax.random.normal(next_key(), _shape(shape)) * std + mean))

@@ -1,0 +1,202 @@
+"""Reduction & search ops (≙ python/paddle/tensor/math.py reductions,
+stat.py, search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.dispatch import op_call
+from ..core.tensor import Tensor
+from ._helpers import norm_axis
+
+
+def _red(jfn, opname, int_promote=False):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        ax = norm_axis(axis)
+
+        def f(a):
+            if int_promote and dtypes.is_integer(a.dtype) and dtype is None:
+                a = a.astype(jnp.int64)
+            out = jfn(a, axis=ax, keepdims=keepdim)
+            if dtype is not None:
+                out = out.astype(dtypes.convert_dtype(dtype))
+            return out
+
+        return op_call(f, x, name=opname)
+
+    op.__name__ = opname
+    return op
+
+
+sum = _red(jnp.sum, "sum", int_promote=True)
+mean = _red(jnp.mean, "mean")
+prod = _red(jnp.prod, "prod", int_promote=True)
+amax = _red(jnp.max, "amax")
+amin = _red(jnp.min, "amin")
+nansum = _red(jnp.nansum, "nansum")
+nanmean = _red(jnp.nanmean, "nanmean")
+logsumexp = _red(jax.scipy.special.logsumexp, "logsumexp")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = norm_axis(axis)
+    return op_call(lambda a: jnp.max(a, axis=ax, keepdims=keepdim), x, name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = norm_axis(axis)
+    return op_call(lambda a: jnp.min(a, axis=ax, keepdims=keepdim), x, name="min")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = norm_axis(axis)
+    return op_call(lambda a: jnp.all(a, axis=ax, keepdims=keepdim), x, name="all", n_diff=0)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = norm_axis(axis)
+    return op_call(lambda a: jnp.any(a, axis=ax, keepdims=keepdim), x, name="any", n_diff=0)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    ax = norm_axis(axis)
+    return op_call(lambda a: jnp.argmax(a, axis=ax, keepdims=keepdim).astype(
+        dtypes.convert_dtype(dtype)), x, name="argmax", n_diff=0)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    ax = norm_axis(axis)
+    return op_call(lambda a: jnp.argmin(a, axis=ax, keepdims=keepdim).astype(
+        dtypes.convert_dtype(dtype)), x, name="argmin", n_diff=0)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = norm_axis(axis)
+    return op_call(lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim).astype(jnp.int64),
+                   x, name="count_nonzero", n_diff=0)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = norm_axis(axis)
+    return op_call(lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim),
+                   x, name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = norm_axis(axis)
+    return op_call(lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim),
+                   x, name="var")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = norm_axis(axis)
+    return op_call(lambda a: jnp.median(a, axis=ax, keepdims=keepdim), x, name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = norm_axis(axis)
+    return op_call(lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), x, name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = norm_axis(axis)
+    qv = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    return op_call(lambda a: jnp.quantile(a, qv, axis=ax, keepdims=keepdim,
+                                          method=interpolation), x, name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    ax = norm_axis(axis)
+    return op_call(lambda a: jnp.nanquantile(a, jnp.asarray(q), axis=ax, keepdims=keepdim),
+                   x, name="nanquantile")
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        srt = jnp.sort(a, axis=ax)
+        idx = jnp.argsort(a, axis=ax)
+        val = jnp.take(srt, k - 1, axis=ax)
+        ind = jnp.take(idx, k - 1, axis=ax)
+        if keepdim:
+            val = jnp.expand_dims(val, ax)
+            ind = jnp.expand_dims(ind, ax)
+        return val, ind.astype(jnp.int64)
+
+    return op_call(f, x, name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        av = jnp.moveaxis(a, ax, -1)
+        cnt = jnp.sum(av[..., :, None] == av[..., None, :], axis=-1)
+        best = jnp.argmax(cnt, axis=-1)
+        val = jnp.take_along_axis(av, best[..., None], axis=-1)[..., 0]
+        idx = jnp.argmax(av == val[..., None], axis=-1)
+        if keepdim:
+            val = jnp.expand_dims(val, ax)
+            idx = jnp.expand_dims(idx, ax)
+        return val, idx.astype(jnp.int64)
+
+    return op_call(f, x, name="mode")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def f(a):
+        ax = axis % a.ndim
+        am = jnp.moveaxis(a, ax, -1)
+        if largest:
+            v, i = jax.lax.top_k(am, k)
+        else:
+            v, i = jax.lax.top_k(-am, k)
+            v = -v
+        return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i, -1, ax).astype(jnp.int64)
+
+    return op_call(f, x, name="topk")
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    ax = norm_axis(axis)
+
+    def f(a):
+        if p in ("fro", None) and (ax is None or isinstance(ax, tuple)):
+            return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim))
+        if p == "nuc":
+            return jnp.sum(jnp.linalg.svd(a, compute_uv=False), axis=-1, keepdims=keepdim)
+        pv = float(p)
+        if pv == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if pv == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if pv == 0:
+            return jnp.sum(a != 0, axis=ax, keepdims=keepdim).astype(a.dtype)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), pv), axis=ax, keepdims=keepdim),
+                         1.0 / pv)
+
+    return op_call(f, x, name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    return norm(x - y, p=p)
+
+
+def histogram(x, bins=100, min=0, max=0, name=None):
+    def f(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        h, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
+        return h.astype(jnp.int64)
+
+    return op_call(f, x, name="histogram", n_diff=0)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    if weights is None:
+        return op_call(lambda a: jnp.bincount(a, minlength=minlength), x,
+                       name="bincount", n_diff=0)
+    return op_call(lambda a, w: jnp.bincount(a, w, minlength=minlength), x, weights,
+                   name="bincount", n_diff=0)

@@ -1,0 +1,442 @@
+"""Optimizers (≙ python/paddle/optimizer). Updates are single fused jnp
+expressions per parameter executed under no_grad; in to_static the whole
+optimizer step traces into the compiled program (the analog of paddle's fused
+multi-tensor adam paths — XLA fuses across parameters after donation)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.dispatch import no_grad
+from ..core.tensor import Parameter, Tensor
+from . import lr
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        self._lr = learning_rate
+        if parameters is None:
+            raise ValueError("parameters must be provided (dygraph mode)")
+        plist = list(parameters)
+        # parameter groups (paddle: list of dicts with 'params')
+        if plist and isinstance(plist[0], dict):
+            self._param_groups = plist
+            self._parameters = [p for g in plist for p in g["params"]]
+        else:
+            self._param_groups = [{"params": plist}]
+            self._parameters = plist
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._accumulators: dict[str, dict[int, Tensor]] = {}
+        self._master_weights: dict[int, Tensor] = {}
+        self._step_count = 0
+        # trace-threaded step counter: python ints would be baked as constants
+        # into to_static programs (Adam bias correction must advance per step)
+        self._step_t = Tensor(jnp.zeros((), jnp.float32), _internal=True)
+        self._lr_t = Tensor(jnp.asarray(
+            learning_rate() if isinstance(learning_rate, LRScheduler) else learning_rate,
+            jnp.float32), _internal=True)
+        if isinstance(learning_rate, LRScheduler):
+            import weakref
+
+            learning_rate._bound.append(weakref.ref(self))
+        self._aux_tensors: list[Tensor] = []
+
+    # ------------------------------------------------------------ lr
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return self._lr()
+        return self._lr
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = value
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # ------------------------------------------------------------ state
+    def _acc(self, kind, p, init=None, dtype=None):
+        store = self._accumulators.setdefault(kind, {})
+        key = id(p)
+        if key not in store:
+            dt = dtype or (dtypes.float32 if self._multi_precision and
+                           p.dtype in (dtypes.float16, dtypes.bfloat16) else p._data.dtype)
+            data = jnp.zeros(tuple(p.shape), dt) if init is None else init
+            t = Tensor(data, _internal=True)
+            store[key] = t
+            self._aux_tensors.append(t)
+        return store[key]
+
+    def _master(self, p):
+        if not self._multi_precision or p.dtype not in (dtypes.float16, dtypes.bfloat16):
+            return None
+        key = id(p)
+        if key not in self._master_weights:
+            t = Tensor(p._data.astype(jnp.float32), _internal=True)
+            self._master_weights[key] = t
+            self._aux_tensors.append(t)
+        return self._master_weights[key]
+
+    def state_dict(self):
+        out = {}
+        for kind, store in self._accumulators.items():
+            for p in self._parameters:
+                if id(p) in store:
+                    out[f"{p.name}_{kind}"] = store[id(p)]
+        for p in self._parameters:
+            if id(p) in self._master_weights:
+                out[f"{p.name}_master"] = self._master_weights[id(p)]
+        out["step"] = self._step_count
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        for kind, store in self._accumulators.items():
+            for p in self._parameters:
+                k = f"{p.name}_{kind}"
+                if k in state and id(p) in store:
+                    v = state[k]
+                    store[id(p)].set_value(v.numpy() if isinstance(v, Tensor) else v)
+        self._step_count = int(state.get("step", self._step_count))
+        if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state:
+            self._lr.set_state_dict(state["LR_Scheduler"])
+
+    set_dict = set_state_dict
+
+    # ------------------------------------------------------------ step
+    def _collect_params_grads(self):
+        pg = []
+        for group in self._param_groups:
+            for p in group["params"]:
+                if p.stop_gradient:
+                    continue
+                pg.append((p, p.grad, group))
+        return pg
+
+    def _lr_value(self):
+        """jnp scalar LR, trace-aware: outside a trace (or in discovery) the
+        tensor is refreshed from the scheduler, and the read is registered so
+        compiled programs take LR as an input — never a baked constant."""
+        from ..core.dispatch import current_trace
+
+        tr = current_trace()
+        if tr is None or tr.phase == "discover":
+            self._lr_t._data = jnp.asarray(self.get_lr(), jnp.float32)
+            if tr is not None:
+                tr.on_read(self._lr_t)
+        return self._lr_t._data
+
+    def step(self):
+        with no_grad():
+            pgs = self._collect_params_grads()
+            if self._grad_clip is not None:
+                clipped = self._grad_clip([(p, g) for p, g, _ in pgs])
+                pgs = [(p, g2, grp) for (p, _, grp), (_, g2) in zip(pgs, clipped)]
+            self._step_count += 1
+            self._step_t._assign_raw(self._step_t._data + 1.0)
+            lr_data = self._lr_value()
+            for p, g, group in pgs:
+                if g is None:
+                    continue
+                lr_val = group.get("learning_rate", 1.0) * lr_data \
+                    if "learning_rate" in group else lr_data
+                wd = group.get("weight_decay", self._weight_decay)
+                self._apply_one(p, g, lr_val, wd)
+
+    @no_grad()
+    def _apply_one(self, p, g, lr_val, wd):
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameters:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def _decay_l2(self, data, wd):
+        if wd is None:
+            return data * 0.0
+        w = wd if isinstance(wd, float) else getattr(wd, "_coeff", 0.0)
+        return data * w
+
+
+def _wd_coeff(wd):
+    if wd is None:
+        return 0.0
+    if isinstance(wd, (int, float)):
+        return float(wd)
+    return getattr(wd, "_coeff", 0.0)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         multi_precision)
+
+    def _apply_one(self, p, g, lr_val, wd):
+        gd = g._data.astype(jnp.float32) if self._multi_precision else g._data
+        master = self._master(p)
+        base = master._data if master is not None else p._data
+        gd = gd + _wd_coeff(wd) * base
+        new = base - lr_val * gd
+        if master is not None:
+            master._assign_raw(new)
+            p._assign_raw(new.astype(p._data.dtype))
+        else:
+            p._assign_raw(new.astype(p._data.dtype))
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _apply_one(self, p, g, lr_val, wd):
+        v = self._acc("velocity", p)
+        master = self._master(p)
+        base = master._data if master is not None else p._data
+        gd = g._data.astype(base.dtype) + _wd_coeff(wd) * base
+        vel = self._momentum * v._data + gd
+        v._assign_raw(vel)
+        if self._nesterov:
+            upd = gd + self._momentum * vel
+        else:
+            upd = vel
+        new = base - lr_val * upd
+        if master is not None:
+            master._assign_raw(new)
+        p._assign_raw(new.astype(p._data.dtype))
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _apply_one(self, p, g, lr_val, wd):
+        acc = self._acc("moment", p, init=jnp.full(tuple(p.shape), self._init_acc,
+                                                   p._data.dtype))
+        gd = g._data + _wd_coeff(wd) * p._data
+        new_acc = acc._data + jnp.square(gd)
+        acc._assign_raw(new_acc)
+        p._assign_raw(p._data - lr_val * gd / (jnp.sqrt(new_acc) + self._epsilon))
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _apply_one(self, p, g, lr_val, wd):
+        ms = self._acc("mean_square", p)
+        mom = self._acc("momentum", p)
+        gd = g._data + _wd_coeff(wd) * p._data
+        new_ms = self._rho * ms._data + (1 - self._rho) * jnp.square(gd)
+        ms._assign_raw(new_ms)
+        denom = new_ms
+        if self._centered:
+            mg = self._acc("mean_grad", p)
+            new_mg = self._rho * mg._data + (1 - self._rho) * gd
+            mg._assign_raw(new_mg)
+            denom = new_ms - jnp.square(new_mg)
+        upd = self._momentum * mom._data + lr_val * gd / jnp.sqrt(denom + self._epsilon)
+        mom._assign_raw(upd)
+        p._assign_raw(p._data - upd)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _apply_one(self, p, g, lr_val, wd):
+        avg_sq = self._acc("avg_squared_grad", p)
+        avg_upd = self._acc("avg_squared_update", p)
+        gd = g._data + _wd_coeff(wd) * p._data
+        new_sq = self._rho * avg_sq._data + (1 - self._rho) * jnp.square(gd)
+        upd = jnp.sqrt(avg_upd._data + self._epsilon) / jnp.sqrt(new_sq + self._epsilon) * gd
+        new_upd = self._rho * avg_upd._data + (1 - self._rho) * jnp.square(upd)
+        avg_sq._assign_raw(new_sq)
+        avg_upd._assign_raw(new_upd)
+        p._assign_raw(p._data - lr_val * upd)
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, use_multi_tensor=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+        self._decoupled_wd = False
+
+    def _apply_one(self, p, g, lr_val, wd):
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        master = self._master(p)
+        base = master._data if master is not None else p._data
+        comp_dt = base.dtype if master is not None else (
+            jnp.float32 if p.dtype in (dtypes.float16, dtypes.bfloat16) else base.dtype)
+        gd = g._data.astype(comp_dt)
+        if not self._decoupled_wd:
+            gd = gd + _wd_coeff(wd) * base.astype(comp_dt)
+        t = self._step_t._data
+        b1, b2 = self._beta1, self._beta2
+        new_m = b1 * m._data + (1 - b1) * gd
+        new_v = b2 * v._data + (1 - b2) * jnp.square(gd)
+        m._assign_raw(new_m)
+        v._assign_raw(new_v)
+        mhat = new_m / (1 - b1 ** t)
+        if self._amsgrad:
+            vmax = self._acc("moment2_max", p)
+            new_vmax = jnp.maximum(vmax._data, new_v)
+            vmax._assign_raw(new_vmax)
+            vhat = new_vmax / (1 - b2 ** t)
+        else:
+            vhat = new_v / (1 - b2 ** t)
+        step = lr_val * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        newb = base.astype(comp_dt)
+        if self._decoupled_wd:
+            newb = newb * (1.0 - lr_val * _wd_coeff(wd))
+        new = newb - step
+        if master is not None:
+            master._assign_raw(new)
+        p._assign_raw(new.astype(p._data.dtype))
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         amsgrad=amsgrad)
+        self._decoupled_wd = True
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _apply_one(self, p, g, lr_val, wd):
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+            wd = 0.0
+        if self._lr_ratio is not None:
+            lr_val = lr_val * self._lr_ratio(p)
+        super()._apply_one(p, g, lr_val, wd)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _apply_one(self, p, g, lr_val, wd):
+        m = self._acc("moment", p)
+        u = self._acc("inf_norm", p)
+        gd = g._data + _wd_coeff(wd) * p._data
+        new_m = self._beta1 * m._data + (1 - self._beta1) * gd
+        new_u = jnp.maximum(self._beta2 * u._data, jnp.abs(gd))
+        m._assign_raw(new_m)
+        u._assign_raw(new_u)
+        t = self._step_t._data
+        p._assign_raw(p._data - lr_val / (1 - self._beta1 ** t) * new_m /
+                      (new_u + self._epsilon))
+
+
+class NAdam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 momentum_decay=0.004, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._momentum_decay = momentum_decay
+
+    def _apply_one(self, p, g, lr_val, wd):
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        gd = g._data + _wd_coeff(wd) * p._data
+        t = self._step_t._data
+        b1, b2 = self._beta1, self._beta2
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (t * self._momentum_decay))
+        mu_t1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._momentum_decay))
+        new_m = b1 * m._data + (1 - b1) * gd
+        new_v = b2 * v._data + (1 - b2) * jnp.square(gd)
+        m._assign_raw(new_m)
+        v._assign_raw(new_v)
+        mhat = mu_t1 * new_m / (1 - mu_t * mu_t1) + (1 - mu_t) * gd / (1 - mu_t)
+        vhat = new_v / (1 - b2 ** t)
+        p._assign_raw(p._data - lr_val * mhat / (jnp.sqrt(vhat) + self._epsilon))
+
+
+class RAdam(Adam):
+    pass
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip, name,
+                         multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _apply_one(self, p, g, lr_val, wd):
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        gd = g._data.astype(jnp.float32)
+        t = self._step_t._data
+        b1, b2 = self._beta1, self._beta2
+        new_m = b1 * m._data + (1 - b1) * gd
+        new_v = b2 * v._data + (1 - b2) * jnp.square(gd)
+        m._assign_raw(new_m)
+        v._assign_raw(new_v)
+        mhat = new_m / (1 - b1 ** t)
+        vhat = new_v / (1 - b2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        wd_c = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) else self._wd
+        base = p._data.astype(jnp.float32)
+        upd = r + wd_c * base
+        wnorm = jnp.sqrt(jnp.sum(jnp.square(base)))
+        unorm = jnp.sqrt(jnp.sum(jnp.square(upd)))
+        trust = jnp.where((wnorm > 0) & (unorm > 0), wnorm / unorm, 1.0)
+        p._assign_raw((base - lr_val * trust * upd).astype(p._data.dtype))
+
+
+class LBFGS(Optimizer):
+    def __init__(self, *a, **k):
+        raise NotImplementedError("LBFGS: planned (jaxopt-style line search)")
