@@ -119,6 +119,7 @@ class GradScaler:
         self._good = Tensor(jnp.asarray(0, jnp.int32), _internal=True)
         self._bad = Tensor(jnp.asarray(0, jnp.int32), _internal=True)
         self._found_inf = Tensor(jnp.asarray(False), _internal=True)
+        self._unscaled: set[int] = set()  # optimizers already unscaled this step
 
     def is_enable(self):
         return self._enable
@@ -132,8 +133,9 @@ class GradScaler:
                                     _internal=True))
 
     def unscale_(self, optimizer):
-        if not self._enable:
+        if not self._enable or id(optimizer) in self._unscaled:
             return
+        self._unscaled.add(id(optimizer))
         with no_grad():
             inv = 1.0 / self._scale._data
             found = jnp.asarray(False)
@@ -151,9 +153,6 @@ class GradScaler:
         self.unscale_(optimizer)
         # conditional step: skip update when inf/nan found. Under trace this
         # becomes a jnp.where on every updated buffer via the mask trick.
-        if not isinstance(self._found_inf._data, jnp.ndarray) or \
-                not hasattr(self._found_inf._data, "aval"):
-            pass
         found = bool(self._found_inf._data) if not _is_tracer(self._found_inf._data) \
             else None
         if found is None:
@@ -169,6 +168,7 @@ class GradScaler:
             optimizer.step()
 
     def update(self):
+        self._unscaled.clear()
         if not self._enable or not self._dynamic:
             return
         with no_grad():

@@ -43,7 +43,7 @@ def run_backward(root: Tensor, grad_tensor=None, retain_graph: bool = False):
         seed = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
 
     if root._node is None:
-        root._grad = Tensor(_accum(root._grad._data if root._grad else None, seed), _internal=True)
+        root._grad = Tensor(_accum(root._grad._data if root._grad is not None else None, seed), _internal=True)
         return
 
     # -- collect reachable graph + consumer counts
@@ -105,12 +105,12 @@ def run_backward(root: Tensor, grad_tensor=None, retain_graph: bool = False):
                 if pn is None:
                     if not t.stop_gradient:
                         t._grad = Tensor(
-                            _accum(t._grad._data if t._grad else None, g), _internal=True
+                            _accum(t._grad._data if t._grad is not None else None, g), _internal=True
                         )
                 else:
                     if t._retain_grads:
                         t._grad = Tensor(
-                            _accum(t._grad._data if t._grad else None, g), _internal=True
+                            _accum(t._grad._data if t._grad is not None else None, g), _internal=True
                         )
                     if id(pn) in pending:
                         pending[id(pn)][t._out_idx] = _accum(pending[id(pn)][t._out_idx], g)
@@ -140,8 +140,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=Fa
         t._grad = None
         t._retain_grads = True
     try:
-        for o, go in zip(outputs, grad_outputs):
-            run_backward(o, go, retain_graph=True if len(outputs) > 1 else retain_graph)
+        for i, (o, go) in enumerate(zip(outputs, grad_outputs)):
+            last = i == len(outputs) - 1
+            run_backward(o, go, retain_graph=retain_graph if last else True)
         result = []
         for t in inputs:
             if t._grad is None and not allow_unused:

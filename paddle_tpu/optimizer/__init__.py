@@ -33,6 +33,7 @@ class Optimizer:
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
         self._accumulators: dict[str, dict[int, Tensor]] = {}
+        self._pending_state: dict[str, Tensor] = {}  # set_state_dict before first step
         self._master_weights: dict[int, Tensor] = {}
         self._step_count = 0
         # trace-threaded step counter: python ints would be baked as constants
@@ -69,7 +70,13 @@ class Optimizer:
         if key not in store:
             dt = dtype or (dtypes.float32 if self._multi_precision and
                            p.dtype in (dtypes.float16, dtypes.bfloat16) else p._data.dtype)
-            data = jnp.zeros(tuple(p.shape), dt) if init is None else init
+            pend = self._pending_state.pop(f"{p.name}_{kind}", None)
+            if pend is not None:
+                data = jnp.asarray(pend.numpy() if isinstance(pend, Tensor) else pend, dt)
+            elif init is None:
+                data = jnp.zeros(tuple(p.shape), dt)
+            else:
+                data = init() if callable(init) else init
             t = Tensor(data, _internal=True)
             store[key] = t
             self._aux_tensors.append(t)
@@ -80,13 +87,19 @@ class Optimizer:
             return None
         key = id(p)
         if key not in self._master_weights:
-            t = Tensor(p._data.astype(jnp.float32), _internal=True)
+            pend = self._pending_state.pop(f"{p.name}_master", None)
+            if pend is not None:
+                data = jnp.asarray(
+                    pend.numpy() if isinstance(pend, Tensor) else pend, jnp.float32)
+            else:
+                data = p._data.astype(jnp.float32)
+            t = Tensor(data, _internal=True)
             self._master_weights[key] = t
             self._aux_tensors.append(t)
         return self._master_weights[key]
 
     def state_dict(self):
-        out = {}
+        out = dict(self._pending_state)  # restored-but-not-yet-materialized
         for kind, store in self._accumulators.items():
             for p in self._parameters:
                 if id(p) in store:
@@ -94,19 +107,37 @@ class Optimizer:
         for p in self._parameters:
             if id(p) in self._master_weights:
                 out[f"{p.name}_master"] = self._master_weights[id(p)]
-        out["step"] = self._step_count
+        # the device-side counter is the truth: compiled train steps advance
+        # _step_t inside the XLA program without running this Python method
+        dev_step = int(np.asarray(self._step_t._data))
+        out["step"] = max(self._step_count, dev_step)
         if isinstance(self._lr, LRScheduler):
             out["LR_Scheduler"] = self._lr.state_dict()
         return out
 
     def set_state_dict(self, state):
+        consumed = set()
         for kind, store in self._accumulators.items():
             for p in self._parameters:
                 k = f"{p.name}_{kind}"
                 if k in state and id(p) in store:
                     v = state[k]
                     store[id(p)].set_value(v.numpy() if isinstance(v, Tensor) else v)
+                    consumed.add(k)
+        for p in self._parameters:
+            k = f"{p.name}_master"
+            if k in state and id(p) in self._master_weights:
+                v = state[k]
+                self._master_weights[id(p)].set_value(
+                    v.numpy() if isinstance(v, Tensor) else v)
+                consumed.add(k)
+        # not-yet-created accumulators: stash and materialize on first _acc
+        for k, v in state.items():
+            if k in consumed or k in ("step", "LR_Scheduler"):
+                continue
+            self._pending_state[k] = v
         self._step_count = int(state.get("step", self._step_count))
+        self._step_t._assign_raw(jnp.asarray(float(self._step_count), jnp.float32))
         if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state:
             self._lr.set_state_dict(state["LR_Scheduler"])
 
@@ -236,8 +267,8 @@ class Adagrad(Optimizer):
         self._init_acc = initial_accumulator_value
 
     def _apply_one(self, p, g, lr_val, wd):
-        acc = self._acc("moment", p, init=jnp.full(tuple(p.shape), self._init_acc,
-                                                   p._data.dtype))
+        acc = self._acc("moment", p, init=lambda: jnp.full(
+            tuple(p.shape), self._init_acc, p._data.dtype))
         gd = g._data + _wd_coeff(wd) * p._data
         new_acc = acc._data + jnp.square(gd)
         acc._assign_raw(new_acc)
@@ -387,16 +418,22 @@ class NAdam(Optimizer):
     def _apply_one(self, p, g, lr_val, wd):
         m = self._acc("moment1", p)
         v = self._acc("moment2", p)
+        # cumulative mu product accumulator (scalar per param)
+        mu_prod = self._acc("mu_product", p,
+                            init=lambda: jnp.ones((), jnp.float32), dtype=jnp.float32)
         gd = g._data + _wd_coeff(wd) * p._data
         t = self._step_t._data
         b1, b2 = self._beta1, self._beta2
         mu_t = b1 * (1 - 0.5 * 0.96 ** (t * self._momentum_decay))
         mu_t1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._momentum_decay))
+        new_mu_prod = mu_prod._data * mu_t
+        mu_prod._assign_raw(new_mu_prod)
         new_m = b1 * m._data + (1 - b1) * gd
         new_v = b2 * v._data + (1 - b2) * jnp.square(gd)
         m._assign_raw(new_m)
         v._assign_raw(new_v)
-        mhat = mu_t1 * new_m / (1 - mu_t * mu_t1) + (1 - mu_t) * gd / (1 - mu_t)
+        mhat = (mu_t1 * new_m / (1 - new_mu_prod * mu_t1)
+                + (1 - mu_t) * gd / (1 - new_mu_prod))
         vhat = new_v / (1 - b2 ** t)
         p._assign_raw(p._data - lr_val * mhat / (jnp.sqrt(vhat) + self._epsilon))
 

@@ -42,10 +42,12 @@ class LRScheduler:
 
     def state_dict(self):
         return {k: v for k, v in self.__dict__.items()
-                if isinstance(v, (int, float, bool, str, list))}
+                if k != "_bound" and isinstance(v, (int, float, bool, str, list))}
 
     def set_state_dict(self, state):
+        state = {k: v for k, v in state.items() if k != "_bound"}
         self.__dict__.update(state)
+        self._push()
 
     set_dict = set_state_dict
 

@@ -1,0 +1,45 @@
+"""Test configuration: run everything on a virtual 8-device CPU platform.
+
+Mirrors the reference's strategy of testing distributed logic without real
+fabric (/root/reference/test/legacy_test/test_dist_base.py:957 forks local
+processes; test/custom_runtime/ uses a fake CPU device plugin): here a single
+process gets 8 XLA CPU devices via --xla_force_host_platform_device_count, so
+mesh/sharding/collective tests exercise the real partitioner with no TPU.
+
+NOTE: this host's sitecustomize imports jax at interpreter start with the
+TPU-tunnel ("axon") platform selected, so JAX_PLATFORMS in os.environ is read
+before conftest runs. We therefore flip `jax.config.jax_platforms` directly —
+that controls which registered backend actually initializes (the tunnel client
+is only registered, never dialed).
+"""
+import os
+
+# must be set before the CPU client initializes (read at client creation)
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# this host's CPU backend defaults matmuls to a bf16-like fast path; parity
+# tests need exact fp32 (TPU runs keep the fast default)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np
+import pytest
+
+assert jax.devices()[0].platform == "cpu"
+assert len(jax.devices()) == 8, jax.devices()
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    yield

@@ -1,0 +1,183 @@
+"""Autograd engine tests.
+
+Parity model: reference eager backward (paddle/fluid/eager/backward.cc:105)
+semantics — leaf grad accumulation, retain_graph, hooks, no_grad, paddle.grad.
+Numeric ground truth is jax.grad over the same computation.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(x, sg=False):
+    return paddle.to_tensor(np.asarray(x, dtype=np.float32), stop_gradient=sg)
+
+
+def test_scalar_backward():
+    x = t([1.0, 2.0, 3.0])
+    y = paddle.sum(x * x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+
+def test_reused_input_accumulates():
+    w = t([[1.0, 2.0], [3.0, 4.0]])
+    loss = paddle.sum(paddle.matmul(w, w))
+    loss.backward()
+    ref = jax.grad(lambda w: jnp.sum(w @ w))(jnp.array([[1.0, 2.0], [3.0, 4.0]]))
+    np.testing.assert_allclose(w.grad.numpy(), np.asarray(ref))
+
+
+def test_grad_accumulation_across_backwards():
+    x = t([2.0])
+    (x * 3.0).backward()
+    (x * 4.0).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_chain_and_branching():
+    def f(a, b):
+        c = a * b
+        d = jnp.sin(c) + c
+        return jnp.sum(d * d)
+
+    a_np = np.random.randn(4).astype(np.float32)
+    b_np = np.random.randn(4).astype(np.float32)
+    a, b = t(a_np), t(b_np)
+    c = a * b
+    d = paddle.sin(c) + c
+    loss = paddle.sum(d * d)
+    loss.backward()
+    ga, gb = jax.grad(f, argnums=(0, 1))(jnp.asarray(a_np), jnp.asarray(b_np))
+    np.testing.assert_allclose(a.grad.numpy(), np.asarray(ga), rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(), np.asarray(gb), rtol=1e-5)
+
+
+def test_stop_gradient_blocks():
+    x = t([1.0, 2.0])
+    y = t([3.0, 4.0], sg=True)
+    loss = paddle.sum(x * y)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 4.0])
+    assert y.grad is None
+
+
+def test_detach_cuts_graph():
+    x = t([1.0, 2.0])
+    y = (x * 2.0).detach()
+    assert y.stop_gradient
+    z = x * 3.0
+    paddle.sum(z).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+
+def test_no_grad_context():
+    x = t([1.0])
+    with paddle.no_grad():
+        y = x * 2.0
+    assert y._node is None and y.stop_gradient
+    z = x * 2.0
+    assert z._node is not None
+
+
+def test_non_scalar_backward_requires_grad_tensor():
+    x = t([1.0, 2.0])
+    y = x * 2.0
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y.backward(paddle.to_tensor(np.ones(2, np.float32)))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_retain_graph():
+    x = t([3.0])
+    y = x * x
+    loss = paddle.sum(y)
+    loss.backward(retain_graph=True)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+    with pytest.raises(RuntimeError):
+        loss.backward()
+
+
+def test_backward_twice_without_retain_raises():
+    x = t([3.0])
+    loss = paddle.sum(x * x)
+    loss.backward()
+    with pytest.raises(RuntimeError):
+        loss.backward()
+
+
+def test_hook_scales_grad():
+    x = t([1.0, 2.0])
+    x.register_hook(lambda g: g * 2.0)
+    paddle.sum(x * 3.0).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+
+def test_hook_remove():
+    x = t([1.0])
+    h = x.register_hook(lambda g: g * 100.0)
+    h.remove()
+    paddle.sum(x).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0])
+
+
+def test_paddle_grad_api():
+    x = t([2.0])
+    y = x * x * x
+    (g,) = paddle.grad(y, x, retain_graph=False)
+    np.testing.assert_allclose(g.numpy(), [12.0])
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_paddle_grad_unused():
+    x = t([2.0])
+    z = t([1.0])
+    y = x * 2.0
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [x, z])
+    gx, gz = paddle.grad(paddle.sum(x * 2.0), [x, z], allow_unused=True)
+    np.testing.assert_allclose(gx.numpy(), [2.0])
+    assert gz is None
+
+
+def test_retain_grads_on_intermediate():
+    x = t([1.0, 2.0])
+    y = x * 2.0
+    y.retain_grads()
+    paddle.sum(y * 3.0).backward()
+    np.testing.assert_allclose(y.grad.numpy(), [3.0, 3.0])
+
+
+def test_integer_inputs_not_differentiated():
+    idx = paddle.to_tensor(np.array([0, 1], np.int64))
+    w = t(np.random.randn(4, 3).astype(np.float32))
+    emb = paddle.gather(w, idx)
+    paddle.sum(emb).backward()
+    assert w.grad is not None
+    assert w.grad.shape == [4, 3]
+
+
+def test_clear_grad():
+    x = t([1.0])
+    paddle.sum(x * 2.0).backward()
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_multi_output_op_backward():
+    x = t(np.array([3.0, 1.0, 2.0], np.float32))
+    vals, idx = paddle.topk(x, 2)
+    paddle.sum(vals).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
+
+
+def test_broadcast_grad_reduces():
+    a = t(np.ones((3, 4), np.float32))
+    b = t(np.ones((4,), np.float32))
+    paddle.sum(a + b).backward()
+    np.testing.assert_allclose(b.grad.numpy(), [3.0] * 4)

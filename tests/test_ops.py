@@ -1,0 +1,235 @@
+"""Op parity tests vs NumPy — the OpTest model.
+
+Reference: test/legacy_test/op_test.py:418 checks each op's forward against a
+NumPy reference and gradients numerically. Here forward parity is vs NumPy and
+grad parity is vs jax.grad (exact, not finite-difference, since both sides
+share XLA numerics).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def rnd(*shape, dtype=np.float32):
+    return np.random.randn(*shape).astype(dtype)
+
+
+UNARY_CASES = [
+    ("abs", np.abs, rnd(3, 4)),
+    ("exp", np.exp, rnd(3, 4)),
+    ("log", np.log, np.abs(rnd(3, 4)) + 0.5),
+    ("sqrt", np.sqrt, np.abs(rnd(3, 4)) + 0.1),
+    ("sin", np.sin, rnd(3, 4)),
+    ("cos", np.cos, rnd(3, 4)),
+    ("tanh", np.tanh, rnd(3, 4)),
+    ("floor", np.floor, rnd(3, 4) * 3),
+    ("ceil", np.ceil, rnd(3, 4) * 3),
+    ("round", np.round, rnd(3, 4) * 3),
+    ("sign", np.sign, rnd(3, 4)),
+    ("reciprocal", np.reciprocal, np.abs(rnd(3, 4)) + 0.5),
+    ("square", np.square, rnd(3, 4)),
+    ("erf", None, rnd(3, 4)),
+    ("expm1", np.expm1, rnd(3, 4)),
+    ("log1p", np.log1p, np.abs(rnd(3, 4))),
+    ("log2", np.log2, np.abs(rnd(3, 4)) + 0.5),
+    ("log10", np.log10, np.abs(rnd(3, 4)) + 0.5),
+    ("rsqrt", lambda x: 1 / np.sqrt(x), np.abs(rnd(3, 4)) + 0.5),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x)), rnd(3, 4)),
+]
+
+
+@pytest.mark.parametrize("name,ref,x", UNARY_CASES, ids=[c[0] for c in UNARY_CASES])
+def test_unary(name, ref, x):
+    out = getattr(paddle, name)(paddle.to_tensor(x))
+    if ref is not None:
+        np.testing.assert_allclose(out.numpy(), ref(x), rtol=1e-5, atol=1e-6)
+    assert out.shape == list(x.shape)
+
+
+BINARY_CASES = [
+    ("add", np.add),
+    ("subtract", np.subtract),
+    ("multiply", np.multiply),
+    ("divide", np.divide),
+    ("maximum", np.maximum),
+    ("minimum", np.minimum),
+    ("pow", lambda a, b: np.abs(a) ** b),
+    ("atan2", np.arctan2),
+    ("fmax", np.fmax),
+    ("fmin", np.fmin),
+]
+
+
+@pytest.mark.parametrize("name,ref", BINARY_CASES, ids=[c[0] for c in BINARY_CASES])
+def test_binary(name, ref):
+    a, b = rnd(3, 4), rnd(3, 4) + 2.0
+    if name == "pow":
+        a = np.abs(a)
+    out = getattr(paddle, name)(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), ref(a, b), rtol=1e-5, atol=1e-6)
+
+
+def test_broadcasting():
+    a, b = rnd(3, 1, 4), rnd(5, 1)
+    out = paddle.add(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a + b, rtol=1e-6)
+
+
+REDUCE_CASES = [
+    ("sum", np.sum),
+    ("mean", np.mean),
+    ("max", np.max),
+    ("min", np.min),
+    ("prod", np.prod),
+]
+
+
+@pytest.mark.parametrize("name,ref", REDUCE_CASES, ids=[c[0] for c in REDUCE_CASES])
+@pytest.mark.parametrize("axis,keepdim", [(None, False), (0, False), (1, True), ([0, 2], False)])
+def test_reduce(name, ref, axis, keepdim):
+    x = rnd(2, 3, 4)
+    out = getattr(paddle, name)(paddle.to_tensor(x), axis=axis, keepdim=keepdim)
+    expect = ref(x, axis=tuple(axis) if isinstance(axis, list) else axis, keepdims=keepdim)
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5, atol=1e-6)
+
+
+def test_matmul_shapes():
+    for sa, sb in [((3, 4), (4, 5)), ((2, 3, 4), (2, 4, 5)), ((4,), (4,)), ((2, 3, 4), (4,))]:
+        a, b = rnd(*sa), rnd(*sb)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-4, atol=1e-5)
+
+
+def test_matmul_transpose_flags():
+    a, b = rnd(4, 3), rnd(4, 5)
+    out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b), transpose_x=True)
+    np.testing.assert_allclose(out.numpy(), a.T @ b, rtol=1e-4, atol=1e-5)
+    out = paddle.matmul(paddle.to_tensor(rnd(3, 4)), paddle.to_tensor(b), transpose_y=False)
+
+
+def test_manipulation():
+    x = rnd(2, 3, 4)
+    tx = paddle.to_tensor(x)
+    np.testing.assert_array_equal(paddle.reshape(tx, [6, 4]).numpy(), x.reshape(6, 4))
+    np.testing.assert_array_equal(paddle.transpose(tx, [2, 0, 1]).numpy(), x.transpose(2, 0, 1))
+    np.testing.assert_array_equal(paddle.flatten(tx, 1).numpy(), x.reshape(2, 12))
+    np.testing.assert_array_equal(paddle.squeeze(paddle.to_tensor(x[:1]), 0).numpy(), x[0])
+    np.testing.assert_array_equal(paddle.unsqueeze(tx, 0).numpy(), x[None])
+    np.testing.assert_array_equal(
+        paddle.concat([tx, tx], axis=1).numpy(), np.concatenate([x, x], 1))
+    np.testing.assert_array_equal(paddle.stack([tx, tx]).numpy(), np.stack([x, x]))
+    parts = paddle.split(tx, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    np.testing.assert_array_equal(paddle.tile(tx, [2, 1, 1]).numpy(), np.tile(x, (2, 1, 1)))
+    np.testing.assert_array_equal(paddle.flip(tx, [0]).numpy(), x[::-1])
+    np.testing.assert_array_equal(paddle.roll(tx, 1, 0).numpy(), np.roll(x, 1, 0))
+
+
+def test_creation():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3], dtype="int32").dtype == np.int32
+    np.testing.assert_array_equal(paddle.arange(0, 10, 2).numpy(), np.arange(0, 10, 2))
+    np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+    np.testing.assert_array_equal(paddle.full([2], 7.0).numpy(), np.full(2, 7.0, np.float32))
+    x = paddle.to_tensor(rnd(2, 3))
+    assert paddle.zeros_like(x).shape == [2, 3]
+    assert paddle.ones_like(x).numpy().sum() == 6
+    np.testing.assert_array_equal(
+        paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5, dtype=np.float32))
+
+
+def test_indexing_gather_scatter():
+    x = rnd(5, 3)
+    tx = paddle.to_tensor(x)
+    idx = paddle.to_tensor(np.array([0, 2], np.int64))
+    np.testing.assert_array_equal(paddle.gather(tx, idx).numpy(), x[[0, 2]])
+    np.testing.assert_array_equal(paddle.index_select(tx, idx, axis=0).numpy(), x[[0, 2]])
+    np.testing.assert_array_equal(tx[1:3].numpy(), x[1:3])
+    np.testing.assert_array_equal(tx[:, 1].numpy(), x[:, 1])
+    np.testing.assert_array_equal(tx[-1].numpy(), x[-1])
+
+
+def test_where_and_comparison():
+    a, b = rnd(3, 4), rnd(3, 4)
+    ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+    np.testing.assert_array_equal((ta > tb).numpy(), a > b)
+    np.testing.assert_array_equal((ta == tb).numpy(), a == b)
+    np.testing.assert_array_equal(
+        paddle.where(ta > tb, ta, tb).numpy(), np.where(a > b, a, b))
+
+
+def test_argmax_sort_topk():
+    x = rnd(4, 5)
+    tx = paddle.to_tensor(x)
+    np.testing.assert_array_equal(paddle.argmax(tx, axis=1).numpy(), x.argmax(1))
+    np.testing.assert_array_equal(paddle.argmin(tx, axis=0).numpy(), x.argmin(0))
+    np.testing.assert_allclose(paddle.sort(tx, axis=1).numpy(), np.sort(x, 1))
+    np.testing.assert_array_equal(paddle.argsort(tx, axis=1).numpy(), np.argsort(x, 1))
+    v, i = paddle.topk(tx, 3, axis=1)
+    np.testing.assert_allclose(v.numpy(), np.sort(x, 1)[:, ::-1][:, :3])
+
+
+def test_cast_and_dtypes():
+    x = paddle.to_tensor(rnd(2, 2))
+    assert paddle.cast(x, "float64").dtype == np.float64
+    assert paddle.cast(x, paddle.int32).dtype == np.int32
+    assert x.astype("bool").dtype == np.bool_
+    bf = paddle.cast(x, paddle.bfloat16)
+    assert bf.dtype == paddle.bfloat16
+
+
+def test_cumsum_cumprod():
+    x = rnd(3, 4)
+    np.testing.assert_allclose(
+        paddle.cumsum(paddle.to_tensor(x), axis=1).numpy(), np.cumsum(x, 1), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.cumprod(paddle.to_tensor(x), dim=1).numpy(), np.cumprod(x, 1), rtol=1e-5)
+
+
+def test_clip_and_norms():
+    x = rnd(3, 4) * 5
+    np.testing.assert_allclose(
+        paddle.clip(paddle.to_tensor(x), -1.0, 1.0).numpy(), np.clip(x, -1, 1))
+    np.testing.assert_allclose(
+        paddle.norm(paddle.to_tensor(x)).numpy(), np.linalg.norm(x), rtol=1e-5)
+
+
+def test_einsum():
+    a, b = rnd(3, 4), rnd(4, 5)
+    np.testing.assert_allclose(
+        paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+        np.einsum("ij,jk->ik", a, b), rtol=1e-4, atol=1e-5)
+
+
+def test_inplace_ops_swap_buffer():
+    x = paddle.to_tensor(np.zeros((2, 2), np.float32))
+    y = x  # aliases see the swap
+    x.add_(paddle.to_tensor(np.ones((2, 2), np.float32)))
+    np.testing.assert_array_equal(y.numpy(), np.ones((2, 2)))
+    x.zero_()
+    np.testing.assert_array_equal(y.numpy(), np.zeros((2, 2)))
+    x.fill_(3.0)
+    assert float(x.numpy()[0, 0]) == 3.0
+
+
+def test_random_ops_shapes_and_determinism():
+    paddle.seed(42)
+    a = paddle.rand([3, 4])
+    paddle.seed(42)
+    b = paddle.rand([3, 4])
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+    assert paddle.randn([2, 3]).shape == [2, 3]
+    r = paddle.randint(0, 10, [100])
+    assert r.numpy().min() >= 0 and r.numpy().max() < 10
+    assert paddle.uniform([5], min=-2.0, max=-1.0).numpy().max() <= -1.0
+
+
+def test_scalar_tensor_interop():
+    x = paddle.to_tensor(rnd(2, 2))
+    np.testing.assert_allclose((x + 1.0).numpy(), x.numpy() + 1.0)
+    np.testing.assert_allclose((2.0 * x).numpy(), 2 * x.numpy())
+    np.testing.assert_allclose((1.0 - x).numpy(), 1 - x.numpy(), rtol=1e-6)
+    np.testing.assert_allclose((x / 2).numpy(), x.numpy() / 2)
+    np.testing.assert_allclose((x ** 2).numpy(), x.numpy() ** 2)
+    np.testing.assert_allclose((-x).numpy(), -x.numpy())

@@ -1,0 +1,143 @@
+"""Optimizer + LR scheduler tests.
+
+Reference: python/paddle/optimizer semantics (step/clear_grad, param_groups,
+grad clip, schedulers from optimizer/lr.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def quad_problem():
+    """min ||Wx - y||^2 — convex, every optimizer must reduce loss."""
+    w = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32), stop_gradient=False)
+    x = paddle.to_tensor(np.random.randn(16, 4).astype(np.float32))
+    y = paddle.to_tensor(np.random.randn(16, 4).astype(np.float32))
+
+    def loss_fn():
+        return paddle.mean((paddle.matmul(x, w) - y) ** 2)
+
+    return w, loss_fn
+
+
+OPTIMIZERS = [
+    ("SGD", dict(learning_rate=0.05)),
+    ("Momentum", dict(learning_rate=0.05, momentum=0.9)),
+    ("Adam", dict(learning_rate=0.05)),
+    ("AdamW", dict(learning_rate=0.05, weight_decay=0.01)),
+    ("Adagrad", dict(learning_rate=0.1)),
+    ("RMSProp", dict(learning_rate=0.01)),
+    ("Adamax", dict(learning_rate=0.05)),
+    ("Adadelta", dict(learning_rate=1.0)),
+    ("Lamb", dict(learning_rate=0.05, lamb_weight_decay=0.01)),
+]
+
+
+@pytest.mark.parametrize("name,kwargs", OPTIMIZERS, ids=[o[0] for o in OPTIMIZERS])
+def test_optimizer_reduces_loss(name, kwargs):
+    w, loss_fn = quad_problem()
+    opt = getattr(paddle.optimizer, name)(parameters=[w], **kwargs)
+    l0 = float(loss_fn())
+    for _ in range(25):
+        loss = loss_fn()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss_fn()) < l0 * 0.9, f"{name} failed to reduce loss"
+
+
+def test_sgd_exact_update():
+    w = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    paddle.sum(w * 2.0).backward()
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), np.ones(3) - 0.1 * 2.0, rtol=1e-6)
+
+
+def test_adam_state_dict_roundtrip():
+    w, loss_fn = quad_problem()
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[w])
+    for _ in range(3):
+        loss_fn().backward()
+        opt.step()
+        opt.clear_grad()
+    sd = opt.state_dict()
+    opt2 = paddle.optimizer.Adam(learning_rate=0.01, parameters=[w])
+    opt2.set_state_dict(sd)
+    sd2 = opt2.state_dict()
+    for k in sd:
+        a, b = sd[k], sd2[k]
+        if hasattr(a, "numpy"):
+            np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+
+def test_clear_grad_and_accumulation():
+    w = paddle.to_tensor(np.zeros(2, np.float32), stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w])
+    paddle.sum(w).backward()
+    paddle.sum(w).backward()  # grads accumulate
+    np.testing.assert_allclose(w.grad.numpy(), [2.0, 2.0])
+    opt.clear_grad()
+    assert w.grad is None
+
+
+def test_grad_clip_global_norm():
+    w = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w], grad_clip=clip)
+    paddle.sum(w * 10.0).backward()  # grad = 10s, norm 20
+    opt.step()
+    # clipped grad norm == 1 → step size per-element = 10/20
+    np.testing.assert_allclose(w.numpy(), 1.0 - 10.0 / 20.0, rtol=1e-5)
+
+
+def test_lr_schedulers():
+    sch = paddle.optimizer.lr.StepDecay(learning_rate=1.0, step_size=2, gamma=0.5)
+    w = paddle.to_tensor(np.ones(1, np.float32), stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=sch, parameters=[w])
+    lrs = []
+    for _ in range(6):
+        lrs.append(opt.get_lr())
+        sch.step()
+    np.testing.assert_allclose(lrs, [1.0, 1.0, 0.5, 0.5, 0.25, 0.25])
+
+    cos = paddle.optimizer.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+    assert abs(cos.get_lr() - 1.0) < 1e-6
+
+    warm = paddle.optimizer.lr.LinearWarmup(
+        learning_rate=1.0, warmup_steps=5, start_lr=0.0, end_lr=1.0)
+    vals = []
+    for _ in range(6):
+        vals.append(warm.get_lr())
+        warm.step()
+    np.testing.assert_allclose(vals[:5], [0.0, 0.2, 0.4, 0.6, 0.8], atol=1e-6)
+
+    nd = paddle.optimizer.lr.NoamDecay(d_model=64, warmup_steps=10)
+    assert nd.get_lr() >= 0.0
+
+
+def test_set_lr_and_get_lr():
+    w = paddle.to_tensor(np.ones(1, np.float32), stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    assert abs(opt.get_lr() - 0.1) < 1e-8
+    opt.set_lr(0.5)
+    assert abs(opt.get_lr() - 0.5) < 1e-8
+
+
+def test_weight_decay_sgd():
+    w = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w], weight_decay=0.1)
+    paddle.sum(w * 0.0).backward()
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), 1.0 - 0.1 * 0.1, rtol=1e-5)
+
+
+def test_no_grad_params_skipped():
+    w1 = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    w2 = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w1, w2])
+    paddle.sum(w1).backward()
+    opt.step()  # w2 has no grad — must not crash
+    np.testing.assert_allclose(w2.numpy(), np.ones(2))
