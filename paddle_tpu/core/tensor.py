@@ -98,6 +98,18 @@ class Tensor:
     def is_leaf(self):
         return self._node is None
 
+    # ------------------------------------------------- dist tensor surface
+    @property
+    def placements(self):
+        return list(self._dist_attr.placements) if self._dist_attr is not None else None
+
+    @property
+    def process_mesh(self):
+        return self._dist_attr.process_mesh if self._dist_attr is not None else None
+
+    def is_dist(self):
+        return self._dist_attr is not None
+
     def retain_grads(self):
         self._retain_grads = True
 

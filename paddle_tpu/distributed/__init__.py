@@ -1,1 +1,53 @@
+"""paddle_tpu.distributed — distributed training over ICI/DCN via XLA.
 
+Reference parity: python/paddle/distributed/ (156k LoC over NCCL/Gloo/brpc).
+TPU-native: rendezvous = JAX coordination service, groups = mesh axes,
+collectives = XLA HLO ops; parallelism = NamedSharding + shard_map; no comm
+library, no parameter server, no stream management.
+"""
+from .parallel_env import (
+    ParallelEnv,
+    init_parallel_env,
+    is_initialized,
+    get_rank,
+    get_world_size,
+    global_mesh,
+)
+from .collective import Group, ReduceOp, new_group, get_group, destroy_process_group
+from .communication import (
+    all_reduce,
+    all_gather,
+    all_gather_object,
+    all_gather_into_tensor,
+    reduce_scatter,
+    all_to_all,
+    alltoall,
+    all_to_all_single,
+    broadcast,
+    broadcast_object_list,
+    reduce,
+    scatter,
+    send,
+    recv,
+    isend,
+    irecv,
+    P2POp,
+    batch_isend_irecv,
+    barrier,
+    stream,
+)
+from .auto_parallel import (
+    ProcessMesh,
+    Placement,
+    Replicate,
+    Shard,
+    Partial,
+    shard_tensor,
+    reshard,
+    dtensor_from_fn,
+    unshard_dtensor,
+    shard_layer,
+)
+from . import auto_parallel
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,19 @@
+from .process_mesh import ProcessMesh
+from .placement import Placement, Replicate, Shard, Partial, to_partition_spec
+from .api import (
+    DistAttr,
+    shard_tensor,
+    reshard,
+    dtensor_from_fn,
+    unshard_dtensor,
+    shard_layer,
+    get_placements,
+    get_mesh,
+)
+
+__all__ = [
+    "ProcessMesh", "Placement", "Replicate", "Shard", "Partial",
+    "to_partition_spec", "DistAttr", "shard_tensor", "reshard",
+    "dtensor_from_fn", "unshard_dtensor", "shard_layer",
+    "get_placements", "get_mesh",
+]

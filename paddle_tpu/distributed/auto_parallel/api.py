@@ -1,0 +1,180 @@
+"""Semi-auto parallel API: shard_tensor / reshard / shard_layer.
+
+Reference parity: python/paddle/distributed/auto_parallel/api.py:220
+(shard_tensor), :796 (reshard); C++ DistTensor (dist_tensor.h:39) and the 15
+reshard functions (auto_parallel/reshard/). TPU-native collapse: a
+"DistTensor" is an ordinary framework Tensor whose jax.Array carries a
+`NamedSharding` over the ProcessMesh's jax Mesh, plus a DistAttr recording
+placements (incl. Partial, which NamedSharding cannot express). Reshard is
+one `jax.device_put` — XLA emits the collective (all-gather for s→r,
+slice for r→s, all-to-all for s→s', psum for p→r, reduce-scatter for p→s)
+instead of 15 hand-written comm functions. SPMD propagation through ops is
+GSPMD's job: computed outputs inherit shardings with no per-op rules.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+
+from ...core.tensor import Parameter, Tensor
+from .placement import Partial, Placement, Replicate, Shard, to_partition_spec
+from .process_mesh import ProcessMesh
+
+
+class DistAttr:
+    """(mesh, placements) pair carried on a dist tensor (≙ TensorDistAttr)."""
+
+    def __init__(self, mesh: ProcessMesh, placements: Sequence[Placement]):
+        self.process_mesh = mesh
+        self.placements = list(placements)
+
+    @property
+    def partial_dims(self):
+        return [i for i, p in enumerate(self.placements) if p.is_partial()]
+
+    def __repr__(self):
+        return f"DistAttr(mesh={self.process_mesh}, placements={self.placements})"
+
+
+def _normalize_placements(mesh: ProcessMesh, placements):
+    if placements is None:
+        return [Replicate() for _ in range(mesh.ndim)]
+    out = list(placements)
+    while len(out) < mesh.ndim:
+        out.append(Replicate())
+    return out
+
+
+def _sharding_for(mesh: ProcessMesh, placements, shape) -> NamedSharding:
+    """Physical NamedSharding for (mesh, placements) given the array shape.
+
+    XLA requires sharded dims divisible by the mesh-axis size (the reference
+    pads uneven shards instead — reshard/dist_tensor.cc); dims that don't
+    divide stay physically replicated while the logical placement is kept in
+    DistAttr, trading memory for correctness on ragged shapes.
+    """
+    eff = []
+    factor = {}  # tensor dim -> product of mesh-axis sizes already sharding it
+    for i, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            combined = factor.get(pl.dim, 1) * mesh.shape[i]
+            if shape[pl.dim] % combined != 0:
+                eff.append(Replicate())
+                continue
+            factor[pl.dim] = combined
+        eff.append(pl)
+    spec = to_partition_spec(eff, mesh.dim_names, len(shape))
+    return NamedSharding(mesh.to_jax_mesh(), spec)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None, place=None,
+                 stop_gradient=None) -> Tensor:
+    """Create a distributed tensor from local/global data.
+
+    `data` is the GLOBAL (logical) value — single-controller mode sees the
+    whole array. Shard placements slice it across the mesh via NamedSharding;
+    a Partial placement stores value/axis_size so that the implicit sum over
+    that mesh axis reconstructs the logical value.
+    """
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype, place=place)
+    placements = _normalize_placements(mesh, placements)
+    arr = t._data
+    for i, pl in enumerate(placements):
+        # sum/avg-partial: store value/n so the implicit sum reconstructs the
+        # logical value; max/min-partial shards already hold it verbatim
+        if pl.is_partial() and pl.reduce_type in ("sum", "avg"):
+            arr = arr / mesh.shape[i]
+    arr = jax.device_put(arr, _sharding_for(mesh, placements, arr.shape))
+    sg = t.stop_gradient if stop_gradient is None else stop_gradient
+    if isinstance(t, Parameter):
+        out = Parameter(arr, _internal=True, trainable=not sg)
+    else:
+        out = Tensor(arr, _internal=True, stop_gradient=sg)
+    out._dist_attr = DistAttr(mesh, placements)
+    out.name = t.name
+    return out
+
+
+def reshard(t: Tensor, mesh: ProcessMesh, placements) -> Tensor:
+    """Change a dist tensor's placements — ONE device_put, XLA picks the
+    collective (≙ the reference's reshard function zoo)."""
+    placements = _normalize_placements(mesh, placements)
+    arr = t._data
+    old = t._dist_attr
+    if old is not None:
+        # materialize pending partial sums (p→anything goes through the
+        # logical value; XLA fuses the implied psum into the transfer).
+        # max/min-partial shards hold the logical value already.
+        for i in old.partial_dims:
+            if old.placements[i].reduce_type in ("sum", "avg"):
+                arr = arr * old.process_mesh.shape[i]
+    for i, pl in enumerate(placements):
+        if pl.is_partial() and pl.reduce_type in ("sum", "avg"):
+            arr = arr / mesh.shape[i]
+    arr = jax.device_put(arr, _sharding_for(mesh, placements, arr.shape))
+    out = Tensor(arr, _internal=True, stop_gradient=t.stop_gradient)
+    out._dist_attr = DistAttr(mesh, placements)
+    return out
+
+
+def dtensor_from_fn(fn: Callable, mesh: ProcessMesh, placements, *args, **kwargs) -> Tensor:
+    """Build a dist tensor without materializing it replicated first: jit the
+    creator with output shardings so each chip only fills its own shard."""
+    placements = _normalize_placements(mesh, placements)
+
+    def raw():
+        out = fn(*args, **kwargs)
+        return out._data if isinstance(out, Tensor) else out
+
+    shape = jax.eval_shape(raw)
+    sharding = _sharding_for(mesh, placements, shape.shape)
+    arr = jax.jit(raw, out_shardings=sharding)()
+    out = Tensor(arr, _internal=True)
+    out._dist_attr = DistAttr(mesh, placements)
+    return out
+
+
+def unshard_dtensor(t: Tensor) -> Tensor:
+    """Gather a dist tensor to a dense replicated tensor."""
+    if t._dist_attr is None:
+        return t
+    mesh = t._dist_attr.process_mesh
+    return reshard(t, mesh, [Replicate() for _ in range(mesh.ndim)])
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn: Callable | None = None,
+                input_fn=None, output_fn=None):
+    """≙ dist.shard_layer (api.py): apply a shard plan to every sublayer's
+    parameters in place (buffer swap keeps Parameter identity for optimizers).
+    """
+    def default_fn(name, sublayer, mesh):
+        for pname, p in list(sublayer._parameters.items()):
+            if p is None:
+                continue
+            sharded = shard_tensor(p, mesh, None)
+            p._assign_raw(sharded._data)
+            p._dist_attr = sharded._dist_attr
+
+    fn = shard_fn or default_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(lambda l, inp, out: output_fn(out, process_mesh))
+    return layer
+
+
+def get_placements(t: Tensor):
+    if t._dist_attr is None:
+        return None
+    return list(t._dist_attr.placements)
+
+
+def get_mesh(t: Tensor):
+    if t._dist_attr is None:
+        return None
+    return t._dist_attr.process_mesh

@@ -1,0 +1,98 @@
+"""Placements: Shard / Replicate / Partial — how one tensor dim maps to one
+mesh dim.
+
+Reference parity: paddle Placement types
+(phi/core/distributed/auto_parallel/placement_types.h, python
+distributed/auto_parallel/placement_type.py). The triple
+(ProcessMesh, [placement per mesh dim]) is `TensorDistAttr`
+(dist_attr.h:81). TPU-native: Shard/Replicate lower exactly onto
+`jax.sharding.NamedSharding` PartitionSpecs; Partial (pending-reduction
+state after a local matmul) is tracked as dist-attr metadata and resolved to
+an XLA psum/reduce-scatter at reshard time.
+"""
+from __future__ import annotations
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def get_dim(self):
+        return self.dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = getattr(reduce_type, "name", reduce_type) or "sum"
+
+    def is_partial(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type})"
+
+
+def to_partition_spec(placements, mesh_dim_names, ndim: int):
+    """[placement per mesh dim] → jax PartitionSpec entries per tensor dim.
+
+    Partial dims contribute nothing to the spec (the partial state is
+    metadata); two mesh dims sharding the same tensor dim become a tuple
+    entry (jax 'multi-axis sharding').
+    """
+    from jax.sharding import PartitionSpec as P
+
+    per_dim: list = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            name = mesh_dim_names[mesh_dim]
+            cur = per_dim[pl.dim]
+            if cur is None:
+                per_dim[pl.dim] = name
+            elif isinstance(cur, tuple):
+                per_dim[pl.dim] = cur + (name,)
+            else:
+                per_dim[pl.dim] = (cur, name)
+    return P(*per_dim)

@@ -1,0 +1,130 @@
+"""ProcessMesh — the logical device mesh of the auto-parallel API.
+
+Reference parity: paddle ProcessMesh
+(phi/core/distributed/auto_parallel/process_mesh.h:34, python
+distributed/auto_parallel/process_mesh.py). TPU-native: backed 1:1 by a
+`jax.sharding.Mesh`; "process ids" are chip indices in single-controller
+mode. SPMD sharding propagation (the reference's 59 C++ spmd_rules) is
+delegated to XLA GSPMD — a ProcessMesh only has to name axes.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, shape=None, process_ids=None):
+        if isinstance(mesh, Mesh):
+            self._shape = list(mesh.devices.shape)
+            self._dim_names = list(mesh.axis_names)
+            self._process_ids = [d.id for d in mesh.devices.flatten()]
+            self._jax_mesh = mesh
+            return
+        arr = np.asarray(mesh)
+        self._shape = list(arr.shape) if shape is None else list(shape)
+        self._process_ids = list(arr.flatten()) if process_ids is None else list(process_ids)
+        self._dim_names = (
+            list(dim_names) if dim_names is not None
+            else [f"d{i}" for i in range(len(self._shape))]
+        )
+        if len(self._dim_names) != len(self._shape):
+            raise ValueError("dim_names must match mesh rank")
+        self._jax_mesh = None
+
+    # ------------------------------------------------------------ properties
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return list(self._process_ids)
+
+    @property
+    def mesh(self):
+        return np.asarray(self._process_ids).reshape(self._shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self._shape))
+
+    def get_dim_size(self, dim_name) -> int:
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def get_rank_by_dim_and_process_id(self, dim_name, process_id):
+        coords = np.argwhere(self.mesh == process_id)
+        if coords.size == 0:
+            return -1
+        return int(coords[0][self._dim_names.index(dim_name)])
+
+    # ------------------------------------------------------------ jax bridge
+    def to_jax_mesh(self) -> Mesh:
+        """Materialize as a jax Mesh over real devices.
+
+        Chip i backs process id at flat position i; when the mesh is smaller
+        than the device count (sub-meshes for pp stages), only those chips
+        participate.
+        """
+        if self._jax_mesh is None:
+            devs = jax.devices()
+            if max(self._process_ids) >= len(devs):
+                raise ValueError(
+                    f"ProcessMesh references process id {max(self._process_ids)} "
+                    f"but only {len(devs)} devices are visible; on CPU set "
+                    f"XLA_FLAGS=--xla_force_host_platform_device_count")
+            picked = np.array([devs[pid] for pid in self._process_ids])
+            self._jax_mesh = Mesh(picked.reshape(self._shape), tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __getitem__(self, idx):
+        sub = self.mesh[idx]
+        if np.ndim(sub) == 0:
+            return int(sub)
+        drop = idx if isinstance(idx, tuple) else (idx,)
+        names = []
+        pos = 0
+        for sel in drop:
+            if isinstance(sel, int):
+                pos += 1
+                continue
+            names.append(self._dim_names[pos])
+            pos += 1
+        names += self._dim_names[pos:]
+        return ProcessMesh(sub, dim_names=names[: np.ndim(sub)])
+
+    def get_submesh_with_dim(self, dim_name):
+        """1-D sub-mesh along `dim_name` containing the current process
+        (other mesh dims fixed at the current process's coordinates)."""
+        from ..parallel_env import get_rank
+
+        axis = self._dim_names.index(dim_name)
+        coords = np.argwhere(self.mesh == get_rank())
+        fixed = coords[0] if coords.size else np.zeros(self.ndim, dtype=int)
+        idx = tuple(
+            slice(None) if d == axis else int(fixed[d]) for d in range(self.ndim)
+        )
+        return ProcessMesh(self.mesh[idx], dim_names=[dim_name])
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and self._shape == other._shape
+            and self._process_ids == other._process_ids
+            and self._dim_names == other._dim_names
+        )
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self._process_ids), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"

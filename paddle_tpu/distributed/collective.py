@@ -1,0 +1,131 @@
+"""Process groups over mesh axes — no NCCL, no comm rings to boot.
+
+Reference parity: paddle's ProcessGroup object model
+(phi/core/distributed/collective/process_group.h:48, python
+distributed/collective.py:151 _new_process_group_impl). TPU-native: a Group
+names a set of chips and (when it aligns with one) a mesh axis; collectives
+on it are XLA HLO collectives — `lax.psum`/`all_gather`/... inside traced
+(shard_map) code, or tiny jitted global-view programs in eager. Rendezvous,
+comm init, and stream management do not exist here: the XLA runtime owns ICI.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from .parallel_env import get_rank, get_world_size, global_mesh
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A collective group ≙ one mesh axis (or an explicit rank list).
+
+    `axis_name` is the name visible to lax collectives when code runs inside
+    shard_map over a mesh containing this axis.
+    """
+
+    _next_gid = 1  # gid 0 is reserved for the default (world) group
+
+    def __init__(self, ranks=None, axis_name=None, mesh: Mesh | None = None, gid=None):
+        world = get_world_size()
+        self.ranks = list(ranks) if ranks is not None else list(range(world))
+        self.axis_name = axis_name or f"group_{Group._next_gid}"
+        self.id = gid if gid is not None else Group._next_gid
+        Group._next_gid += 1
+        self._mesh = mesh
+
+    @property
+    def nranks(self) -> int:
+        return len(self.ranks)
+
+    world_size = nranks
+
+    @property
+    def rank(self) -> int:
+        return self.get_group_rank(get_rank())
+
+    def get_group_rank(self, global_rank: int) -> int:
+        try:
+            return self.ranks.index(global_rank)
+        except ValueError:
+            return -1
+
+    @property
+    def process_ids(self):
+        return self.ranks
+
+    @property
+    def mesh(self) -> Mesh:
+        """1-D device mesh over this group's chips (device i ≙ group rank i).
+
+        In single-controller mode a "rank" is a chip; when the group spans all
+        chips this is the global mesh relabeled with this group's axis name.
+        """
+        if self._mesh is None:
+            devs = np.array(jax.devices())
+            if max(self.ranks) >= len(devs):
+                raise ValueError(
+                    f"Group rank {max(self.ranks)} exceeds visible device "
+                    f"count {len(devs)}")
+            self._mesh = Mesh(devs[self.ranks], (self.axis_name,))
+        return self._mesh
+
+    def __repr__(self):
+        return f"Group(id={self.id}, nranks={self.nranks}, axis={self.axis_name!r})"
+
+
+_default_group: Group | None = None
+_groups: dict[int, Group] = {}
+
+
+def _get_or_create_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        from .parallel_env import WORLD_AXIS, init_parallel_env
+
+        init_parallel_env()
+        _default_group = Group(
+            ranks=list(range(max(get_world_size(), 1))),
+            axis_name=WORLD_AXIS,
+            mesh=global_mesh() if global_mesh().size == max(get_world_size(), 1) else None,
+            gid=0,
+        )
+        _groups[0] = _default_group
+    return _default_group
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return _get_or_create_default_group()
+    return _groups[gid]
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None) -> Group:
+    """≙ paddle.distributed.new_group — but creation is free (no comm init)."""
+    _get_or_create_default_group()
+    g = Group(ranks=ranks, axis_name=axis_name)
+    _groups[g.id] = g
+    return g
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _groups.clear()
+        _default_group = None
+    else:
+        _groups.pop(group.id, None)
+
+
+def _resolve_group(group) -> Group:
+    if group is None:
+        return _get_or_create_default_group()
+    return group

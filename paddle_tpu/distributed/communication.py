@@ -1,0 +1,308 @@
+"""Collective communication API — paddle.distributed.{all_reduce,...}.
+
+Reference parity: python/paddle/distributed/communication/ (each API
+dispatches to a ProcessGroup; kernels are NCCL ops). TPU-native lowering:
+
+* Inside traced SPMD code (a `shard_map` over a mesh that carries the
+  group's axis — how meta_parallel layers and compiled train steps run):
+  the APIs emit `jax.lax` collectives (`psum`, `all_gather`, `ppermute`,
+  `all_to_all`, `psum_scatter`) on the group's axis name. XLA maps these to
+  ICI/DCN collectives — this is the hot path.
+
+* Eager single-controller mode: every chip sees the same Python program, so
+  a plain Tensor is by construction replicated and collectives have
+  global-view semantics computed directly (all_reduce(SUM) ≙ t * nranks,
+  broadcast ≙ identity, all_gather ≙ n copies). Distributed tensors made by
+  shard_tensor/reshard carry real shardings and are handled by the
+  auto_parallel reshard path instead.
+
+Every API accepts and returns framework Tensors.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .collective import Group, ReduceOp, _resolve_group
+
+
+def _data(t):
+    return t._data if isinstance(t, Tensor) else t
+
+
+def _wrap(x) -> Tensor:
+    return Tensor(x, _internal=True)
+
+
+def _in_trace(*tensors) -> bool:
+    return any(isinstance(_data(t), jax.core.Tracer) for t in tensors if t is not None)
+
+
+def _axis_in_scope(axis_name) -> bool:
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except Exception:
+        return False
+
+
+def _reduce_traced(x, op, axis):
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        out = jax.lax.psum(x, axis)
+        if op == ReduceOp.AVG:
+            out = out / jax.lax.psum(jnp.ones((), x.dtype), axis)
+        return out
+    if op == ReduceOp.MAX:
+        return jax.lax.pmax(x, axis)
+    if op == ReduceOp.MIN:
+        return jax.lax.pmin(x, axis)
+    if op == ReduceOp.PROD:
+        return jnp.prod(jax.lax.all_gather(x, axis), axis=0)
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Group | None = None, sync_op=True):
+    """In-place all-reduce (buffer swap). Traced: lax.psum on the group axis."""
+    g = _resolve_group(group)
+    x = _data(tensor)
+    if _in_trace(tensor) and _axis_in_scope(g.axis_name):
+        out = _reduce_traced(x, op, g.axis_name)
+    elif g.nranks == 1:
+        out = x
+    else:
+        # replicated global view: every "rank" holds the same value
+        if op == ReduceOp.SUM:
+            out = x * g.nranks
+        elif op == ReduceOp.AVG or op in (ReduceOp.MAX, ReduceOp.MIN):
+            out = x
+        elif op == ReduceOp.PROD:
+            out = x**g.nranks
+        else:
+            raise ValueError(op)
+    if isinstance(tensor, Tensor):
+        tensor._assign_raw(out)
+        return tensor
+    return _wrap(out)
+
+
+def all_gather(tensor_list: list, tensor: Tensor, group: Group | None = None, sync_op=True):
+    """Gather each rank's tensor; fills `tensor_list` with nranks Tensors."""
+    g = _resolve_group(group)
+    x = _data(tensor)
+    if _in_trace(tensor) and _axis_in_scope(g.axis_name):
+        stacked = jax.lax.all_gather(x, g.axis_name)  # [n, ...]
+        parts = [stacked[i] for i in range(g.nranks)]
+    else:
+        parts = [x for _ in range(g.nranks)]
+    if tensor_list is not None:
+        tensor_list.clear()
+        tensor_list.extend(_wrap(p) for p in parts)
+        return tensor_list
+    return [_wrap(p) for p in parts]
+
+
+def all_gather_object(object_list, obj, group=None):
+    g = _resolve_group(group)
+    object_list.clear()
+    object_list.extend(obj for _ in range(g.nranks))
+
+
+def all_gather_into_tensor(out: Tensor, tensor: Tensor, group=None, axis=0):
+    """Concat-style all-gather (≙ paddle concat on gathered list)."""
+    g = _resolve_group(group)
+    x = _data(tensor)
+    if _in_trace(tensor) and _axis_in_scope(g.axis_name):
+        res = jax.lax.all_gather(x, g.axis_name, axis=axis, tiled=True)
+    else:
+        res = jnp.concatenate([x] * g.nranks, axis=axis)
+    if out is not None:
+        out._assign_raw(res)
+        return out
+    return _wrap(res)
+
+
+def reduce_scatter(tensor: Tensor, tensor_or_list, op=ReduceOp.SUM,
+                   group: Group | None = None, sync_op=True):
+    """Reduce then scatter dim-0 chunks; result (1/n of dim0) lands in `tensor`."""
+    g = _resolve_group(group)
+    if isinstance(tensor_or_list, (list, tuple)):
+        x = jnp.concatenate([_data(t) for t in tensor_or_list], axis=0)
+    else:
+        x = _data(tensor_or_list)
+    if _in_trace(tensor_or_list if not isinstance(tensor_or_list, (list, tuple)) else tensor_or_list[0]) \
+            and _axis_in_scope(g.axis_name):
+        if op != ReduceOp.SUM:
+            raise NotImplementedError("traced reduce_scatter supports SUM")
+        out = jax.lax.psum_scatter(x, g.axis_name, scatter_dimension=0, tiled=True)
+    elif g.nranks == 1:
+        out = x
+    else:
+        full = x * g.nranks if op == ReduceOp.SUM else x
+        chunk = full.shape[0] // g.nranks
+        r = g.rank if g.rank >= 0 else 0
+        out = full[r * chunk:(r + 1) * chunk]
+    tensor._assign_raw(out)
+    return tensor
+
+
+def all_to_all(out_tensor_list: list, in_tensor_list: list, group: Group | None = None,
+               sync_op=True):
+    g = _resolve_group(group)
+    xs = [_data(t) for t in in_tensor_list]
+    if _in_trace(*in_tensor_list) and _axis_in_scope(g.axis_name):
+        stacked = jnp.stack(xs, axis=0)  # [n, ...] — chunk j is for rank j
+        ex = jax.lax.all_to_all(stacked, g.axis_name, split_axis=0, concat_axis=0, tiled=False)
+        parts = [ex[i] for i in range(g.nranks)]
+    else:
+        parts = xs  # single-controller replicated view: rank r keeps chunk r
+    if out_tensor_list is not None:
+        out_tensor_list.clear()
+        out_tensor_list.extend(_wrap(p) for p in parts)
+        return out_tensor_list
+    return [_wrap(p) for p in parts]
+
+
+def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
+    # legacy arg order
+    return all_to_all(out_tensor_list, in_tensor_list, group, sync_op)
+
+
+def all_to_all_single(out: Tensor, tensor: Tensor, out_split_sizes=None,
+                      in_split_sizes=None, group: Group | None = None, sync_op=True):
+    g = _resolve_group(group)
+    x = _data(tensor)
+    if _in_trace(tensor) and _axis_in_scope(g.axis_name):
+        if out_split_sizes or in_split_sizes:
+            raise NotImplementedError("uneven all_to_all_single under trace")
+        res = jax.lax.all_to_all(x, g.axis_name, split_axis=0, concat_axis=0, tiled=True)
+    else:
+        res = x
+    if out is not None:
+        out._assign_raw(res)
+        return out
+    return _wrap(res)
+
+
+def broadcast(tensor: Tensor, src: int = 0, group: Group | None = None, sync_op=True):
+    # single-controller: value already identical on all chips; traced: select src
+    g = _resolve_group(group)
+    x = _data(tensor)
+    if _in_trace(tensor) and _axis_in_scope(g.axis_name):
+        stacked = jax.lax.all_gather(x, g.axis_name)
+        x = stacked[g.get_group_rank(src) if g.get_group_rank(src) >= 0 else src]
+        tensor._assign_raw(x)
+    return tensor
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group: Group | None = None,
+           sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)  # every rank gets the result
+
+
+def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group: Group | None = None,
+            sync_op=True):
+    g = _resolve_group(group)
+    if tensor_list:
+        idx = g.rank if g.rank >= 0 else 0
+        tensor._assign_raw(_data(tensor_list[idx]))
+    return tensor
+
+
+def send(tensor: Tensor, dst: int = 0, group: Group | None = None, sync_op=True):
+    g = _resolve_group(group)
+    if _in_trace(tensor) and _axis_in_scope(g.axis_name):
+        raise RuntimeError(
+            "traced send/recv must be paired: use paddle_tpu.distributed.p2p "
+            "ppermute helpers (batch_isend_irecv) inside shard_map")
+    _p2p_mailbox[(g.id, dst)] = _data(tensor)
+    return None
+
+
+def recv(tensor: Tensor, src: int = 0, group: Group | None = None, sync_op=True):
+    g = _resolve_group(group)
+    key = (g.id, get_rank_in(g))
+    if key in _p2p_mailbox:
+        tensor._assign_raw(_p2p_mailbox.pop(key))
+    return tensor
+
+
+def get_rank_in(g: Group) -> int:
+    from .parallel_env import get_rank
+
+    r = g.get_group_rank(get_rank())
+    return r if r >= 0 else 0
+
+
+_p2p_mailbox: dict = {}
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = _resolve_group(group)
+
+
+def batch_isend_irecv(p2p_op_list):
+    """≙ communication/batch_isend_irecv.py. Traced: one ppermute per send.
+
+    Single-controller SPMD sees ONE op list (not per-rank lists), so a send
+    to `peer` means the uniform shift "every rank i sends to i + peer mod n"
+    (my_rank traces as 0) — exactly the next/prev-stage pattern pipeline
+    parallelism uses. Each send lowers to `lax.ppermute`; the matching recv
+    receives the permuted value. Eager single-process falls back to an
+    in-process mailbox.
+    """
+    sends = [p for p in p2p_op_list if p.op is isend or p.op == "isend"]
+    recvs = [p for p in p2p_op_list if p.op is irecv or p.op == "irecv"]
+    if sends and _in_trace(sends[0].tensor) and _axis_in_scope(sends[0].group.axis_name):
+        for i, s in enumerate(sends):
+            g = s.group
+            n = g.nranks
+            shift = s.peer % n
+            perm = [(j, (j + shift) % n) for j in range(n)]
+            out = jax.lax.ppermute(_data(s.tensor), g.axis_name, perm)
+            if i < len(recvs):
+                recvs[i].tensor._assign_raw(out)
+        return []
+    for p in sends:
+        isend(p.tensor, p.peer, p.group)
+    for p in recvs:
+        irecv(p.tensor, p.peer, p.group)
+    return []
+
+
+def isend(tensor, dst, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+def barrier(group: Group | None = None):
+    jax.effects_barrier()
+    return None
+
+
+# ----------------------------------------------------------------- stream.*
+class stream:
+    """paddle.distributed.stream.* parity — streams are an XLA runtime detail
+    on TPU; these forward to the synchronous APIs."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    all_to_all = staticmethod(all_to_all)
+    alltoall = staticmethod(alltoall)
+    reduce_scatter = staticmethod(reduce_scatter)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    scatter = staticmethod(scatter)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

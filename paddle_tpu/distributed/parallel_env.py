@@ -1,0 +1,120 @@
+"""Parallel environment: the TPU-native analog of init_parallel_env + TCPStore.
+
+Reference parity: python/paddle/distributed/parallel.py:978 (init_parallel_env
+creates the TCPStore rendezvous and NCCL process groups). Here rendezvous is
+the JAX coordination service (`jax.distributed.initialize`) and there are no
+comm libraries to boot: collectives are XLA HLO ops over a
+`jax.sharding.Mesh`. One OS process may own many chips (single-controller);
+`rank`/`world_size` follow the paddle env-var contract (PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM) when launched multi-process, else map to jax process
+index/count.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_lock = threading.Lock()
+_state: dict = {
+    "initialized": False,
+    "mesh": None,  # global 1-D Mesh over all devices, axis "world"
+}
+
+WORLD_AXIS = "world"
+
+
+class ParallelEnv:
+    """≙ paddle.distributed.ParallelEnv (env-var view of the job)."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    local_rank = rank
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    nranks = world_size
+
+    @property
+    def device_id(self) -> int:
+        return int(os.environ.get("FLAGS_selected_tpus", os.environ.get("FLAGS_selected_gpus", "0")).split(",")[0])
+
+    @property
+    def current_endpoint(self) -> str:
+        eps = self.trainer_endpoints
+        r = self.rank
+        return eps[r] if r < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self) -> list[str]:
+        s = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return s.split(",") if s else []
+
+
+def is_initialized() -> bool:
+    return _state["initialized"]
+
+
+def init_parallel_env():
+    """Bring up the distributed runtime.
+
+    Multi-process (PADDLE_TRAINERS_NUM > 1 or JAX_COORDINATOR set): dial the
+    JAX coordination service so all processes see the global device set.
+    Single-process: nothing to dial; the global mesh spans local devices.
+    """
+    with _lock:
+        if _state["initialized"]:
+            return ParallelEnv()
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        coord = os.environ.get("PADDLE_MASTER") or os.environ.get("JAX_COORDINATOR_ADDRESS")
+        if (nprocs > 1 or coord) and jax.process_count() == 1:
+            pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+            if coord is None and os.environ.get("PADDLE_TRAINER_ENDPOINTS"):
+                coord = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")[0]
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=nprocs,
+                    process_id=pid,
+                )
+            except Exception:
+                # already initialized by launcher, or single-host fallback
+                pass
+        devs = np.array(jax.devices())
+        _state["mesh"] = Mesh(devs, (WORLD_AXIS,))
+        _state["initialized"] = True
+        return ParallelEnv()
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.get_group_rank(get_rank())
+    return jax.process_index() if jax.process_count() > 1 else int(
+        os.environ.get("PADDLE_TRAINER_ID", "0")
+    )
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    if jax.process_count() > 1:
+        return jax.process_count()
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+
+def global_mesh() -> Mesh:
+    """The implicit 1-D mesh over every chip (axis name "world")."""
+    if _state["mesh"] is None or _state["mesh"].size != len(jax.devices()):
+        _state["mesh"] = Mesh(np.array(jax.devices()), (WORLD_AXIS,))
+    return _state["mesh"]
+
+
+def device_count() -> int:
+    return len(jax.devices())
