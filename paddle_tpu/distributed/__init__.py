@@ -49,5 +49,10 @@ from .auto_parallel import (
     shard_layer,
 )
 from . import auto_parallel
+from . import fleet
+from . import meta_parallel
+from . import sharding
+from .sharding import group_sharded_parallel, save_group_sharded_model
+from .meta_parallel import DataParallel
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,98 @@
+"""fleet — hybrid-parallel orchestration facade.
+
+Reference parity: fleet.init / distributed_model / distributed_optimizer
+(fleet/fleet.py:151,218,1448; model wrap cases fleet/model.py:135-154).
+TPU-native: `init` builds the hybrid Mesh (topology.py here); wrapping a
+model shards its parameters onto mesh axes via NamedSharding instead of
+booting NCCL groups and installing grad hooks — gradient "allreduce" is
+whatever XLA emits for the sharded-batch loss, and sharding stages are
+placement changes on optimizer state/grads/params.
+"""
+from __future__ import annotations
+
+from ..parallel_env import get_rank, get_world_size, init_parallel_env
+from .strategy import DistributedStrategy
+from .topology import CommunicateTopology, HybridCommunicateGroup
+
+_fleet_state = {
+    "initialized": False,
+    "strategy": None,
+    "hcg": None,
+}
+
+
+def init(role_maker=None, is_collective=True, strategy: DistributedStrategy | None = None,
+         log_level="INFO"):
+    init_parallel_env()
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    order = hc.get("order", ["dp", "pp", "sharding", "sep", "mp"])
+    dims = [int(hc.get(f"{n}_degree", 1)) for n in order]
+    topo = CommunicateTopology(order, dims)
+    _fleet_state["strategy"] = strategy
+    _fleet_state["hcg"] = HybridCommunicateGroup(topo)
+    _fleet_state["initialized"] = True
+    return fleet
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    if _fleet_state["hcg"] is None:
+        init()
+    return _fleet_state["hcg"]
+
+
+def is_initialized():
+    return _fleet_state["initialized"]
+
+
+def worker_index():
+    return get_rank()
+
+
+def worker_num():
+    return get_world_size()
+
+
+def get_strategy() -> DistributedStrategy:
+    return _fleet_state["strategy"] or DistributedStrategy()
+
+
+def distributed_model(model):
+    """Wrap per active axes (≙ fleet/model.py:33). On TPU the wrap is
+    parameter/input placement: mp/sp layers place themselves at construction;
+    pp returns the model for PipelineParallel scheduling; dp shards the batch.
+    """
+    hcg = get_hybrid_communicate_group()
+    from ..meta_parallel.parallel_wrappers import DataParallelShard
+    from ..meta_parallel.pipeline_parallel import PipelineParallel
+    from ..meta_parallel.pp_layers import PipelineLayer
+
+    if hcg.get_pipe_parallel_world_size() > 1 and isinstance(model, PipelineLayer):
+        return PipelineParallel(model, hcg, get_strategy())
+    if hcg.get_data_parallel_world_size() > 1:
+        return DataParallelShard(model, hcg)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """≙ HybridParallelOptimizer (hybrid_parallel_optimizer.py:275): layer
+    sharding-stage placement over the optimizer; grad sync is implicit."""
+    hcg = get_hybrid_communicate_group()
+    if hcg.get_sharding_parallel_world_size() > 1:
+        from ..sharding.sharding_optimizer import ShardingOptimizerStage1
+
+        return ShardingOptimizerStage1(optimizer, hcg)
+    return optimizer
+
+
+def barrier_worker():
+    from ..communication import barrier
+
+    barrier()
+
+
+# `from paddle_tpu.distributed import fleet` then `fleet.init(...)` — the
+# module itself is the singleton object, like the reference's `fleet`.
+import sys as _sys
+
+fleet = _sys.modules[__name__]

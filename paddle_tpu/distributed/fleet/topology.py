@@ -1,0 +1,159 @@
+"""Hybrid-parallel topology over one jax Mesh.
+
+Reference parity: CommunicateTopology + HybridCommunicateGroup
+(python/paddle/distributed/fleet/base/topology.py:70,189): the reference
+builds a 5-D cartesian rank topology and boots one NCCL group per axis.
+TPU-native: the topology IS a `jax.sharding.Mesh` with named axes
+(default order [dp, pp, sharding, sep, mp] ≙ fleet/fleet.py:702-725); a
+"communication group" is a mesh axis name — zero comm setup, and the same
+axis names drive NamedSharding placement of parameters/activations and lax
+collectives inside shard_map.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from ..collective import Group
+
+# paddle's default hybrid_parallel_order (distributed_strategy.py:323)
+DEFAULT_ORDER = ["dp", "pp", "sharding", "sep", "mp"]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._parallel_names = list(hybrid_group_names or DEFAULT_ORDER)
+        self._dims = list(dims or [1] * len(self._parallel_names))
+        self.coordinate = None
+
+    def get_hybrid_group_names(self):
+        return list(self._parallel_names)
+
+    def get_dim(self, axis_name) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs) -> int:
+        coord = [kwargs[name] for name in self._parallel_names]
+        return int(np.ravel_multi_index(coord, self._dims))
+
+    def get_coord(self, rank: int):
+        return tuple(int(c) for c in np.unravel_index(rank, self._dims))
+
+    def get_axis_list(self, axis_name: str, index: int):
+        axis = self._parallel_names.index(axis_name)
+        ranks = [
+            r for r in range(self.world_size())
+            if self.get_coord(r)[axis] == index
+        ]
+        return ranks
+
+    def get_comm_list(self, axis_name: str):
+        """All rank-lists that vary only along `axis_name`."""
+        axis = self._parallel_names.index(axis_name)
+        others = [d for i, d in enumerate(self._dims) if i != axis]
+        comm_list = []
+        for flat in range(int(np.prod(others)) if others else 1):
+            coord_rest = np.unravel_index(flat, others) if others else ()
+            ranks = []
+            for k in range(self._dims[axis]):
+                coord = list(coord_rest[:axis]) + [k] + list(coord_rest[axis:])
+                ranks.append(int(np.ravel_multi_index(coord, self._dims)))
+            comm_list.append(ranks)
+        return comm_list
+
+
+class HybridCommunicateGroup:
+    """≙ topology.py:189 — axis groups + the hybrid mesh they live on."""
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self.nranks = topology.world_size()
+        devs = jax.devices()
+        if self.nranks > len(devs):
+            raise ValueError(
+                f"hybrid topology needs {self.nranks} chips, {len(devs)} visible")
+        names = topology.get_hybrid_group_names()
+        dims = [topology.get_dim(n) for n in names]
+        self._mesh = Mesh(
+            np.array(devs[: self.nranks]).reshape(dims), tuple(names)
+        )
+        self.global_rank = 0  # single-controller: the controller traces rank 0
+        self._groups = {
+            n: Group(
+                ranks=topology.get_comm_list(n)[0],
+                axis_name=n,
+            )
+            for n in names
+        }
+
+    # ------------------------------------------------------------ mesh
+    def get_mesh(self) -> Mesh:
+        """The hybrid jax Mesh — THE object pjit/shard_map programs use."""
+        return self._mesh
+
+    def topology(self):
+        return self._topo
+
+    # ------------------------------------------------------------ degrees
+    def _degree(self, name):
+        return self._topo.get_dim(name) if name in self._topo.get_hybrid_group_names() else 1
+
+    def get_data_parallel_world_size(self):
+        return self._degree("dp")
+
+    def get_model_parallel_world_size(self):
+        return self._degree("mp")
+
+    def get_pipe_parallel_world_size(self):
+        return self._degree("pp")
+
+    def get_sharding_parallel_world_size(self):
+        return self._degree("sharding")
+
+    def get_sep_parallel_world_size(self):
+        return self._degree("sep")
+
+    # single-controller: the trace is written rank-0-relative
+    def get_data_parallel_rank(self):
+        return 0
+
+    get_model_parallel_rank = get_data_parallel_rank
+    get_stage_id = get_data_parallel_rank
+    get_sharding_parallel_rank = get_data_parallel_rank
+    get_sep_parallel_rank = get_data_parallel_rank
+
+    # ------------------------------------------------------------ groups
+    def get_data_parallel_group(self) -> Group:
+        return self._groups["dp"]
+
+    def get_model_parallel_group(self) -> Group:
+        return self._groups["mp"]
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._groups["pp"]
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._groups["sep"]
+
+    def get_check_parallel_group(self, *a, **k) -> Group:
+        return self._groups[self._topo.get_hybrid_group_names()[0]]
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    get_model_parallel_group_src_rank = get_data_parallel_group_src_rank
+
+    def get_p2p_groups(self):
+        return None
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_axis_list("pp", stage_id)[0]

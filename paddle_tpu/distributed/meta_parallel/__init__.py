@@ -1,0 +1,28 @@
+from .mp_layers import (
+    VocabParallelEmbedding,
+    ColumnParallelLinear,
+    RowParallelLinear,
+    ParallelCrossEntropy,
+)
+from .sp_utils import (
+    ScatterOp,
+    GatherOp,
+    AllGatherOp,
+    ReduceScatterOp,
+    ColumnSequenceParallelLinear,
+    RowSequenceParallelLinear,
+    mark_as_sequence_parallel_parameter,
+    register_sequence_parallel_allreduce_hooks,
+)
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer
+from .pipeline_parallel import PipelineParallel, PipelineParallelWithInterleave
+from .parallel_wrappers import (
+    DataParallel,
+    DataParallelShard,
+    TensorParallel,
+    SegmentParallel,
+    ShardingParallel,
+)
+from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed
+
+__all__ = [n for n in dir() if not n.startswith("_")]

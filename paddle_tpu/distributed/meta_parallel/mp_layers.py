@@ -1,0 +1,160 @@
+"""Tensor-parallel (Megatron-style) layers, TPU-native.
+
+Reference parity: fleet/layers/mpu/mp_layers.py — VocabParallelEmbedding
+(:49), ColumnParallelLinear (:336), RowParallelLinear (:543), and the
+identity/allreduce ops of mpu/mp_ops.py. The reference stores a per-rank
+WEIGHT SLICE and calls NCCL explicitly. Here each layer stores the FULL
+logical weight with a `NamedSharding` over the mesh's `mp` axis; forward is
+the plain math, and GSPMD inserts the all-gather/psum the mp_ops encode by
+hand. `gather_output` / `input_is_parallel` become output/input sharding
+constraints. Works identically in eager (sharded jax.Arrays) and under
+jit/pjit of a whole train step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.dispatch import op_call
+from ...core.tensor import Parameter, Tensor
+from ...nn import functional as F
+from ...nn.layer_base import Layer
+
+
+def _hcg():
+    from ..fleet import get_hybrid_communicate_group
+
+    return get_hybrid_communicate_group()
+
+
+def _mp_place(param: Parameter, spec: P):
+    """Shard a parameter over the hybrid mesh in place (buffer swap)."""
+    mesh = _hcg().get_mesh()
+    param._assign_raw(jax.device_put(param._data, NamedSharding(mesh, spec)))
+    return param
+
+
+def _constraint(t: Tensor, spec: P) -> Tensor:
+    """Differentiable sharding annotation (identity w/ placement).
+
+    Resolves against the mesh the data currently lives on when that mesh
+    carries every axis the spec names (inside a pipeline stage activations
+    live on the stage's sub-mesh, not the full hybrid mesh)."""
+    needed = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        needed.update(entry if isinstance(entry, tuple) else (entry,))
+    mesh = None
+    cur = getattr(t._data, "sharding", None)
+    if isinstance(cur, NamedSharding) and needed <= set(cur.mesh.axis_names):
+        mesh = cur.mesh
+    if mesh is None:
+        mesh = _hcg().get_mesh()
+    sh = NamedSharding(mesh, spec)
+
+    def fn(x):
+        if isinstance(x, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(x, sh)
+        return jax.device_put(x, sh)
+
+    return op_call(fn, t, name="sharding_constraint")
+
+
+def _clear_axis(t: Tensor, axis: str = "mp") -> Tensor:
+    """Gather over one mesh axis only: drop `axis` from the current spec,
+    keeping other placements (dp batch sharding survives an mp-gather)."""
+    cur = getattr(t._data, "sharding", None)
+    entries = [None] * t.ndim
+    if isinstance(cur, NamedSharding):
+        spec = tuple(cur.spec) + (None,) * (t.ndim - len(tuple(cur.spec)))
+        for d, entry in enumerate(spec):
+            names = entry if isinstance(entry, tuple) else (entry,) if entry else ()
+            kept = tuple(nm for nm in names if nm != axis)
+            entries[d] = kept if len(kept) > 1 else (kept[0] if kept else None)
+    return _constraint(t, P(*entries))
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with vocab-dim sharded weight (mpu/mp_layers.py:49)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=None)
+        if num_embeddings % max(_hcg().get_model_parallel_world_size(), 1) == 0:
+            _mp_place(self.weight, P("mp", None))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with output-dim sharded weight (mpu/mp_layers.py:336)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr)
+        _mp_place(self.weight, P(None, "mp"))
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            _mp_place(self.bias, P("mp"))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            y = _clear_axis(y, "mp")
+        return y
+
+
+class RowParallelLinear(Layer):
+    """Linear with input-dim sharded weight (mpu/mp_layers.py:543); partial
+    outputs are summed by the psum GSPMD inserts for the contracted dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr)
+        _mp_place(self.weight, P("mp", None))
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            spec = [None] * x.ndim
+            spec[-1] = "mp"
+            x = _constraint(x, P(*spec))
+        return F.linear(x, self.weight, self.bias)
+
+
+class ParallelCrossEntropy(Layer):
+    """CE over mp-sharded logits (mpu/mp_layers.py ParallelCrossEntropy):
+    logits stay vocab-sharded; XLA handles the sharded reduce in softmax."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(
+            input, label, reduction="none", ignore_index=self.ignore_index)

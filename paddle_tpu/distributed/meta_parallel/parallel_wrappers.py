@@ -1,0 +1,128 @@
+"""Model wrappers for the hybrid axes (≙ fleet/meta_parallel wrappers).
+
+Reference parity: TensorParallel (tensor_parallel.py:28), SegmentParallel
+(segment_parallel.py:26), ShardingParallel, paddle.DataParallel
+(distributed/parallel.py:219 + C++ Reducer gradient bucketing). On TPU the
+wrappers don't install gradient hooks: data parallelism is the `dp` mesh
+axis on the BATCH dim — the wrapper shards inputs, and the gradient
+"allreduce with bucketing/overlap" is the psum XLA schedules for the
+sharded-batch loss (overlapped with backward by the compiler).
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...nn.layer_base import Layer
+
+
+class _AxisShardWrapper(Layer):
+    axis: str = "dp"
+
+    def __init__(self, layers: Layer, hcg=None, **kwargs):
+        super().__init__()
+        if hcg is None:
+            from ..fleet import get_hybrid_communicate_group
+
+            hcg = get_hybrid_communicate_group()
+        self._layers = layers
+        self._hcg = hcg
+
+    def _shard_input(self, t: Tensor, dim: int = 0) -> Tensor:
+        mesh = self._hcg.get_mesh()
+        if t._data.shape[dim] % mesh.shape[self.axis] != 0:
+            return t
+        spec = [None] * t.ndim
+        spec[dim] = self.axis
+        sh = NamedSharding(mesh, P(*spec))
+        if isinstance(t._data, jax.core.Tracer):
+            out = Tensor(
+                jax.lax.with_sharding_constraint(t._data, sh), _internal=True,
+                stop_gradient=t.stop_gradient)
+            out._node, out._out_idx = t._node, t._out_idx
+            return out
+        out = Tensor(jax.device_put(t._data, sh), _internal=True,
+                     stop_gradient=t.stop_gradient)
+        out._node, out._out_idx = t._node, t._out_idx
+        return out
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(
+            self._shard_input(x) if isinstance(x, Tensor) and x.ndim > 0 else x
+            for x in inputs
+        )
+        return self._layers(*inputs, **kwargs)
+
+    # transparent passthrough for training utilities
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+
+class DataParallelShard(_AxisShardWrapper):
+    """dp-axis wrapper: shard the batch; grads come out globally correct."""
+
+    axis = "dp"
+
+
+class TensorParallel(_AxisShardWrapper):
+    """mp wrapper (tensor_parallel.py:28): mp layers place their own weights
+    at construction; the wrapper only broadcasts inputs (a no-op here since
+    single-controller tensors are replicated by construction) — it never
+    shards inputs, hence the forward override."""
+
+    axis = "mp"
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+
+class SegmentParallel(_AxisShardWrapper):
+    """sep wrapper (segment_parallel.py:26): shard the sequence dim (dim 1
+    of [batch, seq, ...] inputs) across the sep axis."""
+
+    axis = "sep"
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(
+            self._shard_input(x, dim=1) if isinstance(x, Tensor) and x.ndim > 1 else x
+            for x in inputs
+        )
+        return self._layers(*inputs, **kwargs)
+
+
+class ShardingParallel(_AxisShardWrapper):
+    axis = "sharding"
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+
+def DataParallel(layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+    """paddle.DataParallel — buffer sizes/unused-param scan are NCCL-Reducer
+    knobs with no TPU analog; accepted and ignored."""
+    try:
+        from ..fleet import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+    except Exception:
+        hcg = None
+    if hcg is None or hcg.get_data_parallel_world_size() <= 1:
+        # single-axis default: whole world is data-parallel
+        from ..fleet import CommunicateTopology, HybridCommunicateGroup
+        import jax as _jax
+
+        n = len(_jax.devices())
+        hcg = HybridCommunicateGroup(CommunicateTopology(["dp"], [n]))
+    return DataParallelShard(layers, hcg)

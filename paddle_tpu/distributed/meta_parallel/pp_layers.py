@@ -1,0 +1,268 @@
+"""Pipeline-stage model description and partitioning.
+
+Reference parity: PipelineLayer / LayerDesc / SharedLayerDesc
+(fleet/meta_parallel/parallel_layers/pp_layers.py:258). The reference builds
+only the local stage's layers per rank. Single-controller TPU builds ALL
+stages and pins each stage's parameters to its slice of the `pp` mesh axis
+(a per-stage sub-Mesh over the device grid), so stage compute runs on
+disjoint chips and the XLA runtime overlaps in-flight micro-batches.
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...nn.layer_base import Layer
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer appearing in several stages (embedding/lm-head).
+    Single-controller builds it ONCE and reuses the instance — tying and
+    grad accumulation are free (same Parameter object on the tape)."""
+
+    def __init__(self, key, layer_cls, *args, forward_func=None, shared_weight_attr="weight",
+                 **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.key = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class _FunctionWrapper(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+def _to_stage(x, mesh, shard_batch=False):
+    """Move an activation onto a stage's sub-mesh (ICI p2p) with a hand-built
+    GradNode: cross-device-set movement cannot live inside one traced program
+    (one XLA program = one device set), so both directions are eager
+    device_puts — the runtime still overlaps them with compute.
+
+    shard_batch: additionally shard dim 0 over the stage mesh's dp axis
+    (used on raw micro-batch inputs; downstream activations inherit it)."""
+    if mesh is None or not isinstance(x, Tensor) or isinstance(x._data, jax.core.Tracer):
+        return x
+    from ...core.dispatch import GradNode, grad_enabled
+
+    src = getattr(x._data, "sharding", None)
+    sh = _keep_axes(x._data, mesh)
+    if shard_batch and "dp" in mesh.axis_names and x.ndim > 0 \
+            and x._data.shape[0] % mesh.shape["dp"] == 0:
+        spec = list(tuple(sh.spec) + (None,) * (x.ndim - len(tuple(sh.spec))))
+        if spec[0] is None:
+            spec[0] = "dp"
+            sh = NamedSharding(mesh, P(*spec))
+    out_data = jax.device_put(x._data, sh)
+    if x.stop_gradient or not grad_enabled():
+        return Tensor(out_data, _internal=True, stop_gradient=x.stop_gradient)
+
+    def vjp(cot):
+        return (jax.device_put(cot, src) if src is not None else cot,)
+
+    node = GradNode(vjp, [x], [(out_data.shape, out_data.dtype)], True, "pp_transfer")
+    out = Tensor(out_data, _internal=True, stop_gradient=False)
+    out._node = node
+    return out
+
+
+def _align_act(x, layer):
+    """Move an activation onto the mesh a layer's parameters live on."""
+    ps = layer.parameters()
+    wsh = getattr(ps[0]._data, "sharding", None) if ps else None
+    if not isinstance(wsh, NamedSharding):
+        return x
+    return _to_stage(x, wsh.mesh)
+
+
+def _align_weight(w, act):
+    """Move a (possibly other-stage) weight onto the activation's mesh at
+    call time — how SharedLayerDesc weight tying works across stages: the
+    transfer is autograd-recorded, so both uses accumulate into ONE
+    Parameter (the reference instead allreduces shared grads by hand)."""
+    cur = getattr(act._data if isinstance(act, Tensor) else act, "sharding", None)
+    wsh = getattr(w._data, "sharding", None)
+    if not isinstance(cur, NamedSharding) or not isinstance(wsh, NamedSharding):
+        return w
+    if set(d.id for d in cur.mesh.devices.flat) == set(d.id for d in wsh.mesh.devices.flat):
+        return w
+    return _to_stage(w, cur.mesh)
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, num_virtual_pipeline_stages=None,
+                 **kwargs):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._descs = list(layers)
+        if num_stages is None:
+            if topology is not None:
+                num_stages = topology.get_dim("pp")
+            else:
+                from ..fleet import get_hybrid_communicate_group
+
+                num_stages = get_hybrid_communicate_group().get_pipe_parallel_world_size()
+        self.num_stages = max(int(num_stages), 1)
+        self._recompute_interval = recompute_interval
+
+        shared_instances: dict[str, Layer] = {}
+        built: list[Layer] = []
+        self._shared_descs: list[tuple[int, SharedLayerDesc]] = []
+        for i, d in enumerate(self._descs):
+            if isinstance(d, SharedLayerDesc):
+                if d.key not in shared_instances:
+                    shared_instances[d.key] = d.build_layer()
+                inst = shared_instances[d.key]
+                first_use = d.key not in [sd.key for _, sd in self._shared_descs]
+                if d.forward_func is not None:
+                    fn = d.forward_func
+                    weight = getattr(inst, d.shared_weight_attr)
+                    wrapped = _FunctionWrapper(
+                        lambda x, _fn=fn, _w=weight: _fn(x, _align_weight(_w, x)))
+                    if first_use:
+                        wrapped.add_sublayer("shared", inst)
+                    built.append(wrapped)
+                elif first_use:
+                    built.append(inst)
+                else:
+                    # bare reuse in a later stage: run it where its weights
+                    # live (activation hops meshes; named_parameters dedupes
+                    # by identity so the tied weight stays one Parameter)
+                    built.append(_FunctionWrapper(
+                        lambda x, _l=inst: _l(_align_act(x, _l))))
+                self._shared_descs.append((i, d))
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            elif callable(d):
+                built.append(_FunctionWrapper(d))
+            else:
+                raise TypeError(f"unsupported pipeline entry {d!r}")
+        for i, l in enumerate(built):
+            self.add_sublayer(str(i), l)
+        self._layers_list = built
+        self._partition(seg_method)
+        self._place_stages()
+
+    # ------------------------------------------------------------ partition
+    def _partition(self, seg_method):
+        n = len(self._layers_list)
+        if isinstance(seg_method, str) and seg_method.startswith("layer:"):
+            # cut at layers whose class name matches (reference seg_method)
+            pat = seg_method.split("layer:", 1)[1]
+            marks = [i for i, l in enumerate(self._layers_list)
+                     if re.match(pat, type(l).__name__)]
+            per = max(len(marks) // self.num_stages, 1)
+            bounds = [0]
+            for s in range(1, self.num_stages):
+                idx = s * per
+                bounds.append(marks[idx] if idx < len(marks) else n)
+            bounds.append(n)
+        else:
+            per = -(-n // self.num_stages)
+            bounds = [min(i * per, n) for i in range(self.num_stages)] + [n]
+        self.segment_parts = bounds
+        self._stage_slices = [
+            (bounds[s], bounds[s + 1]) for s in range(self.num_stages)
+        ]
+
+    def get_stage_from_index(self, idx: int) -> int:
+        for s, (a, b) in enumerate(self._stage_slices):
+            if a <= idx < b:
+                return s
+        return self.num_stages - 1
+
+    # ------------------------------------------------------------ placement
+    def _stage_mesh(self, stage: int) -> Mesh | None:
+        """Sub-mesh of the hybrid mesh at pp-coordinate == stage."""
+        try:
+            from ..fleet import get_hybrid_communicate_group
+
+            mesh = get_hybrid_communicate_group().get_mesh()
+        except Exception:
+            return None
+        if "pp" not in mesh.axis_names or mesh.shape["pp"] < self.num_stages:
+            return None
+        axis = mesh.axis_names.index("pp")
+        devs = np.take(mesh.devices, stage, axis=axis)
+        names = tuple(nm for nm in mesh.axis_names if nm != "pp")
+        return Mesh(devs, names)
+
+    def _place_stages(self):
+        for s, (a, b) in enumerate(self._stage_slices):
+            mesh = self._stage_mesh(s)
+            if mesh is None:
+                continue
+            for l in self._layers_list[a:b]:
+                for p in l.parameters():
+                    if getattr(p, "_pp_placed", False):
+                        continue
+                    sh = _keep_axes(p._data, mesh)
+                    p._assign_raw(jax.device_put(p._data, sh))
+                    p._pp_placed = True
+
+    # ------------------------------------------------------------ forward
+    def forward(self, x, stage_range=None):
+        if stage_range is None:
+            # full model: hop stage sub-meshes at the boundaries
+            for s in range(self.num_stages):
+                x = _to_stage(x, self.stage_meshes[s])
+                x = self.forward_stage(x, s)
+            return x
+        lo, hi = stage_range
+        for i in range(lo, hi):
+            if isinstance(x, tuple):
+                x = self._layers_list[i](*x)
+            else:
+                x = self._layers_list[i](x)
+        return x
+
+    def forward_stage(self, x, stage: int):
+        a, b = self._stage_slices[stage]
+        return self.forward(x, stage_range=(a, b))
+
+    @property
+    def stage_meshes(self):
+        if not hasattr(self, "_stage_meshes"):
+            self._stage_meshes = [self._stage_mesh(s) for s in range(self.num_stages)]
+        return self._stage_meshes
+
+    @property
+    def loss_fn(self):
+        return self._loss_fn
+
+
+def _keep_axes(arr, mesh: Mesh) -> NamedSharding:
+    """Re-place an array on a stage sub-mesh, keeping any axis sharding it
+    already has on axes that still exist (mp/dp sharding survives pp pinning)."""
+    old = getattr(arr, "sharding", None)
+    spec = [None] * arr.ndim
+    if isinstance(old, NamedSharding):
+        for d, entry in enumerate(tuple(old.spec) + (None,) * (arr.ndim - len(tuple(old.spec)))):
+            names = entry if isinstance(entry, tuple) else (entry,) if entry else ()
+            kept = tuple(nm for nm in names if nm in mesh.axis_names)
+            spec[d] = kept if len(kept) > 1 else (kept[0] if kept else None)
+    return NamedSharding(mesh, P(*spec))

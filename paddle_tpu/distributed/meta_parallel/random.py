@@ -1,0 +1,63 @@
+"""Model-parallel RNG state tracking.
+
+Reference parity: fleet/meta_parallel/parallel_layers/random.py
+RNGStatesTracker — distinct dropout streams inside vs outside TP regions so
+replicated activations drop identically and sharded ones independently.
+TPU-native: named jax PRNG keys; `rng_state(name)` swaps the framework's
+global key (paddle_tpu.core.rng) for the named stream's and folds it forward.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ...core import rng as core_rng
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states: dict[str, jax.Array] = {}
+
+    def reset(self):
+        self.states.clear()
+
+    def add(self, name: str, seed: int):
+        if name in self.states:
+            raise ValueError(f"rng state {name!r} already added")
+        self.states[name] = [jax.random.PRNGKey(seed)]
+
+    def get_states_tracker(self):
+        return dict(self.states)
+
+    def set_states_tracker(self, states):
+        self.states = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states:
+            raise ValueError(f"rng state {name!r} not added")
+        orig = core_rng.get_rng_state()
+        core_rng.set_rng_state(self.states[name])
+        try:
+            yield
+        finally:
+            self.states[name] = core_rng.get_rng_state()
+            core_rng.set_rng_state(orig)
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed: int = 0):
+    """≙ random.py model_parallel_random_seed: distinct seed per mp "rank" —
+    single-controller derives the mp stream by folding the axis constant."""
+    _tracker.reset()
+    core_rng.seed(seed)
+    _tracker.add(MODEL_PARALLEL_RNG, seed + 1024)

@@ -1,0 +1,115 @@
+"""Sequence-parallel utilities, TPU-native.
+
+Reference parity: fleet/utils/sequence_parallel_utils.py — ScatterOp/
+GatherOp/AllGatherOp/ReduceScatterOp PyLayers (:85-127) and the
+Column/RowSequenceParallelLinear layers (:429,:564). The reference moves
+activations with explicit NCCL calls; here sequence parallelism is the
+`mp` mesh axis re-used on the SEQUENCE dim of activations: the ops are
+differentiable sharding annotations and XLA materializes the all-gather /
+reduce-scatter pairs (fused with the adjacent matmuls where profitable).
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.dispatch import op_call
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...nn.layer_base import Layer
+from .mp_layers import ColumnParallelLinear, RowParallelLinear, _clear_axis, _constraint
+
+
+def _seq_spec(ndim: int, seq_dim: int = 0) -> P:
+    spec = [None] * ndim
+    spec[seq_dim] = "mp"
+    return P(*spec)
+
+
+def mark_as_sequence_parallel_parameter(param):
+    param.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(param) -> bool:
+    return getattr(param, "sequence_parallel", False)
+
+
+class ScatterOp:
+    """Split activation along the sequence dim across the mp axis."""
+
+    @staticmethod
+    def apply(x: Tensor, axis: int = 0) -> Tensor:
+        return _constraint(x, _seq_spec(x.ndim, axis))
+
+
+class GatherOp:
+    """Gather sequence shards back to the full sequence (mp axis only —
+    other placements, e.g. dp batch sharding, are preserved)."""
+
+    @staticmethod
+    def apply(x: Tensor, axis: int = 0) -> Tensor:
+        return _clear_axis(x, "mp")
+
+
+# paddle exposes these as module-level functions too
+def scatter(x, axis=0):
+    return ScatterOp.apply(x, axis)
+
+
+def all_gather(x, axis=0):
+    return GatherOp.apply(x, axis)
+
+
+class AllGatherOp(GatherOp):
+    pass
+
+
+class ReduceScatterOp:
+    """Sum partial activations and scatter along sequence (≙ :118)."""
+
+    @staticmethod
+    def apply(x: Tensor, axis: int = 0) -> Tensor:
+        return _constraint(x, _seq_spec(x.ndim, axis))
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Input arrives sequence-sharded; weight is column-sharded. The
+    all-gather of the sequence before the matmul (reference :429) is the
+    resharding XLA emits between the two constraints."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias, gather_output=gather_output,
+                         mp_group=mp_group, name=name)
+
+    def forward(self, x):
+        x = _constraint(x, _seq_spec(x.ndim, 0))
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            y = _clear_axis(y, "mp")
+        return y
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Weight row-sharded; output reduce-scattered along sequence
+    (reference :564): encoded as hidden-sharded input + seq-sharded output."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias, input_is_parallel=input_is_parallel,
+                         mp_group=mp_group, name=name)
+
+    def forward(self, x):
+        y = super().forward(x)
+        return _constraint(y, _seq_spec(y.ndim, 0))
+
+
+def register_sequence_parallel_allreduce_hooks(model, *args, **kwargs):
+    """Reference :192 installs grad allreduce hooks for SP params; with
+    sharded-batch autodiff the partitioner already produces correct grads —
+    kept as an API no-op."""
+    return None

@@ -1,0 +1,12 @@
+from .sharding_optimizer import (
+    ShardingOptimizerStage1,
+    ShardingOptimizerStage2,
+    ShardingOptimizerStage3,
+)
+from .group_sharded import group_sharded_parallel, save_group_sharded_model
+
+__all__ = [
+    "ShardingOptimizerStage1", "ShardingOptimizerStage2",
+    "ShardingOptimizerStage3", "group_sharded_parallel",
+    "save_group_sharded_model",
+]

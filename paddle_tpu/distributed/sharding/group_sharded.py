@@ -1,0 +1,61 @@
+"""group_sharded_parallel — the standalone ZeRO-2/3 entry point.
+
+Reference parity: distributed/sharding/group_sharded.py:50 — level 'os'
+(optimizer states), 'os_g' (+ gradients), 'p_g_os' (+ parameters, FSDP).
+Returns (model, optimizer, scaler) like the reference; the model is
+unchanged (placements are on tensors, not module structure).
+"""
+from __future__ import annotations
+
+from .sharding_optimizer import (
+    ShardingOptimizerStage1,
+    ShardingOptimizerStage2,
+    ShardingOptimizerStage3,
+)
+
+_LEVELS = {
+    "os": ShardingOptimizerStage1,
+    "os_g": ShardingOptimizerStage2,
+    "p_g_os": ShardingOptimizerStage3,
+}
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=2**23,
+                           segment_size=2**20, sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    if level not in _LEVELS:
+        raise ValueError(f"level must be one of {list(_LEVELS)}, got {level!r}")
+    if offload:
+        raise NotImplementedError("CPU offload is not supported on the TPU stack")
+    import jax
+
+    mesh = None
+    if group is not None:
+        mesh = group.mesh
+        axis = group.axis_name
+    else:
+        from ..fleet import get_hybrid_communicate_group, is_initialized
+
+        if is_initialized():
+            hcg = get_hybrid_communicate_group()
+            if hcg.get_sharding_parallel_world_size() > 1:
+                mesh, axis = hcg.get_mesh(), "sharding"
+        if mesh is None:
+            from jax.sharding import Mesh
+            import numpy as np
+
+            mesh, axis = Mesh(np.array(jax.devices()), ("sharding",)), "sharding"
+    opt = _LEVELS[level](optimizer, mesh=mesh, axis=axis)
+    return model, opt, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    import os
+
+    from ...framework_io import save
+
+    os.makedirs(output, exist_ok=True)
+    save(model.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))
