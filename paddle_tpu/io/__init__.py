@@ -1,358 +1,35 @@
 """paddle.io: Dataset / DataLoader / samplers (≙ python/paddle/io).
 
-Single-process loader with async host→device prefetch (device_put pipelining —
-the TPU analog of paddle's pinned-memory + GPU prefetch path). Multiprocess
-workers (io/reader.py:262 _DataLoaderIterMultiProcess) use a
-multiprocessing.Pool-based prefetcher; a C++ shared-memory ring is planned.
+Datasets and samplers are host-side Python; the DataLoader moves batches to
+the chips. Multiprocess workers (≙ io/dataloader/dataloader_iter.py:368
+_DataLoaderIterMultiProcess + shared-memory queue) use fork + queues with
+numpy payloads; transfer to HBM is the collate step's device_put, prefetched
+one batch ahead.
 """
-from __future__ import annotations
-
-import bisect
-import itertools
-import math
-
-import numpy as np
-
-from ..core.rng import next_key
-from ..core.tensor import Tensor
-
-
-class Dataset:
-    def __getitem__(self, idx):
-        raise NotImplementedError
-
-    def __len__(self):
-        raise NotImplementedError
-
-
-class IterableDataset(Dataset):
-    def __iter__(self):
-        raise NotImplementedError
-
-    def __getitem__(self, idx):
-        raise RuntimeError("IterableDataset has no __getitem__")
-
-    def __len__(self):
-        raise RuntimeError("IterableDataset has no __len__")
-
-
-class TensorDataset(Dataset):
-    def __init__(self, tensors):
-        self.tensors = tensors
-
-    def __getitem__(self, idx):
-        return tuple(t[idx] for t in self.tensors)
-
-    def __len__(self):
-        return self.tensors[0].shape[0]
-
-
-class ComposeDataset(Dataset):
-    def __init__(self, datasets):
-        self.datasets = datasets
-
-    def __len__(self):
-        return min(len(d) for d in self.datasets)
-
-    def __getitem__(self, idx):
-        out = []
-        for d in self.datasets:
-            item = d[idx]
-            out.extend(item if isinstance(item, (list, tuple)) else [item])
-        return tuple(out)
-
-
-class ChainDataset(IterableDataset):
-    def __init__(self, datasets):
-        self.datasets = datasets
-
-    def __iter__(self):
-        for d in self.datasets:
-            yield from d
-
-
-class ConcatDataset(Dataset):
-    def __init__(self, datasets):
-        self.datasets = list(datasets)
-        self.cumulative_sizes = list(itertools.accumulate(len(d) for d in self.datasets))
-
-    def __len__(self):
-        return self.cumulative_sizes[-1]
-
-    def __getitem__(self, idx):
-        if idx < 0:
-            idx += len(self)
-        i = bisect.bisect_right(self.cumulative_sizes, idx)
-        off = idx - (self.cumulative_sizes[i - 1] if i > 0 else 0)
-        return self.datasets[i][off]
-
-
-class Subset(Dataset):
-    def __init__(self, dataset, indices):
-        self.dataset = dataset
-        self.indices = indices
-
-    def __getitem__(self, idx):
-        return self.dataset[self.indices[idx]]
-
-    def __len__(self):
-        return len(self.indices)
-
-
-def random_split(dataset, lengths, generator=None):
-    if all(isinstance(l, float) for l in lengths):
-        n = len(dataset)
-        lengths = [int(math.floor(n * l)) for l in lengths]
-        lengths[-1] += n - sum(lengths)
-    perm = np.random.permutation(sum(lengths))
-    out = []
-    off = 0
-    for l in lengths:
-        out.append(Subset(dataset, perm[off:off + l].tolist()))
-        off += l
-    return out
-
-
-# ---------------------------------------------------------------- samplers
-class Sampler:
-    def __init__(self, data_source=None):
-        self.data_source = data_source
-
-    def __iter__(self):
-        raise NotImplementedError
-
-    def __len__(self):
-        return len(self.data_source)
-
-
-class SequenceSampler(Sampler):
-    def __iter__(self):
-        return iter(range(len(self.data_source)))
-
-
-class RandomSampler(Sampler):
-    def __init__(self, data_source, replacement=False, num_samples=None, generator=None):
-        super().__init__(data_source)
-        self.replacement = replacement
-        self._num_samples = num_samples
-
-    @property
-    def num_samples(self):
-        return self._num_samples or len(self.data_source)
-
-    def __iter__(self):
-        n = len(self.data_source)
-        if self.replacement:
-            return iter(np.random.randint(0, n, self.num_samples).tolist())
-        return iter(np.random.permutation(n)[: self.num_samples].tolist())
-
-    def __len__(self):
-        return self.num_samples
-
-
-class WeightedRandomSampler(Sampler):
-    def __init__(self, weights, num_samples, replacement=True):
-        self.weights = np.asarray(weights, np.float64)
-        self.num_samples = num_samples
-        self.replacement = replacement
-
-    def __iter__(self):
-        p = self.weights / self.weights.sum()
-        return iter(np.random.choice(len(self.weights), self.num_samples,
-                                     replace=self.replacement, p=p).tolist())
-
-    def __len__(self):
-        return self.num_samples
-
-
-class BatchSampler(Sampler):
-    def __init__(self, dataset=None, sampler=None, shuffle=False, batch_size=1,
-                 drop_last=False):
-        self.batch_size = batch_size
-        self.drop_last = drop_last
-        if sampler is not None:
-            self.sampler = sampler
-        elif shuffle:
-            self.sampler = RandomSampler(dataset)
-        else:
-            self.sampler = SequenceSampler(dataset)
-
-    def __iter__(self):
-        batch = []
-        for idx in self.sampler:
-            batch.append(idx)
-            if len(batch) == self.batch_size:
-                yield batch
-                batch = []
-        if batch and not self.drop_last:
-            yield batch
-
-    def __len__(self):
-        n = len(self.sampler)
-        if self.drop_last:
-            return n // self.batch_size
-        return (n + self.batch_size - 1) // self.batch_size
-
-
-class DistributedBatchSampler(BatchSampler):
-    """Shards the index space across data-parallel ranks
-    (≙ python/paddle/io/dataloader/batch_sampler.py DistributedBatchSampler)."""
-
-    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
-                 shuffle=False, drop_last=False):
-        from ..distributed import get_rank, get_world_size
-
-        self.dataset = dataset
-        self.batch_size = batch_size
-        self.nranks = num_replicas if num_replicas is not None else get_world_size()
-        self.local_rank = rank if rank is not None else get_rank()
-        self.shuffle = shuffle
-        self.drop_last = drop_last
-        self.epoch = 0
-        self.num_samples = int(math.ceil(len(dataset) / self.nranks))
-        self.total_size = self.num_samples * self.nranks
-
-    def __iter__(self):
-        indices = list(range(len(self.dataset)))
-        if self.shuffle:
-            rng = np.random.RandomState(self.epoch)
-            rng.shuffle(indices)
-        indices += indices[: self.total_size - len(indices)]
-        indices = indices[self.local_rank::self.nranks]
-        batch = []
-        for idx in indices:
-            batch.append(idx)
-            if len(batch) == self.batch_size:
-                yield batch
-                batch = []
-        if batch and not self.drop_last:
-            yield batch
-
-    def set_epoch(self, epoch):
-        self.epoch = epoch
-
-    def __len__(self):
-        if self.drop_last:
-            return self.num_samples // self.batch_size
-        return (self.num_samples + self.batch_size - 1) // self.batch_size
-
-
-# ---------------------------------------------------------------- collate
-def default_collate_fn(batch):
-    sample = batch[0]
-    if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
-    if isinstance(sample, Tensor):
-        from ..ops import stack
-
-        return stack(batch)
-    if isinstance(sample, (int, np.integer)):
-        return Tensor(np.asarray(batch, np.int64))
-    if isinstance(sample, float):
-        return Tensor(np.asarray(batch, np.float32))
-    if isinstance(sample, (list, tuple)):
-        transposed = list(zip(*batch))
-        return tuple(default_collate_fn(list(s)) for s in transposed)
-    if isinstance(sample, dict):
-        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
-    return batch
-
-
-class _SingleProcessIter:
-    def __init__(self, loader):
-        self.loader = loader
-        ds = loader.dataset
-        if isinstance(ds, IterableDataset):
-            self._it = iter(ds)
-            self._mode = "iterable"
-        else:
-            self._batches = iter(loader.batch_sampler)
-            self._mode = "map"
-        self._prefetched = []
-
-    def __iter__(self):
-        return self
-
-    def _fetch(self):
-        if self._mode == "iterable":
-            batch = list(itertools.islice(self._it, self.loader.batch_size))
-            if not batch:
-                raise StopIteration
-        else:
-            idxs = next(self._batches)
-            batch = [self.loader.dataset[i] for i in idxs]
-        fn = self.loader.collate_fn or default_collate_fn
-        return fn(batch)
-
-    def __next__(self):
-        return self._fetch()
-
-
-class DataLoader:
-    """≙ paddle.io.DataLoader (io/reader.py:262). num_workers>0 uses a thread
-    prefetcher (jax host compute releases the GIL during device transfers);
-    process workers + shm queue arrive with the C++ runtime component."""
-
-    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
-                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
-                 collate_fn=None, num_workers=0, use_buffer_reader=True,
-                 prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
-        self.dataset = dataset
-        self.batch_size = batch_size
-        self.collate_fn = collate_fn
-        self.num_workers = num_workers
-        self.prefetch_factor = prefetch_factor
-        if batch_sampler is not None:
-            self.batch_sampler = batch_sampler
-            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
-        elif not isinstance(dataset, IterableDataset):
-            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
-                                              batch_size=batch_size, drop_last=drop_last)
-        else:
-            self.batch_sampler = None
-
-    def __iter__(self):
-        if self.num_workers > 0:
-            return _ThreadPrefetchIter(self)
-        return _SingleProcessIter(self)
-
-    def __len__(self):
-        if self.batch_sampler is not None:
-            return len(self.batch_sampler)
-        raise TypeError("IterableDataset DataLoader has no length")
-
-
-class _ThreadPrefetchIter(_SingleProcessIter):
-    def __init__(self, loader):
-        super().__init__(loader)
-        import queue
-        import threading
-
-        self._q = queue.Queue(maxsize=max(2, loader.prefetch_factor * loader.num_workers))
-        self._done = object()
-        self._thread = threading.Thread(target=self._worker, daemon=True)
-        self._thread.start()
-
-    def _worker(self):
-        try:
-            while True:
-                try:
-                    self._q.put(self._fetch())
-                except StopIteration:
-                    self._q.put(self._done)
-                    return
-        except Exception as e:  # propagate to consumer
-            self._q.put(e)
-
-    def __next__(self):
-        item = self._q.get()
-        if item is self._done:
-            raise StopIteration
-        if isinstance(item, Exception):
-            raise item
-        return item
-
-
-def get_worker_info():
-    return None
+from .dataset import (
+    Dataset,
+    IterableDataset,
+    TensorDataset,
+    ComposeDataset,
+    ChainDataset,
+    ConcatDataset,
+    Subset,
+    random_split,
+)
+from .sampler import (
+    Sampler,
+    SequenceSampler,
+    RandomSampler,
+    WeightedRandomSampler,
+    BatchSampler,
+    DistributedBatchSampler,
+)
+from .dataloader import DataLoader, WorkerInfo, default_collate_fn, get_worker_info
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "ConcatDataset", "Subset", "random_split",
+    "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
+    "BatchSampler", "DistributedBatchSampler",
+    "DataLoader", "WorkerInfo", "default_collate_fn", "get_worker_info",
+]
