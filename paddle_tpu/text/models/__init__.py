@@ -1,0 +1,11 @@
+from .llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+    llama_7b_config,
+    llama_tiny_config,
+)
+from .bert import BertConfig, BertForSequenceClassification, BertModel
+from .gpt import GPTConfig, GPTForCausalLM
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,91 @@
+"""GPT decoder (≙ BASELINE.json config-4: GPT-3-medium, DP + sharding-2).
+
+Pre-norm GPT-2/3 style: learned positions, LayerNorm, GELU MLP. Shares the
+TP-aware layer selection with the LLaMA flagship.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ... import nn
+from ...nn import functional as F
+from .llama import _tp_layers
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024          # GPT-3 medium
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    intermediate_size: int | None = None
+    max_position_embeddings: int = 2048
+    layer_norm_eps: float = 1e-5
+    tensor_parallel: bool = False
+    sequence_parallel: bool = False
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        col, row, _ = _tp_layers(config)
+        h = config.hidden_size
+        self.qkv_proj = col(h, 3 * h)
+        self.out_proj = row(h, h)
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        o = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        return self.out_proj(o.reshape([b, s, h]))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        col, row, _ = _tp_layers(config)
+        self.ln_1 = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.attn = GPTAttention(config)
+        self.ln_2 = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.fc_in = col(config.hidden_size, config.intermediate_size)
+        self.fc_out = row(config.intermediate_size, config.hidden_size)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln_1(x))
+        x = x + self.fc_out(F.gelu(self.fc_in(self.ln_2(x))))
+        return x
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        _, _, emb = _tp_layers(config)
+        self.wte = emb(config.vocab_size, config.hidden_size)
+        self.wpe = nn.Embedding(config.max_position_embeddings, config.hidden_size)
+        self.blocks = nn.LayerList([GPTBlock(config)
+                                    for _ in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                 bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        import paddle_tpu as paddle
+
+        s = input_ids.shape[1]
+        pos = paddle.arange(s, dtype="int64").unsqueeze(0)
+        x = self.wte(input_ids) + self.wpe(pos)
+        for blk in self.blocks:
+            x = blk(x)
+        logits = self.lm_head(self.ln_f(x))
+        if labels is not None:
+            return F.cross_entropy(logits.reshape([-1, self.config.vocab_size]),
+                                   labels.reshape([-1]), reduction="mean")
+        return logits

@@ -1,0 +1,232 @@
+"""LLaMA-family decoder — the flagship model of the framework.
+
+Reference parity: the BASELINE.json config-5 workload (PaddleNLP LLaMA-7B
+hybrid tp×pp×dp pretrain). The reference ecosystem implements the model with
+fleet mpu layers + fused CUDA kernels (fusion inventory at
+/root/reference/paddle/phi/kernels/fusion/); here the same architecture is
+built TPU-first:
+
+  - attention runs through F.scaled_dot_product_attention, whose fast path is
+    the Pallas flash kernel (paddle_tpu/ops/pallas_attention.py) on TPU;
+  - tensor parallelism = Column/Row/VocabParallelLinear layers storing FULL
+    logical weights with NamedSharding over the `mp` mesh axis (GSPMD inserts
+    the collectives Megatron codes by hand);
+  - sequence parallelism = sharding annotations on the sequence dim
+    (meta_parallel/sp_utils.py);
+  - pipeline = `pipeline_descs()` emits LayerDesc chunks for PipelineLayer.
+
+All matmuls are [B*S, H]-shaped and bf16-friendly for the MXU.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ... import nn
+from ...core.tensor import Tensor
+from ...nn import functional as F
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int | None = None
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    # parallelism switches (≙ PaddleNLP config knobs)
+    tensor_parallel: bool = False
+    sequence_parallel: bool = False
+    use_recompute: bool = False  # ≙ recompute_granularity: jax.checkpoint per block
+
+    def __post_init__(self):
+        if self.num_key_value_heads is None:
+            self.num_key_value_heads = self.num_attention_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def llama_7b_config(**kw) -> LlamaConfig:
+    return LlamaConfig(**kw)
+
+
+def llama_tiny_config(**kw) -> LlamaConfig:
+    base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                max_position_embeddings=128)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def _rope_tables(seq_len: int, head_dim: int, theta: float, dtype="float32"):
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    t = np.arange(seq_len, dtype=np.float64)
+    freqs = np.outer(t, inv)  # [S, D/2]
+    emb = np.concatenate([freqs, freqs], axis=-1)  # [S, D]
+    cos = np.cos(emb)[None, :, None, :].astype(dtype)  # [1, S, 1, D]
+    sin = np.sin(emb)[None, :, None, :].astype(dtype)
+    return cos, sin
+
+
+def _tp_layers(config: LlamaConfig):
+    """Pick dense vs tensor-parallel linear/embedding classes."""
+    if config.tensor_parallel:
+        from ...distributed.meta_parallel.mp_layers import (
+            ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+
+        col = lambda i, o: ColumnParallelLinear(i, o, has_bias=False,
+                                                gather_output=False)
+        row = lambda i, o: RowParallelLinear(i, o, has_bias=False,
+                                             input_is_parallel=True)
+        emb = lambda v, h: VocabParallelEmbedding(v, h)
+        return col, row, emb
+    col = lambda i, o: nn.Linear(i, o, bias_attr=False)
+    row = lambda i, o: nn.Linear(i, o, bias_attr=False)
+    emb = lambda v, h: nn.Embedding(v, h)
+    return col, row, emb
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.head_dim
+        col, row, _ = _tp_layers(config)
+        h = config.hidden_size
+        self.q_proj = col(h, self.num_heads * self.head_dim)
+        self.k_proj = col(h, self.num_kv_heads * self.head_dim)
+        self.v_proj = col(h, self.num_kv_heads * self.head_dim)
+        self.o_proj = row(self.num_heads * self.head_dim, h)
+        cos, sin = _rope_tables(config.max_position_embeddings, self.head_dim,
+                                config.rope_theta)
+        # rope tables are non-trainable buffers
+        self.cos = Tensor(cos, stop_gradient=True)
+        self.sin = Tensor(sin, stop_gradient=True)
+
+    def forward(self, x, attn_mask=None):
+        b, s, _ = x.shape
+        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        cos = self.cos[:, :s]
+        sin = self.sin[:, :s]
+        q, k = F.rotary_position_embedding(q, k, cos, sin)
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                             is_causal=True)
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        return self.o_proj(out)
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        col, row, _ = _tp_layers(config)
+        self.gate_proj = col(config.hidden_size, config.intermediate_size)
+        self.up_proj = col(config.hidden_size, config.intermediate_size)
+        self.down_proj = row(config.intermediate_size, config.hidden_size)
+
+    def forward(self, x):
+        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          epsilon=config.rms_norm_eps)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
+                                                   epsilon=config.rms_norm_eps)
+
+    def forward(self, x, attn_mask=None):
+        x = x + self.self_attn(self.input_layernorm(x), attn_mask)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        _, _, emb = _tp_layers(config)
+        self.embed_tokens = emb(config.vocab_size, config.hidden_size)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x, attn_mask)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = self.model = LlamaModel(config)
+        if config.tensor_parallel:
+            from ...distributed.meta_parallel.mp_layers import ColumnParallelLinear
+
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=True)
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        hidden = self.model(input_ids, attn_mask)
+        logits = self.lm_head(hidden)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size]),
+                labels.reshape([-1]), reduction="mean")
+            return loss
+        return logits
+
+
+def pipeline_descs(config: LlamaConfig):
+    """LayerDesc list for PipelineLayer (≙ PaddleNLP LlamaForCausalLMPipe)."""
+    from ...distributed.meta_parallel.pp_layers import LayerDesc, SharedLayerDesc
+
+    _, _, emb_cls = _tp_layers(config)
+
+    class _Embed(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            _, _, emb = _tp_layers(config)
+            self.embed_tokens = emb(config.vocab_size, config.hidden_size)
+
+        def forward(self, ids):
+            return self.embed_tokens(ids)
+
+    class _Head(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+
+        def forward(self, x):
+            return self.lm_head(self.norm(x))
+
+    descs = [LayerDesc(_Embed)]
+    descs += [LayerDesc(LlamaDecoderLayer, config)
+              for _ in range(config.num_hidden_layers)]
+    descs += [LayerDesc(_Head)]
+    return descs
