@@ -1,0 +1,223 @@
+"""Benchmark harness — BASELINE.md config ladder on the real chip.
+
+Prints ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Headline = config-2 (ResNet-50 train, to_static). Per-config details go to
+stderr and BENCH_DETAILS.json.
+
+Reference parity: the role of tools/ci_op_benchmark.sh +
+python/paddle/cost_model/static_op_benchmark.json — self-measured A/B
+numbers, since the reference publishes no end-to-end figures (BASELINE.md).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _sync(x):
+    import jax
+
+    jax.block_until_ready(x._data if hasattr(x, "_data") else x)
+
+
+def _timeit(step, iters=10, warmup=3):
+    for _ in range(warmup):
+        out = step()
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step()
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_lenet(iters=20):
+    """Config-1: LeNet on synthetic MNIST, pure dygraph (per-op dispatch)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    batch = 128
+    model = LeNet()
+    opt = paddle.optimizer.Momentum(learning_rate=0.01,
+                                    parameters=model.parameters())
+    rs = np.random.RandomState(0)
+    X = paddle.to_tensor(rs.randn(batch, 1, 28, 28).astype("float32"))
+    Y = paddle.to_tensor(rs.randint(0, 10, (batch,)).astype("int64"))
+
+    def step():
+        loss = F.cross_entropy(model(X), Y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    dt = _timeit(step, iters=iters, warmup=5)
+    return {"name": "lenet_mnist_dygraph", "images_per_sec": batch / dt,
+            "step_ms": dt * 1e3, "batch": batch}
+
+
+def bench_resnet50(iters=10, batch=32, image=224):
+    """Config-2: ResNet-50 train step under to_static (one XLA program)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    model.train()
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    rs = np.random.RandomState(0)
+    X = paddle.to_tensor(rs.randn(batch, 3, image, image).astype("float32"))
+    Y = paddle.to_tensor(rs.randint(0, 1000, (batch,)).astype("int64"))
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    def step():
+        return train_step(X, Y)
+
+    dt = _timeit(step, iters=iters, warmup=4)  # warm-up/discover/compile/run
+    # ResNet-50 fwd ≈ 4.1 GFLOP/image @224; train ≈ 3x fwd
+    flops = 3 * 4.1e9 * batch / dt
+    return {"name": "resnet50_to_static", "images_per_sec": batch / dt,
+            "step_ms": dt * 1e3, "batch": batch, "achieved_tflops": flops / 1e12}
+
+
+def bench_bert(iters=8, batch=8, seq=128):
+    """Config-3: BERT-base fine-tune step, to_static, single device."""
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models import BertConfig, BertForSequenceClassification
+
+    paddle.seed(0)
+    model = BertForSequenceClassification(BertConfig())
+    opt = paddle.optimizer.AdamW(learning_rate=2e-5,
+                                 parameters=model.parameters())
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, 30000, (batch, seq)).astype("int64"))
+    lab = paddle.to_tensor(rs.randint(0, 2, (batch,)).astype("int64"))
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    dt = _timeit(lambda: train_step(ids, lab), iters=iters, warmup=4)
+    return {"name": "bert_base_finetune", "sequences_per_sec": batch / dt,
+            "step_ms": dt * 1e3, "batch": batch}
+
+
+def bench_llama_train(iters=6, batch=4, seq=512):
+    """Config-5 proxy on one chip: LLaMA-sized-down causal LM train step
+    (bf16 params via amp O2 would halve HBM; fp32 here for parity)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                      num_hidden_layers=8, num_attention_heads=16,
+                      max_position_embeddings=seq)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, 32000, (batch, seq)).astype("int64"))
+
+    @paddle.jit.to_static
+    def train_step(x):
+        loss = model(x, x)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    dt = _timeit(lambda: train_step(ids), iters=iters, warmup=4)
+    toks = batch * seq / dt
+    # 6ND: N params
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops = 6 * n_params * toks
+    return {"name": "llama_1b_proxy_train", "tokens_per_sec": toks,
+            "step_ms": dt * 1e3, "batch": batch, "seq": seq,
+            "achieved_tflops": flops / 1e12, "n_params": n_params}
+
+
+def bench_eager_dispatch(iters=50):
+    """Micro-bench: per-op eager dispatch overhead (matmul chain), the
+    SURVEY §7-1 hot loop. Records ops/sec through op_call."""
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    x = paddle.rand([256, 256])
+    w = paddle.rand([256, 256])
+    w.stop_gradient = False
+    n_ops = 20
+
+    def step():
+        y = x
+        for _ in range(n_ops):
+            y = paddle.matmul(y, w)
+        return y
+
+    dt = _timeit(step, iters=iters, warmup=5)
+    return {"name": "eager_dispatch_matmul_chain",
+            "ops_per_sec": n_ops / dt, "us_per_op": dt / n_ops * 1e6}
+
+
+ALL = {
+    "lenet": bench_lenet,
+    "resnet50": bench_resnet50,
+    "bert": bench_bert,
+    "llama": bench_llama_train,
+    "eager": bench_eager_dispatch,
+}
+
+
+def main(argv):
+    import jax
+
+    which = [a.lstrip("-") for a in argv if a.lstrip("-") in ALL] or list(ALL)
+    details = {"platform": jax.devices()[0].platform,
+               "device_count": jax.device_count(), "results": {}}
+    for name in which:
+        try:
+            t0 = time.perf_counter()
+            res = ALL[name]()
+            res["wall_s"] = round(time.perf_counter() - t0, 1)
+            details["results"][name] = res
+            print(f"[bench] {name}: {res}", file=sys.stderr)
+        except Exception as e:  # keep the headline printable no matter what
+            details["results"][name] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] {name} FAILED: {e}", file=sys.stderr)
+
+    with open("BENCH_DETAILS.json", "w") as f:
+        json.dump(details, f, indent=2)
+
+    r50 = details["results"].get("resnet50", {})
+    if "images_per_sec" in r50:
+        headline = {"metric": "resnet50_train_images_per_sec",
+                    "value": round(r50["images_per_sec"], 2),
+                    "unit": "images/sec/chip", "vs_baseline": 1.0}
+    else:
+        ln = details["results"].get("lenet", {})
+        headline = {"metric": "lenet_train_images_per_sec",
+                    "value": round(ln.get("images_per_sec", 0.0), 2),
+                    "unit": "images/sec/chip", "vs_baseline": 1.0}
+    print(json.dumps(headline))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

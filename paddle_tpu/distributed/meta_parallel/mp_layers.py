@@ -62,18 +62,24 @@ def _constraint(t: Tensor, spec: P) -> Tensor:
     return op_call(fn, t, name="sharding_constraint")
 
 
-def _clear_axis(t: Tensor, axis: str = "mp") -> Tensor:
-    """Gather over one mesh axis only: drop `axis` from the current spec,
-    keeping other placements (dp batch sharding survives an mp-gather)."""
-    cur = getattr(t._data, "sharding", None)
-    entries = [None] * t.ndim
+def _spec_without_axis(cur, ndim: int, axis: str = "mp") -> list:
+    """Entry list mirroring `cur`'s spec padded to ndim, with `axis` dropped
+    everywhere (other placements — e.g. dp on batch — are preserved)."""
+    entries = [None] * ndim
     if isinstance(cur, NamedSharding):
-        spec = tuple(cur.spec) + (None,) * (t.ndim - len(tuple(cur.spec)))
+        spec = tuple(cur.spec) + (None,) * (ndim - len(tuple(cur.spec)))
         for d, entry in enumerate(spec):
             names = entry if isinstance(entry, tuple) else (entry,) if entry else ()
             kept = tuple(nm for nm in names if nm != axis)
             entries[d] = kept if len(kept) > 1 else (kept[0] if kept else None)
-    return _constraint(t, P(*entries))
+    return entries
+
+
+def _clear_axis(t: Tensor, axis: str = "mp") -> Tensor:
+    """Gather over one mesh axis only: drop `axis` from the current spec,
+    keeping other placements (dp batch sharding survives an mp-gather)."""
+    cur = getattr(t._data, "sharding", None)
+    return _constraint(t, P(*_spec_without_axis(cur, t.ndim, axis)))
 
 
 class VocabParallelEmbedding(Layer):

@@ -17,13 +17,36 @@ from ...core.dispatch import op_call
 from ...core.tensor import Tensor
 from ...nn import functional as F
 from ...nn.layer_base import Layer
-from .mp_layers import ColumnParallelLinear, RowParallelLinear, _clear_axis, _constraint
+from .mp_layers import (ColumnParallelLinear, RowParallelLinear, _clear_axis,
+                        _constraint, _spec_without_axis)
 
 
-def _seq_spec(ndim: int, seq_dim: int = 0) -> P:
-    spec = [None] * ndim
-    spec[seq_dim] = "mp"
-    return P(*spec)
+def _seq_spec(ndim: int, seq_dim: int = 0, current=None) -> P:
+    """Spec placing `mp` on the sequence dim, PRESERVING whatever other axes
+    (e.g. dp on batch) the activation already carries — dropping them forces
+    an involuntary rematerialization in the partitioner."""
+    entries = _spec_without_axis(current, ndim, "mp")
+    entries[seq_dim] = "mp"
+    return P(*entries)
+
+
+def _seq_constraint(x: Tensor, seq_dim: int) -> Tensor:
+    """Sequence-shard over mp keeping the dp batch placement. Under jit the
+    tracer carries no .sharding, so when the hybrid mesh has a dp axis and
+    the batch dim divides, dim 0 is pinned to dp explicitly (matching what
+    DataParallelShard put there eagerly)."""
+    cur = getattr(x._data, "sharding", None)
+    spec = _seq_spec(x.ndim, seq_dim, cur)
+    if cur is None and seq_dim != 0 and x.ndim >= 2:
+        from ..fleet import get_hybrid_communicate_group
+
+        mesh = get_hybrid_communicate_group().get_mesh()
+        if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 \
+                and x.shape[0] % mesh.shape["dp"] == 0:
+            entries = list(tuple(spec) + (None,) * (x.ndim - len(tuple(spec))))
+            entries[0] = "dp"
+            spec = P(*entries)
+    return _constraint(x, spec)
 
 
 def mark_as_sequence_parallel_parameter(param):
@@ -39,7 +62,7 @@ class ScatterOp:
 
     @staticmethod
     def apply(x: Tensor, axis: int = 0) -> Tensor:
-        return _constraint(x, _seq_spec(x.ndim, axis))
+        return _seq_constraint(x, axis)
 
 
 class GatherOp:
@@ -69,7 +92,7 @@ class ReduceScatterOp:
 
     @staticmethod
     def apply(x: Tensor, axis: int = 0) -> Tensor:
-        return _constraint(x, _seq_spec(x.ndim, axis))
+        return _seq_constraint(x, axis)
 
 
 class ColumnSequenceParallelLinear(ColumnParallelLinear):
@@ -85,7 +108,7 @@ class ColumnSequenceParallelLinear(ColumnParallelLinear):
                          mp_group=mp_group, name=name)
 
     def forward(self, x):
-        x = _constraint(x, _seq_spec(x.ndim, 0))
+        x = _seq_constraint(x, 0)
         y = F.linear(x, self.weight, self.bias)
         if self.gather_output:
             y = _clear_axis(y, "mp")
@@ -105,7 +128,7 @@ class RowSequenceParallelLinear(RowParallelLinear):
 
     def forward(self, x):
         y = super().forward(x)
-        return _constraint(y, _seq_spec(y.ndim, 0))
+        return _seq_constraint(y, 0)
 
 
 def register_sequence_parallel_allreduce_hooks(model, *args, **kwargs):

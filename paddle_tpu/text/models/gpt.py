@@ -73,8 +73,15 @@ class GPTForCausalLM(nn.Layer):
         self.blocks = nn.LayerList([GPTBlock(config)
                                     for _ in range(config.num_hidden_layers)])
         self.ln_f = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
-        self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
-                                 bias_attr=False)
+        if config.tensor_parallel:
+            from ...distributed.meta_parallel.mp_layers import ColumnParallelLinear
+
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=True)
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
 
     def forward(self, input_ids, labels=None):
         import paddle_tpu as paddle

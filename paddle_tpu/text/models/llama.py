@@ -67,13 +67,22 @@ def llama_tiny_config(**kw) -> LlamaConfig:
     return LlamaConfig(**base)
 
 
+_ROPE_CACHE: dict = {}
+
+
 def _rope_tables(seq_len: int, head_dim: int, theta: float, dtype="float32"):
+    """Shared across layers: every LlamaAttention uses the SAME [1,S,1,D]
+    cos/sin Tensors (one HBM copy, not num_layers copies)."""
+    key = (seq_len, head_dim, theta, dtype)
+    if key in _ROPE_CACHE:
+        return _ROPE_CACHE[key]
     inv = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
     t = np.arange(seq_len, dtype=np.float64)
     freqs = np.outer(t, inv)  # [S, D/2]
     emb = np.concatenate([freqs, freqs], axis=-1)  # [S, D]
-    cos = np.cos(emb)[None, :, None, :].astype(dtype)  # [1, S, 1, D]
-    sin = np.sin(emb)[None, :, None, :].astype(dtype)
+    cos = Tensor(np.cos(emb)[None, :, None, :].astype(dtype), stop_gradient=True)
+    sin = Tensor(np.sin(emb)[None, :, None, :].astype(dtype), stop_gradient=True)
+    _ROPE_CACHE[key] = (cos, sin)
     return cos, sin
 
 
@@ -108,11 +117,9 @@ class LlamaAttention(nn.Layer):
         self.k_proj = col(h, self.num_kv_heads * self.head_dim)
         self.v_proj = col(h, self.num_kv_heads * self.head_dim)
         self.o_proj = row(self.num_heads * self.head_dim, h)
-        cos, sin = _rope_tables(config.max_position_embeddings, self.head_dim,
-                                config.rope_theta)
-        # rope tables are non-trainable buffers
-        self.cos = Tensor(cos, stop_gradient=True)
-        self.sin = Tensor(sin, stop_gradient=True)
+        # rope tables are shared non-trainable buffers (one copy per process)
+        self.cos, self.sin = _rope_tables(
+            config.max_position_embeddings, self.head_dim, config.rope_theta)
 
     def forward(self, x, attn_mask=None):
         b, s, _ = x.shape
@@ -169,9 +176,25 @@ class LlamaModel(nn.Layer):
 
     def forward(self, input_ids, attn_mask=None):
         x = self.embed_tokens(input_ids)
+        if self.config.sequence_parallel:
+            # Megatron-SP: activations sequence-sharded between blocks
+            # (meta_parallel/sp_utils.py ≙ sequence_parallel_utils.py:429,564)
+            from ...distributed.meta_parallel.sp_utils import ScatterOp
+
+            x = ScatterOp.apply(x, axis=1)
         for layer in self.layers:
-            x = layer(x, attn_mask)
-        return self.norm(x)
+            if self.config.use_recompute:
+                from ...distributed.fleet.utils import recompute
+
+                x = recompute(layer, x, attn_mask)
+            else:
+                x = layer(x, attn_mask)
+        x = self.norm(x)
+        if self.config.sequence_parallel:
+            from ...distributed.meta_parallel.sp_utils import GatherOp
+
+            x = GatherOp.apply(x, axis=1)
+        return x
 
 
 class LlamaForCausalLM(nn.Layer):
@@ -179,7 +202,9 @@ class LlamaForCausalLM(nn.Layer):
         super().__init__()
         self.config = config
         self.llama = self.model = LlamaModel(config)
-        if config.tensor_parallel:
+        if config.tie_word_embeddings:
+            self.lm_head = None  # logits = hidden @ embed.weight^T
+        elif config.tensor_parallel:
             from ...distributed.meta_parallel.mp_layers import ColumnParallelLinear
 
             self.lm_head = ColumnParallelLinear(
@@ -190,8 +215,14 @@ class LlamaForCausalLM(nn.Layer):
                                      bias_attr=False)
 
     def forward(self, input_ids, labels=None, attn_mask=None):
+        import paddle_tpu as paddle
+
         hidden = self.model(input_ids, attn_mask)
-        logits = self.lm_head(hidden)
+        if self.lm_head is None:
+            logits = paddle.matmul(hidden, self.model.embed_tokens.weight,
+                                   transpose_y=True)
+        else:
+            logits = self.lm_head(hidden)
         if labels is not None:
             loss = F.cross_entropy(
                 logits.reshape([-1, self.config.vocab_size]),
@@ -200,33 +231,69 @@ class LlamaForCausalLM(nn.Layer):
         return logits
 
 
-def pipeline_descs(config: LlamaConfig):
-    """LayerDesc list for PipelineLayer (≙ PaddleNLP LlamaForCausalLMPipe)."""
-    from ...distributed.meta_parallel.pp_layers import LayerDesc, SharedLayerDesc
+class _PipeEmbed(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        _, _, emb = _tp_layers(config)
+        self.embed_tokens = emb(config.vocab_size, config.hidden_size)
 
-    _, _, emb_cls = _tp_layers(config)
+    def forward(self, ids):
+        return self.embed_tokens(ids)
 
-    class _Embed(nn.Layer):
-        def __init__(self):
-            super().__init__()
-            _, _, emb = _tp_layers(config)
-            self.embed_tokens = emb(config.vocab_size, config.hidden_size)
+    @property
+    def weight(self):
+        # SharedLayerDesc(shared_weight_attr="weight") resolves here
+        return self.embed_tokens.weight
 
-        def forward(self, ids):
-            return self.embed_tokens(ids)
 
-    class _Head(nn.Layer):
-        def __init__(self):
-            super().__init__()
-            self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+class _PipeHead(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        if config.tensor_parallel:
+            from ...distributed.meta_parallel.mp_layers import ColumnParallelLinear
+
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=True)
+        else:
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
                                      bias_attr=False)
 
-        def forward(self, x):
-            return self.lm_head(self.norm(x))
+    def forward(self, x):
+        return self.lm_head(self.norm(x))
 
-    descs = [LayerDesc(_Embed)]
-    descs += [LayerDesc(LlamaDecoderLayer, config)
-              for _ in range(config.num_hidden_layers)]
-    descs += [LayerDesc(_Head)]
-    return descs
+
+class _PipeNormOnly(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, x):
+        return self.norm(x)
+
+
+def pipeline_descs(config: LlamaConfig):
+    """LayerDesc list for PipelineLayer (≙ PaddleNLP LlamaForCausalLMPipe).
+
+    With tie_word_embeddings the embedding appears in the first AND last
+    stage as ONE SharedLayerDesc key — pp_layers builds a single instance,
+    so tying and grad accumulation are automatic."""
+    from ...distributed.meta_parallel.pp_layers import LayerDesc, SharedLayerDesc
+
+    body = [LayerDesc(LlamaDecoderLayer, config)
+            for _ in range(config.num_hidden_layers)]
+    if config.tie_word_embeddings:
+        import paddle_tpu as paddle
+
+        def lm_head(x, w):
+            return paddle.matmul(x, w, transpose_y=True)
+
+        return ([SharedLayerDesc("embed", _PipeEmbed, config,
+                                 shared_weight_attr="weight")]
+                + body
+                + [LayerDesc(_PipeNormOnly, config),
+                   SharedLayerDesc("embed", _PipeEmbed, config,
+                                   forward_func=lm_head,
+                                   shared_weight_attr="weight")])
+    return [LayerDesc(_PipeEmbed, config)] + body + [LayerDesc(_PipeHead, config)]

@@ -43,4 +43,8 @@ class AlexNet(nn.Layer):
 
 
 def alexnet(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights require network access; load a local "
+            "checkpoint with set_state_dict instead")
     return AlexNet(**kwargs)

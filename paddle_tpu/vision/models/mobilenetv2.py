@@ -94,4 +94,8 @@ class MobileNetV2(nn.Layer):
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights require network access; load a local "
+            "checkpoint with set_state_dict instead")
     return MobileNetV2(scale=scale, **kwargs)
