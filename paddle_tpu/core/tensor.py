@@ -81,9 +81,11 @@ class Tensor:
         devs = getattr(self._data, "devices", None)
         if devs is not None and not isinstance(self._data, jax.core.Tracer):
             try:
-                return Place(next(iter(self._data.devices())))
-            except Exception:
-                pass
+                ds = self._data.devices()
+            except RuntimeError:  # buffer donated/deleted by a jitted step
+                ds = None
+            if ds:
+                return Place(next(iter(ds)))
         return current_place()
 
     @property

@@ -39,6 +39,19 @@ def _in_trace(*tensors) -> bool:
     return any(isinstance(_data(t), jax.core.Tracer) for t in tensors if t is not None)
 
 
+def _require_single_controller(api: str):
+    """Eager (non-traced) collectives compute the global view analytically,
+    which is only valid when every process runs the same single-controller
+    program over the same data. Under a real multi-process launch
+    (jax.distributed.initialize with >1 processes) each process may hold
+    different values, so the analytic answer would be silently wrong."""
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            f"eager {api}() is single-controller only; under a multi-process "
+            "launch run collectives inside a traced step (shard_map/to_static) "
+            "so they lower to XLA collectives")
+
+
 def _axis_in_scope(axis_name) -> bool:
     try:
         jax.lax.axis_index(axis_name)
@@ -72,6 +85,7 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Group | None = None, sync
         out = x
     else:
         # replicated global view: every "rank" holds the same value
+        _require_single_controller("all_reduce")
         if op == ReduceOp.SUM:
             out = x * g.nranks
         elif op == ReduceOp.AVG or op in (ReduceOp.MAX, ReduceOp.MIN):
@@ -94,6 +108,8 @@ def all_gather(tensor_list: list, tensor: Tensor, group: Group | None = None, sy
         stacked = jax.lax.all_gather(x, g.axis_name)  # [n, ...]
         parts = [stacked[i] for i in range(g.nranks)]
     else:
+        if g.nranks > 1:
+            _require_single_controller("all_gather")
         parts = [x for _ in range(g.nranks)]
     if tensor_list is not None:
         tensor_list.clear()
@@ -104,6 +120,8 @@ def all_gather(tensor_list: list, tensor: Tensor, group: Group | None = None, sy
 
 def all_gather_object(object_list, obj, group=None):
     g = _resolve_group(group)
+    if g.nranks > 1:
+        _require_single_controller("all_gather_object")
     object_list.clear()
     object_list.extend(obj for _ in range(g.nranks))
 
@@ -115,6 +133,8 @@ def all_gather_into_tensor(out: Tensor, tensor: Tensor, group=None, axis=0):
     if _in_trace(tensor) and _axis_in_scope(g.axis_name):
         res = jax.lax.all_gather(x, g.axis_name, axis=axis, tiled=True)
     else:
+        if g.nranks > 1:
+            _require_single_controller("all_gather_into_tensor")
         res = jnp.concatenate([x] * g.nranks, axis=axis)
     if out is not None:
         out._assign_raw(res)
@@ -138,6 +158,7 @@ def reduce_scatter(tensor: Tensor, tensor_or_list, op=ReduceOp.SUM,
     elif g.nranks == 1:
         out = x
     else:
+        _require_single_controller("reduce_scatter")
         full = x * g.nranks if op == ReduceOp.SUM else x
         chunk = full.shape[0] // g.nranks
         r = g.rank if g.rank >= 0 else 0
@@ -155,6 +176,8 @@ def all_to_all(out_tensor_list: list, in_tensor_list: list, group: Group | None 
         ex = jax.lax.all_to_all(stacked, g.axis_name, split_axis=0, concat_axis=0, tiled=False)
         parts = [ex[i] for i in range(g.nranks)]
     else:
+        if g.nranks > 1:
+            _require_single_controller("all_to_all")
         parts = xs  # single-controller replicated view: rank r keeps chunk r
     if out_tensor_list is not None:
         out_tensor_list.clear()
@@ -177,6 +200,8 @@ def all_to_all_single(out: Tensor, tensor: Tensor, out_split_sizes=None,
             raise NotImplementedError("uneven all_to_all_single under trace")
         res = jax.lax.all_to_all(x, g.axis_name, split_axis=0, concat_axis=0, tiled=True)
     else:
+        if g.nranks > 1:
+            _require_single_controller("all_to_all_single")
         res = x
     if out is not None:
         out._assign_raw(res)
@@ -196,38 +221,59 @@ def broadcast(tensor: Tensor, src: int = 0, group: Group | None = None, sync_op=
 
 
 def broadcast_object_list(object_list, src=0, group=None):
+    g = _resolve_group(group)
+    if g.nranks > 1:
+        _require_single_controller("broadcast_object_list")
     return object_list
 
 
 def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group: Group | None = None,
            sync_op=True):
-    return all_reduce(tensor, op, group, sync_op)  # every rank gets the result
+    """Reduce to `dst`. Deviation from the reference: the reduced value is
+    delivered to EVERY rank (an all_reduce) — under single-controller SPMD
+    there is one logical buffer, so "non-dst ranks keep their old buffer"
+    is not representable. Code must not rely on non-dst buffers being
+    unchanged."""
+    return all_reduce(tensor, op, group, sync_op)
 
 
 def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group: Group | None = None,
             sync_op=True):
     g = _resolve_group(group)
     if tensor_list:
+        if g.nranks > 1:
+            _require_single_controller("scatter")
         idx = g.rank if g.rank >= 0 else 0
         tensor._assign_raw(_data(tensor_list[idx]))
     return tensor
 
 
 def send(tensor: Tensor, dst: int = 0, group: Group | None = None, sync_op=True):
+    """Eager p2p mailbox. Key convention: (group.id, GROUP-rank of dst) on
+    both sides, so groups with non-0-based global ranks still match."""
     g = _resolve_group(group)
     if _in_trace(tensor) and _axis_in_scope(g.axis_name):
         raise RuntimeError(
             "traced send/recv must be paired: use paddle_tpu.distributed.p2p "
             "ppermute helpers (batch_isend_irecv) inside shard_map")
-    _p2p_mailbox[(g.id, dst)] = _data(tensor)
+    _require_single_controller("send")
+    gdst = g.get_group_rank(dst)
+    _p2p_mailbox[(g.id, gdst if gdst >= 0 else dst)] = _data(tensor)
     return None
 
 
 def recv(tensor: Tensor, src: int = 0, group: Group | None = None, sync_op=True):
     g = _resolve_group(group)
+    _require_single_controller("recv")
     key = (g.id, get_rank_in(g))
     if key in _p2p_mailbox:
         tensor._assign_raw(_p2p_mailbox.pop(key))
+    else:
+        import warnings
+
+        warnings.warn(
+            f"recv(): no pending send for group {g.id} rank {get_rank_in(g)} "
+            "(src={}) — tensor left unmodified".format(src))
     return tensor
 
 

@@ -14,6 +14,7 @@ from .sp_utils import (
     mark_as_sequence_parallel_parameter,
     register_sequence_parallel_allreduce_hooks,
 )
+from .ring_attention import ring_attention, ulysses_attention
 from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer
 from .pipeline_parallel import PipelineParallel, PipelineParallelWithInterleave
 from .parallel_wrappers import (

@@ -84,9 +84,16 @@ def get_worker_info():
     return _worker_info
 
 
-def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, num_workers):
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, num_workers,
+                 worker_init_fn=None):
     global _worker_info
     _worker_info = WorkerInfo(worker_id, num_workers, dataset)
+    if worker_init_fn is not None:
+        try:
+            worker_init_fn(worker_id)
+        except Exception as e:
+            data_queue.put((-1, None, e))
+            return
     while True:
         item = index_queue.get()
         if item is None:
@@ -157,7 +164,10 @@ class DataLoader:
     def _iter_workers(self):
         """Round-robin index distribution to worker processes, in-order
         results with a bounded reorder buffer (≙ _DataLoaderIterMultiProcess)."""
-        ctx = mp.get_context("fork")
+        # fork is cheapest (no re-import, dataset shared CoW) but unavailable
+        # on some platforms; fall back to spawn there
+        method = "fork" if "fork" in mp.get_all_start_methods() else "spawn"
+        ctx = mp.get_context(method)
         index_queues = [ctx.Queue() for _ in range(self.num_workers)]
         data_queue = ctx.Queue()
         collate = self.collate_fn or _numpy_collate
@@ -165,7 +175,7 @@ class DataLoader:
             ctx.Process(
                 target=_worker_loop,
                 args=(self.dataset, index_queues[w], data_queue, collate,
-                      w, self.num_workers),
+                      w, self.num_workers, self.worker_init_fn),
                 daemon=True,
             )
             for w in range(self.num_workers)

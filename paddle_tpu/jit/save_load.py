@@ -42,7 +42,13 @@ def save(layer, path, input_spec=None, **configs):
             exported = jexport.export(jax.jit(pure))(*specs)
             with open(path + ".stablehlo", "wb") as f:
                 f.write(exported.serialize())
-        except Exception as e:  # export is best-effort in round 1
+        except Exception as e:
+            # StableHLO export failed — the pickled state_dict payload is
+            # still written, so load() works; surface the export failure
+            # loudly instead of only in a side file
+            import warnings
+
+            warnings.warn(f"jit.save: StableHLO export failed: {e!r}")
             with open(path + ".export_error", "w") as f:
                 f.write(str(e))
 

@@ -1,7 +1,8 @@
 """paddle_tpu.nn.functional (≙ python/paddle/nn/functional).
 
 Every function is a jnp/lax composition through op_call, so XLA fuses them;
-attention has a Pallas fast path (paddle_tpu/ops/pallas_ops.py) on real TPU.
+attention has a Pallas flash-kernel fast path
+(paddle_tpu/ops/pallas_attention.py) on real TPU.
 """
 from __future__ import annotations
 

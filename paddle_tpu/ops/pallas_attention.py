@@ -1,0 +1,351 @@
+"""Pallas TPU flash attention (fwd + bwd), the fusion-library equivalent.
+
+Reference parity: paddle's flash attention surface
+(python/paddle/nn/functional/flash_attention.py:358 `flash_attention`,
+:1139 `scaled_dot_product_attention`) backed by the CUDA fusion library
+(paddle/phi/kernels/fusion/gpu). Here the kernel is written directly for the
+TPU memory hierarchy: Q/K/V tiles are streamed HBM->VMEM by the Pallas grid
+pipeline, the online-softmax running state (m, l, acc) lives in VMEM scratch
+that persists across the innermost (kv) grid steps, and every matmul hits the
+MXU in f32 accumulation.
+
+Layout convention at this level is [batch, heads, seq, head_dim]; the public
+wrapper accepts paddle's [batch, seq, heads, head_dim] and transposes.
+
+On non-TPU backends the same kernels run in Pallas interpreter mode, which is
+how tests/test_pallas_attention.py checks numerics against the XLA softmax
+composition on the CPU mesh.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports fail cleanly on backends without TPU support
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+# ----------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
+                scale, causal, sq, sk, block_q, block_k):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    # causal offset aligns the last q row with the last kv col
+    offset = sk - sq
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale          # [bq, d]
+        k = k_ref[0, 0]                                      # [bk, d]
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [bq, bk]
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = cols < sk
+        if causal:
+            mask = mask & (cols <= rows + offset)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_s[:, :1]                                  # [bq, 1]
+        l_prev = l_s[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                               # [bq, bk]
+        p = jnp.where(mask, p, 0.0)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0, 0]                                      # [bk, d]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [bq, d]
+        acc[:] = acc[:] * alpha + pv
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[:] = jnp.broadcast_to(l_new, l_s.shape)
+
+    if causal:
+        # skip kv blocks that lie entirely above the diagonal
+        @pl.when(k_start <= q_start + block_q - 1 + offset)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_s[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_s[:, 0] + jnp.log(safe_l[:, 0]))
+
+
+def _flash_forward(q, k, v, causal, block_q, block_k):
+    """q,k,v: [B, H, S, D] (same H — GQA expanded by caller). Returns (o, lse)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    sq_p = _ceil_to(sq, block_q)
+    sk_p = _ceil_to(sk, block_k)
+    d_p = _ceil_to(d, 128)
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, sq_p - sq), (0, d_p - d)))
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, sk_p - sk), (0, d_p - d)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, sk_p - sk), (0, d_p - d)))
+    nq, nk = sq_p // block_q, sk_p // block_k
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, sq=sq, sk=sk,
+        block_q=block_q, block_k=block_k)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d_p), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d_p), lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d_p), lambda b, h, qi, ki: (b, h, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d_p), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, qi, ki: (b, h, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq_p, d_p), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq_p), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d_p), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp)
+    return o[:, :, :sq, :d], lse[:, :, :sq]
+
+
+# ----------------------------------------------------------------- backward
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, causal, sq, sk, block_q, block_k):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    offset = sk - sq
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = cols < sk
+        if causal:
+            mask = mask & (cols <= rows + offset)
+        lse = lse_ref[0, 0][:, None]                          # [bq, 1]
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)            # [bq, bk]
+        do = do_ref[0, 0].astype(jnp.float32)                 # [bq, d]
+        v = v_ref[0, 0].astype(jnp.float32)                   # [bk, d]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        delta = delta_ref[0, 0][:, None]
+        ds = p * (dp - delta) * scale                         # [bq, bk]
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(k_start <= q_start + block_q - 1 + offset)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    scale, causal, sq, sk, block_q, block_k):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    offset = sk - sq
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale           # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)                   # [bk, d]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = cols < sk
+        if causal:
+            mask = mask & (cols <= rows + offset)
+        lse = lse_ref[0, 0][:, None]
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)            # [bq, bk]
+        do = do_ref[0, 0].astype(jnp.float32)                 # [bq, d]
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        delta = delta_ref[0, 0][:, None]
+        # `q` here is pre-scaled by 1/sqrt(d), which is exactly dk's scale
+        # factor — so ds must NOT be scaled again
+        ds = p * (dp - delta)                                 # [bq, bk]
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(k_start <= q_start + block_q - 1 + offset)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        # dk picked up the q-side 1/sqrt(d) scale through `q`; already applied
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    sq_p = _ceil_to(sq, block_q)
+    sk_p = _ceil_to(sk, block_k)
+    d_p = _ceil_to(d, 128)
+    pad4 = lambda x, s: jnp.pad(x, ((0, 0), (0, 0), (0, s - x.shape[2]), (0, d_p - d)))
+    qp, kp, vp = pad4(q, sq_p), pad4(k, sk_p), pad4(v, sk_p)
+    dop = pad4(do, sq_p)
+    lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, sq_p - sq)))
+    deltap = jnp.pad(delta, ((0, 0), (0, 0), (0, sq_p - sq)))
+    nq, nk = sq_p // block_q, sk_p // block_k
+
+    common = dict(scale=scale, causal=causal, sq=sq, sk=sk,
+                  block_q=block_q, block_k=block_k)
+    q_spec = pl.BlockSpec((1, 1, block_q, d_p), lambda b, h, qi, ki: (b, h, qi, 0))
+    k_spec = pl.BlockSpec((1, 1, block_k, d_p), lambda b, h, qi, ki: (b, h, ki, 0))
+    r_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, qi, ki: (b, h, qi))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(b, h, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sq_p, d_p), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d_p), jnp.float32)],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)[0]
+
+    # dkv kernel: kv blocks outer, q blocks inner
+    q_spec2 = pl.BlockSpec((1, 1, block_q, d_p), lambda b, h, ki, qi: (b, h, qi, 0))
+    k_spec2 = pl.BlockSpec((1, 1, block_k, d_p), lambda b, h, ki, qi: (b, h, ki, 0))
+    r_spec2 = pl.BlockSpec((1, 1, block_q), lambda b, h, ki, qi: (b, h, qi))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(b, h, nk, nq),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
+        out_specs=[k_spec2, k_spec2],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sk_p, d_p), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, sk_p, d_p), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d_p), jnp.float32),
+                        pltpu.VMEM((block_k, d_p), jnp.float32)],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)
+    return (dq[:, :, :sq, :d], dk[:, :, :sk, :d], dv[:, :, :sk, :d])
+
+
+# ----------------------------------------------------------- differentiable op
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, block_k):
+    o, _ = _flash_forward(q, k, v, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
+    o, lse = _flash_forward(q, k, v, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, res, g):
+    q, k, v, o, lse = res
+    return _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_raw(q, k, v, causal=False,
+                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """jax-level flash attention on [B, H, S, D] arrays (GQA expanded here)."""
+    hq, hk = q.shape[1], k.shape[1]
+    if hq != hk:
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    bq = min(block_q, _ceil_to(q.shape[2], 128))
+    bk = min(block_k, _ceil_to(k.shape[2], 128))
+    return _flash(q, k, v, causal, bq, bk)
+
+
+def flash_attention_op(query, key, value, is_causal=False):
+    """Framework-level op on paddle-layout [B, S, H, D] Tensors; tape-recorded."""
+    from ..core.dispatch import op_call
+
+    def f(q, k, v):
+        qt = jnp.swapaxes(q, 1, 2)
+        kt = jnp.swapaxes(k, 1, 2)
+        vt = jnp.swapaxes(v, 1, 2)
+        out = flash_attention_raw(qt, kt, vt, causal=is_causal)
+        return jnp.swapaxes(out, 1, 2)
+
+    return op_call(f, query, key, value, name="flash_attention", n_diff=3)

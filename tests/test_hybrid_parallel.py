@@ -240,8 +240,12 @@ def test_shared_layer_desc_tied_weight():
         ],
         num_stages=2,
         loss_fn=lambda o, y: F.cross_entropy(o.reshape([-1, 16]), y.reshape([-1])))
-    # one tied parameter, not two
-    embs = [p for n, p in pipe.named_parameters() if "embedding" in type(p).__name__.lower() or p.shape == [16, 8]]
+    # one tied parameter, not two: every [16,8] param reachable from the
+    # pipeline is the SAME object (embedding weight reused by lm_head)
+    embs = [p for n, p in pipe.named_parameters() if tuple(p.shape) == (16, 8)]
+    assert embs, "tied embedding weight not found in named_parameters()"
+    assert len({id(p) for p in embs}) == 1, (
+        f"expected one tied [16,8] parameter, got {len(embs)} distinct")
     ids = paddle.to_tensor(np.random.RandomState(3).randint(0, 16, (4, 6)).astype(np.int64))
     opt = paddle.optimizer.Adam(learning_rate=5e-3, parameters=pipe.parameters())
     model = fleet.distributed_model(pipe)

@@ -1,0 +1,209 @@
+"""Flash-attention kernel + context-parallel attention tests.
+
+The Pallas kernels run in interpreter mode on the CPU mesh (conftest forces
+JAX_PLATFORMS=cpu); numerics are checked against the XLA softmax composition
+— the same parity discipline the reference applies to its fusion kernels
+(test/legacy_test/test_flash_attention.py style).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas_attention import flash_attention_raw
+
+
+def _ref_sdpa(q, k, v, causal):
+    d = q.shape[-1]
+    kk, vv = k, v
+    if k.shape[1] != q.shape[1]:
+        rep = q.shape[1] // k.shape[1]
+        kk = jnp.repeat(k, rep, axis=1)
+        vv = jnp.repeat(v, rep, axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, kk) / np.sqrt(d)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, vv)
+
+
+@pytest.mark.parametrize(
+    "b,h,hk,sq,sk,d,causal",
+    [
+        (2, 4, 4, 256, 256, 64, False),
+        (2, 4, 4, 256, 256, 64, True),
+        (1, 4, 2, 200, 200, 80, True),     # GQA + ragged seq + odd head_dim
+        (1, 2, 2, 100, 160, 64, False),    # cross attention kv longer than q
+        (1, 2, 2, 160, 96, 32, True),      # q longer than kv, causal offset
+    ],
+)
+def test_flash_fwd_bwd_parity(b, h, hk, sq, sk, d, causal):
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, h, sq, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, hk, sk, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, hk, sk, d).astype(np.float32))
+    g = jnp.asarray(rng.randn(b, h, sq, d).astype(np.float32))
+
+    # with causal and sq > sk, leading q rows have zero valid keys: softmax
+    # is undefined there — the reference composition yields NaN, the flash
+    # kernel defines the output (and grads) as 0. Compare on defined rows,
+    # assert the kernel's empty rows are 0 (not NaN).
+    n_empty = max(sq - sk, 0) if causal else 0
+    valid = np.s_[:, :, n_empty:, :]
+
+    # ref on the sliced q: causal alignment is preserved (both align the
+    # last q row with the last kv col), and everything stays finite
+    ref_fn = lambda q, k, v: _ref_sdpa(q[:, :, n_empty:], k, v, causal)
+
+    o = flash_attention_raw(q, k, v, causal=causal)
+    r = ref_fn(q, k, v)
+    assert not np.isnan(np.asarray(o)).any()
+    if n_empty:
+        np.testing.assert_array_equal(np.asarray(o)[:, :, :n_empty], 0.0)
+    np.testing.assert_allclose(np.asarray(o)[valid], np.asarray(r),
+                               atol=2e-5, rtol=2e-5)
+
+    if n_empty:  # zero the cotangent on undefined rows (kernel grads are 0)
+        g = g.at[:, :, :n_empty].set(0.0)
+    dq, dk, dv = jax.grad(
+        lambda q, k, v: jnp.vdot(flash_attention_raw(q, k, v, causal=causal), g),
+        argnums=(0, 1, 2))(q, k, v)
+    rq, rk, rv = jax.grad(
+        lambda q, k, v: jnp.vdot(ref_fn(q, k, v), g[:, :, n_empty:]),
+        argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rq), atol=5e-5, rtol=5e-5)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rk), atol=5e-5, rtol=5e-5)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), atol=5e-5, rtol=5e-5)
+
+
+def test_flash_bf16():
+    """bf16 inputs — the dtype TPUs train in — vs f32 reference, bf16 tol."""
+    rng = np.random.RandomState(1)
+    b, h, s, d = 1, 2, 256, 64
+    qf = rng.randn(b, h, s, d).astype(np.float32)
+    kf = rng.randn(b, h, s, d).astype(np.float32)
+    vf = rng.randn(b, h, s, d).astype(np.float32)
+    q, k, v = (jnp.asarray(x, jnp.bfloat16) for x in (qf, kf, vf))
+    o = flash_attention_raw(q, k, v, causal=True).astype(jnp.float32)
+    r = _ref_sdpa(jnp.asarray(qf), jnp.asarray(kf), jnp.asarray(vf), True)
+    assert o.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(o), np.asarray(r), atol=3e-2, rtol=3e-2)
+
+
+def test_functional_sdpa_uses_pallas_and_matches():
+    """scaled_dot_product_attention with the Pallas path forced: same value
+    and gradient as the XLA path; phantom-module regression guard."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.nn.functional import attention as attn_mod
+
+    rng = np.random.RandomState(2)
+    mk = lambda: paddle.to_tensor(rng.randn(2, 128, 4, 64).astype(np.float32),
+                                  stop_gradient=False)
+    q1, k1, v1 = mk(), mk(), mk()
+    q2, k2, v2 = (paddle.to_tensor(t.numpy(), stop_gradient=False)
+                  for t in (q1, k1, v1))
+
+    prev = attn_mod.FORCE_PALLAS
+    attn_mod.FORCE_PALLAS = True
+    try:
+        out_p = F.scaled_dot_product_attention(q1, k1, v1, is_causal=True)
+    finally:
+        attn_mod.FORCE_PALLAS = prev
+    out_x = F.scaled_dot_product_attention(q2, k2, v2, is_causal=True)
+    np.testing.assert_allclose(out_p.numpy(), out_x.numpy(), atol=2e-5, rtol=2e-5)
+
+    out_p.sum().backward()
+    out_x.sum().backward()
+    np.testing.assert_allclose(q1.grad.numpy(), q2.grad.numpy(), atol=5e-5, rtol=5e-5)
+    np.testing.assert_allclose(k1.grad.numpy(), k2.grad.numpy(), atol=5e-5, rtol=5e-5)
+    np.testing.assert_allclose(v1.grad.numpy(), v2.grad.numpy(), atol=5e-5, rtol=5e-5)
+
+
+# ------------------------------------------------------- context parallelism
+
+def _run_sharded(fn, n, *arrays):
+    """shard_map fn over a sep axis of size n; arrays sharded on dim 1 (seq)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:n]), ("sep",))
+    spec = P(None, "sep")
+    shard = shard_map(fn, mesh=mesh, in_specs=(spec,) * len(arrays),
+                      out_specs=spec, check_rep=False)
+    return shard(*arrays)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(causal):
+    from paddle_tpu.distributed.meta_parallel import ring_attention
+
+    rng = np.random.RandomState(3)
+    b, s, h, d = 2, 4 * 32, 4, 32
+    q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+
+    out = _run_sharded(
+        lambda q, k, v: ring_attention(q, k, v, "sep", causal=causal), 4, q, k, v)
+    ref = _ref_sdpa(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                    jnp.swapaxes(v, 1, 2), causal)
+    ref = jnp.swapaxes(ref, 1, 2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_full(causal):
+    from paddle_tpu.distributed.meta_parallel import ulysses_attention
+
+    rng = np.random.RandomState(4)
+    b, s, h, d = 1, 4 * 16, 8, 32   # h=8 divisible by sep=4
+    q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+
+    out = _run_sharded(
+        lambda q, k, v: ulysses_attention(q, k, v, "sep", causal=causal), 4, q, k, v)
+    ref = _ref_sdpa(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                    jnp.swapaxes(v, 1, 2), causal)
+    ref = jnp.swapaxes(ref, 1, 2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_grad_matches_full():
+    """Ring attention is differentiable through ppermute; grads match."""
+    from paddle_tpu.distributed.meta_parallel import ring_attention
+
+    rng = np.random.RandomState(5)
+    b, s, h, d = 1, 4 * 16, 2, 32
+    q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sep",))
+    spec = P(None, "sep")
+
+    @jax.jit
+    def loss_ring(q, k, v):
+        f = shard_map(
+            lambda q, k, v: ring_attention(q, k, v, "sep", causal=True),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_rep=False)
+        return jnp.sum(f(q, k, v) ** 2)
+
+    def loss_ref(q, k, v):
+        r = _ref_sdpa(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                      jnp.swapaxes(v, 1, 2), True)
+        return jnp.sum(jnp.swapaxes(r, 1, 2) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=5e-5, rtol=5e-5)
