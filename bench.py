@@ -157,8 +157,11 @@ def bench_llama_train(iters=6, batch=4, seq=512):
 
 def bench_eager_dispatch(iters=50):
     """Micro-bench: per-op eager dispatch overhead (matmul chain), the
-    SURVEY §7-1 hot loop. Records ops/sec through op_call."""
+    SURVEY §7-1 hot loop — measured with the per-op executable cache off
+    (uncached jax.vjp re-trace) and on (jitted fwd/vjp pairs, the analog of
+    KernelFactory's precompiled kernels)."""
     import paddle_tpu as paddle
+    from paddle_tpu.core import dispatch
 
     paddle.seed(0)
     x = paddle.rand([256, 256])
@@ -172,9 +175,15 @@ def bench_eager_dispatch(iters=50):
             y = paddle.matmul(y, w)
         return y
 
+    paddle.set_flags({"FLAGS_use_compiled_eager": False})
+    dt_uncached = _timeit(step, iters=iters, warmup=5)
+    paddle.set_flags({"FLAGS_use_compiled_eager": True})
     dt = _timeit(step, iters=iters, warmup=5)
     return {"name": "eager_dispatch_matmul_chain",
-            "ops_per_sec": n_ops / dt, "us_per_op": dt / n_ops * 1e6}
+            "ops_per_sec": n_ops / dt, "us_per_op": dt / n_ops * 1e6,
+            "us_per_op_uncached": dt_uncached / n_ops * 1e6,
+            "dispatch_cache_speedup": round(dt_uncached / dt, 2),
+            "cache": dispatch.eager_cache_info()}
 
 
 ALL = {

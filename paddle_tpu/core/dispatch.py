@@ -130,6 +130,186 @@ def _is_tensor(x) -> bool:
     return isinstance(x, Tensor)
 
 
+# ------------------------------------------------- eager executable cache
+# TPU-native analog of KernelFactory::SelectKernelOrThrowError
+# (/root/reference/paddle/phi/core/kernel_factory.h:326) + the generated C++
+# ad_funcs: instead of a registry of precompiled kernels, each (op, static
+# operands, diff-mask, amp-target) gets a jitted executable pair — forward
+# returns (out, vjp Partial), and vjp application itself runs through one
+# shared jitted trampoline so backward is compiled too. jax.jit's internal
+# C++ dispatch handles shape/dtype keying within an entry, so re-tracing
+# happens only on genuinely new signatures.
+_SIMPLE_TYPES = (int, float, bool, str, bytes, complex, type(None))
+_UNCACHABLE = object()  # sentinel: this key can never be compiled
+
+_eager_cache: dict = {}
+_eager_hits = 0
+_eager_misses = 0
+_vjp_apply_jit = None
+
+
+def _freeze(v):
+    """Hashable cache-key fragment for a static operand, or _UNCACHABLE."""
+    if isinstance(v, _SIMPLE_TYPES):
+        return (type(v).__name__, v)
+    if isinstance(v, (tuple, list)):
+        parts = tuple(_freeze(x) for x in v)
+        if any(p is _UNCACHABLE for p in parts):
+            return _UNCACHABLE
+        return (type(v).__name__, parts)
+    if isinstance(v, np.dtype) or (isinstance(v, type) and issubclass(v, np.generic)):
+        return ("dtype", np.dtype(v).name)
+    if callable(v):
+        return _fn_key(v)
+    return _UNCACHABLE
+
+
+def _fn_key(fn):
+    """Identity key for the op function. Keyed by code object (stable across
+    per-call re-creation of nested defs — ops like rope build a fresh inner
+    fn each call) plus frozen defaults/closure cells. Unhashable cells ⇒
+    uncachable."""
+    import functools
+
+    if isinstance(fn, functools.partial):
+        base = _fn_key(fn.func)
+        args = tuple(_freeze(a) for a in fn.args)
+        kws = tuple(sorted((k, _freeze(v)) for k, v in fn.keywords.items()))
+        if base is _UNCACHABLE or any(
+            p is _UNCACHABLE for p in args
+        ) or any(v is _UNCACHABLE for _, v in kws):
+            return _UNCACHABLE
+        return ("partial", base, args, kws)
+
+    code = getattr(fn, "__code__", None)
+    if code is None:  # builtins / C-level callables: stable module objects
+        try:
+            hash(fn)
+        except TypeError:
+            return _UNCACHABLE
+        return fn
+
+    defaults = getattr(fn, "__defaults__", None) or ()
+    frozen_defaults = tuple(_freeze(d) for d in defaults)
+    if any(d is _UNCACHABLE for d in frozen_defaults):
+        return _UNCACHABLE
+
+    vals = []
+    for c in fn.__closure__ or ():
+        try:
+            frozen = _freeze(c.cell_contents)
+        except ValueError:  # empty cell
+            return _UNCACHABLE
+        if frozen is _UNCACHABLE:
+            return _UNCACHABLE
+        vals.append(frozen)
+    return (code, frozen_defaults, tuple(vals))
+
+
+def _is_dynamic(v) -> bool:
+    return isinstance(v, (jax.Array, np.ndarray))
+
+
+def _has_float0(cot) -> bool:
+    leaves = cot if isinstance(cot, (tuple, list)) else (cot,)
+    return any(getattr(c, "dtype", None) == jax.dtypes.float0 for c in leaves)
+
+
+def _apply_vjp(vjp_fn, cot):
+    global _vjp_apply_jit
+    if _has_float0(cot):  # float0 cotangents can't cross a jit boundary
+        return vjp_fn(cot)
+    if _vjp_apply_jit is None:
+        _vjp_apply_jit = jax.jit(lambda f, c: f(c))
+    return _vjp_apply_jit(vjp_fn, cot)
+
+
+def _build_entry(fn, datas, diff_idx, dyn_pos):
+    """Compile-once closure over the static operands (they're in the key)."""
+    raw = [None if i in dyn_pos else d for i, d in enumerate(datas)]
+    if not diff_idx:
+        def call(*dyn):
+            vals = list(raw)
+            for p, v in zip(dyn_pos, dyn):
+                vals[p] = v
+            return fn(*vals)
+
+        return ("nograd", jax.jit(call))
+
+    def fwd(*dyn):
+        vals = list(raw)
+        for p, v in zip(dyn_pos, dyn):
+            vals[p] = v
+
+        def primal(*ds):
+            vs = list(vals)
+            for i, dv in zip(diff_idx, ds):
+                vs[i] = dv
+            return fn(*vs)
+
+        return jax.vjp(primal, *[vals[i] for i in diff_idx])
+
+    return ("grad", jax.jit(fwd))
+
+
+def _cached_dispatch(fn, fn_id, name, datas, diff_idx, target):
+    """Returns (out, vjp_or_None) via the executable cache, or None to fall
+    back to the uncached path (unhashable statics / trace failure)."""
+    global _eager_hits, _eager_misses
+    for d in datas:
+        if isinstance(d, jax.core.Tracer):
+            return None
+    dyn_pos = tuple(i for i, d in enumerate(datas) if _is_dynamic(d))
+    statics = tuple(
+        _freeze(d) for i, d in enumerate(datas) if i not in set(dyn_pos)
+    )
+    if fn_id is _UNCACHABLE or any(s is _UNCACHABLE for s in statics):
+        return None
+    key = (fn_id, name, target, dyn_pos, tuple(diff_idx), statics)
+    entry = _eager_cache.get(key)
+    if entry is _UNCACHABLE:
+        return None
+    if entry is None:
+        limit = flag("FLAGS_eager_cache_size")
+        if limit <= 0:  # size 0 ⇒ cache disabled
+            return None
+        _eager_misses += 1
+        while len(_eager_cache) >= limit and _eager_cache:
+            _eager_cache.pop(next(iter(_eager_cache)))
+        entry = _build_entry(fn, datas, diff_idx, dyn_pos)
+        _eager_cache[key] = entry
+    else:
+        _eager_hits += 1
+    kind, jitted = entry
+    dyn = [datas[p] for p in dyn_pos]
+    try:
+        if kind == "nograd":
+            return jitted(*dyn), None
+        out, vjp_fn = jitted(*dyn)
+        return out, (lambda cot, _v=vjp_fn: _apply_vjp(_v, cot))
+    except jax.errors.TracerArrayConversionError:
+        # fn inspects concrete values — permanently uncachable
+        _eager_cache[key] = _UNCACHABLE
+        return None
+    except (TypeError, jax.errors.ConcretizationTypeError, jax.errors.TracerBoolConversionError):
+        _eager_cache[key] = _UNCACHABLE
+        return None
+
+
+def eager_cache_info() -> dict:
+    return {
+        "entries": len(_eager_cache),
+        "hits": _eager_hits,
+        "misses": _eager_misses,
+    }
+
+
+def eager_cache_clear():
+    global _eager_hits, _eager_misses
+    _eager_cache.clear()
+    _eager_hits = _eager_misses = 0
+
+
 def _check_nan_inf(name, arrs):
     import jax.numpy as jnp
 
@@ -165,6 +345,7 @@ def op_call(fn: Callable, *args, name: str | None = None, n_diff: int | None = N
         from ..amp import amp_dtype_for as _adf
 
         _amp_dtype_for = _adf
+    orig_fn = fn
     target = _amp_dtype_for(name)
     if target is not None:
         # cast inside the differentiated fn so vjp returns grads in the
@@ -187,9 +368,25 @@ def op_call(fn: Callable, *args, name: str | None = None, n_diff: int | None = N
             if _is_tensor(a) and not a.stop_gradient and dtypes.is_floating_point(a.dtype):
                 diff_idx.append(i)
 
+    use_cache = flag("FLAGS_use_compiled_eager")
+
     if not diff_idx:
+        if use_cache:
+            cached = _cached_dispatch(fn, _fn_key(orig_fn), name, datas, [], target)
+            if cached is not None:
+                return _wrap_outputs(cached[0], None, name)
         out = fn(*datas)
         return _wrap_outputs(out, None, name)
+
+    if use_cache:
+        cached = _cached_dispatch(fn, _fn_key(orig_fn), name, datas, diff_idx, target)
+        if cached is not None:
+            out, vjp_fn = cached
+            single = not isinstance(out, (tuple, list))
+            outs = [out] if single else list(out)
+            avals = [(o.shape, o.dtype) for o in outs]
+            node = GradNode(vjp_fn, [args[i] for i in diff_idx], avals, single, name)
+            return _wrap_outputs(out, node, name)
 
     if len(diff_idx) == len(datas):
         primal_fn = fn

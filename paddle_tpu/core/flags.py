@@ -61,7 +61,7 @@ def flag(name: str):
 
 # Core flags (subset of reference's 187; grows as subsystems land).
 define_flag("FLAGS_check_nan_inf", False, "scan every op output for nan/inf")
-define_flag("FLAGS_use_compiled_eager", False, "jit-compile per-op eager dispatch")
+define_flag("FLAGS_use_compiled_eager", True, "jit-compile per-op eager dispatch")
 define_flag("FLAGS_eager_cache_size", 4096, "per-op executable cache entries")
 define_flag("FLAGS_to_static_donate", True, "donate captured buffers in to_static")
 define_flag("FLAGS_log_level", 0, "VLOG-style verbosity")

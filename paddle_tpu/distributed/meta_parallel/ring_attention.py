@@ -61,8 +61,7 @@ def ring_attention(q, k, v, axis_name, causal=False):
     l0 = jnp.zeros((b, h, sl, 1), jnp.float32)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    def step(i, carry):
-        acc, m, l, kc, vc = carry
+    def block(i, acc, m, l, kc, vc):
         kv_idx = (idx - i) % n
         s = _block_scores(qt, kc, scale)                  # [B,H,Sl,Sl]
         if causal:
@@ -77,13 +76,19 @@ def ring_attention(q, k, v, axis_name, causal=False):
         pv = jax.lax.dot_general(
             p, vc.astype(jnp.float32), (((3,), (2,)), ((0, 1), (0, 1))),
             preferred_element_type=jnp.float32)
-        acc_new = acc * alpha + pv
+        return acc * alpha + pv, m_new, l_new
+
+    def step(i, carry):
+        acc, m, l, kc, vc = carry
+        acc, m, l = block(i, acc, m, l, kc, vc)
         # rotate K/V one hop: after this, we hold chunk (idx - i - 1) % n
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
-        return acc_new, m_new, l_new, kc, vc
+        return acc, m, l, kc, vc
 
-    acc, m, l, _, _ = jax.lax.fori_loop(0, n, step, (acc0, m0, l0, kt, vt))
+    # last block outside the loop: no wasted final K/V rotation (n-1 hops total)
+    acc, m, l, kt, vt = jax.lax.fori_loop(0, n - 1, step, (acc0, m0, l0, kt, vt))
+    acc, m, l = block(n - 1, acc, m, l, kt, vt)
     safe_l = jnp.where(l == 0.0, 1.0, l)
     out = (acc / safe_l).astype(q.dtype)
     return jnp.swapaxes(out, 1, 2)                        # [B, Sl, H, D]

@@ -54,10 +54,20 @@ def _xla_sdpa(q, k, v, mask, dropout_p, is_causal, dropout_key):
 FORCE_PALLAS: bool | None = None
 
 
+def _pallas_available() -> bool:
+    try:
+        from ...ops import pallas_attention
+
+        return pallas_attention.pltpu is not None
+    except ImportError:
+        return False
+
+
 def _use_pallas(q):
     if FORCE_PALLAS is not None:
         return FORCE_PALLAS
-    return jax.default_backend() == "tpu" and q.shape[1] >= 128
+    return (jax.default_backend() == "tpu" and q.shape[1] >= 128
+            and _pallas_available())
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,

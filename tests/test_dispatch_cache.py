@@ -1,0 +1,115 @@
+"""Per-op jitted executable cache (SURVEY §7-1 eager dispatch design).
+
+Reference parity: the role of KernelFactory::SelectKernelOrThrowError
+(/root/reference/paddle/phi/core/kernel_factory.h:326) — precompiled kernels
+selected by signature. Here: entries keyed by (op, static operands,
+diff-mask, amp target); jax.jit handles shape/dtype keying inside an entry.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import dispatch
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache():
+    paddle.set_flags({"FLAGS_use_compiled_eager": True})
+    dispatch.eager_cache_clear()
+    yield
+    paddle.set_flags({"FLAGS_use_compiled_eager": True})
+
+
+def _train_step(x, w, b):
+    y = paddle.matmul(x, w) + b
+    z = paddle.nn.functional.relu(y)
+    loss = z.mean()
+    loss.backward()
+    return loss
+
+
+def test_cached_matches_uncached_fwd_bwd():
+    rs = np.random.RandomState(0)
+    xv = rs.randn(16, 32).astype("float32")
+    wv = rs.randn(32, 8).astype("float32")
+    bv = rs.randn(8).astype("float32")
+
+    results = {}
+    for cached in (False, True):
+        paddle.set_flags({"FLAGS_use_compiled_eager": cached})
+        x = paddle.to_tensor(xv)
+        w = paddle.to_tensor(wv, stop_gradient=False)
+        b = paddle.to_tensor(bv, stop_gradient=False)
+        loss = _train_step(x, w, b)
+        results[cached] = (loss.numpy(), w.grad.numpy(), b.grad.numpy())
+
+    for a, b_ in zip(results[False], results[True]):
+        np.testing.assert_allclose(a, b_, rtol=1e-6, atol=1e-6)
+
+
+def test_cache_hits_on_repeat_calls():
+    x = paddle.rand([8, 8])
+    w = paddle.rand([8, 8])
+    w.stop_gradient = False
+    for _ in range(5):
+        (paddle.matmul(x, w)).sum().backward()
+        w.clear_grad()
+    info = dispatch.eager_cache_info()
+    assert info["hits"] > 0, info
+    assert info["misses"] <= info["hits"], info
+
+
+def test_new_shape_same_entry():
+    # shape changes are handled inside jax.jit — entry count must not grow
+    w = paddle.rand([8, 8])
+    paddle.matmul(paddle.rand([4, 8]), w)
+    n1 = dispatch.eager_cache_info()["entries"]
+    paddle.matmul(paddle.rand([16, 8]), w)
+    n2 = dispatch.eager_cache_info()["entries"]
+    assert n1 == n2
+
+
+def test_static_scalar_operand_keys_entry():
+    # different static raw operands must not collide
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+    a = paddle.sum(x, axis=0)
+    b = paddle.sum(x, axis=1)
+    assert a.shape == [3] and b.shape == [2]
+    np.testing.assert_allclose(a.numpy(), x.numpy().sum(0))
+    np.testing.assert_allclose(b.numpy(), x.numpy().sum(1))
+
+
+def test_integer_ops_no_grad_path():
+    x = paddle.to_tensor(np.array([3, 1, 2]))
+    y = paddle.argsort(x)
+    np.testing.assert_array_equal(y.numpy(), [1, 2, 0])
+
+
+def test_cache_eviction_bounded():
+    paddle.set_flags({"FLAGS_eager_cache_size": 4})
+    try:
+        x = paddle.to_tensor(np.ones((2, 2), "float32"))
+        for k in range(10):
+            paddle.scale(x, scale=float(k))  # distinct static scalar per call
+        assert dispatch.eager_cache_info()["entries"] <= 4
+    finally:
+        paddle.set_flags({"FLAGS_eager_cache_size": 4096})
+
+
+def test_second_backward_still_guarded():
+    x = paddle.rand([4, 4])
+    x.stop_gradient = False
+    loss = (x * x).sum()
+    loss.backward()
+    with pytest.raises(RuntimeError, match="second time"):
+        loss.backward()
+
+
+def test_amp_target_in_key():
+    x = paddle.rand([8, 8])
+    w = paddle.rand([8, 8])
+    with paddle.amp.auto_cast(enable=True, dtype="bfloat16", level="O1"):
+        y16 = paddle.matmul(x, w)
+    y32 = paddle.matmul(x, w)
+    assert str(y16.dtype).endswith("bfloat16")
+    assert str(y32.dtype).endswith("float32")
