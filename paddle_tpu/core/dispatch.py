@@ -287,12 +287,17 @@ def _cached_dispatch(fn, fn_id, name, datas, diff_idx, target):
             return jitted(*dyn), None
         out, vjp_fn = jitted(*dyn)
         return out, (lambda cot, _v=vjp_fn: _apply_vjp(_v, cot))
-    except jax.errors.TracerArrayConversionError:
-        # fn inspects concrete values — permanently uncachable
+    except (jax.errors.TracerArrayConversionError,
+            jax.errors.TracerBoolConversionError,
+            jax.errors.ConcretizationTypeError):
+        # fn inspects concrete values — shape-independent, permanently
+        # uncachable for this key
         _eager_cache[key] = _UNCACHABLE
         return None
-    except (TypeError, jax.errors.ConcretizationTypeError, jax.errors.TracerBoolConversionError):
-        _eager_cache[key] = _UNCACHABLE
+    except TypeError:
+        # usually a per-shape user error (e.g. mismatched contracting dims):
+        # fall back for THIS call only — the uncached path raises the same
+        # error to the user; valid calls keep using the cached entry
         return None
 
 

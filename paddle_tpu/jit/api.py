@@ -94,9 +94,22 @@ class _Specialization:
                  "n_out_leaves", "trace_muts")
 
 
+#: exception types that mean "this program can't be captured as one graph"
+#: (data-dependent Python control flow / concrete-value inspection under
+#: tracing) — the analog of an SOT graph break
+#: (/root/reference/python/paddle/jit/sot/translate.py:37 falls back to
+#: eager frame execution on BreakGraphError).
+_GRAPH_BREAK_ERRORS = (
+    jax.errors.TracerBoolConversionError,
+    jax.errors.TracerArrayConversionError,
+    jax.errors.TracerIntegerConversionError,
+    jax.errors.ConcretizationTypeError,
+)
+
+
 class CompiledFunction:
     def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
-                 backend=None, full_graph=True, donate_buffers=None):
+                 backend=None, full_graph=False, donate_buffers=None):
         functools.update_wrapper(self, fn)
         self._fn = fn
         self._cache: dict[str, Any] = {}
@@ -105,6 +118,7 @@ class CompiledFunction:
         self._donate = flag("FLAGS_to_static_donate") if donate_buffers is None \
             else donate_buffers
         self._lock = threading.RLock()
+        self._full_graph = full_graph
         self._fallback_eager = False
 
     # -- paddle API parity
@@ -199,7 +213,29 @@ class CompiledFunction:
         arg_datas = [t._data for t in leaves]
         ro_datas = [t._data for t in ro_caps]
         mut_datas = [t._data for t in mut_caps]
-        out_datas, mut_out = jitted(arg_datas, ro_datas, mut_datas)
+        try:
+            out_datas, mut_out = jitted(arg_datas, ro_datas, mut_datas)
+        except _GRAPH_BREAK_ERRORS as e:
+            if self._full_graph:
+                raise RuntimeError(
+                    f"to_static(full_graph=True): '{getattr(self._fn, '__name__', self._fn)}' "
+                    f"cannot be captured as one graph ({type(e).__name__}). "
+                    "Remove data-dependent Python control flow (use lax.cond/where) "
+                    "or pass full_graph=False to fall back to eager."
+                ) from e
+            import warnings
+
+            warnings.warn(
+                f"to_static: graph break in "
+                f"'{getattr(self._fn, '__name__', self._fn)}' "
+                f"({type(e).__name__}); falling back to eager execution. "
+                "Tensor state from the failed capture was rolled back, but "
+                "Python-level side effects before the break ran once during "
+                "capture and will run again eagerly this call.",
+                stacklevel=3)
+            self._fallback_eager = True
+            a, k = _unflatten(struct, leaves)
+            return self._fn(*a, **k)
 
         spec.executable = jitted
         spec.out_struct = holder["out_struct"]
@@ -222,8 +258,13 @@ class CompiledFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
-              full_graph=True, **kwargs):
-    """Decorator/wrapper compiling a dygraph callable into one XLA program."""
+              full_graph=False, **kwargs):
+    """Decorator/wrapper compiling a dygraph callable into one XLA program.
+
+    full_graph=False (default, ≙ SOT): a trace failure (data-dependent Python
+    control flow) is a graph break — warns once and permanently falls back to
+    eager for this function. full_graph=True (≙ AST mode): trace failure raises.
+    """
 
     def wrap(fn):
         if isinstance(fn, CompiledFunction):
