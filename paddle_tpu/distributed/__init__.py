@@ -49,6 +49,8 @@ from .auto_parallel import (
     shard_layer,
 )
 from . import auto_parallel
+from . import checkpoint
+from .checkpoint import save_state_dict, load_state_dict
 from . import fleet
 from . import meta_parallel
 from . import sharding

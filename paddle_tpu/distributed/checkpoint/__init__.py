@@ -1,0 +1,7 @@
+from .save_load import (
+    LoadStatus,
+    load_state_dict,
+    save_state_dict,
+)
+
+__all__ = ["save_state_dict", "load_state_dict", "LoadStatus"]
