@@ -147,6 +147,15 @@ _eager_hits = 0
 _eager_misses = 0
 _vjp_apply_jit = None
 
+#: "fn inspects concrete values under tracing" — shared by the eager cache
+#: (permanently uncachable key) and to_static (SOT-style graph break).
+GRAPH_BREAK_ERRORS = (
+    jax.errors.TracerArrayConversionError,
+    jax.errors.TracerBoolConversionError,
+    jax.errors.TracerIntegerConversionError,
+    jax.errors.ConcretizationTypeError,
+)
+
 
 def _freeze(v):
     """Hashable cache-key fragment for a static operand, or _UNCACHABLE."""
@@ -287,9 +296,7 @@ def _cached_dispatch(fn, fn_id, name, datas, diff_idx, target):
             return jitted(*dyn), None
         out, vjp_fn = jitted(*dyn)
         return out, (lambda cot, _v=vjp_fn: _apply_vjp(_v, cot))
-    except (jax.errors.TracerArrayConversionError,
-            jax.errors.TracerBoolConversionError,
-            jax.errors.ConcretizationTypeError):
+    except GRAPH_BREAK_ERRORS:
         # fn inspects concrete values — shape-independent, permanently
         # uncachable for this key
         _eager_cache[key] = _UNCACHABLE
@@ -324,12 +331,28 @@ def _check_nan_inf(name, arrs):
                 raise FloatingPointError(f"Operator '{name}' output contains NaN/Inf")
 
 
+#: set by paddle_tpu.profiler while recording: callable(name) -> RecordEvent
+_profiler_hook = None
+
+
 def op_call(fn: Callable, *args, name: str | None = None, n_diff: int | None = None):
     """Run pure jax function `fn` over mixed Tensor/raw args, recording autograd.
 
     Args after position `n_diff` (when given) are never differentiated —
     use for index/shape/flag operands. Returns Tensor or tuple[Tensor].
     """
+    hook = _profiler_hook
+    if hook is not None:
+        ev = hook(name or getattr(fn, "__name__", "op"))
+        ev.begin()
+        try:
+            return _op_call_impl(fn, *args, name=name, n_diff=n_diff)
+        finally:
+            ev.end()
+    return _op_call_impl(fn, *args, name=name, n_diff=n_diff)
+
+
+def _op_call_impl(fn: Callable, *args, name: str | None = None, n_diff: int | None = None):
     from .tensor import Tensor
 
     name = name or getattr(fn, "__name__", "op")

@@ -98,13 +98,9 @@ class _Specialization:
 #: (data-dependent Python control flow / concrete-value inspection under
 #: tracing) — the analog of an SOT graph break
 #: (/root/reference/python/paddle/jit/sot/translate.py:37 falls back to
-#: eager frame execution on BreakGraphError).
-_GRAPH_BREAK_ERRORS = (
-    jax.errors.TracerBoolConversionError,
-    jax.errors.TracerArrayConversionError,
-    jax.errors.TracerIntegerConversionError,
-    jax.errors.ConcretizationTypeError,
-)
+#: eager frame execution on BreakGraphError). One shared definition with the
+#: eager dispatch cache.
+from ..core.dispatch import GRAPH_BREAK_ERRORS as _GRAPH_BREAK_ERRORS
 
 
 class CompiledFunction:

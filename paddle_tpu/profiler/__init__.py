@@ -1,1 +1,354 @@
+"""paddle.profiler — scheduler-driven profiler over the XLA/JAX tracers.
 
+Reference parity: python/paddle/profiler/profiler.py:358 (Profiler with
+CLOSED/READY/RECORD scheduler states, RecordEvent user scopes, summary
+tables from profiler_statistic.py, chrome-trace export) and the C++
+multi-tracer design (paddle/fluid/platform/profiler/profiler.h: host
+ring-buffer tracer + device tracer). TPU-native mapping:
+
+* device tracer ≙ `jax.profiler` xplane trace (start_trace/stop_trace) —
+  the XLA runtime records device ops; view in TensorBoard/XProf.
+* host tracer ≙ in-process event list fed by `RecordEvent` scopes and
+  automatic per-op instrumentation of the eager dispatch funnel
+  (the analog of RecordEvent wrapping in pir_interpreter.cc).
+* summary ≙ Paddle-style aggregated table (calls/total/avg/max/min/ratio).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import threading
+import time
+from typing import Callable, Iterable
+
+from .timer import benchmark  # noqa: F401
+
+__all__ = [
+    "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+    "make_scheduler", "export_chrome_tracing", "benchmark", "TracerEventType",
+]
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3  # last RECORD step of a cycle: stats returned
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1       # parity alias — maps to the XLA device tracer
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class TracerEventType(enum.Enum):
+    Operator = 0
+    Dataloader = 1
+    ProfileStep = 2
+    Forward = 3
+    Backward = 4
+    Optimization = 5
+    Communication = 6
+    PythonOp = 7
+    UserDefined = 8
+
+
+# ------------------------------------------------------------- host tracer
+class _HostEvent:
+    __slots__ = ("name", "type", "start", "end", "tid")
+
+    def __init__(self, name, type_, start, end, tid):
+        self.name, self.type, self.start, self.end, self.tid = (
+            name, type_, start, end, tid)
+
+
+class _HostTracer:
+    """RecordEvent TLS ring (≙ paddle/fluid/platform/profiler/host_tracer.h)."""
+
+    def __init__(self, capacity: int = 1 << 20):
+        self.events: list[_HostEvent] = []
+        self.capacity = capacity
+        self.enabled = False
+        self._lock = threading.Lock()
+
+    def add(self, ev: _HostEvent):
+        with self._lock:
+            if len(self.events) < self.capacity:
+                self.events.append(ev)
+
+    def clear(self):
+        with self._lock:
+            self.events = []
+
+
+_tracer = _HostTracer()
+
+
+class RecordEvent:
+    """User-defined scope, visible in the summary and the xplane trace.
+
+    Usable as a context manager or via explicit begin()/end()
+    (≙ python/paddle/profiler/utils.py RecordEvent).
+    """
+
+    def __init__(self, name: str, event_type: TracerEventType = TracerEventType.UserDefined):
+        self.name = name
+        self.event_type = event_type
+        self._t0 = None
+        self._jax_ctx = None
+
+    def begin(self):
+        if _tracer.enabled:
+            import jax.profiler
+
+            self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ctx.__enter__()
+            self._t0 = time.perf_counter_ns()
+        return self
+
+    def end(self):
+        if self._t0 is not None:
+            t1 = time.perf_counter_ns()
+            _tracer.add(_HostEvent(self.name, self.event_type, self._t0, t1,
+                                   threading.get_ident()))
+            if self._jax_ctx is not None:
+                self._jax_ctx.__exit__(None, None, None)
+            self._t0 = None
+            self._jax_ctx = None
+
+    __enter__ = begin
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def _op_hook(name: str):
+    """Per-op auto instrumentation installed into the dispatch funnel while
+    recording (≙ RecordEvent wrapping in new_executor/pir_interpreter.cc)."""
+    return RecordEvent(name, TracerEventType.Operator)
+
+
+# ------------------------------------------------------------- scheduler
+def make_scheduler(*, closed: int = 0, ready: int = 0, record: int = 1,
+                   repeat: int = 0, skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Step-indexed state machine (≙ profiler.py make_scheduler)."""
+    cycle = closed + ready + record
+    if record <= 0:
+        raise ValueError("record must be > 0")
+
+    def fn(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat > 0 and s >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return fn
+
+
+def _default_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD  # record everything between start() and stop()
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str | None = None):
+    """on_trace_ready callback writing chrome://tracing JSON from host events."""
+
+    def handle(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_{int(time.time())}.json")
+        events = []
+        for ev in prof._events:
+            events.append({
+                "name": ev.name, "ph": "X", "pid": os.getpid(), "tid": ev.tid,
+                "ts": ev.start / 1e3, "dur": (ev.end - ev.start) / 1e3,
+                "cat": ev.type.name,
+            })
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        prof._chrome_trace_path = path
+
+    return handle
+
+
+def export_protobuf(dir_name: str, worker_name: str | None = None):
+    """Parity shim: the xplane protobuf is written by jax.profiler itself
+    into the Profiler's log_dir; this returns a handler pointing there."""
+
+    def handle(prof):
+        prof._chrome_trace_path = prof._log_dir
+
+    return handle
+
+
+# ------------------------------------------------------------- profiler
+class Profiler:
+    """paddle.profiler.Profiler(targets=…, scheduler=…, on_trace_ready=…).
+
+    with Profiler(scheduler=make_scheduler(closed=1, ready=1, record=3)) as p:
+        for batch in loader:
+            train_step(batch)
+            p.step()
+    print(p.summary())
+    """
+
+    def __init__(self, *, targets: Iterable[ProfilerTarget] | None = None,
+                 scheduler=None, on_trace_ready=None, timer_only: bool = False,
+                 record_shapes: bool = False, profile_memory: bool = False,
+                 with_flops: bool = False, log_dir: str | None = None):
+        if isinstance(scheduler, (tuple, list)):  # paddle accepts (start, end)
+            start, end = scheduler
+            scheduler = make_scheduler(closed=max(0, start), record=end - start,
+                                       repeat=1)
+        self._scheduler = scheduler or _default_scheduler
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._events: list[_HostEvent] = []      # current cycle (handler input)
+        self._all_events: list[_HostEvent] = []  # cumulative (summary/events)
+        self._device_tracing = False
+        self._log_dir = log_dir or os.path.join(".", "profiler_log")
+        self._chrome_trace_path = None
+        self._step_records: list[float] = []
+        self._last_step_t = None
+
+    # -- lifecycle
+    def start(self):
+        self._state = self._scheduler(self._step)
+        self._apply_state()
+        return self
+
+    def stop(self):
+        if self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._collect()
+        self._set_recording(False)
+        self._state = ProfilerState.CLOSED
+        if self._on_trace_ready is not None and self._events:
+            self._on_trace_ready(self)
+        self._events = []  # consumed; cumulative copy stays in _all_events
+
+    def step(self, num_samples: int | None = None):
+        now = time.perf_counter()
+        if self._last_step_t is not None and self._state != ProfilerState.CLOSED:
+            self._step_records.append(now - self._last_step_t)
+        self._last_step_t = now
+        if num_samples is not None:
+            benchmark().step(num_samples)
+        old = self._state
+        if old == ProfilerState.RECORD_AND_RETURN:
+            self._collect()
+            if self._on_trace_ready is not None:
+                self._on_trace_ready(self)
+            self._events = []  # each cycle's handler sees only its own events
+        self._step += 1
+        self._state = self._scheduler(self._step)
+        if old != self._state:
+            self._apply_state()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- state plumbing
+    def _apply_state(self):
+        rec = self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        self._set_recording(rec)
+
+    def _set_recording(self, on: bool):
+        from ..core import dispatch
+
+        if on and not self._timer_only:
+            if not _tracer.enabled:
+                _tracer.enabled = True
+                dispatch._profiler_hook = _op_hook
+                if not self._device_tracing:
+                    try:
+                        import jax.profiler
+
+                        os.makedirs(self._log_dir, exist_ok=True)
+                        jax.profiler.start_trace(self._log_dir)
+                        self._device_tracing = True
+                    except Exception:
+                        self._device_tracing = False
+        elif not on and _tracer.enabled:
+            _tracer.enabled = False
+            dispatch._profiler_hook = None
+            if self._device_tracing:
+                try:
+                    import jax.profiler
+
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                self._device_tracing = False
+
+    def _collect(self):
+        self._events.extend(_tracer.events)
+        self._all_events.extend(_tracer.events)
+        _tracer.clear()
+
+    # -- reporting
+    def summary(self, sorted_by: str = "total", op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms") -> str:
+        unit = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}[time_unit]
+        agg: dict[tuple, list] = {}
+        for ev in self._all_events:
+            key = (ev.type.name, ev.name)
+            rec = agg.setdefault(key, [0, 0.0, 0.0, float("inf")])
+            d = ev.end - ev.start
+            rec[0] += 1
+            rec[1] += d
+            rec[2] = max(rec[2], d)
+            rec[3] = min(rec[3], d)
+        total = sum(r[1] for r in agg.values()) or 1.0
+        lines = []
+        header = (f"{'Event':<42}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
+                  f"{'Avg(' + time_unit + ')':>12}{'Max(' + time_unit + ')':>12}"
+                  f"{'Min(' + time_unit + ')':>12}{'Ratio(%)':>10}")
+        bar = "-" * len(header)
+        lines += [bar, "Profiling Report".center(len(header)), bar, header, bar]
+        order = sorted(agg.items(), key=lambda kv: -kv[1][1])
+        for (etype, name), (calls, tot, mx, mn) in order:
+            if not op_detail and etype == "Operator":
+                continue
+            label = f"{etype}::{name}"
+            if len(label) > 40:
+                label = label[:37] + "..."
+            lines.append(
+                f"{label:<42}{calls:>8}{tot / unit:>14.4f}{tot / calls / unit:>12.4f}"
+                f"{mx / unit:>12.4f}{mn / unit:>12.4f}{100 * tot / total:>10.2f}")
+        lines.append(bar)
+        if self._step_records:
+            import numpy as np
+
+            arr = np.array(self._step_records)
+            lines.append(f"steps: {len(arr)}  avg {arr.mean() * 1e3:.3f} ms  "
+                         f"p50 {np.percentile(arr, 50) * 1e3:.3f} ms  "
+                         f"p99 {np.percentile(arr, 99) * 1e3:.3f} ms")
+        return "\n".join(lines)
+
+    @property
+    def events(self):
+        return list(self._all_events)
+
+
+def load_profiler_result(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)

@@ -1,0 +1,93 @@
+"""Throughput benchmark timer (≙ python/paddle/profiler/timer.py).
+
+paddle.profiler.benchmark() returns the global Benchmark: hooked into a
+train loop it reports reader cost, batch cost, and ips (items/sec).
+"""
+from __future__ import annotations
+
+import time
+
+
+class _Stat:
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+        self.window = []
+
+    def add(self, v, window=100):
+        self.total += v
+        self.count += 1
+        self.window.append(v)
+        if len(self.window) > window:
+            self.window.pop(0)
+
+    @property
+    def avg(self):
+        return self.total / self.count if self.count else 0.0
+
+    @property
+    def smoothed(self):
+        return sum(self.window) / len(self.window) if self.window else 0.0
+
+
+class Benchmark:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._reader = _Stat()
+        self._batch = _Stat()
+        self._ips = _Stat()
+        self._t_begin = None
+        self._t_reader_done = None
+        self.num_samples = None
+
+    # -- loop hooks
+    def begin(self):
+        self._t_begin = time.perf_counter()
+
+    def before_reader(self):
+        self.begin()
+
+    def after_reader(self):
+        if self._t_begin is not None:
+            self._t_reader_done = time.perf_counter()
+            self._reader.add(self._t_reader_done - self._t_begin)
+
+    def step(self, num_samples: int | None = None):
+        """End of one iteration; num_samples for ips."""
+        if self._t_begin is None:
+            self.begin()
+            return
+        now = time.perf_counter()
+        dt = now - self._t_begin
+        self._batch.add(dt)
+        if num_samples:
+            self._ips.add(num_samples / dt)
+        self._t_begin = now
+        self._t_reader_done = None
+
+    def end(self):
+        self._t_begin = None
+
+    # -- reporting
+    def step_info(self, unit: str = "samples") -> str:
+        parts = []
+        if self._reader.count:
+            parts.append(f"reader_cost: {self._reader.smoothed:.5f} s")
+        if self._batch.count:
+            parts.append(f"batch_cost: {self._batch.smoothed:.5f} s")
+        if self._ips.count:
+            parts.append(f"ips: {self._ips.smoothed:.3f} {unit}/s")
+        return " ".join(parts)
+
+    @property
+    def speed_average(self):
+        return self._ips.avg
+
+
+_global_benchmark = Benchmark()
+
+
+def benchmark() -> Benchmark:
+    return _global_benchmark

@@ -1,0 +1,110 @@
+"""Profiler tests (≙ python/paddle/profiler/profiler.py:358 surface)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler import (
+    Profiler, ProfilerState, RecordEvent, TracerEventType,
+    export_chrome_tracing, make_scheduler,
+)
+
+
+class TestScheduler:
+    def test_states_cycle(self):
+        sch = make_scheduler(closed=1, ready=1, record=2, repeat=2, skip_first=1)
+        states = [sch(i) for i in range(10)]
+        assert states[0] == ProfilerState.CLOSED          # skip_first
+        assert states[1] == ProfilerState.CLOSED
+        assert states[2] == ProfilerState.READY
+        assert states[3] == ProfilerState.RECORD
+        assert states[4] == ProfilerState.RECORD_AND_RETURN
+        assert states[5] == ProfilerState.CLOSED          # cycle 2
+        assert states[8] == ProfilerState.RECORD_AND_RETURN
+        assert states[9] == ProfilerState.CLOSED          # repeat exhausted
+
+    def test_record_only(self):
+        sch = make_scheduler(record=3)
+        assert sch(0) == ProfilerState.RECORD
+        assert sch(2) == ProfilerState.RECORD_AND_RETURN
+
+
+class TestProfiler:
+    def test_ops_recorded_and_summary(self, tmp_path):
+        x = paddle.rand([16, 16])
+        with Profiler(log_dir=str(tmp_path / "log")) as p:
+            for _ in range(3):
+                y = paddle.matmul(x, x)
+                z = paddle.tanh(y)
+            with RecordEvent("my_scope"):
+                z.sum()
+        names = {e.name for e in p.events}
+        assert "matmul" in names and "tanh" in names and "my_scope" in names
+        ops = [e for e in p.events if e.type == TracerEventType.Operator]
+        assert len(ops) >= 7
+        table = p.summary()
+        assert "Profiling Report" in table and "matmul" in table
+        assert "Ratio(%)" in table
+
+    def test_not_recording_when_closed(self):
+        before = paddle.rand([4, 4])
+        p = Profiler(scheduler=make_scheduler(closed=100, record=1))
+        p.start()
+        paddle.matmul(before, before)
+        p.stop()
+        assert all(e.name != "matmul" for e in p.events)
+
+    def test_step_scheduler_drives_collection(self, tmp_path):
+        x = paddle.rand([8, 8])
+        collected = []
+        p = Profiler(scheduler=make_scheduler(closed=1, record=2, repeat=1),
+                     on_trace_ready=lambda prof: collected.append(len(prof.events)),
+                     log_dir=str(tmp_path / "log"))
+        p.start()
+        for _ in range(4):
+            paddle.matmul(x, x)
+            p.step()
+        p.stop()
+        assert collected, "RECORD_AND_RETURN must fire on_trace_ready"
+        assert any(e.name == "matmul" for e in p.events)
+
+    def test_chrome_trace_export(self, tmp_path):
+        x = paddle.rand([4, 4])
+        handler = export_chrome_tracing(str(tmp_path / "chrome"))
+        with Profiler(on_trace_ready=handler, log_dir=str(tmp_path / "log")) as p:
+            paddle.matmul(x, x)
+        assert p._chrome_trace_path and os.path.exists(p._chrome_trace_path)
+        data = profiler.load_profiler_result(p._chrome_trace_path)
+        assert any(ev["name"] == "matmul" for ev in data["traceEvents"])
+
+    def test_xplane_trace_written(self, tmp_path):
+        # the device tracer (jax.profiler) must produce an xplane artifact
+        log = str(tmp_path / "xplane")
+        x = paddle.rand([8, 8])
+        with Profiler(log_dir=log):
+            paddle.matmul(x, x).sum()
+        found = []
+        for root, _dirs, files in os.walk(log):
+            found += [f for f in files if f.endswith(".xplane.pb")]
+        assert found, f"no xplane under {log}"
+
+    def test_hook_uninstalled_after_stop(self):
+        from paddle_tpu.core import dispatch
+
+        with Profiler():
+            pass
+        assert dispatch._profiler_hook is None
+
+
+class TestBenchmarkTimer:
+    def test_step_info(self):
+        bm = profiler.benchmark()
+        bm.reset()
+        bm.begin()
+        for _ in range(3):
+            paddle.rand([64, 64]).sum()
+            bm.step(num_samples=64)
+        info = bm.step_info()
+        assert "batch_cost" in info and "ips" in info
+        assert bm.speed_average > 0
