@@ -1,0 +1,45 @@
+"""Token-exchange primitives for MoE (API parity).
+
+Reference parity: paddle.distributed.utils.moe_utils — global_scatter
+(/root/reference/python/paddle/distributed/utils/moe_utils.py:20) and
+global_gather (:153): count-based NCCL all-to-alls moving selected tokens to
+the ranks that own their experts.
+
+TPU-native note: the in-tree MoELayer does NOT use these — its einsum
+dispatch with an `ep` sharding constraint lets XLA emit the token
+all-to-all (moe_layer.py), which keeps shapes static (count-based exchanges
+are dynamically shaped, hostile to XLA). These wrappers exist for users
+porting count-based MoE code: with one process the exchange is the
+identity on the already-bucket-sorted token matrix; a real multi-process
+eager exchange is intentionally unsupported, like the other eager
+collectives (communication.py) — move the loop under jit/shard_map or use
+MoELayer.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...core.tensor import Tensor
+
+
+def _check_single_process(op: str):
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            f"{op}: count-based eager token exchange across processes is not "
+            "supported on the TPU backend — use MoELayer (einsum dispatch, "
+            "XLA emits the all-to-all) or run under jit/shard_map.")
+
+
+def global_scatter(x: Tensor, local_count: Tensor, global_count: Tensor,
+                   group=None, use_calc_stream: bool = True) -> Tensor:
+    """Single-process: tokens are already grouped by (expert, source) bucket
+    and every expert is local, so the exchange is the identity."""
+    _check_single_process("global_scatter")
+    return x
+
+
+def global_gather(x: Tensor, local_count: Tensor, global_count: Tensor,
+                  group=None, use_calc_stream: bool = True) -> Tensor:
+    """Inverse of global_scatter (identity with one process)."""
+    _check_single_process("global_gather")
+    return x

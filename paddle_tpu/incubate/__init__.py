@@ -1,1 +1,6 @@
+"""paddle.incubate — experimental APIs (≙ python/paddle/incubate)."""
+from . import autograd
+from . import distributed
+from . import nn
 
+__all__ = ["autograd", "distributed", "nn"]

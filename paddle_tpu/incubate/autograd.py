@@ -1,0 +1,119 @@
+"""Higher-order functional autodiff (≙ paddle.incubate.autograd).
+
+Reference parity: python/paddle/incubate/autograd/{functional,primapi}.py —
+jvp/vjp/Jacobian/Hessian over paddle functions. TPU-native: these are direct
+jax transform compositions over op-level functions of Tensors; arbitrary
+nesting (forward-over-reverse etc.) is free because every op is a pure jax
+function underneath. `paddle.grad(create_graph=True)` (core/engine.py)
+routes double-grad through the same machinery.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return x
+
+
+def _wrap(x):
+    if isinstance(x, jax.Array):
+        return Tensor(x, _internal=True)
+    if isinstance(x, (list, tuple)):
+        return type(x)(_wrap(v) for v in x)
+    return x
+
+
+def _lift(func):
+    """Tensor-level callable -> pure jax-array callable."""
+
+    def pure(*arrs):
+        out = func(*[Tensor(a, _internal=True, stop_gradient=False) for a in arrs])
+        return _unwrap(out)
+
+    return pure
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode JVP. xs: Tensor or sequence; v: tangents (defaults to
+    ones). Returns (outputs, jvp_result)."""
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    prim = [_unwrap(x) for x in xs]
+    if v is None:
+        tang = [jnp.ones_like(p) for p in prim]
+    else:
+        v = v if isinstance(v, (list, tuple)) else [v]
+        tang = [_unwrap(t) for t in v]
+    out, tan_out = jax.jvp(_lift(func), tuple(prim), tuple(tang))
+    return _wrap(out), _wrap(tan_out)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode VJP. Returns (outputs, vjp_result)."""
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    prim = [_unwrap(x) for x in xs]
+    out, pullback = jax.vjp(_lift(func), *prim)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        cot = _unwrap(v if not isinstance(v, Tensor) else v)
+    grads = pullback(cot)
+    grads = grads[0] if len(grads) == 1 else list(grads)
+    return _wrap(out), _wrap(grads)
+
+
+def grad(func, xs, v=None):
+    """Gradient of a scalar-output func (sugar over vjp)."""
+    _out, g = vjp(func, xs, v)
+    return g
+
+
+class Jacobian:
+    """Lazy Jacobian (≙ incubate/autograd/functional.py Jacobian): J[:]
+    materializes, row/col indexing computes on demand via jacrev."""
+
+    def __init__(self, func, xs, is_batched=False):
+        xs = xs if isinstance(xs, (list, tuple)) else [xs]
+        self._prim = [_unwrap(x) for x in xs]
+        self._jac = None
+        self._fn = _lift(func)
+        self._is_batched = is_batched
+
+    def _materialize(self):
+        if self._jac is None:
+            jac = jax.jacrev(self._fn, argnums=tuple(range(len(self._prim))))(
+                *self._prim)
+            jac = jac[0] if len(self._prim) == 1 else jac
+            self._jac = jac
+        return self._jac
+
+    def __getitem__(self, idx):
+        j = self._materialize()
+        if isinstance(j, tuple):
+            return tuple(_wrap(a[idx] if idx != slice(None) else a) for a in j)
+        return _wrap(j[idx] if idx != slice(None) else j)
+
+    @property
+    def shape(self):
+        j = self._materialize()
+        return j[0].shape if isinstance(j, tuple) else j.shape
+
+
+class Hessian(Jacobian):
+    """Lazy Hessian via forward-over-reverse."""
+
+    def _materialize(self):
+        if self._jac is None:
+            h = jax.hessian(self._fn, argnums=tuple(range(len(self._prim))))(
+                *self._prim)
+            while isinstance(h, tuple) and len(self._prim) == 1:
+                h = h[0]
+            self._jac = h
+        return self._jac

@@ -1,0 +1,6 @@
+from .gate import GATES, gshard_gating, naive_gating, switch_gating
+from .grad_clip import ClipGradForMOEByGlobalNorm
+from .moe_layer import ExpertFFN, MoELayer
+
+__all__ = ["MoELayer", "ExpertFFN", "ClipGradForMOEByGlobalNorm",
+           "gshard_gating", "switch_gating", "naive_gating", "GATES"]

@@ -1,0 +1,119 @@
+"""MoE gates: naive top-k, Switch (top-1), GShard (top-2).
+
+Reference parity: paddle.incubate.distributed.models.moe.gate
+(/root/reference/python/paddle/incubate/distributed/models/moe/gate/
+{naive_gate,switch_gate,gshard_gate}.py, surfaced by moe_layer.py:261).
+TPU-native formulation: gating returns dense [N, E, C] combine/dispatch
+tensors (the GShard-paper einsum form) so expert routing is static-shaped —
+no gather/scatter with data-dependent sizes, XLA tiles everything onto the
+MXU and inserts the token all-to-all from the sharding annotations.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _one_hot(idx, n):
+    if jnp.issubdtype(jnp.asarray(idx).dtype, jnp.floating):
+        idx = idx.astype(jnp.int32)
+    return jax.nn.one_hot(idx, n, dtype=jnp.float32)
+
+
+def _position_in_expert(expert_mask):
+    """expert_mask: [N, E] 0/1 — position of each token within its expert's
+    queue (cumulative count order = token order)."""
+    pos = jnp.cumsum(expert_mask, axis=0) * expert_mask  # 1-based
+    return pos - 1.0
+
+
+def naive_gating(logits, capacity, top_k=2):
+    """Top-k softmax gating without capacity dropping beyond C (naive gate).
+    Returns (combine [N,E,C], dispatch [N,E,C], aux_loss scalar)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    n, e = probs.shape
+    combine = jnp.zeros((n, e, capacity), jnp.float32)
+    used = jnp.zeros((n, e), jnp.float32)
+    counts = jnp.zeros((1, e), jnp.float32)  # expert slots consumed so far
+    remaining = probs
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)
+        mask = _one_hot(idx, e) * (1.0 - used)
+        # choice-k tokens queue behind all earlier choices in each expert —
+        # without the offset two iterations would share slot indices and the
+        # dispatch einsum would sum their tokens into one slot
+        pos = _position_in_expert(mask) + counts
+        keep = (pos < capacity) & (mask > 0)
+        gate = jnp.sum(probs * mask, axis=-1, keepdims=True)
+        combine = combine + (
+            gate[..., None] * mask[..., None]
+            * _one_hot(jnp.clip(pos, 0, capacity - 1), capacity)
+            * keep[..., None].astype(jnp.float32))
+        used = used + mask
+        counts = counts + jnp.sum(mask, axis=0, keepdims=True)
+        remaining = remaining * (1.0 - mask)
+    dispatch = combine > 0.0
+    return combine, dispatch, jnp.zeros((), jnp.float32)
+
+
+def switch_gating(logits, capacity):
+    """Switch-transformer top-1 gating with load-balancing aux loss."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    n, e = probs.shape
+    idx = jnp.argmax(probs, axis=-1)
+    mask = _one_hot(idx, e)                                   # [N, E]
+    # aux: E * sum_e (fraction routed to e) * (mean prob of e)
+    density = jnp.mean(mask, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * e
+
+    pos = _position_in_expert(mask)
+    keep = (pos < capacity) & (mask > 0)
+    gate = jnp.sum(probs * mask, axis=-1, keepdims=True)      # top-1 prob
+    combine = (gate[..., None] * mask[..., None]
+               * _one_hot(jnp.clip(pos, 0, capacity - 1), capacity)
+               * keep[..., None].astype(jnp.float32))
+    return combine, combine > 0.0, aux
+
+
+def gshard_gating(logits, capacity, second_policy="all"):
+    """GShard top-2 gating: top-1 always, top-2 weighted; aux loss on top-1
+    assignment (GShard paper / gshard_gate.py)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    n, e = probs.shape
+
+    idx1 = jnp.argmax(probs, axis=-1)
+    mask1 = _one_hot(idx1, e)
+    probs_wo1 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs_wo1, axis=-1)
+    mask2 = _one_hot(idx2, e)
+
+    density = jnp.mean(mask1, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * e
+
+    pos1 = _position_in_expert(mask1)
+    keep1 = (pos1 < capacity) & (mask1 > 0)
+    # second choice queues BEHIND all first choices in each expert
+    pos2 = _position_in_expert(mask2) + jnp.sum(mask1, axis=0, keepdims=True)
+    keep2 = (pos2 < capacity) & (mask2 > 0)
+
+    g1 = jnp.sum(probs * mask1, axis=-1, keepdims=True)
+    g2 = jnp.sum(probs * mask2, axis=-1, keepdims=True)
+    denom = jnp.clip(g1 + g2, 1e-9, None)
+    g1, g2 = g1 / denom, g2 / denom
+
+    def contrib(gate, mask, pos, keep):
+        return (gate[..., None] * mask[..., None]
+                * _one_hot(jnp.clip(pos, 0, capacity - 1), capacity)
+                * keep[..., None].astype(jnp.float32))
+
+    combine = contrib(g1, mask1, pos1, keep1) + contrib(g2, mask2, pos2, keep2)
+    return combine, combine > 0.0, aux
+
+
+GATES = {
+    "naive": lambda logits, cap, top_k=2: naive_gating(logits, cap, top_k),
+    "switch": lambda logits, cap, top_k=1: switch_gating(logits, cap),
+    "gshard": lambda logits, cap, top_k=2: gshard_gating(logits, cap),
+}

@@ -1,0 +1,24 @@
+"""MoE-aware gradient clipping.
+
+Reference parity: moe/grad_clip.py ClipGradForMOEByGlobalNorm — on GPU the
+global norm must be assembled from (a) replicated dense params counted once
+and (b) expert params living only on their own rank, allreduced over the moe
+group. TPU-native: expert parameters are ONE logical stacked tensor sharded
+over `ep`; `jnp.linalg.norm` of a sharded jax.Array is already the global
+value (GSPMD inserts the partial-norm psum), so the reference's two-pool
+bookkeeping collapses to ordinary global-norm clipping.
+"""
+from __future__ import annotations
+
+from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    """Identical math to ClipGradByGlobalNorm; kept as a distinct class for
+    API parity (is_expert_param filtering is unnecessary under GSPMD)."""
+
+    def __init__(self, clip_norm, is_expert_param_func=None, moe_group=None,
+                 group_name="default_moe_group"):
+        super().__init__(clip_norm, group_name=group_name)
+        self.is_expert_param_func = is_expert_param_func
+        self.moe_group = moe_group

@@ -1,0 +1,176 @@
+"""MoE layer with expert parallelism over an `ep` mesh axis.
+
+Reference parity: paddle.incubate.distributed.models.moe.MoELayer
+(/root/reference/python/paddle/incubate/distributed/models/moe/moe_layer.py:261)
+— gate → global_scatter (token all-to-all) → local experts → global_gather →
+combine. The reference moves tokens with explicit NCCL all-to-alls sized by
+per-rank counts (distributed/utils/moe_utils.py:20,153).
+
+TPU-native design (GShard einsum form): experts live as ONE stacked weight
+[E, ...] sharded over the `ep` mesh axis; dispatch/combine are dense
+einsums against a [N, E, C] routing tensor with a sharding constraint on
+the [E, C, M] expert-major intermediate — XLA's SPMD partitioner emits the
+token all-to-all between the data-sharded and expert-sharded layouts
+automatically (this is how GShard itself was implemented). Static capacity
+keeps every shape fixed: no recompiles, MXU-friendly.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.dispatch import op_call
+from paddle_tpu.core.tensor import Parameter, Tensor
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.nn import initializer as I
+from .gate import GATES
+
+_ACTS = {
+    "gelu": jax.nn.gelu,
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+}
+
+
+def _ep_mesh_axis(moe_group):
+    """Resolve the expert-parallel mesh axis name (or None = all local)."""
+    if moe_group is not None:
+        return getattr(moe_group, "axis_name", moe_group)
+    from paddle_tpu.distributed import fleet
+
+    if fleet.is_initialized():
+        mesh = fleet.get_hybrid_communicate_group().get_mesh()
+        if "ep" in mesh.axis_names and mesh.shape["ep"] > 1:
+            return "ep"
+    return None
+
+
+class ExpertFFN(Layer):
+    """Stacked per-expert FFN weights: [E, d_model, d_hidden] / [E, d_hidden,
+    d_model] — replaces the reference's Python list of expert sub-Layers so
+    all experts run as ONE batched matmul on the MXU."""
+
+    def __init__(self, num_experts, d_model, d_hidden, act="gelu", name_prefix=""):
+        super().__init__()
+        self.num_experts = num_experts
+        self.act = _ACTS[act]
+        k = 1.0 / math.sqrt(d_model)
+        self.w1 = self.create_parameter(
+            [num_experts, d_model, d_hidden],
+            default_initializer=I.Uniform(-k, k))
+        self.b1 = self.create_parameter(
+            [num_experts, 1, d_hidden], is_bias=True)
+        k2 = 1.0 / math.sqrt(d_hidden)
+        self.w2 = self.create_parameter(
+            [num_experts, d_hidden, d_model],
+            default_initializer=I.Uniform(-k2, k2))
+        self.b2 = self.create_parameter(
+            [num_experts, 1, d_model], is_bias=True)
+
+    def forward(self, expert_in: Tensor) -> Tensor:
+        """expert_in: [E, C, M] -> [E, C, M]."""
+        act = self.act
+
+        def fn(x, w1, b1, w2, b2):
+            h = act(jnp.einsum("ecm,emh->ech", x, w1) + b1)
+            return jnp.einsum("ech,ehm->ecm", h, w2) + b2
+
+        return op_call(fn, expert_in, self.w1, self.b1, self.w2, self.b2,
+                       name="expert_ffn")
+
+
+class MoELayer(Layer):
+    """Mixture-of-experts layer (≙ moe_layer.py:261).
+
+    moe = MoELayer(d_model=512, d_hidden=2048, num_experts=8,
+                   gate="gshard", top_k=2, capacity_factor=1.25)
+    y = moe(x)                 # x: [B, S, M]
+    loss = task_loss + 0.01 * moe.l_aux
+
+    With fleet initialized on a mesh that has an `ep` axis (or an explicit
+    `moe_group`), expert weights shard over it and XLA inserts the token
+    all-to-all; otherwise all experts are local.
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, gate="gshard",
+                 top_k=2, capacity_factor=1.25, act="gelu", moe_group=None,
+                 experts=None):
+        super().__init__()
+        if isinstance(gate, dict):  # reference passes gate config dicts
+            gate_cfg = dict(gate)
+            gate = gate_cfg.pop("type", "gshard")
+            top_k = gate_cfg.pop("top_k", top_k)
+            capacity_factor = gate_cfg.pop("capacity_factor", capacity_factor)
+        if gate not in GATES:
+            raise ValueError(f"unknown gate '{gate}' (have {sorted(GATES)})")
+        self.gate_type = gate
+        self.top_k = 1 if gate == "switch" else top_k
+        self.capacity_factor = capacity_factor
+        self.num_experts = num_experts
+        self.d_model = d_model
+        k = 1.0 / math.sqrt(d_model)
+        self.gate_weight = self.create_parameter(
+            [d_model, num_experts], default_initializer=I.Uniform(-k, k))
+        self.experts = experts or ExpertFFN(num_experts, d_model, d_hidden, act)
+        self._ep_axis = _ep_mesh_axis(moe_group)
+        if self._ep_axis is not None:
+            self._shard_experts()
+        self.l_aux = None
+
+    def _shard_experts(self):
+        from paddle_tpu.distributed import fleet
+
+        mesh = fleet.get_hybrid_communicate_group().get_mesh()
+        axis = self._ep_axis
+        for p in self.experts.parameters():
+            spec = P(*([axis] + [None] * (len(p.shape) - 1)))
+            p._assign_raw(jax.device_put(p._data, NamedSharding(mesh, spec)))
+
+    def capacity(self, n_tokens: int) -> int:
+        return max(1, int(self.capacity_factor * self.top_k * n_tokens
+                          / self.num_experts))
+
+    def forward(self, x: Tensor) -> Tensor:
+        b, s, m = x.shape
+        n = b * s
+        cap = self.capacity(n)
+        gate_fn = GATES[self.gate_type]
+        top_k = self.top_k
+        axis = self._ep_axis
+        mesh = None
+        if axis is not None:
+            from paddle_tpu.distributed import fleet
+
+            mesh = fleet.get_hybrid_communicate_group().get_mesh()
+
+        def fn(xv, gw):
+            tokens = xv.reshape(n, m)
+            logits = tokens.astype(jnp.float32) @ gw.astype(jnp.float32)
+            combine, dispatch, aux = gate_fn(logits, cap, top_k=top_k)
+            expert_in = jnp.einsum(
+                "nec,nm->ecm", dispatch.astype(xv.dtype), tokens)
+            if mesh is not None:
+                # expert-major layout sharded over ep: the boundary where
+                # XLA emits the token all-to-all
+                expert_in = jax.lax.with_sharding_constraint(
+                    expert_in, NamedSharding(mesh, P(axis, None, None)))
+            return expert_in, combine.astype(xv.dtype), aux
+
+        expert_in, combine, aux = op_call(fn, x, self.gate_weight,
+                                          name="moe_dispatch")
+        expert_out = self.experts(expert_in)
+
+        def fin(eo, comb):
+            if mesh is not None:
+                eo = jax.lax.with_sharding_constraint(
+                    eo, NamedSharding(mesh, P(axis, None, None)))
+            y = jnp.einsum("nec,ecm->nm", comb, eo)
+            return y.reshape(b, s, m)
+
+        out = op_call(fin, expert_out, combine, name="moe_combine")
+        self.l_aux = aux
+        return out

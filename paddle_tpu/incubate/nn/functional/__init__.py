@@ -1,0 +1,74 @@
+"""paddle.incubate.nn.functional — fused-op API surface.
+
+Reference parity: python/paddle/incubate/nn/functional/ (fused_rms_norm.py,
+swiglu.py, fused_rotary_position_embedding.py, fused_moe.py, ...). On the
+reference these bind hand-fused CUDA kernels
+(/root/reference/paddle/phi/kernels/fusion/); here they are the SAME
+computations expressed once in nn.functional — XLA fuses the elementwise
+chains into the surrounding matmuls, and the attention path has its own
+Pallas kernel. The incubate names exist so fused-op user code ports 1:1.
+"""
+from __future__ import annotations
+
+from paddle_tpu.nn import functional as F  # noqa: N812
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                   begin_norm_axis=-1, **kw):
+    out = F.rms_norm(x, norm_weight, epsilon=epsilon, axis=begin_norm_axis)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out, None  # reference returns (out, invvar)
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=-1, **kw):
+    shape = x.shape[begin_norm_axis:] if begin_norm_axis >= 0 \
+        else x.shape[begin_norm_axis:]
+    return F.layer_norm(x, shape, weight=norm_weight, bias=norm_bias,
+                        epsilon=epsilon), None, None
+
+
+def swiglu(x, y=None):
+    return F.swiglu(x, y)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    out = F.rotary_position_embedding(q, k, sin=sin, cos=cos,
+                                      position_ids=position_ids,
+                                      use_neox_rotary_style=use_neox_rotary_style)
+    if v is not None:
+        return (*out, v)
+    return out
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train"):
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    if transpose_weight:
+        weight = weight.T
+    return F.linear(x, weight, bias)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu"):
+    if bias is not None:
+        x = x + bias
+    return getattr(F, act_method)(x)
+
+
+def fused_multi_head_attention(*args, **kwargs):
+    raise NotImplementedError(
+        "fused_multi_head_attention: use "
+        "paddle_tpu.nn.functional.scaled_dot_product_attention (Pallas flash "
+        "kernel on TPU) — the fused QKV+attention+proj megakernel is a CUDA "
+        "artifact; XLA composes the same fusion from the sdpa graph.")
+
+
+__all__ = [
+    "fused_rms_norm", "fused_layer_norm", "swiglu",
+    "fused_rotary_position_embedding", "fused_dropout_add", "fused_linear",
+    "fused_bias_act", "fused_multi_head_attention",
+]

@@ -84,8 +84,16 @@ class _HostTracer:
         with self._lock:
             self.events = []
 
+    def drain(self) -> list:
+        """Atomically take all pending events (no drop window)."""
+        with self._lock:
+            out = self.events
+            self.events = []
+        return out
+
 
 _tracer = _HostTracer()
+_active_profiler = None  # recording is process-global; one owner at a time
 
 
 class RecordEvent:
@@ -169,7 +177,9 @@ def export_chrome_tracing(dir_name: str, worker_name: str | None = None):
     def handle(prof: "Profiler"):
         os.makedirs(dir_name, exist_ok=True)
         name = worker_name or f"host_{os.getpid()}"
-        path = os.path.join(dir_name, f"{name}_{int(time.time())}.json")
+        prof._export_seq = getattr(prof, "_export_seq", 0) + 1
+        path = os.path.join(
+            dir_name, f"{name}_{time.perf_counter_ns()}_{prof._export_seq}.json")
         events = []
         for ev in prof._events:
             events.append({
@@ -272,10 +282,16 @@ class Profiler:
         self._set_recording(rec)
 
     def _set_recording(self, on: bool):
+        global _active_profiler
         from ..core import dispatch
 
         if on and not self._timer_only:
+            if _active_profiler is not None and _active_profiler is not self:
+                raise RuntimeError(
+                    "another paddle_tpu.profiler.Profiler is already recording "
+                    "(recording is process-global); stop it first")
             if not _tracer.enabled:
+                _active_profiler = self
                 _tracer.enabled = True
                 dispatch._profiler_hook = _op_hook
                 if not self._device_tracing:
@@ -287,9 +303,11 @@ class Profiler:
                         self._device_tracing = True
                     except Exception:
                         self._device_tracing = False
-        elif not on and _tracer.enabled:
+        elif not on and _tracer.enabled and _active_profiler is self:
+            self._collect()  # RECORD→CLOSED transitions must not strand events
             _tracer.enabled = False
             dispatch._profiler_hook = None
+            _active_profiler = None
             if self._device_tracing:
                 try:
                     import jax.profiler
@@ -300,9 +318,9 @@ class Profiler:
                 self._device_tracing = False
 
     def _collect(self):
-        self._events.extend(_tracer.events)
-        self._all_events.extend(_tracer.events)
-        _tracer.clear()
+        pending = _tracer.drain()
+        self._events.extend(pending)
+        self._all_events.extend(pending)
 
     # -- reporting
     def summary(self, sorted_by: str = "total", op_detail: bool = True,
@@ -310,7 +328,7 @@ class Profiler:
         unit = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}[time_unit]
         agg: dict[tuple, list] = {}
         for ev in self._all_events:
-            key = (ev.type.name, ev.name)
+            key = (ev.tid if thread_sep else None, ev.type.name, ev.name)
             rec = agg.setdefault(key, [0, 0.0, 0.0, float("inf")])
             d = ev.end - ev.start
             rec[0] += 1
@@ -318,17 +336,25 @@ class Profiler:
             rec[2] = max(rec[2], d)
             rec[3] = min(rec[3], d)
         total = sum(r[1] for r in agg.values()) or 1.0
+        sort_keys = {
+            "total": lambda rec: -rec[1], "max": lambda rec: -rec[2],
+            "min": lambda rec: -rec[3], "calls": lambda rec: -rec[0],
+            "avg": lambda rec: -(rec[1] / rec[0]),
+        }
+        if sorted_by not in sort_keys:
+            raise ValueError(f"sorted_by must be one of {sorted(sort_keys)}")
+        sort_key = sort_keys[sorted_by]
         lines = []
         header = (f"{'Event':<42}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
                   f"{'Avg(' + time_unit + ')':>12}{'Max(' + time_unit + ')':>12}"
                   f"{'Min(' + time_unit + ')':>12}{'Ratio(%)':>10}")
         bar = "-" * len(header)
         lines += [bar, "Profiling Report".center(len(header)), bar, header, bar]
-        order = sorted(agg.items(), key=lambda kv: -kv[1][1])
-        for (etype, name), (calls, tot, mx, mn) in order:
+        order = sorted(agg.items(), key=lambda kv: sort_key(kv[1]))
+        for (tid, etype, name), (calls, tot, mx, mn) in order:
             if not op_detail and etype == "Operator":
                 continue
-            label = f"{etype}::{name}"
+            label = f"{etype}::{name}" if tid is None else f"[t{tid}] {etype}::{name}"
             if len(label) > 40:
                 label = label[:37] + "..."
             lines.append(

@@ -89,12 +89,40 @@ class TestProfiler:
             found += [f for f in files if f.endswith(".xplane.pb")]
         assert found, f"no xplane under {log}"
 
-    def test_hook_uninstalled_after_stop(self):
+    def test_hook_uninstalled_after_stop(self, tmp_path):
         from paddle_tpu.core import dispatch
 
-        with Profiler():
+        with Profiler(log_dir=str(tmp_path / "log")):
             pass
         assert dispatch._profiler_hook is None
+
+    def test_second_concurrent_profiler_rejected(self, tmp_path):
+        import pytest as _pytest
+
+        with Profiler(log_dir=str(tmp_path / "a")):
+            with _pytest.raises(RuntimeError, match="already recording"):
+                Profiler(log_dir=str(tmp_path / "b")).start()
+
+    def test_custom_scheduler_record_to_closed_collects(self, tmp_path):
+        x = paddle.rand([4, 4])
+        p = Profiler(scheduler=lambda s: ProfilerState.RECORD if s == 0
+                     else ProfilerState.CLOSED, log_dir=str(tmp_path / "log"))
+        p.start()
+        paddle.matmul(x, x)
+        p.step()  # RECORD -> CLOSED without RECORD_AND_RETURN
+        p.stop()
+        assert any(e.name == "matmul" for e in p.events)
+
+    def test_summary_sort_keys(self, tmp_path):
+        x = paddle.rand([4, 4])
+        with Profiler(log_dir=str(tmp_path / "log")) as p:
+            paddle.matmul(x, x)
+        for key in ("total", "max", "min", "calls", "avg"):
+            assert "matmul" in p.summary(sorted_by=key)
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="sorted_by"):
+            p.summary(sorted_by="bogus")
 
 
 class TestBenchmarkTimer:
