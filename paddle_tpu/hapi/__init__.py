@@ -1,3 +1,7 @@
-class Model:  # placeholder until hapi lands
-    def __init__(self, *a, **k):
-        raise NotImplementedError("hapi.Model: landing later this round")
+"""paddle.hapi — high-level Model API (≙ python/paddle/hapi)."""
+from . import callbacks
+from .callbacks import Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger
+from .model import Model
+
+__all__ = ["Model", "callbacks", "Callback", "ProgBarLogger", "ModelCheckpoint",
+           "EarlyStopping", "LRScheduler"]

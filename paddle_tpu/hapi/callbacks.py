@@ -1,0 +1,195 @@
+"""hapi training callbacks (≙ python/paddle/hapi/callbacks.py).
+
+ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler — the callback
+hooks fire from Model.fit/evaluate/predict exactly as in the reference
+(config_callbacks assembles the default stack)."""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+
+class Callback:
+    def set_params(self, params):
+        self.params = params or {}
+
+    def set_model(self, model):
+        self.model = model
+
+    # -- train
+    def on_train_begin(self, logs=None): ...
+    def on_train_end(self, logs=None): ...
+    def on_epoch_begin(self, epoch, logs=None): ...
+    def on_epoch_end(self, epoch, logs=None): ...
+    def on_train_batch_begin(self, step, logs=None): ...
+    def on_train_batch_end(self, step, logs=None): ...
+    # -- eval
+    def on_eval_begin(self, logs=None): ...
+    def on_eval_end(self, logs=None): ...
+    def on_eval_batch_begin(self, step, logs=None): ...
+    def on_eval_batch_end(self, step, logs=None): ...
+    # -- predict
+    def on_predict_begin(self, logs=None): ...
+    def on_predict_end(self, logs=None): ...
+    def on_predict_batch_begin(self, step, logs=None): ...
+    def on_predict_batch_end(self, step, logs=None): ...
+
+
+class CallbackList:
+    def __init__(self, callbacks, model, params):
+        self.callbacks = list(callbacks)
+        for cb in self.callbacks:
+            cb.set_model(model)
+            cb.set_params(params)
+
+    def call(self, hook, *args):
+        for cb in self.callbacks:
+            getattr(cb, hook)(*args)
+
+
+class ProgBarLogger(Callback):
+    """Per-epoch progress line with smoothed metrics (≙ callbacks.py ProgBarLogger)."""
+
+    def __init__(self, log_freq: int = 1, verbose: int = 2):
+        self.log_freq = log_freq
+        self.verbose = verbose
+        self.steps = None
+        self.epoch = 0
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.steps = self.params.get("steps")
+        self._t0 = time.time()
+        if self.verbose and self.params.get("epochs"):
+            print(f"Epoch {epoch + 1}/{self.params['epochs']}", file=sys.stderr)
+
+    def _line(self, step, logs):
+        items = [f"step {step + 1}" + (f"/{self.steps}" if self.steps else "")]
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple)):
+                v = v[0] if v else 0.0
+            if isinstance(v, (int, float, np.floating)):
+                items.append(f"{k}: {float(v):.4f}")
+        return " - ".join(items)
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose == 2 and (step + 1) % self.log_freq == 0:
+            print(self._line(step, logs), file=sys.stderr)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._t0
+            print(self._line(self.params.get("steps", 1) - 1 if self.params.get("steps") else 0, logs)
+                  + f" - {dt:.2f}s", file=sys.stderr)
+
+    def on_eval_begin(self, logs=None):
+        self.eval_steps = (logs or {}).get("steps")
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            print("Eval " + self._line((self.eval_steps or 1) - 1, logs),
+                  file=sys.stderr)
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq: int = 1, save_dir: str = "checkpoint"):
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and epoch % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        if mode == "auto":
+            mode = "min" if "loss" in monitor else "max"
+        self.mode = mode
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.best = baseline  # baseline seeds the initial best (reference semantics)
+
+    def _better(self, cur, ref):
+        if ref is None:
+            return True
+        delta = self.min_delta if self.mode == "max" else -self.min_delta
+        return cur > ref + delta if self.mode == "max" else cur < ref + delta
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:  # evaluate() prefixes loss keys with "eval_"
+            cur = logs.get("eval_" + self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        cur = float(cur)
+        if self._better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+            if self.save_best_model and getattr(self.model, "_save_dir", None):
+                self.model.save(os.path.join(self.model._save_dir, "best_model"))
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.model.stop_training = True
+                if self.verbose:
+                    print(f"EarlyStopping: no {self.monitor} improvement for "
+                          f"{self.wait} evals, stopping", file=sys.stderr)
+
+
+class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler (by_step or by_epoch)."""
+
+    def __init__(self, by_step: bool = True, by_epoch: bool = False):
+        if by_step and by_epoch:
+            raise ValueError("by_step and by_epoch are mutually exclusive")
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+
+def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
+                     log_freq=1, verbose=2, save_freq=1, save_dir=None,
+                     metrics=None, mode="train"):
+    cbks = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)] + cbks
+    if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
+    params = {"epochs": epochs, "steps": steps, "verbose": verbose,
+              "metrics": metrics or []}
+    return CallbackList(cbks, model, params)

@@ -1,0 +1,292 @@
+"""hapi.Model — Keras-style fit/evaluate/predict.
+
+Reference parity: python/paddle/hapi/model.py:1472 (class Model): prepare()
+binds optimizer/loss/metrics, fit() drives DataLoader epochs with the
+callback stack, train_batch/eval_batch/predict_batch are the single-step
+primitives, save/load wrap state dicts. The reference's dual
+dygraph/static-graph adapters collapse here: eager mode IS the XLA path
+(per-op compiled executables), and `paddle.jit.to_static` can wrap the
+whole network independently.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .callbacks import config_callbacks
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _to_tensor(x):
+    import paddle_tpu as paddle
+
+    if isinstance(x, Tensor):
+        return x
+    return paddle.to_tensor(np.asarray(x))
+
+
+class Model:
+    """model = paddle.Model(network); model.prepare(opt, loss, metrics);
+    model.fit(train_dataset, eval_dataset, epochs=2, batch_size=64)."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+        self._save_dir = None
+
+    # ------------------------------------------------------------ setup
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        if loss is not None and not callable(loss):
+            raise TypeError("loss must be callable (a Loss layer or function)")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        return self
+
+    def parameters(self, include_sublayers=True):
+        return self.network.parameters()
+
+    # ------------------------------------------------------------ batches
+    def train_batch(self, inputs, labels=None, update=True):
+        import paddle_tpu as paddle
+
+        self.network.train()
+        inputs = [_to_tensor(v) for v in _to_list(inputs)]
+        labels = [_to_tensor(v) for v in _to_list(labels)]
+        outputs = self.network(*inputs)
+        losses = self._loss(*(_to_list(outputs) + labels)) if self._loss \
+            else outputs
+        loss_list = _to_list(losses)
+        total = loss_list[0]
+        for extra in loss_list[1:]:
+            total = total + extra
+        total.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return ([float(l.numpy()) for l in loss_list], metrics) if metrics \
+            else [float(l.numpy()) for l in loss_list]
+
+    def eval_batch(self, inputs, labels=None):
+        from ..core.dispatch import no_grad
+
+        self.network.eval()
+        with no_grad():
+            inputs = [_to_tensor(v) for v in _to_list(inputs)]
+            labels = [_to_tensor(v) for v in _to_list(labels)]
+            outputs = self.network(*inputs)
+            loss_list = []
+            if self._loss:
+                losses = self._loss(*(_to_list(outputs) + labels))
+                loss_list = [float(l.numpy()) for l in _to_list(losses)]
+            metrics = self._update_metrics(outputs, labels)
+        return (loss_list, metrics) if metrics else loss_list
+
+    def predict_batch(self, inputs):
+        from ..core.dispatch import no_grad
+
+        self.network.eval()
+        with no_grad():
+            inputs = [_to_tensor(v) for v in _to_list(inputs)]
+            outputs = self.network(*inputs)
+        return [o.numpy() for o in _to_list(outputs)]
+
+    def _update_metrics(self, outputs, labels):
+        res = []
+        for m in self._metrics:
+            pred = _to_list(outputs)[0]
+            stat = m.compute(pred, *labels)
+            res.append(m.update(stat))
+        return res
+
+    # ------------------------------------------------------------ loops
+    def _loader(self, data, batch_size, shuffle, num_workers, drop_last=False):
+        from ..io import DataLoader, Dataset
+
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = self._loader(train_data, batch_size, shuffle, num_workers,
+                              drop_last)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        self._save_dir = save_dir
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, log_freq=log_freq, verbose=verbose,
+                                save_freq=save_freq, save_dir=save_dir,
+                                metrics=[m.name() for m in self._metrics])
+        self.stop_training = False
+        cbks.call("on_train_begin")
+        logs = {}
+        for epoch in range(epochs):
+            cbks.call("on_epoch_begin", epoch)
+            for m in self._metrics:
+                m.reset()
+            updated = True
+            for step, batch in enumerate(loader):
+                cbks.call("on_train_batch_begin", step)
+                ins, labs = self._split_batch(batch)
+                updated = (step + 1) % accumulate_grad_batches == 0
+                result = self.train_batch(ins, labs, update=updated)
+                logs = self._logs(result)
+                cbks.call("on_train_batch_end", step, logs)
+                if num_iters is not None and step + 1 >= num_iters:
+                    break
+            if not updated and self._optimizer is not None:
+                # flush a trailing partial accumulation group so stale grads
+                # never leak into the next epoch
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+            cbks.call("on_epoch_end", epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=0,
+                              num_workers=num_workers, callbacks=cbks)
+            if self.stop_training:
+                break
+        cbks.call("on_train_end", logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._loader(eval_data, batch_size, False, num_workers)
+        cbks = callbacks if hasattr(callbacks, "call") else config_callbacks(
+            callbacks, model=self, verbose=verbose,
+            metrics=[m.name() for m in self._metrics])
+        for m in self._metrics:
+            m.reset()
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks.call("on_eval_begin", {"steps": steps})
+        logs = {}
+        for step, batch in enumerate(loader):
+            cbks.call("on_eval_batch_begin", step)
+            ins, labs = self._split_batch(batch)
+            result = self.eval_batch(ins, labs)
+            logs = self._logs(result, prefix="eval_")
+            cbks.call("on_eval_batch_end", step, logs)
+        final = {}
+        for m in self._metrics:
+            final[m.name()] = m.accumulate()
+        final.update({k: v for k, v in logs.items() if k.startswith("eval_loss")})
+        cbks.call("on_eval_end", final)
+        return final
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                callbacks=None, verbose=1):
+        loader = self._loader(test_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, verbose=0)
+        cbks.call("on_predict_begin")
+        outputs = []
+        for step, batch in enumerate(loader):
+            cbks.call("on_predict_batch_begin", step)
+            ins, _ = self._split_batch(batch, has_labels=False)
+            outs = self.predict_batch(ins)
+            outputs.append(outs)
+            cbks.call("on_predict_batch_end", step)
+        cbks.call("on_predict_end")
+        # transpose list-of-batches -> per-output lists
+        n_out = len(outputs[0]) if outputs else 0
+        result = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            result = [np.concatenate(r, axis=0) for r in result]
+        return result
+
+    def _n_inputs(self):
+        """How many positional inputs the network's forward takes: from the
+        `inputs` spec when given, else the forward signature (≙ reference
+        using InputSpec to split data from labels, model.py _update_inputs)."""
+        if self._inputs is not None:
+            return len(_to_list(self._inputs))
+        import inspect
+
+        try:
+            sig = inspect.signature(self.network.forward)
+            n = 0
+            for p in sig.parameters.values():
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD) \
+                        and p.default is p.empty and p.name != "self":
+                    n += 1
+            return max(1, n)
+        except (TypeError, ValueError):
+            return 1
+
+    def _split_batch(self, batch, has_labels=True):
+        batch = batch if isinstance(batch, (list, tuple)) else [batch]
+        n_in = self._n_inputs()
+        if not has_labels:
+            return list(batch[:n_in]), []
+        return list(batch[:n_in]), list(batch[n_in:])
+
+    def _logs(self, result, prefix=""):
+        logs = {}
+        if isinstance(result, tuple):
+            losses, metrics = result
+            logs[prefix + "loss"] = losses
+            for m, v in zip(self._metrics, metrics):
+                logs[prefix + m.name()] = v
+        else:
+            logs[prefix + "loss"] = result
+        return logs
+
+    # ------------------------------------------------------------ persistence
+    def save(self, path, training=True):
+        from ..framework_io import save as _save
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework_io import load as _load
+
+        state = _load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_load(opt_path))
+        return self
+
+    # ------------------------------------------------------------ summary
+    def summary(self, input_size=None, dtype=None):
+        if input_size is not None:
+            from .summary import summary as _summary
+
+            return _summary(self.network, input_size, dtype)
+        rows, total, trainable = [], 0, 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape))
+            total += n
+            if not p.stop_gradient:
+                trainable += n
+            rows.append((name, tuple(p.shape), n))
+        width = max((len(r[0]) for r in rows), default=10) + 2
+        lines = [f"{'Layer (param)':<{width}}{'Shape':<20}{'Params':>12}",
+                 "-" * (width + 32)]
+        for name, shape, n in rows:
+            lines.append(f"{name:<{width}}{str(shape):<20}{n:>12,}")
+        lines.append("-" * (width + 32))
+        lines.append(f"Total params: {total:,}")
+        print("\n".join(lines))
+        return {"total_params": total, "trainable_params": trainable}

@@ -1,1 +1,6 @@
+"""paddle.vision — models, transforms, datasets."""
+from . import datasets
+from . import models
+from . import transforms
 
+__all__ = ["models", "transforms", "datasets"]

@@ -1,0 +1,3 @@
+from .datasets import MNIST, Cifar10, Cifar100, FakeData, FashionMNIST
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]

@@ -1,0 +1,169 @@
+"""Vision datasets (≙ python/paddle/vision/datasets/{mnist,cifar}.py).
+
+Local-file readers only — this environment has zero network egress, so
+`download=True` raises with instructions instead of fetching. `FakeData`
+provides deterministic synthetic images with the same interface for
+smoke tests and benchmarks (the role of the reference's fake readers in
+test/legacy_test).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+
+class _VisionDataset(Dataset):
+    def __init__(self, transform=None, backend="numpy"):
+        self.transform = transform
+        self.backend = backend
+
+    def _apply(self, img, label):
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+def _no_download(name, url_hint):
+    raise RuntimeError(
+        f"{name}: download is not available in this environment; place the "
+        f"original files ({url_hint}) locally and pass the path(s).")
+
+
+class MNIST(_VisionDataset):
+    """IDX-format MNIST reader. Pass image_path/label_path to the (optionally
+    gzipped) ubyte files."""
+
+    NAME = "MNIST"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend="numpy"):
+        super().__init__(transform, backend)
+        self.mode = mode
+        if image_path is None or label_path is None:
+            if download:
+                _no_download(self.NAME, "train-images-idx3-ubyte.gz etc.")
+            raise ValueError(
+                f"{self.NAME}: image_path and label_path are required "
+                "(no-network environment)")
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise ValueError(f"bad IDX image magic {magic} in {path}")
+            data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise ValueError(f"bad IDX label magic {magic} in {path}")
+            return np.frombuffer(f.read(n), dtype=np.uint8).astype("int64")
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        return self._apply(img, int(self.labels[idx]))
+
+
+class FashionMNIST(MNIST):
+    NAME = "FashionMNIST"
+
+
+class Cifar10(_VisionDataset):
+    """Reads the python-pickle CIFAR tarball (cifar-10-python.tar.gz) or an
+    extracted directory."""
+
+    _TRAIN_FILES = [f"data_batch_{i}" for i in range(1, 6)]
+    _TEST_FILES = ["test_batch"]
+    _LABEL_KEY = b"labels"
+    NAME = "Cifar10"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="numpy"):
+        super().__init__(transform, backend)
+        if data_file is None:
+            if download:
+                _no_download(self.NAME, "cifar-10-python.tar.gz")
+            raise ValueError(f"{self.NAME}: data_file is required")
+        names = self._TRAIN_FILES if mode == "train" else self._TEST_FILES
+        images, labels = [], []
+        for raw in self._iter_batches(data_file, names):
+            batch = pickle.loads(raw, encoding="bytes")
+            images.append(np.asarray(batch[b"data"], np.uint8))
+            labels.extend(batch[self._LABEL_KEY])
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32) \
+            .transpose(0, 2, 3, 1)  # HWC
+        self.labels = np.asarray(labels, "int64")
+
+    def _iter_batches(self, data_file, names):
+        found = {}
+        if os.path.isdir(data_file):
+            for root, _d, files in os.walk(data_file):
+                for n in names:
+                    if n in files and n not in found:
+                        with open(os.path.join(root, n), "rb") as f:
+                            found[n] = f.read()
+        else:
+            with tarfile.open(data_file) as tf:
+                for m in tf.getmembers():
+                    base = os.path.basename(m.name)
+                    if base in names and base not in found:
+                        found[base] = tf.extractfile(m).read()
+        missing = [n for n in names if n not in found]
+        if missing:
+            raise FileNotFoundError(
+                f"{self.NAME}: batch files {missing} not found in {data_file}")
+        for n in names:  # deterministic order
+            yield found[n]
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        return self._apply(self.images[idx], int(self.labels[idx]))
+
+
+class Cifar100(Cifar10):
+    _TRAIN_FILES = ["train"]
+    _TEST_FILES = ["test"]
+    _LABEL_KEY = b"fine_labels"
+    NAME = "Cifar100"
+
+
+class FakeData(_VisionDataset):
+    """Deterministic synthetic image dataset: FakeData(1000, (1, 28, 28), 10)."""
+
+    def __init__(self, size=1000, image_shape=(3, 32, 32), num_classes=10,
+                 transform=None, seed=0, data_format="CHW"):
+        super().__init__(transform)
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.seed = seed
+        self.data_format = data_format
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rs = np.random.RandomState(self.seed + idx)
+        img = rs.randn(*self.image_shape).astype("float32")
+        label = int(rs.randint(0, self.num_classes))
+        return self._apply(img, label)

@@ -1,0 +1,328 @@
+"""Vision transforms (≙ python/paddle/vision/transforms/transforms.py).
+
+Pure-numpy implementations over HWC uint8/float arrays (no PIL dependency —
+PIL images are converted on entry if passed). Output convention matches
+paddle: ToTensor -> CHW float32 in [0, 1].
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+
+def _as_array(img):
+    if isinstance(img, np.ndarray):
+        return img
+    # PIL.Image or anything exposing __array__
+    return np.asarray(img)
+
+
+def _size_pair(size):
+    if isinstance(size, numbers.Number):
+        return int(size), int(size)
+    return int(size[0]), int(size[1])
+
+
+# ------------------------------------------------------------- functional
+def to_tensor(img, data_format="CHW"):
+    import paddle_tpu as paddle
+
+    arr = _as_array(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.dtype == np.uint8:
+        arr = arr.astype("float32") / 255.0
+    else:
+        arr = arr.astype("float32")
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return paddle.to_tensor(arr)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = _as_array(img).astype("float32")
+    return _np_normalize(arr, mean, std, data_format)
+
+
+def _np_normalize(arr, mean, std, data_format="CHW"):
+    mean = np.asarray(mean, "float32")
+    std = np.asarray(std, "float32")
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    return (arr - mean) / std
+
+
+def resize(img, size, interpolation="bilinear"):
+    """Nearest/bilinear resize on HWC numpy arrays (no cv2/PIL)."""
+    arr = _as_array(img)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    h, w = arr.shape[:2]
+    if isinstance(size, numbers.Number):
+        # paddle semantics: smaller edge -> size, keep aspect
+        if h <= w:
+            nh, nw = int(size), max(1, int(round(w * size / h)))
+        else:
+            nh, nw = max(1, int(round(h * size / w))), int(size)
+    else:
+        nh, nw = _size_pair(size)
+    if interpolation == "nearest":
+        ri = (np.arange(nh) * h / nh).astype(int).clip(0, h - 1)
+        ci = (np.arange(nw) * w / nw).astype(int).clip(0, w - 1)
+        out = arr[ri][:, ci]
+    else:  # bilinear
+        ry = (np.arange(nh) + 0.5) * h / nh - 0.5
+        rx = (np.arange(nw) + 0.5) * w / nw - 0.5
+        y0 = np.clip(np.floor(ry).astype(int), 0, h - 1)
+        x0 = np.clip(np.floor(rx).astype(int), 0, w - 1)
+        y1 = np.clip(y0 + 1, 0, h - 1)
+        x1 = np.clip(x0 + 1, 0, w - 1)
+        wy = np.clip(ry - y0, 0, 1)[:, None, None]
+        wx = np.clip(rx - x0, 0, 1)[None, :, None]
+        a = arr.astype("float32")
+        out = ((a[y0][:, x0] * (1 - wy) * (1 - wx)) + (a[y1][:, x0] * wy * (1 - wx))
+               + (a[y0][:, x1] * (1 - wy) * wx) + (a[y1][:, x1] * wy * wx))
+        if arr.dtype == np.uint8:
+            out = np.clip(np.round(out), 0, 255).astype(np.uint8)
+        else:
+            out = out.astype(arr.dtype)
+    return out[:, :, 0] if squeeze else out
+
+
+def hflip(img):
+    return _as_array(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_array(img)[::-1]
+
+
+def crop(img, top, left, height, width):
+    return _as_array(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _as_array(img)
+    th, tw = _size_pair(output_size)
+    h, w = arr.shape[:2]
+    top = max(0, (h - th) // 2)
+    left = max(0, (w - tw) // 2)
+    return crop(arr, top, left, th, tw)
+
+
+# ------------------------------------------------------------- transforms
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    """Operates on numpy arrays or Tensors; CHW by default (after ToTensor)."""
+
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean = list(mean)
+        self.std = list(std)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        from ...core.tensor import Tensor
+
+        if isinstance(img, Tensor):
+            arr = img.numpy()
+            out = _np_normalize(arr, self.mean[:arr.shape[0]] if self.data_format == "CHW"
+                                else self.mean, self.std[:arr.shape[0]] if self.data_format == "CHW"
+                                else self.std, self.data_format)
+            import paddle_tpu as paddle
+
+            return paddle.to_tensor(out.astype("float32"))
+        arr = _as_array(img).astype("float32")
+        c = arr.shape[0] if self.data_format == "CHW" else arr.shape[-1]
+        return _np_normalize(arr, self.mean[:c], self.std[:c], self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = _size_pair(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _as_array(img)
+        th, tw = self.size
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) \
+                else [self.padding] * 4
+            arr = np.pad(arr, [(p[1], p[3]), (p[0], p[2])] +
+                         [(0, 0)] * (arr.ndim - 2), constant_values=self.fill)
+        h, w = arr.shape[:2]
+        if self.pad_if_needed and (h < th or w < tw):
+            ph, pw = max(0, th - h), max(0, tw - w)
+            arr = np.pad(arr, [(0, ph), (0, pw)] + [(0, 0)] * (arr.ndim - 2),
+                         constant_values=self.fill)
+            h, w = arr.shape[:2]
+        top = random.randint(0, h - th)
+        left = random.randint(0, w - tw)
+        return crop(arr, top, left, th, tw)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if random.random() < self.prob else _as_array(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if random.random() < self.prob else _as_array(img)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = _size_pair(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _as_array(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * random.uniform(*self.scale)
+            ar = random.uniform(*self.ratio)
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = random.randint(0, h - ch)
+                left = random.randint(0, w - cw)
+                patch = crop(arr, top, left, ch, cw)
+                return resize(patch, self.size, self.interpolation)
+        return resize(center_crop(arr, min(h, w)), self.size, self.interpolation)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        p = padding if isinstance(padding, (list, tuple)) else [padding] * 4
+        if len(p) == 2:
+            p = [p[0], p[1], p[0], p[1]]
+        self.padding = p
+        self.fill = fill
+        self.mode = padding_mode
+
+    def _apply_image(self, img):
+        arr = _as_array(img)
+        p = self.padding
+        pad = [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2)
+        if self.mode == "constant":
+            return np.pad(arr, pad, constant_values=self.fill)
+        return np.pad(arr, pad, mode=self.mode)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = _as_array(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr.transpose(self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _as_array(img)
+        arr = _as_array(img).astype("float32")
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        out = arr * factor
+        return np.clip(out, 0, 255).astype(np.uint8) if _as_array(img).dtype == np.uint8 \
+            else out
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _as_array(img)
+        arr = _as_array(img).astype("float32")
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        mean = arr.mean()
+        out = (arr - mean) * factor + mean
+        return np.clip(out, 0, 255).astype(np.uint8) if _as_array(img).dtype == np.uint8 \
+            else out

@@ -1,0 +1,214 @@
+"""hapi.Model + vision transforms/datasets tests.
+
+Reference parity model: python/paddle/hapi/model.py:1472 fit/evaluate/predict
+semantics and vision/transforms behavior.
+"""
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import EarlyStopping, ProgBarLogger
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision.datasets import Cifar10, FakeData, MNIST
+
+
+def _small_model():
+    return nn.Sequential(nn.Flatten(), nn.Linear(28 * 28, 32), nn.ReLU(),
+                         nn.Linear(32, 10))
+
+
+class TestModel:
+    def _prepared(self, lr=1e-2):
+        paddle.seed(0)
+        m = paddle.Model(_small_model())
+        m.prepare(paddle.optimizer.Adam(learning_rate=lr, parameters=m.parameters()),
+                  paddle.nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+        return m
+
+    def test_fit_decreases_loss(self):
+        m = self._prepared()
+        data = FakeData(128, (1, 28, 28), 10, seed=1)
+        first = m.train_batch([data[0][0][None]], [np.array([data[0][1]])])
+        m.fit(data, epochs=3, batch_size=32, verbose=0)
+        last = m.train_batch([data[0][0][None]], [np.array([data[0][1]])])
+        assert last[0][0] < first[0][0]
+
+    def test_evaluate_returns_metrics(self):
+        m = self._prepared()
+        res = m.evaluate(FakeData(64, (1, 28, 28), 10), batch_size=16, verbose=0)
+        assert "acc" in res and 0.0 <= res["acc"] <= 1.0
+
+    def test_predict_stacked(self):
+        m = self._prepared()
+        out = m.predict(FakeData(40, (1, 28, 28), 10), batch_size=16,
+                        stack_outputs=True)
+        assert out[0].shape == (40, 10)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        m = self._prepared()
+        data = FakeData(32, (1, 28, 28), 10)
+        m.fit(data, epochs=1, batch_size=16, verbose=0)
+        path = str(tmp_path / "ckpt" / "model")
+        m.save(path)
+        assert os.path.exists(path + ".pdparams")
+        assert os.path.exists(path + ".pdopt")
+
+        m2 = self._prepared()
+        m2.load(path)
+        x = paddle.to_tensor(np.ones((2, 1, 28, 28), "float32"))
+        np.testing.assert_allclose(m.network(x).numpy(), m2.network(x).numpy(),
+                                   rtol=1e-6)
+
+    def test_early_stopping_stops(self):
+        m = self._prepared(lr=0.0)  # no learning: eval loss never improves
+        data = FakeData(32, (1, 28, 28), 10)
+        stopper = EarlyStopping(monitor="acc", mode="max", patience=1,
+                                verbose=0, save_best_model=False)
+        m.fit(data, eval_data=data, epochs=6, batch_size=16, verbose=0,
+              callbacks=[stopper])
+        assert m.stop_training
+
+    def test_callbacks_fire_in_order(self):
+        events = []
+
+        class Spy(paddle.hapi.Callback):
+            def on_train_begin(self, logs=None):
+                events.append("train_begin")
+
+            def on_epoch_begin(self, epoch, logs=None):
+                events.append(f"epoch{epoch}")
+
+            def on_train_batch_end(self, step, logs=None):
+                events.append("batch")
+
+            def on_train_end(self, logs=None):
+                events.append("train_end")
+
+        m = self._prepared()
+        m.fit(FakeData(32, (1, 28, 28), 10), epochs=2, batch_size=16,
+              verbose=0, callbacks=[Spy()])
+        assert events[0] == "train_begin" and events[-1] == "train_end"
+        assert events.count("batch") == 4 and "epoch1" in events
+
+    def test_summary_counts_params(self, capsys):
+        m = paddle.Model(_small_model())
+        info = m.summary()
+        expect = (28 * 28 * 32 + 32) + (32 * 10 + 10)
+        assert info["total_params"] == expect
+
+    def test_paddle_summary_api(self, capsys):
+        net = _small_model()
+        info = paddle.summary(net, (1, 1, 28, 28))
+        assert info["total_params"] == (28 * 28 * 32 + 32) + (32 * 10 + 10)
+        assert "Linear" in capsys.readouterr().out
+
+
+class TestTransforms:
+    def test_to_tensor_chw_scaling(self):
+        img = (np.arange(12, dtype=np.uint8).reshape(2, 2, 3) * 20)
+        t = T.ToTensor()(img)
+        assert t.shape == [3, 2, 2]
+        assert float(t.numpy().max()) <= 1.0
+
+    def test_normalize(self):
+        arr = np.ones((3, 4, 4), "float32")
+        out = T.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5])(arr)
+        np.testing.assert_allclose(out, np.ones_like(arr))
+
+    def test_resize_shapes(self):
+        img = np.zeros((10, 20, 3), np.uint8)
+        assert T.Resize((5, 7))(img).shape == (5, 7, 3)
+        # scalar: short edge -> 5, aspect kept
+        assert T.Resize(5)(img).shape == (5, 10, 3)
+
+    def test_resize_bilinear_values(self):
+        img = np.array([[0.0, 10.0], [20.0, 30.0]], "float32")
+        out = T.resize(img, (4, 4), "bilinear")
+        assert out.shape == (4, 4)
+        assert out[0, 0] == pytest.approx(0.0, abs=1e-5)
+        assert out[-1, -1] == pytest.approx(30.0, abs=1e-5)
+        assert np.all(np.diff(out, axis=1) >= -1e-5)
+
+    def test_crops_and_flips(self):
+        img = np.arange(25, dtype=np.uint8).reshape(5, 5)
+        assert T.CenterCrop(3)(img).shape == (3, 3)
+        np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+        np.testing.assert_array_equal(T.vflip(img), img[::-1])
+        assert T.RandomCrop(3)(img).shape == (3, 3)
+        assert T.RandomResizedCrop(4)(np.zeros((8, 8, 3), np.uint8)).shape == (4, 4, 3)
+
+    def test_compose_pipeline(self):
+        tf = T.Compose([T.Resize((8, 8)), T.ToTensor(),
+                        T.Normalize([0.5], [0.5], data_format="CHW")])
+        out = tf(np.zeros((16, 16), np.uint8))
+        assert out.shape == [1, 8, 8]
+
+    def test_pad(self):
+        img = np.ones((2, 2), np.uint8)
+        assert T.Pad(1)(img).shape == (4, 4)
+        assert T.Pad([1, 2])(img).shape == (6, 4)  # (left/right=1, top/bottom=2)
+
+
+class TestDatasets:
+    def test_fake_data_deterministic(self):
+        a = FakeData(10, (1, 8, 8), 5, seed=3)
+        b = FakeData(10, (1, 8, 8), 5, seed=3)
+        ia, la = a[4]
+        ib, lb = b[4]
+        np.testing.assert_array_equal(ia, ib)
+        assert la == lb
+
+    def test_mnist_idx_reader(self, tmp_path):
+        # write a 4-image IDX pair (gzipped) and read it back
+        rs = np.random.RandomState(0)
+        imgs = rs.randint(0, 255, (4, 28, 28)).astype(np.uint8)
+        labels = np.array([3, 1, 4, 1], np.uint8)
+        ip = str(tmp_path / "imgs.gz")
+        lp = str(tmp_path / "labels.gz")
+        with gzip.open(ip, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 4, 28, 28))
+            f.write(imgs.tobytes())
+        with gzip.open(lp, "wb") as f:
+            f.write(struct.pack(">II", 2049, 4))
+            f.write(labels.tobytes())
+
+        ds = MNIST(image_path=ip, label_path=lp,
+                   transform=T.Compose([T.ToTensor()]))
+        assert len(ds) == 4
+        img, lab = ds[2]
+        assert img.shape == [1, 28, 28] and lab == 4
+        np.testing.assert_allclose(img.numpy()[0], imgs[2] / 255.0, rtol=1e-6)
+
+    def test_cifar_pickle_reader(self, tmp_path):
+        import pickle
+        import tarfile
+
+        rs = np.random.RandomState(1)
+        data = rs.randint(0, 255, (6, 3 * 32 * 32)).astype(np.uint8)
+        batch = {b"data": data, b"labels": list(range(6))}
+        d = tmp_path / "cifar-10-batches-py"
+        d.mkdir()
+        with open(d / "test_batch", "wb") as f:
+            pickle.dump(batch, f)
+        ds = Cifar10(data_file=str(tmp_path), mode="test")
+        assert len(ds) == 6
+        img, lab = ds[5]
+        assert img.shape == (32, 32, 3) and lab == 5
+
+    def test_download_raises_helpfully(self):
+        with pytest.raises((RuntimeError, ValueError), match="MNIST"):
+            MNIST(download=True)
+
+    def test_dataloader_integration(self):
+        from paddle_tpu.io import DataLoader
+
+        ds = FakeData(20, (3, 8, 8), 4)
+        dl = DataLoader(ds, batch_size=8, drop_last=True)
+        batches = list(dl)
+        assert len(batches) == 2
+        assert batches[0][0].shape == [8, 3, 8, 8]
