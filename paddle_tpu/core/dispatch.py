@@ -111,14 +111,19 @@ def trace_context(ctx: TraceContext):
 class GradNode:
     """One recorded op on the tape (≙ GradNodeBase, grad_node_info.h:197)."""
 
-    __slots__ = ("vjp_fn", "inputs", "out_avals", "single_out", "name", "__weakref__")
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "single_out", "name",
+                 "diff_idx", "__weakref__")
 
-    def __init__(self, vjp_fn, inputs, out_avals, single_out, name):
+    def __init__(self, vjp_fn, inputs, out_avals, single_out, name,
+                 diff_idx=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # list[Tensor] — differentiable inputs, positional
         self.out_avals = out_avals  # list[(shape, dtype)]
         self.single_out = single_out
         self.name = name
+        # original arg positions of `inputs` (zero-bubble dW/dX split rules
+        # need to know which operand is the activation vs the weight)
+        self.diff_idx = diff_idx
 
 
 _amp_dtype_for = None
@@ -413,7 +418,8 @@ def _op_call_impl(fn: Callable, *args, name: str | None = None, n_diff: int | No
             single = not isinstance(out, (tuple, list))
             outs = [out] if single else list(out)
             avals = [(o.shape, o.dtype) for o in outs]
-            node = GradNode(vjp_fn, [args[i] for i in diff_idx], avals, single, name)
+            node = GradNode(vjp_fn, [args[i] for i in diff_idx], avals, single, name,
+                            diff_idx=list(diff_idx))
             return _wrap_outputs(out, node, name)
 
     if len(diff_idx) == len(datas):
@@ -433,7 +439,8 @@ def _op_call_impl(fn: Callable, *args, name: str | None = None, n_diff: int | No
     single = not isinstance(out, (tuple, list))
     outs = [out] if single else list(out)
     avals = [(o.shape, o.dtype) for o in outs]
-    node = GradNode(vjp_fn, [args[i] for i in diff_idx], avals, single, name)
+    node = GradNode(vjp_fn, [args[i] for i in diff_idx], avals, single, name,
+                    diff_idx=list(diff_idx))
     return _wrap_outputs(out, node, name)
 
 

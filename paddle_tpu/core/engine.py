@@ -28,7 +28,79 @@ def _accum(slot, value):
     return value if slot is None else slot + value
 
 
-def run_backward(root: Tensor, grad_tensor=None, retain_graph: bool = False):
+# ------------------------------------------------- zero-bubble dW/dX split
+# Reference parity: the zero-bubble pipeline pass splits matmul_grad into a
+# dX job (critical path: feeds the previous stage's backward) and a dW job
+# (fills the bubble later) —
+# /root/reference/python/paddle/distributed/passes/pipeline_scheduler_pass/
+# pipeline_zero_bubble.py:62,151. Here the split is a VJP-rule override: for
+# weight-bearing ops the engine computes the activation grad immediately and
+# defers a thunk computing the weight grads. Compiled once via jax.jit.
+
+_dx_linear = jax.jit(lambda g, w: g @ w.T)
+_dw_linear = jax.jit(
+    lambda x, g: jnp.einsum("ni,no->io", x.reshape(-1, x.shape[-1]),
+                            g.reshape(-1, g.shape[-1])))
+_db_linear = jax.jit(lambda g: g.reshape(-1, g.shape[-1]).sum(0))
+
+
+def _split_linear(node, cot):
+    """Split rule for F.linear(x, w[, b]) where w/b are graph leaves.
+
+    Returns (in_grads aligned with node.inputs, deferred thunks) or None
+    when the node isn't splittable (x not differentiated, or the weight is
+    itself a non-leaf — e.g. tied/derived weights need the fused vjp)."""
+    # positions must be exactly (x=0, w=1[, b=2]): a frozen weight with a
+    # trainable bias (diff_idx [0, 2]) would misalign inputs[1] onto the bias
+    if node.diff_idx not in ([0, 1], [0, 1, 2]):
+        return None
+    weights = node.inputs[1:]
+    if not weights or any(t._node is not None for t in weights):
+        return None
+    x = node.inputs[0]
+    xd = x._data
+    in_grads = [_dx_linear(cot, weights[0]._data)]
+    thunks = []
+    w = weights[0]
+    thunks.append((w, lambda _x=xd, _g=cot: _dw_linear(_x, _g)))
+    in_grads.append(None)
+    if len(weights) > 1:  # bias
+        b = weights[1]
+        thunks.append((b, lambda _g=cot: _db_linear(_g)))
+        in_grads.append(None)
+    return in_grads, thunks
+
+
+#: op name -> split rule. matmul/einsum variants can register here too; the
+#: transformer hot path (every Linear) is what zero-bubble needs.
+SPLIT_VJP_RULES = {"linear": _split_linear}
+
+
+def flush_deferred(deferred: list) -> int:
+    """Run deferred dW thunks, accumulating into parameter .grad (the
+    bubble-filling phase of the zero-bubble schedule). Returns #thunks."""
+    n = 0
+    with no_grad():
+        for t, thunk in deferred:
+            g = thunk()
+            for hook in t._hooks:
+                out = hook(Tensor(g, _internal=True))
+                if out is not None:
+                    g = out._data if isinstance(out, Tensor) else out
+            if not t.stop_gradient:
+                t._grad = Tensor(
+                    _accum(t._grad._data if t._grad is not None else None, g),
+                    _internal=True)
+            n += 1
+    deferred.clear()
+    return n
+
+
+def run_backward(root: Tensor, grad_tensor=None, retain_graph: bool = False,
+                 deferred: list | None = None):
+    """deferred: when a list is passed, weight grads of splittable ops are
+    NOT computed now — (param, thunk) pairs are appended for a later
+    flush_deferred() call (zero-bubble dX phase)."""
     if root.stop_gradient:
         raise RuntimeError(
             "Tensor.backward() on a tensor with stop_gradient=True — nothing to do"
@@ -91,7 +163,14 @@ def run_backward(root: Tensor, grad_tensor=None, retain_graph: bool = False):
                     "call backward(retain_graph=True) the first time."
                 )
             cot = cots[0] if node.single_out else tuple(cots)
-            in_grads = node.vjp_fn(cot)
+            in_grads = None
+            if deferred is not None and node.name in SPLIT_VJP_RULES:
+                split = SPLIT_VJP_RULES[node.name](node, cot)
+                if split is not None:
+                    in_grads, thunks = split
+                    deferred.extend(thunks)
+            if in_grads is None:
+                in_grads = node.vjp_fn(cot)
             if not retain_graph:
                 node.vjp_fn = None
             for t, g in zip(node.inputs, in_grads):

@@ -16,7 +16,11 @@ from .sp_utils import (
 )
 from .ring_attention import ring_attention, ulysses_attention
 from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer
-from .pipeline_parallel import PipelineParallel, PipelineParallelWithInterleave
+from .pipeline_parallel import (
+    PipelineParallel,
+    PipelineParallelWithInterleave,
+    ZeroBubblePipelineParallel,
+)
 from .parallel_wrappers import (
     DataParallel,
     DataParallelShard,

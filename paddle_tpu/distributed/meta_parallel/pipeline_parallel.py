@@ -31,7 +31,22 @@ class PipelineParallel(Layer):
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
         self.micro_batch_size = int(cfg.get("micro_batch_size", 1))
         self.num_stages = layers.num_stages
-        self._stage_meshes = layers.stage_meshes
+
+    def _run_chunks(self, act, lo=0, hi=None):
+        """Forward through model chunks [lo, hi) with mesh hops."""
+        meshes = self._layers.chunk_meshes
+        hi = self._layers.num_chunks if hi is None else hi
+        for c in range(lo, hi):
+            act = _to_stage(act, meshes[c], shard_batch=(c == 0))
+            act = self._layers.forward_chunk(act, c)
+        return act
+
+    def _bwd(self, loss, scaler):
+        """Backward for one micro-batch; schedule subclasses override."""
+        if scaler is not None:
+            scaler.scale(loss).backward(retain_graph=False)
+        else:
+            loss.backward()
 
     # ------------------------------------------------------------ data split
     def _split_micro(self, data):
@@ -59,20 +74,13 @@ class PipelineParallel(Layer):
 
         def fwd(mb):
             x, y = mb
-            act = x
-            for s in range(self.num_stages):
-                act = _to_stage(act, self._stage_meshes[s], shard_batch=(s == 0))
-                act = self._layers.forward_stage(act, s)
+            act = self._run_chunks(x)
             loss = self._layers.loss_fn(act, y) if self._layers.loss_fn else act
             if loss.ndim > 0:
                 loss = loss.mean()
             return loss / n
 
-        def bwd(loss):
-            if scaler is not None:
-                scaler.scale(loss).backward(retain_graph=False)
-            else:
-                loss.backward()
+        bwd = lambda loss: self._bwd(loss, scaler)
 
         k = 0
         for _ in range(warmup):  # fill the pipe
@@ -110,10 +118,7 @@ class PipelineParallel(Layer):
         micro = self._split_micro(data)
         losses = []
         for x, y in micro:
-            act = x
-            for s in range(self.num_stages):
-                act = _to_stage(act, self._stage_meshes[s], shard_batch=(s == 0))
-                act = self._layers.forward_stage(act, s)
+            act = self._run_chunks(x)
             if compute_loss and self._layers.loss_fn is not None:
                 l = self._layers.loss_fn(act, y)
                 losses.append(l.mean() if l.ndim > 0 else l)
@@ -144,9 +149,107 @@ class PipelineParallel(Layer):
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    """Interleaved virtual-pipeline schedule (reference :1308). The issue
-    order collapses to the same async stream single-controller; kept as a
-    distinct type for API parity."""
+    """Interleaved virtual-pipeline (VPP) schedule (reference
+    pipeline_parallel.py:1308). Requires a PipelineLayer built with
+    num_virtual_pipeline_stages=v > 1: the model is p*v chunks, chunk c on
+    physical stage c % p.
+
+    Issue order (Megatron interleaving): micro-batches are grouped in
+    groups of p; within a group, forwards are issued CHUNK-MAJOR —
+    (mb0,c0) (mb1,c0) … (mb_{p-1},c0) (mb0,c1) … — so every physical stage
+    receives work for chunk k of all group members before chunk k+1, which
+    is what shrinks the bubble from (p-1)/m to (p-1)/(v·m). Backwards run
+    1F1B against completed micro-batches. The issue trace is recorded on
+    `self.issue_order` for schedule verification."""
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__(layers, hcg, strategy)
+        if layers.num_virtual_stages < 2:
+            raise ValueError(
+                "PipelineParallelWithInterleave needs a PipelineLayer with "
+                "num_virtual_pipeline_stages >= 2")
+        self.issue_order: list = []
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        micro = self._split_micro(data)
+        n = len(micro)
+        p = self.num_stages
+        n_chunks = self._layers.num_chunks
+        self.issue_order = []
+        losses = [None] * n
+        acts: dict[int, object] = {}
+        pending: list[int] = []
+
+        def fwd_chunk(mb, c):
+            self.issue_order.append(("F", mb, c))
+            act = acts.pop(mb, None)
+            if act is None:
+                act = micro[mb][0]
+            meshes = self._layers.chunk_meshes
+            act = _to_stage(act, meshes[c], shard_batch=(c == 0))
+            act = self._layers.forward_chunk(act, c)
+            if c == n_chunks - 1:
+                y = micro[mb][1]
+                loss = self._layers.loss_fn(act, y) if self._layers.loss_fn else act
+                if loss.ndim > 0:
+                    loss = loss.mean()
+                losses[mb] = loss / n
+                pending.append(mb)
+            else:
+                acts[mb] = act
+
+        def bwd_one():
+            mb = pending.pop(0)
+            self.issue_order.append(("B", mb))
+            self._bwd(losses[mb], scaler)
+
+        for base in range(0, n, p):
+            group = list(range(base, min(base + p, n)))
+            for c in range(n_chunks):
+                for mb in group:
+                    fwd_chunk(mb, c)
+                    # steady state: one backward per completed forward unit
+                    # once the pipe is full (1F1B against finished mbs)
+                    if pending and len(pending) > max(p - 1, 1) - 1:
+                        bwd_one()
+        while pending:
+            bwd_one()
+
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        return total.detach()
+
+
+class ZeroBubblePipelineParallel(PipelineParallel):
+    """Zero-bubble schedule (reference pipeline_zero_bubble.py:62,151): each
+    micro-batch's backward is split into the dX chain (critical path,
+    issued 1F1B) and deferred dW jobs (weight grads of every Linear),
+    flushed after the drain phase — the work that fills the tail bubble.
+    Numerics are identical to the fused backward (tests assert parity)."""
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__(layers, hcg, strategy)
+        self.deferred_dw: list = []
+        self.stats = {"dx_backwards": 0, "dw_flushed": 0}
+
+    def _bwd(self, loss, scaler):
+        """dX phase only: weight grads of every Linear are deferred."""
+        from ...core import engine
+
+        if scaler is not None:
+            loss = scaler.scale(loss)
+        engine.run_backward(loss, deferred=self.deferred_dw)
+        self.stats["dx_backwards"] += 1
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        from ...core import engine
+
+        self.deferred_dw = []
+        total = super().forward_backward_pipeline(data, scaler)
+        # bubble fill: the deferred dW jobs run while the pipe drains
+        self.stats["dw_flushed"] = engine.flush_deferred(self.deferred_dw)
+        return total
 
 
 def _chunk(t, n):

@@ -125,6 +125,11 @@ class PipelineLayer(Layer):
 
                 num_stages = get_hybrid_communicate_group().get_pipe_parallel_world_size()
         self.num_stages = max(int(num_stages), 1)
+        # interleaved VPP (reference pp_layers.py num_virtual_pipeline_stages):
+        # the model splits into num_stages * v chunks; chunk c runs on
+        # physical stage c % num_stages, so each stage holds v
+        # non-contiguous layer ranges (Megatron interleaving)
+        self.num_virtual_stages = max(int(num_virtual_pipeline_stages or 1), 1)
         self._recompute_interval = recompute_interval
 
         shared_instances: dict[str, Layer] = {}
@@ -170,29 +175,41 @@ class PipelineLayer(Layer):
     # ------------------------------------------------------------ partition
     def _partition(self, seg_method):
         n = len(self._layers_list)
+        n_chunks = self.num_stages * self.num_virtual_stages
         if isinstance(seg_method, str) and seg_method.startswith("layer:"):
             # cut at layers whose class name matches (reference seg_method)
             pat = seg_method.split("layer:", 1)[1]
             marks = [i for i, l in enumerate(self._layers_list)
                      if re.match(pat, type(l).__name__)]
-            per = max(len(marks) // self.num_stages, 1)
+            per = max(len(marks) // n_chunks, 1)
             bounds = [0]
-            for s in range(1, self.num_stages):
+            for s in range(1, n_chunks):
                 idx = s * per
                 bounds.append(marks[idx] if idx < len(marks) else n)
             bounds.append(n)
         else:
-            per = -(-n // self.num_stages)
-            bounds = [min(i * per, n) for i in range(self.num_stages)] + [n]
+            per = -(-n // n_chunks)
+            bounds = [min(i * per, n) for i in range(n_chunks)] + [n]
         self.segment_parts = bounds
+        self._chunk_slices = [
+            (bounds[c], bounds[c + 1]) for c in range(n_chunks)
+        ]
+        # physical-stage view (v==1: identical to chunks)
         self._stage_slices = [
             (bounds[s], bounds[s + 1]) for s in range(self.num_stages)
-        ]
+        ] if self.num_virtual_stages == 1 else None
+
+    def stage_of_chunk(self, c: int) -> int:
+        return c % self.num_stages
+
+    @property
+    def num_chunks(self) -> int:
+        return len(self._chunk_slices)
 
     def get_stage_from_index(self, idx: int) -> int:
-        for s, (a, b) in enumerate(self._stage_slices):
+        for c, (a, b) in enumerate(self._chunk_slices):
             if a <= idx < b:
-                return s
+                return self.stage_of_chunk(c)
         return self.num_stages - 1
 
     # ------------------------------------------------------------ placement
@@ -212,8 +229,8 @@ class PipelineLayer(Layer):
         return Mesh(devs, names)
 
     def _place_stages(self):
-        for s, (a, b) in enumerate(self._stage_slices):
-            mesh = self._stage_mesh(s)
+        for c, (a, b) in enumerate(self._chunk_slices):
+            mesh = self._stage_mesh(self.stage_of_chunk(c))
             if mesh is None:
                 continue
             for l in self._layers_list[a:b]:
@@ -227,10 +244,11 @@ class PipelineLayer(Layer):
     # ------------------------------------------------------------ forward
     def forward(self, x, stage_range=None):
         if stage_range is None:
-            # full model: hop stage sub-meshes at the boundaries
-            for s in range(self.num_stages):
-                x = _to_stage(x, self.stage_meshes[s])
-                x = self.forward_stage(x, s)
+            # full model: hop chunk sub-meshes at the boundaries (with VPP a
+            # micro-batch visits each physical stage num_virtual_stages times)
+            for c in range(self.num_chunks):
+                x = _to_stage(x, self.chunk_meshes[c])
+                x = self.forward_chunk(x, c)
             return x
         lo, hi = stage_range
         for i in range(lo, hi):
@@ -240,15 +258,29 @@ class PipelineLayer(Layer):
                 x = self._layers_list[i](x)
         return x
 
-    def forward_stage(self, x, stage: int):
-        a, b = self._stage_slices[stage]
+    def forward_chunk(self, x, chunk: int):
+        a, b = self._chunk_slices[chunk]
         return self.forward(x, stage_range=(a, b))
+
+    def forward_stage(self, x, stage: int):
+        if self.num_virtual_stages != 1:
+            raise RuntimeError("forward_stage is for v==1; use forward_chunk")
+        return self.forward_chunk(x, stage)
 
     @property
     def stage_meshes(self):
-        if not hasattr(self, "_stage_meshes"):
-            self._stage_meshes = [self._stage_mesh(s) for s in range(self.num_stages)]
-        return self._stage_meshes
+        if not hasattr(self, "_stage_meshes_cache"):
+            self._stage_meshes_cache = [
+                self._stage_mesh(s) for s in range(self.num_stages)]
+        return self._stage_meshes_cache
+
+    @property
+    def chunk_meshes(self):
+        if not hasattr(self, "_chunk_meshes_cache"):
+            self._chunk_meshes_cache = [
+                self.stage_meshes[self.stage_of_chunk(c)]
+                for c in range(self.num_chunks)]
+        return self._chunk_meshes_cache
 
     @property
     def loss_fn(self):
