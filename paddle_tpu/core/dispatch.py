@@ -112,10 +112,10 @@ class GradNode:
     """One recorded op on the tape (≙ GradNodeBase, grad_node_info.h:197)."""
 
     __slots__ = ("vjp_fn", "inputs", "out_avals", "single_out", "name",
-                 "diff_idx", "__weakref__")
+                 "diff_idx", "ctx", "__weakref__")
 
     def __init__(self, vjp_fn, inputs, out_avals, single_out, name,
-                 diff_idx=None):
+                 diff_idx=None, ctx=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # list[Tensor] — differentiable inputs, positional
         self.out_avals = out_avals  # list[(shape, dtype)]
@@ -124,6 +124,11 @@ class GradNode:
         # original arg positions of `inputs` (zero-bubble dW/dX split rules
         # need to know which operand is the activation vs the weight)
         self.diff_idx = diff_idx
+        # (fn, datas): enough to RE-derive this op's vjp as a fresh traced
+        # computation — how create_graph=True records backward ops onto the
+        # tape (≙ the reference generating grad-of-grad GradNodes,
+        # eager/backward.cc double-grad path)
+        self.ctx = ctx
 
 
 _amp_dtype_for = None
@@ -419,7 +424,7 @@ def _op_call_impl(fn: Callable, *args, name: str | None = None, n_diff: int | No
             outs = [out] if single else list(out)
             avals = [(o.shape, o.dtype) for o in outs]
             node = GradNode(vjp_fn, [args[i] for i in diff_idx], avals, single, name,
-                            diff_idx=list(diff_idx))
+                            diff_idx=list(diff_idx), ctx=(fn, datas))
             return _wrap_outputs(out, node, name)
 
     if len(diff_idx) == len(datas):
@@ -440,7 +445,7 @@ def _op_call_impl(fn: Callable, *args, name: str | None = None, n_diff: int | No
     outs = [out] if single else list(out)
     avals = [(o.shape, o.dtype) for o in outs]
     node = GradNode(vjp_fn, [args[i] for i in diff_idx], avals, single, name,
-                    diff_idx=list(diff_idx))
+                    diff_idx=list(diff_idx), ctx=(fn, datas))
     return _wrap_outputs(out, node, name)
 
 

@@ -96,11 +96,59 @@ def flush_deferred(deferred: list) -> int:
     return n
 
 
+def _regrad(node, cots):
+    """Re-derive a node's input grads THROUGH op_call so the backward
+    computation itself lands on the tape (create_graph=True). The node's
+    saved (fn, datas) ctx is re-traced with jax.vjp; cotangents enter as
+    differentiable operands, so grad-of-grad chains through both the
+    primals and the upstream cotangents."""
+    from .dispatch import op_call
+
+    fn, datas = node.ctx
+    diff_idx = node.diff_idx or []
+    k = len(diff_idx)
+    # float cotangents ride as op args (differentiable); float0 stay closed over
+    float_pos = [i for i, c in enumerate(cots) if isinstance(c, Tensor)]
+    closed = [c._data if isinstance(c, Tensor) else c for c in cots]
+    single = node.single_out
+
+    def grad_fn(*vals):
+        dvals = vals[:k]
+        cot_vals = list(closed)
+        for j, p in enumerate(float_pos):
+            cot_vals[p] = vals[k + j]
+        full = list(datas)
+        for i, v in zip(diff_idx, dvals):
+            full[i] = v
+
+        def primal(*ds):
+            vs = list(full)
+            for i, dv in zip(diff_idx, ds):
+                vs[i] = dv
+            return fn(*vs)
+
+        _out, vjp = jax.vjp(primal, *dvals)
+        cot_in = cot_vals[0] if single else tuple(cot_vals)
+        return vjp(cot_in)
+
+    args = list(node.inputs) + [cots[p] for p in float_pos]
+    out = op_call(grad_fn, *args, name=node.name + "_grad")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
 def run_backward(root: Tensor, grad_tensor=None, retain_graph: bool = False,
-                 deferred: list | None = None):
+                 deferred: list | None = None, create_graph: bool = False,
+                 restrict_to: set | None = None):
     """deferred: when a list is passed, weight grads of splittable ops are
     NOT computed now — (param, thunk) pairs are appended for a later
-    flush_deferred() call (zero-bubble dX phase)."""
+    flush_deferred() call (zero-bubble dX phase).
+
+    create_graph: backward ops are recorded on the tape (via _regrad), so
+    the returned/accumulated grads support another backward (double grad,
+    ≙ eager/backward.cc grad-of-grad). Implies retain_graph.
+
+    restrict_to: ids of the only tensors allowed to receive .grad —
+    paddle.grad() semantics (other leaves stay untouched)."""
     if root.stop_gradient:
         raise RuntimeError(
             "Tensor.backward() on a tensor with stop_gradient=True — nothing to do"
@@ -149,7 +197,24 @@ def run_backward(root: Tensor, grad_tensor=None, retain_graph: bool = False,
     if remaining.get(id(root_node), 0) == 0:
         queue.append(root_node)
 
-    with no_grad():
+    retain_graph = retain_graph or create_graph
+    import contextlib
+
+    def as_tensor(g):
+        return g if isinstance(g, Tensor) else Tensor(g, _internal=True)
+
+    def accum_tensor(slot, g) -> Tensor:
+        """slot: Tensor | raw array | None. One accumulation rule for both
+        .grad writes and pending cotangent slots, in both grad modes."""
+        if create_graph:
+            g = as_tensor(g)
+            return g if slot is None else as_tensor(slot) + g
+        gd = g._data if isinstance(g, Tensor) else g
+        sd = slot._data if isinstance(slot, Tensor) else slot
+        return Tensor(_accum(sd, gd), _internal=True)
+
+    grad_mode = contextlib.nullcontext() if create_graph else no_grad()
+    with grad_mode:
         while queue:
             node = queue.popleft()
             outs = pending.pop(id(node))
@@ -162,37 +227,54 @@ def run_backward(root: Tensor, grad_tensor=None, retain_graph: bool = False,
                     "Trying to backward through the graph a second time; "
                     "call backward(retain_graph=True) the first time."
                 )
-            cot = cots[0] if node.single_out else tuple(cots)
             in_grads = None
-            if deferred is not None and node.name in SPLIT_VJP_RULES:
-                split = SPLIT_VJP_RULES[node.name](node, cot)
-                if split is not None:
-                    in_grads, thunks = split
-                    deferred.extend(thunks)
-            if in_grads is None:
-                in_grads = node.vjp_fn(cot)
+            if create_graph:
+                if node.ctx is None:
+                    # hand-built GradNodes (PyLayer, fleet recompute,
+                    # pipeline transfers) carry no re-derivation context —
+                    # silently treating their cotangents as constants would
+                    # drop Hessian terms, so refuse loudly
+                    raise NotImplementedError(
+                        f"create_graph=True through '{node.name}' "
+                        "(a hand-built GradNode) is not supported; use "
+                        "paddle_tpu.incubate.autograd (jax transform "
+                        "composition) for higher-order grads of this op")
+                cots_t = [c if isinstance(c, Tensor) or
+                          (hasattr(c, "dtype") and c.dtype == jax.dtypes.float0)
+                          else as_tensor(c) for c in cots]
+                in_grads = _regrad(node, cots_t)
+            else:
+                raw = [c._data if isinstance(c, Tensor) else c for c in cots]
+                cot = raw[0] if node.single_out else tuple(raw)
+                if deferred is not None and node.name in SPLIT_VJP_RULES:
+                    split = SPLIT_VJP_RULES[node.name](node, cot)
+                    if split is not None:
+                        in_grads, thunks = split
+                        deferred.extend(thunks)
+                if in_grads is None:
+                    in_grads = node.vjp_fn(cot)
             if not retain_graph:
                 node.vjp_fn = None
+                node.ctx = None  # release the pinned input buffers too
             for t, g in zip(node.inputs, in_grads):
                 if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
                     continue
                 for hook in t._hooks:
-                    out = hook(Tensor(g, _internal=True))
+                    out = hook(as_tensor(g))
                     if out is not None:
-                        g = out._data if isinstance(out, Tensor) else out
+                        g = out if create_graph and isinstance(out, Tensor) else (
+                            out._data if isinstance(out, Tensor) else out)
                 pn = t._node
+                allowed = restrict_to is None or id(t) in restrict_to
                 if pn is None:
-                    if not t.stop_gradient:
-                        t._grad = Tensor(
-                            _accum(t._grad._data if t._grad is not None else None, g), _internal=True
-                        )
+                    if not t.stop_gradient and allowed:
+                        t._grad = accum_tensor(t._grad, g)
                 else:
-                    if t._retain_grads:
-                        t._grad = Tensor(
-                            _accum(t._grad._data if t._grad is not None else None, g), _internal=True
-                        )
+                    if t._retain_grads and allowed:
+                        t._grad = accum_tensor(t._grad, g)
                     if id(pn) in pending:
-                        pending[id(pn)][t._out_idx] = _accum(pending[id(pn)][t._out_idx], g)
+                        pending[id(pn)][t._out_idx] = accum_tensor(
+                            pending[id(pn)][t._out_idx], g)
                         remaining[id(pn)] -= 1
                         if remaining[id(pn)] == 0:
                             queue.append(pn)
@@ -202,13 +284,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=Fa
          allow_unused=False):
     """paddle.grad — functional gradient of outputs w.r.t. inputs.
 
-    create_graph is not yet supported (single-level tape); double grad goes
-    through paddle_tpu.incubate.autograd jax transforms instead.
+    create_graph=True records the backward pass on the tape (via _regrad),
+    so the returned grads can be backward()ed again — gradient penalties,
+    Hessian-vector products, etc. (≙ eager/backward.cc double grad).
     """
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use paddle_tpu.incubate.autograd (jax.grad composition)"
-        )
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
@@ -219,9 +298,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=Fa
         t._grad = None
         t._retain_grads = True
     try:
+        only = {id(t) for t in inputs}
         for i, (o, go) in enumerate(zip(outputs, grad_outputs)):
             last = i == len(outputs) - 1
-            run_backward(o, go, retain_graph=retain_graph if last else True)
+            run_backward(o, go, retain_graph=retain_graph if last else True,
+                         create_graph=create_graph, restrict_to=only)
         result = []
         for t in inputs:
             if t._grad is None and not allow_unused:

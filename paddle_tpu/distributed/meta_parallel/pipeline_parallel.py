@@ -185,9 +185,7 @@ class PipelineParallelWithInterleave(PipelineParallel):
             act = acts.pop(mb, None)
             if act is None:
                 act = micro[mb][0]
-            meshes = self._layers.chunk_meshes
-            act = _to_stage(act, meshes[c], shard_batch=(c == 0))
-            act = self._layers.forward_chunk(act, c)
+            act = self._run_chunks(act, lo=c, hi=c + 1)
             if c == n_chunks - 1:
                 y = micro[mb][1]
                 loss = self._layers.loss_fn(act, y) if self._layers.loss_fn else act

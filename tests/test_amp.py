@@ -1,0 +1,129 @@
+"""AMP tests: auto_cast O1/O2, GradScaler dynamics, decorate.
+
+VERDICT weak-#3: amp_dtype_for is consulted on EVERY op_call, and GradScaler
+has unscale/clip logic — previously untested. Reference surface:
+python/paddle/amp/auto_cast.py:1018, grad_scaler.py:657.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestAutoCast:
+    def test_o1_casts_whitelist_only(self):
+        x = paddle.rand([4, 4])
+        w = paddle.rand([4, 4])
+        with paddle.amp.auto_cast(enable=True, dtype="bfloat16", level="O1"):
+            mm = paddle.matmul(x, w)          # white list -> bf16
+            s = paddle.nn.functional.softmax(mm.astype("float32"))  # black/other
+        assert "bfloat16" in str(mm.dtype)
+        assert "float32" in str(s.dtype)
+        # outside the context nothing is cast
+        assert "float32" in str(paddle.matmul(x, w).dtype)
+
+    def test_o2_casts_more(self):
+        x = paddle.rand([4, 4])
+        with paddle.amp.auto_cast(enable=True, dtype="bfloat16", level="O2"):
+            y = x + x
+        # O2: (almost) everything low precision
+        assert "bfloat16" in str(y.dtype)
+
+    def test_disabled_is_noop(self):
+        x = paddle.rand([4, 4])
+        with paddle.amp.auto_cast(enable=False):
+            y = paddle.matmul(x, x)
+        assert "float32" in str(y.dtype)
+
+    def test_custom_white_black_lists(self):
+        x = paddle.rand([4, 4])
+        with paddle.amp.auto_cast(enable=True, dtype="bfloat16",
+                                  custom_black_list=["matmul"], level="O1"):
+            y = paddle.matmul(x, x)
+        assert "float32" in str(y.dtype)
+
+    def test_grads_arrive_in_param_dtype(self):
+        lin = nn.Linear(8, 8)
+        x = paddle.rand([2, 8])
+        with paddle.amp.auto_cast(enable=True, dtype="bfloat16", level="O1"):
+            loss = lin(x).sum()
+        loss.backward()
+        assert "float32" in str(lin.weight.grad.dtype)
+
+
+class TestGradScaler:
+    def _step(self, scaler, opt, loss):
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+
+    def test_scaled_training_matches_unscaled(self):
+        paddle.seed(0)
+        m1 = nn.Linear(8, 4)
+        paddle.seed(0)
+        m2 = nn.Linear(8, 4)
+        o1 = paddle.optimizer.SGD(learning_rate=0.1, parameters=m1.parameters())
+        o2 = paddle.optimizer.SGD(learning_rate=0.1, parameters=m2.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0 ** 8)
+        x = paddle.rand([4, 8])
+        for _ in range(5):
+            l1 = (m1(x) ** 2).mean()
+            l1.backward()
+            o1.step()
+            o1.clear_grad()
+            self._step(scaler, o2, (m2(x) ** 2).mean())
+        np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_nonfinite_skips_step_and_shrinks_scale(self):
+        m = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0,
+                                       decr_ratio=0.5, incr_every_n_steps=10**9)
+        w_before = m.weight.numpy().copy()
+        x = paddle.to_tensor(np.full((2, 4), np.inf, "float32"))
+        loss = m(x).sum()
+        self._step(scaler, opt, loss)
+        np.testing.assert_array_equal(m.weight.numpy(), w_before)  # skipped
+        assert float(scaler._scale.numpy() if hasattr(scaler._scale, "numpy")
+                     else scaler._scale) == 512.0
+
+    def test_scale_grows_after_n_good_steps(self):
+        m = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=m.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0,
+                                       incr_every_n_steps=2, incr_ratio=2.0)
+        x = paddle.rand([2, 4])
+        for _ in range(4):
+            self._step(scaler, opt, m(x).sum())
+        s = float(scaler._scale.numpy() if hasattr(scaler._scale, "numpy")
+                  else scaler._scale)
+        assert s == 8.0  # two doublings in four steps
+
+    def test_unscale_then_clip(self):
+        m = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=256.0)
+        loss = m(paddle.rand([2, 4])).sum()
+        scaler.scale(loss).backward()
+        scaler.unscale_(opt)
+        g = m.weight.grad.numpy()
+        loss2 = m(paddle.rand([2, 4]))  # unrelated fwd shouldn't matter
+        # unscaled grads are O(1), not O(256)
+        assert np.abs(g).max() < 50.0
+        scaler.step(opt)
+        scaler.update()
+
+
+class TestDecorate:
+    def test_o2_decorate_casts_params(self):
+        model = nn.Linear(8, 8)
+        model, opt = paddle.amp.decorate(
+            models=model,
+            optimizers=paddle.optimizer.SGD(parameters=model.parameters()),
+            level="O2", dtype="bfloat16")
+        assert "bfloat16" in str(model.weight.dtype)

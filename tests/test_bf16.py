@@ -1,0 +1,120 @@
+"""bfloat16 dtype sweep (VERDICT weak-#5: bf16 — the dtype TPUs actually
+train in — was never tested). Model: the reference OpTest dtype sweeps
+(test/legacy_test/op_test.py:418 runs fp32/fp16/bf16 with per-dtype
+tolerances); here each op runs in bf16 forward + backward and is compared
+against its fp32 result at bf16 tolerance (rtol ~ 2^-8).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+RTOL = 3e-2
+ATOL = 3e-2
+
+
+def _pair(shape, seed=0):
+    rs = np.random.RandomState(seed)
+    a = rs.randn(*shape).astype("float32")
+    t32 = paddle.to_tensor(a)
+    t16 = paddle.to_tensor(a).astype("bfloat16")
+    t32.stop_gradient = False
+    t16.stop_gradient = False
+    return t32, t16
+
+
+UNARY_OPS = [
+    ("exp", paddle.exp), ("tanh", paddle.tanh), ("sigmoid", F.sigmoid),
+    ("relu", F.relu), ("gelu", F.gelu), ("silu", F.silu),
+    ("softmax", lambda t: F.softmax(t, axis=-1)),
+    ("log_softmax", lambda t: F.log_softmax(t, axis=-1)),
+    ("sqrt_abs", lambda t: paddle.sqrt(paddle.abs(t))),
+    ("mean", lambda t: t.mean()), ("sum", lambda t: t.sum()),
+]
+
+
+class TestUnaryBf16:
+    @pytest.mark.parametrize("name,op", UNARY_OPS, ids=[n for n, _ in UNARY_OPS])
+    def test_fwd_bwd(self, name, op):
+        t32, t16 = _pair((4, 8), seed=hash(name) % 1000)
+        o32, o16 = op(t32), op(t16)
+        assert "bfloat16" in str(o16.dtype)
+        np.testing.assert_allclose(o16.astype("float32").numpy(), o32.numpy(),
+                                   rtol=RTOL, atol=ATOL)
+        o32.sum().backward()
+        o16.sum().backward()
+        assert "bfloat16" in str(t16.grad.dtype)
+        np.testing.assert_allclose(t16.grad.astype("float32").numpy(),
+                                   t32.grad.numpy(), rtol=RTOL, atol=ATOL)
+
+
+class TestBinaryBf16:
+    @pytest.mark.parametrize("op", ["add", "sub", "mul", "matmul", "div"])
+    def test_fwd_bwd(self, op):
+        a32, a16 = _pair((8, 8), 1)
+        b32, b16 = _pair((8, 8), 2)
+        fns = {
+            "add": lambda x, y: x + y, "sub": lambda x, y: x - y,
+            "mul": lambda x, y: x * y, "matmul": paddle.matmul,
+            "div": lambda x, y: x / (y * y + 1.0),
+        }
+        o32, o16 = fns[op](a32, b32), fns[op](a16, b16)
+        assert "bfloat16" in str(o16.dtype)
+        np.testing.assert_allclose(o16.astype("float32").numpy(), o32.numpy(),
+                                   rtol=RTOL, atol=RTOL * 8)
+        o32.sum().backward()
+        o16.sum().backward()
+        np.testing.assert_allclose(a16.grad.astype("float32").numpy(),
+                                   a32.grad.numpy(), rtol=RTOL, atol=ATOL)
+
+
+class TestLayersBf16:
+    def test_linear_layer_bf16_params(self):
+        paddle.seed(0)
+        lin = nn.Linear(8, 4)
+        lin.to(dtype="bfloat16") if hasattr(lin, "to") else None
+        x = paddle.rand([2, 8]).astype("bfloat16")
+        w16 = lin.weight.astype("bfloat16")
+        b16 = lin.bias.astype("bfloat16")
+        y = F.linear(x, w16, b16)
+        assert "bfloat16" in str(y.dtype)
+        ref = F.linear(x.astype("float32"), lin.weight, lin.bias)
+        np.testing.assert_allclose(y.astype("float32").numpy(), ref.numpy(),
+                                   rtol=RTOL, atol=ATOL)
+
+    def test_layernorm_bf16(self):
+        x32, x16 = _pair((4, 16), 5)
+        o32 = F.layer_norm(x32, [16])
+        o16 = F.layer_norm(x16, [16])
+        np.testing.assert_allclose(o16.astype("float32").numpy(), o32.numpy(),
+                                   rtol=RTOL, atol=ATOL)
+
+    def test_cross_entropy_bf16(self):
+        rs = np.random.RandomState(0)
+        logits = rs.randn(8, 10).astype("float32")
+        labels = paddle.to_tensor(rs.randint(0, 10, (8,)).astype("int64"))
+        l32 = F.cross_entropy(paddle.to_tensor(logits), labels)
+        l16 = F.cross_entropy(paddle.to_tensor(logits).astype("bfloat16"), labels)
+        np.testing.assert_allclose(float(l16.astype("float32").numpy()),
+                                   float(l32.numpy()), rtol=RTOL)
+
+    def test_train_step_bf16_activations(self):
+        """bf16 compute via amp O1 around a small train loop decreases loss."""
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 3))
+        opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                    parameters=net.parameters())
+        rs = np.random.RandomState(0)
+        X = paddle.to_tensor(rs.randn(32, 8).astype("float32"))
+        Y = paddle.to_tensor(rs.randint(0, 3, (32,)).astype("int64"))
+        losses = []
+        for _ in range(15):
+            with paddle.amp.auto_cast(enable=True, dtype="bfloat16", level="O1"):
+                loss = F.cross_entropy(net(X), Y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.astype("float32").numpy()))
+        assert losses[-1] < losses[0] * 0.8, losses

@@ -1,0 +1,102 @@
+"""Double/higher-order grad through the tape (VERDICT missing-#10).
+
+Reference parity: eager/backward.cc grad-of-grad — paddle.grad(...,
+create_graph=True) returns grads that are themselves differentiable.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _t(arr):
+    t = paddle.to_tensor(np.asarray(arr, "float32"))
+    t.stop_gradient = False
+    return t
+
+
+class TestCreateGraph:
+    def test_second_derivative(self):
+        x = _t([2.0, 3.0])
+        (g,) = paddle.grad((x ** 3).sum(), x, create_graph=True)
+        assert g._node is not None, "grad must be on the tape"
+        np.testing.assert_allclose(g.numpy(), [12.0, 27.0])
+        (gg,) = paddle.grad(g.sum(), x)
+        np.testing.assert_allclose(gg.numpy(), [12.0, 18.0])  # 6x
+
+    def test_third_derivative(self):
+        x = _t([2.0])
+        (g1,) = paddle.grad((x ** 4).sum(), x, create_graph=True)
+        (g2,) = paddle.grad(g1.sum(), x, create_graph=True)
+        (g3,) = paddle.grad(g2.sum(), x)
+        np.testing.assert_allclose(g3.numpy(), [48.0])  # 24x
+
+    def test_grad_does_not_pollute_other_leaves(self):
+        x = _t([[1.0, 2.0]])
+        w = _t([[0.5], [1.5]])
+        out = paddle.matmul(x, w).sum()
+        paddle.grad(out, x, create_graph=True)
+        assert w.grad is None
+
+    def test_gradient_penalty_pattern(self):
+        # WGAN-GP style: backward through a gradient norm
+        x = _t([[1.0, 2.0]])
+        w = _t([[0.5], [1.5]])
+        (gx,) = paddle.grad(paddle.matmul(x, w).sum(), x, create_graph=True)
+        penalty = ((gx ** 2).sum() - 1.0) ** 2
+        penalty.backward()
+        wv = w.numpy().ravel()
+        expect = (2 * (np.sum(wv ** 2) - 1) * 2 * wv).reshape(2, 1)
+        np.testing.assert_allclose(w.grad.numpy(), expect, rtol=1e-5)
+
+    def test_hessian_vector_product(self):
+        # H @ v for f = 0.5 x^T A x  ->  Hv = (A + A^T)/2 ... A sym here
+        A = np.array([[2.0, 1.0], [1.0, 3.0]], "float32")
+        x = _t([1.0, -1.0])
+        v = paddle.to_tensor(np.array([0.5, 2.0], "float32"))
+        At = paddle.to_tensor(A)
+        f = 0.5 * (x * paddle.matmul(At, x.reshape([2, 1])).reshape([2])).sum()
+        (g,) = paddle.grad(f, x, create_graph=True)
+        (hv,) = paddle.grad((g * v).sum(), x)
+        np.testing.assert_allclose(hv.numpy(), A @ v.numpy(), rtol=1e-5)
+
+    def test_through_nn_layer(self):
+        paddle.seed(0)
+        lin = nn.Linear(4, 1)
+        x = _t(np.random.RandomState(0).randn(3, 4))
+        (gx,) = paddle.grad(F.tanh(lin(x)).sum(), x, create_graph=True)
+        loss = (gx ** 2).sum()
+        loss.backward()
+        assert lin.weight.grad is not None
+        assert np.isfinite(lin.weight.grad.numpy()).all()
+
+    def test_mixed_with_first_order(self):
+        # plain backward still works after a create_graph pass
+        x = _t([1.0, 2.0])
+        (g,) = paddle.grad((x ** 2).sum(), x, create_graph=True)
+        y = (x * 3).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+
+class TestIncubateAutograd:
+    def test_jvp_vjp(self):
+        import paddle_tpu.incubate.autograd as ag
+
+        x = _t([1.0, 2.0])
+        out, tang = ag.jvp(lambda t: (t ** 2).sum(), x,
+                           v=paddle.to_tensor(np.array([1.0, 0.0], "float32")))
+        np.testing.assert_allclose(float(tang.numpy()), 2.0)
+        out, g = ag.vjp(lambda t: (t ** 2).sum(), x)
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+
+    def test_jacobian_hessian(self):
+        import paddle_tpu.incubate.autograd as ag
+
+        x = _t([1.0, 2.0])
+        J = ag.Jacobian(lambda t: t ** 2, x)
+        np.testing.assert_allclose(J[:].numpy(), np.diag([2.0, 4.0]))
+        H = ag.Hessian(lambda t: (t ** 3).sum(), x)
+        np.testing.assert_allclose(H[:].numpy(), np.diag([6.0, 12.0]))

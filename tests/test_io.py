@@ -1,0 +1,134 @@
+"""DataLoader / Dataset / Sampler tests (VERDICT weak-#4: mp path untested).
+
+Reference surface: python/paddle/io/reader.py:262 DataLoader,
+dataloader_iter.py:368 multiprocess workers.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (
+    BatchSampler, DataLoader, Dataset, IterableDataset, RandomSampler,
+    SequenceSampler, TensorDataset,
+)
+
+
+class SquareDataset(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.array([i], "float32"), np.array([i * i], "float32")
+
+
+class TestDataLoaderSingleProcess:
+    def test_order_and_shapes(self):
+        dl = DataLoader(SquareDataset(10), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 3  # 4+4+2
+        x0, y0 = batches[0]
+        assert x0.shape == [4, 1]
+        np.testing.assert_array_equal(x0.numpy().ravel(), [0, 1, 2, 3])
+        np.testing.assert_array_equal(y0.numpy().ravel(), [0, 1, 4, 9])
+        assert batches[2][0].shape == [2, 1]
+
+    def test_drop_last(self):
+        dl = DataLoader(SquareDataset(10), batch_size=4, drop_last=True)
+        assert len(list(dl)) == 2
+        assert len(dl) == 2
+
+    def test_shuffle_covers_all(self):
+        paddle.seed(7)
+        dl = DataLoader(SquareDataset(16), batch_size=4, shuffle=True)
+        seen = np.sort(np.concatenate([b[0].numpy().ravel() for b in dl]))
+        np.testing.assert_array_equal(seen, np.arange(16))
+
+    def test_custom_collate(self):
+        dl = DataLoader(SquareDataset(4), batch_size=2,
+                        collate_fn=lambda items: sum(int(x[0]) for x, _ in items))
+        assert list(dl) == [1, 5]
+
+    def test_tensor_dataset(self):
+        a = paddle.to_tensor(np.arange(6, dtype="float32").reshape(6, 1))
+        b = paddle.to_tensor(np.arange(6, dtype="int64"))
+        ds = TensorDataset([a, b])
+        assert len(ds) == 6
+        x, y = ds[2]
+        assert float(x.numpy()[0]) == 2.0 and int(y.numpy()) == 2
+
+
+class TestDataLoaderMultiProcess:
+    def test_two_workers_full_epoch(self):
+        dl = DataLoader(SquareDataset(20), batch_size=4, num_workers=2)
+        got = np.sort(np.concatenate([b[0].numpy().ravel() for b in dl]))
+        np.testing.assert_array_equal(got, np.arange(20))
+
+    def test_worker_init_fn_called(self, tmp_path):
+        marker = str(tmp_path / "w{}.txt")
+
+        def init_fn(worker_id):
+            open(marker.format(worker_id), "w").write("hi")
+
+        dl = DataLoader(SquareDataset(8), batch_size=2, num_workers=2,
+                        worker_init_fn=init_fn)
+        list(dl)
+        import os
+
+        assert os.path.exists(marker.format(0))
+        assert os.path.exists(marker.format(1))
+
+    def test_multiple_epochs_reuse(self):
+        dl = DataLoader(SquareDataset(8), batch_size=4, num_workers=2)
+        for _ in range(3):
+            assert len(list(dl)) == 2
+
+    def test_worker_exception_propagates(self):
+        class Bad(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                if i == 2:
+                    raise ValueError("boom at 2")
+                return np.zeros(1, "float32")
+
+        dl = DataLoader(Bad(), batch_size=1, num_workers=2)
+        with pytest.raises(Exception, match="boom"):
+            list(dl)
+
+
+class TestSamplers:
+    def test_sequence_sampler(self):
+        assert list(SequenceSampler(SquareDataset(4))) == [0, 1, 2, 3]
+
+    def test_random_sampler_permutation(self):
+        paddle.seed(3)
+        idx = list(RandomSampler(SquareDataset(8)))
+        assert sorted(idx) == list(range(8))
+
+    def test_batch_sampler(self):
+        bs = BatchSampler(dataset=SquareDataset(7), batch_size=3)
+        batches = list(bs)
+        assert batches[0] == [0, 1, 2] and batches[2] == [6]
+        bs2 = BatchSampler(dataset=SquareDataset(7), batch_size=3, drop_last=True)
+        assert len(list(bs2)) == 2
+
+    def test_dataloader_with_batch_sampler(self):
+        bs = BatchSampler(dataset=SquareDataset(8), batch_size=4)
+        dl = DataLoader(SquareDataset(8), batch_sampler=bs)
+        assert len(list(dl)) == 2
+
+
+class TestIterableDataset:
+    def test_stream(self):
+        class Stream(IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.array([i], "float32")
+
+        dl = DataLoader(Stream(), batch_size=3)
+        batches = list(dl)
+        assert [b.shape[0] for b in batches] == [3, 3, 1]
