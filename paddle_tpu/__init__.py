@@ -65,6 +65,10 @@ from . import incubate
 from . import profiler
 from . import hapi
 from .hapi import Model
+from . import distribution
+from . import quantization
+from . import sparse
+from . import static
 from .framework_io import save, load
 
 # paddle.framework parity namespace bits

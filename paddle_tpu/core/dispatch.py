@@ -341,6 +341,17 @@ def _check_nan_inf(name, arrs):
                 raise FloatingPointError(f"Operator '{name}' output contains NaN/Inf")
 
 
+def _make_ctx(fn, datas, diff_idx):
+    """Re-derivation ctx for create_graph. Differentiable operands are
+    stored as None — _regrad rebuilds them from node.inputs, so the ctx
+    pins only the non-diff operands (and most of those are already alive
+    in the vjp residuals)."""
+    if not flag("FLAGS_enable_double_grad"):
+        return None
+    diff = set(diff_idx)
+    return (fn, [None if i in diff else d for i, d in enumerate(datas)])
+
+
 #: set by paddle_tpu.profiler while recording: callable(name) -> RecordEvent
 _profiler_hook = None
 
@@ -424,7 +435,7 @@ def _op_call_impl(fn: Callable, *args, name: str | None = None, n_diff: int | No
             outs = [out] if single else list(out)
             avals = [(o.shape, o.dtype) for o in outs]
             node = GradNode(vjp_fn, [args[i] for i in diff_idx], avals, single, name,
-                            diff_idx=list(diff_idx), ctx=(fn, datas))
+                            diff_idx=list(diff_idx), ctx=_make_ctx(fn, datas, diff_idx))
             return _wrap_outputs(out, node, name)
 
     if len(diff_idx) == len(datas):
@@ -445,7 +456,7 @@ def _op_call_impl(fn: Callable, *args, name: str | None = None, n_diff: int | No
     outs = [out] if single else list(out)
     avals = [(o.shape, o.dtype) for o in outs]
     node = GradNode(vjp_fn, [args[i] for i in diff_idx], avals, single, name,
-                    diff_idx=list(diff_idx), ctx=(fn, datas))
+                    diff_idx=list(diff_idx), ctx=_make_ctx(fn, datas, diff_idx))
     return _wrap_outputs(out, node, name)
 
 

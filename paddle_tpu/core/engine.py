@@ -163,7 +163,10 @@ def run_backward(root: Tensor, grad_tensor=None, retain_graph: bool = False,
         seed = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
 
     if root._node is None:
-        root._grad = Tensor(_accum(root._grad._data if root._grad is not None else None, seed), _internal=True)
+        if restrict_to is None or id(root) in restrict_to:
+            root._grad = Tensor(
+                _accum(root._grad._data if root._grad is not None else None, seed),
+                _internal=True)
         return
 
     # -- collect reachable graph + consumer counts

@@ -1,0 +1,30 @@
+"""paddle.distribution — probability distributions.
+
+Reference parity: python/paddle/distribution/ (Distribution base,
+distribution zoo, kl_divergence registry). TPU-native: densities/samplers
+are jnp compositions through the op funnel (differentiable for rsample-able
+families), sampling uses the framework RNG key chain.
+"""
+from .distributions import (
+    Bernoulli,
+    Beta,
+    Categorical,
+    Distribution,
+    Exponential,
+    Gamma,
+    Geometric,
+    Gumbel,
+    Laplace,
+    LogNormal,
+    Multinomial,
+    Normal,
+    Poisson,
+    Uniform,
+)
+from .kl import kl_divergence, register_kl
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+    "Exponential", "Gamma", "Beta", "Laplace", "Gumbel", "LogNormal",
+    "Multinomial", "Poisson", "Geometric", "kl_divergence", "register_kl",
+]

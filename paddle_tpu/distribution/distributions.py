@@ -1,0 +1,525 @@
+"""Distribution zoo (≙ python/paddle/distribution/*.py).
+
+Every density/statistic is a jnp composition dispatched through op_call
+(differentiable); `sample` draws via the framework RNG chain, `rsample`
+is reparameterized where the family allows it.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op_call
+from ..core.rng import next_key
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+    "Exponential", "Gamma", "Beta", "Laplace", "Gumbel", "LogNormal",
+    "Multinomial", "Poisson", "Geometric",
+]
+
+
+def _t(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, jnp.float32), _internal=True)
+
+
+def _shape(extra, base_shape):
+    extra = tuple(int(s) for s in (extra or ()))
+    return extra + tuple(base_shape)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no reparameterized sampler")
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return op_call(jnp.exp, self.log_prob(value), name="exp")
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale * self.scale
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self._batch_shape)
+
+        def fn(loc, scale):
+            return loc + scale * jax.random.normal(key, shp, jnp.float32)
+
+        return op_call(fn, self.loc, self.scale, name="normal_rsample")
+
+    def log_prob(self, value):
+        def fn(v, loc, scale):
+            var = scale * scale
+            return (-((v - loc) ** 2) / (2 * var)
+                    - jnp.log(scale) - 0.5 * math.log(2 * math.pi))
+
+        return op_call(fn, _t(value), self.loc, self.scale, name="normal_log_prob")
+
+    def entropy(self):
+        def fn(scale):
+            return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale)
+
+        return op_call(fn, self.scale, name="normal_entropy")
+
+    def cdf(self, value):
+        def fn(v, loc, scale):
+            return 0.5 * (1 + jax.scipy.special.erf((v - loc) / (scale * math.sqrt(2))))
+
+        return op_call(fn, _t(value), self.loc, self.scale, name="normal_cdf")
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return op_call(lambda l, s: jnp.exp(l + s * s / 2), self.loc, self.scale,
+                       name="lognormal_mean")
+
+    def sample(self, shape=()):
+        return op_call(jnp.exp, self._base.sample(shape), name="exp").detach()
+
+    def rsample(self, shape=()):
+        return op_call(jnp.exp, self._base.rsample(shape), name="exp")
+
+    def log_prob(self, value):
+        v = _t(value)
+        inner = self._base.log_prob(op_call(jnp.log, v, name="log"))
+        return op_call(lambda lp, vv: lp - jnp.log(vv), inner, v,
+                       name="lognormal_log_prob")
+
+    def entropy(self):
+        return op_call(lambda l, s: l + 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                       self.loc, self.scale, name="lognormal_entropy")
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2.0
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self._batch_shape)
+
+        def fn(low, high):
+            return low + (high - low) * jax.random.uniform(key, shp, jnp.float32)
+
+        return op_call(fn, self.low, self.high, name="uniform_rsample")
+
+    def log_prob(self, value):
+        def fn(v, low, high):
+            inside = (v >= low) & (v < high)
+            return jnp.where(inside, -jnp.log(high - low), -jnp.inf)
+
+        return op_call(fn, _t(value), self.low, self.high, name="uniform_log_prob")
+
+    def entropy(self):
+        return op_call(lambda l, h: jnp.log(h - l), self.low, self.high,
+                       name="uniform_entropy")
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = _t(probs)
+        else:
+            self.probs = op_call(jax.nn.sigmoid, _t(logits), name="sigmoid")
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self._batch_shape)
+
+        def fn(p):
+            return jax.random.bernoulli(key, p, shp).astype(jnp.float32)
+
+        return op_call(fn, self.probs, name="bernoulli_sample").detach()
+
+    def log_prob(self, value):
+        def fn(v, p):
+            eps = 1e-7
+            p = jnp.clip(p, eps, 1 - eps)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+        return op_call(fn, _t(value), self.probs, name="bernoulli_log_prob")
+
+    def entropy(self):
+        def fn(p):
+            eps = 1e-7
+            p = jnp.clip(p, eps, 1 - eps)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+        return op_call(fn, self.probs, name="bernoulli_entropy")
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if logits is not None:
+            self.logits = _t(logits)
+        else:
+            self.logits = op_call(lambda p: jnp.log(p / p.sum(-1, keepdims=True)),
+                                  _t(probs), name="log")
+        super().__init__(self.logits.shape[:-1])
+        self._n = self.logits.shape[-1]
+
+    @property
+    def probs(self):
+        return op_call(lambda l: jax.nn.softmax(l, -1), self.logits, name="softmax")
+
+    def sample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self._batch_shape)
+
+        def fn(l):
+            return jax.random.categorical(key, l, shape=shp)
+
+        return op_call(fn, self.logits, name="categorical_sample").detach()
+
+    def log_prob(self, value):
+        def fn(l, v):
+            logp = jax.nn.log_softmax(l, -1)
+            # broadcast batch logits against value's extra sample dims
+            logp = jnp.broadcast_to(logp, v.shape + logp.shape[-1:])
+            return jnp.take_along_axis(
+                logp, v.astype(jnp.int32)[..., None], -1).squeeze(-1)
+
+        # logits differentiable (REINFORCE/policy gradients); value is not
+        return op_call(fn, self.logits, _t(value), name="categorical_log_prob",
+                       n_diff=1)
+
+    def entropy(self):
+        def fn(l):
+            logp = jax.nn.log_softmax(l, -1)
+            return -(jnp.exp(logp) * logp).sum(-1)
+
+        return op_call(fn, self.logits, name="categorical_entropy")
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return 1.0 / self.rate
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self._batch_shape)
+
+        def fn(rate):
+            return jax.random.exponential(key, shp, jnp.float32) / rate
+
+        return op_call(fn, self.rate, name="exponential_rsample")
+
+    def log_prob(self, value):
+        def fn(v, rate):
+            return jnp.where(v >= 0, jnp.log(rate) - rate * v, -jnp.inf)
+
+        return op_call(fn, _t(value), self.rate, name="exponential_log_prob")
+
+    def entropy(self):
+        return op_call(lambda r: 1.0 - jnp.log(r), self.rate,
+                       name="exponential_entropy")
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.concentration / self.rate
+
+    def sample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self._batch_shape)
+
+        def fn(a, rate):
+            return jax.random.gamma(key, a, shp, jnp.float32) / rate
+
+        return op_call(fn, self.concentration, self.rate,
+                       name="gamma_sample").detach()
+
+    def log_prob(self, value):
+        def fn(v, a, rate):
+            return (a * jnp.log(rate) + (a - 1) * jnp.log(v) - rate * v
+                    - jax.scipy.special.gammaln(a))
+
+        return op_call(fn, _t(value), self.concentration, self.rate,
+                       name="gamma_log_prob")
+
+    def entropy(self):
+        def fn(a, rate):
+            return (a - jnp.log(rate) + jax.scipy.special.gammaln(a)
+                    + (1 - a) * jax.scipy.special.digamma(a))
+
+        return op_call(fn, self.concentration, self.rate, name="gamma_entropy")
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    def sample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self._batch_shape)
+
+        def fn(a, b):
+            return jax.random.beta(key, a, b, shp, jnp.float32)
+
+        return op_call(fn, self.alpha, self.beta, name="beta_sample").detach()
+
+    def log_prob(self, value):
+        def fn(v, a, b):
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - jax.scipy.special.betaln(a, b))
+
+        return op_call(fn, _t(value), self.alpha, self.beta, name="beta_log_prob")
+
+    def entropy(self):
+        def fn(a, b):
+            dg = jax.scipy.special.digamma
+            return (jax.scipy.special.betaln(a, b)
+                    - (a - 1) * dg(a) - (b - 1) * dg(b)
+                    + (a + b - 2) * dg(a + b))
+
+        return op_call(fn, self.alpha, self.beta, name="beta_entropy")
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self._batch_shape)
+
+        def fn(loc, scale):
+            return loc + scale * jax.random.laplace(key, shp, jnp.float32)
+
+        return op_call(fn, self.loc, self.scale, name="laplace_rsample")
+
+    def log_prob(self, value):
+        def fn(v, loc, scale):
+            return -jnp.abs(v - loc) / scale - jnp.log(2 * scale)
+
+        return op_call(fn, _t(value), self.loc, self.scale, name="laplace_log_prob")
+
+    def entropy(self):
+        return op_call(lambda s: 1.0 + jnp.log(2 * s), self.scale,
+                       name="laplace_entropy")
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * np_euler()
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self._batch_shape)
+
+        def fn(loc, scale):
+            return loc + scale * jax.random.gumbel(key, shp, jnp.float32)
+
+        return op_call(fn, self.loc, self.scale, name="gumbel_rsample")
+
+    def log_prob(self, value):
+        def fn(v, loc, scale):
+            z = (v - loc) / scale
+            return -(z + jnp.exp(-z)) - jnp.log(scale)
+
+        return op_call(fn, _t(value), self.loc, self.scale, name="gumbel_log_prob")
+
+    def entropy(self):
+        return op_call(lambda s: jnp.log(s) + 1.0 + 0.5772156649015329, self.scale,
+                       name="gumbel_entropy")
+
+
+def np_euler():
+    return 0.5772156649015329
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    def sample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self._batch_shape)
+        n = self.total_count
+        k = self.probs.shape[-1]
+
+        def fn(p):
+            logits = jnp.log(p / p.sum(-1, keepdims=True))
+            draws = jax.random.categorical(key, logits, shape=shp + (n,))
+            return jax.nn.one_hot(draws, k).sum(-2)
+
+        return op_call(fn, self.probs, name="multinomial_sample").detach()
+
+    def log_prob(self, value):
+        def fn(v, p):
+            logp = jnp.log(p / p.sum(-1, keepdims=True))
+            return (jax.scipy.special.gammaln(v.sum(-1) + 1)
+                    - jax.scipy.special.gammaln(v + 1).sum(-1)
+                    + (v * logp).sum(-1))
+
+        return op_call(fn, _t(value), self.probs, name="multinomial_log_prob")
+
+
+class Poisson(Distribution):
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self._batch_shape)
+
+        def fn(rate):
+            return jax.random.poisson(key, rate, shp).astype(jnp.float32)
+
+        return op_call(fn, self.rate, name="poisson_sample").detach()
+
+    def log_prob(self, value):
+        def fn(v, rate):
+            return v * jnp.log(rate) - rate - jax.scipy.special.gammaln(v + 1)
+
+        return op_call(fn, _t(value), self.rate, name="poisson_log_prob")
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (number of failures)."""
+
+    def __init__(self, probs):
+        self.probs = _t(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return (1.0 - self.probs) / self.probs
+
+    def sample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self._batch_shape)
+
+        def fn(p):
+            u = jax.random.uniform(key, shp, jnp.float32, 1e-7, 1.0)
+            return jnp.floor(jnp.log(u) / jnp.log1p(-p))
+
+        return op_call(fn, self.probs, name="geometric_sample").detach()
+
+    def log_prob(self, value):
+        def fn(v, p):
+            return v * jnp.log1p(-p) + jnp.log(p)
+
+        return op_call(fn, _t(value), self.probs, name="geometric_log_prob")

@@ -1,0 +1,124 @@
+"""KL divergence registry (≙ python/paddle/distribution/kl.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import op_call
+from .distributions import (
+    Bernoulli, Beta, Categorical, Exponential, Gamma, Laplace, Normal, Uniform,
+)
+
+_KL_REGISTRY: dict = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        # walk MROs for registered superclasses
+        for (pc, qc), f in _KL_REGISTRY.items():
+            if isinstance(p, pc) and isinstance(q, qc):
+                fn = f
+                break
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence({type(p).__name__}, {type(q).__name__}) not registered")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    def fn(pl, ps, ql, qs):
+        var_ratio = (ps / qs) ** 2
+        t1 = ((pl - ql) / qs) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+    return op_call(fn, p.loc, p.scale, q.loc, q.scale, name="kl_normal")
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    def fn(pl, ph, ql, qh):
+        covered = (ql <= pl) & (ph <= qh)
+        return jnp.where(covered, jnp.log((qh - ql) / (ph - pl)), jnp.inf)
+
+    return op_call(fn, p.low, p.high, q.low, q.high, name="kl_uniform")
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    def fn(pp, qp):
+        eps = 1e-7
+        pp = jnp.clip(pp, eps, 1 - eps)
+        qp = jnp.clip(qp, eps, 1 - eps)
+        return (pp * (jnp.log(pp) - jnp.log(qp))
+                + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+
+    return op_call(fn, p.probs, q.probs, name="kl_bernoulli")
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    import jax
+
+    def fn(pl, ql):
+        plog = jax.nn.log_softmax(pl, -1)
+        qlog = jax.nn.log_softmax(ql, -1)
+        return (jnp.exp(plog) * (plog - qlog)).sum(-1)
+
+    return op_call(fn, p.logits, q.logits, name="kl_categorical")
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    def fn(pr, qr):
+        ratio = qr / pr
+        return jnp.log(pr) - jnp.log(qr) + ratio - 1.0
+
+    return op_call(fn, p.rate, q.rate, name="kl_exponential")
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    import jax
+
+    def fn(pa, pb, qa, qb):
+        dg = jax.scipy.special.digamma
+        bl = jax.scipy.special.betaln
+        return (bl(qa, qb) - bl(pa, pb)
+                + (pa - qa) * dg(pa) + (pb - qb) * dg(pb)
+                + (qa - pa + qb - pb) * dg(pa + pb))
+
+    return op_call(fn, p.alpha, p.beta, q.alpha, q.beta, name="kl_beta")
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    import jax
+
+    def fn(pa, pr, qa, qr):
+        dg = jax.scipy.special.digamma
+        gl = jax.scipy.special.gammaln
+        return ((pa - qa) * dg(pa) - gl(pa) + gl(qa)
+                + qa * (jnp.log(pr) - jnp.log(qr))
+                + pa * (qr - pr) / pr)
+
+    return op_call(fn, p.concentration, p.rate, q.concentration, q.rate,
+                   name="kl_gamma")
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    def fn(pl, ps, ql, qs):
+        d = jnp.abs(pl - ql)
+        return (jnp.log(qs) - jnp.log(ps)
+                + (ps * jnp.exp(-d / ps) + d) / qs - 1.0)
+
+    return op_call(fn, p.loc, p.scale, q.loc, q.scale, name="kl_laplace")

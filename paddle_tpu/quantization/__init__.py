@@ -1,0 +1,16 @@
+"""paddle.quantization — config-driven PTQ/QAT.
+
+Reference parity: python/paddle/quantization/{config,ptq,qat}.py +
+observers/quanters. TPU-native notes: int8 weights live as jnp int8 arrays
+with per-tensor (or per-channel) scales; the fake-quant op is a
+round-to-int8 with a straight-through estimator via jax.custom_vjp (XLA
+fuses the quant-dequant chain into the surrounding matmul).
+"""
+from .config import QuantConfig
+from .observers import AbsmaxObserver, MinMaxObserver
+from .ptq import PTQ
+from .qat import QAT
+from .quanters import FakeQuanterWithAbsMax, fake_quant
+
+__all__ = ["QuantConfig", "PTQ", "QAT", "AbsmaxObserver", "MinMaxObserver",
+           "FakeQuanterWithAbsMax", "fake_quant"]

@@ -1,0 +1,49 @@
+"""Calibration observers (≙ quantization/observers/{abs_max,min_max}.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+
+class _ObserverLayer(Layer):
+    def __init__(self, bit_length: int = 8):
+        super().__init__()
+        self.bit_length = bit_length
+
+    @property
+    def qmax(self):
+        return float(2 ** (self.bit_length - 1) - 1)
+
+    def scales(self) -> float:
+        raise NotImplementedError
+
+
+class AbsmaxObserver(_ObserverLayer):
+    def __init__(self, quant_bits: int = 8, **kw):
+        super().__init__(quant_bits)
+        self._absmax = 0.0
+
+    def forward(self, x: Tensor) -> Tensor:
+        self._absmax = max(self._absmax, float(jnp.max(jnp.abs(x._data))))
+        return x
+
+    def scales(self) -> float:
+        return max(self._absmax, 1e-8) / self.qmax
+
+
+class MinMaxObserver(_ObserverLayer):
+    def __init__(self, quant_bits: int = 8, **kw):
+        super().__init__(quant_bits)
+        self._min = float("inf")
+        self._max = float("-inf")
+
+    def forward(self, x: Tensor) -> Tensor:
+        self._min = min(self._min, float(jnp.min(x._data)))
+        self._max = max(self._max, float(jnp.max(x._data)))
+        return x
+
+    def scales(self) -> float:
+        bound = max(abs(self._min), abs(self._max), 1e-8)
+        return bound / self.qmax

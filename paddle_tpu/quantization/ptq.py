@@ -1,0 +1,111 @@
+"""Post-training quantization (≙ python/paddle/quantization/ptq.py).
+
+flow: q_model = PTQ(config).quantize(model) → run calibration batches →
+PTQ.convert(q_model) freezes int8 weights + scales (QuantizedLinear).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.dispatch import op_call
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+
+class _ObservedLayer(Layer):
+    """Wraps one layer with activation/weight observers during calibration."""
+
+    def __init__(self, inner, act_observer=None, weight_observer=None):
+        super().__init__()
+        self.inner = inner
+        self.act_observer = act_observer() if isinstance(act_observer, type) \
+            else act_observer
+        self.weight_observer = weight_observer() if isinstance(weight_observer, type) \
+            else weight_observer
+        if self.weight_observer is not None and hasattr(inner, "weight"):
+            self.weight_observer(inner.weight)
+
+    def forward(self, x):
+        if self.act_observer is not None:
+            x = self.act_observer(x)
+        return self.inner(x)
+
+
+class QuantizedLinear(Layer):
+    """int8 weight + fp scale; forward dequantizes into the matmul (XLA
+    fuses the dequant into the GEMM — the int8 tensor is what ships in a
+    checkpoint)."""
+
+    def __init__(self, linear, weight_scale: float, act_scale: float | None = None,
+                 bit_length: int = 8):
+        super().__init__()
+        qmax = float(2 ** (bit_length - 1) - 1)
+        w = linear.weight._data
+        self.w_int8 = jnp.clip(jnp.round(w / weight_scale), -qmax - 1, qmax
+                               ).astype(jnp.int8)
+        self.weight_scale = float(weight_scale)
+        self.act_scale = act_scale
+        self.bias = getattr(linear, "bias", None)
+        self.bit_length = bit_length
+
+    def forward(self, x):
+        w_int8 = self.w_int8
+        ws = self.weight_scale
+        a_s = self.act_scale
+        qmax = float(2 ** (self.bit_length - 1) - 1)
+
+        def fn(xv, *maybe_bias):
+            if a_s is not None:
+                xv = jnp.clip(jnp.round(xv / a_s), -qmax - 1, qmax) * a_s
+            out = xv @ (w_int8.astype(xv.dtype) * ws)
+            if maybe_bias:
+                out = out + maybe_bias[0]
+            return out
+
+        args = [x] + ([self.bias] if self.bias is not None else [])
+        return op_call(fn, *args, name="quantized_linear")
+
+
+class PTQ:
+    def __init__(self, config):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        from ..nn import Linear
+
+        for name, child in list(model.named_sublayers()):
+            cfg = self.config.config_for(name, child)
+            if cfg is None or not isinstance(child, Linear):
+                continue
+            wrapped = _ObservedLayer(child, cfg.activation, cfg.weight)
+            _replace_child(model, name, wrapped)
+        return model
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        for name, child in list(model.named_sublayers()):
+            if isinstance(child, _ObservedLayer):
+                w_scale = child.weight_observer.scales() \
+                    if child.weight_observer else None
+                a_scale = child.act_observer.scales() \
+                    if child.act_observer else None
+                if w_scale is None:
+                    _replace_child(model, name, child.inner)
+                    continue
+                q = QuantizedLinear(child.inner, w_scale, a_scale)
+                _replace_child(model, name, q)
+        return model
+
+
+def _replace_child(model: Layer, dotted: str, new: Layer):
+    parts = dotted.split(".")
+    node = model
+    for p in parts[:-1]:
+        node = getattr(node, p) if not p.isdigit() else node[int(p)]
+    last = parts[-1]
+    if last.isdigit() and hasattr(node, "__setitem__"):
+        node[int(last)] = new
+    else:
+        node.add_sublayer(last, new) if hasattr(node, "add_sublayer") else \
+            setattr(node, last, new)

@@ -1,0 +1,63 @@
+"""Fake quantization with straight-through gradients."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op_call
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+
+@jax.custom_vjp
+def _fake_quant_ste(x, scale, qmax):
+    q = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax)
+    return q * scale
+
+
+def _fq_fwd(x, scale, qmax):
+    return _fake_quant_ste(x, scale, qmax), (x, scale, qmax)
+
+
+def _fq_bwd(res, g):
+    x, scale, qmax = res
+    # STE: pass-through inside the representable range, zero outside
+    inside = (jnp.abs(x) <= scale * (qmax + 1)).astype(g.dtype)
+    return g * inside, jnp.zeros_like(scale), None
+
+
+_fake_quant_ste.defvjp(_fq_fwd, _fq_bwd)
+
+
+def fake_quant(x: Tensor, scale, bit_length: int = 8) -> Tensor:
+    """Quantize-dequantize with STE gradient (≙ quanters/abs_max.py)."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+    sc = scale._data if isinstance(scale, Tensor) else jnp.asarray(scale, jnp.float32)
+
+    def fn(v, s):
+        return _fake_quant_ste(v, s, qmax)
+
+    return op_call(fn, x, Tensor(sc, _internal=True), name="fake_quant", n_diff=1)
+
+
+class FakeQuanterWithAbsMax(Layer):
+    """QAT quanter: running abs-max scale + fake quant each forward."""
+
+    def __init__(self, bit_length: int = 8, moving_rate: float = 0.9, **kw):
+        super().__init__()
+        self.bit_length = bit_length
+        self.moving_rate = moving_rate
+        self._scale = None
+
+    def forward(self, x: Tensor) -> Tensor:
+        qmax = float(2 ** (self.bit_length - 1) - 1)
+        cur = float(jnp.max(jnp.abs(x._data))) / qmax or 1e-8
+        if self._scale is None:
+            self._scale = cur
+        else:
+            r = self.moving_rate
+            self._scale = r * self._scale + (1 - r) * cur
+        return fake_quant(x, max(self._scale, 1e-8), self.bit_length)
+
+    def scales(self):
+        return self._scale

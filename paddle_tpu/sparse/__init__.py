@@ -1,0 +1,242 @@
+"""paddle.sparse — COO/CSR sparse tensors.
+
+Reference parity: python/paddle/sparse (SparseCooTensor/SparseCsrTensor
+API over paddle/phi/kernels/sparse/, ~21k LoC of CUDA). TPU-native: the
+storage/compute engine is jax.experimental.sparse (BCOO) — XLA lowers
+sparse ops to gather/scatter/segment-sum; dense bridging via todense().
+
+Autograd: a sparse Tensor carries its VALUES as a real framework Tensor
+(`._spvals`), and every sparse op dispatches on it through op_call — so
+gradients flow back to the values the user built the tensor from, exactly
+like the reference's differentiable sparse kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.dispatch import op_call
+from ..core.tensor import Tensor
+from . import nn  # noqa: F401  (re-export subpackage)
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "is_sparse", "is_sparse_coo",
+    "is_sparse_csr", "matmul", "masked_matmul", "add", "multiply", "subtract",
+    "relu", "abs", "sin", "tanh", "sqrt", "pow", "neg", "cast", "transpose",
+    "nn",
+]
+
+
+def _data_of(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _build(values: Tensor, indices, shape) -> Tensor:
+    """Assemble a sparse Tensor around a values Tensor (graph-preserving)."""
+    t = Tensor(jnp.zeros((), values._data.dtype), _internal=True,
+               stop_gradient=values.stop_gradient)
+    t._spvals = values
+    t._spidx = jnp.asarray(indices)  # [nnz, ndim]
+    t._spshape = tuple(int(s) for s in shape)
+    return t
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """Build a COO sparse Tensor: indices [ndim, nnz], values [nnz, ...]."""
+    idx = np.asarray(_data_of(indices))
+    if isinstance(values, Tensor):
+        vt = values
+    else:
+        vt = Tensor(jnp.asarray(values), _internal=True,
+                    stop_gradient=stop_gradient)
+    if dtype is not None:
+        from ..core import dtype as dtypes
+
+        vt = vt.astype(dtypes.convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in idx.max(axis=1))
+    vt.stop_gradient = stop_gradient and vt.stop_gradient
+    return _build(vt, idx.T, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    """CSR: stored as COO internally (XLA has one sparse lowering path);
+    crows/cols layout is preserved for round-tripping."""
+    crows = np.asarray(_data_of(crows)).astype(np.int32)
+    cols = np.asarray(_data_of(cols)).astype(np.int32)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    t = sparse_coo_tensor(np.stack([rows, cols]), values, shape, dtype,
+                          stop_gradient=stop_gradient)
+    t._csr = (crows, cols)
+    return t
+
+
+def is_sparse(x) -> bool:
+    return getattr(x, "_spvals", None) is not None
+
+
+def is_sparse_coo(x) -> bool:
+    return is_sparse(x) and getattr(x, "_csr", None) is None
+
+
+def is_sparse_csr(x) -> bool:
+    return is_sparse(x) and getattr(x, "_csr", None) is not None
+
+
+def _check_sparse(x):
+    if not is_sparse(x):
+        raise TypeError("expected a sparse Tensor")
+    return x
+
+
+def _bcoo(x) -> jsparse.BCOO:
+    _check_sparse(x)
+    return jsparse.BCOO((x._spvals._data, x._spidx), shape=x._spshape)
+
+
+# --------------------------------------------------------------- conversions
+def to_dense(x) -> Tensor:
+    _check_sparse(x)
+    idx, shape = x._spidx, x._spshape
+
+    def fn(vals):
+        return jsparse.BCOO((vals, idx), shape=shape).todense()
+
+    return op_call(fn, x._spvals, name="coo_to_dense")
+
+
+def to_sparse_coo(x, sparse_dim=None) -> Tensor:
+    """Dense -> COO. The value gather is dispatched, so gradients flow back
+    into the dense source."""
+    arr = _data_of(x)
+    snapshot = np.asarray(jax.device_get(arr))
+    idx = np.argwhere(snapshot != 0)
+    gather = tuple(jnp.asarray(idx[:, d]) for d in range(idx.shape[1]))
+    xt = x if isinstance(x, Tensor) else Tensor(jnp.asarray(arr), _internal=True)
+    vals = op_call(lambda d: d[gather], xt, name="coo_gather_values")
+    return _build(vals, idx, snapshot.shape)
+
+
+# --------------------------------------------------------------- compute
+def matmul(x, y, name=None) -> Tensor:
+    """sparse @ dense -> dense (the training hot path)."""
+    _check_sparse(x)
+    idx, shape = x._spidx, x._spshape
+    yt = y if isinstance(y, Tensor) else Tensor(jnp.asarray(y), _internal=True)
+
+    def fn(vals, dense):
+        return jsparse.BCOO((vals, idx), shape=shape) @ dense
+
+    return op_call(fn, x._spvals, yt, name="sparse_matmul")
+
+
+def masked_matmul(x, y, mask, name=None) -> Tensor:
+    """dense @ dense, output only at mask's nonzero positions (SDDMM)."""
+    _check_sparse(mask)
+    idx, shape = mask._spidx, mask._spshape
+    xt = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x), _internal=True)
+    yt = y if isinstance(y, Tensor) else Tensor(jnp.asarray(y), _internal=True)
+    rows, cols = jnp.asarray(idx[:, 0]), jnp.asarray(idx[:, 1])
+
+    def fn(a, b):
+        return (a[rows] * b[:, cols].T).sum(-1)
+
+    vals = op_call(fn, xt, yt, name="masked_matmul")
+    return _build(vals, idx, shape)
+
+
+def _ewise(x, y, jnp_fn, name):
+    """Elementwise over (possibly different) patterns via dense align; the
+    whole chain is dispatched so both inputs receive gradients."""
+    da, db = to_dense(x), to_dense(y)
+    dense = op_call(jnp_fn, da, db, name=name)
+    return to_sparse_coo(dense)
+
+
+def add(x, y, name=None):
+    return _ewise(x, y, jnp.add, "sparse_add")
+
+
+def subtract(x, y, name=None):
+    return _ewise(x, y, jnp.subtract, "sparse_subtract")
+
+
+def multiply(x, y, name=None):
+    return _ewise(x, y, jnp.multiply, "sparse_multiply")
+
+
+def _unary(x, jnp_fn, name):
+    _check_sparse(x)
+    vals = op_call(jnp_fn, x._spvals, name=name)
+    return _build(vals, x._spidx, x._spshape)
+
+
+def relu(x, name=None):
+    return _unary(x, lambda v: jnp.maximum(v, 0), "sparse_relu")
+
+
+def abs(x, name=None):
+    return _unary(x, jnp.abs, "sparse_abs")
+
+
+def sin(x, name=None):
+    return _unary(x, jnp.sin, "sparse_sin")
+
+
+def tanh(x, name=None):
+    return _unary(x, jnp.tanh, "sparse_tanh")
+
+
+def sqrt(x, name=None):
+    return _unary(x, jnp.sqrt, "sparse_sqrt")
+
+
+def pow(x, factor, name=None):
+    return _unary(x, lambda v: jnp.power(v, factor), "sparse_pow")
+
+
+def neg(x, name=None):
+    return _unary(x, jnp.negative, "sparse_neg")
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..core import dtype as dtypes
+
+    if value_dtype is None:
+        return x
+    return _unary(x, lambda v: v.astype(dtypes.convert_dtype(value_dtype)),
+                  "sparse_cast")
+
+
+def transpose(x, perm, name=None):
+    _check_sparse(x)
+    idx = np.asarray(x._spidx)[:, list(perm)]
+    shape = tuple(x._spshape[p] for p in perm)
+    return _build(x._spvals, idx, shape)
+
+
+# Tensor methods (paddle exposes these on Tensor directly)
+def _install_tensor_methods():
+    Tensor.to_dense = lambda self: to_dense(self) if is_sparse(self) else self
+    Tensor.to_sparse_coo = lambda self, sparse_dim=None: to_sparse_coo(self, sparse_dim)
+    Tensor.is_sparse = lambda self: is_sparse(self)
+    Tensor.is_sparse_coo = lambda self: is_sparse_coo(self)
+    Tensor.is_sparse_csr = lambda self: is_sparse_csr(self)
+
+    def _values(self):
+        return _check_sparse(self)._spvals
+
+    def _indices(self):
+        return Tensor(_check_sparse(self)._spidx.T, _internal=True)
+
+    Tensor.values = _values
+    Tensor.indices = _indices
+    Tensor.nnz = lambda self: int(_check_sparse(self)._spidx.shape[0])
+
+
+_install_tensor_methods()

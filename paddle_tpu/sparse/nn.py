@@ -1,0 +1,37 @@
+"""paddle.sparse.nn — activations/layers over sparse tensors (subset)."""
+from __future__ import annotations
+
+from ..nn.layer_base import Layer
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from . import relu
+
+        return relu(x)
+
+
+class Softmax(Layer):
+    """Row-wise softmax over CSR/COO values (≙ sparse.nn.Softmax)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        from . import to_dense, to_sparse_coo
+        import paddle_tpu.nn.functional as F
+
+        dense = to_dense(x)
+        # -inf at structural zeros so they stay zero probability
+        import jax.numpy as jnp
+
+        from ..core.dispatch import op_call
+
+        mask = op_call(lambda d: (d != 0).astype(d.dtype), dense, name="nonzero_mask")
+        out = F.softmax(
+            op_call(lambda d, m: jnp.where(m > 0, d, -jnp.inf), dense, mask,
+                    name="mask_fill"), axis=self.axis)
+        out = op_call(lambda o, m: jnp.where(m > 0, o, 0.0), out, mask,
+                      name="mask_zero")
+        return to_sparse_coo(out)

@@ -1,0 +1,63 @@
+"""paddle.static — static-graph compatibility surface.
+
+By design (SURVEY §7: "do NOT rebuild ProgramDesc/PIR — jaxpr/StableHLO are
+the IR"), there is no separate static-graph engine: `paddle.jit.to_static`
+compiles whole programs through XLA. This module keeps the load-bearing
+pieces of the static API:
+
+* InputSpec — shape/dtype specs for jit.save / to_static input signatures.
+* enable_static/disable_static — explicit, actionable errors pointing at
+  the to_static path (≙ reference python/paddle/base/framework.py switch).
+* name helpers that are harmless no-ops under eager-only execution.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..jit.save_load import InputSpec
+
+__all__ = ["InputSpec", "enable_static", "disable_static", "in_static_mode",
+           "name_scope", "default_main_program", "default_startup_program",
+           "Program", "program_guard"]
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle.static graph mode is not part of the TPU-native design: the "
+        "XLA program built by paddle.jit.to_static IS the static graph. "
+        "Decorate your train step with @paddle.jit.to_static instead.")
+
+
+def disable_static():
+    return None  # eager is the only mode: nothing to do
+
+
+def in_static_mode() -> bool:
+    return False
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str = ""):
+    yield
+
+
+class Program:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "paddle.static.Program: use paddle.jit.to_static — jaxpr/StableHLO "
+            "replace ProgramDesc (SURVEY §7)")
+
+
+def default_main_program():
+    raise NotImplementedError(
+        "no global Program in the TPU-native design; see paddle.jit.to_static")
+
+
+default_startup_program = default_main_program
+
+
+@contextlib.contextmanager
+def program_guard(*a, **k):
+    raise NotImplementedError(
+        "program_guard: use paddle.jit.to_static to capture a program")
+    yield

@@ -1,0 +1,208 @@
+"""distribution / sparse / quantization / static package tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distribution import (
+    Bernoulli, Beta, Categorical, Exponential, Gamma, Laplace, Normal,
+    Uniform, kl_divergence,
+)
+
+
+class TestDistributions:
+    def test_normal_moments_and_logprob(self):
+        d = Normal(loc=1.0, scale=2.0)
+        paddle.seed(0)
+        s = d.sample([20000])
+        assert abs(float(s.mean().numpy()) - 1.0) < 0.1
+        assert abs(float(s.std().numpy()) - 2.0) < 0.1
+        lp = d.log_prob(paddle.to_tensor(np.array(1.0, "float32")))
+        expect = -np.log(2.0) - 0.5 * np.log(2 * np.pi)
+        np.testing.assert_allclose(float(lp.numpy()), expect, rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy().numpy()),
+                                   0.5 + 0.5 * np.log(2 * np.pi) + np.log(2.0),
+                                   rtol=1e-6)
+
+    def test_normal_rsample_differentiable(self):
+        loc = paddle.to_tensor(np.array(0.5, "float32"))
+        loc.stop_gradient = False
+        d = Normal(loc=loc, scale=1.0)
+        paddle.seed(1)
+        out = d.rsample([64]).mean()
+        out.backward()
+        np.testing.assert_allclose(float(loc.grad.numpy()), 1.0, rtol=1e-5)
+
+    def test_uniform_bernoulli_categorical(self):
+        paddle.seed(2)
+        u = Uniform(low=-1.0, high=3.0)
+        s = u.sample([10000])
+        assert -1.0 <= float(s.min().numpy()) and float(s.max().numpy()) < 3.0
+        np.testing.assert_allclose(float(u.entropy().numpy()), np.log(4.0), rtol=1e-6)
+
+        b = Bernoulli(probs=0.7)
+        sb = b.sample([10000])
+        assert abs(float(sb.mean().numpy()) - 0.7) < 0.03
+
+        c = Categorical(logits=np.zeros(4, "float32"))
+        sc = c.sample([8000])
+        counts = np.bincount(np.asarray(sc.numpy()).astype(int), minlength=4)
+        assert (counts > 1500).all()
+        np.testing.assert_allclose(float(c.entropy().numpy()), np.log(4.0), rtol=1e-5)
+
+    def test_gamma_beta_laplace_exponential_logprobs(self):
+        # spot-check densities against scipy-free closed forms
+        g = Gamma(concentration=2.0, rate=3.0)
+        lp = float(g.log_prob(paddle.to_tensor(np.array(1.0, "float32"))).numpy())
+        np.testing.assert_allclose(lp, np.log(9.0 * 1.0 * np.exp(-3.0)), rtol=1e-5)
+
+        be = Beta(alpha=2.0, beta=2.0)
+        lp = float(be.log_prob(paddle.to_tensor(np.array(0.5, "float32"))).numpy())
+        np.testing.assert_allclose(lp, np.log(1.5), rtol=1e-5)
+
+        la = Laplace(loc=0.0, scale=1.0)
+        lp = float(la.log_prob(paddle.to_tensor(np.array(0.0, "float32"))).numpy())
+        np.testing.assert_allclose(lp, -np.log(2.0), rtol=1e-6)
+
+        ex = Exponential(rate=2.0)
+        lp = float(ex.log_prob(paddle.to_tensor(np.array(1.0, "float32"))).numpy())
+        np.testing.assert_allclose(lp, np.log(2.0) - 2.0, rtol=1e-6)
+
+    def test_kl_divergences(self):
+        p = Normal(0.0, 1.0)
+        q = Normal(1.0, 2.0)
+        kl = float(kl_divergence(p, q).numpy())
+        expect = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(kl, expect, rtol=1e-5)
+        assert float(kl_divergence(p, p).numpy()) == pytest.approx(0.0, abs=1e-6)
+
+        b1, b2 = Bernoulli(probs=0.3), Bernoulli(probs=0.6)
+        kl = float(kl_divergence(b1, b2).numpy())
+        expect = 0.3 * np.log(0.3 / 0.6) + 0.7 * np.log(0.7 / 0.4)
+        np.testing.assert_allclose(kl, expect, rtol=1e-5)
+
+        c1 = Categorical(logits=np.array([0.0, 1.0], "float32"))
+        c2 = Categorical(logits=np.array([1.0, 0.0], "float32"))
+        assert float(kl_divergence(c1, c2).numpy()) > 0
+
+
+class TestSparse:
+    def test_coo_roundtrip(self):
+        idx = np.array([[0, 1, 2], [1, 0, 2]])
+        vals = np.array([1.0, 2.0, 3.0], "float32")
+        sp = paddle.sparse.sparse_coo_tensor(idx, vals, shape=[3, 3])
+        assert sp.is_sparse() and sp.is_sparse_coo()
+        assert sp.nnz() == 3
+        dense = sp.to_dense()
+        expect = np.zeros((3, 3), "float32")
+        expect[0, 1], expect[1, 0], expect[2, 2] = 1, 2, 3
+        np.testing.assert_array_equal(dense.numpy(), expect)
+        back = dense.to_sparse_coo()
+        np.testing.assert_array_equal(back.values().numpy(), [1, 2, 3])
+
+    def test_csr_roundtrip(self):
+        crows = np.array([0, 1, 3])
+        cols = np.array([1, 0, 2])
+        vals = np.array([5.0, 6.0, 7.0], "float32")
+        sp = paddle.sparse.sparse_csr_tensor(crows, cols, vals, shape=[2, 3])
+        assert sp.is_sparse_csr()
+        expect = np.array([[0, 5, 0], [6, 0, 7]], "float32")
+        np.testing.assert_array_equal(sp.to_dense().numpy(), expect)
+
+    def test_spmm_forward_backward(self):
+        idx = np.array([[0, 1], [1, 0]])
+        vals = np.array([2.0, 3.0], "float32")
+        sp = paddle.sparse.sparse_coo_tensor(idx, vals, shape=[2, 2],
+                                             stop_gradient=True)
+        y = paddle.to_tensor(np.eye(2, dtype="float32") * 4)
+        out = paddle.sparse.matmul(sp, y)
+        np.testing.assert_array_equal(out.numpy(), [[0, 8], [12, 0]])
+
+    def test_sparse_unary_and_add(self):
+        idx = np.array([[0, 1], [0, 1]])
+        a = paddle.sparse.sparse_coo_tensor(idx, np.array([-1.0, 2.0], "float32"),
+                                            [2, 2])
+        r = paddle.sparse.relu(a)
+        np.testing.assert_array_equal(r.values().numpy(), [0.0, 2.0])
+        s = paddle.sparse.add(a, a)
+        np.testing.assert_array_equal(
+            s.to_dense().numpy(), np.diag([-2.0, 4.0]).astype("float32"))
+
+
+class TestQuantization:
+    def _model(self):
+        paddle.seed(0)
+        return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+    def test_ptq_flow_accuracy(self):
+        from paddle_tpu.quantization import AbsmaxObserver, PTQ, QuantConfig
+
+        model = self._model()
+        x = paddle.rand([16, 8])
+        ref = model(x).numpy()
+        cfg = QuantConfig(activation=AbsmaxObserver, weight=AbsmaxObserver)
+        ptq = PTQ(cfg)
+        model = ptq.quantize(model)
+        for _ in range(3):  # calibration
+            model(x)
+        model = ptq.convert(model)
+        from paddle_tpu.quantization.ptq import QuantizedLinear
+
+        qlayers = [l for _n, l in model.named_sublayers()
+                   if isinstance(l, QuantizedLinear)]
+        assert len(qlayers) == 2
+        assert str(qlayers[0].w_int8.dtype) == "int8"
+        out = model(x).numpy()
+        # int8 quantization error stays small on calibrated ranges
+        assert np.abs(out - ref).max() < np.abs(ref).max() * 0.1
+
+    def test_qat_trains_through_fake_quant(self):
+        from paddle_tpu.quantization import QAT, QuantConfig
+
+        model = self._model()
+        cfg = QuantConfig(activation=None, weight=None)
+        from paddle_tpu.quantization import FakeQuanterWithAbsMax
+
+        cfg2 = QuantConfig(activation=FakeQuanterWithAbsMax,
+                           weight=FakeQuanterWithAbsMax)
+        model = QAT(cfg2).quantize(model)
+        opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                    parameters=model.parameters())
+        rs = np.random.RandomState(0)
+        X = paddle.to_tensor(rs.randn(32, 8).astype("float32"))
+        Y = paddle.to_tensor(rs.randint(0, 4, (32,)).astype("int64"))
+        import paddle_tpu.nn.functional as F
+
+        losses = []
+        for _ in range(15):
+            loss = F.cross_entropy(model(X), Y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.8, losses
+
+    def test_fake_quant_ste_gradient(self):
+        from paddle_tpu.quantization import fake_quant
+
+        x = paddle.to_tensor(np.array([0.5, -0.25, 10.0], "float32"))
+        x.stop_gradient = False
+        y = fake_quant(x, scale=0.01)  # 10.0 is out of range -> clipped
+        y.sum().backward()
+        np.testing.assert_array_equal(x.grad.numpy(), [1.0, 1.0, 0.0])
+
+
+class TestStatic:
+    def test_input_spec(self):
+        spec = paddle.static.InputSpec([None, 8], "float32")
+        assert list(spec.shape)[1] == 8
+
+    def test_enable_static_raises_actionably(self):
+        with pytest.raises(NotImplementedError, match="to_static"):
+            paddle.static.enable_static()
+        assert paddle.static.in_static_mode() is False
+
+    def test_name_scope_noop(self):
+        with paddle.static.name_scope("foo"):
+            y = paddle.rand([2])
+        assert y.shape == [2]
