@@ -233,10 +233,17 @@ def run_backward(root: Tensor, grad_tensor=None, retain_graph: bool = False,
             in_grads = None
             if create_graph:
                 if node.ctx is None:
-                    # hand-built GradNodes (PyLayer, fleet recompute,
-                    # pipeline transfers) carry no re-derivation context —
-                    # silently treating their cotangents as constants would
-                    # drop Hessian terms, so refuse loudly
+                    # no re-derivation context — silently treating the
+                    # cotangents as constants would drop Hessian terms,
+                    # so refuse loudly
+                    from .flags import flag as _flag
+
+                    if not _flag("FLAGS_enable_double_grad"):
+                        raise NotImplementedError(
+                            "create_graph=True needs per-node re-derivation "
+                            "ctx, but FLAGS_enable_double_grad is disabled — "
+                            "re-enable it (paddle.set_flags) and rebuild the "
+                            "graph")
                     raise NotImplementedError(
                         f"create_graph=True through '{node.name}' "
                         "(a hand-built GradNode) is not supported; use "

@@ -49,9 +49,13 @@ from .auto_parallel import (
     shard_layer,
 )
 from . import auto_parallel
+from . import auto_tuner
 from . import checkpoint
 from .checkpoint import save_state_dict, load_state_dict
 from . import fleet
+from . import launch
+from . import rpc
+from .spawn import spawn
 from . import meta_parallel
 from . import sharding
 from .sharding import group_sharded_parallel, save_group_sharded_model

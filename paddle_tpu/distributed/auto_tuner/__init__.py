@@ -1,0 +1,3 @@
+from .tuner import AutoTuner, Candidate, default_memory_model
+
+__all__ = ["AutoTuner", "Candidate", "default_memory_model"]

@@ -1,0 +1,133 @@
+"""Hybrid-parallel config auto-tuner.
+
+Reference parity: python/paddle/distributed/auto_tuner/{tuner,search,prune,
+cost_model}.py — enumerate (dp, mp, pp, sharding stage, micro-batch)
+candidates, prune with divisibility + memory models, launch trial runs,
+keep the fastest. TPU-native pruning: mp should divide heads AND stay
+inside a chip's ICI neighborhood; memory model counts params/grads/opt
+states/activations in bytes against per-chip HBM.
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Candidate:
+    dp: int
+    mp: int
+    pp: int
+    sharding_stage: int = 0      # 0: none, 1/2/3: ZeRO level
+    micro_batch: int = 1
+    vpp: int = 1
+    metric: float | None = None  # filled by trials (higher is better)
+    error: str | None = None
+
+    @property
+    def degree(self) -> int:
+        return self.dp * self.mp * self.pp
+
+
+def default_memory_model(cand: Candidate, *, n_params: float,
+                         hidden: int, layers: int, seq_len: int,
+                         global_batch: int, bytes_per_param: int = 4,
+                         optimizer_factor: float = 3.0) -> float:
+    """Bytes per chip: params+grads+opt (sharded by mp/pp and ZeRO) +
+    activations (micro-batched, sharded by mp, rematerialization ignored)."""
+    shard = cand.mp * cand.pp
+    state = n_params / shard * bytes_per_param
+    grads = state
+    opt = state * optimizer_factor
+    if cand.sharding_stage >= 1:
+        opt /= cand.dp
+    if cand.sharding_stage >= 2:
+        grads /= cand.dp
+    if cand.sharding_stage >= 3:
+        state /= cand.dp
+    # in-flight activations: one micro-batch per live pipeline stage
+    acts = (cand.micro_batch * seq_len * hidden * (layers / cand.pp)
+            * 16 * bytes_per_param / cand.mp)
+    return state + grads + opt + acts
+
+
+class AutoTuner:
+    """tuner = AutoTuner(n_chips=64, config); best = tuner.tune(trial_fn)
+
+    trial_fn(candidate) -> throughput metric (higher better); raise to
+    mark the candidate infeasible (OOM etc.).
+    """
+
+    def __init__(self, n_chips: int, *, num_heads: int | None = None,
+                 num_layers: int | None = None, global_batch: int = 1,
+                 max_mp: int = 8, max_pp: int = 16,
+                 sharding_stages=(0, 1, 2), micro_batches=(1, 2, 4, 8),
+                 memory_limit_bytes: float | None = None,
+                 memory_model=None):
+        self.n_chips = n_chips
+        self.num_heads = num_heads
+        self.num_layers = num_layers
+        self.global_batch = global_batch
+        self.max_mp = max_mp
+        self.max_pp = max_pp
+        self.sharding_stages = tuple(sharding_stages)
+        self.micro_batches = tuple(micro_batches)
+        self.memory_limit = memory_limit_bytes
+        self.memory_model = memory_model
+        self.history: list[Candidate] = []
+
+    # ------------------------------------------------------------ search
+    def candidates(self) -> list[Candidate]:
+        """Exhaustive feasible set after pruning (≙ search.py + prune.py)."""
+        out = []
+        n = self.n_chips
+        for mp in _divisors(n):
+            if mp > self.max_mp:
+                continue
+            if self.num_heads and self.num_heads % mp:
+                continue  # heads must split evenly across mp
+            for pp in _divisors(n // mp):
+                if pp > self.max_pp:
+                    continue
+                if self.num_layers and self.num_layers % pp:
+                    continue
+                dp = n // (mp * pp)
+                for stage in self.sharding_stages:
+                    if stage > 0 and dp == 1:
+                        continue  # ZeRO needs a dp axis to shard over
+                    for mb in self.micro_batches:
+                        if self.global_batch % (dp * mb):
+                            continue
+                        if pp > 1 and (self.global_batch // dp) // mb < pp:
+                            continue  # not enough micro-batches to fill pipe
+                        cand = Candidate(dp, mp, pp, stage, mb)
+                        if self.memory_limit and self.memory_model and \
+                                self.memory_model(cand) > self.memory_limit:
+                            continue
+                        out.append(cand)
+        return out
+
+    def tune(self, trial_fn, max_trials: int | None = None) -> Candidate | None:
+        """Run trials best-guess-first, return the best candidate."""
+        cands = self.candidates()
+        # heuristic order: fewer pipeline stages, more dp first (cheap
+        # comms), bigger micro-batch last
+        cands.sort(key=lambda c: (c.pp, c.mp, c.micro_batch))
+        if max_trials is not None:
+            cands = cands[:max_trials]
+        best = None
+        for cand in cands:
+            try:
+                cand.metric = float(trial_fn(cand))
+            except Exception as e:  # infeasible trial (OOM, ...)
+                cand.error = f"{type(e).__name__}: {e}"
+                self.history.append(cand)
+                continue
+            self.history.append(cand)
+            if best is None or cand.metric > best.metric:
+                best = cand
+        return best
+
+
+def _divisors(n: int):
+    return [d for d in range(1, n + 1) if n % d == 0]

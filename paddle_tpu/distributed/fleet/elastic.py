@@ -1,0 +1,96 @@
+"""Elastic training manager (≙ fleet/elastic/manager.py:125).
+
+Reference: etcd-based membership over an `np` range "min:max"; node
+joins/exits signal the launch controller to relaunch with a new world size.
+TPU-native: XLA collectives have no per-collective abort, so elasticity is
+checkpoint-resume shaped (SURVEY §5.3): the manager tracks member
+heartbeats (filesystem store — the coordination-service analog that works
+with zero extra deps), decides pod health, and tells the launcher whether
+to RELAUNCH (with the surviving world size) or WAIT. Pair with
+paddle.distributed.checkpoint reshard-on-load to resume on the new mesh.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, job_id: str = "default", np_range: str = "1:1",
+                 store_dir: str | None = None, heartbeat_interval: float = 2.0,
+                 timeout: float = 10.0):
+        lo, _, hi = str(np_range).partition(":")
+        self.min_np = int(lo)
+        self.max_np = int(hi or lo)
+        self.job_id = job_id
+        self.interval = heartbeat_interval
+        self.timeout = timeout
+        self.store = store_dir or os.path.join(
+            os.environ.get("PADDLE_ELASTIC_STORE", "/tmp"),
+            f"paddle_elastic_{job_id}")
+        os.makedirs(self.store, exist_ok=True)
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    # ------------------------------------------------------------ membership
+    def _beat_path(self, rank):
+        return os.path.join(self.store, f"node.{rank}.json")
+
+    def heartbeat(self):
+        with open(self._beat_path(self.rank), "w") as f:
+            json.dump({"rank": self.rank, "ts": time.time()}, f)
+
+    def alive_members(self) -> list[int]:
+        now = time.time()
+        out = []
+        for fname in os.listdir(self.store):
+            if not fname.startswith("node."):
+                continue
+            try:
+                with open(os.path.join(self.store, fname)) as f:
+                    rec = json.load(f)
+                if now - rec["ts"] <= self.timeout:
+                    out.append(int(rec["rank"]))
+            except (ValueError, OSError):
+                continue
+        return sorted(out)
+
+    def leave(self):
+        try:
+            os.remove(self._beat_path(self.rank))
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------ decisions
+    def pod_status(self) -> str:
+        n = len(self.alive_members())
+        if n >= self.min_np:
+            return ElasticStatus.HOLD if n < self.max_np else ElasticStatus.COMPLETED
+        return ElasticStatus.RESTART
+
+    def should_relaunch(self, expected_np: int) -> bool:
+        """True when membership changed but the job is still viable —
+        the launcher should respawn with the new world size + ckpt resume."""
+        n = len(self.alive_members())
+        return n != expected_np and n >= self.min_np
+
+    def wait_for_ready(self, max_wait: float = 60.0) -> int:
+        """Block until >= min_np members are alive; returns the world size."""
+        deadline = time.time() + max_wait
+        while time.time() < deadline:
+            self.heartbeat()
+            n = len(self.alive_members())
+            if n >= self.min_np:
+                return n
+            time.sleep(self.interval)
+        raise TimeoutError(
+            f"elastic: only {len(self.alive_members())} of min {self.min_np} "
+            "members after waiting")

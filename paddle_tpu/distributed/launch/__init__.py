@@ -1,0 +1,3 @@
+from .main import launch_pod, main
+
+__all__ = ["main", "launch_pod"]

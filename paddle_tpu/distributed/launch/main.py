@@ -1,0 +1,150 @@
+"""python -m paddle_tpu.distributed.launch — multi-process job launcher.
+
+Reference parity: python/paddle/distributed/launch/main.py:23 +
+CollectiveController.build_pod (launch/controllers/collective.py:22,37):
+parse topology args, write per-rank envs (PADDLE_TRAINER_ID, endpoints,
+master), spawn one OS process per rank, watch them, tear the pod down on
+failure and (elastic) relaunch up to max_restarts.
+
+TPU-native notes: on a TPU pod slice the unit is one process per HOST
+(each sees its local chips; jax.distributed.initialize wires the slice), so
+--nproc_per_node defaults to 1 there; the per-rank env contract matches
+parallel_env.init_parallel_env (PADDLE_MASTER -> coordination service).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch a multi-process (multi-host) training job")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="node count, or elastic range 'min:max'")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--nproc_per_node", type=int, default=None,
+                   help="processes per node (TPU default: 1 per host)")
+    p.add_argument("--devices", "--gpus", type=str, default=None,
+                   help="device ids for this node (sets *_VISIBLE_DEVICES)")
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER"),
+                   help="coordinator ip:port (defaults to local)")
+    p.add_argument("--run_mode", type=str, default="collective")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--elastic_level", type=int,
+                   default=int(os.environ.get("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "0")))
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _rank_env(base_env, rank, world, master, args):
+    env = dict(base_env)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_MASTER": master,
+        "PADDLE_JOB_ID": args.job_id,
+        "FLAGS_selected_devices": str(rank),
+    })
+    if args.devices:
+        env["TPU_VISIBLE_DEVICES"] = args.devices
+        env["CUDA_VISIBLE_DEVICES"] = args.devices
+    return env
+
+
+class Pod:
+    """One node's worth of worker processes (≙ launch/job/pod.py)."""
+
+    def __init__(self, args, nproc, world, rank0):
+        self.args = args
+        self.nproc = nproc
+        self.world = world
+        self.rank0 = rank0
+        self.procs: list[subprocess.Popen] = []
+
+    def start(self):
+        os.makedirs(self.args.log_dir, exist_ok=True)
+        master = self.args.master or "127.0.0.1:49174"
+        cmd = [sys.executable, "-u", self.args.training_script] + \
+            self.args.training_script_args
+        for i in range(self.nproc):
+            rank = self.rank0 + i
+            logf = open(os.path.join(
+                self.args.log_dir, f"workerlog.{rank}"), "ab")
+            p = subprocess.Popen(
+                cmd, env=_rank_env(os.environ, rank, self.world, master,
+                                   self.args),
+                stdout=logf, stderr=subprocess.STDOUT)
+            p._log = logf
+            self.procs.append(p)
+
+    def poll(self):
+        """Returns 'running' | 'done' | 'failed'."""
+        codes = [p.poll() for p in self.procs]
+        if any(c not in (None, 0) for c in codes):
+            return "failed"
+        if all(c == 0 for c in codes):
+            return "done"
+        return "running"
+
+    def stop(self, sig=signal.SIGTERM, grace=10.0):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(sig)
+        deadline = time.time() + grace
+        for p in self.procs:
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            if p.poll() is None:
+                p.kill()
+        for p in self.procs:
+            p._log.close()
+        self.procs = []
+
+
+def launch_pod(args) -> int:
+    """Run the pod with watch + restart (≙ CollectiveController.watch)."""
+    nnodes = int(str(args.nnodes).split(":")[0])
+    nproc = args.nproc_per_node or 1
+    world = nnodes * nproc
+    rank0 = args.node_rank * nproc
+
+    restarts = 0
+    while True:
+        pod = Pod(args, nproc, world, rank0)
+        pod.start()
+        try:
+            while True:
+                state = pod.poll()
+                if state == "running":
+                    time.sleep(0.5)
+                    continue
+                if state == "done":
+                    return 0
+                break  # failed
+        except KeyboardInterrupt:
+            pod.stop(signal.SIGINT)
+            return 130
+        pod.stop()
+        restarts += 1
+        if restarts > args.max_restart or args.elastic_level < 0:
+            print(f"[launch] pod failed after {restarts - 1} restarts",
+                  file=sys.stderr)
+            return 1
+        print(f"[launch] worker failure — restarting pod "
+              f"({restarts}/{args.max_restart})", file=sys.stderr)
+
+
+def main(argv=None):
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    sys.exit(launch_pod(args))

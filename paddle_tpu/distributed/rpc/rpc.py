@@ -1,0 +1,182 @@
+"""paddle.distributed.rpc (≙ python/paddle/distributed/rpc/rpc.py).
+
+The reference rides brpc; here each worker runs a small TCP server
+(pickle-framed request/response over `multiprocessing.connection`, which
+gives authenticated length-prefixed messaging for free). rpc_sync/rpc_async
+execute a pickled callable on the target worker's process.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+from multiprocessing.connection import Client, Listener
+
+_AUTH = b"paddle-tpu-rpc"
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+_state = {
+    "inited": False,
+    "current": None,
+    "workers": {},     # name -> WorkerInfo
+    "listener": None,
+    "serve_thread": None,
+    "pool": None,
+}
+
+
+def _serve(listener):
+    while True:
+        try:
+            conn = listener.accept()
+        except (OSError, EOFError):
+            return
+        try:
+            kind, payload = conn.recv()
+            if kind == "shutdown":
+                conn.send(("ok", None))
+                conn.close()
+                return
+            fn, args, kwargs = payload
+            try:
+                result = fn(*args, **kwargs)
+                try:
+                    conn.send(("ok", result))
+                except Exception as e:  # unpicklable result: report, stay alive
+                    conn.send(("err", RuntimeError(
+                        f"rpc result of {getattr(fn, '__name__', fn)} is not "
+                        f"picklable: {e}")))
+            except Exception as e:  # ship the failure back to the caller
+                conn.send(("err", e))
+        except (OSError, EOFError):
+            pass
+        except Exception:  # never let one bad request kill the accept loop
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def init_rpc(name: str, rank: int | None = None, world_size: int | None = None,
+             master_endpoint: str | None = None):
+    """Start this worker's server and register the peer table.
+
+    Single-process usage registers just this worker; multi-process jobs pass
+    rank/world_size and reachable endpoints via PADDLE_WORKER_ENDPOINTS
+    ("ip:port,ip:port,..." indexed by rank).
+    """
+    if _state["inited"]:
+        raise RuntimeError("init_rpc already called")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0")) if rank is None else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) \
+        if world_size is None else world_size
+
+    endpoints = os.environ.get("PADDLE_WORKER_ENDPOINTS", "")
+    eps = [e for e in endpoints.split(",") if e]
+    if eps and len(eps) != world_size:
+        raise ValueError("PADDLE_WORKER_ENDPOINTS length != world_size")
+    if eps:
+        my_ip, my_port = eps[rank].split(":")
+        listener = Listener((my_ip, int(my_port)), authkey=_AUTH)
+    else:
+        listener = Listener(("127.0.0.1", 0), authkey=_AUTH)
+        my_ip, my_port = listener.address
+        eps = [f"{my_ip}:{my_port}"]
+    t = threading.Thread(target=_serve, args=(listener,), daemon=True)
+    t.start()
+
+    # peer names: the launcher/user publishes PADDLE_WORKER_NAMES (comma
+    # list aligned with endpoints) so by-name addressing matches what each
+    # peer passed to init_rpc; "worker{r}" stays as a rank alias
+    names_env = os.environ.get("PADDLE_WORKER_NAMES", "")
+    peer_names = [n for n in names_env.split(",") if n]
+    if peer_names and len(peer_names) != world_size:
+        raise ValueError("PADDLE_WORKER_NAMES length != world_size")
+    _state["workers"] = {}
+    for r, ep in enumerate(eps):
+        ip, port = ep.split(":") if isinstance(ep, str) else ep
+        info = WorkerInfo(
+            name if r == rank else (peer_names[r] if peer_names else f"worker{r}"),
+            r, ip, int(port))
+        _state["workers"][info.name] = info
+        _state["workers"].setdefault(f"worker{r}", info)  # rank alias
+    _state["current"] = _state["workers"][name]
+    _state["listener"] = listener
+    _state["serve_thread"] = t
+    _state["pool"] = ThreadPoolExecutor(max_workers=8)
+    _state["inited"] = True
+
+
+def _require_init():
+    if not _state["inited"]:
+        raise RuntimeError("call paddle.distributed.rpc.init_rpc first")
+
+
+def _call(to: str, fn, args, kwargs, timeout):
+    info = get_worker_info(to)
+    conn = Client((info.ip, info.port), authkey=_AUTH)
+    try:
+        conn.send(("call", (fn, args or (), kwargs or {})))
+        status, payload = conn.recv()
+    finally:
+        conn.close()
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=-1):
+    _require_init()
+    return _call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout=-1) -> Future:
+    _require_init()
+    return _state["pool"].submit(_call, to, fn, args, kwargs, timeout)
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    _require_init()
+    if name not in _state["workers"]:
+        raise ValueError(f"unknown rpc worker '{name}' "
+                         f"(have {sorted(_state['workers'])})")
+    return _state["workers"][name]
+
+
+def get_all_worker_infos():
+    _require_init()
+    return list(_state["workers"].values())
+
+
+def get_current_worker_info() -> WorkerInfo:
+    _require_init()
+    return _state["current"]
+
+
+def shutdown(graceful: bool = True):
+    if not _state["inited"]:
+        return
+    info = _state["current"]
+    try:  # unblock our own accept loop
+        conn = Client((info.ip, info.port), authkey=_AUTH)
+        conn.send(("shutdown", None))
+        conn.recv()
+        conn.close()
+    except OSError:
+        pass
+    _state["listener"].close()
+    _state["serve_thread"].join(timeout=5)
+    _state["pool"].shutdown(wait=False)
+    _state.update({"inited": False, "current": None, "workers": {},
+                   "listener": None, "serve_thread": None, "pool": None})

@@ -1,0 +1,50 @@
+"""paddle.distributed.spawn (≙ python/paddle/distributed/spawn.py).
+
+Forks `nprocs` worker processes running `func(*args)` with the per-rank
+PADDLE_* env contract set, joins them, and re-raises the first failure.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import traceback
+
+
+def _worker(func, rank, nprocs, master, args, err_q):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    if master:
+        os.environ["PADDLE_MASTER"] = master
+    try:
+        func(*args)
+    except Exception:
+        err_q.put((rank, traceback.format_exc()))
+        raise
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    """Launch func in nprocs processes. Returns the context (list of procs)."""
+    ctx = mp.get_context(options.get("start_method", "spawn"))
+    master = options.get("master", "127.0.0.1:49175")
+    err_q = ctx.Queue()
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, nprocs, master, args, err_q),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    for p in procs:
+        p.join()
+    if not err_q.empty():
+        rank, tb = err_q.get()
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        raise RuntimeError(f"spawn: worker {rank} failed:\n{tb}")
+    bad = [i for i, p in enumerate(procs) if p.exitcode not in (0, None)]
+    if bad:
+        raise RuntimeError(f"spawn: workers {bad} exited nonzero")
+    return procs

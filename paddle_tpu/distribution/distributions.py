@@ -457,8 +457,10 @@ class Multinomial(Distribution):
 
         def fn(p):
             logits = jnp.log(p / p.sum(-1, keepdims=True))
-            draws = jax.random.categorical(key, logits, shape=shp + (n,))
-            return jax.nn.one_hot(draws, k).sum(-2)
+            # categorical broadcasting: shape's TRAILING dims must match the
+            # logits batch shape, so the n draw axis goes in front
+            draws = jax.random.categorical(key, logits, shape=(n,) + shp)
+            return jax.nn.one_hot(draws, k).sum(0)
 
         return op_call(fn, self.probs, name="multinomial_sample").detach()
 

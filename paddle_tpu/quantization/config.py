@@ -37,9 +37,11 @@ class QuantConfig:
             return self._by_name[name]
         if type(layer) in self._by_type:
             return self._by_type[type(layer)]
-        from ..nn import Conv2D, Linear
+        from ..nn import Linear
 
+        # default config covers the quantizable set (Linear for now) only —
+        # explicit type/name/layer configs on other types warn in quantize()
         if (self._default.activation or self._default.weight) and \
-                isinstance(layer, (Linear, Conv2D)):
+                isinstance(layer, Linear):
             return self._default
         return None

@@ -51,21 +51,23 @@ class QuantizedLinear(Layer):
         self.bit_length = bit_length
 
     def forward(self, x):
-        w_int8 = self.w_int8
+        # w_int8 rides as an op operand (dynamic input), NOT a closure cell:
+        # arrays in the closure would make the fn key uncachable and kick the
+        # call off the compiled-eager path (scales are floats — static key)
         ws = self.weight_scale
         a_s = self.act_scale
         qmax = float(2 ** (self.bit_length - 1) - 1)
 
-        def fn(xv, *maybe_bias):
+        def fn(xv, w8, *maybe_bias):
             if a_s is not None:
                 xv = jnp.clip(jnp.round(xv / a_s), -qmax - 1, qmax) * a_s
-            out = xv @ (w_int8.astype(xv.dtype) * ws)
+            out = xv @ (w8.astype(xv.dtype) * ws)
             if maybe_bias:
                 out = out + maybe_bias[0]
             return out
 
-        args = [x] + ([self.bias] if self.bias is not None else [])
-        return op_call(fn, *args, name="quantized_linear")
+        args = [x, self.w_int8] + ([self.bias] if self.bias is not None else [])
+        return op_call(fn, *args, name="quantized_linear", n_diff=1)
 
 
 class PTQ:
@@ -77,7 +79,10 @@ class PTQ:
 
         for name, child in list(model.named_sublayers()):
             cfg = self.config.config_for(name, child)
-            if cfg is None or not isinstance(child, Linear):
+            if cfg is None:
+                continue
+            if not isinstance(child, Linear):
+                _warn_unsupported(name, child)
                 continue
             wrapped = _ObservedLayer(child, cfg.activation, cfg.weight)
             _replace_child(model, name, wrapped)
@@ -96,6 +101,15 @@ class PTQ:
                 q = QuantizedLinear(child.inner, w_scale, a_scale)
                 _replace_child(model, name, q)
         return model
+
+
+def _warn_unsupported(name: str, layer) -> None:
+    import warnings
+
+    warnings.warn(
+        f"quantization: layer '{name}' ({type(layer).__name__}) matched the "
+        "QuantConfig but only Linear is quantizable so far — it is left "
+        "unquantized", stacklevel=3)
 
 
 def _replace_child(model: Layer, dotted: str, new: Layer):

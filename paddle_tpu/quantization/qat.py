@@ -37,10 +37,14 @@ class QAT:
 
     def quantize(self, model: Layer, inplace: bool = False) -> Layer:
         from ..nn import Linear
+        from .ptq import _warn_unsupported
 
         for name, child in list(model.named_sublayers()):
             cfg = self.config.config_for(name, child)
-            if cfg is None or not isinstance(child, Linear):
+            if cfg is None:
+                continue
+            if not isinstance(child, Linear):
+                _warn_unsupported(name, child)
                 continue
             act = cfg.activation or FakeQuanterWithAbsMax
             wq = cfg.weight or FakeQuanterWithAbsMax

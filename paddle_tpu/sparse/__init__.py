@@ -102,12 +102,13 @@ def _bcoo(x) -> jsparse.BCOO:
 # --------------------------------------------------------------- conversions
 def to_dense(x) -> Tensor:
     _check_sparse(x)
-    idx, shape = x._spidx, x._spshape
+    shape = x._spshape
 
-    def fn(vals):
+    def fn(vals, idx):
         return jsparse.BCOO((vals, idx), shape=shape).todense()
 
-    return op_call(fn, x._spvals, name="coo_to_dense")
+    # idx rides as an operand (closure arrays would defeat the eager cache)
+    return op_call(fn, x._spvals, x._spidx, name="coo_to_dense", n_diff=1)
 
 
 def to_sparse_coo(x, sparse_dim=None) -> Tensor:
@@ -129,10 +130,10 @@ def matmul(x, y, name=None) -> Tensor:
     idx, shape = x._spidx, x._spshape
     yt = y if isinstance(y, Tensor) else Tensor(jnp.asarray(y), _internal=True)
 
-    def fn(vals, dense):
-        return jsparse.BCOO((vals, idx), shape=shape) @ dense
+    def fn(vals, dense, idxv):
+        return jsparse.BCOO((vals, idxv), shape=shape) @ dense
 
-    return op_call(fn, x._spvals, yt, name="sparse_matmul")
+    return op_call(fn, x._spvals, yt, idx, name="sparse_matmul", n_diff=2)
 
 
 def masked_matmul(x, y, mask, name=None) -> Tensor:
@@ -141,12 +142,11 @@ def masked_matmul(x, y, mask, name=None) -> Tensor:
     idx, shape = mask._spidx, mask._spshape
     xt = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x), _internal=True)
     yt = y if isinstance(y, Tensor) else Tensor(jnp.asarray(y), _internal=True)
-    rows, cols = jnp.asarray(idx[:, 0]), jnp.asarray(idx[:, 1])
-
-    def fn(a, b):
+    def fn(a, b, rows, cols):
         return (a[rows] * b[:, cols].T).sum(-1)
 
-    vals = op_call(fn, xt, yt, name="masked_matmul")
+    vals = op_call(fn, xt, yt, jnp.asarray(idx[:, 0]), jnp.asarray(idx[:, 1]),
+                   name="masked_matmul", n_diff=2)
     return _build(vals, idx, shape)
 
 
