@@ -1,0 +1,77 @@
+// Native host-event tracer backing paddle_tpu.profiler's RecordEvent ring.
+//
+// Reference parity: the C++ host tracer TLS ring
+// (/root/reference/paddle/fluid/platform/profiler/host_tracer.h) — event
+// recording must be cheap enough to leave per-op instrumentation on during
+// profiled steps. Names are interned once; each record is 24 bytes.
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct NEvent {
+  uint32_t name_id;
+  uint32_t tid;
+  uint64_t start;
+  uint64_t end;
+};
+
+std::vector<NEvent> g_events;
+std::vector<std::string> g_names;
+std::mutex g_mu;
+uint64_t g_capacity = 1ull << 20;
+
+}  // namespace
+
+extern "C" {
+
+uint32_t tracer_intern(const char* name) {
+  std::lock_guard<std::mutex> l(g_mu);
+  for (uint32_t i = 0; i < g_names.size(); ++i) {
+    if (g_names[i] == name) return i;
+  }
+  g_names.emplace_back(name);
+  return static_cast<uint32_t>(g_names.size() - 1);
+}
+
+const char* tracer_name(uint32_t id) {
+  std::lock_guard<std::mutex> l(g_mu);
+  if (id >= g_names.size()) return "";
+  return g_names[id].c_str();
+}
+
+void tracer_record(uint32_t name_id, uint64_t start, uint64_t end,
+                   uint32_t tid) {
+  std::lock_guard<std::mutex> l(g_mu);
+  if (g_events.size() < g_capacity) g_events.push_back({name_id, tid, start, end});
+}
+
+uint64_t tracer_count() {
+  std::lock_guard<std::mutex> l(g_mu);
+  return g_events.size();
+}
+
+// Atomically move up to maxn events into the caller's parallel arrays.
+uint64_t tracer_drain(uint32_t* name_ids, uint32_t* tids, uint64_t* starts,
+                      uint64_t* ends, uint64_t maxn) {
+  std::lock_guard<std::mutex> l(g_mu);
+  uint64_t n = g_events.size() < maxn ? g_events.size() : maxn;
+  for (uint64_t i = 0; i < n; ++i) {
+    name_ids[i] = g_events[i].name_id;
+    tids[i] = g_events[i].tid;
+    starts[i] = g_events[i].start;
+    ends[i] = g_events[i].end;
+  }
+  g_events.erase(g_events.begin(), g_events.begin() + n);
+  return n;
+}
+
+void tracer_clear() {
+  std::lock_guard<std::mutex> l(g_mu);
+  g_events.clear();
+}
+
+}  // extern "C"

@@ -9,8 +9,7 @@ states/activations in bytes against per-chip HBM.
 """
 from __future__ import annotations
 
-import itertools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 
 @dataclass
@@ -45,8 +44,11 @@ def default_memory_model(cand: Candidate, *, n_params: float,
         grads /= cand.dp
     if cand.sharding_stage >= 3:
         state /= cand.dp
-    # in-flight activations: one micro-batch per live pipeline stage
-    acts = (cand.micro_batch * seq_len * hidden * (layers / cand.pp)
+    # in-flight activations: 1F1B keeps up to pp micro-batches live per
+    # stage (warmup depth), bounded by how many micro-batches exist at all
+    total_micro = max(global_batch // max(cand.dp * cand.micro_batch, 1), 1)
+    live = min(cand.pp, total_micro)
+    acts = (live * cand.micro_batch * seq_len * hidden * (layers / cand.pp)
             * 16 * bytes_per_param / cand.mp)
     return state + grads + opt + acts
 

@@ -45,8 +45,11 @@ class ElasticManager:
         return os.path.join(self.store, f"node.{rank}.json")
 
     def heartbeat(self):
-        with open(self._beat_path(self.rank), "w") as f:
+        path = self._beat_path(self.rank)
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump({"rank": self.rank, "ts": time.time()}, f)
+        os.replace(tmp, path)  # atomic: readers never see a torn file
 
     def alive_members(self) -> list[int]:
         now = time.time()

@@ -47,13 +47,14 @@ def _parse(argv):
     return p.parse_args(argv)
 
 
-def _rank_env(base_env, rank, world, master, args):
+def _rank_env(base_env, rank, world, master, args, rpc_key):
     env = dict(base_env)
     env.update({
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_TRAINERS_NUM": str(world),
         "PADDLE_MASTER": master,
         "PADDLE_JOB_ID": args.job_id,
+        "PADDLE_RPC_AUTH_KEY": rpc_key,
         "FLAGS_selected_devices": str(rank),
     })
     if args.devices:
@@ -77,13 +78,15 @@ class Pod:
         master = self.args.master or "127.0.0.1:49174"
         cmd = [sys.executable, "-u", self.args.training_script] + \
             self.args.training_script_args
+        rpc_key = os.environ.get("PADDLE_RPC_AUTH_KEY") or __import__(
+            "secrets").token_hex(32)
         for i in range(self.nproc):
             rank = self.rank0 + i
             logf = open(os.path.join(
                 self.args.log_dir, f"workerlog.{rank}"), "ab")
             p = subprocess.Popen(
                 cmd, env=_rank_env(os.environ, rank, self.world, master,
-                                   self.args),
+                                   self.args, rpc_key),
                 stdout=logf, stderr=subprocess.STDOUT)
             p._log = logf
             self.procs.append(p)

@@ -8,12 +8,28 @@ execute a pickled callable on the target worker's process.
 from __future__ import annotations
 
 import os
+import secrets
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass
 from multiprocessing.connection import Client, Listener
 
-_AUTH = b"paddle-tpu-rpc"
+
+def _auth_key(multi_worker: bool) -> bytes:
+    """Per-job HMAC secret. NEVER a source constant: the server executes
+    pickled callables, so the key is the only thing standing between the
+    port and remote code execution. Multi-worker jobs must share one via
+    PADDLE_RPC_AUTH_KEY (the launcher generates it); single-worker local
+    use gets a random per-process key."""
+    key = os.environ.get("PADDLE_RPC_AUTH_KEY")
+    if key:
+        return key.encode()
+    if multi_worker:
+        raise RuntimeError(
+            "init_rpc with multiple workers requires PADDLE_RPC_AUTH_KEY to "
+            "be set to a shared per-job secret (paddle_tpu.distributed.launch "
+            "sets it automatically)")
+    return secrets.token_bytes(32)
 
 
 @dataclass
@@ -86,11 +102,13 @@ def init_rpc(name: str, rank: int | None = None, world_size: int | None = None,
     eps = [e for e in endpoints.split(",") if e]
     if eps and len(eps) != world_size:
         raise ValueError("PADDLE_WORKER_ENDPOINTS length != world_size")
+    auth = _auth_key(multi_worker=bool(eps) and world_size > 1)
+    _state["auth"] = auth
     if eps:
         my_ip, my_port = eps[rank].split(":")
-        listener = Listener((my_ip, int(my_port)), authkey=_AUTH)
+        listener = Listener((my_ip, int(my_port)), authkey=auth)
     else:
-        listener = Listener(("127.0.0.1", 0), authkey=_AUTH)
+        listener = Listener(("127.0.0.1", 0), authkey=auth)
         my_ip, my_port = listener.address
         eps = [f"{my_ip}:{my_port}"]
     t = threading.Thread(target=_serve, args=(listener,), daemon=True)
@@ -125,9 +143,13 @@ def _require_init():
 
 def _call(to: str, fn, args, kwargs, timeout):
     info = get_worker_info(to)
-    conn = Client((info.ip, info.port), authkey=_AUTH)
+    conn = Client((info.ip, info.port), authkey=_state["auth"])
     try:
         conn.send(("call", (fn, args or (), kwargs or {})))
+        if timeout is not None and timeout > 0:
+            if not conn.poll(timeout):
+                raise TimeoutError(
+                    f"rpc to '{to}' got no reply within {timeout}s")
         status, payload = conn.recv()
     finally:
         conn.close()
@@ -169,7 +191,7 @@ def shutdown(graceful: bool = True):
         return
     info = _state["current"]
     try:  # unblock our own accept loop
-        conn = Client((info.ip, info.port), authkey=_AUTH)
+        conn = Client((info.ip, info.port), authkey=_state["auth"])
         conn.send(("shutdown", None))
         conn.recv()
         conn.close()

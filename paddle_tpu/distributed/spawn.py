@@ -36,15 +36,28 @@ def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
         procs.append(p)
     if not join:
         return procs
-    for p in procs:
-        p.join()
-    if not err_q.empty():
-        rank, tb = err_q.get()
-        for p in procs:
-            if p.is_alive():
-                p.terminate()
-        raise RuntimeError(f"spawn: worker {rank} failed:\n{tb}")
-    bad = [i for i, p in enumerate(procs) if p.exitcode not in (0, None)]
-    if bad:
-        raise RuntimeError(f"spawn: workers {bad} exited nonzero")
-    return procs
+    # monitor loop (not sequential joins): one crashed rank must terminate
+    # the survivors — they may be blocked on the dead peer in a collective
+    import time
+
+    while True:
+        if not err_q.empty():
+            rank, tb = err_q.get()
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            raise RuntimeError(f"spawn: worker {rank} failed:\n{tb}")
+        codes = [p.exitcode for p in procs]
+        bad = [i for i, c in enumerate(codes) if c not in (0, None)]
+        if bad:
+            time.sleep(0.2)  # give the failing rank a beat to queue its tb
+            if not err_q.empty():
+                continue
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            raise RuntimeError(f"spawn: workers {bad} exited nonzero "
+                               f"(codes {[codes[i] for i in bad]})")
+        if all(c == 0 for c in codes):
+            return procs
+        time.sleep(0.05)

@@ -85,14 +85,22 @@ def get_worker_info():
 
 
 def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, num_workers,
-                 worker_init_fn=None):
+                 worker_init_fn=None, ring_name=None):
     global _worker_info
     _worker_info = WorkerInfo(worker_id, num_workers, dataset)
+    sink = data_queue
+    if ring_name is not None:
+        try:  # native shared-memory transport (csrc/ring_queue.cpp)
+            from .shm_channel import ShmWorkerSender
+
+            sink = ShmWorkerSender(ring_name, data_queue)
+        except Exception:
+            sink = data_queue
     if worker_init_fn is not None:
         try:
             worker_init_fn(worker_id)
         except Exception as e:
-            data_queue.put((-1, None, e))
+            sink.put((-1, None, e))
             return
     while True:
         item = index_queue.get()
@@ -101,9 +109,9 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, num_wo
         seq, indices = item
         try:
             samples = [dataset[i] for i in indices]
-            data_queue.put((seq, collate_fn(samples), None))
+            sink.put((seq, collate_fn(samples), None))
         except Exception as e:  # surface worker errors on the main process
-            data_queue.put((seq, None, e))
+            sink.put((seq, None, e))
 
 
 class DataLoader:
@@ -115,6 +123,7 @@ class DataLoader:
                  persistent_workers=False):
         self.dataset = dataset
         self.num_workers = max(int(num_workers), 0)
+        self.use_shared_memory = use_shared_memory
         self.collate_fn = collate_fn
         self.timeout = timeout or 120
         self.prefetch_factor = max(int(prefetch_factor), 1)
@@ -171,11 +180,24 @@ class DataLoader:
         index_queues = [ctx.Queue() for _ in range(self.num_workers)]
         data_queue = ctx.Queue()
         collate = self.collate_fn or _numpy_collate
+        channel = None
+        ring_names = [None] * self.num_workers
+        if self.use_shared_memory:
+            try:  # native shm rings; silently fall back to the queue path
+                from .shm_channel import ShmDataChannel, available
+
+                if available():
+                    channel = ShmDataChannel(self.num_workers, data_queue)
+                    ring_names = channel.worker_names()
+            except Exception:
+                channel = None
+        source = channel if channel is not None else data_queue
         workers = [
             ctx.Process(
                 target=_worker_loop,
                 args=(self.dataset, index_queues[w], data_queue, collate,
-                      w, self.num_workers, self.worker_init_fn),
+                      w, self.num_workers, self.worker_init_fn,
+                      ring_names[w]),
                 daemon=True,
             )
             for w in range(self.num_workers)
@@ -196,7 +218,7 @@ class DataLoader:
                 inflight += 1
             while next_yield < len(batches):
                 while next_yield not in reorder:
-                    seq, data, err = data_queue.get(timeout=self.timeout)
+                    seq, data, err = source.get(timeout=self.timeout)
                     if err is not None:
                         raise err
                     reorder[seq] = data
@@ -218,3 +240,5 @@ class DataLoader:
                 w.join(timeout=5)
                 if w.is_alive():
                     w.terminate()
+            if channel is not None:
+                channel.close()

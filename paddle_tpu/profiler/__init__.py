@@ -67,25 +67,63 @@ class _HostEvent:
 
 
 class _HostTracer:
-    """RecordEvent TLS ring (≙ paddle/fluid/platform/profiler/host_tracer.h)."""
+    """RecordEvent ring (≙ paddle/fluid/platform/profiler/host_tracer.h).
+
+    Backed by the native C++ tracer (csrc/host_tracer.cpp: interned names,
+    24-byte records, one mutex) when the toolchain built it; pure-Python
+    list otherwise."""
 
     def __init__(self, capacity: int = 1 << 20):
         self.events: list[_HostEvent] = []
         self.capacity = capacity
         self.enabled = False
         self._lock = threading.Lock()
+        from ..core import native
+
+        self._native = native.tracer_lib()
+        self._name_ids: dict[str, int] = {}
 
     def add(self, ev: _HostEvent):
+        if self._native is not None:
+            key = f"{ev.type.name}|{ev.name}"
+            nid = self._name_ids.get(key)
+            if nid is None:
+                nid = int(self._native.tracer_intern(key.encode()))
+                self._name_ids[key] = nid
+            self._native.tracer_record(nid, ev.start, ev.end,
+                                       ev.tid & 0xFFFFFFFF)
+            return
         with self._lock:
             if len(self.events) < self.capacity:
                 self.events.append(ev)
 
     def clear(self):
-        with self._lock:
-            self.events = []
+        self.drain()
 
     def drain(self) -> list:
         """Atomically take all pending events (no drop window)."""
+        if self._native is not None:
+            import ctypes
+
+            n = int(self._native.tracer_count())
+            if n == 0:
+                return []
+            ids = (ctypes.c_uint32 * n)()
+            tids = (ctypes.c_uint32 * n)()
+            starts = (ctypes.c_uint64 * n)()
+            ends = (ctypes.c_uint64 * n)()
+            got = int(self._native.tracer_drain(ids, tids, starts, ends, n))
+            id2key = {v: k for k, v in self._name_ids.items()}
+            out = []
+            for i in range(got):
+                key = id2key.get(int(ids[i]))
+                if key is None:
+                    key = self._native.tracer_name(ids[i]).decode() or "?|?"
+                type_name, _, name = key.partition("|")
+                out.append(_HostEvent(
+                    name, TracerEventType[type_name], int(starts[i]),
+                    int(ends[i]), int(tids[i])))
+            return out
         with self._lock:
             out = self.events
             self.events = []

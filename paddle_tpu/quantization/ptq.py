@@ -58,16 +58,24 @@ class QuantizedLinear(Layer):
         a_s = self.act_scale
         qmax = float(2 ** (self.bit_length - 1) - 1)
 
-        def fn(xv, w8, *maybe_bias):
+        # differentiable operands (x[, bias]) come first, the int8 weight
+        # last and outside n_diff (int weights have no gradient; the bias
+        # must keep one)
+        if self.bias is not None:
+            def fn(xv, b, w8):
+                if a_s is not None:
+                    xv = jnp.clip(jnp.round(xv / a_s), -qmax - 1, qmax) * a_s
+                return xv @ (w8.astype(xv.dtype) * ws) + b
+
+            return op_call(fn, x, self.bias, self.w_int8,
+                           name="quantized_linear", n_diff=2)
+
+        def fn(xv, w8):
             if a_s is not None:
                 xv = jnp.clip(jnp.round(xv / a_s), -qmax - 1, qmax) * a_s
-            out = xv @ (w8.astype(xv.dtype) * ws)
-            if maybe_bias:
-                out = out + maybe_bias[0]
-            return out
+            return xv @ (w8.astype(xv.dtype) * ws)
 
-        args = [x, self.w_int8] + ([self.bias] if self.bias is not None else [])
-        return op_call(fn, *args, name="quantized_linear", n_diff=1)
+        return op_call(fn, x, self.w_int8, name="quantized_linear", n_diff=1)
 
 
 class PTQ:
