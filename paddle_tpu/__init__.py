@@ -69,6 +69,7 @@ from . import distribution
 from . import quantization
 from . import sparse
 from . import static
+from . import inference
 from .framework_io import save, load
 
 # paddle.framework parity namespace bits

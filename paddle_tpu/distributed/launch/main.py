@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import secrets
 import signal
 import subprocess
 import sys
@@ -78,8 +79,15 @@ class Pod:
         master = self.args.master or "127.0.0.1:49174"
         cmd = [sys.executable, "-u", self.args.training_script] + \
             self.args.training_script_args
-        rpc_key = os.environ.get("PADDLE_RPC_AUTH_KEY") or __import__(
-            "secrets").token_hex(32)
+        rpc_key = os.environ.get("PADDLE_RPC_AUTH_KEY")
+        if rpc_key is None:
+            if self.world > self.nproc:
+                # multi-node: a per-node random key would desync the HMAC
+                # handshake across nodes — the operator must provide one
+                raise RuntimeError(
+                    "multi-node launch needs PADDLE_RPC_AUTH_KEY set to the "
+                    "same per-job secret on every node")
+            rpc_key = secrets.token_hex(32)
         for i in range(self.nproc):
             rank = self.rank0 + i
             logf = open(os.path.join(

@@ -1,0 +1,4 @@
+from .predictor import Config, PrecisionType, Predictor, Tensor as InferTensor, create_predictor
+
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
+           "InferTensor"]

@@ -1,0 +1,174 @@
+"""paddle.inference — deployment predictor (L13).
+
+Reference parity: AnalysisPredictor / AnalysisConfig / create_predictor
+(/root/reference/paddle/fluid/inference/api/analysis_predictor.h:101,
+paddle_inference_api.h): load a saved program + params, run the analysis
+pass pipeline, serve zero-copy Run() calls.
+
+TPU-native design (SURVEY §7 "AOT-compiled StableHLO serving"): the saved
+artifact is paddle.jit.save's serialized StableHLO (+ pickled state_dict);
+"analysis passes" ARE XLA's AOT pipeline — deserialization hands back a
+compiled executable, so Predictor.run is one XLA invocation with no Python
+op dispatch. Where only the state_dict exists, the predictor falls back to
+re-jitting the registered network class once (first call compiles).
+"""
+from __future__ import annotations
+
+import enum
+import os
+
+import numpy as np
+
+
+class PrecisionType(enum.Enum):
+    Float32 = 0
+    Half = 1      # maps to bfloat16 on TPU
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class Config:
+    """≙ AnalysisConfig: model paths + device + precision switches."""
+
+    def __init__(self, prog_file: str | None = None, params_file: str | None = None):
+        # paddle passes either (model_dir) or (prog, params); we accept the
+        # jit.save prefix in either slot
+        self._prefix = None
+        if prog_file is not None:
+            self._prefix = prog_file[:-len(".stablehlo")] \
+                if prog_file.endswith(".stablehlo") else prog_file
+        self._device = "tpu"
+        self._device_id = 0
+        self._precision = PrecisionType.Float32
+        self._enable_memory_optim = True
+        self._network_factory = None
+
+    # -- device selection (parity names)
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=PrecisionType.Float32):
+        self._device, self._device_id = "tpu", device_id  # tpu-native alias
+        self._precision = precision
+
+    def enable_xpu(self, *a, **k):
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def set_model(self, prog_file, params_file=None):
+        self._prefix = prog_file[:-len(".stablehlo")] \
+            if prog_file.endswith(".stablehlo") else prog_file
+
+    def set_network_factory(self, factory):
+        """TPU extension: zero-arg callable rebuilding the network — the
+        fallback when no serialized StableHLO exists for this artifact."""
+        self._network_factory = factory
+
+    def enable_memory_optim(self, flag=True):
+        self._enable_memory_optim = flag
+
+    def model_dir(self):
+        return self._prefix
+
+    def summary(self) -> str:
+        return (f"Config(prefix={self._prefix}, device={self._device}, "
+                f"precision={self._precision.name})")
+
+
+class Tensor:
+    """≙ paddle_infer::Tensor — named zero-copy handle."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = np.asarray(arr)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        import jax
+
+        return np.asarray(jax.device_get(self._value))
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        self.config = config
+        prefix = config.model_dir()
+        if prefix is None:
+            raise ValueError("Config has no model path")
+        self._exported = None
+        self._layer = None
+        hlo = prefix + ".stablehlo"
+        if os.path.exists(hlo):
+            import jax.export as jexport
+
+            with open(hlo, "rb") as f:
+                self._exported = jexport.deserialize(f.read())
+            self._n_inputs = len(self._exported.in_avals)
+        elif config._network_factory is not None:
+            from ..framework_io import load as _load_obj
+
+            payload = _load_obj(prefix + ".pdparams")
+            net = config._network_factory()
+            net.set_state_dict(payload.get("state_dict", payload))
+            net.eval()
+            self._layer = net
+            self._n_inputs = None
+        else:
+            raise FileNotFoundError(
+                f"no serialized program at {hlo}; pass "
+                "Config.set_network_factory to serve from the state_dict")
+        self._inputs: dict[str, Tensor] = {}
+        self._outputs: list[np.ndarray] = []
+
+    # -- paddle_infer API
+    def get_input_names(self):
+        n = self._n_inputs if self._n_inputs is not None else 1
+        return [f"input_{i}" for i in range(n)]
+
+    def get_input_handle(self, name) -> Tensor:
+        return self._inputs.setdefault(name, Tensor(name))
+
+    def get_output_names(self):
+        return [f"output_{i}" for i in range(max(len(self._outputs), 1))]
+
+    def get_output_handle(self, name) -> Tensor:
+        idx = int(name.rsplit("_", 1)[1])
+        t = Tensor(name)
+        t._value = self._outputs[idx]
+        return t
+
+    def run(self, inputs: list[np.ndarray] | None = None):
+        """Execute the compiled program. With `inputs` given, returns the
+        outputs directly (paddle_infer also supports the handle API)."""
+        if inputs is None:
+            names = self.get_input_names()
+            inputs = [self._inputs[n]._value for n in names]
+        if self._exported is not None:
+            out = self._exported.call(*[np.asarray(a) for a in inputs])
+        else:
+            from ..core.dispatch import no_grad
+            from ..core.tensor import Tensor as PTensor
+
+            with no_grad():
+                res = self._layer(*[PTensor(np.asarray(a)) for a in inputs])
+            out = res._data if isinstance(res, PTensor) else \
+                [r._data for r in res]
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        self._outputs = outs
+        return outs
+
+    def try_shrink_memory(self):
+        return None
+
+    def clear_intermediate_tensor(self):
+        return None
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

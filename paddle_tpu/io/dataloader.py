@@ -85,7 +85,7 @@ def get_worker_info():
 
 
 def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, num_workers,
-                 worker_init_fn=None, ring_name=None):
+                 worker_init_fn=None, ring_name=None, timeout=120.0):
     global _worker_info
     _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     sink = data_queue
@@ -93,7 +93,7 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, num_wo
         try:  # native shared-memory transport (csrc/ring_queue.cpp)
             from .shm_channel import ShmWorkerSender
 
-            sink = ShmWorkerSender(ring_name, data_queue)
+            sink = ShmWorkerSender(ring_name, data_queue, timeout=timeout)
         except Exception:
             sink = data_queue
     if worker_init_fn is not None:
@@ -197,7 +197,7 @@ class DataLoader:
                 target=_worker_loop,
                 args=(self.dataset, index_queues[w], data_queue, collate,
                       w, self.num_workers, self.worker_init_fn,
-                      ring_names[w]),
+                      ring_names[w], self.timeout),
                 daemon=True,
             )
             for w in range(self.num_workers)

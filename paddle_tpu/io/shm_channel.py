@@ -43,7 +43,9 @@ def _decode(frame: memoryview):
     for _ in range(n_buf):
         (n,) = struct.unpack_from("<Q", frame, off)
         off += 8
-        bufs.append(bytes(frame[off:off + n]))
+        # bytearray: reconstructed arrays stay WRITABLE, matching the
+        # mp.Queue fallback path (bytes would make them read-only)
+        bufs.append(bytearray(frame[off:off + n]))
         off += n
     return pickle.loads(head, buffers=bufs)
 
@@ -117,6 +119,7 @@ class ShmDataChannel:
 
     def get(self, timeout: float = 120.0):
         deadline = time.monotonic() + timeout
+        delay = 0.0005
         while True:
             for ring in self.rings:
                 item = ring.try_pop()
@@ -128,7 +131,10 @@ class ShmDataChannel:
                 pass
             if time.monotonic() > deadline:
                 raise TimeoutError("no batch from workers within timeout")
-            time.sleep(0.0005)
+            time.sleep(delay)
+            # back off toward 20ms when idle so a slow dataset doesn't cost
+            # the fork-shared workers a busy-polling core
+            delay = min(delay * 1.5, 0.02)
 
     def close(self):
         for r in self.rings:
@@ -138,14 +144,19 @@ class ShmDataChannel:
 class ShmWorkerSender:
     """Worker-side producer handle (attaches to the parent's segment)."""
 
-    def __init__(self, ring_name: str, fallback_queue):
+    def __init__(self, ring_name: str, fallback_queue, timeout: float = 120.0):
         self.ring = ShmRing(name=ring_name, create=False, size=1)  # attach
         self.fallback = fallback_queue
+        self.timeout = timeout
 
     def put(self, item):
         payload = _encode(item)
-        if not self.ring.push(payload):
-            self.fallback.put(item)  # frame larger than the whole ring
+        try:
+            fits = self.ring.push(payload, timeout=self.timeout)
+        except TimeoutError:
+            fits = False  # ring wedged: the mp.Queue still reaches the parent
+        if not fits:
+            self.fallback.put(item)  # oversize frame or stuck ring
 
     def close(self):
         self.ring.close()

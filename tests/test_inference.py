@@ -1,0 +1,65 @@
+"""Inference predictor tests (≙ AnalysisPredictor, analysis_predictor.h:101)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit.save_load import InputSpec
+
+
+def _save_model(tmp_path, batch=3):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = np.random.RandomState(0).randn(batch, 4).astype("float32")
+    ref = net(paddle.to_tensor(x)).numpy()
+    prefix = str(tmp_path / "model" / "m")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([batch, 4], "float32")])
+    return prefix, x, ref, net
+
+
+class TestPredictor:
+    def test_stablehlo_roundtrip_direct_run(self, tmp_path):
+        prefix, x, ref, _net = _save_model(tmp_path)
+        cfg = paddle.inference.Config(prefix)
+        pred = paddle.inference.create_predictor(cfg)
+        outs = pred.run([x])
+        np.testing.assert_allclose(np.asarray(outs[0]), ref, rtol=1e-5, atol=1e-6)
+
+    def test_handle_api(self, tmp_path):
+        prefix, x, ref, _net = _save_model(tmp_path)
+        pred = paddle.inference.create_predictor(paddle.inference.Config(prefix))
+        names = pred.get_input_names()
+        assert names == ["input_0"]
+        h = pred.get_input_handle(names[0])
+        h.copy_from_cpu(x)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_network_factory_fallback(self, tmp_path):
+        # artifact without .stablehlo: serve from state_dict via factory
+        paddle.seed(1)
+        net = nn.Linear(4, 4)
+        prefix = str(tmp_path / "m2")
+        paddle.jit.save(net, prefix)  # no input_spec -> no stablehlo
+        x = np.random.RandomState(1).randn(2, 4).astype("float32")
+        ref = net(paddle.to_tensor(x)).numpy()
+
+        cfg = paddle.inference.Config(prefix)
+        cfg.set_network_factory(lambda: nn.Linear(4, 4))
+        pred = paddle.inference.create_predictor(cfg)
+        outs = pred.run([x])
+        np.testing.assert_allclose(np.asarray(outs[0]), ref, rtol=1e-5, atol=1e-6)
+
+    def test_missing_artifact_raises(self, tmp_path):
+        cfg = paddle.inference.Config(str(tmp_path / "nope"))
+        with pytest.raises(FileNotFoundError, match="network_factory"):
+            paddle.inference.create_predictor(cfg)
+
+    def test_config_surface(self, tmp_path):
+        prefix, _x, _ref, _net = _save_model(tmp_path)
+        cfg = paddle.inference.Config(prefix + ".stablehlo")
+        assert cfg.model_dir() == prefix
+        cfg.enable_use_gpu(100, 0)  # parity alias -> tpu
+        cfg.enable_memory_optim()
+        assert "Config(" in cfg.summary()

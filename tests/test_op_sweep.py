@@ -1,0 +1,318 @@
+"""OpTest-style parity sweep: forward vs NumPy + analytic-vs-numeric grads.
+
+Reference parity model: test/legacy_test/op_test.py:418 — one harness runs
+each op against a NumPy reference and checks gradients by finite
+differences across dtypes/places. Here: a declarative case table (op,
+inputs, reference); every case checks forward parity, differentiable cases
+also check backward by central differences THROUGH THE OP ITSELF (the
+analytic tape grad must match the numeric derivative of the same paddle
+computation).
+"""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+class Case(NamedTuple):
+    name: str
+    fn: Callable            # paddle computation over Tensors
+    inputs: tuple           # numpy input arrays
+    ref: Callable | None    # numpy reference (None: fn IS the reference spec)
+    grad: bool = True       # run the finite-difference backward check
+    rtol: float = 1e-5
+    atol: float = 1e-6
+
+
+def _r(shape, seed, lo=-2.0, hi=2.0, dtype="float32"):
+    rs = np.random.RandomState(seed)
+    return (lo + (hi - lo) * rs.rand(*shape)).astype(dtype)
+
+
+def _pos(shape, seed):
+    return _r(shape, seed, 0.2, 2.0)
+
+
+def _ints(shape, seed, n=5):
+    return np.random.RandomState(seed).randint(0, n, shape).astype("int64")
+
+
+S = (2, 3)
+
+CASES = [
+    # ---------------- elementwise binary
+    Case("add", lambda x, y: x + y, (_r(S, 0), _r(S, 1)), np.add),
+    Case("subtract", lambda x, y: x - y, (_r(S, 0), _r(S, 1)), np.subtract),
+    Case("multiply", lambda x, y: x * y, (_r(S, 0), _r(S, 1)), np.multiply),
+    Case("divide", lambda x, y: x / y, (_r(S, 0), _pos(S, 1)), np.divide),
+    Case("pow", lambda x, y: x ** y, (_pos(S, 0), _r(S, 1)), np.power),
+    Case("maximum", paddle.maximum, (_r(S, 0), _r(S, 1)), np.maximum),
+    Case("minimum", paddle.minimum, (_r(S, 0), _r(S, 1)), np.minimum),
+    Case("mod", paddle.mod, (_r(S, 0), _pos(S, 1)), np.mod, grad=False),
+    Case("atan2", paddle.atan2, (_r(S, 0), _pos(S, 1)), np.arctan2),
+    Case("broadcast_add", lambda x, y: x + y, (_r((2, 3), 0), _r((1, 3), 1)),
+         np.add),
+    # ---------------- unary math
+    Case("exp", paddle.exp, (_r(S, 2),), np.exp),
+    Case("log", paddle.log, (_pos(S, 2),), np.log),
+    Case("log2", paddle.log2, (_pos(S, 2),), np.log2),
+    Case("log10", paddle.log10, (_pos(S, 2),), np.log10),
+    Case("log1p", paddle.log1p, (_pos(S, 2),), np.log1p),
+    Case("expm1", paddle.expm1, (_r(S, 2),), np.expm1),
+    Case("sqrt", paddle.sqrt, (_pos(S, 3),), np.sqrt),
+    Case("rsqrt", paddle.rsqrt, (_pos(S, 3),), lambda x: 1 / np.sqrt(x)),
+    Case("abs", paddle.abs, (_r(S, 4),), np.abs),
+    Case("sin", paddle.sin, (_r(S, 5),), np.sin),
+    Case("cos", paddle.cos, (_r(S, 5),), np.cos),
+    Case("tan", paddle.tan, (_r(S, 5, -1, 1),), np.tan),
+    Case("asin", paddle.asin, (_r(S, 6, -0.9, 0.9),), np.arcsin),
+    Case("acos", paddle.acos, (_r(S, 6, -0.9, 0.9),), np.arccos),
+    Case("atan", paddle.atan, (_r(S, 6),), np.arctan),
+    Case("sinh", paddle.sinh, (_r(S, 7),), np.sinh),
+    Case("cosh", paddle.cosh, (_r(S, 7),), np.cosh),
+    Case("tanh", paddle.tanh, (_r(S, 7),), np.tanh),
+    Case("asinh", paddle.asinh, (_r(S, 7),), np.arcsinh),
+    Case("acosh", paddle.acosh, (_r(S, 7, 1.1, 3.0),), np.arccosh),
+    Case("atanh", paddle.atanh, (_r(S, 7, -0.9, 0.9),), np.arctanh),
+    Case("erf", paddle.erf, (_r(S, 8),),
+         lambda x: np.vectorize(__import__("math").erf)(x).astype("float32")),
+    Case("floor", paddle.floor, (_r(S, 9),), np.floor, grad=False),
+    Case("ceil", paddle.ceil, (_r(S, 9),), np.ceil, grad=False),
+    Case("round", paddle.round, (_r(S, 9),), np.round, grad=False),
+    Case("sign", paddle.sign, (_r(S, 9),), np.sign, grad=False),
+    Case("trunc", paddle.trunc, (_r(S, 9),), np.trunc, grad=False),
+    Case("reciprocal", paddle.reciprocal, (_pos(S, 10),), lambda x: 1 / x),
+    Case("square", paddle.square, (_r(S, 10),), np.square),
+    Case("clip", lambda x: paddle.clip(x, -0.5, 0.5), (_r(S, 11),),
+         lambda x: np.clip(x, -0.5, 0.5)),
+    Case("neg", lambda x: -x, (_r(S, 11),), np.negative),
+    # ---------------- activations
+    Case("relu", F.relu, (_r(S, 12),), lambda x: np.maximum(x, 0)),
+    Case("sigmoid", F.sigmoid, (_r(S, 12),), lambda x: 1 / (1 + np.exp(-x))),
+    Case("softplus", F.softplus, (_r(S, 12),), lambda x: np.log1p(np.exp(x))),
+    Case("softsign", F.softsign, (_r(S, 12),), lambda x: x / (1 + np.abs(x))),
+    Case("silu", F.silu, (_r(S, 13),), lambda x: x / (1 + np.exp(-x))),
+    Case("gelu", F.gelu, (_r(S, 13),),
+         lambda x: x * 0.5 * (1 + np.vectorize(__import__("math").erf)(
+             x / np.sqrt(2))), rtol=1e-4, atol=1e-5),
+    Case("leaky_relu", lambda x: F.leaky_relu(x, 0.1), (_r(S, 13),),
+         lambda x: np.where(x > 0, x, 0.1 * x)),
+    Case("elu", lambda x: F.elu(x, 1.0), (_r(S, 14),),
+         lambda x: np.where(x > 0, x, np.exp(x) - 1)),
+    Case("hardtanh", F.hardtanh, (_r(S, 14),), lambda x: np.clip(x, -1, 1)),
+    Case("relu6", F.relu6, (_r(S, 14, -1, 8),), lambda x: np.clip(x, 0, 6)),
+    Case("mish", F.mish, (_r(S, 14),),
+         lambda x: x * np.tanh(np.log1p(np.exp(x))), rtol=1e-4, atol=1e-5),
+    Case("tanhshrink", F.tanhshrink, (_r(S, 15),), lambda x: x - np.tanh(x)),
+    Case("softshrink", lambda x: F.softshrink(x, 0.5), (_r(S, 15),),
+         lambda x: np.where(x > 0.5, x - 0.5, np.where(x < -0.5, x + 0.5, 0))),
+    Case("hardshrink", lambda x: F.hardshrink(x, 0.5), (_r(S, 15),),
+         lambda x: np.where(np.abs(x) > 0.5, x, 0), grad=False),
+    Case("softmax", lambda x: F.softmax(x, axis=-1), (_r(S, 16),),
+         lambda x: np.exp(x - x.max(-1, keepdims=True))
+         / np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True)),
+    Case("log_softmax", lambda x: F.log_softmax(x, axis=-1), (_r(S, 16),),
+         lambda x: x - x.max(-1, keepdims=True)
+         - np.log(np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True))),
+    # ---------------- reductions
+    Case("sum", lambda x: paddle.sum(x), (_r(S, 17),), np.sum),
+    Case("sum_axis", lambda x: paddle.sum(x, axis=1), (_r(S, 17),),
+         lambda x: x.sum(1)),
+    Case("mean", lambda x: paddle.mean(x), (_r(S, 17),), np.mean),
+    Case("mean_keepdim", lambda x: paddle.mean(x, axis=0, keepdim=True),
+         (_r(S, 17),), lambda x: x.mean(0, keepdims=True)),
+    Case("max", lambda x: paddle.max(x, axis=1), (_r(S, 18),),
+         lambda x: x.max(1)),
+    Case("min", lambda x: paddle.min(x, axis=0), (_r(S, 18),),
+         lambda x: x.min(0)),
+    Case("prod", lambda x: paddle.prod(x, axis=1), (_pos(S, 18),),
+         lambda x: x.prod(1)),
+    Case("logsumexp", lambda x: paddle.logsumexp(x, axis=1), (_r(S, 19),),
+         lambda x: np.log(np.exp(x).sum(1))),
+    Case("cumsum", lambda x: paddle.cumsum(x, axis=1), (_r(S, 19),),
+         lambda x: x.cumsum(1)),
+    Case("cumprod", lambda x: paddle.cumprod(x, dim=1), (_pos(S, 19),),
+         lambda x: x.cumprod(1)),
+    Case("var", lambda x: paddle.var(x), (_r(S, 20),),
+         lambda x: x.var(ddof=1), rtol=1e-4),
+    Case("std", lambda x: paddle.std(x), (_r(S, 20),),
+         lambda x: x.std(ddof=1), rtol=1e-4),
+    Case("median", lambda x: paddle.median(x), (_r((5,), 20),),
+         np.median, grad=False),
+    Case("norm_fro", lambda x: paddle.linalg.norm(x), (_r(S, 21),),
+         np.linalg.norm, rtol=1e-4),
+    Case("norm_l1", lambda x: paddle.linalg.norm(x, p=1, axis=1),
+         (_r(S, 21),), lambda x: np.abs(x).sum(1)),
+    # ---------------- matmul family
+    Case("matmul", paddle.matmul, (_r((2, 4), 22), _r((4, 3), 23)), np.matmul),
+    Case("matmul_tx", lambda x, y: paddle.matmul(x, y, transpose_x=True),
+         (_r((4, 2), 22), _r((4, 3), 23)),
+         lambda x, y: x.T @ y),
+    Case("bmm", paddle.bmm, (_r((2, 3, 4), 24), _r((2, 4, 5), 25)), np.matmul),
+    Case("dot", paddle.dot, (_r((4,), 26), _r((4,), 27)), np.dot),
+    Case("outer", paddle.outer, (_r((3,), 26), _r((4,), 27)), np.outer),
+    Case("mv", paddle.mv, (_r((3, 4), 28), _r((4,), 29)), np.matmul),
+    Case("t", paddle.t, (_r(S, 30),), np.transpose),
+    # ---------------- manipulation
+    Case("reshape", lambda x: paddle.reshape(x, [3, 2]), (_r(S, 31),),
+         lambda x: x.reshape(3, 2)),
+    Case("transpose", lambda x: paddle.transpose(x, [1, 0]), (_r(S, 31),),
+         np.transpose),
+    Case("squeeze", lambda x: paddle.squeeze(x, axis=1), (_r((2, 1, 3), 31),),
+         lambda x: x.squeeze(1)),
+    Case("unsqueeze", lambda x: paddle.unsqueeze(x, axis=0), (_r(S, 31),),
+         lambda x: x[None]),
+    Case("concat", lambda x, y: paddle.concat([x, y], axis=0),
+         (_r(S, 32), _r(S, 33)), lambda x, y: np.concatenate([x, y], 0)),
+    Case("stack", lambda x, y: paddle.stack([x, y], axis=0),
+         (_r(S, 32), _r(S, 33)), lambda x, y: np.stack([x, y], 0)),
+    Case("split", lambda x: paddle.split(x, 3, axis=1)[1], (_r((2, 6), 34),),
+         lambda x: np.split(x, 3, 1)[1]),
+    Case("chunk", lambda x: paddle.chunk(x, 2, axis=1)[0], (_r((2, 6), 34),),
+         lambda x: np.split(x, 2, 1)[0]),
+    Case("flip", lambda x: paddle.flip(x, axis=[1]), (_r(S, 35),),
+         lambda x: x[:, ::-1]),
+    Case("roll", lambda x: paddle.roll(x, 1, axis=1), (_r(S, 35),),
+         lambda x: np.roll(x, 1, 1)),
+    Case("tile", lambda x: paddle.tile(x, [2, 1]), (_r(S, 36),),
+         lambda x: np.tile(x, (2, 1))),
+    Case("expand", lambda x: paddle.expand(x, [4, 3]), (_r((1, 3), 36),),
+         lambda x: np.broadcast_to(x, (4, 3))),
+    Case("broadcast_to", lambda x: paddle.broadcast_to(x, [2, 3]),
+         (_r((3,), 36),), lambda x: np.broadcast_to(x, (2, 3))),
+    Case("flatten", lambda x: paddle.flatten(x), (_r((2, 3, 2), 37),),
+         np.ravel),
+    Case("slice_basic", lambda x: x[:, 1:3], (_r((2, 4), 37),),
+         lambda x: x[:, 1:3]),
+    Case("gather", lambda x: paddle.gather(x, paddle.to_tensor(
+        np.array([0, 0, 1], "int64")), axis=0), (_r(S, 38),),
+         lambda x: x[[0, 0, 1]]),
+    Case("index_select", lambda x: paddle.index_select(
+        x, paddle.to_tensor(np.array([2, 0], "int64")), axis=1), (_r(S, 38),),
+         lambda x: x[:, [2, 0]]),
+    Case("where", lambda x, y: paddle.where(
+        paddle.to_tensor(np.array([[True, False, True],
+                                   [False, True, False]])), x, y),
+         (_r(S, 39), _r(S, 40)),
+         lambda x, y: np.where([[True, False, True], [False, True, False]],
+                               x, y)),
+    Case("pad2d", lambda x: F.pad(x, [1, 1], value=0.0), (_r(S, 41),),
+         lambda x: np.pad(x, [(0, 0), (1, 1)])),
+    Case("diag", paddle.diag, (_r((3,), 41),), np.diag),
+    Case("tril", paddle.tril, (_r((3, 3), 41),), np.tril),
+    Case("triu", paddle.triu, (_r((3, 3), 41),), np.triu),
+    Case("kron", paddle.kron, (_r((2, 2), 42), _r((2, 2), 43)), np.kron),
+    # ---------------- sorting / search (non-diff)
+    Case("argmax", lambda x: paddle.argmax(x, axis=1), (_r(S, 44),),
+         lambda x: x.argmax(1), grad=False),
+    Case("argmin", lambda x: paddle.argmin(x, axis=1), (_r(S, 44),),
+         lambda x: x.argmin(1), grad=False),
+    Case("argsort", lambda x: paddle.argsort(x, axis=1), (_r(S, 44),),
+         lambda x: x.argsort(1), grad=False),
+    Case("sort", lambda x: paddle.sort(x, axis=1), (_r(S, 44),),
+         lambda x: np.sort(x, 1)),
+    Case("topk_values", lambda x: paddle.topk(x, 2, axis=1)[0], (_r(S, 45),),
+         lambda x: -np.sort(-x, 1)[:, :2]),
+    # ---------------- comparison / logical (non-diff)
+    Case("equal", lambda x, y: paddle.equal(x, y).astype("float32"),
+         (_ints(S, 46).astype("float32"), _ints(S, 47).astype("float32")),
+         lambda x, y: (x == y).astype("float32"), grad=False),
+    Case("less_than", lambda x, y: paddle.less_than(x, y).astype("float32"),
+         (_r(S, 46), _r(S, 47)), lambda x, y: (x < y).astype("float32"),
+         grad=False),
+    Case("greater_equal",
+         lambda x, y: paddle.greater_equal(x, y).astype("float32"),
+         (_r(S, 46), _r(S, 47)), lambda x, y: (x >= y).astype("float32"),
+         grad=False),
+    Case("logical_and",
+         lambda x, y: paddle.logical_and(x > 0, y > 0).astype("float32"),
+         (_r(S, 48), _r(S, 49)),
+         lambda x, y: ((x > 0) & (y > 0)).astype("float32"), grad=False),
+    Case("isnan", lambda x: paddle.isnan(x).astype("float32"),
+         (np.array([[1.0, np.nan, 2.0]], "float32"),),
+         lambda x: np.isnan(x).astype("float32"), grad=False),
+    Case("isfinite", lambda x: paddle.isfinite(x).astype("float32"),
+         (np.array([[1.0, np.inf, np.nan]], "float32"),),
+         lambda x: np.isfinite(x).astype("float32"), grad=False),
+    # ---------------- losses
+    Case("mse_loss", F.mse_loss, (_r(S, 50), _r(S, 51)),
+         lambda x, y: np.mean((x - y) ** 2)),
+    Case("l1_loss", F.l1_loss, (_r(S, 50), _r(S, 51)),
+         lambda x, y: np.mean(np.abs(x - y))),
+    Case("kl_div", lambda p, q: F.kl_div(p, q, reduction="sum"),
+         (np.log(_pos(S, 52) / _pos(S, 52).sum()), _pos(S, 53)),
+         lambda lp, q: float((q * (np.log(q) - lp)).sum()), rtol=1e-4),
+    # ---------------- norm layers (functional)
+    Case("layer_norm", lambda x: F.layer_norm(x, [3]), (_r(S, 54),),
+         lambda x: (x - x.mean(-1, keepdims=True))
+         / np.sqrt(x.var(-1, keepdims=True) + 1e-5), rtol=1e-4, atol=1e-5),
+    Case("rms_norm_fn", lambda x: F.rms_norm(x, None), (_r(S, 54),),
+         lambda x: x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-5),
+         rtol=1e-4, atol=1e-5),
+    Case("normalize", lambda x: F.normalize(x, axis=1), (_r(S, 55),),
+         lambda x: x / np.maximum(np.linalg.norm(x, axis=1, keepdims=True),
+                                  1e-12), rtol=1e-4),
+]
+
+_IDS = [c.name for c in CASES]
+assert len(set(_IDS)) == len(_IDS), "duplicate case names"
+
+
+def _tensors(case, diff=False):
+    ts = []
+    for arr in case.inputs:
+        t = paddle.to_tensor(arr)
+        if diff and np.issubdtype(arr.dtype, np.floating):
+            t.stop_gradient = False
+        ts.append(t)
+    return ts
+
+
+class TestForwardParity:
+    @pytest.mark.parametrize("case", CASES, ids=_IDS)
+    def test_forward(self, case):
+        out = case.fn(*_tensors(case))
+        got = np.asarray(out.numpy())
+        expect = np.asarray(case.ref(*case.inputs))
+        np.testing.assert_allclose(got, expect.astype(got.dtype),
+                                   rtol=case.rtol, atol=case.atol)
+
+
+GRAD_CASES = [c for c in CASES if c.grad]
+
+
+class TestGradParity:
+    @pytest.mark.parametrize("case", GRAD_CASES, ids=[c.name for c in GRAD_CASES])
+    def test_numeric_gradient(self, case):
+        """Analytic tape grad vs central difference THROUGH the paddle op."""
+        ts = _tensors(case, diff=True)
+        out = case.fn(*ts)
+        loss = out.sum() if out.ndim > 0 else out
+        loss.backward()
+
+        eps = 1e-3
+        for k, (t, arr) in enumerate(zip(ts, case.inputs)):
+            if t.stop_gradient:
+                continue
+            assert t.grad is not None, f"input {k} got no grad"
+            analytic = np.asarray(t.grad.numpy())
+            flat = arr.ravel()
+            numeric = np.zeros_like(flat)
+            for i in range(flat.size):
+                for sgn in (+1, -1):
+                    pert = arr.copy().ravel()
+                    pert[i] += sgn * eps
+                    ins = list(case.inputs)
+                    ins[k] = pert.reshape(arr.shape)
+                    o = case.fn(*[paddle.to_tensor(a) for a in ins])
+                    val = float((o.sum() if o.ndim > 0 else o).numpy())
+                    numeric[i] += sgn * val
+            numeric = (numeric / (2 * eps)).reshape(arr.shape)
+            np.testing.assert_allclose(
+                analytic, numeric, rtol=2e-2, atol=2e-3,
+                err_msg=f"{case.name}: grad mismatch on input {k}")
