@@ -1,5 +1,16 @@
 from .process_mesh import ProcessMesh
 from .placement import Placement, Replicate, Shard, Partial, to_partition_spec
+from .parallelize import (
+    ColWiseEmbeddingParallel,
+    ColWiseParallel,
+    PlanBase,
+    RowWiseEmbeddingParallel,
+    RowWiseParallel,
+    SequenceParallelBegin,
+    SequenceParallelEnd,
+    parallelize,
+)
+from .static_engine import Engine
 from .api import (
     DistAttr,
     shard_tensor,
@@ -15,5 +26,7 @@ __all__ = [
     "ProcessMesh", "Placement", "Replicate", "Shard", "Partial",
     "to_partition_spec", "DistAttr", "shard_tensor", "reshard",
     "dtensor_from_fn", "unshard_dtensor", "shard_layer",
-    "get_placements", "get_mesh",
+    "get_placements", "get_mesh", "parallelize", "Engine", "PlanBase",
+    "ColWiseParallel", "RowWiseParallel", "ColWiseEmbeddingParallel",
+    "RowWiseEmbeddingParallel", "SequenceParallelBegin", "SequenceParallelEnd",
 ]

@@ -1,0 +1,123 @@
+"""Intermediate one-call parallelize API.
+
+Reference parity: python/paddle/distributed/auto_parallel/intermediate/
+parallelize.py — parallelize(model, optimizer, config) applies TP/PP/DP
+plans by layer-name pattern. TPU-native: a "plan" is a NamedSharding
+placement rule; applying it re-places the matched layers' weights over the
+hybrid mesh axes and GSPMD inserts the collectives (no layer rewriting —
+the reference swaps in ColumnParallelLinear subclasses, here placement IS
+the parallelism).
+"""
+from __future__ import annotations
+
+import fnmatch
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class PlanBase:
+    def apply(self, layer, mesh):
+        raise NotImplementedError
+
+
+class ColWiseParallel(PlanBase):
+    """Linear weight [in, out]: shard the OUT dim over mp (Megatron column)."""
+
+    def __init__(self, gather_output: bool = False):
+        self.gather_output = gather_output
+
+    def apply(self, layer, mesh):
+        _place(layer, "weight", mesh, P(None, "mp"))
+        _place(layer, "bias", mesh, P("mp"))
+
+
+class RowWiseParallel(PlanBase):
+    """Linear weight [in, out]: shard the IN dim over mp (Megatron row)."""
+
+    def __init__(self, is_input_parallel: bool = True):
+        self.is_input_parallel = is_input_parallel
+
+    def apply(self, layer, mesh):
+        _place(layer, "weight", mesh, P("mp", None))
+        _place(layer, "bias", mesh, P(None))
+
+
+class ColWiseEmbeddingParallel(PlanBase):
+    """Embedding weight [vocab, hidden]: shard hidden over mp."""
+
+    def apply(self, layer, mesh):
+        _place(layer, "weight", mesh, P(None, "mp"))
+
+
+class RowWiseEmbeddingParallel(PlanBase):
+    """Embedding weight [vocab, hidden]: shard the vocab dim over mp."""
+
+    def apply(self, layer, mesh):
+        _place(layer, "weight", mesh, P("mp", None))
+
+
+class SequenceParallelBegin(PlanBase):
+    def apply(self, layer, mesh):  # marker: activations shard at runtime
+        layer._sp_begin = True
+
+
+class SequenceParallelEnd(PlanBase):
+    def apply(self, layer, mesh):
+        layer._sp_end = True
+
+
+def _place(layer, attr, mesh, spec):
+    p = getattr(layer, attr, None)
+    if p is None:
+        return
+    entries = list(spec)
+    if len(entries) > len(p.shape):
+        entries = entries[:len(p.shape)]
+    entries += [None] * (len(p.shape) - len(entries))
+    p._assign_raw(jax.device_put(p._data, NamedSharding(mesh, P(*entries))))
+
+
+def parallelize(model, optimizer=None, config=None):
+    """Apply dp/mp/pp configs (≙ intermediate/parallelize.py).
+
+    config = {
+      "mp_config": {"parallelize_plan": {"llama.layers.*.q_proj": ColWiseParallel(), ...}},
+      "dp_config": {"sharding_level": 0|1|2|3},
+      "pp_config": {...},   # pipeline split is PipelineLayer's job here
+    }
+    Returns (model, optimizer).
+    """
+    from .. import fleet
+
+    config = config or {}
+    hcg = fleet.get_hybrid_communicate_group()
+    mesh = hcg.get_mesh()
+
+    mp_cfg = config.get("mp_config") or {}
+    plan = mp_cfg.get("parallelize_plan") or {}
+    if plan and "mp" not in mesh.axis_names:
+        raise ValueError("mp_config given but the hybrid mesh has no 'mp' axis")
+    for pattern, rule in plan.items():
+        matched = False
+        for name, layer in model.named_sublayers():
+            if fnmatch.fnmatch(name, pattern):
+                rule.apply(layer, mesh)
+                matched = True
+        if not matched:
+            import warnings
+
+            warnings.warn(f"parallelize: pattern '{pattern}' matched no layer")
+
+    dp_cfg = config.get("dp_config") or {}
+    level = int(dp_cfg.get("sharding_level", 0) or 0)
+    if level > 0 and optimizer is not None:
+        from ..sharding.sharding_optimizer import (
+            ShardingOptimizerStage1, ShardingOptimizerStage2,
+        )
+
+        axis = "sharding" if "sharding" in mesh.axis_names and \
+            mesh.shape["sharding"] > 1 else "dp"
+        cls = ShardingOptimizerStage1 if level == 1 else ShardingOptimizerStage2
+        optimizer = cls(optimizer, hcg, axis=axis)
+    return model, optimizer

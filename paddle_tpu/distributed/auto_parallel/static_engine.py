@@ -1,0 +1,231 @@
+"""Auto-parallel static Engine.
+
+Reference parity: python/paddle/distributed/auto_parallel/static/engine.py:99
+— Engine.prepare runs completion (dist-attr propagation), partitioner, and
+reshard insertion, then fit/evaluate/predict drive the partitioned static
+program. TPU-native collapse: completion+partition+reshard ARE GSPMD — the
+Engine jits ONE train/eval/predict step over the sharded parameters via
+to_static, and XLA's SPMD partitioner inserts every collective. What
+remains (and is implemented here) is the orchestration: mode-keyed compiled
+programs, the epoch loop with dp batch sharding, metrics, and save/load.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Engine:
+    """engine = Engine(model, loss, optimizer, metrics); engine.fit(ds)"""
+
+    def __init__(self, model, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = _to_list(metrics)
+        self.strategy = strategy
+        self._steps: dict[str, object] = {}  # mode -> CompiledFunction
+        self._n_inputs: int | None = None    # from inputs_spec (prepare)
+        self._prepared = False
+
+    def _split(self, batch):
+        """(inputs, labels) from one batch: inputs_spec wins; with no loss
+        the model computes its own loss and EVERYTHING is an input."""
+        batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+        if self._n_inputs is not None:
+            return batch[:self._n_inputs], batch[self._n_inputs:]
+        if self.loss is None:
+            return batch, []
+        return batch[:-1], batch[-1:]
+
+    # ------------------------------------------------------------ prepare
+    def prepare(self, inputs_spec=None, labels_spec=None, main_program=None,
+                startup_program=None, mode: str = "train"):
+        """Build the compiled step for `mode` (lazy per-mode cache)."""
+        import paddle_tpu as paddle
+
+        if mode == "train" and self.optimizer is None:
+            raise ValueError("Engine.prepare(mode='train') needs an optimizer")
+        if inputs_spec is not None:
+            self._n_inputs = len(_to_list(inputs_spec))
+
+        if mode == "train":
+            def step(*batch):
+                ins, labels = self._split(batch)
+                out = self.model(*ins)
+                loss = self.loss(out, *labels) if self.loss else out
+                if loss.ndim > 0:
+                    loss = loss.mean()
+                loss.backward()
+                self.optimizer.step()
+                self.optimizer.clear_grad()
+                return loss
+        elif mode == "eval":
+            def step(*batch):
+                from ...core.dispatch import no_grad
+
+                ins, labels = self._split(batch)
+                with no_grad():
+                    out = self.model(*ins)
+                    loss = self.loss(out, *labels) if self.loss else out
+                    if loss.ndim > 0:
+                        loss = loss.mean()
+                return loss, out
+        else:  # predict
+            def step(*ins):
+                from ...core.dispatch import no_grad
+
+                with no_grad():
+                    return self.model(*ins)
+
+        self._steps[mode] = paddle.jit.to_static(step)
+        self._prepared = True
+        return self
+
+    def _step_for(self, mode):
+        if mode not in self._steps:
+            self.prepare(mode=mode)
+        return self._steps[mode]
+
+    # ------------------------------------------------------------ batching
+    def _shard_batch(self, arrs):
+        """Place batch dim over the dp axis when the hybrid mesh has one
+        (the reference's reshard-inputs step)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .. import fleet
+
+        if not fleet.is_initialized():
+            return arrs
+        mesh = fleet.get_hybrid_communicate_group().get_mesh()
+        if "dp" not in mesh.axis_names or mesh.shape["dp"] <= 1:
+            return arrs
+        out = []
+        for a in arrs:
+            data = a._data if isinstance(a, Tensor) else a
+            if data.ndim > 0 and data.shape[0] % mesh.shape["dp"] == 0:
+                spec = P(*(["dp"] + [None] * (data.ndim - 1)))
+                data = jax.device_put(data, NamedSharding(mesh, spec))
+            out.append(Tensor(data, _internal=True))
+        return out
+
+    def _loader(self, data, batch_size):
+        from ...io import DataLoader
+
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=False)
+
+    # ------------------------------------------------------------ loops
+    def fit(self, train_data=None, valid_data=None, batch_size=1, epochs=1,
+            steps_per_iter=None, log_freq=10, save_dir=None, save_freq=1,
+            valid_freq=1, verbose=1, callbacks=None, num_iters=None):
+        import paddle_tpu as paddle
+
+        step = self._step_for("train")
+        loader = self._loader(train_data, batch_size)
+        history = {"loss": []}
+        for _epoch in range(epochs):
+            for it, batch in enumerate(loader):
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                batch = self._shard_batch(batch)
+                loss = step(*batch)
+                history["loss"].append(float(loss.numpy()))
+                if num_iters is not None and it + 1 >= num_iters:
+                    break
+            if valid_data is not None:
+                self.evaluate(valid_data, batch_size=batch_size, verbose=0)
+        if save_dir:
+            self.save(save_dir + "/model")
+        return history
+
+    def evaluate(self, valid_data, batch_size=1, steps=None, log_freq=10,
+                 verbose=1, callbacks=None):
+        step = self._step_for("eval")
+        loader = self._loader(valid_data, batch_size)
+        for m in self.metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            batch = self._shard_batch(batch)
+            loss, out = step(*batch)
+            losses.append(float(loss.numpy()))
+            for m in self.metrics:
+                m.update(m.compute(out, batch[-1]))
+        res = {"eval_loss": float(np.mean(losses)) if losses else None}
+        for m in self.metrics:
+            res[m.name()] = m.accumulate()
+        return res
+
+    def predict(self, test_data, batch_size=1, steps=None, verbose=1,
+                callbacks=None):
+        step = self._step_for("predict")
+        loader = self._loader(test_data, batch_size)
+        outs = []
+        for batch in loader:
+            # predict datasets may still carry labels; split like fit does
+            # (inputs_spec wins, no-loss mode feeds everything)
+            ins, _labels = self._split(batch)
+            ins = self._shard_batch(ins)
+            outs.append(step(*ins).numpy())
+        return outs
+
+    # ------------------------------------------------------------ persistence
+    def save(self, path, training=True):
+        from ... import distributed as dist
+
+        state = {"model": self.model.state_dict()}
+        if training and self.optimizer is not None:
+            state["optimizer"] = self.optimizer.state_dict()
+        dist.save_state_dict(state, path)
+
+    def load(self, path, strict=True, load_optimizer=True):
+        import json
+        import os
+        import pickle
+
+        from ... import distributed as dist
+
+        state = {"model": self.model.state_dict()}
+        if load_optimizer and self.optimizer is not None:
+            # a fresh optimizer creates its accumulators LAZILY, so its
+            # state_dict can't serve as the load template (the checkpoint's
+            # moment entries would be classified "unexpected" and silently
+            # dropped) — build the template from the checkpoint metadata
+            with open(os.path.join(path, "metadata.json")) as f:
+                meta = json.load(f)
+            tmpl = {}
+            for name, t in meta["tensors"].items():
+                if name.startswith("optimizer."):
+                    tmpl[name[len("optimizer."):]] = Tensor(
+                        np.zeros(t["global_shape"], np.dtype(t["dtype"])))
+            obj_path = os.path.join(path, "objects.pkl")
+            if os.path.exists(obj_path):
+                with open(obj_path, "rb") as f:
+                    for name, v in pickle.load(f).items():
+                        if name.startswith("optimizer."):
+                            tmpl[name[len("optimizer."):]] = v
+            if tmpl:
+                state["optimizer"] = tmpl
+        dist.load_state_dict(state, path, strict=strict)
+        self.model.set_state_dict(state["model"])
+        if load_optimizer and self.optimizer is not None and \
+                "optimizer" in state:
+            self.optimizer.set_state_dict(state["optimizer"])
+        return self
+
+    @property
+    def main_program(self):  # parity: the XLA program replaces ProgramDesc
+        return self._steps.get("train")
