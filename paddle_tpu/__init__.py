@@ -70,6 +70,9 @@ from . import quantization
 from . import sparse
 from . import static
 from . import inference
+from . import audio
+from . import onnx
+from . import utils
 from .framework_io import save, load
 
 # paddle.framework parity namespace bits

@@ -212,7 +212,15 @@ def _is_tracer(x):
 
 
 class debugging:
-    """≙ paddle.amp.debugging — per-op NaN/Inf scan toggles."""
+    """≙ paddle.amp.debugging — NaN/Inf toggles + op-dtype stats +
+    run-based accuracy compare (debug_tools.py)."""
+
+    from .debug_tools import (  # noqa: F401 — surfaced as methods
+        collect_operator_stats,
+        compare_accuracy,
+        disable_operator_stats_collection,
+        enable_operator_stats_collection,
+    )
 
     class TensorCheckerConfig:
         def __init__(self, enable=True, debug_mode=None, **kw):

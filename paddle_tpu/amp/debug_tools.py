@@ -1,0 +1,97 @@
+"""AMP numeric debugging (≙ python/paddle/amp/debugging.py:235).
+
+Beyond the TensorCheckerConfig NaN/Inf toggles in amp/__init__:
+
+* operator stats collection — per-op call counts bucketed by output dtype
+  (the reference's low-precision op audit: "which ops actually ran in
+  bf16?"), hooked into the dispatch funnel while enabled.
+* compare_accuracy — run the SAME callable in fp32 and under amp, report
+  per-output max abs/rel divergence (the role of the reference's
+  accuracy_compare log diffing, run-based instead of dump-file-based).
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+import numpy as np
+
+
+_stats: dict | None = None
+
+
+def _stat_fn(name, outputs):
+    for o in outputs:
+        dt = str(getattr(o, "dtype", "?"))
+        _stats[name][dt] += 1  # type: ignore[index]
+
+
+def enable_operator_stats_collection():
+    """Start counting (op, output dtype) occurrences."""
+    global _stats
+    from ..core import dispatch
+
+    _stats = defaultdict(lambda: defaultdict(int))
+    dispatch._op_stat_fn = _stat_fn
+
+
+def disable_operator_stats_collection() -> dict:
+    """Stop collecting; returns {op_name: {dtype: count}} and prints the
+    reference-style summary table."""
+    global _stats
+    from ..core import dispatch
+
+    dispatch._op_stat_fn = None
+    out = {k: dict(v) for k, v in (_stats or {}).items()}
+    _stats = None
+    if out:
+        dtypes = sorted({d for v in out.values() for d in v})
+        header = f"{'op':<28}" + "".join(f"{d:>16}" for d in dtypes)
+        lines = ["-" * len(header), "Operator dtype stats", header,
+                 "-" * len(header)]
+        for name in sorted(out):
+            row = f"{name:<28}" + "".join(
+                f"{out[name].get(d, 0):>16}" for d in dtypes)
+            lines.append(row)
+        print("\n".join(lines))
+    return out
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def compare_accuracy(func, args=(), dtype: str = "bfloat16", level: str = "O1",
+                     rtol: float = 1e-2, atol: float = 1e-2,
+                     raise_on_mismatch: bool = False) -> list[dict]:
+    """Run func(*args) in fp32 and under amp(dtype, level); per-output report
+    of max abs/rel error (≙ debugging accuracy_compare, run-based)."""
+    from .. import amp
+
+    ref_out = func(*args)
+    with amp.auto_cast(enable=True, dtype=dtype, level=level):
+        amp_out = func(*args)
+
+    refs = ref_out if isinstance(ref_out, (list, tuple)) else [ref_out]
+    amps = amp_out if isinstance(amp_out, (list, tuple)) else [amp_out]
+    report = []
+    for i, (r, a) in enumerate(zip(refs, amps)):
+        rv = np.asarray(r.numpy(), np.float32)
+        av = np.asarray(a.astype("float32").numpy()
+                        if hasattr(a, "astype") else a, np.float32)
+        abs_err = float(np.max(np.abs(rv - av))) if rv.size else 0.0
+        denom = np.maximum(np.abs(rv), 1e-6)
+        rel_err = float(np.max(np.abs(rv - av) / denom)) if rv.size else 0.0
+        entry = {"output": i, "max_abs_err": abs_err, "max_rel_err": rel_err,
+                 "ok": abs_err <= atol or rel_err <= rtol}
+        report.append(entry)
+        if raise_on_mismatch and not entry["ok"]:
+            raise AssertionError(
+                f"amp({dtype},{level}) output {i} diverges from fp32: "
+                f"abs {abs_err:.3e} rel {rel_err:.3e}")
+    return report

@@ -1,0 +1,11 @@
+"""paddle.audio (≙ python/paddle/audio) — feature extraction subset.
+
+Functional features implemented over jnp (differentiable); dataset
+downloads are unavailable in this environment (datasets raise with
+instructions, like paddle.vision.datasets).
+"""
+from . import functional
+from .features import LogMelSpectrogram, MFCC, MelSpectrogram, Spectrogram
+
+__all__ = ["functional", "Spectrogram", "MelSpectrogram", "LogMelSpectrogram",
+           "MFCC"]

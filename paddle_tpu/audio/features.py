@@ -1,0 +1,88 @@
+"""paddle.audio.features layers (≙ python/paddle/audio/features/layers.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import op_call
+from ..nn.layer_base import Layer
+from . import functional as AF
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length: int | None = None,
+                 win_length: int | None = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True, pad_mode: str = "reflect",
+                 dtype: str = "float32"):
+        super().__init__()
+        self.kw = dict(n_fft=n_fft, hop_length=hop_length,
+                       win_length=win_length, window=window, power=power,
+                       center=center)
+
+    def forward(self, x):
+        return AF.spectrogram(x, **self.kw)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: int | None = None, win_length: int | None = None,
+                 window: str = "hann", power: float = 2.0, center: bool = True,
+                 n_mels: int = 64, f_min: float = 50.0, f_max: float | None = None,
+                 htk: bool = False, norm: str = "slaney", dtype: str = "float32"):
+        super().__init__()
+        self.spec = Spectrogram(n_fft, hop_length, win_length, window, power,
+                                center)
+        self.fbank = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                             htk, norm)
+
+    def forward(self, x):
+        s = self.spec(x)  # [..., frames, bins]
+        fb = self.fbank
+
+        def fn(sv, fbv):
+            return sv @ fbv.T  # [..., frames, n_mels]
+
+        return op_call(fn, s, fb, name="mel_spectrogram")
+
+
+class LogMelSpectrogram(MelSpectrogram):
+    def __init__(self, *args, ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: float | None = None, **kw):
+        super().__init__(*args, **kw)
+        self.amin = amin
+        self.ref_value = ref_value
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = super().forward(x)
+        amin, ref, top_db = self.amin, self.ref_value, self.top_db
+
+        def fn(m):
+            db = 10.0 * jnp.log10(jnp.maximum(m, amin) / ref)
+            if top_db is not None:
+                db = jnp.maximum(db, db.max() - top_db)
+            return db
+
+        return op_call(fn, mel, name="log_mel")
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_mels: int = 64,
+                 **mel_kw):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr=sr, n_mels=n_mels, **mel_kw)
+        # type-II DCT basis
+        k = np.arange(n_mels)
+        dct = np.cos(np.pi / n_mels * (k + 0.5)[None, :] * np.arange(n_mfcc)[:, None])
+        dct *= np.sqrt(2.0 / n_mels)
+        dct[0] *= np.sqrt(0.5)
+        self._dct = jnp.asarray(dct.T, jnp.float32)  # [n_mels, n_mfcc]
+
+    def forward(self, x):
+        lm = self.logmel(x)
+        dct = self._dct
+
+        def fn(m):
+            return m @ dct
+
+        return op_call(fn, lm, name="mfcc")

@@ -1,0 +1,130 @@
+"""paddle.audio.functional subset (≙ python/paddle/audio/functional).
+
+STFT/mel machinery as jnp compositions through the dispatch funnel — the
+MXU-friendly formulation (framing via gather + matmul with the DFT/mel
+bases) rather than a CUDA FFT binding.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import op_call
+from ..core.tensor import Tensor
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True):
+    """hann/hamming/blackman/rectangular window as a Tensor."""
+    n = win_length
+    k = np.arange(n)
+    denom = n if fftbins else n - 1
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * k / denom)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * k / denom)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * k / denom)
+             + 0.08 * np.cos(4 * np.pi * k / denom))
+    elif window in ("rect", "rectangular", "ones", "boxcar"):
+        w = np.ones(n)
+    else:
+        raise ValueError(f"unknown window '{window}'")
+    return Tensor(jnp.asarray(w, jnp.float32), _internal=True)
+
+
+def hz_to_mel(freq, htk: bool = False):
+    f = np.asarray(freq, np.float64)
+    if htk:
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(f >= min_log_hz,
+                    min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep,
+                    mels)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = np.asarray(mel, np.float64)
+    if htk:
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(m >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (m - min_log_mel)), freqs)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64, f_min: float = 0.0,
+                         f_max: float | None = None, htk: bool = False,
+                         norm: str = "slaney"):
+    """[n_mels, n_fft//2 + 1] triangular mel filter bank."""
+    f_max = f_max or sr / 2.0
+    n_bins = n_fft // 2 + 1
+    fft_freqs = np.linspace(0, sr / 2, n_bins)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                          n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    fb = np.zeros((n_mels, n_bins))
+    for m in range(n_mels):
+        lo, ctr, hi = hz_pts[m], hz_pts[m + 1], hz_pts[m + 2]
+        up = (fft_freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - fft_freqs) / max(hi - ctr, 1e-10)
+        fb[m] = np.maximum(0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+        fb *= enorm[:, None]
+    return Tensor(jnp.asarray(fb, jnp.float32), _internal=True)
+
+
+def _frame(xv, frame_length, hop_length):
+    n = xv.shape[-1]
+    n_frames = 1 + (n - frame_length) // hop_length
+    idx = (np.arange(frame_length)[None, :]
+           + hop_length * np.arange(n_frames)[:, None])
+    return xv[..., idx]  # [..., n_frames, frame_length]
+
+
+def stft(x: Tensor, n_fft: int = 512, hop_length: int | None = None,
+         win_length: int | None = None, window: str = "hann",
+         center: bool = True, pad_mode: str = "reflect"):
+    """Magnitude-capable complex STFT as framed matmul with the DFT basis.
+    Returns (real, imag) Tensors [..., n_frames, n_fft//2 + 1]."""
+    win_length = win_length or n_fft
+    hop_length = hop_length or win_length // 4
+    w = get_window(window, win_length)._data
+    if win_length < n_fft:  # center-pad the window
+        pad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+    k = np.arange(n_fft // 2 + 1)[:, None] * np.arange(n_fft)[None, :]
+    ang = -2.0 * np.pi * k / n_fft
+    cos_b = jnp.asarray(np.cos(ang).T, jnp.float32)  # [n_fft, bins]
+    sin_b = jnp.asarray(np.sin(ang).T, jnp.float32)
+
+    def fn(xv):
+        if center:
+            pad = n_fft // 2
+            mode = "reflect" if pad_mode == "reflect" else "constant"
+            xv = jnp.pad(xv, [(0, 0)] * (xv.ndim - 1) + [(pad, pad)], mode=mode)
+        frames = _frame(xv, n_fft, hop_length) * w
+        return frames @ cos_b, frames @ sin_b
+
+    return op_call(fn, x, name="stft")
+
+
+def spectrogram(x: Tensor, n_fft: int = 512, hop_length: int | None = None,
+                win_length: int | None = None, window: str = "hann",
+                power: float = 2.0, center: bool = True):
+    re, im = stft(x, n_fft, hop_length, win_length, window, center)
+
+    def fn(r, i):
+        mag = r * r + i * i
+        return mag if power == 2.0 else jnp.power(jnp.sqrt(mag), power)
+
+    return op_call(fn, re, im, name="spectrogram")

@@ -355,6 +355,9 @@ def _make_ctx(fn, datas, diff_idx):
 #: set by paddle_tpu.profiler while recording: callable(name) -> RecordEvent
 _profiler_hook = None
 
+#: set by amp.debugging while collecting op-dtype stats: fn(name, outputs)
+_op_stat_fn = None
+
 
 def op_call(fn: Callable, *args, name: str | None = None, n_diff: int | None = None):
     """Run pure jax function `fn` over mixed Tensor/raw args, recording autograd.
@@ -466,6 +469,9 @@ def _wrap_outputs(out, node, name):
     if flag("FLAGS_check_nan_inf"):
         flat = [out] if not isinstance(out, (tuple, list)) else list(out)
         _check_nan_inf(name, [o for o in flat if hasattr(o, "dtype")])
+    if _op_stat_fn is not None:
+        flat = [out] if not isinstance(out, (tuple, list)) else list(out)
+        _op_stat_fn(name, [o for o in flat if hasattr(o, "dtype")])
 
     def mk(o, idx):
         t = Tensor(o, stop_gradient=node is None, _internal=True)

@@ -71,3 +71,41 @@ define_flag("FLAGS_log_level", 0, "VLOG-style verbosity")
 define_flag("FLAGS_cudnn_deterministic", False, "parity shim; XLA is deterministic")
 define_flag("FLAGS_embedding_deterministic", False, "parity shim")
 define_flag("FLAGS_allocator_strategy", "xla", "parity shim; XLA owns allocation")
+
+# Reference flag-name parity (flags.cc defines 187 PHI_DEFINE_EXPORTED_*;
+# the commonly consumed ones are registered here so set_flags/get_flags and
+# FLAGS_* env seeding work for ported code — shims note where XLA makes the
+# knob moot).
+define_flag("FLAGS_check_nan_inf_level", 0, "0: raise on nan/inf; >0 thresholds")
+define_flag("FLAGS_benchmark", False, "sync-per-op benchmark mode shim")
+define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "shim; XLA GC owns buffers")
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92,
+            "maps to XLA_PYTHON_CLIENT_MEM_FRACTION at init")
+define_flag("FLAGS_gpu_memory_limit_mb", 0, "per-chip HBM cap shim")
+define_flag("FLAGS_initial_cpu_memory_in_mb", 500, "host allocator shim")
+define_flag("FLAGS_use_pinned_memory", True, "host staging shim")
+define_flag("FLAGS_conv_workspace_size_limit", 512, "shim; XLA autotunes")
+define_flag("FLAGS_cudnn_exhaustive_search", False, "shim; XLA autotunes")
+define_flag("FLAGS_sync_nccl_allreduce", False,
+            "shim; ICI collectives are compiler-scheduled")
+define_flag("FLAGS_max_inplace_grad_add", 0, "grad accumulation fusion shim")
+define_flag("FLAGS_apply_pass_to_program", False, "shim; XLA pass pipeline")
+define_flag("FLAGS_new_executor_serial_run", False, "shim; XLA owns scheduling")
+define_flag("FLAGS_use_stream_safe_cuda_allocator", True, "shim")
+define_flag("FLAGS_call_stack_level", 1, "error stack verbosity (1|2|3)")
+define_flag("FLAGS_enable_pir_api", True, "shim; jaxpr/StableHLO ARE the IR")
+define_flag("FLAGS_use_cinn", True, "shim; XLA IS the tensor compiler")
+define_flag("FLAGS_cinn_subgraph_graphviz_dir", "", "shim")
+define_flag("FLAGS_low_precision_op_list", 0, "amp op-stats collection level")
+define_flag("FLAGS_enable_auto_parallel_align_mode", False,
+            "bitwise-align debugging shim")
+define_flag("FLAGS_flash_attn_version", 2, "pallas flash kernel version")
+define_flag("FLAGS_set_to_1d", False, "0-D tensor compat shim")
+define_flag("FLAGS_tensor_operants_mode", "eager", "parity shim")
+define_flag("FLAGS_jit_engine_type", "xla", "executor engine selector shim")
+define_flag("FLAGS_allreduce_record_one_event", False, "comm stream shim")
+define_flag("FLAGS_distributed_heartbeat_timeout", 600,
+            "comm watchdog default timeout (seconds)")
+define_flag("FLAGS_rpc_retry_times", 3, "rpc retry shim")
+define_flag("FLAGS_dataloader_use_shared_memory", True,
+            "native shm ring transport for DataLoader workers")

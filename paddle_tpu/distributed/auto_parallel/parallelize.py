@@ -58,13 +58,39 @@ class RowWiseEmbeddingParallel(PlanBase):
 
 
 class SequenceParallelBegin(PlanBase):
-    def apply(self, layer, mesh):  # marker: activations shard at runtime
-        layer._sp_begin = True
+    """After this layer, activations shard along the SEQUENCE dim over mp
+    (a forward post-hook adds the constraint; GSPMD inserts the scatter)."""
+
+    def __init__(self, need_transpose: bool = True):
+        self.need_transpose = need_transpose
+
+    def apply(self, layer, mesh):
+        from ..meta_parallel.mp_layers import _constraint
+        from jax.sharding import PartitionSpec as P
+
+        def hook(_lyr, _ins, out):
+            if hasattr(out, "ndim") and out.ndim >= 2:
+                return _constraint(out, P(None, "mp"))  # [b, s, ...]: shard s
+            return out
+
+        layer.register_forward_post_hook(hook)
 
 
 class SequenceParallelEnd(PlanBase):
+    """After this layer, gather the sequence dim back (drop mp from it)."""
+
+    def __init__(self, need_transpose: bool = True):
+        self.need_transpose = need_transpose
+
     def apply(self, layer, mesh):
-        layer._sp_end = True
+        from ..meta_parallel.mp_layers import _clear_axis
+
+        def hook(_lyr, _ins, out):
+            if hasattr(out, "ndim") and out.ndim >= 2:
+                return _clear_axis(out, "mp")
+            return out
+
+        layer.register_forward_post_hook(hook)
 
 
 def _place(layer, attr, mesh, spec):
@@ -112,12 +138,12 @@ def parallelize(model, optimizer=None, config=None):
     dp_cfg = config.get("dp_config") or {}
     level = int(dp_cfg.get("sharding_level", 0) or 0)
     if level > 0 and optimizer is not None:
-        from ..sharding.sharding_optimizer import (
-            ShardingOptimizerStage1, ShardingOptimizerStage2,
-        )
+        from ..sharding import sharding_optimizer as so
 
         axis = "sharding" if "sharding" in mesh.axis_names and \
             mesh.shape["sharding"] > 1 else "dp"
-        cls = ShardingOptimizerStage1 if level == 1 else ShardingOptimizerStage2
+        cls = {1: so.ShardingOptimizerStage1,
+               2: so.ShardingOptimizerStage2,
+               3: so.ShardingOptimizerStage3}[min(level, 3)]
         optimizer = cls(optimizer, hcg, axis=axis)
     return model, optimizer

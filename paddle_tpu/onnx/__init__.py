@@ -1,0 +1,19 @@
+"""paddle.onnx (≙ python/paddle/onnx — paddle2onnx shim).
+
+ONNX export is explicitly deferred in the TPU-native design (SURVEY §7
+"what we do NOT rebuild"): the deployment artifact is serialized StableHLO
+(paddle.jit.save → paddle.inference), which XLA-backed runtimes consume
+directly. export() raises with that guidance.
+"""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "paddle.onnx.export: ONNX is not the TPU deployment path — use "
+        "paddle.jit.save(layer, path, input_spec=...) to produce serialized "
+        "StableHLO and serve it with paddle.inference.create_predictor "
+        "(SURVEY §7 defers ONNX by design)")
+
+
+__all__ = ["export"]

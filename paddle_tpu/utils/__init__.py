@@ -1,0 +1,3 @@
+from . import cpp_extension
+
+__all__ = ["cpp_extension"]

@@ -1,0 +1,124 @@
+"""paddle.utils.cpp_extension — user custom C++ op build + load.
+
+Reference parity: python/paddle/utils/cpp_extension (JIT-compile user
+C++/CUDA ops with setuptools and register them). TPU-native: user C++
+builds through the same g++-on-first-use pipeline as the in-tree native
+runtime (core/native.py), binds via ctypes, and `custom_op` lifts a C
+function into a dispatched framework op — NumPy buffers cross the C ABI,
+and an optional Python vjp makes it differentiable on the tape.
+(CUDAExtension has no meaning on TPU; device compute belongs in Pallas.)
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+
+import numpy as np
+
+
+def load(name: str, sources: list[str], extra_cxx_cflags=None,
+         extra_cuda_cflags=None, extra_ldflags=None, verbose: bool = False,
+         build_directory: str | None = None):
+    """Compile `sources` into a shared object and return the ctypes CDLL."""
+    if extra_cuda_cflags:
+        raise ValueError(
+            "cpp_extension.load: CUDA sources are not supported on the TPU "
+            "backend — write device compute as a Pallas kernel instead")
+    build_dir = build_directory or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+    os.makedirs(build_dir, exist_ok=True)
+    h = hashlib.sha1()
+    for src in sources:
+        with open(src, "rb") as f:
+            h.update(f.read())
+    so = os.path.join(build_dir, f"{name}-{h.hexdigest()[:12]}.so")
+    if not os.path.exists(so):
+        cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
+               + (extra_cxx_cflags or []) + list(sources)
+               + (extra_ldflags or []) + ["-o", so])
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return ctypes.CDLL(so)
+
+
+def custom_op(lib, symbol: str, out_shape_fn, vjp=None, name: str | None = None):
+    """Lift `lib.<symbol>(const float* in, float* out, long n)`-style C
+    kernels into a framework op.
+
+    out_shape_fn(*input_shapes) -> output shape. The C function receives
+    flat float32 buffers (inputs then output) and element counts. With
+    `vjp(inputs, cot) -> grads`, the op joins the autograd tape via
+    jax.pure_callback + custom_vjp.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import op_call
+
+    fn_c = getattr(lib, symbol)
+    op_name = name or symbol
+
+    def host_call(*arrs):
+        out_shape = out_shape_fn(*[a.shape for a in arrs])
+        out = np.zeros(out_shape, np.float32)
+        bufs = []
+        for a in arrs:
+            flat = np.ascontiguousarray(a, np.float32)
+            bufs.append(flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            bufs.append(ctypes.c_long(flat.size))
+        fn_c(*bufs, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+             ctypes.c_long(out.size))
+        return out
+
+    def impl(*vals):
+        out_shape = out_shape_fn(*[v.shape for v in vals])
+        res_spec = jax.ShapeDtypeStruct(tuple(out_shape), jnp.float32)
+        return jax.pure_callback(host_call, res_spec, *vals)
+
+    if vjp is not None:
+        wrapped = jax.custom_vjp(impl)
+
+        def fwd(*vals):
+            return impl(*vals), vals
+
+        def bwd(res, cot):
+            return tuple(vjp(res, cot))
+
+        wrapped.defvjp(fwd, bwd)
+        impl_final = wrapped
+    else:
+        impl_final = impl
+
+    def op(*tensors):
+        return op_call(impl_final, *tensors, name=op_name)
+
+    op.__name__ = op_name
+    return op
+
+
+class CppExtension:
+    """setuptools-style descriptor (≙ cpp_extension.CppExtension); consumed
+    by BuildExtension or the simpler `load()` above."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = list(sources)
+        self.kwargs = kwargs
+
+
+def CUDAExtension(*args, **kwargs):
+    raise NotImplementedError(
+        "CUDAExtension: no CUDA on the TPU backend — implement device "
+        "kernels with Pallas (see ops/pallas_attention.py for the pattern) "
+        "and host glue with CppExtension/load()")
+
+
+class BuildExtension:
+    """Minimal build driver for CppExtension in setup.py flows."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "BuildExtension: use paddle_tpu.utils.cpp_extension.load(name, "
+            "sources) — the JIT path covers custom-op builds here")
