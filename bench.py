@@ -19,9 +19,16 @@ import numpy as np
 
 
 def _sync(x):
+    """TRUE completion barrier. Over the axon TPU tunnel,
+    jax.block_until_ready returns before device execution finishes (verified:
+    0.1ms vs a 60s computation), so the only reliable barrier is fetching a
+    value derived from the output — a scalar slice keeps the transfer tiny
+    while forcing the producing program to finish."""
     import jax
+    import jax.numpy as jnp
 
-    jax.block_until_ready(x._data if hasattr(x, "_data") else x)
+    arr = x._data if hasattr(x, "_data") else x
+    jax.device_get(jnp.ravel(arr)[0])
 
 
 def _timeit(step, iters=10, warmup=3):

@@ -17,7 +17,7 @@ class Spectrogram(Layer):
         super().__init__()
         self.kw = dict(n_fft=n_fft, hop_length=hop_length,
                        win_length=win_length, window=window, power=power,
-                       center=center)
+                       center=center, pad_mode=pad_mode)
 
     def forward(self, x):
         return AF.spectrogram(x, **self.kw)

@@ -120,8 +120,9 @@ def stft(x: Tensor, n_fft: int = 512, hop_length: int | None = None,
 
 def spectrogram(x: Tensor, n_fft: int = 512, hop_length: int | None = None,
                 win_length: int | None = None, window: str = "hann",
-                power: float = 2.0, center: bool = True):
-    re, im = stft(x, n_fft, hop_length, win_length, window, center)
+                power: float = 2.0, center: bool = True,
+                pad_mode: str = "reflect"):
+    re, im = stft(x, n_fft, hop_length, win_length, window, center, pad_mode)
 
     def fn(r, i):
         mag = r * r + i * i

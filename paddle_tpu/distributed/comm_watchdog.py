@@ -136,13 +136,40 @@ def get_comm_task_manager() -> CommTaskManager:
 def watched_barrier(tag: str = "barrier", timeout: float = 300.0,
                     group=None) -> None:
     """Cross-process barrier with hang diagnostics. Coordination service ≙
-    TCPStore; the watchdog turns a peer failure into an error with the
-    blocking site instead of an eternal wait."""
+    TCPStore; the watchdog turns a peer failure into a raised TimeoutError
+    carrying the diagnostics instead of an eternal wait (the barrier itself
+    runs on a daemon thread — XLA offers no collective abort, so the stuck
+    sync is abandoned, not cancelled)."""
     import jax
 
     mgr = get_comm_task_manager()
-    with mgr.watch(f"barrier:{tag}", group, timeout):
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
+    task = mgr.register(f"barrier:{tag}", group, timeout)
 
+    if jax.process_count() <= 1:
+        mgr.complete(task)
+        return
+
+    from jax.experimental import multihost_utils
+
+    done = threading.Event()
+    err: list[BaseException] = []
+
+    def _run():
+        try:
             multihost_utils.sync_global_devices(tag)
+        except BaseException as e:  # noqa: BLE001 — surfaced to the caller
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    if not done.wait(timeout):
+        diag = task.describe()
+        mgr.complete(task)
+        raise TimeoutError(
+            f"watched_barrier '{tag}' did not complete within {timeout}s — "
+            f"a peer is likely dead or hung. {diag}")
+    mgr.complete(task)
+    if err:
+        raise err[0]

@@ -23,6 +23,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 try:  # pltpu imports fail cleanly on backends without TPU support
@@ -32,7 +33,13 @@ except ImportError:  # pragma: no cover
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
-_NEG_INF = -1e30
+# paddle_tpu enables jax x64 globally, so bare python floats would trace as
+# STRONG f64 constants inside the kernels — Mosaic cannot legalize the
+# resulting f64->f32 truncf on real TPUs. Every scalar here must therefore
+# be an explicitly-typed np.float32.
+_NEG_INF = np.float32(-1e30)
+_ZERO = np.float32(0.0)
+_ONE = np.float32(1.0)
 
 
 def _interpret() -> bool:
@@ -47,9 +54,15 @@ def _ceil_to(x: int, m: int) -> int:
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
                 scale, causal, sq, sk, block_q, block_k):
-    qi = pl.program_id(2)
+    # NOTE: program_id(2) is only materialized under `causal` — Mosaic on
+    # real TPUs fails to legalize kernels carrying unused program-id-derived
+    # values ('tpu.truncf'/'func.return'), so nothing dead may be traced.
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
+    # only bound under causal (used in mask + block-skip predicate): an
+    # unused program_id value fails Mosaic legalization, and program_id
+    # cannot be called inside a pl.when body in interpreter mode
+    qi = pl.program_id(2) if causal else None
 
     @pl.when(ki == 0)
     def _init():
@@ -57,22 +70,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
         m_s[:] = jnp.full_like(m_s, _NEG_INF)
         l_s[:] = jnp.zeros_like(l_s)
 
-    q_start = qi * block_q
-    k_start = ki * block_k
-    # causal offset aligns the last q row with the last kv col
-    offset = sk - sq
-
     def compute():
-        q = q_ref[0, 0].astype(jnp.float32) * scale          # [bq, d]
+        q = q_ref[0, 0].astype(jnp.float32) * np.float32(scale)  # [bq, d]
         k = k_ref[0, 0]                                      # [bk, d]
         s = jax.lax.dot_general(
             q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)              # [bq, bk]
-        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         mask = cols < sk
         if causal:
-            mask = mask & (cols <= rows + offset)
+            # causal offset aligns the last q row with the last kv col
+            rows = qi * block_q + \
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            mask = mask & (cols <= rows + (sk - sq))
         s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_s[:, :1]                                  # [bq, 1]
@@ -81,7 +91,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)                               # [bq, bk]
-        p = jnp.where(mask, p, 0.0)
+        p = jnp.where(mask, p, _ZERO)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         v = v_ref[0, 0]                                      # [bk, d]
         pv = jax.lax.dot_general(
@@ -93,7 +103,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
 
     if causal:
         # skip kv blocks that lie entirely above the diagonal
-        @pl.when(k_start <= q_start + block_q - 1 + offset)
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1 + (sk - sq))
         def _():
             compute()
     else:
@@ -102,13 +112,29 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
     @pl.when(ki == nk - 1)
     def _finish():
         l = l_s[:, :1]
-        safe_l = jnp.where(l == 0.0, 1.0, l)
+        safe_l = jnp.where(l == _ZERO, _ONE, l)
         o_ref[0, 0] = (acc[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_s[:, 0] + jnp.log(safe_l[:, 0]))
+        # lse is lane-replicated [bq, 128]: TPU block tiling requires the
+        # last two block dims be (8k, 128)-aligned, so per-row stats ride a
+        # full lane dim (the standard TPU flash-kernel layout)
+        lse_ref[0, 0] = jnp.broadcast_to(
+            m_s[:, :1] + jnp.log(safe_l), lse_ref[0, 0].shape)
 
 
 def _flash_forward(q, k, v, causal, block_q, block_k):
-    """q,k,v: [B, H, S, D] (same H — GQA expanded by caller). Returns (o, lse)."""
+    """q,k,v: [B, H, S, D] (same H — GQA expanded by caller).
+
+    Returns (o [B,H,S,D], lse_lanes [B,H,Sq_padded,1]) — per-row softmax
+    stats (lane-replication for the TPU tiling happens inside the kernel
+    and is sliced away here to keep residuals small)."""
+    # paddle_tpu runs jax with x64 enabled; trace the pallas program with
+    # x64 OFF so index-map/kernel literals stay i32/f32 (Mosaic cannot
+    # legalize stray i64/f64 values on real TPUs)
+    with jax.enable_x64(False):
+        return _flash_forward_x32(q, k, v, causal, block_q, block_k)
+
+
+def _flash_forward_x32(q, k, v, causal, block_q, block_k):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     scale = 1.0 / math.sqrt(d)
@@ -133,11 +159,11 @@ def _flash_forward(q, k, v, causal, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d_p), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, qi, ki: (b, h, qi)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda b, h, qi, ki: (b, h, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sq_p, d_p), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq_p), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq_p, 128), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d_p), jnp.float32),
@@ -146,48 +172,47 @@ def _flash_forward(q, k, v, causal, block_q, block_k):
         ],
         interpret=_interpret(),
     )(qp, kp, vp)
-    return o[:, :, :sq, :d], lse[:, :, :sq]
+    # keep one lane in the residuals (128x smaller); backward re-broadcasts
+    return o[:, :, :sq, :d], lse[:, :, :, :1]
 
 
 # ----------------------------------------------------------------- backward
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_acc, *, scale, causal, sq, sk, block_q, block_k):
-    qi = pl.program_id(2)
+    # like _fwd_kernel: nothing dead may be traced (Mosaic legalization)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
+    qi = pl.program_id(2) if causal else None
 
     @pl.when(ki == 0)
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    q_start = qi * block_q
-    k_start = ki * block_k
-    offset = sk - sq
-
     def compute():
-        q = q_ref[0, 0].astype(jnp.float32) * scale
+        q = q_ref[0, 0].astype(jnp.float32) * np.float32(scale)
         k = k_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         mask = cols < sk
         if causal:
-            mask = mask & (cols <= rows + offset)
-        lse = lse_ref[0, 0][:, None]                          # [bq, 1]
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)            # [bq, bk]
+            rows = qi * block_q + \
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            mask = mask & (cols <= rows + (sk - sq))
+        lse = lse_ref[0, 0][:, :1]                            # [bq, 1] of lanes
+        p = jnp.where(mask, jnp.exp(s - lse), _ZERO)          # [bq, bk]
         do = do_ref[0, 0].astype(jnp.float32)                 # [bq, d]
         v = v_ref[0, 0].astype(jnp.float32)                   # [bk, d]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        delta = delta_ref[0, 0][:, None]
-        ds = p * (dp - delta) * scale                         # [bq, bk]
+        delta = delta_ref[0, 0][:, :1]
+        ds = p * (dp - delta) * np.float32(scale)             # [bq, bk]
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     if causal:
-        @pl.when(k_start <= q_start + block_q - 1 + offset)
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1 + (sk - sq))
         def _():
             compute()
     else:
@@ -201,6 +226,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *,
                     scale, causal, sq, sk, block_q, block_k):
+    # grid here is (b, h, ki, qi): kv blocks outer, q blocks inner
     ki = pl.program_id(2)
     qi = pl.program_id(3)
     nq = pl.num_programs(3)
@@ -210,29 +236,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    q_start = qi * block_q
     k_start = ki * block_k
-    offset = sk - sq
 
     def compute():
-        q = q_ref[0, 0].astype(jnp.float32) * scale           # [bq, d]
+        q = q_ref[0, 0].astype(jnp.float32) * np.float32(scale)  # [bq, d]
         k = k_ref[0, 0].astype(jnp.float32)                   # [bk, d]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         mask = cols < sk
         if causal:
-            mask = mask & (cols <= rows + offset)
-        lse = lse_ref[0, 0][:, None]
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)            # [bq, bk]
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            mask = mask & (cols <= rows + (sk - sq))
+        lse = lse_ref[0, 0][:, :1]
+        p = jnp.where(mask, jnp.exp(s - lse), _ZERO)          # [bq, bk]
         do = do_ref[0, 0].astype(jnp.float32)                 # [bq, d]
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        delta = delta_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, :1]
         # `q` here is pre-scaled by 1/sqrt(d), which is exactly dk's scale
         # factor — so ds must NOT be scaled again
         ds = p * (dp - delta)                                 # [bq, bk]
@@ -240,7 +264,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     if causal:
-        @pl.when(k_start <= q_start + block_q - 1 + offset)
+        @pl.when(k_start <= qi * block_q + block_q - 1 + (sk - sq))
         def _():
             compute()
     else:
@@ -253,7 +277,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k):
+def _flash_backward(q, k, v, o, lse_lanes, do, causal, block_q, block_k):
+    with jax.enable_x64(False):  # see _flash_forward
+        return _flash_backward_x32(q, k, v, o, lse_lanes, do, causal,
+                                   block_q, block_k)
+
+
+def _flash_backward_x32(q, k, v, o, lse_lanes, do, causal, block_q, block_k):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     scale = 1.0 / math.sqrt(d)
@@ -265,15 +295,17 @@ def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k):
     pad4 = lambda x, s: jnp.pad(x, ((0, 0), (0, 0), (0, s - x.shape[2]), (0, d_p - d)))
     qp, kp, vp = pad4(q, sq_p), pad4(k, sk_p), pad4(v, sk_p)
     dop = pad4(do, sq_p)
-    lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, sq_p - sq)))
-    deltap = jnp.pad(delta, ((0, 0), (0, 0), (0, sq_p - sq)))
+    lsep = jnp.broadcast_to(lse_lanes, (b, h, lse_lanes.shape[2], 128))
+    deltap = jnp.broadcast_to(
+        jnp.pad(delta, ((0, 0), (0, 0), (0, sq_p - sq)))[..., None],
+        (b, h, sq_p, 128))
     nq, nk = sq_p // block_q, sk_p // block_k
 
     common = dict(scale=scale, causal=causal, sq=sq, sk=sk,
                   block_q=block_q, block_k=block_k)
     q_spec = pl.BlockSpec((1, 1, block_q, d_p), lambda b, h, qi, ki: (b, h, qi, 0))
     k_spec = pl.BlockSpec((1, 1, block_k, d_p), lambda b, h, qi, ki: (b, h, ki, 0))
-    r_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, qi, ki: (b, h, qi))
+    r_spec = pl.BlockSpec((1, 1, block_q, 128), lambda b, h, qi, ki: (b, h, qi, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
@@ -288,7 +320,7 @@ def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k):
     # dkv kernel: kv blocks outer, q blocks inner
     q_spec2 = pl.BlockSpec((1, 1, block_q, d_p), lambda b, h, ki, qi: (b, h, qi, 0))
     k_spec2 = pl.BlockSpec((1, 1, block_k, d_p), lambda b, h, ki, qi: (b, h, ki, 0))
-    r_spec2 = pl.BlockSpec((1, 1, block_q), lambda b, h, ki, qi: (b, h, qi))
+    r_spec2 = pl.BlockSpec((1, 1, block_q, 128), lambda b, h, ki, qi: (b, h, qi, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
         grid=(b, h, nk, nq),

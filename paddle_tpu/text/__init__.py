@@ -1,3 +1,4 @@
 """paddle_tpu.text — NLP model zoo (≙ PaddleNLP models the BASELINE.json
 config ladder names: BERT/ERNIE fine-tune, GPT-3-medium, LLaMA-7B)."""
 from . import models
+from . import datasets

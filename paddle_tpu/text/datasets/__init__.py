@@ -1,0 +1,4 @@
+from .datasets import Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16
+
+__all__ = ["Imdb", "UCIHousing", "Conll05st", "Imikolov", "Movielens",
+           "WMT14", "WMT16"]

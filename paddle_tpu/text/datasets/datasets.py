@@ -1,0 +1,119 @@
+"""paddle.text.datasets (≙ python/paddle/text/datasets/*).
+
+Local-file readers only (zero-egress environment): Imdb reads the standard
+aclImdb tarball/directory, UCIHousing the housing.data table. The
+download-era corpora without a stable local format raise with instructions.
+"""
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+
+def _no_download(name: str, hint: str):
+    raise RuntimeError(
+        f"{name}: downloads are unavailable in this environment; place "
+        f"{hint} locally and pass data_file=...")
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression table (13 features + target per row)."""
+
+    def __init__(self, data_file=None, mode="train", download=False):
+        if data_file is None:
+            _no_download("UCIHousing", "housing.data")
+        raw = np.loadtxt(data_file).astype("float32")
+        feats, target = raw[:, :-1], raw[:, -1:]
+        # reference normalizes by feature max/min over the train split
+        lo, hi = feats.min(0), feats.max(0)
+        feats = (feats - lo) / np.maximum(hi - lo, 1e-8)
+        n_train = int(len(raw) * 0.8)
+        if mode == "train":
+            self.x, self.y = feats[:n_train], target[:n_train]
+        else:
+            self.x, self.y = feats[n_train:], target[n_train:]
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+
+class Imdb(Dataset):
+    """IMDB sentiment corpus from the standard aclImdb_v1.tar.gz (or the
+    extracted directory). Builds the vocabulary from the train split."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150, download=False):
+        if data_file is None:
+            _no_download("Imdb", "aclImdb_v1.tar.gz (or the extracted dir)")
+        self.mode = mode
+        docs = {"pos": [], "neg": []}
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        if os.path.isdir(data_file):
+            for label in ("pos", "neg"):
+                d = os.path.join(data_file, "aclImdb", mode, label)
+                if not os.path.isdir(d):
+                    d = os.path.join(data_file, mode, label)
+                for fname in sorted(os.listdir(d)):
+                    with open(os.path.join(d, fname), "rb") as f:
+                        docs[label].append(f.read().decode("utf-8", "ignore"))
+        else:
+            with tarfile.open(data_file) as tf:
+                for m in tf.getmembers():
+                    match = pat.match(m.name)
+                    if match:
+                        docs[match.group(1)].append(
+                            tf.extractfile(m).read().decode("utf-8", "ignore"))
+        self.word_idx = self._build_vocab(docs, cutoff)
+        unk = self.word_idx["<unk>"]
+        self.docs, self.labels = [], []
+        for label, texts in (("pos", docs["pos"]), ("neg", docs["neg"])):
+            for t in texts:
+                toks = self._tokenize(t)
+                self.docs.append(np.array(
+                    [self.word_idx.get(w, unk) for w in toks], "int64"))
+                self.labels.append(0 if label == "pos" else 1)
+
+    @staticmethod
+    def _tokenize(text):
+        return re.sub(r"[^a-z0-9\s]", "", text.lower()).split()
+
+    def _build_vocab(self, docs, cutoff):
+        from collections import Counter
+
+        counts = Counter()
+        for texts in docs.values():
+            for t in texts:
+                counts.update(self._tokenize(t))
+        vocab = [w for w, c in counts.most_common() if c > cutoff or len(counts) < 200]
+        word_idx = {w: i for i, w in enumerate(sorted(vocab))}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], int(self.labels[idx])
+
+
+def _stub(name, hint):
+    class _Stub(Dataset):
+        def __init__(self, *a, **k):
+            _no_download(name, hint)
+
+    _Stub.__name__ = name
+    return _Stub
+
+
+Conll05st = _stub("Conll05st", "the conll05st corpus files")
+Imikolov = _stub("Imikolov", "simple-examples.tgz")
+Movielens = _stub("Movielens", "ml-1m.zip")
+WMT14 = _stub("WMT14", "the wmt14 corpus files")
+WMT16 = _stub("WMT16", "the wmt16 corpus files")

@@ -33,6 +33,8 @@ def load(name: str, sources: list[str], extra_cxx_cflags=None,
     for src in sources:
         with open(src, "rb") as f:
             h.update(f.read())
+    # flags are part of the build identity: changing them must rebuild
+    h.update(repr((extra_cxx_cflags, extra_ldflags)).encode())
     so = os.path.join(build_dir, f"{name}-{h.hexdigest()[:12]}.so")
     if not os.path.exists(so):
         cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]

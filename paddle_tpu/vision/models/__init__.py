@@ -13,6 +13,26 @@ from .resnet import (
 )
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .alexnet import AlexNet, alexnet
+from .mobilenetv1 import MobileNetV1, mobilenet_v1
 from .mobilenetv2 import MobileNetV2, mobilenet_v2
+from .densenet import (
+    DenseNet,
+    densenet121,
+    densenet161,
+    densenet169,
+    densenet201,
+    densenet264,
+)
+from .googlenet import GoogLeNet, googlenet
+from .shufflenetv2 import (
+    ShuffleNetV2,
+    shufflenet_v2_x0_25,
+    shufflenet_v2_x0_33,
+    shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0,
+    shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0,
+)
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
 
 __all__ = [n for n in dir() if not n.startswith("_")]

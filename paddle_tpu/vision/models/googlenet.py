@@ -1,0 +1,111 @@
+"""GoogLeNet / Inception v1 (≙ python/paddle/vision/models/googlenet.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+
+class _Inception(nn.Layer):
+    def __init__(self, inp, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        relu = nn.ReLU
+        self.branch1 = nn.Sequential(nn.Conv2D(inp, c1, 1), relu())
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(inp, c3r, 1), relu(),
+            nn.Conv2D(c3r, c3, 3, padding=1), relu())
+        self.branch3 = nn.Sequential(
+            nn.Conv2D(inp, c5r, 1), relu(),
+            nn.Conv2D(c5r, c5, 5, padding=2), relu())
+        self.branch4 = nn.Sequential(
+            nn.MaxPool2D(3, stride=1, padding=1),
+            nn.Conv2D(inp, proj, 1), relu())
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        return paddle.concat([self.branch1(x), self.branch2(x),
+                              self.branch3(x), self.branch4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """Returns (main, aux1, aux2) logits in train mode like the reference."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        relu = nn.ReLU
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), relu(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            nn.Conv2D(64, 64, 1), relu(),
+            nn.Conv2D(64, 192, 3, padding=1), relu(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        self.inc3 = nn.Sequential(
+            _Inception(192, 64, 96, 128, 16, 32, 32),
+            _Inception(256, 128, 128, 192, 32, 96, 64),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        self.inc4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4bcd = nn.Sequential(
+            _Inception(512, 160, 112, 224, 24, 64, 64),
+            _Inception(512, 128, 128, 256, 24, 64, 64),
+            _Inception(512, 112, 144, 288, 32, 64, 64),
+        )
+        self.inc4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.inc5 = nn.Sequential(
+            _Inception(832, 256, 160, 320, 32, 128, 128),
+            _Inception(832, 384, 192, 384, 48, 128, 128),
+        )
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool5 = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.4)
+            self.fc = nn.Linear(1024, num_classes)
+            self.aux1 = _AuxHead(512, num_classes)
+            self.aux2 = _AuxHead(528, num_classes)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        x = self.stem(x)
+        x = self.inc3(x)
+        x = self.inc4a(x)
+        a1 = self.aux1(x) if self.training and self.num_classes > 0 else None
+        x = self.inc4bcd(x)
+        a2 = self.aux2(x) if self.training and self.num_classes > 0 else None
+        x = self.inc5(self.pool4(self.inc4e(x)))
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(paddle.flatten(x, 1)))
+        if a1 is not None:
+            return x, a1, a2
+        return x
+
+
+class _AuxHead(nn.Layer):
+    def __init__(self, inp, num_classes):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(4)
+        self.conv = nn.Conv2D(inp, 128, 1)
+        self.relu = nn.ReLU()
+        self.fc1 = nn.Linear(128 * 16, 1024)
+        self.fc2 = nn.Linear(1024, num_classes)
+        self.dropout = nn.Dropout(0.7)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        x = self.relu(self.conv(self.pool(x)))
+        x = paddle.flatten(x, 1)
+        x = self.relu(self.fc1(x))
+        return self.fc2(self.dropout(x))
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise ValueError(
+            "pretrained weights are not bundled (no-network environment)")
+    return GoogLeNet(**kwargs)

@@ -1,0 +1,62 @@
+"""MobileNetV1 (≙ python/paddle/vision/models/mobilenetv1.py architecture)."""
+from __future__ import annotations
+
+from ... import nn
+
+
+class _ConvBNReLU(nn.Sequential):
+    def __init__(self, inp, oup, kernel=3, stride=1, padding=0, groups=1):
+        super().__init__(
+            nn.Conv2D(inp, oup, kernel, stride, padding, groups=groups,
+                      bias_attr=False),
+            nn.BatchNorm2D(oup),
+            nn.ReLU(),
+        )
+
+
+class _DepthwiseSeparable(nn.Sequential):
+    def __init__(self, inp, oup, stride):
+        super().__init__(
+            _ConvBNReLU(inp, inp, 3, stride, 1, groups=inp),
+            _ConvBNReLU(inp, oup, 1),
+        )
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        s = lambda c: max(int(c * scale), 8)
+        cfg = [  # (out, stride)
+            (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+            (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+            (1024, 1),
+        ]
+        layers = [_ConvBNReLU(3, s(32), 3, 2, 1)]
+        in_c = s(32)
+        for out, stride in cfg:
+            layers.append(_DepthwiseSeparable(in_c, s(out), stride))
+            in_c = s(out)
+        self.features = nn.Sequential(*layers)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(in_c, num_classes)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(paddle.flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise ValueError(
+            "pretrained weights are not bundled (no-network environment)")
+    return MobileNetV1(scale=scale, **kwargs)

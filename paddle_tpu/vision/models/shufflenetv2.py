@@ -1,0 +1,134 @@
+"""ShuffleNetV2 (≙ python/paddle/vision/models/shufflenetv2.py architecture)."""
+from __future__ import annotations
+
+from ... import nn
+
+
+def _channel_shuffle(x, groups):
+    import paddle_tpu as paddle
+
+    b, c, h, w = x.shape
+    x = paddle.reshape(x, [b, groups, c // groups, h, w])
+    x = paddle.transpose(x, [0, 2, 1, 3, 4])
+    return paddle.reshape(x, [b, c, h, w])
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, inp, oup, stride):
+        super().__init__()
+        self.stride = stride
+        branch_features = oup // 2
+
+        if stride > 1:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(inp, inp, 3, stride=stride, padding=1, groups=inp,
+                          bias_attr=False),
+                nn.BatchNorm2D(inp),
+                nn.Conv2D(inp, branch_features, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_features), nn.ReLU(),
+            )
+        else:
+            self.branch1 = None
+        in2 = inp if stride > 1 else branch_features
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(in2, branch_features, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_features), nn.ReLU(),
+            nn.Conv2D(branch_features, branch_features, 3, stride=stride,
+                      padding=1, groups=branch_features, bias_attr=False),
+            nn.BatchNorm2D(branch_features),
+            nn.Conv2D(branch_features, branch_features, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_features), nn.ReLU(),
+        )
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        if self.stride == 1:
+            x1, x2 = paddle.chunk(x, 2, axis=1)
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    _CFG = {
+        0.25: (24, 24, 48, 96, 512),
+        0.33: (24, 32, 64, 128, 512),
+        0.5: (24, 48, 96, 192, 1024),
+        1.0: (24, 116, 232, 464, 1024),
+        1.5: (24, 176, 352, 704, 1024),
+        2.0: (24, 244, 488, 976, 2048),
+    }
+    _REPEATS = (4, 8, 4)
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        if scale not in self._CFG:
+            raise ValueError(f"scale {scale} not in {sorted(self._CFG)}")
+        c0, c1, c2, c3, c_out = self._CFG[scale]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, c0, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(c0), nn.ReLU())
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = c0
+        for out_c, repeat in zip((c1, c2, c3), self._REPEATS):
+            blocks = [_InvertedResidual(in_c, out_c, 2)]
+            blocks += [_InvertedResidual(out_c, out_c, 1)
+                       for _ in range(repeat - 1)]
+            stages.append(nn.Sequential(*blocks))
+            in_c = out_c
+        self.stage2, self.stage3, self.stage4 = stages
+        self.conv5 = nn.Sequential(
+            nn.Conv2D(in_c, c_out, 1, bias_attr=False),
+            nn.BatchNorm2D(c_out), nn.ReLU())
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c_out, num_classes)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        x = self.maxpool(self.conv1(x))
+        x = self.stage4(self.stage3(self.stage2(x)))
+        x = self.conv5(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(paddle.flatten(x, 1))
+        return x
+
+
+def _shufflenet(scale, pretrained=False, **kwargs):
+    if pretrained:
+        raise ValueError(
+            "pretrained weights are not bundled (no-network environment)")
+    return ShuffleNetV2(scale=scale, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return _shufflenet(0.25, pretrained, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return _shufflenet(0.33, pretrained, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return _shufflenet(0.5, pretrained, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return _shufflenet(1.0, pretrained, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return _shufflenet(1.5, pretrained, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return _shufflenet(2.0, pretrained, **kw)

@@ -1,0 +1,56 @@
+"""paddle.text.datasets local-file readers."""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text.datasets import Conll05st, Imdb, UCIHousing
+
+
+class TestUCIHousing:
+    def _file(self, tmp_path):
+        rs = np.random.RandomState(0)
+        data = np.hstack([rs.rand(50, 13), rs.rand(50, 1) * 50])
+        p = str(tmp_path / "housing.data")
+        np.savetxt(p, data)
+        return p
+
+    def test_split_and_normalization(self, tmp_path):
+        p = self._file(tmp_path)
+        train = UCIHousing(p, mode="train")
+        test = UCIHousing(p, mode="test")
+        assert len(train) == 40 and len(test) == 10
+        x, y = train[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        allx = np.stack([train[i][0] for i in range(len(train))])
+        assert allx.min() >= 0.0 and allx.max() <= 1.0 + 1e-6
+
+    def test_requires_file(self):
+        with pytest.raises(RuntimeError, match="housing.data"):
+            UCIHousing()
+
+
+class TestImdb:
+    def _corpus(self, tmp_path):
+        for mode in ("train", "test"):
+            for lbl, texts in [("pos", ["great movie great fun", "loved it a lot"]),
+                               ("neg", ["terrible boring film", "bad bad script"])]:
+                d = tmp_path / "aclImdb" / mode / lbl
+                d.mkdir(parents=True, exist_ok=True)
+                for i, t in enumerate(texts):
+                    (d / f"{i}.txt").write_text(t)
+        return str(tmp_path)
+
+    def test_reader_and_vocab(self, tmp_path):
+        root = self._corpus(tmp_path)
+        ds = Imdb(root, mode="train", cutoff=0)
+        assert len(ds) == 4
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+        assert "<unk>" in ds.word_idx
+        labels = sorted(ds[i][1] for i in range(4))
+        assert labels == [0, 0, 1, 1]  # two pos, two neg
+
+    def test_stub_datasets_raise(self):
+        with pytest.raises(RuntimeError, match="conll05st"):
+            Conll05st()
