@@ -34,7 +34,7 @@ def _sync(x):
 def _timeit(step, iters=10, warmup=3):
     for _ in range(warmup):
         out = step()
-    _sync(out)
+        _sync(out)  # bound in-flight buffers during eager warmup/discovery
     t0 = time.perf_counter()
     for _ in range(iters):
         out = step()
@@ -69,8 +69,9 @@ def bench_lenet(iters=20):
             "step_ms": dt * 1e3, "batch": batch}
 
 
-def bench_resnet50(iters=10, batch=32, image=224):
-    """Config-2: ResNet-50 train step under to_static (one XLA program)."""
+def bench_resnet50(iters=10, batch=16, image=224, amp=False):
+    """Config-2: ResNet-50 train step under to_static (one XLA program);
+    amp=True wraps the forward in bf16 autocast."""
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
     from paddle_tpu.vision.models import resnet50
@@ -86,7 +87,9 @@ def bench_resnet50(iters=10, batch=32, image=224):
 
     @paddle.jit.to_static
     def train_step(x, y):
-        loss = F.cross_entropy(model(x), y)
+        with paddle.amp.auto_cast(enable=amp, dtype="bfloat16", level="O1"):
+            logits = model(x)
+        loss = F.cross_entropy(logits.astype("float32"), y)
         loss.backward()
         opt.step()
         opt.clear_grad()
@@ -98,7 +101,8 @@ def bench_resnet50(iters=10, batch=32, image=224):
     dt = _timeit(step, iters=iters, warmup=4)  # warm-up/discover/compile/run
     # ResNet-50 fwd ≈ 4.1 GFLOP/image @224; train ≈ 3x fwd
     flops = 3 * 4.1e9 * batch / dt
-    return {"name": "resnet50_to_static", "images_per_sec": batch / dt,
+    name = "resnet50_to_static_bf16" if amp else "resnet50_to_static"
+    return {"name": name, "images_per_sec": batch / dt,
             "step_ms": dt * 1e3, "batch": batch, "achieved_tflops": flops / 1e12}
 
 
@@ -128,9 +132,9 @@ def bench_bert(iters=8, batch=8, seq=128):
             "step_ms": dt * 1e3, "batch": batch}
 
 
-def bench_llama_train(iters=6, batch=4, seq=512):
-    """Config-5 proxy on one chip: LLaMA-sized-down causal LM train step
-    (bf16 params via amp O2 would halve HBM; fp32 here for parity)."""
+def bench_llama_train(iters=6, batch=4, seq=512, amp=False):
+    """Config-5 proxy on one chip: LLaMA-sized-down causal LM train step;
+    amp=True runs the forward under bf16 autocast."""
     import paddle_tpu as paddle
     from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
 
@@ -146,7 +150,8 @@ def bench_llama_train(iters=6, batch=4, seq=512):
 
     @paddle.jit.to_static
     def train_step(x):
-        loss = model(x, x)
+        with paddle.amp.auto_cast(enable=amp, dtype="bfloat16", level="O1"):
+            loss = model(x, x)
         loss.backward()
         opt.step()
         opt.clear_grad()
@@ -157,7 +162,8 @@ def bench_llama_train(iters=6, batch=4, seq=512):
     # 6ND: N params
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     flops = 6 * n_params * toks
-    return {"name": "llama_1b_proxy_train", "tokens_per_sec": toks,
+    name = "llama_proxy_train_bf16" if amp else "llama_1b_proxy_train"
+    return {"name": name, "tokens_per_sec": toks,
             "step_ms": dt * 1e3, "batch": batch, "seq": seq,
             "achieved_tflops": flops / 1e12, "n_params": n_params}
 
@@ -196,8 +202,10 @@ def bench_eager_dispatch(iters=50):
 ALL = {
     "lenet": bench_lenet,
     "resnet50": bench_resnet50,
+    "resnet50_bf16": lambda: bench_resnet50(amp=True),
     "bert": bench_bert,
     "llama": bench_llama_train,
+    "llama_bf16": lambda: bench_llama_train(amp=True),
     "eager": bench_eager_dispatch,
 }
 
@@ -205,7 +213,9 @@ ALL = {
 def main(argv):
     import jax
 
-    which = [a.lstrip("-") for a in argv if a.lstrip("-") in ALL] or list(ALL)
+    # default run = the BASELINE.md ladder; bf16 variants are opt-in by name
+    default = ["lenet", "resnet50", "bert", "llama", "eager"]
+    which = [a.lstrip("-") for a in argv if a.lstrip("-") in ALL] or default
     details = {"platform": jax.devices()[0].platform,
                "device_count": jax.device_count(), "results": {}}
     for name in which:

@@ -69,6 +69,7 @@ from . import distribution
 from . import quantization
 from . import sparse
 from . import static
+from . import device
 from . import inference
 from . import audio
 from . import onnx

@@ -1,0 +1,56 @@
+"""paddle.device namespace (≙ python/paddle/device/__init__.py subset).
+
+Device management rides jax.devices(); cuda/xpu sub-namespaces are honest
+shims (is_available() -> False) so capability probes in ported code work.
+"""
+from __future__ import annotations
+
+from ..core.device import get_device, set_device  # noqa: F401
+
+
+def get_all_device_type():
+    import jax
+
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    import jax
+
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return get_available_device()
+
+
+def device_count():
+    import jax
+
+    return jax.device_count()
+
+
+class _UnavailableNamespace:
+    def __init__(self, name):
+        self._name = name
+
+    def is_available(self) -> bool:
+        return False
+
+    def device_count(self) -> int:
+        return 0
+
+    def __getattr__(self, item):
+        # AttributeError so hasattr/getattr capability probes return False
+        # instead of crashing
+        raise AttributeError(
+            f"paddle.device.{self._name}.{item}: {self._name} is not part of "
+            "the TPU backend (devices are TPU chips via jax.devices())")
+
+
+cuda = _UnavailableNamespace("cuda")
+xpu = _UnavailableNamespace("xpu")
+
+__all__ = ["set_device", "get_device", "get_all_device_type",
+           "get_available_device", "get_available_custom_device",
+           "device_count", "cuda", "xpu"]

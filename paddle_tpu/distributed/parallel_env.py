@@ -74,19 +74,31 @@ def init_parallel_env():
             return ParallelEnv()
         nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
         coord = os.environ.get("PADDLE_MASTER") or os.environ.get("JAX_COORDINATOR_ADDRESS")
-        if (nprocs > 1 or coord) and jax.process_count() == 1:
+        if nprocs > 1 or coord:
+            # IMPORTANT: nothing may touch jax backends (jax.devices /
+            # process_count) before this call — backend creation pins the
+            # single-process world and initialize() then has no effect
             pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
             if coord is None and os.environ.get("PADDLE_TRAINER_ENDPOINTS"):
                 coord = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")[0]
             try:
                 jax.distributed.initialize(
                     coordinator_address=coord,
-                    num_processes=nprocs,
-                    process_id=pid,
+                    num_processes=nprocs if nprocs > 1 else None,
+                    process_id=pid if nprocs > 1 else None,
                 )
-            except Exception:
-                # already initialized by launcher, or single-host fallback
-                pass
+            except RuntimeError as e:
+                msg = str(e).lower()
+                # jax 0.9: "distributed.initialize should only be called once"
+                if "once" not in msg and "already" not in msg:
+                    if nprocs > 1:
+                        raise  # a real wiring failure must not be silent
+                    import warnings
+
+                    warnings.warn(
+                        f"init_parallel_env: coordinator '{coord}' set but "
+                        f"jax.distributed.initialize failed ({e}); continuing "
+                        "single-process")
         devs = np.array(jax.devices())
         _state["mesh"] = Mesh(devs, (WORLD_AXIS,))
         _state["initialized"] = True

@@ -1,0 +1,85 @@
+"""REAL multi-process validation (VERDICT weak-#6): two OS processes through
+the launcher, jax.distributed wired by init_parallel_env, a cross-process
+psum through shard_map, and the documented eager-collective guard.
+
+Reference parity model: test_dist_base.py:957 _run_cluster (fork trainer
+subprocesses with fabricated PADDLE_TRAINER_* envs, compare results).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+WORKER = r'''
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.environ["REPO_ROOT"])
+import numpy as np
+import jax
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+dist.init_parallel_env()
+rank, world = dist.get_rank(), dist.get_world_size()
+assert jax.process_count() == 2, jax.process_count()
+assert world == 2
+
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental import multihost_utils
+import jax.numpy as jnp
+
+devs = np.array(jax.devices())
+assert len(devs) == 2  # one CPU device per process
+mesh = Mesh(devs, ("dp",))
+
+# each process contributes a shard holding its RANK; psum must see both
+local = np.full((1, 4), float(rank), np.float32)
+garr = multihost_utils.host_local_array_to_global_array(local, mesh, P("dp"))
+f = jax.shard_map(lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
+                  in_specs=(P("dp"),), out_specs=P("dp"))
+res = jax.jit(f)(garr)
+got = np.asarray(res.addressable_shards[0].data)
+assert np.allclose(got, 1.0), got  # 0 + 1
+
+# the eager single-controller shortcuts must REFUSE multi-process use
+try:
+    dist.all_reduce(paddle.to_tensor(np.ones(2, "float32")))
+    print(f"rank {rank}: FAIL eager all_reduce did not raise")
+    sys.exit(1)
+except NotImplementedError:
+    pass
+
+print(f"MPOK rank={rank} world={world}")
+'''
+
+
+def test_two_process_launch_and_collectives(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    env = dict(os.environ)
+    env.pop("PADDLE_TRAINER_ID", None)
+    env.pop("PADDLE_TRAINERS_NUM", None)
+    env["REPO_ROOT"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    import socket
+
+    with socket.socket() as sock:  # pick a free coordinator port
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+    env["PADDLE_MASTER"] = f"127.0.0.1:{port}"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--max_restart", "0",
+         "--log_dir", str(tmp_path / "log"), str(script)],
+        cwd=env["REPO_ROOT"], env=env, capture_output=True, text=True,
+        timeout=280)
+    logs = {}
+    for r in range(2):
+        p = tmp_path / "log" / f"workerlog.{r}"
+        logs[r] = p.read_text() if p.exists() else "<missing>"
+    assert proc.returncode == 0, f"launcher rc={proc.returncode}\n" \
+        f"stderr={proc.stderr[-800:]}\nlog0={logs[0][-800:]}\nlog1={logs[1][-800:]}"
+    assert "MPOK rank=0" in logs[0] + logs[1]
+    assert "MPOK rank=1" in logs[0] + logs[1]
