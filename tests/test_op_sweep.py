@@ -148,6 +148,45 @@ CASES = [
          np.linalg.norm, rtol=1e-4),
     Case("norm_l1", lambda x: paddle.linalg.norm(x, p=1, axis=1),
          (_r(S, 21),), lambda x: np.abs(x).sum(1)),
+    # ---------------- linalg decompositions / solvers
+    Case("cholesky", paddle.linalg.cholesky,
+         (np.array([[4.0, 2.0], [2.0, 3.0]], "float32"),),
+         np.linalg.cholesky, rtol=1e-5),
+    Case("det", paddle.linalg.det, (_r((3, 3), 60),), np.linalg.det,
+         rtol=1e-4),
+    Case("slogdet_logdet",
+         lambda x: paddle.linalg.slogdet(x)[1],
+         (np.eye(3, dtype="float32") * 2 + _r((3, 3), 61, -0.1, 0.1),),
+         lambda x: np.linalg.slogdet(x)[1], rtol=1e-4),
+    Case("inv", paddle.linalg.inv,
+         (np.eye(3, dtype="float32") * 2 + _r((3, 3), 62, -0.1, 0.1),),
+         np.linalg.inv, rtol=1e-4),
+    Case("solve", paddle.linalg.solve,
+         (np.eye(3, dtype="float32") * 2 + _r((3, 3), 63, -0.1, 0.1),
+          _r((3, 2), 64)),
+         np.linalg.solve, rtol=1e-4),
+    Case("matrix_power", lambda x: paddle.linalg.matrix_power(x, 3),
+         (_r((3, 3), 65, -0.5, 0.5),),
+         lambda x: np.linalg.matrix_power(x, 3), rtol=1e-4),
+    Case("qr_reconstruct",
+         lambda x: paddle.matmul(*paddle.linalg.qr(x)), (_r((4, 3), 66),),
+         lambda x: x, rtol=1e-4, atol=1e-5),
+    Case("svd_singular_values",
+         lambda x: paddle.linalg.svd(x)[1], (_r((4, 3), 67),),
+         lambda x: np.linalg.svd(x, compute_uv=False), rtol=1e-4,
+         grad=False),
+    Case("eigh_eigenvalues",
+         lambda x: paddle.linalg.eigh(x + x.T)[0],
+         (_r((3, 3), 68),),
+         lambda x: np.linalg.eigvalsh(x + x.T), rtol=1e-4, grad=False),
+    Case("pinv_reconstruct",
+         lambda x: paddle.matmul(paddle.matmul(x, paddle.linalg.pinv(x)), x),
+         (_r((4, 3), 69),), lambda x: x, rtol=1e-3, atol=1e-4, grad=False),
+    Case("triangular_solve",
+         lambda a, b: paddle.linalg.triangular_solve(a, b, upper=False),
+         (np.tril(_r((3, 3), 70)) + np.eye(3, dtype="float32") * 3,
+          _r((3, 2), 71)),
+         lambda a, b: np.linalg.solve(a, b), rtol=1e-4),
     # ---------------- matmul family
     Case("matmul", paddle.matmul, (_r((2, 4), 22), _r((4, 3), 23)), np.matmul),
     Case("matmul_tx", lambda x, y: paddle.matmul(x, y, transpose_x=True),
