@@ -70,6 +70,7 @@ from . import quantization
 from . import sparse
 from . import static
 from . import device
+from . import text
 from . import inference
 from . import audio
 from . import onnx
