@@ -75,6 +75,9 @@ from . import inference
 from . import audio
 from . import onnx
 from . import utils
+from . import fft
+from . import signal
+from . import geometric
 from .framework_io import save, load
 
 # paddle.framework parity namespace bits

@@ -134,6 +134,40 @@ class GradNode:
 _amp_dtype_for = None
 
 
+def _complexify_vjp(vjp_fn, single_out):
+    """Convention bridge: JAX's complex cotangents/grads are the conjugate of
+    Paddle's (reference AbsGradFunctor<complex>, funcs/complex_functors.h:158,
+    computes dout·x/|x|, i.e. the non-holomorphic ∂L/∂conj(z) convention).
+    The tape carries Paddle-convention grads, so conj on the way into
+    jax.vjp and conj complex grads on the way out. Only installed when a
+    complex dtype is involved — the real-dtype hot path is untouched."""
+    import jax.numpy as jnp
+
+    def wrapped(cot):
+        if single_out:
+            c = jnp.conj(cot) if jnp.iscomplexobj(cot) else cot
+        else:
+            c = tuple(jnp.conj(x) if jnp.iscomplexobj(x) else x for x in cot)
+        grads = vjp_fn(c)
+        return tuple(
+            jnp.conj(g) if hasattr(g, "dtype") and jnp.iscomplexobj(g) else g
+            for g in grads)
+
+    return wrapped
+
+
+def _needs_complex_bridge(avals, datas, diff_idx):
+    import numpy as _np2
+
+    if any(_np2.issubdtype(_np2.dtype(dt), _np2.complexfloating)
+           for _, dt in avals):
+        return True
+    return any(
+        hasattr(datas[i], "dtype")
+        and _np2.issubdtype(_np2.dtype(datas[i].dtype), _np2.complexfloating)
+        for i in diff_idx)
+
+
 def _is_tensor(x) -> bool:
     from .tensor import Tensor
 
@@ -336,8 +370,13 @@ def _check_nan_inf(name, arrs):
     import jax.numpy as jnp
 
     for a in arrs:
-        if dtypes.is_floating_point(a.dtype) and not isinstance(a, jax.core.Tracer):
+        if isinstance(a, jax.core.Tracer):
+            continue
+        if dtypes.is_floating_point(a.dtype):
             if bool(jnp.any(~jnp.isfinite(a))):
+                raise FloatingPointError(f"Operator '{name}' output contains NaN/Inf")
+        elif dtypes.is_complex(a.dtype):
+            if bool(jnp.any(~jnp.isfinite(a.real) | ~jnp.isfinite(a.imag))):
                 raise FloatingPointError(f"Operator '{name}' output contains NaN/Inf")
 
 
@@ -417,7 +456,9 @@ def _op_call_impl(fn: Callable, *args, name: str | None = None, n_diff: int | No
     diff_idx = []
     if grad_enabled():
         for i, a in enumerate(args[:limit]):
-            if _is_tensor(a) and not a.stop_gradient and dtypes.is_floating_point(a.dtype):
+            if _is_tensor(a) and not a.stop_gradient and (
+                    dtypes.is_floating_point(a.dtype)
+                    or dtypes.is_complex(a.dtype)):  # fft/complex ops have VJPs
                 diff_idx.append(i)
 
     use_cache = flag("FLAGS_use_compiled_eager")
@@ -437,6 +478,8 @@ def _op_call_impl(fn: Callable, *args, name: str | None = None, n_diff: int | No
             single = not isinstance(out, (tuple, list))
             outs = [out] if single else list(out)
             avals = [(o.shape, o.dtype) for o in outs]
+            if _needs_complex_bridge(avals, datas, diff_idx):
+                vjp_fn = _complexify_vjp(vjp_fn, single)
             node = GradNode(vjp_fn, [args[i] for i in diff_idx], avals, single, name,
                             diff_idx=list(diff_idx), ctx=_make_ctx(fn, datas, diff_idx))
             return _wrap_outputs(out, node, name)
@@ -458,6 +501,8 @@ def _op_call_impl(fn: Callable, *args, name: str | None = None, n_diff: int | No
     single = not isinstance(out, (tuple, list))
     outs = [out] if single else list(out)
     avals = [(o.shape, o.dtype) for o in outs]
+    if _needs_complex_bridge(avals, datas, diff_idx):
+        vjp_fn = _complexify_vjp(vjp_fn, single)
     node = GradNode(vjp_fn, [args[i] for i in diff_idx], avals, single, name,
                     diff_idx=list(diff_idx), ctx=_make_ctx(fn, datas, diff_idx))
     return _wrap_outputs(out, node, name)

@@ -128,8 +128,13 @@ def _regrad(node, cots):
             return fn(*vs)
 
         _out, vjp = jax.vjp(primal, *dvals)
-        cot_in = cot_vals[0] if single else tuple(cot_vals)
-        return vjp(cot_in)
+        # Paddle↔JAX complex grad convention bridge (see dispatch._complexify_vjp)
+        conj = lambda v: jnp.conj(v) if jnp.iscomplexobj(v) else v
+        if single:
+            cot_in = conj(cot_vals[0])
+        else:
+            cot_in = tuple(conj(c) for c in cot_vals)
+        return tuple(conj(g) for g in vjp(cot_in))
 
     args = list(node.inputs) + [cots[p] for p in float_pos]
     out = op_call(grad_fn, *args, name=node.name + "_grad")

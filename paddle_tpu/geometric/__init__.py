@@ -1,0 +1,288 @@
+"""paddle.geometric — graph-learning message passing + sampling
+(≙ python/paddle/geometric/__init__.py:20 __all__; kernels:
+phi graph_send_recv / segment_pool / graph_reindex / graph_sample_neighbors).
+
+TPU-first split:
+- Message passing (send_u_recv/send_ue_recv/send_uv) and segment reductions
+  are static-shape scatter/gather compositions (`.at[].add/max/min`,
+  `jax.ops.segment_*`) that trace into single fused XLA programs and
+  differentiate through the tape. `out_size` is a static int so jit never
+  sees a data-dependent output shape.
+- Graph restructuring (reindex_graph, sample_neighbors) has inherently
+  data-dependent output shapes, so it runs on host (numpy) as data-prep —
+  the same place a DataLoader runs — instead of forcing XLA recompiles.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.dispatch import op_call
+from ..core.tensor import Tensor
+
+__all__ = [
+    'send_u_recv', 'send_ue_recv', 'send_uv',
+    'segment_sum', 'segment_mean', 'segment_min', 'segment_max',
+    'reindex_graph', 'reindex_heter_graph',
+    'sample_neighbors', 'weighted_sample_neighbors',
+]
+
+_MSG_OPS = ("add", "sub", "mul", "div")
+_REDUCE_OPS = ("sum", "mean", "max", "min")
+
+
+def _as_data(t):
+    return t._data if hasattr(t, "_data") else jnp.asarray(t)
+
+
+def _segment_reduce(msg, dst, n_out, reduce_op):
+    """Scatter-reduce messages [E, ...] onto [n_out, ...]; empty rows -> 0
+    (paddle's graph_send_recv fills untouched rows with zeros)."""
+    if reduce_op == "sum":
+        z = jnp.zeros((n_out,) + msg.shape[1:], dtype=msg.dtype)
+        return z.at[dst].add(msg)
+    if reduce_op == "mean":
+        z = jnp.zeros((n_out,) + msg.shape[1:], dtype=msg.dtype)
+        tot = z.at[dst].add(msg)
+        cnt = jnp.zeros((n_out,), dtype=msg.dtype).at[dst].add(
+            jnp.ones(dst.shape, dtype=msg.dtype))
+        cnt = jnp.maximum(cnt, 1).reshape((n_out,) + (1,) * (msg.ndim - 1))
+        return tot / cnt
+    if reduce_op == "max":
+        init = jnp.full((n_out,) + msg.shape[1:],
+                        -jnp.inf if jnp.issubdtype(msg.dtype, jnp.floating)
+                        else jnp.iinfo(msg.dtype).min, dtype=msg.dtype)
+        out = init.at[dst].max(msg)
+        return jnp.where(jnp.equal(out, init), 0, out).astype(msg.dtype)
+    if reduce_op == "min":
+        init = jnp.full((n_out,) + msg.shape[1:],
+                        jnp.inf if jnp.issubdtype(msg.dtype, jnp.floating)
+                        else jnp.iinfo(msg.dtype).max, dtype=msg.dtype)
+        out = init.at[dst].min(msg)
+        return jnp.where(jnp.equal(out, init), 0, out).astype(msg.dtype)
+    raise ValueError(f"reduce_op should be one of {_REDUCE_OPS}, got {reduce_op}")
+
+
+def _resolve_out_size(out_size, x, dst_index):
+    """Static output row count: out_size if given (>0) else x.shape[0]."""
+    if out_size is not None:
+        n = int(out_size.item()) if hasattr(out_size, "item") else int(out_size)
+        if n > 0:
+            return n
+    return x.shape[0]
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x rows at src_index, scatter-reduce at dst_index
+    (≙ geometric/message_passing/send_recv.py:55)."""
+    if reduce_op not in _REDUCE_OPS:
+        raise ValueError(
+            f"reduce_op should be one of {_REDUCE_OPS}, got {reduce_op}")
+    n_out = _resolve_out_size(out_size, x, dst_index)
+
+    def f(a, src, dst):
+        return _segment_reduce(a[src], dst, n_out, reduce_op)
+
+    return op_call(f, x, src_index, dst_index, name="send_u_recv", n_diff=1)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Message = x[src] (message_op) y_edge, then scatter-reduce at dst
+    (≙ send_recv.py send_ue_recv). y has one row per edge."""
+    if message_op not in _MSG_OPS:
+        raise ValueError(
+            f"message_op should be one of {_MSG_OPS}, got {message_op}")
+    if reduce_op not in _REDUCE_OPS:
+        raise ValueError(
+            f"reduce_op should be one of {_REDUCE_OPS}, got {reduce_op}")
+    n_out = _resolve_out_size(out_size, x, dst_index)
+
+    def f(a, e, src, dst):
+        m = a[src]
+        if message_op == "add":
+            m = m + e
+        elif message_op == "sub":
+            m = m - e
+        elif message_op == "mul":
+            m = m * e
+        else:
+            m = m / e
+        return _segment_reduce(m, dst, n_out, reduce_op)
+
+    return op_call(f, x, y, src_index, dst_index, name="send_ue_recv", n_diff=2)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message x[src] (message_op) y[dst] — no reduction
+    (≙ send_recv.py send_uv)."""
+    if message_op not in _MSG_OPS:
+        raise ValueError(
+            f"message_op should be one of {_MSG_OPS}, got {message_op}")
+
+    def f(a, b, src, dst):
+        u, v = a[src], b[dst]
+        if message_op == "add":
+            return u + v
+        if message_op == "sub":
+            return u - v
+        if message_op == "mul":
+            return u * v
+        return u / v
+
+    return op_call(f, x, y, src_index, dst_index, name="send_uv", n_diff=2)
+
+
+def _segment(x, segment_ids, pool):
+    """Segment pooling over rows (≙ incubate/tensor/math segment_* → phi
+    segment_pool kernels). num_segments = max(segment_ids)+1, resolved on
+    host (segment ids are data-prep outputs, known before jit)."""
+    ids = _as_data(segment_ids)
+    n_seg = int(np.asarray(ids).max()) + 1 if ids.shape[0] else 0
+
+    def f(a, sid):
+        return _segment_reduce(a, sid, n_seg, pool)
+
+    return op_call(f, x, segment_ids, name=f"segment_{pool}", n_diff=1)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "max")
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "min")
+
+
+# ---------------------------------------------------------------------------
+# Host-side graph restructuring (dynamic output shapes — data-prep, not jit)
+# ---------------------------------------------------------------------------
+
+def _np(t):
+    return np.asarray(_as_data(t))
+
+
+def _host_rng():
+    """Host numpy RNG seeded from the framework's global PRNG key, so
+    sampling is reproducible under paddle.seed (reference
+    graph_sample_neighbors is deterministic under the global seed) and
+    each call advances the global state."""
+    from ..core.rng import next_key
+
+    seed_words = np.asarray(next_key()).astype(np.uint32).ravel().tolist()
+    return np.random.default_rng(seed_words)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Renumber a sampled subgraph to local ids (≙ geometric/reindex.py
+    reindex_graph → phi graph_reindex). Returns (reindex_src, reindex_dst,
+    out_nodes) with x's ids first, then first-seen neighbor order."""
+    xs, nbr, cnt = _np(x), _np(neighbors), _np(count)
+    id2local = {int(v): i for i, v in enumerate(xs)}
+    out_nodes = list(map(int, xs))
+    for v in nbr:
+        v = int(v)
+        if v not in id2local:
+            id2local[v] = len(out_nodes)
+            out_nodes.append(v)
+    reindex_src = np.array([id2local[int(v)] for v in nbr], dtype=np.int64)
+    reindex_dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    mk = lambda a: Tensor(jnp.asarray(a), _internal=True, stop_gradient=True)
+    return mk(reindex_src), mk(reindex_dst), mk(np.array(out_nodes, np.int64))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: per-edge-type neighbor/count tensors sharing
+    one id space (≙ geometric/reindex.py reindex_heter_graph). Each count[i]
+    has one entry per node in x; the shared id map covers x then all
+    neighbor lists in first-seen order."""
+    xs = _np(x)
+    nbrs = [_np(n) for n in neighbors]
+    cnts = [_np(c) for c in count]
+    id2local = {int(v): i for i, v in enumerate(xs)}
+    out_nodes = list(map(int, xs))
+    for nbr in nbrs:
+        for v in nbr:
+            v = int(v)
+            if v not in id2local:
+                id2local[v] = len(out_nodes)
+                out_nodes.append(v)
+    src = np.array([id2local[int(v)] for nbr in nbrs for v in nbr],
+                   dtype=np.int64)
+    dst = np.concatenate([
+        np.repeat(np.arange(len(xs), dtype=np.int64), c) for c in cnts]) \
+        if cnts else np.empty(0, np.int64)
+    mk = lambda a: Tensor(jnp.asarray(a), _internal=True, stop_gradient=True)
+    return mk(src), mk(dst), mk(np.array(out_nodes, np.int64))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling on CSC graph (≙ geometric/sampling/
+    neighbors.py sample_neighbors → phi graph_sample_neighbors). Host-side:
+    output size is data-dependent."""
+    r, cp, nodes = _np(row), _np(colptr), _np(input_nodes)
+    rng = _host_rng()
+    out_nbr, out_cnt, out_eid = [], [], []
+    eid_arr = _np(eids) if eids is not None else None
+    for v in nodes:
+        beg, end = int(cp[int(v)]), int(cp[int(v) + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(beg, end)
+        else:
+            pick = beg + rng.choice(deg, size=sample_size, replace=False)
+        out_nbr.append(r[pick])
+        out_cnt.append(len(pick))
+        if return_eids and eid_arr is not None:
+            out_eid.append(eid_arr[pick])
+    mk = lambda a: Tensor(jnp.asarray(a), _internal=True, stop_gradient=True)
+    nbrs = mk(np.concatenate(out_nbr) if out_nbr else np.empty(0, np.int64))
+    cnts = mk(np.array(out_cnt, dtype=np.int64))
+    if return_eids:
+        return nbrs, cnts, mk(
+            np.concatenate(out_eid) if out_eid else np.empty(0, np.int64))
+    return nbrs, cnts
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weighted (without-replacement) neighbor sampling (≙ geometric/
+    sampling/neighbors.py weighted_sample_neighbors)."""
+    r, cp, w, nodes = _np(row), _np(colptr), _np(edge_weight), _np(input_nodes)
+    rng = _host_rng()
+    out_nbr, out_cnt, out_eid = [], [], []
+    eid_arr = _np(eids) if eids is not None else None
+    for v in nodes:
+        beg, end = int(cp[int(v)]), int(cp[int(v) + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(beg, end)
+        else:
+            p = w[beg:end].astype(np.float64)
+            p = p / p.sum()
+            pick = beg + rng.choice(deg, size=sample_size, replace=False, p=p)
+        out_nbr.append(r[pick])
+        out_cnt.append(len(pick))
+        if return_eids and eid_arr is not None:
+            out_eid.append(eid_arr[pick])
+    mk = lambda a: Tensor(jnp.asarray(a), _internal=True, stop_gradient=True)
+    nbrs = mk(np.concatenate(out_nbr) if out_nbr else np.empty(0, np.int64))
+    cnts = mk(np.array(out_cnt, dtype=np.int64))
+    if return_eids:
+        return nbrs, cnts, mk(
+            np.concatenate(out_eid) if out_eid else np.empty(0, np.int64))
+    return nbrs, cnts

@@ -1,0 +1,191 @@
+"""paddle.signal — frame / overlap_add / stft / istft
+(≙ python/paddle/signal.py:42,167,272,449; kernels: phi frame/overlap_add +
+fft_r2c/c2c).
+
+TPU-first: frame extraction is a strided gather expressed with static shapes
+(one `jnp.take` over precomputed indices — XLA lowers it to a cheap gather);
+overlap-add is a segment-sum scatter; stft = frame × window → batched FFT on
+the last axis, which XLA fuses into a single program. All paths trace, jit,
+and differentiate through the tape.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .core.dispatch import op_call
+
+__all__ = ['stft', 'istft']
+
+
+def _check_pos_int(v, what):
+    if not isinstance(v, int) or v <= 0:
+        raise ValueError(f'Unexpected {what}: {v}. It should be an positive integer.')
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice into overlapping frames. axis=-1: [..., L] -> [..., frame_length,
+    num_frames]; axis=0: [L, ...] -> [num_frames, frame_length, ...]."""
+    if axis not in (0, -1):
+        raise ValueError(f'Unexpected axis: {axis}. It should be 0 or -1.')
+    _check_pos_int(frame_length, 'frame_length')
+    _check_pos_int(hop_length, 'hop_length')
+    L = x.shape[axis]
+    if frame_length > L:
+        raise ValueError(
+            f'Attribute frame_length should be less equal than sequence length, '
+            f'but got ({frame_length}) > ({L}).')
+    n_frames = 1 + (L - frame_length) // hop_length
+    # [n_frames, frame_length] static index grid
+    idx = (np.arange(n_frames)[:, None] * hop_length +
+           np.arange(frame_length)[None, :])
+
+    def f(a):
+        g = jnp.take(a, jnp.asarray(idx), axis=axis)
+        if axis == -1:
+            # take put [n_frames, frame_length] last; paddle wants
+            # [..., frame_length, n_frames]
+            return jnp.swapaxes(g, -1, -2)
+        return g  # axis=0: [n_frames, frame_length, ...] already
+
+    return op_call(f, x, name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Reconstruct from frames by summing overlaps (inverse of `frame`).
+    axis=-1: [..., frame_length, n_frames] -> [..., output_len]."""
+    if axis not in (0, -1):
+        raise ValueError(f'Unexpected axis: {axis}. It should be 0 or -1.')
+    _check_pos_int(hop_length, 'hop_length')
+    if axis == -1:
+        frame_length, n_frames = x.shape[-2], x.shape[-1]
+    else:
+        n_frames, frame_length = x.shape[0], x.shape[1]
+    out_len = (n_frames - 1) * hop_length + frame_length
+    seg = (np.arange(n_frames)[:, None] * hop_length +
+           np.arange(frame_length)[None, :]).ravel()
+
+    def f(a):
+        if axis == -1:
+            fr = jnp.swapaxes(a, -1, -2)          # [..., n_frames, frame_length]
+            flat = fr.reshape(a.shape[:-2] + (n_frames * frame_length,))
+            z = jnp.zeros(a.shape[:-2] + (out_len,), dtype=a.dtype)
+            return z.at[..., jnp.asarray(seg)].add(flat)
+        flat = a.reshape((n_frames * frame_length,) + a.shape[2:])
+        z = jnp.zeros((out_len,) + a.shape[2:], dtype=a.dtype)
+        return z.at[jnp.asarray(seg)].add(flat)
+
+    return op_call(f, x, name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Short-time Fourier transform; output [..., freq, num_frames]."""
+    from .core.dtype import is_complex
+
+    _check_pos_int(n_fft, 'n_fft')
+    hop_length = hop_length if hop_length is not None else n_fft // 4
+    win_length = win_length if win_length is not None else n_fft
+    _check_pos_int(hop_length, 'hop_length')
+    if not (0 < win_length <= n_fft):
+        raise ValueError(f'Unexpected win_length: {win_length}.')
+    complex_input = is_complex(x.dtype)
+    if complex_input and onesided:
+        raise ValueError('onesided should be False when input is a complex Tensor.')
+
+    if window is not None:
+        wshape = tuple(window.shape)
+        if wshape != (win_length,):
+            raise ValueError(
+                f'Unexpected window shape: {wshape}, expected ({win_length},)')
+        win = window  # stays a live Tensor: grads + trace capture flow
+    else:
+        win = jnp.ones((win_length,), dtype=jnp.float32)
+    seq_len = x.shape[-1] + (2 * (n_fft // 2) if center else 0)
+    if seq_len < n_fft:
+        raise ValueError(
+            f'Input too short: {x.shape[-1]} samples with n_fft={n_fft} '
+            f'(center={center}) yields no complete frame.')
+
+    def f(a, w):
+        if win_length < n_fft:  # center-pad the window to n_fft
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        if center:
+            pad = n_fft // 2
+            cfg = [(0, 0)] * (a.ndim - 1) + [(pad, pad)]
+            a = jnp.pad(a, cfg, mode=pad_mode)
+        L = a.shape[-1]
+        n_frames = 1 + (L - n_fft) // hop_length
+        idx = (jnp.arange(n_frames)[:, None] * hop_length +
+               jnp.arange(n_fft)[None, :])
+        fr = jnp.take(a, idx, axis=-1) * w          # [..., n_frames, n_fft]
+        if complex_input:
+            spec = jnp.fft.fft(fr, axis=-1)
+        elif onesided:
+            spec = jnp.fft.rfft(fr, axis=-1)
+        else:
+            spec = jnp.fft.fft(fr.astype(jnp.complex64), axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, dtype=spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)           # [..., freq, n_frames]
+
+    return op_call(f, x, win, name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Inverse STFT (least-squares overlap-add); input [..., freq, frames]."""
+    _check_pos_int(n_fft, 'n_fft')
+    hop_length = hop_length if hop_length is not None else n_fft // 4
+    win_length = win_length if win_length is not None else n_fft
+    if return_complex and onesided:
+        raise ValueError('onesided should be False when return_complex is True.')
+    n_freq, n_frames = x.shape[-2], x.shape[-1]
+    expected = n_fft // 2 + 1 if onesided else n_fft
+    if n_freq != expected:
+        raise ValueError(f'Unexpected freq dim: {n_freq}, expected {expected}.')
+
+    if window is not None:
+        wshape = tuple(window.shape)
+        if wshape != (win_length,):
+            raise ValueError(
+                f'Unexpected window shape: {wshape}, expected ({win_length},)')
+        win = window
+    else:
+        win = jnp.ones((win_length,), dtype=jnp.float32)
+
+    out_len = (n_frames - 1) * hop_length + n_fft
+    seg = (np.arange(n_frames)[:, None] * hop_length +
+           np.arange(n_fft)[None, :]).ravel()
+
+    def f(a, w):
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        spec = jnp.swapaxes(a, -1, -2)              # [..., n_frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, dtype=spec.real.dtype))
+        if onesided:
+            fr = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            fr = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                fr = fr.real
+        fr = fr * w                                  # windowed frames
+        flat = fr.reshape(fr.shape[:-2] + (n_frames * n_fft,))
+        num = jnp.zeros(fr.shape[:-2] + (out_len,), dtype=fr.dtype)
+        num = num.at[..., jnp.asarray(seg)].add(flat)
+        wsq = jnp.tile(w * w, n_frames)
+        den = jnp.zeros((out_len,), dtype=w.dtype)
+        den = den.at[jnp.asarray(seg)].add(wsq)
+        out = num / jnp.where(den > 1e-11, den, 1.0)
+        if center:
+            out = out[..., n_fft // 2: out_len - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return op_call(f, x, win, name="istft")

@@ -1,0 +1,317 @@
+"""paddle.fft / paddle.signal / paddle.geometric parity vs numpy references
+(reference surfaces: python/paddle/fft.py:38, signal.py:36,
+geometric/__init__.py:20)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+class TestFFT:
+    def setup_method(self, _):
+        rs = np.random.RandomState(7)
+        self.x = rs.randn(4, 16).astype("float32")
+        self.c = (rs.randn(4, 16) + 1j * rs.randn(4, 16)).astype("complex64")
+
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_fft_ifft_roundtrip(self, norm):
+        t = paddle.to_tensor(self.c)
+        out = paddle.fft.fft(t, norm=norm)
+        np.testing.assert_allclose(_np(out), np.fft.fft(self.c, norm=norm),
+                                   rtol=1e-4, atol=1e-4)
+        back = paddle.fft.ifft(out, norm=norm)
+        np.testing.assert_allclose(_np(back), self.c, rtol=1e-4, atol=1e-4)
+
+    def test_rfft_irfft_hfft_ihfft(self):
+        t = paddle.to_tensor(self.x)
+        r = paddle.fft.rfft(t)
+        np.testing.assert_allclose(_np(r), np.fft.rfft(self.x), rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(_np(paddle.fft.irfft(r)),
+                                   np.fft.irfft(np.fft.rfft(self.x)),
+                                   rtol=1e-4, atol=1e-4)
+        h = paddle.fft.hfft(paddle.to_tensor(self.c))
+        np.testing.assert_allclose(_np(h), np.fft.hfft(self.c), rtol=1e-3,
+                                   atol=1e-3)
+        ih = paddle.fft.ihfft(t)
+        np.testing.assert_allclose(_np(ih), np.fft.ihfft(self.x), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_2d_n_variants(self):
+        t = paddle.to_tensor(self.x)
+        np.testing.assert_allclose(_np(paddle.fft.fft2(t)),
+                                   np.fft.fft2(self.x), rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(_np(paddle.fft.rfftn(t)),
+                                   np.fft.rfftn(self.x), rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(_np(paddle.fft.ifftn(paddle.to_tensor(self.c))),
+                                   np.fft.ifftn(self.c), rtol=1e-4, atol=1e-4)
+
+    def test_hfftn_ihfftn_match_torch_convention(self):
+        # no numpy hfftn; FFTW/torch convention = c2c over other axes first,
+        # then hermitian c2r on the last axis (verified vs torch.fft.hfftn)
+        t = paddle.to_tensor(self.c)
+        got = _np(paddle.fft.hfftn(t))
+        want = np.fft.hfft(np.fft.fftn(self.c, axes=[0]), axis=-1)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+        assert got.dtype == np.float32  # real output by construction
+        # ihfftn on real input == ifftn-over-rows of ihfft (torch parity)
+        x = self.x
+        ih = _np(paddle.fft.ihfftn(paddle.to_tensor(x)))
+        want_ih = np.fft.ifftn(np.fft.ihfft(x, axis=-1), axes=[0])
+        np.testing.assert_allclose(ih, want_ih, rtol=1e-4, atol=1e-5)
+
+    def test_helpers(self):
+        np.testing.assert_allclose(_np(paddle.fft.fftfreq(8, d=0.5)),
+                                   np.fft.fftfreq(8, d=0.5).astype("float32"))
+        np.testing.assert_allclose(_np(paddle.fft.rfftfreq(8)),
+                                   np.fft.rfftfreq(8).astype("float32"))
+        t = paddle.to_tensor(self.x)
+        np.testing.assert_allclose(_np(paddle.fft.fftshift(t)),
+                                   np.fft.fftshift(self.x))
+        np.testing.assert_allclose(_np(paddle.fft.ifftshift(t)),
+                                   np.fft.ifftshift(self.x))
+
+    def test_norm_validation(self):
+        with pytest.raises(ValueError):
+            paddle.fft.fft(paddle.to_tensor(self.x), norm="bogus")
+
+    def test_fft_grad_flows(self):
+        t = paddle.to_tensor(self.x)
+        t.stop_gradient = False
+        y = paddle.fft.rfft(t)
+        loss = (y.real() ** 2 + y.imag() ** 2).sum()
+        loss.backward()
+        assert t.grad is not None and _np(t.grad).shape == self.x.shape
+        assert np.isfinite(_np(t.grad)).all()
+
+
+class TestSignal:
+    def test_frame_axis_last(self):
+        x = np.arange(8).astype("float32")
+        y = paddle.signal.frame(paddle.to_tensor(x), 4, 2, axis=-1)
+        want = np.array([[0, 2, 4], [1, 3, 5], [2, 4, 6], [3, 5, 7]],
+                        dtype="float32")
+        np.testing.assert_allclose(_np(y), want)
+
+    def test_frame_axis0_and_batch(self):
+        x = np.arange(16).reshape(2, 8).astype("float32")
+        y = paddle.signal.frame(paddle.to_tensor(x), 4, 2, axis=-1)
+        assert list(y.shape) == [2, 4, 3]
+        x1 = np.arange(16).reshape(8, 2).astype("float32")
+        y1 = paddle.signal.frame(paddle.to_tensor(x1), 4, 2, axis=0)
+        assert list(y1.shape) == [3, 4, 2]
+
+    def test_overlap_add_inverts_frame_nonoverlap(self):
+        x = np.random.RandomState(0).randn(32).astype("float32")
+        fr = paddle.signal.frame(paddle.to_tensor(x), 4, 4, axis=-1)
+        back = paddle.signal.overlap_add(fr, 4, axis=-1)
+        np.testing.assert_allclose(_np(back), x, rtol=1e-6, atol=1e-6)
+
+    def test_stft_istft_roundtrip(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(2, 256).astype("float32")
+        w = np.hanning(64).astype("float32")
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=64, hop_length=16,
+                                  window=paddle.to_tensor(w))
+        assert spec.shape[-2] == 64 // 2 + 1
+        back = paddle.signal.istft(spec, n_fft=64, hop_length=16,
+                                   window=paddle.to_tensor(w), length=256)
+        np.testing.assert_allclose(_np(back), x, rtol=1e-3, atol=1e-3)
+
+    def test_stft_normalized_twosided(self):
+        x = np.random.RandomState(2).randn(128).astype("float32")
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=32, onesided=False,
+                                  normalized=True)
+        assert spec.shape[-2] == 32
+
+    def test_stft_complex_input_rejects_onesided(self):
+        c = (np.random.randn(64) + 1j * np.random.randn(64)).astype("complex64")
+        with pytest.raises(ValueError):
+            paddle.signal.stft(paddle.to_tensor(c), n_fft=16, onesided=True)
+
+    def test_stft_rejects_too_short_input(self):
+        with pytest.raises(ValueError, match="too short"):
+            paddle.signal.stft(paddle.to_tensor(np.ones(5, dtype="float32")),
+                               n_fft=8, hop_length=4, center=False)
+
+    def test_stft_window_gets_grad(self):
+        x = paddle.to_tensor(np.random.RandomState(9).randn(64).astype("float32"))
+        w = paddle.to_tensor(np.hanning(16).astype("float32"))
+        w.stop_gradient = False
+        spec = paddle.signal.stft(x, n_fft=16, hop_length=8, window=w)
+        (spec.real() ** 2 + spec.imag() ** 2).sum().backward()
+        assert w.grad is not None
+        assert np.isfinite(_np(w.grad)).all() and np.abs(_np(w.grad)).sum() > 0
+
+
+class TestComplexGradConvention:
+    def test_abs_grad_matches_reference_convention(self):
+        # reference AbsGradFunctor<complex> (complex_functors.h:158): dout·x/|x|
+        z = paddle.to_tensor(np.array([3 + 4j], dtype="complex64"))
+        z.stop_gradient = False
+        paddle.abs(z).sum().backward()
+        np.testing.assert_allclose(_np(z.grad), np.array([0.6 + 0.8j]),
+                                   rtol=1e-5)
+
+    def test_complex_mul_grad(self):
+        # L = Re(conj(w)·w) = |w|^2; paddle/torch convention: dL/dw = 2w... but
+        # through real(z*z̄) the per-op chain gives grad = 2·w for real loss
+        w = paddle.to_tensor(np.array([1 + 2j, 3 - 1j], dtype="complex64"))
+        w.stop_gradient = False
+        loss = (w.real() ** 2 + w.imag() ** 2).sum()
+        loss.backward()
+        np.testing.assert_allclose(_np(w.grad), 2 * _np(w), rtol=1e-5)
+
+
+class TestGeometric:
+    def test_send_u_recv_sum_docstring_case(self):
+        x = paddle.to_tensor(np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]],
+                                      dtype="float32"))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0], dtype="int64"))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0], dtype="int64"))
+        out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="sum")
+        want = np.array([[0, 2, 3], [2, 8, 10], [1, 4, 5]], dtype="float32")
+        np.testing.assert_allclose(_np(out), want)
+
+    @pytest.mark.parametrize("op", ["mean", "max", "min"])
+    def test_send_u_recv_reduce_ops(self, op):
+        rs = np.random.RandomState(3)
+        x = rs.randn(5, 4).astype("float32")
+        src = np.array([0, 1, 2, 3, 4, 0], dtype="int64")
+        dst = np.array([1, 1, 2, 0, 0, 3], dtype="int64")
+        out = _np(paddle.geometric.send_u_recv(
+            paddle.to_tensor(x), paddle.to_tensor(src), paddle.to_tensor(dst),
+            reduce_op=op))
+        want = np.zeros((5, 4), dtype="float32")
+        for i in range(5):
+            rows = x[src[dst == i]]
+            if len(rows):
+                want[i] = {"mean": rows.mean(0), "max": rows.max(0),
+                           "min": rows.min(0)}[op]
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+    def test_send_ue_recv_and_send_uv(self):
+        rs = np.random.RandomState(4)
+        x = rs.randn(4, 3).astype("float32")
+        e = rs.randn(5, 3).astype("float32")
+        src = np.array([0, 1, 2, 3, 0], dtype="int64")
+        dst = np.array([1, 0, 3, 2, 2], dtype="int64")
+        out = _np(paddle.geometric.send_ue_recv(
+            paddle.to_tensor(x), paddle.to_tensor(e), paddle.to_tensor(src),
+            paddle.to_tensor(dst), message_op="mul", reduce_op="sum"))
+        want = np.zeros((4, 3), dtype="float32")
+        for s, d, ev in zip(src, dst, e):
+            want[d] += x[s] * ev
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+        uv = _np(paddle.geometric.send_uv(
+            paddle.to_tensor(x), paddle.to_tensor(x), paddle.to_tensor(src),
+            paddle.to_tensor(dst), message_op="add"))
+        np.testing.assert_allclose(uv, x[src] + x[dst], rtol=1e-6)
+
+    def test_segment_ops(self):
+        rs = np.random.RandomState(5)
+        data = rs.randn(6, 3).astype("float32")
+        ids = np.array([0, 0, 1, 1, 1, 2], dtype="int64")
+        t, it = paddle.to_tensor(data), paddle.to_tensor(ids)
+        np.testing.assert_allclose(
+            _np(paddle.geometric.segment_sum(t, it)),
+            np.stack([data[:2].sum(0), data[2:5].sum(0), data[5:].sum(0)]),
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            _np(paddle.geometric.segment_mean(t, it)),
+            np.stack([data[:2].mean(0), data[2:5].mean(0), data[5:].mean(0)]),
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            _np(paddle.geometric.segment_max(t, it)),
+            np.stack([data[:2].max(0), data[2:5].max(0), data[5:].max(0)]),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(paddle.geometric.segment_min(t, it)),
+            np.stack([data[:2].min(0), data[2:5].min(0), data[5:].min(0)]),
+            rtol=1e-5)
+
+    def test_send_u_recv_grad(self):
+        x = paddle.to_tensor(np.ones((3, 2), dtype="float32"))
+        x.stop_gradient = False
+        src = paddle.to_tensor(np.array([0, 1, 2], dtype="int64"))
+        dst = paddle.to_tensor(np.array([1, 1, 0], dtype="int64"))
+        out = paddle.geometric.send_u_recv(x, src, dst)
+        out.sum().backward()
+        np.testing.assert_allclose(_np(x.grad), np.ones((3, 2)))
+
+    def test_reindex_graph(self):
+        x = np.array([0, 5, 9], dtype="int64")
+        neighbors = np.array([8, 9, 0, 4, 7, 6, 7], dtype="int64")
+        count = np.array([2, 3, 2], dtype="int32")
+        src, dst, nodes = paddle.geometric.reindex_graph(
+            paddle.to_tensor(x), paddle.to_tensor(neighbors),
+            paddle.to_tensor(count))
+        nodes_np = _np(nodes)
+        # x ids come first, then first-seen neighbor order
+        np.testing.assert_array_equal(nodes_np[:3], x)
+        # every edge maps back to the original neighbor id
+        np.testing.assert_array_equal(nodes_np[_np(src)], neighbors)
+        np.testing.assert_array_equal(_np(dst),
+                                      np.repeat(np.arange(3), count))
+
+    def test_sample_neighbors(self):
+        # CSC: node0 -> {1,2}, node1 -> {0}, node2 -> {0,1}
+        row = np.array([1, 2, 0, 0, 1], dtype="int64")
+        colptr = np.array([0, 2, 3, 5], dtype="int64")
+        nbr, cnt = paddle.geometric.sample_neighbors(
+            paddle.to_tensor(row), paddle.to_tensor(colptr),
+            paddle.to_tensor(np.array([0, 2], dtype="int64")), sample_size=1)
+        assert _np(cnt).tolist() == [1, 1]
+        assert _np(nbr)[0] in (1, 2) and _np(nbr)[1] in (0, 1)
+        # full sampling
+        nbr2, cnt2 = paddle.geometric.sample_neighbors(
+            paddle.to_tensor(row), paddle.to_tensor(colptr),
+            paddle.to_tensor(np.array([0], dtype="int64")), sample_size=-1)
+        np.testing.assert_array_equal(np.sort(_np(nbr2)), [1, 2])
+
+    def test_reindex_heter_graph_two_types(self):
+        x = np.array([0, 5, 9], dtype="int64")
+        nbr1 = np.array([8, 9, 0, 4, 7], dtype="int64")
+        cnt1 = np.array([2, 2, 1], dtype="int32")
+        nbr2 = np.array([0, 5, 3], dtype="int64")
+        cnt2 = np.array([1, 1, 1], dtype="int32")
+        src, dst, nodes = paddle.geometric.reindex_heter_graph(
+            paddle.to_tensor(x),
+            [paddle.to_tensor(nbr1), paddle.to_tensor(nbr2)],
+            [paddle.to_tensor(cnt1), paddle.to_tensor(cnt2)])
+        nodes_np = _np(nodes)
+        np.testing.assert_array_equal(nodes_np[:3], x)
+        np.testing.assert_array_equal(nodes_np[_np(src)],
+                                      np.concatenate([nbr1, nbr2]))
+        np.testing.assert_array_equal(
+            _np(dst), np.concatenate([np.repeat(np.arange(3), cnt1),
+                                      np.repeat(np.arange(3), cnt2)]))
+
+    def test_sample_neighbors_reproducible_under_seed(self):
+        row = np.arange(10, dtype="int64")
+        colptr = np.array([0, 10], dtype="int64")
+        nodes = paddle.to_tensor(np.array([0], dtype="int64"))
+        paddle.seed(123)
+        a = _np(paddle.geometric.sample_neighbors(
+            paddle.to_tensor(row), paddle.to_tensor(colptr), nodes,
+            sample_size=4)[0])
+        paddle.seed(123)
+        b = _np(paddle.geometric.sample_neighbors(
+            paddle.to_tensor(row), paddle.to_tensor(colptr), nodes,
+            sample_size=4)[0])
+        np.testing.assert_array_equal(a, b)
+
+    def test_weighted_sample_neighbors(self):
+        row = np.array([1, 2, 0], dtype="int64")
+        colptr = np.array([0, 3, 3, 3], dtype="int64")
+        w = np.array([0.0, 0.0, 1.0], dtype="float32")
+        nbr, cnt = paddle.geometric.weighted_sample_neighbors(
+            paddle.to_tensor(row), paddle.to_tensor(colptr),
+            paddle.to_tensor(w),
+            paddle.to_tensor(np.array([0], dtype="int64")), sample_size=1)
+        assert _np(nbr).tolist() == [0]  # only nonzero-weight edge
