@@ -78,6 +78,20 @@ from . import utils
 from . import fft
 from . import signal
 from . import geometric
+from . import version
+from . import sysconfig
+from . import hub
+from . import regularizer
+from . import callbacks
+from . import reader
+from . import framework
+from . import base
+from . import tensor
+from . import dataset
+from . import tensorrt
+from . import cost_model
+from . import decomposition
+from .batch import batch
 from .framework_io import save, load
 
 # paddle.framework parity namespace bits

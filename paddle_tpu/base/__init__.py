@@ -1,0 +1,7 @@
+"""paddle.base compat glue (≙ python/paddle/base/): the reference's core
+bridge module. Here `core` is a thin shim over the XLA runtime — kept so
+`from paddle.base import core` style probes keep working."""
+from __future__ import annotations
+
+from .. import framework  # noqa: F401
+from . import core  # noqa: F401

@@ -1,0 +1,45 @@
+"""paddle.base.core shim (≙ the pybind'd libpaddle module,
+paddle/fluid/pybind/pybind.cc:1080). The native runtime here is XLA; this
+module answers the capability probes user code commonly makes."""
+from __future__ import annotations
+
+from ..core.device import (  # noqa: F401
+    CPUPlace, CUDAPlace, TPUPlace, XPUPlace, CustomPlace, Place,
+    is_compiled_with_cuda,
+)
+from ..core.flags import get_flags, set_flags  # noqa: F401
+
+
+def is_compiled_with_dist():
+    return True
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    """XLA plays CINN's role; report False for the literal CINN probe."""
+    return False
+
+
+def is_compiled_with_mkldnn():
+    return False
+
+
+def get_cuda_device_count():
+    return 0
+
+
+def globals():  # noqa: A001 — paddle.base.core.globals() flag map
+    from ..core.flags import _REGISTRY
+
+    return {k: v["value"] for k, v in _REGISTRY.items()}

@@ -1,0 +1,25 @@
+"""paddle.batch parity (≙ python/paddle/batch.py): wrap a sample reader
+into a minibatch reader. Legacy reader API kept for capability parity —
+new code should use paddle.io.DataLoader (device prefetch, workers)."""
+from __future__ import annotations
+
+__all__ = ['batch']
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Yield lists of `batch_size` samples from `reader()`."""
+    if batch_size <= 0:
+        raise ValueError(
+            f"batch_size should be a positive integer, but got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

@@ -1,0 +1,9 @@
+"""paddle.callbacks parity (≙ python/paddle/callbacks.py): re-export the
+hapi callback set used by Model.fit."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback, CallbackList, ProgBarLogger, ModelCheckpoint, EarlyStopping,
+    LRScheduler,
+)
+
+__all__ = ['Callback', 'ProgBarLogger', 'ModelCheckpoint', 'EarlyStopping',
+           'LRScheduler']

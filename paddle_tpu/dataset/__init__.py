@@ -1,0 +1,9 @@
+"""paddle.dataset parity (≙ python/paddle/dataset/): legacy reader-factory
+datasets. Each submodule exposes train()/test() reader creators compatible
+with paddle.batch / paddle.reader decorators, backed by the vision dataset
+readers (local files only — zero-egress build)."""
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import uci_housing  # noqa: F401
+
+__all__ = ['mnist', 'cifar', 'uci_housing']

@@ -1,0 +1,35 @@
+"""paddle.dataset.cifar parity (≙ python/paddle/dataset/cifar.py): reader
+creators over a local cifar python tarball/dir."""
+from __future__ import annotations
+
+__all__ = ['train10', 'test10', 'train100', 'test100']
+
+
+def _reader(data_path, mode, n_classes):
+    from ..vision.datasets import Cifar10, Cifar100
+
+    cls = Cifar10 if n_classes == 10 else Cifar100
+    ds = cls(data_file=data_path, mode=mode)
+
+    def reader():
+        for i in range(len(ds)):
+            img, label = ds[i]
+            yield img.reshape(-1).astype("float32") / 255.0, label
+
+    return reader
+
+
+def train10(data_path=None):
+    return _reader(data_path, "train", 10)
+
+
+def test10(data_path=None):
+    return _reader(data_path, "test", 10)
+
+
+def train100(data_path=None):
+    return _reader(data_path, "train", 100)
+
+
+def test100(data_path=None):
+    return _reader(data_path, "test", 100)

@@ -1,0 +1,31 @@
+"""paddle.dataset.mnist parity (≙ python/paddle/dataset/mnist.py): reader
+creators over local IDX files."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ['train', 'test']
+
+
+def _reader(image_path, label_path):
+    from ..vision.datasets import MNIST
+
+    ds = MNIST(image_path=image_path, label_path=label_path)
+
+    def reader():
+        for i in range(len(ds)):
+            img, label = ds[i]
+            yield img.reshape(-1).astype("float32") / 255.0 * 2.0 - 1.0, label
+
+    return reader
+
+
+def train(image_path=None, label_path=None):
+    """Reader creator for the training split: yields (784-float vector in
+    [-1,1], int label). Local IDX file paths are required."""
+    return _reader(image_path, label_path)
+
+
+def test(image_path=None, label_path=None):
+    """Reader creator for the test split."""
+    return _reader(image_path, label_path)

@@ -1,0 +1,49 @@
+"""paddle.dataset.uci_housing parity (≙ python/paddle/dataset/uci_housing.py):
+reader creators over a local housing.data file (13 features + target,
+whitespace-separated UCI format), feature-normalized like the reference."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ['train', 'test']
+
+_TRAIN_RATIO = 0.8
+
+
+def _load(path):
+    data = np.loadtxt(path)
+    if data.ndim != 2 or data.shape[1] != 14:
+        raise ValueError(
+            f"uci_housing: expected Nx14 whitespace table, got {data.shape}")
+    feats = data[:, :-1]
+    mx, mn, avg = feats.max(0), feats.min(0), feats.mean(0)
+    feats = (feats - avg) / (mx - mn)
+    data = np.concatenate([feats, data[:, -1:]], axis=1).astype("float32")
+    split = int(len(data) * _TRAIN_RATIO)
+    return data[:split], data[split:]
+
+
+def train(data_path=None):
+    if data_path is None:
+        raise ValueError("uci_housing.train: data_path to housing.data is "
+                         "required (no-network environment)")
+    tr, _ = _load(data_path)
+
+    def reader():
+        for row in tr:
+            yield row[:-1], row[-1:]
+
+    return reader
+
+
+def test(data_path=None):
+    if data_path is None:
+        raise ValueError("uci_housing.test: data_path to housing.data is "
+                         "required (no-network environment)")
+    _, te = _load(data_path)
+
+    def reader():
+        for row in te:
+            yield row[:-1], row[-1:]
+
+    return reader

@@ -1,0 +1,29 @@
+"""paddle.decomposition parity (≙ python/paddle/decomposition/decomp.py):
+the reference lowers big ops to primitive ops at the PIR level so the
+compiler and higher-order AD see a closed primitive set.
+
+TPU-native: this pass is structurally free — every op here is ALREADY a
+composition of jax/lax primitives, and jax.jit traces straight to that
+closed primitive set (jaxpr). `decompose` is therefore an identity that
+validates its input; `sink_decomp` mirrors the reference's entrypoint.
+"""
+from __future__ import annotations
+
+__all__ = ['decompose', 'sink_decomp']
+
+
+def decompose(program, src_vars=None, blacklist=None, whitelist=None):
+    """Identity on compiled programs: ops trace to lax primitives already.
+    Accepts a paddle.jit CompiledFunction or a plain callable."""
+    if blacklist and whitelist:
+        common = set(blacklist) & set(whitelist)
+        if common:
+            raise ValueError(
+                f"ops cannot be in both blacklist and whitelist: {common}")
+    if src_vars is not None:
+        return program, src_vars
+    return program
+
+
+def sink_decomp(*args, **kwargs):
+    return decompose(*args, **kwargs)

@@ -63,6 +63,41 @@ def is_server():
     return False
 
 
+def is_first_worker():
+    return get_rank() == 0
+
+
+_PS_MSG = ("parameter-server fleet mode (brpc dense/sparse tables, "
+           "fleet/runtime) is out of the TPU-native scope — SURVEY §7 keeps "
+           "these as API stubs; use collective mode on a device mesh")
+
+
+def init_server(*args, **kwargs):
+    """PS-mode stub (≙ fleet.init_server)."""
+    raise NotImplementedError(_PS_MSG)
+
+
+def run_server(*args, **kwargs):
+    """PS-mode stub (≙ fleet.run_server)."""
+    raise NotImplementedError(_PS_MSG)
+
+
+def init_worker(*args, **kwargs):
+    """PS-mode no-op: collective workers need no table bootstrap."""
+    return None
+
+
+def stop_worker(*args, **kwargs):
+    """PS-mode no-op on collective meshes."""
+    return None
+
+
+def save_persistables(executor=None, dirname=None, main_program=None, **kw):
+    """PS-mode stub (≙ fleet.save_persistables) — use paddle.save /
+    paddle.distributed.save_state_dict for checkpoints here."""
+    raise NotImplementedError(_PS_MSG)
+
+
 def init(role_maker=None, is_collective=True, strategy: DistributedStrategy | None = None,
          log_level="INFO"):
     if role_maker is not None and \

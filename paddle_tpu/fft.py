@@ -98,6 +98,9 @@ def _split_last(x_ndim, s, axes):
     axes = [a % x_ndim for a in axes]
     if s is None:
         s = [None] * len(axes)
+    elif len(s) != len(axes):
+        raise ValueError(
+            f"Shape and axes have different lengths: {len(s)} vs {len(axes)}")
     return list(s[:-1]), axes[:-1], s[-1], axes[-1]
 
 

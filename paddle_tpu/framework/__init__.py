@@ -1,0 +1,37 @@
+"""paddle.framework parity (≙ python/paddle/framework/__init__.py): the
+grab-bag namespace user code reaches into for dtype defaults, grad guards,
+places, and random state."""
+from __future__ import annotations
+
+from ..core.dtype import (  # noqa: F401
+    get_default_dtype, set_default_dtype,
+)
+from ..core.device import (  # noqa: F401
+    CPUPlace, CUDAPlace, TPUPlace, XPUPlace, CustomPlace, Place,
+)
+from ..core.dispatch import no_grad, set_grad_enabled  # noqa: F401
+from ..core.rng import seed, get_rng_state, set_rng_state  # noqa: F401
+from ..core.tensor import Parameter  # noqa: F401
+from ..framework_io import save, load  # noqa: F401
+
+
+def in_dynamic_mode():
+    return True
+
+
+def in_pir_mode():
+    """The IR here is jaxpr/StableHLO under jit; no separate PIR mode."""
+    return False
+
+
+def use_pir_api():
+    return False
+
+
+def is_grad_enabled():
+    from ..core.dispatch import grad_enabled
+
+    return grad_enabled()
+
+
+from ..nn import ParamAttr  # noqa: F401,E402 — one definition, shared

@@ -64,7 +64,7 @@ def _segment_reduce(msg, dst, n_out, reduce_op):
     raise ValueError(f"reduce_op should be one of {_REDUCE_OPS}, got {reduce_op}")
 
 
-def _resolve_out_size(out_size, x, dst_index):
+def _resolve_out_size(out_size, x):
     """Static output row count: out_size if given (>0) else x.shape[0]."""
     if out_size is not None:
         n = int(out_size.item()) if hasattr(out_size, "item") else int(out_size)
@@ -80,7 +80,7 @@ def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
     if reduce_op not in _REDUCE_OPS:
         raise ValueError(
             f"reduce_op should be one of {_REDUCE_OPS}, got {reduce_op}")
-    n_out = _resolve_out_size(out_size, x, dst_index)
+    n_out = _resolve_out_size(out_size, x)
 
     def f(a, src, dst):
         return _segment_reduce(a[src], dst, n_out, reduce_op)
@@ -98,7 +98,7 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
     if reduce_op not in _REDUCE_OPS:
         raise ValueError(
             f"reduce_op should be one of {_REDUCE_OPS}, got {reduce_op}")
-    n_out = _resolve_out_size(out_size, x, dst_index)
+    n_out = _resolve_out_size(out_size, x)
 
     def f(a, e, src, dst):
         m = a[src]
@@ -273,7 +273,13 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
             pick = np.arange(beg, end)
         else:
             p = w[beg:end].astype(np.float64)
-            p = p / p.sum()
+            total = p.sum()
+            if total <= 0:
+                raise ValueError(
+                    f"weighted_sample_neighbors: node {int(v)} has "
+                    f"{deg} candidate edges but non-positive total weight "
+                    f"({total}); edge weights must be positive to sample")
+            p = p / total
             pick = beg + rng.choice(deg, size=sample_size, replace=False, p=p)
         out_nbr.append(r[pick])
         out_cnt.append(len(pick))

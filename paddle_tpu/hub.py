@@ -1,0 +1,63 @@
+"""paddle.hub parity (≙ python/paddle/hub.py): load models from a hubconf.py
+entrypoint file. The `local` source is fully supported; `github`/`gitee`
+need network access and raise (this build runs with zero egress — vendor the
+repo and use source='local')."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ['list', 'help', 'load']
+
+_HUBCONF = 'hubconf.py'
+
+
+def _load_entry_module(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} found in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _check_source(source):
+    if source not in ('local', 'github', 'gitee'):
+        raise ValueError(
+            f"Unknown source: {source}. Should be 'github', 'gitee' or 'local'.")
+    if source in ('github', 'gitee'):
+        raise RuntimeError(
+            f"source='{source}' needs network access, unavailable in this "
+            "build — clone the repo and pass source='local'.")
+
+
+def list(repo_dir, source='github', force_reload=False):  # noqa: A001
+    """List callable entrypoints exported by the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_entry_module(repo_dir)
+    return [n for n, f in vars(mod).items()
+            if callable(f) and not n.startswith('_')]
+
+
+def help(repo_dir, model, source='github', force_reload=False):  # noqa: A001
+    """Return the docstring of one entrypoint."""
+    _check_source(source)
+    mod = _load_entry_module(repo_dir)
+    if not hasattr(mod, model):
+        raise RuntimeError(f"Cannot find model '{model}' in {repo_dir}")
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source='github', force_reload=False, **kwargs):
+    """Instantiate an entrypoint: load(repo, 'resnet50', pretrained=False)."""
+    _check_source(source)
+    mod = _load_entry_module(repo_dir)
+    if not hasattr(mod, model):
+        raise RuntimeError(f"Cannot find model '{model}' in {repo_dir}")
+    return getattr(mod, model)(**kwargs)

@@ -213,6 +213,18 @@ def _wd_coeff(wd):
     return getattr(wd, "_coeff", 0.0)
 
 
+def _wd_grad(wd, base):
+    """Penalty gradient for coupled weight decay: L2 (float or
+    regularizer.L2Decay) adds coeff*param; regularizer.L1Decay adds
+    coeff*sign(param) (reference python/paddle/regularizer.py semantics)."""
+    c = _wd_coeff(wd)
+    if c == 0.0:
+        return 0.0
+    if getattr(wd, "_kind", "l2") == "l1":
+        return c * jnp.sign(base)
+    return c * base
+
+
 class SGD(Optimizer):
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, multi_precision=False, name=None):
@@ -223,7 +235,7 @@ class SGD(Optimizer):
         gd = g._data.astype(jnp.float32) if self._multi_precision else g._data
         master = self._master(p)
         base = master._data if master is not None else p._data
-        gd = gd + _wd_coeff(wd) * base
+        gd = gd + _wd_grad(wd, base)
         new = base - lr_val * gd
         if master is not None:
             master._assign_raw(new)
@@ -245,7 +257,7 @@ class Momentum(Optimizer):
         v = self._acc("velocity", p)
         master = self._master(p)
         base = master._data if master is not None else p._data
-        gd = g._data.astype(base.dtype) + _wd_coeff(wd) * base
+        gd = g._data.astype(base.dtype) + _wd_grad(wd, base)
         vel = self._momentum * v._data + gd
         v._assign_raw(vel)
         if self._nesterov:
@@ -269,7 +281,7 @@ class Adagrad(Optimizer):
     def _apply_one(self, p, g, lr_val, wd):
         acc = self._acc("moment", p, init=lambda: jnp.full(
             tuple(p.shape), self._init_acc, p._data.dtype))
-        gd = g._data + _wd_coeff(wd) * p._data
+        gd = g._data + _wd_grad(wd, p._data)
         new_acc = acc._data + jnp.square(gd)
         acc._assign_raw(new_acc)
         p._assign_raw(p._data - lr_val * gd / (jnp.sqrt(new_acc) + self._epsilon))
@@ -288,7 +300,7 @@ class RMSProp(Optimizer):
     def _apply_one(self, p, g, lr_val, wd):
         ms = self._acc("mean_square", p)
         mom = self._acc("momentum", p)
-        gd = g._data + _wd_coeff(wd) * p._data
+        gd = g._data + _wd_grad(wd, p._data)
         new_ms = self._rho * ms._data + (1 - self._rho) * jnp.square(gd)
         ms._assign_raw(new_ms)
         denom = new_ms
@@ -312,7 +324,7 @@ class Adadelta(Optimizer):
     def _apply_one(self, p, g, lr_val, wd):
         avg_sq = self._acc("avg_squared_grad", p)
         avg_upd = self._acc("avg_squared_update", p)
-        gd = g._data + _wd_coeff(wd) * p._data
+        gd = g._data + _wd_grad(wd, p._data)
         new_sq = self._rho * avg_sq._data + (1 - self._rho) * jnp.square(gd)
         upd = jnp.sqrt(avg_upd._data + self._epsilon) / jnp.sqrt(new_sq + self._epsilon) * gd
         new_upd = self._rho * avg_upd._data + (1 - self._rho) * jnp.square(upd)
@@ -343,7 +355,7 @@ class Adam(Optimizer):
             jnp.float32 if p.dtype in (dtypes.float16, dtypes.bfloat16) else base.dtype)
         gd = g._data.astype(comp_dt)
         if not self._decoupled_wd:
-            gd = gd + _wd_coeff(wd) * base.astype(comp_dt)
+            gd = gd + _wd_grad(wd, base.astype(comp_dt))
         t = self._step_t._data
         b1, b2 = self._beta1, self._beta2
         new_m = b1 * m._data + (1 - b1) * gd
@@ -361,7 +373,13 @@ class Adam(Optimizer):
         step = lr_val * mhat / (jnp.sqrt(vhat) + self._epsilon)
         newb = base.astype(comp_dt)
         if self._decoupled_wd:
-            newb = newb * (1.0 - lr_val * _wd_coeff(wd))
+            # decoupled decay honors the regularizer kind: L2 (default) is
+            # the multiplicative AdamW shrink, L1Decay subtracts
+            # lr·coeff·sign(param)
+            if getattr(wd, "_kind", "l2") == "l1":
+                newb = newb - lr_val * _wd_coeff(wd) * jnp.sign(newb)
+            else:
+                newb = newb * (1.0 - lr_val * _wd_coeff(wd))
         new = newb - step
         if master is not None:
             master._assign_raw(new)
@@ -397,7 +415,7 @@ class Adamax(Optimizer):
     def _apply_one(self, p, g, lr_val, wd):
         m = self._acc("moment", p)
         u = self._acc("inf_norm", p)
-        gd = g._data + _wd_coeff(wd) * p._data
+        gd = g._data + _wd_grad(wd, p._data)
         new_m = self._beta1 * m._data + (1 - self._beta1) * gd
         new_u = jnp.maximum(self._beta2 * u._data, jnp.abs(gd))
         m._assign_raw(new_m)
@@ -421,7 +439,7 @@ class NAdam(Optimizer):
         # cumulative mu product accumulator (scalar per param)
         mu_prod = self._acc("mu_product", p,
                             init=lambda: jnp.ones((), jnp.float32), dtype=jnp.float32)
-        gd = g._data + _wd_coeff(wd) * p._data
+        gd = g._data + _wd_grad(wd, p._data)
         t = self._step_t._data
         b1, b2 = self._beta1, self._beta2
         mu_t = b1 * (1 - 0.5 * 0.96 ** (t * self._momentum_decay))

@@ -1,0 +1,215 @@
+"""paddle.reader parity — legacy reader decorators
+(≙ python/paddle/reader/decorator.py): composable generator transforms kept
+for capability parity; paddle.io.DataLoader is the modern path.
+"""
+from __future__ import annotations
+
+import itertools
+import random as _random
+from queue import Queue
+from threading import Thread
+
+__all__ = ['cache', 'map_readers', 'buffered', 'compose', 'chain',
+           'shuffle', 'firstn', 'xmap_readers', 'multiprocess_reader']
+
+
+def cache(reader):
+    """Materialize the wrapped reader once; replay from memory after."""
+    all_data = tuple(reader())
+
+    def cached_reader():
+        yield from all_data
+
+    return cached_reader
+
+
+def map_readers(func, *readers):
+    """Zip readers and map func over the per-reader samples."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Shuffle within a sliding buffer of buf_size samples."""
+
+    def shuffled_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return shuffled_reader
+
+
+def chain(*readers):
+    """Concatenate readers back-to-back."""
+
+    def chained_reader():
+        for r in readers:
+            yield from r()
+
+    return chained_reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into tuples of their outputs; check_alignment raises
+    ComposeNotAligned when one reader runs short."""
+    check_alignment = kwargs.pop('check_alignment', True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in itertools.zip_longest(*rs):
+                yield sum((make_tuple(x) for x in outputs), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum((make_tuple(x) for x in outputs), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Prefetch up to `size` samples in a background thread."""
+
+    class _End:
+        pass
+
+    def read_worker(r, q):
+        for d in r:
+            q.put(d)
+        q.put(_End())
+
+    def data_reader():
+        r = reader()
+        q = Queue(maxsize=size)
+        t = Thread(target=read_worker, args=(r, q))
+        t.daemon = True
+        t.start()
+        e = q.get()
+        while not isinstance(e, _End):
+            yield e
+            e = q.get()
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """Keep only the first n samples."""
+
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Map `mapper` over samples with a pool of worker threads."""
+    end_token = object()
+
+    def read_worker(r, in_q):
+        for i, d in enumerate(r()):
+            in_q.put((i, d) if order else d)
+        in_q.put(end_token)
+
+    def map_worker(in_q, out_q):
+        sample = in_q.get()
+        while sample is not end_token:
+            if order:
+                i, d = sample
+                out_q.put((i, mapper(d)))
+            else:
+                out_q.put(mapper(sample))
+            sample = in_q.get()
+        in_q.put(end_token)  # let siblings see the end
+        out_q.put(end_token)
+
+    def xreader():
+        in_q, out_q = Queue(buffer_size), Queue(buffer_size)
+        t = Thread(target=read_worker, args=(reader, in_q))
+        t.daemon = True
+        t.start()
+        workers = []
+        for _ in range(process_num):
+            w = Thread(target=map_worker, args=(in_q, out_q))
+            w.daemon = True
+            w.start()
+            workers.append(w)
+        finished = 0
+        if order:
+            buf, want = {}, 0
+            while finished < process_num:
+                s = out_q.get()
+                if s is end_token:
+                    finished += 1
+                    continue
+                i, d = s
+                buf[i] = d
+                while want in buf:
+                    yield buf.pop(want)
+                    want += 1
+            while want in buf:
+                yield buf.pop(want)
+                want += 1
+        else:
+            while finished < process_num:
+                s = out_q.get()
+                if s is end_token:
+                    finished += 1
+                else:
+                    yield s
+
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave multiple readers; thread-based here (the heavy multiprocess
+    IO path lives in paddle.io.DataLoader's worker pool)."""
+
+    def reader():
+        q = Queue(queue_size)
+        end_token = object()
+
+        def worker(r):
+            for d in r():
+                q.put(d)
+            q.put(end_token)
+
+        ts = []
+        for r in readers:
+            t = Thread(target=worker, args=(r,))
+            t.daemon = True
+            t.start()
+            ts.append(t)
+        finished = 0
+        while finished < len(readers):
+            s = q.get()
+            if s is end_token:
+                finished += 1
+            else:
+                yield s
+
+    return reader

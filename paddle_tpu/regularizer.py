@@ -1,0 +1,30 @@
+"""paddle.regularizer parity (≙ python/paddle/regularizer.py): L1Decay /
+L2Decay objects consumed by optimizers' weight_decay argument. The penalty
+gradient is folded into the (jitted) optimizer update — no separate pass."""
+from __future__ import annotations
+
+__all__ = ['L1Decay', 'L2Decay']
+
+
+class WeightDecayRegularizer:
+    _kind = "l2"
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """L1 weight decay: grad += coeff * sign(param)."""
+    _kind = "l1"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """L2 weight decay: grad += coeff * param."""
+    _kind = "l2"

@@ -1,0 +1,20 @@
+"""paddle.sysconfig parity (≙ python/paddle/sysconfig.py): install paths for
+building extensions against the framework (here: the C++ runtime pieces under
+paddle_tpu/csrc, see utils.cpp_extension)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ['get_include', 'get_lib']
+
+_PKG = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory containing the framework's C/C++ headers."""
+    return os.path.join(_PKG, 'csrc')
+
+
+def get_lib():
+    """Directory containing built native libraries (csrc/_build)."""
+    return os.path.join(_PKG, 'csrc', '_build')

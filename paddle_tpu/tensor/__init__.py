@@ -1,0 +1,18 @@
+"""paddle.tensor namespace (≙ python/paddle/tensor/__init__.py): the op
+library grouped by area. Implementations live in paddle_tpu/ops/*; this
+package re-exports them and exposes the per-area submodules
+(paddle.tensor.math etc.) under their reference names."""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ops import *  # noqa: F401,F403
+from ..ops import math, creation, reduction, manipulation, linalg, random  # noqa: F401
+
+# reference submodule names → our op modules
+_sys.modules[__name__ + ".math"] = math
+_sys.modules[__name__ + ".creation"] = creation
+_sys.modules[__name__ + ".linalg"] = linalg
+_sys.modules[__name__ + ".manipulation"] = manipulation
+_sys.modules[__name__ + ".random"] = random
+_sys.modules[__name__ + ".stat"] = reduction  # mean/std/var/median live here

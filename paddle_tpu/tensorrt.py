@@ -1,0 +1,30 @@
+"""paddle.tensorrt stub (≙ python/paddle/tensorrt/): TensorRT is a CUDA
+serving engine and has no TPU equivalent — the deployment path here is
+AOT-compiled StableHLO via paddle.inference (see inference/predictor).
+Every entrypoint raises with that pointer (SURVEY.md: TRT paths are
+explicitly not rebuilt)."""
+from __future__ import annotations
+
+__all__ = ['convert', 'convert_loaded_model', 'Input', 'TensorRTConfig']
+
+_MSG = ("TensorRT is CUDA-only; this TPU-native build serves models via "
+        "paddle.inference (AOT StableHLO under XLA). Export with "
+        "paddle.jit.save and load with paddle.inference.create_predictor.")
+
+
+def convert(*args, **kwargs):
+    raise NotImplementedError(_MSG)
+
+
+def convert_loaded_model(*args, **kwargs):
+    raise NotImplementedError(_MSG)
+
+
+class Input:
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(_MSG)
+
+
+class TensorRTConfig:
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(_MSG)

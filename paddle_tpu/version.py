@@ -1,0 +1,71 @@
+"""paddle.version parity (≙ generated python/paddle/version/__init__.py).
+
+The reference generates this file at build time with CUDA/cuDNN metadata;
+the TPU-native build reports the XLA-stack versions instead.
+"""
+from __future__ import annotations
+
+import subprocess
+
+full_version = "0.2.0"
+major, minor, patch = "0", "2", "0"
+rc = "0"
+istaged = False
+with_pip_cuda_libraries = "OFF"
+
+
+def _git_commit():
+    try:
+        return subprocess.check_output(
+            ["git", "rev-parse", "HEAD"], stderr=subprocess.DEVNULL,
+            timeout=2).decode().strip()
+    except Exception:
+        return "unknown"
+
+
+commit = _git_commit()
+
+
+def show():
+    """Print version info (≙ paddle.version.show)."""
+    print("full_version:", full_version)
+    print("commit:", commit)
+    print("jax:", jax_version())
+    print("platform:", "tpu-native (XLA)")
+
+
+def mkl():
+    return "OFF"
+
+
+def cuda():
+    """No CUDA in the TPU-native build (compute path is XLA on TPU)."""
+    return "False"
+
+
+def cudnn():
+    return "False"
+
+
+def nccl():
+    """Collectives are XLA ICI/DCN collectives, not NCCL."""
+    return "0"
+
+
+def xpu():
+    return "False"
+
+
+def xpu_xccl():
+    return "False"
+
+
+def jax_version():
+    import jax
+
+    return jax.__version__
+
+
+def tpu():
+    """TPU support marker — the native platform of this build."""
+    return "True"

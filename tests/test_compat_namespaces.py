@@ -1,0 +1,170 @@
+"""Compat namespaces parity: paddle.{batch,reader,regularizer,hub,dataset,
+framework,base,tensor,version,sysconfig,cost_model,decomposition,tensorrt,
+callbacks} + fleet PS stubs (reference surfaces per python/paddle/ root)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestBatchReader:
+    def test_batch(self):
+        r = paddle.batch(lambda: iter(range(7)), batch_size=3)
+        assert [len(b) for b in r()] == [3, 3, 1]
+        r2 = paddle.batch(lambda: iter(range(7)), batch_size=3, drop_last=True)
+        assert [len(b) for b in r2()] == [3, 3]
+        with pytest.raises(ValueError):
+            paddle.batch(lambda: iter([]), 0)
+
+    def test_reader_decorators(self):
+        base = lambda: iter(range(10))
+        assert list(paddle.reader.firstn(base, 4)()) == [0, 1, 2, 3]
+        assert list(paddle.reader.chain(base, base)()) == list(range(10)) * 2
+        assert sorted(paddle.reader.shuffle(base, 5)()) == list(range(10))
+        assert list(paddle.reader.map_readers(
+            lambda a, b: a + b, base, base)()) == [2 * i for i in range(10)]
+        assert list(paddle.reader.buffered(base, 2)()) == list(range(10))
+        cached = paddle.reader.cache(base)
+        assert list(cached()) == list(cached()) == list(range(10))
+        comp = paddle.reader.compose(base, base)
+        assert list(comp())[0] == (0, 0)
+        out = sorted(paddle.reader.xmap_readers(
+            lambda x: x * x, base, 2, 4)())
+        assert out == [i * i for i in range(10)]
+        ordered = list(paddle.reader.xmap_readers(
+            lambda x: x * x, base, 3, 4, order=True)())
+        assert ordered == [i * i for i in range(10)]
+
+    def test_compose_not_aligned(self):
+        short = lambda: iter(range(3))
+        full = lambda: iter(range(5))
+        with pytest.raises(paddle.reader.ComposeNotAligned):
+            list(paddle.reader.compose(short, full)())
+
+
+class TestRegularizer:
+    def _train(self, wd):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        lin = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters(),
+                                   weight_decay=wd)
+        x = paddle.to_tensor(np.zeros((2, 4), dtype="float32"))
+        loss = lin(x).sum()  # dL/dW = 0 for zero input → pure decay visible
+        loss.backward()
+        opt.step()
+        return np.asarray(lin.weight._data)
+
+    def test_l2_decay_shrinks_weights(self):
+        paddle.seed(0)
+        import paddle_tpu.nn as nn
+
+        w0 = np.asarray(nn.Linear(4, 4).weight._data)
+        w = self._train(paddle.regularizer.L2Decay(0.5))
+        np.testing.assert_allclose(w, w0 * (1 - 0.1 * 0.5), rtol=1e-5)
+
+    def test_l1_decay_steps_by_sign(self):
+        paddle.seed(0)
+        import paddle_tpu.nn as nn
+
+        w0 = np.asarray(nn.Linear(4, 4).weight._data)
+        w = self._train(paddle.regularizer.L1Decay(0.5))
+        np.testing.assert_allclose(w, w0 - 0.1 * 0.5 * np.sign(w0), rtol=1e-5)
+
+
+class TestHubDataset:
+    def test_hub_local(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny(n=3):\n    'docstring here'\n    return list(range(n))\n")
+        assert paddle.hub.list(str(tmp_path), source='local') == ['tiny']
+        assert 'docstring' in paddle.hub.help(str(tmp_path), 'tiny',
+                                              source='local')
+        assert paddle.hub.load(str(tmp_path), 'tiny', source='local',
+                               n=2) == [0, 1]
+        with pytest.raises(RuntimeError, match="network"):
+            paddle.hub.list(str(tmp_path), source='github')
+
+    def test_uci_housing(self, tmp_path):
+        rs = np.random.RandomState(0)
+        data = np.concatenate([rs.rand(50, 13), rs.rand(50, 1) * 50], axis=1)
+        f = tmp_path / "housing.data"
+        np.savetxt(str(f), data)
+        tr = list(paddle.dataset.uci_housing.train(str(f))())
+        te = list(paddle.dataset.uci_housing.test(str(f))())
+        assert len(tr) == 40 and len(te) == 10
+        assert tr[0][0].shape == (13,) and tr[0][1].shape == (1,)
+
+    def test_mnist_requires_paths(self):
+        with pytest.raises(ValueError, match="required"):
+            paddle.dataset.mnist.train()()
+
+
+class TestMiscNamespaces:
+    def test_version(self):
+        assert paddle.version.full_version
+        assert paddle.version.cuda() == "False"
+        assert paddle.version.tpu() == "True"
+        paddle.version.show()
+
+    def test_sysconfig(self):
+        assert os.path.isdir(paddle.sysconfig.get_include())
+
+    def test_framework_and_base(self):
+        assert paddle.framework.in_dynamic_mode()
+        assert not paddle.framework.in_pir_mode()
+        assert paddle.framework.get_default_dtype() == "float32"
+        pa = paddle.framework.ParamAttr(name="w", learning_rate=0.5)
+        assert pa.learning_rate == 0.5
+        from paddle_tpu.base import core
+        assert core.is_compiled_with_dist()
+        assert not core.is_compiled_with_rocm()
+        assert "FLAGS_use_compiled_eager" in core.globals()
+
+    def test_tensor_namespace(self):
+        x = paddle.tensor.ones([2, 2])
+        y = paddle.tensor.matmul(x, x)
+        np.testing.assert_allclose(np.asarray(y._data), 2 * np.ones((2, 2)))
+        import paddle_tpu.tensor.creation as tc
+        assert tc.ones is not None
+
+    def test_tensorrt_stub(self):
+        with pytest.raises(NotImplementedError, match="StableHLO"):
+            paddle.tensorrt.convert("model")
+
+    def test_decomposition_identity(self):
+        fn = lambda x: x
+        assert paddle.decomposition.decompose(fn) is fn
+        with pytest.raises(ValueError):
+            paddle.decomposition.decompose(fn, blacklist={"a"},
+                                           whitelist={"a"})
+
+    def test_cost_model(self):
+        cm = paddle.cost_model.CostModel()
+        res = cm.get_static_op_time("tanh", shape=(8, 8))
+        assert res["op_time_ms"] > 0
+        assert cm.get_static_op_time("tanh", shape=(8, 8)) is res  # memoized
+        res_b = cm.get_static_op_time("tanh", forward=False, shape=(8, 8))
+        assert res_b["op_time_ms"] > 0
+        with pytest.raises(ValueError):
+            cm.get_static_op_time("not_an_op")
+
+    def test_callbacks_reexport(self):
+        assert paddle.callbacks.EarlyStopping is not None
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+        assert paddle.callbacks.EarlyStopping is EarlyStopping
+
+    def test_fleet_ps_stubs(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        assert fleet.is_worker() and not fleet.is_server()
+        assert fleet.init_worker() is None and fleet.stop_worker() is None
+        with pytest.raises(NotImplementedError):
+            fleet.init_server()
+        with pytest.raises(NotImplementedError):
+            fleet.run_server()
+        with pytest.raises(NotImplementedError):
+            fleet.save_persistables()
