@@ -1,11 +1,14 @@
-"""paddle.audio (≙ python/paddle/audio) — feature extraction subset.
+"""paddle.audio (≙ python/paddle/audio) — features, WAV backends, datasets.
 
-Functional features implemented over jnp (differentiable); dataset
-downloads are unavailable in this environment (datasets raise with
-instructions, like paddle.vision.datasets).
+Feature extractors are jnp compositions (differentiable, jit-able); the
+backend is a zero-dependency stdlib `wave` reader/writer; datasets read
+locally provided archives (downloads unavailable in this environment).
 """
 from . import functional
+from . import backends
+from . import datasets
+from .backends import load, save, info
 from .features import LogMelSpectrogram, MFCC, MelSpectrogram, Spectrogram
 
-__all__ = ["functional", "Spectrogram", "MelSpectrogram", "LogMelSpectrogram",
-           "MFCC"]
+__all__ = ["functional", "backends", "datasets", "load", "save", "info",
+           "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

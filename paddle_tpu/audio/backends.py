@@ -1,0 +1,106 @@
+"""paddle.audio.backends parity (≙ python/paddle/audio/backends/ —
+wave_backend.py): WAV load/save/info without external audio libs (stdlib
+`wave` + numpy). The reference's optional paddleaudio backend is a plugin;
+here the wave backend is the only one (zero-dependency build)."""
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+
+__all__ = ['load', 'save', 'info', 'list_available_backends', 'get_current_backend',
+           'set_backend']
+
+_BACKEND = "wave_backend"
+
+
+class AudioInfo:
+    """≙ backends/backend.AudioInfo."""
+
+    def __init__(self, sample_rate, num_samples, num_channels, bits_per_sample,
+                 encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (f"AudioInfo(sample_rate={self.sample_rate}, "
+                f"num_samples={self.num_samples}, "
+                f"num_channels={self.num_channels}, "
+                f"bits_per_sample={self.bits_per_sample})")
+
+
+def list_available_backends():
+    return [_BACKEND]
+
+
+def get_current_backend():
+    return _BACKEND
+
+
+def set_backend(backend_name):
+    if backend_name != _BACKEND:
+        raise NotImplementedError(
+            f"only '{_BACKEND}' is available in this build (no external "
+            "audio libraries); got {backend_name!r}")
+
+
+_WIDTH_DTYPE = {1: np.uint8, 2: np.int16, 4: np.int32}
+
+
+def info(filepath):
+    """Read WAV header metadata."""
+    with _wave.open(str(filepath), 'rb') as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Load WAV → (Tensor [channels, time] float32 in [-1,1] when normalize,
+    sample_rate) (≙ wave_backend.load)."""
+    from ..core.tensor import Tensor
+
+    with _wave.open(str(filepath), 'rb') as f:
+        sr, nch, width = f.getframerate(), f.getnchannels(), f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    dt = _WIDTH_DTYPE.get(width)
+    if dt is None:
+        raise ValueError(f"unsupported WAV sample width: {width} bytes")
+    data = np.frombuffer(raw, dtype=dt).reshape(-1, nch)
+    if width == 1:  # 8-bit WAV is unsigned
+        data = data.astype(np.float32) - 128.0
+        scale = 128.0
+    else:
+        data = data.astype(np.float32)
+        scale = float(2 ** (8 * width - 1))
+    if normalize:
+        data = data / scale
+    out = data.T if channels_first else data
+    return Tensor(out.copy(), _internal=True, stop_gradient=True), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True, encoding="PCM_16",
+         bits_per_sample=16):
+    """Save a float waveform Tensor/array to 16-bit PCM WAV."""
+    data = np.asarray(src._data if hasattr(src, "_data") else src)
+    if data.ndim == 1:
+        # 1-D mono has no channel axis: normalize to [1, time] and treat as
+        # channels-first regardless of the flag
+        data = data[None, :]
+        channels_first = True
+    if channels_first:
+        data = data.T  # → [time, channels]
+    if bits_per_sample != 16:
+        raise NotImplementedError("wave backend writes 16-bit PCM only")
+    pcm = np.clip(data, -1.0, 1.0)
+    pcm = (pcm * 32767.0).astype(np.int16)
+    with _wave.open(str(filepath), 'wb') as f:
+        f.setnchannels(pcm.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(pcm.tobytes())

@@ -1,0 +1,90 @@
+"""paddle.audio.datasets parity (≙ python/paddle/audio/datasets/{tess,esc50}.py):
+folder-layout readers over locally provided archives (zero-egress build —
+no download), emitting raw waveforms or features via paddle.audio.features.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ['TESS', 'ESC50']
+
+
+class _AudioFolderDataset(Dataset):
+    """Walk a directory of WAV files, label from filename via _label_of."""
+
+    def __init__(self, data_dir, sample_rate, feat_type='raw', **feat_kwargs):
+        if data_dir is None or not os.path.isdir(data_dir):
+            raise ValueError(
+                f"{type(self).__name__}: data_dir with the extracted WAV "
+                "files is required (downloads unavailable in this build)")
+        self.files = []
+        for root, _dirs, files in os.walk(data_dir):
+            for fn in sorted(files):
+                if fn.lower().endswith('.wav'):
+                    self.files.append(os.path.join(root, fn))
+        if not self.files:
+            raise ValueError(f"no .wav files under {data_dir}")
+        self.sample_rate = sample_rate
+        self.feat_type = feat_type
+        self.feat_kwargs = feat_kwargs
+        self._extractor = None
+
+    def _feature(self, wave):
+        if self.feat_type == 'raw':
+            return wave
+        if self._extractor is None:
+            from . import features as F
+
+            cls = {'spectrogram': F.Spectrogram,
+                   'melspectrogram': F.MelSpectrogram,
+                   'logmelspectrogram': F.LogMelSpectrogram,
+                   'mfcc': F.MFCC}.get(self.feat_type)
+            if cls is None:
+                raise ValueError(f"unknown feat_type {self.feat_type!r}")
+            self._extractor = cls(**self.feat_kwargs)
+        return self._extractor(wave)
+
+    def __len__(self):
+        return len(self.files)
+
+    def __getitem__(self, idx):
+        from .backends import load
+
+        wave, _sr = load(self.files[idx])
+        mono = wave[0] if wave.shape[0] >= 1 else wave
+        return np.asarray(self._feature(mono)._data), self._label_of(
+            self.files[idx])
+
+
+class TESS(_AudioFolderDataset):
+    """Toronto emotional speech set: label = emotion token in the filename
+    (OAF_back_angry.wav → angry)."""
+
+    EMOTIONS = ['angry', 'disgust', 'fear', 'happy', 'neutral', 'ps', 'sad']
+
+    def __init__(self, data_dir=None, mode='train', n_folds=5, split=1,
+                 feat_type='raw', **kwargs):
+        super().__init__(data_dir, 24414, feat_type, **kwargs)
+
+    def _label_of(self, path):
+        token = os.path.basename(path).rsplit('.', 1)[0].split('_')[-1].lower()
+        if token not in self.EMOTIONS:
+            raise ValueError(f"unrecognized TESS emotion in {path}")
+        return self.EMOTIONS.index(token)
+
+
+class ESC50(_AudioFolderDataset):
+    """ESC-50 environmental sounds: label = target field of the filename
+    (1-100032-A-0.wav → class 0)."""
+
+    def __init__(self, data_dir=None, mode='train', split=1, feat_type='raw',
+                 **kwargs):
+        super().__init__(data_dir, 44100, feat_type, **kwargs)
+
+    def _label_of(self, path):
+        stem = os.path.basename(path).rsplit('.', 1)[0]
+        return int(stem.split('-')[-1])

@@ -36,11 +36,11 @@ class MelSpectrogram(Layer):
                                              htk, norm)
 
     def forward(self, x):
-        s = self.spec(x)  # [..., frames, bins]
+        s = self.spec(x)  # [..., bins, frames] (reference orientation)
         fb = self.fbank
 
         def fn(sv, fbv):
-            return sv @ fbv.T  # [..., frames, n_mels]
+            return fbv @ sv  # [..., n_mels, frames]
 
         return op_call(fn, s, fb, name="mel_spectrogram")
 
@@ -79,10 +79,11 @@ class MFCC(Layer):
         self._dct = jnp.asarray(dct.T, jnp.float32)  # [n_mels, n_mfcc]
 
     def forward(self, x):
-        lm = self.logmel(x)
+        lm = self.logmel(x)  # [..., n_mels, frames]
         dct = self._dct
 
         def fn(m):
-            return m @ dct
+            # [..., n_mfcc, frames] (reference orientation)
+            return jnp.swapaxes(jnp.swapaxes(m, -1, -2) @ dct, -1, -2)
 
         return op_call(fn, lm, name="mfcc")

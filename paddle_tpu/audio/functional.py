@@ -126,6 +126,8 @@ def spectrogram(x: Tensor, n_fft: int = 512, hop_length: int | None = None,
 
     def fn(r, i):
         mag = r * r + i * i
-        return mag if power == 2.0 else jnp.power(jnp.sqrt(mag), power)
+        out = mag if power == 2.0 else jnp.power(jnp.sqrt(mag), power)
+        # reference orientation: [..., n_fft//2+1, num_frames]
+        return jnp.swapaxes(out, -1, -2)
 
     return op_call(fn, re, im, name="spectrogram")

@@ -2,8 +2,6 @@
 creators over local IDX files."""
 from __future__ import annotations
 
-import numpy as np
-
 __all__ = ['train', 'test']
 
 

@@ -21,6 +21,7 @@ from .reduction import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 
 from . import math as _math
 from . import creation as _creation
@@ -28,7 +29,8 @@ from . import reduction as _reduction
 from . import manipulation as _manip
 from . import linalg as _linalg
 from . import random as _random
-from ._helpers import raw
+from . import extras as _extras
+from ._helpers import inplace_variant as _inplace_variant, raw
 
 
 # ---------------------------------------------------------------- getitem/setitem
@@ -142,7 +144,8 @@ for _n, _f in _METHODS.items():
     setattr(Tensor, _n, _f)
 
 # attach functional ops as tensor methods (paddle exposes ~all of these)
-_METHOD_SOURCES = [_math, _creation, _reduction, _manip, _linalg, _random]
+_METHOD_SOURCES = [_math, _creation, _reduction, _manip, _linalg, _random,
+                   _extras]
 _SKIP = {"zeros", "ones", "full", "empty", "arange", "linspace", "logspace", "eye",
          "meshgrid", "to_tensor", "rand", "randn", "randint", "randperm", "tril_indices",
          "triu_indices", "create_parameter", "scatter_nd", "uniform", "gaussian",
@@ -158,6 +161,95 @@ for _mod in _METHOD_SOURCES:
 
 # paddle-name aliases on Tensor
 Tensor.add_n = staticmethod(lambda xs: add_n(xs))
+
+# ------------------------------------------------- bulk in-place (`op_`) sweep
+# The reference exposes an in-place twin for most tensor methods
+# (python/paddle/tensor/__init__.py tensor_method_func `*_` entries); all of
+# them are buffer-swap wrappers here, generated from the functional op.
+_INPLACE_BASES = (
+    "abs acos acosh addmm asin asinh atan atanh bitwise_and bitwise_invert "
+    "bitwise_left_shift bitwise_not bitwise_or bitwise_right_shift "
+    "bitwise_xor copysign cos cosh cumprod cumsum digamma equal erfinv "
+    "floor_divide floor_mod frac gammainc gammaincc gammaln gcd "
+    "greater_equal greater_than hypot i0 index_fill index_put lcm ldexp "
+    "lerp less less_equal less_than lgamma log log10 log1p log2 logical_and "
+    "logical_not logical_or logical_xor logit masked_fill masked_scatter "
+    "mod multigammaln multiply nan_to_num neg not_equal pow polygamma "
+    "put_along_axis relu remainder renorm rsqrt scatter_nd_add sin sinc "
+    "sinh subtract tan tanh trunc index_add log_normal square t "
+    "tril triu"
+).split()
+
+for _bn in _INPLACE_BASES:
+    _ipname = _bn + "_"
+    _base = globals().get(_bn)
+    if _base is None or _ipname in globals():
+        continue
+    globals()[_ipname] = _inplace_variant(_base)
+    if not hasattr(Tensor, _ipname):
+        setattr(Tensor, _ipname, globals()[_ipname])
+
+
+def _fill_inplace_random(name, sampler):
+    """In-place distribution fills (cauchy_/geometric_ — reference
+    tensor/random.py): overwrite x with samples, keep shape/dtype."""
+
+    def op_(x, *args, **kwargs):
+        x._assign_raw(sampler(x, *args, **kwargs))
+        return x
+
+    op_.__name__ = name
+    setattr(Tensor, name, op_)
+    globals()[name] = op_
+    return op_
+
+
+def _cauchy_sample(x, loc=0, scale=1, **kw):
+    from ..core.rng import next_key
+
+    u = jax.random.uniform(next_key(), x._data.shape, jnp.float32,
+                           1e-6, 1 - 1e-6)
+    return (loc + scale * jnp.tan(jnp.pi * (u - 0.5))).astype(x._data.dtype)
+
+
+def _geometric_sample(x, probs=0.5, **kw):
+    from ..core.rng import next_key
+
+    u = jax.random.uniform(next_key(), x._data.shape, jnp.float32,
+                           1e-6, 1 - 1e-6)
+    p = probs._data if isinstance(probs, Tensor) else probs
+    return jnp.ceil(jnp.log(u) / jnp.log1p(-p)).astype(x._data.dtype)
+
+
+_fill_inplace_random("cauchy_", _cauchy_sample)
+_fill_inplace_random("geometric_", _geometric_sample)
+
+
+def where_(condition, x, y, name=None):
+    """In-place on x (reference ops.yaml marks where inplace x->out) — NOT
+    on the condition, so it can't ride the bulk first-arg sweep."""
+    out = where(condition, x, y)
+    x._assign_raw(out._data)
+    x._node = out._node
+    x._out_idx = out._out_idx
+    x.stop_gradient = x.stop_gradient and out.stop_gradient
+    return x
+
+
+Tensor.where_ = where_
+
+
+def _tensor_set_(self, source):
+    """Adopt source's data AND shape (paddle Tensor.set_ repoints storage,
+    unlike set_value which broadcasts into the existing shape)."""
+    self._assign_raw(source._data if isinstance(source, Tensor)
+                     else jnp.asarray(source))
+    return self
+
+
+Tensor.set_ = _tensor_set_
+Tensor.resize_ = lambda self, shape: self._assign_raw(
+    jnp.resize(self._data, tuple(shape))) or self
 Tensor.mean_all = lambda self: mean(self)
 
 

@@ -60,10 +60,34 @@ def norm_axis(axis):
 
 
 def inplace_variant(fn):
-    """Build the paddle `op_`(in-place) from the functional op."""
+    """Build the paddle `op_`(in-place) from the functional op.
+
+    Autograd semantics match the reference's inplace handling
+    (eager/auto_code_generator inplace ad_funcs + version counters):
+    - leaf tensor requiring grad → error (torch/paddle both forbid it);
+    - non-leaf: the recorded node must link to the PRODUCER of the
+      pre-mutation value, so the mutated tensor object is swapped out of
+      the new node's input list for a shadow alias carrying the old
+      (node, out_idx) link — otherwise the node would point at itself.
+    """
 
     def op_(x, *args, **kwargs):
+        from ..core.dispatch import grad_enabled
+
+        old_node, old_idx = x._node, x._out_idx
+        if not x.stop_gradient and old_node is None and grad_enabled():
+            raise RuntimeError(
+                f"{fn.__name__}_(): an in-place operation on a leaf Tensor "
+                "that requires grad is not allowed — operate on a "
+                "computed value or use the out-of-place op")
         out = fn(x, *args, **kwargs)
+        if out._node is not None and old_node is not None:
+            shadow = Tensor(x._data, _internal=True,
+                            stop_gradient=x.stop_gradient)
+            shadow._node = old_node
+            shadow._out_idx = old_idx
+            out._node.inputs = [shadow if t is x else t
+                                for t in out._node.inputs]
         x._assign_raw(out._data)
         # in-place on a graph-recorded tensor keeps the new node (paddle semantics)
         x._node = out._node

@@ -1,0 +1,499 @@
+"""Long-tail tensor ops closing the paddle.tensor surface gap
+(≙ python/paddle/tensor/__init__.py tensor_method_func entries not covered
+by math/creation/reduction/manipulation/linalg/random; kernels: assorted phi
+cpu/gpu kernels). All are jnp/lax compositions that trace into XLA."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+
+from ..core.dispatch import op_call
+from ..core.tensor import Tensor
+from ._helpers import norm_axis
+
+
+# ------------------------------------------------------------- complex views
+def as_complex(x, name=None):
+    """[..., 2] float → [...] complex (≙ phi as_complex_kernel)."""
+    return op_call(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x,
+                   name="as_complex")
+
+
+def as_real(x, name=None):
+    """[...] complex → [..., 2] float."""
+    return op_call(lambda a: jnp.stack([a.real, a.imag], axis=-1), x,
+                   name="as_real")
+
+
+def isreal(x, name=None):
+    return op_call(lambda a: jnp.isreal(a), x, name="isreal")
+
+
+def sgn(x, name=None):
+    """sign for real; z/|z| (0 at 0) for complex (≙ phi sgn_kernel)."""
+
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(a)
+
+    return op_call(f, x, name="sgn")
+
+
+# ------------------------------------------------------------------- bitwise
+def bitwise_invert(x, name=None):
+    return op_call(jnp.invert, x, name="bitwise_invert")
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
+    return op_call(jnp.left_shift, x, y, name="bitwise_left_shift")
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
+    def f(a, b):
+        if is_arithmetic:
+            return jnp.right_shift(a, b)
+        # logical shift: operate on the unsigned view
+        ui = a.dtype.name.replace("int", "uint") if not a.dtype.name.startswith(
+            "uint") else a.dtype.name
+        return jnp.right_shift(a.view(ui), b.astype(ui)).view(a.dtype)
+
+    return op_call(f, x, y, name="bitwise_right_shift")
+
+
+# ------------------------------------------------------------------ special
+def gammaln(x, name=None):
+    return op_call(jsp.gammaln, x, name="gammaln")
+
+
+def gammainc(x, y, name=None):
+    return op_call(jsp.gammainc, x, y, name="gammainc")
+
+
+def gammaincc(x, y, name=None):
+    return op_call(jsp.gammaincc, x, y, name="gammaincc")
+
+
+def multigammaln(x, p, name=None):
+    return op_call(lambda a: jsp.multigammaln(a, p), x, name="multigammaln")
+
+
+def polygamma(x, n, name=None):
+    return op_call(lambda a: jsp.polygamma(n, a), x, name="polygamma")
+
+
+def i0e(x, name=None):
+    return op_call(jsp.i0e, x, name="i0e")
+
+
+def i1(x, name=None):
+    return op_call(jsp.i1, x, name="i1")
+
+
+def i1e(x, name=None):
+    return op_call(jsp.i1e, x, name="i1e")
+
+
+def sinc(x, name=None):
+    return op_call(jnp.sinc, x, name="sinc")
+
+
+def isneginf(x, name=None):
+    return op_call(jnp.isneginf, x, name="isneginf")
+
+
+def isposinf(x, name=None):
+    return op_call(jnp.isposinf, x, name="isposinf")
+
+
+def frexp(x, name=None):
+    return op_call(lambda a: tuple(jnp.frexp(a)), x, name="frexp", n_diff=0)
+
+
+# ----------------------------------------------------------------- reductions
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return op_call(lambda a: jnp.trace(a, offset=offset, axis1=axis1,
+                                       axis2=axis2), x, name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return op_call(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                          axis2=axis2), x, name="diagonal")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    step = 1.0 if dx is None else dx
+
+    def f(*arrs):
+        if x is not None:
+            return jnp.trapezoid(arrs[0], x=arrs[1], axis=axis)
+        return jnp.trapezoid(arrs[0], dx=step, axis=axis)
+
+    args = (y,) if x is None else (y, x)
+    return op_call(f, *args, name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    step = 1.0 if dx is None else dx
+
+    def f(*arrs):
+        a = arrs[0]
+        a = jnp.moveaxis(a, axis, -1)
+        avg = (a[..., 1:] + a[..., :-1]) / 2.0
+        if x is not None:
+            xs = jnp.moveaxis(jnp.broadcast_to(arrs[1], a.shape), axis, -1) \
+                if arrs[1].ndim == a.ndim else arrs[1]
+            d = jnp.diff(xs, axis=-1)
+            seg = avg * d
+        else:
+            seg = avg * step
+        return jnp.moveaxis(jnp.cumsum(seg, axis=-1), -1, axis)
+
+    args = (y,) if x is None else (y, x)
+    return op_call(f, *args, name="cumulative_trapezoid")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    def f(a, *extra):
+        pre = extra[0] if prepend is not None else None
+        app = extra[-1] if append is not None else None
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+
+    args = [x] + [t for t in (prepend, append) if t is not None]
+    return op_call(f, *args, name="diff")
+
+
+def reduce_as(x, target, name=None):
+    """Sum x down to target's shape (≙ phi reduce_as_kernel: the grad-side
+    inverse of broadcasting)."""
+    tshape = tuple(target.shape)
+
+    def f(a):
+        extra = a.ndim - len(tshape)
+        if extra:
+            a = a.sum(axis=tuple(range(extra)))
+        keep = tuple(i for i, (s, t) in enumerate(zip(a.shape, tshape))
+                     if s != t)
+        if keep:
+            a = a.sum(axis=keep, keepdims=True)
+        return a.reshape(tshape)
+
+    return op_call(f, x, name="reduce_as")
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) == 0), _internal=True,
+                  stop_gradient=True)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return op_call(lambda a, t: jnp.isin(a, t, invert=invert), x, test_x,
+                   name="isin", n_diff=0)
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):  # noqa: A002
+    rng = None if (min == 0 and max == 0) else (min, max)
+
+    def f(a):
+        return jnp.histogram_bin_edges(a, bins=bins, range=rng)
+
+    return op_call(f, x, name="histogram_bin_edges", n_diff=0)
+
+
+# -------------------------------------------------------------- manipulation
+def reverse(x, axis, name=None):
+    from .manipulation import flip
+
+    return flip(x, axis)
+
+
+def less(x, y, name=None):
+    from .math import less_than
+
+    return less_than(x, y)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    ax = axis % x.ndim
+    n = x.shape[ax]
+    if num is not None and num != n:
+        raise ValueError(f"unstack: num={num} != dim size {n}")
+    out = op_call(
+        lambda a: tuple(jnp.squeeze(s, ax) for s in jnp.split(a, n, axis=ax)),
+        x, name="unstack")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def unflatten(x, axis, shape, name=None):
+    ax = axis % x.ndim
+    shape = [int(s.item()) if hasattr(s, "item") else int(s) for s in shape]
+    new = list(x.shape[:ax]) + list(shape) + list(x.shape[ax + 1:])
+    neg = [i for i, s in enumerate(shape) if s == -1]
+    if neg:
+        known = int(np.prod([s for s in shape if s != -1])) or 1
+        shape[neg[0]] = x.shape[ax] // known
+        new = list(x.shape[:ax]) + list(shape) + list(x.shape[ax + 1:])
+    return op_call(lambda a: a.reshape(new), x, name="unflatten")
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    ax = norm_axis(axis) or 0
+    out = op_call(
+        lambda a: tuple(jnp.array_split(a, num_or_indices, axis=ax)),
+        x, name="tensor_split")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return op_call(lambda a: jnp.vander(a, N=n, increasing=increasing), x,
+                   name="vander")
+
+
+def block_diag(inputs, name=None):
+    import jax.scipy.linalg as jsl
+
+    return op_call(lambda *arrs: jsl.block_diag(*arrs), *list(inputs),
+                   name="block_diag")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """Relabel global ids to shard-local ids (≙ phi shard_index_kernel)."""
+    if not 0 <= shard_id < nshards:
+        raise ValueError(
+            f"shard_id {shard_id} out of range for nshards {nshards}")
+    size = (index_num + nshards - 1) // nshards
+
+    def f(a):
+        in_shard = a // size == shard_id
+        return jnp.where(in_shard, a % size, ignore_value)
+
+    return op_call(f, input, name="shard_index", n_diff=0)
+
+
+# ------------------------------------------------------------ scatter family
+def index_fill(x, index, axis, value, name=None):
+    ax = axis % x.ndim
+
+    def f(a, idx):
+        moved = jnp.moveaxis(a, ax, 0)
+        moved = moved.at[idx].set(value)
+        return jnp.moveaxis(moved, 0, ax)
+
+    return op_call(f, x, index, name="index_fill", n_diff=1)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    ax = axis % x.ndim
+
+    def f(a, v):
+        moved = jnp.moveaxis(a, ax, 0)
+        moved = moved.at[index].set(v)
+        return jnp.moveaxis(moved, 0, ax)
+
+    return op_call(f, x, values, name="select_scatter")
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def f(a, v):
+        idx = [slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax % a.ndim] = slice(st, en, sd)
+        return a.at[tuple(idx)].set(v)
+
+    return op_call(f, x, value, name="slice_scatter")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def f(a, v):
+        ax1, ax2 = axis1 % a.ndim, axis2 % a.ndim
+        moved = jnp.moveaxis(a, (ax1, ax2), (-2, -1))
+        h, w = moved.shape[-2:]
+        if offset >= 0:
+            rows = jnp.arange(min(h, w - offset))
+            cols = rows + offset
+        else:
+            cols = jnp.arange(min(w, h + offset))
+            rows = cols - offset
+        moved = moved.at[..., rows, cols].set(v)
+        return jnp.moveaxis(moved, (-2, -1), (ax1, ax2))
+
+    return op_call(f, x, y, name="diagonal_scatter")
+
+
+# ------------------------------------------------------------------- linalg+
+def cholesky_inverse(x, upper=False, name=None):
+    def f(a):
+        ident = jnp.eye(a.shape[-1], dtype=a.dtype)
+        # cho_solve's flag is `lower`; paddle's is `upper`
+        return jax.scipy.linalg.cho_solve((a, not upper), ident)
+
+    return op_call(f, x, name="cholesky_inverse")
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """(lu_factor data, 1-based pivots) → (P, L, U)
+    (≙ phi lu_unpack_kernel)."""
+    n = x.shape[-2]
+
+    def f(lu_, piv):
+        lo = jnp.tril(lu_, -1) + jnp.eye(
+            lu_.shape[-2], lu_.shape[-1], dtype=lu_.dtype)
+        up = jnp.triu(lu_)
+        perm = jnp.arange(n)
+        pv = piv.astype(jnp.int32) - 1
+
+        def body(i, pm):
+            a, b = pm[i], pm[pv[i]]
+            return pm.at[i].set(b).at[pv[i]].set(a)
+
+        perm = jax.lax.fori_loop(0, pv.shape[-1], body, perm)
+        p = jnp.eye(n, dtype=lu_.dtype)[perm].T
+        return p, lo, up
+
+    out = op_call(f, x, y, name="lu_unpack", n_diff=0)
+    return out
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply `other` by Q (from a QR factorization's reflectors)
+    (≙ phi ormqr_kernel over LAPACK ormqr)."""
+
+    def f(a, t, o):
+        m, k = a.shape[-2], a.shape[-1]
+        # LAPACK Q is the full m×m product of the k reflectors; pad the
+        # factor/taus so householder_product emits it (zero taus = identity)
+        if k < m:
+            pad_a = [(0, 0)] * (a.ndim - 1) + [(0, m - k)]
+            a = jnp.pad(a, pad_a)
+            pad_t = [(0, 0)] * (t.ndim - 1) + [(0, m - k)]
+            t = jnp.pad(t, pad_t)
+        q = jax.lax.linalg.householder_product(a, t)
+        qm = jnp.swapaxes(q, -1, -2) if transpose else q
+        return jnp.matmul(qm, o) if left else jnp.matmul(o, qm)
+
+    return op_call(f, x, tau, other, name="ormqr")
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Batched pairwise p-norm distances [..., P, M] x [..., R, M] →
+    [..., P, R]; p=2 rides the MXU as a matmul expansion."""
+
+    def f(a, b):
+        if p == 2.0 and "use_mm" in compute_mode:
+            aa = jnp.sum(a * a, -1)[..., :, None]
+            bb = jnp.sum(b * b, -1)[..., None, :]
+            ab = jnp.matmul(a, jnp.swapaxes(b, -1, -2))
+            d2 = jnp.maximum(aa + bb - 2 * ab, 0)
+            # double-where: subgradient 0 at coincident points instead of
+            # NaN from d/dx sqrt(0) (torch cdist matches)
+            safe = jnp.where(d2 > 0, d2, 1.0)
+            return jnp.where(d2 > 0, jnp.sqrt(safe), 0.0)
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 0:
+            return jnp.sum(d != 0, -1).astype(a.dtype)
+        if jnp.isinf(p):
+            return jnp.max(jnp.abs(d), -1)
+        return jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+
+    return op_call(f, x, y, name="cdist")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Scale sub-tensors along `axis` whose p-norm exceeds max_norm
+    (≙ phi renorm_kernel)."""
+    ax = axis % x.ndim
+
+    def f(a):
+        moved = jnp.moveaxis(a, ax, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, ax)
+
+    return op_call(f, x, name="renorm")
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (subspace iteration, all-matmul → MXU)
+    (≙ python/paddle/tensor/linalg.py svd_lowrank)."""
+    from ..core.rng import next_key
+
+    key = next_key()
+    qq = min(q, *x.shape[-2:])
+
+    def f(a, *rest):
+        m = rest[0] if M is not None else None
+        if m is not None:
+            a = a - m
+        g = jax.random.normal(key, a.shape[:-2] + (a.shape[-1], qq), a.dtype)
+        y = jnp.matmul(a, g)
+        for _ in range(niter):
+            y = jnp.matmul(a, jnp.matmul(jnp.swapaxes(a, -1, -2), y))
+        qmat, _ = jnp.linalg.qr(y)
+        b = jnp.matmul(jnp.swapaxes(qmat, -1, -2), a)
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return jnp.matmul(qmat, u), s, jnp.swapaxes(vh, -1, -2)
+
+    args = (x,) if M is None else (x, M)
+    return op_call(f, *args, name="svd_lowrank")
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
+                   k=0, mode="truncated", return_top=False, name=None):
+    """Nucleus sampling per row (≙ phi top_p_sampling fused kernel):
+    keep the smallest prefix of sorted probs with cumsum ≥ p, renormalize,
+    sample. Returns (sampled scores, sampled ids)."""
+    from ..core.rng import next_key
+
+    key = next_key()
+
+    def f(probs, p):
+        srt = jnp.sort(probs, axis=-1)[..., ::-1]
+        idx = jnp.argsort(probs, axis=-1)[..., ::-1]
+        cum = jnp.cumsum(srt, axis=-1)
+        keep = cum - srt < p  # first index where cumsum(prev) >= p is cut
+        masked = jnp.where(keep, srt, 0.0)
+        masked = masked / jnp.sum(masked, axis=-1, keepdims=True)
+        flat = masked.reshape(-1, masked.shape[-1])
+        keys = jax.random.split(key, flat.shape[0])
+        picks = jax.vmap(
+            lambda kk, pp: jax.random.choice(kk, pp.shape[-1], p=pp))(
+            keys, flat)
+        picks = picks.reshape(masked.shape[:-1])
+        ids = jnp.take_along_axis(idx, picks[..., None], axis=-1)[..., 0]
+        scores = jnp.take_along_axis(probs, ids[..., None], axis=-1)[..., 0]
+        return scores, ids[..., None]
+
+    return op_call(f, x, ps, name="top_p_sampling", n_diff=0)
+
+
+def create_tensor(dtype="float32", name=None, persistable=False):
+    """Placeholder-tensor creator (legacy static-graph helper)."""
+    return Tensor(jnp.zeros((0,), dtype=np.dtype(dtype)), _internal=True,
+                  stop_gradient=True)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Tensor-level alias of paddle.signal.stft."""
+    from ..signal import stft as _stft
+
+    return _stft(x, n_fft, hop_length, win_length, window, center, pad_mode,
+                 normalized, onesided, name)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Tensor-level alias of paddle.signal.istft."""
+    from ..signal import istft as _istft
+
+    return _istft(x, n_fft, hop_length, win_length, window, center,
+                  normalized, onesided, length, return_complex, name)

@@ -16,3 +16,4 @@ _sys.modules[__name__ + ".linalg"] = linalg
 _sys.modules[__name__ + ".manipulation"] = manipulation
 _sys.modules[__name__ + ".random"] = random
 _sys.modules[__name__ + ".stat"] = reduction  # mean/std/var/median live here
+stat = reduction  # attribute access must work too, not just import-by-name

@@ -15,21 +15,31 @@ with_pip_cuda_libraries = "OFF"
 
 
 def _git_commit():
+    import os
+
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
     try:
         return subprocess.check_output(
-            ["git", "rev-parse", "HEAD"], stderr=subprocess.DEVNULL,
-            timeout=2).decode().strip()
+            ["git", "-C", pkg_dir, "rev-parse", "HEAD"],
+            stderr=subprocess.DEVNULL, timeout=2).decode().strip()
     except Exception:
         return "unknown"
 
 
-commit = _git_commit()
+def __getattr__(name):
+    # `commit` is resolved lazily so `import paddle_tpu` never pays a
+    # subprocess call; cached after first access.
+    if name == "commit":
+        value = _git_commit()
+        globals()["commit"] = value
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def show():
     """Print version info (≙ paddle.version.show)."""
     print("full_version:", full_version)
-    print("commit:", commit)
+    print("commit:", globals().get("commit") or _git_commit())
     print("jax:", jax_version())
     print("platform:", "tpu-native (XLA)")
 

@@ -1,0 +1,78 @@
+"""paddle.audio backends + datasets (≙ python/paddle/audio/backends/
+wave_backend.py, audio/datasets/{tess,esc50}.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _write_wavs(tmp_path, names, sr=16000, n=1600):
+    rs = np.random.RandomState(0)
+    for name in names:
+        wave = (0.4 * np.sin(2 * np.pi * 440 *
+                             np.arange(n) / sr)).astype("float32")
+        wave += 0.05 * rs.randn(n).astype("float32")
+        paddle.audio.save(str(tmp_path / name), paddle.to_tensor(wave), sr)
+
+
+class TestWaveBackend:
+    def test_save_load_roundtrip(self, tmp_path):
+        sr = 16000
+        wave = (0.5 * np.sin(2 * np.pi * 220 *
+                             np.arange(3200) / sr)).astype("float32")
+        f = str(tmp_path / "tone.wav")
+        paddle.audio.save(f, paddle.to_tensor(wave), sr)
+        out, sr2 = paddle.audio.load(f)
+        assert sr2 == sr
+        assert list(out.shape) == [1, 3200]
+        np.testing.assert_allclose(np.asarray(out._data)[0], wave, atol=1e-3)
+
+    def test_info_and_offsets(self, tmp_path):
+        _write_wavs(tmp_path, ["a.wav"], n=1600)
+        f = str(tmp_path / "a.wav")
+        meta = paddle.audio.info(f)
+        assert meta.sample_rate == 16000 and meta.num_samples == 1600
+        assert meta.num_channels == 1 and meta.bits_per_sample == 16
+        part, _ = paddle.audio.load(f, frame_offset=100, num_frames=200)
+        assert list(part.shape) == [1, 200]
+
+    def test_save_mono_channels_last(self, tmp_path):
+        wave = np.linspace(-0.5, 0.5, 100).astype("float32")
+        f = str(tmp_path / "mono.wav")
+        paddle.audio.save(f, paddle.to_tensor(wave), 8000,
+                          channels_first=False)
+        meta = paddle.audio.info(f)
+        assert meta.num_channels == 1 and meta.num_samples == 100
+        out, _ = paddle.audio.load(f)
+        np.testing.assert_allclose(np.asarray(out._data)[0], wave, atol=1e-3)
+
+    def test_backend_registry(self):
+        assert paddle.audio.backends.get_current_backend() == "wave_backend"
+        assert paddle.audio.backends.list_available_backends() == ["wave_backend"]
+        with pytest.raises(NotImplementedError):
+            paddle.audio.backends.set_backend("soundfile")
+
+
+class TestAudioDatasets:
+    def test_esc50_folder(self, tmp_path):
+        _write_wavs(tmp_path, ["1-100032-A-0.wav", "1-100038-A-14.wav"])
+        ds = paddle.audio.datasets.ESC50(data_dir=str(tmp_path))
+        assert len(ds) == 2
+        feat, label = ds[0]
+        assert label == 0 and feat.shape == (1600,)
+        _feat, label1 = ds[1]
+        assert label1 == 14
+
+    def test_tess_folder_with_features(self, tmp_path):
+        _write_wavs(tmp_path, ["OAF_back_angry.wav", "OAF_bar_happy.wav"])
+        ds = paddle.audio.datasets.TESS(data_dir=str(tmp_path),
+                                        feat_type='mfcc', n_mfcc=13,
+                                        n_fft=256)
+        feat, label = ds[0]
+        assert label == paddle.audio.datasets.TESS.EMOTIONS.index('angry')
+        assert feat.shape[0] == 13
+        assert np.isfinite(feat).all()
+
+    def test_missing_dir_raises(self):
+        with pytest.raises(ValueError, match="required"):
+            paddle.audio.datasets.ESC50(data_dir=None)

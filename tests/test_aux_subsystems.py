@@ -103,10 +103,11 @@ class TestAudio:
         wave = np.sin(2 * np.pi * 440.0 * t).astype("float32")
         x = paddle.to_tensor(wave[None])
         spec = paddle.audio.Spectrogram(n_fft=n_fft, hop_length=hop)(x)
-        assert spec.shape[0] == 1 and spec.shape[-1] == n_fft // 2 + 1
+        # reference orientation: [N, n_fft//2+1, num_frames]
+        assert spec.shape[0] == 1 and spec.shape[-2] == n_fft // 2 + 1
         arr = spec.numpy()[0]
         # energy concentrates at the 440 Hz bin
-        peak = arr.mean(0).argmax()
+        peak = arr.mean(-1).argmax()
         expect_bin = round(440.0 * n_fft / sr)
         assert abs(int(peak) - expect_bin) <= 1
 
@@ -114,11 +115,11 @@ class TestAudio:
         x = paddle.to_tensor(np.random.RandomState(0)
                              .randn(1, 4000).astype("float32"))
         mel = paddle.audio.MelSpectrogram(sr=16000, n_fft=256, n_mels=32)(x)
-        assert mel.shape[-1] == 32
+        assert mel.shape[-2] == 32  # [N, n_mels, frames]
         logmel = paddle.audio.LogMelSpectrogram(sr=16000, n_fft=256, n_mels=32)(x)
         assert np.isfinite(logmel.numpy()).all()
         mfcc = paddle.audio.MFCC(sr=16000, n_mfcc=13, n_mels=32, n_fft=256)(x)
-        assert mfcc.shape[-1] == 13
+        assert mfcc.shape[-2] == 13  # [N, n_mfcc, frames]
 
     def test_fbank_rows_nonzero(self):
         from paddle_tpu.audio.functional import compute_fbank_matrix

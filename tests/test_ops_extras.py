@@ -1,0 +1,314 @@
+"""Long-tail op parity vs numpy/scipy references (extras.py; reference
+surface python/paddle/tensor/__init__.py tensor_method_func)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestComplexViews:
+    def test_as_complex_as_real_roundtrip(self):
+        x = np.random.RandomState(0).randn(3, 4, 2).astype("float32")
+        c = paddle.as_complex(_t(x))
+        assert _np(c).dtype == np.complex64
+        np.testing.assert_allclose(_np(paddle.as_real(c)), x)
+
+    def test_sgn(self):
+        z = np.array([3 + 4j, 0j], dtype="complex64")
+        out = _np(paddle.sgn(_t(z)))
+        np.testing.assert_allclose(out, [0.6 + 0.8j, 0j], rtol=1e-6)
+        r = np.array([-2.0, 0.0, 5.0], dtype="float32")
+        np.testing.assert_allclose(_np(paddle.sgn(_t(r))), np.sign(r))
+
+    def test_isreal(self):
+        z = np.array([1 + 0j, 1 + 1j], dtype="complex64")
+        np.testing.assert_array_equal(_np(paddle.isreal(_t(z))),
+                                      [True, False])
+
+
+class TestBitwise:
+    def test_shifts_and_invert(self):
+        x = np.array([8, 16], dtype="int32")
+        np.testing.assert_array_equal(
+            _np(paddle.bitwise_left_shift(_t(x), _t(np.array([1, 2],
+                                                            dtype="int32")))),
+            [16, 64])
+        np.testing.assert_array_equal(
+            _np(paddle.bitwise_right_shift(_t(x), _t(np.array([2, 3],
+                                                             dtype="int32")))),
+            [2, 2])
+        np.testing.assert_array_equal(_np(paddle.bitwise_invert(_t(x))), ~x)
+
+
+class TestSpecial:
+    def test_gamma_family(self):
+        from scipy import special as sp
+
+        x = np.array([0.5, 1.5, 3.0], dtype="float32")
+        y = np.array([1.0, 2.0, 0.5], dtype="float32")
+        np.testing.assert_allclose(_np(paddle.gammaln(_t(x))),
+                                   sp.gammaln(x), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.gammainc(_t(x), _t(y))),
+                                   sp.gammainc(x, y), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.gammaincc(_t(x), _t(y))),
+                                   sp.gammaincc(x, y), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.multigammaln(_t(x + 2), 2)),
+                                   sp.multigammaln(x + 2, 2), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.polygamma(_t(x), 1)),
+                                   sp.polygamma(1, x), rtol=1e-4)
+        np.testing.assert_allclose(_np(paddle.i1(_t(x))), sp.i1(x),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.i1e(_t(x))), sp.i1e(x),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.i0e(_t(x))), sp.i0e(x),
+                                   rtol=1e-5)
+
+    def test_sinc_frexp(self):
+        x = np.array([0.0, 0.5, 2.5], dtype="float32")
+        np.testing.assert_allclose(_np(paddle.sinc(_t(x))), np.sinc(x),
+                                   rtol=1e-6)
+        m, e = paddle.frexp(_t(x))
+        mn, en = np.frexp(x)
+        np.testing.assert_allclose(_np(m), mn)
+        np.testing.assert_array_equal(_np(e), en)
+
+    def test_inf_checks(self):
+        x = np.array([-np.inf, 0.0, np.inf], dtype="float32")
+        np.testing.assert_array_equal(_np(paddle.isneginf(_t(x))),
+                                      np.isneginf(x))
+        np.testing.assert_array_equal(_np(paddle.isposinf(_t(x))),
+                                      np.isposinf(x))
+
+
+class TestReductionsManip:
+    def setup_method(self, _):
+        self.x = np.random.RandomState(1).randn(4, 5).astype("float32")
+
+    def test_trace_diagonal(self):
+        np.testing.assert_allclose(_np(paddle.trace(_t(self.x))),
+                                   np.trace(self.x), rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.diagonal(_t(self.x), offset=1)),
+                                   np.diagonal(self.x, offset=1))
+
+    def test_trapezoid_family(self):
+        y = self.x
+        np.testing.assert_allclose(_np(paddle.trapezoid(_t(y), dx=0.5)),
+                                   np.trapezoid(y, dx=0.5, axis=-1),
+                                   rtol=1e-5)
+        got = _np(paddle.cumulative_trapezoid(_t(y), dx=0.5))
+        from scipy.integrate import cumulative_trapezoid as ct
+        np.testing.assert_allclose(got, ct(y, dx=0.5, axis=-1), rtol=1e-5)
+        xx = np.sort(np.random.RandomState(2).rand(5)).astype("float32")
+        np.testing.assert_allclose(
+            _np(paddle.trapezoid(_t(y), x=_t(xx))),
+            np.trapezoid(y, x=xx, axis=-1), rtol=1e-5)
+
+    def test_diff(self):
+        np.testing.assert_allclose(_np(paddle.diff(_t(self.x))),
+                                   np.diff(self.x))
+        np.testing.assert_allclose(_np(paddle.diff(_t(self.x), n=2, axis=0)),
+                                   np.diff(self.x, n=2, axis=0))
+
+    def test_reduce_as(self):
+        big = np.random.RandomState(3).randn(3, 4, 5).astype("float32")
+        target = paddle.zeros([4, 1])
+        got = _np(paddle.reduce_as(_t(big), target))
+        np.testing.assert_allclose(got, big.sum(axis=(0, 2), keepdims=True)[0],
+                                   rtol=1e-5)
+
+    def test_isin_is_empty(self):
+        x = np.array([1, 2, 3, 4], dtype="int64")
+        np.testing.assert_array_equal(
+            _np(paddle.isin(_t(x), _t(np.array([2, 4], dtype="int64")))),
+            [False, True, False, True])
+        assert not bool(_np(paddle.is_empty(_t(x))))
+        assert bool(_np(paddle.is_empty(paddle.zeros([0, 3]))))
+
+    def test_unstack_unflatten_tensor_split(self):
+        parts = paddle.unstack(_t(self.x), axis=0)
+        assert len(parts) == 4
+        np.testing.assert_allclose(_np(parts[2]), self.x[2])
+        uf = paddle.unflatten(_t(self.x.reshape(20)), 0, [4, 5])
+        np.testing.assert_allclose(_np(uf), self.x)
+        ts = paddle.tensor_split(_t(self.x), 2, axis=1)
+        assert [list(t.shape) for t in ts] == [[4, 3], [4, 2]]
+
+    def test_vander_block_diag(self):
+        v = np.array([1.0, 2.0, 3.0], dtype="float32")
+        np.testing.assert_allclose(_np(paddle.vander(_t(v))), np.vander(v))
+        from scipy.linalg import block_diag as bd
+        a, b = np.ones((2, 2), "float32"), 2 * np.ones((1, 3), "float32")
+        np.testing.assert_allclose(_np(paddle.block_diag([_t(a), _t(b)])),
+                                   bd(a, b))
+
+    def test_reverse_less_aliases(self):
+        np.testing.assert_allclose(_np(paddle.reverse(_t(self.x), [0])),
+                                   self.x[::-1])
+        np.testing.assert_array_equal(
+            _np(paddle.less(_t(self.x), _t(np.zeros_like(self.x)))),
+            self.x < 0)
+
+    def test_shard_index(self):
+        x = np.array([[1], [6], [12], [19]], dtype="int64")
+        out = _np(paddle.shard_index(_t(x), 20, 2, 0))
+        np.testing.assert_array_equal(out, [[1], [6], [-1], [-1]])
+        out1 = _np(paddle.shard_index(_t(x), 20, 2, 1))
+        np.testing.assert_array_equal(out1, [[-1], [-1], [2], [9]])
+
+    def test_histogram_bin_edges(self):
+        e = _np(paddle.histogram_bin_edges(_t(self.x), bins=4, min=-1, max=1))
+        np.testing.assert_allclose(e, np.histogram_bin_edges(
+            self.x, bins=4, range=(-1, 1)), rtol=1e-6)
+
+
+class TestScatterFamily:
+    def test_index_fill_select_scatter(self):
+        x = np.zeros((3, 4), dtype="float32")
+        out = _np(paddle.index_fill(
+            _t(x), _t(np.array([0, 2], dtype="int64")), 0, 7.0))
+        want = x.copy(); want[[0, 2]] = 7
+        np.testing.assert_allclose(out, want)
+        out2 = _np(paddle.select_scatter(
+            _t(x), _t(np.ones(4, dtype="float32")), 0, 1))
+        want2 = x.copy(); want2[1] = 1
+        np.testing.assert_allclose(out2, want2)
+
+    def test_slice_scatter_diagonal_scatter(self):
+        x = np.zeros((4, 4), dtype="float32")
+        v = np.ones((4, 2), dtype="float32")
+        out = _np(paddle.slice_scatter(_t(x), _t(v), [1], [1], [3], [1]))
+        want = x.copy(); want[:, 1:3] = 1
+        np.testing.assert_allclose(out, want)
+        d = _np(paddle.diagonal_scatter(
+            _t(x), _t(np.arange(4, dtype="float32"))))
+        np.testing.assert_allclose(np.diagonal(d), np.arange(4))
+        d1 = _np(paddle.diagonal_scatter(
+            _t(x), _t(np.arange(3, dtype="float32")), offset=1))
+        np.testing.assert_allclose(np.diagonal(d1, offset=1), np.arange(3))
+
+
+class TestLinalgExtras:
+    def test_cholesky_inverse(self):
+        rs = np.random.RandomState(5)
+        a = rs.randn(4, 4).astype("float32")
+        spd = a @ a.T + 4 * np.eye(4, dtype="float32")
+        L = np.linalg.cholesky(spd)
+        inv = _np(paddle.cholesky_inverse(_t(L)))
+        np.testing.assert_allclose(inv, np.linalg.inv(spd), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_lu_unpack(self):
+        rs = np.random.RandomState(6)
+        a = rs.randn(4, 4).astype("float32")
+        lu_t, piv = paddle.linalg.lu(_t(a))
+        p, lo, up = paddle.lu_unpack(lu_t, piv)
+        np.testing.assert_allclose(_np(p) @ _np(lo) @ _np(up), a, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_ormqr(self):
+        rs = np.random.RandomState(7)
+        a = rs.randn(4, 3).astype("float32")
+        import scipy.linalg as sl
+        raw, _r = sl.qr(a, mode='raw')  # ((qr, tau), r)
+        h, tau = raw
+        other = rs.randn(4, 2).astype("float32")
+        got = _np(paddle.ormqr(_t(h), _t(tau), _t(other)))
+        q = sl.qr(a)[0]  # full 4x4 Q, LAPACK ormqr semantics
+        np.testing.assert_allclose(got, q @ other, rtol=1e-4, atol=1e-4)
+        # right-multiply + transpose path
+        other_r = rs.randn(2, 4).astype("float32")
+        got_t = _np(paddle.ormqr(_t(h), _t(tau), _t(other_r), left=False,
+                                 transpose=True))
+        np.testing.assert_allclose(got_t, other_r @ q.T, rtol=1e-4, atol=1e-4)
+
+    def test_cdist(self):
+        rs = np.random.RandomState(8)
+        a = rs.randn(5, 3).astype("float32")
+        b = rs.randn(7, 3).astype("float32")
+        from scipy.spatial.distance import cdist as scdist
+        np.testing.assert_allclose(_np(paddle.cdist(_t(a), _t(b))),
+                                   scdist(a, b), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(
+            _np(paddle.cdist(_t(a), _t(b), p=1.0)),
+            scdist(a, b, metric='minkowski', p=1), rtol=1e-4, atol=1e-5)
+
+    def test_renorm(self):
+        x = np.array([[3.0, 0], [0, 10.0]], dtype="float32")
+        out = _np(paddle.renorm(_t(x), 2.0, 0, 5.0))
+        norms = np.linalg.norm(out, axis=1)
+        assert norms[0] == pytest.approx(3.0, rel=1e-4)
+        assert norms[1] == pytest.approx(5.0, rel=1e-3)
+
+    def test_svd_lowrank(self):
+        rs = np.random.RandomState(9)
+        base = rs.randn(8, 3).astype("float32")
+        a = base @ rs.randn(3, 6).astype("float32")  # rank 3
+        paddle.seed(0)
+        u, s, v = paddle.svd_lowrank(_t(a), q=3)
+        approx = _np(u) @ np.diag(_np(s)) @ _np(v).T
+        np.testing.assert_allclose(approx, a, rtol=1e-2, atol=1e-3)
+
+
+class TestSamplingAndInplace:
+    def test_top_p_sampling(self):
+        probs = np.array([[0.05, 0.05, 0.9], [0.5, 0.49, 0.01]],
+                         dtype="float32")
+        paddle.seed(4)
+        scores, ids = paddle.top_p_sampling(_t(probs),
+                                            _t(np.full((2, 1), 0.5, "float32")))
+        assert _np(ids).flatten()[0] == 2  # only token 2 is in the p=0.5 set
+        assert _np(ids).flatten()[1] in (0, 1)
+
+    def test_bulk_inplace_variants(self):
+        x = np.array([0.5, 1.0], dtype="float32")
+        t = _t(x); t.cos_()
+        np.testing.assert_allclose(_np(t), np.cos(x), rtol=1e-6)
+        t2 = _t(x); t2.log1p_()
+        np.testing.assert_allclose(_np(t2), np.log1p(x), rtol=1e-6)
+        t3 = _t(np.array([[1., 2.], [3., 4.]], dtype="float32")); t3.tril_()
+        np.testing.assert_allclose(_np(t3), np.tril([[1., 2.], [3., 4.]]))
+        t4 = _t(x); t4.square_()
+        np.testing.assert_allclose(_np(t4), x ** 2)
+
+    def test_inplace_keeps_autograd(self):
+        t = _t(np.array([1.0, 2.0], dtype="float32"))
+        t.stop_gradient = False
+        y = t * 2.0
+        y.tanh_()
+        y.sum().backward()
+        want = (1 - np.tanh([2.0, 4.0]) ** 2) * 2
+        np.testing.assert_allclose(_np(t.grad), want, rtol=1e-3)
+
+    def test_where_inplace_mutates_x_not_condition(self):
+        cond = _t(np.array([True, False]))
+        x = _t(np.array([1.0, 2.0], dtype="float32"))
+        y = _t(np.array([9.0, 9.0], dtype="float32"))
+        out = paddle.where_(cond, x, y)
+        assert out is x
+        np.testing.assert_allclose(_np(x), [1.0, 9.0])
+        np.testing.assert_array_equal(_np(cond), [True, False])  # untouched
+
+    def test_set_adopts_source_shape(self):
+        b = paddle.zeros([2, 2])
+        src = paddle.ones([3, 3])
+        b.set_(src)
+        assert list(b.shape) == [3, 3]
+        np.testing.assert_allclose(_np(b), np.ones((3, 3)))
+
+    def test_cauchy_geometric_fill(self):
+        paddle.seed(3)
+        t = paddle.zeros([1000]); t.cauchy_(loc=1.0, scale=2.0)
+        vals = _np(t)
+        assert np.isfinite(vals).all()
+        assert abs(np.median(vals) - 1.0) < 0.5  # median of cauchy = loc
+        g = paddle.zeros([1000]); g.geometric_(0.5)
+        gv = _np(g)
+        assert gv.min() >= 1 and abs(gv.mean() - 2.0) < 0.4  # mean = 1/p
