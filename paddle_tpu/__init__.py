@@ -60,7 +60,13 @@ from . import jit
 from . import metric
 from . import vision
 from . import distributed
-from . import linalg
+# NOTE: `from .ops import *` above leaked the ops.linalg SUBMODULE as the
+# `linalg` attribute, which makes `from . import linalg` short-circuit
+# (the import system skips the submodule load when the attr exists) —
+# force-load the real top-level namespace module instead.
+import importlib as _importlib
+
+linalg = _importlib.import_module(".linalg", __name__)
 from . import incubate
 from . import profiler
 from . import hapi

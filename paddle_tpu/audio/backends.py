@@ -44,7 +44,7 @@ def set_backend(backend_name):
     if backend_name != _BACKEND:
         raise NotImplementedError(
             f"only '{_BACKEND}' is available in this build (no external "
-            "audio libraries); got {backend_name!r}")
+            f"audio libraries); got {backend_name!r}")
 
 
 _WIDTH_DTYPE = {1: np.uint8, 2: np.int16, 4: np.int32}

@@ -14,24 +14,39 @@ __all__ = ['TESS', 'ESC50']
 
 
 class _AudioFolderDataset(Dataset):
-    """Walk a directory of WAV files, label from filename via _label_of."""
+    """Walk a directory of WAV files, label from filename via _label_of;
+    train/dev partitioning via _fold_of (reference datasets split by fold)."""
 
-    def __init__(self, data_dir, sample_rate, feat_type='raw', **feat_kwargs):
+    def __init__(self, data_dir, sample_rate, mode, n_folds, split,
+                 feat_type='raw', **feat_kwargs):
         if data_dir is None or not os.path.isdir(data_dir):
             raise ValueError(
                 f"{type(self).__name__}: data_dir with the extracted WAV "
                 "files is required (downloads unavailable in this build)")
-        self.files = []
+        if mode not in ('train', 'dev'):
+            raise ValueError(f"mode should be 'train' or 'dev', got {mode!r}")
+        all_files = []
         for root, _dirs, files in os.walk(data_dir):
             for fn in sorted(files):
                 if fn.lower().endswith('.wav'):
-                    self.files.append(os.path.join(root, fn))
-        if not self.files:
+                    all_files.append(os.path.join(root, fn))
+        if not all_files:
             raise ValueError(f"no .wav files under {data_dir}")
+        self.files = []
+        for i, path in enumerate(all_files):
+            fold = self._fold_of(path, i, n_folds)
+            in_dev = fold == split
+            if (mode == 'dev') == in_dev:
+                self.files.append(path)
         self.sample_rate = sample_rate
         self.feat_type = feat_type
         self.feat_kwargs = feat_kwargs
         self._extractor = None
+
+    def _fold_of(self, path, index, n_folds):
+        """Default fold assignment: stable round-robin by sorted position
+        (1-based, like the reference's fold column)."""
+        return index % n_folds + 1
 
     def _feature(self, wave):
         if self.feat_type == 'raw':
@@ -68,7 +83,8 @@ class TESS(_AudioFolderDataset):
 
     def __init__(self, data_dir=None, mode='train', n_folds=5, split=1,
                  feat_type='raw', **kwargs):
-        super().__init__(data_dir, 24414, feat_type, **kwargs)
+        super().__init__(data_dir, 24414, mode, n_folds, split, feat_type,
+                         **kwargs)
 
     def _label_of(self, path):
         token = os.path.basename(path).rsplit('.', 1)[0].split('_')[-1].lower()
@@ -83,7 +99,15 @@ class ESC50(_AudioFolderDataset):
 
     def __init__(self, data_dir=None, mode='train', split=1, feat_type='raw',
                  **kwargs):
-        super().__init__(data_dir, 44100, feat_type, **kwargs)
+        super().__init__(data_dir, 44100, mode, 5, split, feat_type, **kwargs)
+
+    def _fold_of(self, path, index, n_folds):
+        """ESC-50 filenames carry their fold: {fold}-{id}-{take}-{target}.wav."""
+        stem = os.path.basename(path).rsplit('.', 1)[0]
+        try:
+            return int(stem.split('-')[0])
+        except ValueError:
+            return index % n_folds + 1
 
     def _label_of(self, path):
         stem = os.path.basename(path).rsplit('.', 1)[0]

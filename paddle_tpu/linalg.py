@@ -5,3 +5,6 @@ from .ops.linalg import (  # noqa: F401
     matrix_power, matrix_rank, matrix_transpose, multi_dot, norm, pca_lowrank,
     pinv, qr, slogdet, solve, svd, svdvals, triangular_solve, vector_norm,
 )
+from .ops.extras import (  # noqa: F401 — reference linalg.py:58,78,80,92
+    cholesky_inverse, lu_unpack, ormqr, svd_lowrank,
+)

@@ -37,6 +37,20 @@ from .layer.transformer import (
     TransformerEncoder, TransformerEncoderLayer,
 )
 from .layer.rnn import GRU, GRUCell, LSTM, LSTMCell, SimpleRNN
+from .layer.container import ParameterDict
+from .layer.extended import (
+    Softmax2D, ChannelShuffle, ZeroPad1D, ZeroPad3D, Fold, Unfold,
+    PairwiseDistance, FeatureAlphaDropout,
+    LPPool1D, LPPool2D, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
+    FractionalMaxPool2D, FractionalMaxPool3D, Conv3DTranspose,
+    SoftMarginLoss, MultiLabelSoftMarginLoss, MultiMarginLoss,
+    PoissonNLLLoss, GaussianNLLLoss, TripletMarginWithDistanceLoss,
+    CTCLoss, RNNTLoss, HSigmoidLoss, AdaptiveLogSoftmaxWithLoss,
+    RNNCellBase, SimpleRNNCell, RNN, BiRNN,
+    BeamSearchDecoder, dynamic_decode,
+)
+
+Silu = SiLU  # both spellings are exported by the reference
 
 from ..core.tensor import Parameter
 

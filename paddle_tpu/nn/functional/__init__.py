@@ -24,10 +24,9 @@ def relu(x, name=None):
 
 
 def relu_(x, name=None):
-    out = relu(x)
-    x._assign_raw(out._data)
-    x._node, x._out_idx = out._node, out._out_idx
-    return x
+    from ...ops._helpers import inplace_variant
+
+    return inplace_variant(relu)(x)
 
 
 def relu6(x, name=None):
@@ -145,10 +144,9 @@ def softmax(x, axis=-1, dtype=None, name=None):
 
 
 def softmax_(x, axis=-1, dtype=None, name=None):
-    out = softmax(x, axis, dtype)
-    x._assign_raw(out._data)
-    x._node, x._out_idx = out._node, out._out_idx
-    return x
+    from ...ops._helpers import inplace_variant
+
+    return inplace_variant(softmax)(x, axis, dtype)
 
 
 def log_softmax(x, axis=-1, dtype=None, name=None):
@@ -526,6 +524,8 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
     p = _pair(padding, 2)
     dil = _pair(dilation, 2)
 
+    op_h, op_w = _pair(output_padding, 2)
+
     def f(a, w, *b):
         # weight layout [in, out/groups, kh, kw] (paddle conv_transpose)
         wt = jnp.swapaxes(w, 0, 1)  # -> [out/groups, in, kh, kw]
@@ -537,7 +537,7 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
             a.shape, wt.shape, ("NCHW", "OIHW", "NCHW"))
         out = jax.lax.conv_general_dilated(
             a, wt, window_strides=(1, 1),
-            padding=[(pad_h, pad_h + output_padding), (pad_w, pad_w + output_padding)],
+            padding=[(pad_h, pad_h + op_h), (pad_w, pad_w + op_w)],
             lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
             feature_group_count=groups)
         if b:
@@ -973,8 +973,33 @@ def log_loss(input, label, epsilon=1e-4, name=None):
         input, label, name="log_loss")
 
 
-def ctc_loss(*args, **kwargs):
-    raise NotImplementedError("ctc_loss: planned (optax.ctc_loss wrapper)")
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction='mean', norm_by_times=False, name=None):
+    """CTC loss (≙ phi warpctc wrapper, functional/loss.py ctc_loss) over
+    optax's lax.scan alpha recursion — one compiled DP loop on TPU.
+
+    log_probs: [max_T, B, n_class] (paddle layout), labels: [B, max_U]."""
+    import optax as _optax
+
+    def f(lp, y, tl, ul):
+        logits = jnp.swapaxes(lp, 0, 1)              # → [B, T, C]
+        T = logits.shape[1]
+        U = y.shape[1]
+        logit_pad = (jnp.arange(T)[None, :] >= tl[:, None]).astype(jnp.float32)
+        label_pad = (jnp.arange(U)[None, :] >= ul[:, None]).astype(jnp.float32)
+        losses = _optax.ctc_loss(logits, logit_pad, y, label_pad,
+                                 blank_id=blank)
+        if norm_by_times:
+            losses = losses / jnp.maximum(tl, 1).astype(losses.dtype)
+        if reduction == 'mean':
+            # paddle mean mode divides per-sample loss by label length first
+            return jnp.mean(losses / jnp.maximum(ul, 1).astype(losses.dtype))
+        if reduction == 'sum':
+            return jnp.sum(losses)
+        return losses
+
+    return op_call(f, log_probs, labels, input_lengths, label_lengths,
+                   name="ctc_loss")
 
 
 # ------------------------------------------------------------------ attention
@@ -1072,3 +1097,22 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
         return loss_ce + reg
 
     return op_call(f, anchor, positive, labels, name="npair_loss", n_diff=2)
+
+
+# ---------------------------------------------------------------- extended set
+# (long-tail surface parity — see extended.py for the implementations)
+from .extended import (  # noqa: F401,E402
+    log_sigmoid, thresholded_relu, thresholded_relu_, tanh_, elu_,
+    leaky_relu_, hardtanh_,
+    channel_shuffle, zeropad2d, pairwise_distance, feature_alpha_dropout,
+    fold, lp_pool1d, lp_pool2d, max_unpool1d, max_unpool2d, max_unpool3d,
+    fractional_max_pool2d, fractional_max_pool3d,
+    conv1d_transpose, conv3d_transpose,
+    affine_grid, grid_sample,
+    dice_loss, soft_margin_loss, multi_label_soft_margin_loss,
+    multi_margin_loss, poisson_nll_loss, gaussian_nll_loss,
+    triplet_margin_with_distance_loss, hsigmoid_loss,
+    adaptive_log_softmax_with_loss, margin_cross_entropy, rnnt_loss,
+    gather_tree, flash_attn_qkvpacked, flash_attn_varlen_qkvpacked,
+    flashmask_attention, sparse_attention,
+)

@@ -1,0 +1,874 @@
+"""Extended functionals closing the paddle.nn.functional surface gap
+(≙ python/paddle/nn/functional/__init__.py entries: activations, padding,
+pooling extras, vision sampling, the long-tail loss zoo, sequence decode
+utilities; kernels: assorted phi cpu/gpu + fused ops).
+
+Everything is a jnp/lax composition traced through op_call — XLA fuses the
+elementwise chains; the samplers are gathers; the DP losses (ctc via optax,
+rnnt via a lax.scan grid) compile to single fused loops on TPU.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op_call
+from ...core.rng import next_key
+from ...core.tensor import Tensor
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+# ----------------------------------------------------------------- activations
+def log_sigmoid(x, name=None):
+    return op_call(jax.nn.log_sigmoid, x, name="log_sigmoid")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return op_call(lambda a: jnp.where(a > threshold, a, value), x,
+                   name="thresholded_relu")
+
+
+from ...ops._helpers import inplace_variant as _inplace_variant  # noqa: E402
+
+thresholded_relu_ = _inplace_variant(thresholded_relu)
+
+
+def _late_inplace(fn_name):
+    """In-place twin of a functional defined in __init__ (resolved lazily to
+    dodge the import cycle). Uses ops._helpers.inplace_variant, which swaps
+    a shadow alias into the recorded node so the tape keeps the
+    pre-mutation producer link (no self-loop, grads flow)."""
+
+    def op_(x, *args, **kwargs):
+        import paddle_tpu.nn.functional as _F
+
+        return _inplace_variant(getattr(_F, fn_name))(x, *args, **kwargs)
+
+    op_.__name__ = fn_name + "_"
+    return op_
+
+
+tanh_ = _late_inplace("tanh")
+elu_ = _late_inplace("elu")
+leaky_relu_ = _late_inplace("leaky_relu")
+hardtanh_ = _late_inplace("hardtanh")
+
+
+# ------------------------------------------------------------ shapes / padding
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    """≙ phi channel_shuffle_kernel."""
+    if x.ndim != 4:
+        raise ValueError("channel_shuffle expects a 4-D tensor")
+    c_ax = 1 if data_format == "NCHW" else 3
+    c = x.shape[c_ax]
+    if c % groups:
+        raise ValueError(f"channels {c} not divisible by groups {groups}")
+
+    def f(a):
+        if data_format == "NCHW":
+            n, _, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            a = jnp.swapaxes(a, 1, 2)
+            return a.reshape(n, c, h, w)
+        n, h, w, _ = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        a = jnp.swapaxes(a, 3, 4)
+        return a.reshape(n, h, w, c)
+
+    return op_call(f, x, name="channel_shuffle")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    pl, pr, pt, pb = _pair(padding, 4)
+
+    def f(a):
+        if data_format == "NCHW":
+            cfg = [(0, 0), (0, 0), (pt, pb), (pl, pr)]
+        else:
+            cfg = [(0, 0), (pt, pb), (pl, pr), (0, 0)]
+        return jnp.pad(a, cfg)
+
+    return op_call(f, x, name="zeropad2d")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+
+    return op_call(f, x, y, name="pairwise_distance")
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout over whole channel maps (SELU-preserving statistics;
+    ≙ functional/common.py feature_alpha_dropout)."""
+    if not training or p == 0.0:
+        return x
+    if not 0 <= p < 1:
+        raise ValueError(f"p must be in [0,1), got {p}")
+    alpha_p = -1.7580993408473766  # -scale*alpha of SELU
+    a = (1 - p + p * alpha_p ** 2 * (1 - p)) ** -0.5
+    b = -a * alpha_p * p
+    key = next_key()
+
+    def f(v):
+        shape = (v.shape[0], v.shape[1]) + (1,) * (v.ndim - 2)
+        keep = jax.random.bernoulli(key, 1 - p, shape)
+        return a * jnp.where(keep, v, alpha_p) + b
+
+    return op_call(f, x, name="feature_alpha_dropout")
+
+
+# ----------------------------------------------------------------- fold / pool
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """Col2im — inverse of unfold (≙ phi fold_kernel). x: [N, C·kh·kw, L]."""
+    H, W = _pair(output_sizes, 2)
+    kh, kw = _pair(kernel_sizes, 2)
+    sh, sw = _pair(strides, 2)
+    ph, pw = _pair(paddings, 2)
+    dh, dw = _pair(dilations, 2)
+    oh = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    L = oh * ow
+    if x.shape[-1] != L:
+        raise ValueError(f"fold: expected L={L} windows, got {x.shape[-1]}")
+    # static index map [kh*kw, L] into padded (H+2ph, W+2pw) flat space
+    ky, kx = np.meshgrid(np.arange(kh), np.arange(kw), indexing="ij")
+    oy, ox = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+    yy = (oy.reshape(-1)[None, :] * sh + (ky.reshape(-1) * dh)[:, None])
+    xx = (ox.reshape(-1)[None, :] * sw + (kx.reshape(-1) * dw)[:, None])
+    flat = (yy * (W + 2 * pw) + xx).reshape(-1)
+
+    def f(a):
+        n = a.shape[0]
+        c = a.shape[1] // (kh * kw)
+        cols = a.reshape(n, c, kh * kw * L)
+        canvas = jnp.zeros((n, c, (H + 2 * ph) * (W + 2 * pw)), a.dtype)
+        canvas = canvas.at[:, :, jnp.asarray(flat)].add(cols)
+        canvas = canvas.reshape(n, c, H + 2 * ph, W + 2 * pw)
+        return canvas[:, :, ph:ph + H, pw:pw + W]
+
+    return op_call(f, x, name="fold")
+
+
+def _lp_pool(x, norm_type, kernel, stride, padding, nd, ceil_mode, data_format):
+    from . import avg_pool1d, avg_pool2d
+
+    p = float(norm_type)
+    if math.isinf(p):
+        from . import max_pool1d, max_pool2d
+
+        mp = max_pool1d if nd == 1 else max_pool2d
+        return mp(x, kernel, stride, padding, ceil_mode=ceil_mode)
+    ap = avg_pool1d if nd == 1 else avg_pool2d
+    powed = op_call(lambda a: jnp.abs(a) ** p, x, name="lp_pow")
+    avg = ap(powed, kernel, stride, padding, ceil_mode=ceil_mode,
+             exclusive=False)
+    count = int(np.prod(_pair(kernel, nd)))
+    return op_call(lambda a: (a * count) ** (1.0 / p), avg, name="lp_root")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, 1, ceil_mode,
+                    data_format)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, 2, ceil_mode,
+                    data_format)
+
+
+def _max_unpool(x, indices, nd, kernel_size, stride, padding, output_size,
+                data_format, opname):
+    ks = _pair(kernel_size, nd)
+    st = _pair(stride if stride is not None else kernel_size, nd)
+    pd = _pair(padding, nd)
+    in_spatial = tuple(x.shape[2:])
+    if output_size is None:
+        output_size = tuple(
+            (in_spatial[i] - 1) * st[i] - 2 * pd[i] + ks[i] for i in range(nd))
+    else:
+        output_size = tuple(output_size)[-nd:]
+    flat_out = int(np.prod(output_size))
+
+    def f(a, idx):
+        n, c = a.shape[0], a.shape[1]
+        av = a.reshape(n, c, -1)
+        iv = idx.reshape(n, c, -1).astype(jnp.int32)
+        canvas = jnp.zeros((n, c, flat_out), a.dtype)
+        canvas = jax.vmap(jax.vmap(
+            lambda cv, ii, vv: cv.at[ii].set(vv)))(canvas, iv, av)
+        return canvas.reshape((n, c) + output_size)
+
+    return op_call(f, x, indices, name=opname, n_diff=1)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size, data_format, "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size, data_format, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size, data_format, "max_unpool3d")
+
+
+def _fractional_bounds(in_size, out_size, u):
+    """Static window boundaries for fractional max pooling (Graham 2014):
+    b_i = ceil(alpha·(i+u)), pinned so b_0=0? — use the floor variant with
+    guaranteed coverage."""
+    alpha = in_size / out_size
+    idx = np.arange(out_size + 1)
+    b = np.floor(alpha * (idx + u)).astype(np.int64) - int(np.floor(alpha * u))
+    b[0], b[-1] = 0, in_size
+    b = np.clip(b, 0, in_size)
+    for i in range(1, len(b)):  # enforce monotone, nonempty windows
+        if b[i] <= b[i - 1]:
+            b[i] = min(b[i - 1] + 1, in_size)
+    return b
+
+
+def _fractional_pool(x, nd, output_size, kernel_size, random_u, opname,
+                     return_mask=False):
+    out_sz = _pair(output_size, nd)
+    spatial = tuple(x.shape[2:])
+    if random_u is None:
+        u = float(jax.random.uniform(next_key(), ()))
+    else:
+        u = float(random_u)
+        if not 0 < u < 1:
+            raise ValueError(f"random_u must be in (0,1), got {random_u}")
+    bounds = [_fractional_bounds(spatial[i], out_sz[i], u) for i in range(nd)]
+    kmax = [int((b[1:] - b[:-1]).max()) for b in bounds]
+
+    # per-dim gather indices [out, kmax] with validity mask beyond window end
+    gidx, gmask = [], []
+    for d in range(nd):
+        b = bounds[d]
+        starts = b[:-1]
+        lens = b[1:] - b[:-1]
+        idx = starts[:, None] + np.arange(kmax[d])[None, :]
+        mask = np.arange(kmax[d])[None, :] < lens[:, None]
+        gidx.append(np.clip(idx, 0, spatial[d] - 1))
+        gmask.append(mask)
+
+    def f(a):
+        # joint window gather: each spatial dim expands to (out_d, k_d)
+        out = a
+        for d in range(nd):
+            ax = 2 + 2 * d  # dims before this one already expanded to pairs
+            g = jnp.take(out, jnp.asarray(gidx[d].reshape(-1)), axis=ax)
+            out = g.reshape(out.shape[:ax] + (out_sz[d], kmax[d])
+                            + out.shape[ax + 1:])
+        # reorder [N,C, o1,k1, o2,k2, ...] → [N,C, o1,o2,..., k1,k2,...]
+        perm = [0, 1] + [2 + 2 * d for d in range(nd)] \
+            + [3 + 2 * d for d in range(nd)]
+        out = jnp.transpose(out, perm)
+        # outer product of per-dim validity masks → [o1..ond, k1..knd]
+        full_mask = np.einsum(
+            *sum(([gmask[d], [d, nd + d]] for d in range(nd)), []),
+            range(2 * nd)).astype(bool)
+        mshape = (1, 1) + tuple(out_sz) + tuple(kmax)
+        out = jnp.where(jnp.asarray(full_mask).reshape(mshape), out, -jnp.inf)
+        flatk = out.reshape(out.shape[:2 + nd] + (-1,))
+        vals = jnp.max(flatk, axis=-1)
+        if not return_mask:
+            return vals
+        arg = jnp.argmax(flatk, axis=-1)
+        # decode joint k-offset → absolute per-dim index → flat spatial index
+        flat_idx = jnp.zeros(arg.shape, jnp.int32)
+        rem = arg
+        for d in range(nd - 1, -1, -1):
+            off = rem % kmax[d]
+            rem = rem // kmax[d]
+            osh = [1] * arg.ndim
+            osh[2 + d] = out_sz[d]
+            starts_d = jnp.asarray(bounds[d][:-1].astype(np.int32)).reshape(osh)
+            absolute = starts_d + off.astype(jnp.int32)
+            stride = int(np.prod(spatial[d + 1:], initial=1))
+            flat_idx = flat_idx + absolute * stride
+        return vals, flat_idx
+
+    return op_call(f, x, name=opname)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_pool(x, 2, output_size, kernel_size, random_u,
+                            "fractional_max_pool2d", return_mask)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_pool(x, 3, output_size, kernel_size, random_u,
+                            "fractional_max_pool3d", return_mask)
+
+
+# -------------------------------------------------------- transposed convs
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCL", output_size=None, name=None):
+    """1-D transposed conv via the 2-D path on a height-1 image."""
+    from . import conv2d_transpose
+    from ...ops.manipulation import squeeze, unsqueeze
+
+    if data_format not in ("NCL", "NLC"):
+        raise ValueError(f"bad data_format {data_format}")
+    xin = x if data_format == "NCL" else x.transpose([0, 2, 1])
+    x4 = unsqueeze(xin, 2)            # [N, C, 1, L]
+    w4 = unsqueeze(weight, 2)         # [in, out/g, 1, k]
+    out = conv2d_transpose(x4, w4, bias, (1, _pair(stride, 1)[0]),
+                           (0, _pair(padding, 1)[0]),
+                           (0, _pair(output_padding, 1)[0]), groups,
+                           (1, _pair(dilation, 1)[0]), "NCHW")
+    out = squeeze(out, 2)
+    return out if data_format == "NCL" else out.transpose([0, 2, 1])
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW", output_size=None, name=None):
+    strides = _pair(stride, 3)
+    p = _pair(padding, 3)
+    dil = _pair(dilation, 3)
+    opad = _pair(output_padding, 3)
+
+    def f(a, w, *b):
+        wt = jnp.swapaxes(w, 0, 1)
+        wt = jnp.flip(wt, axis=(-3, -2, -1))
+        pads = []
+        for i in range(3):
+            k = w.shape[2 + i]
+            lo = dil[i] * (k - 1) - p[i]
+            pads.append((lo, lo + opad[i]))
+        dn = jax.lax.conv_dimension_numbers(
+            a.shape, wt.shape, ("NCDHW", "OIDHW", "NCDHW"))
+        out = jax.lax.conv_general_dilated(
+            a, wt, window_strides=(1, 1, 1), padding=pads,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups)
+        if b:
+            out = out + b[0].reshape(1, -1, 1, 1, 1)
+        return out
+
+    if data_format == "NDHWC":
+        from ...ops.manipulation import transpose as _tp
+
+        out = conv3d_transpose(_tp(x, [0, 4, 1, 2, 3]), weight, bias, stride,
+                               padding, output_padding, groups, dilation,
+                               "NCDHW", output_size)
+        return _tp(out, [0, 2, 3, 4, 1])
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return op_call(f, *args, name="conv3d_transpose")
+
+
+# ------------------------------------------------------------ vision sampling
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2-D affine sampling grid (≙ phi affine_grid_kernel).
+    theta [N,2,3] → grid [N,H,W,2] in [-1,1]."""
+    n, _c, h, w = [int(s) for s in out_shape]
+
+    def base(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    def f(t):
+        ys, xs = base(h), base(w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx, gy, ones], axis=-1)      # [H,W,3]
+        out = jnp.einsum("hwk,nik->nhwi", coords, t)     # [N,H,W,2]
+        return out.astype(t.dtype)
+
+    return op_call(f, theta, name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """2-D grid sampler (≙ phi grid_sample_kernel): bilinear/nearest with
+    zeros/border/reflection padding — gathers + weighted sums, which XLA
+    lowers efficiently on TPU."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"bad mode {mode}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"bad padding_mode {padding_mode}")
+
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+
+        def unnorm(v, size):
+            if align_corners:
+                return (v + 1) / 2 * (size - 1)
+            return ((v + 1) * size - 1) / 2
+
+        ix, iy = unnorm(gx, w), unnorm(gy, h)
+
+        def reflect(v, size):
+            if align_corners:
+                span = 2 * (size - 1)
+                v = jnp.abs(jnp.mod(v, span))
+                return jnp.where(v > size - 1, span - v, v)
+            span = 2 * size
+            v = jnp.mod(v + 0.5, span)
+            v = jnp.abs(v) - 0.5
+            v = jnp.where(v > size - 0.5, span - 1 - v - 0.5, v)
+            return jnp.clip(v, 0, size - 1)
+
+        if padding_mode == "reflection":
+            ix, iy = reflect(ix, w), reflect(iy, h)
+
+        def sample(yi, xi):
+            # integer gather with clamping; mask handles 'zeros'
+            yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            # batched gather: a [N,C,H,W], idx [N,Ho,Wo] → [N,C,Ho,Wo]
+            out = jax.vmap(lambda av, yv, xv: av[:, yv, xv])(a, yc, xc)
+            if padding_mode == "zeros":
+                inb = ((yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1))
+                out = out * inb[:, None, :, :].astype(a.dtype)
+            return out
+
+        if mode == "nearest":
+            return sample(jnp.round(iy), jnp.round(ix))
+
+        x0, y0 = jnp.floor(ix), jnp.floor(iy)
+        x1, y1 = x0 + 1, y0 + 1
+        wa = (x1 - ix) * (y1 - iy)
+        wb = (ix - x0) * (y1 - iy)
+        wc = (x1 - ix) * (iy - y0)
+        wd = (ix - x0) * (iy - y0)
+        va = sample(y0, x0)
+        vb = sample(y0, x1)
+        vc = sample(y1, x0)
+        vd = sample(y1, x1)
+        wexp = lambda wv: wv[:, None, :, :].astype(a.dtype)
+        return va * wexp(wa) + vb * wexp(wb) + vc * wexp(wc) + vd * wexp(wd)
+
+    return op_call(f, x, grid, name="grid_sample")
+
+
+# ------------------------------------------------------------------ loss zoo
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    if reduction == "none":
+        return v
+    raise ValueError(f"bad reduction {reduction}")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """≙ functional/loss.py dice_loss: input [N,...,C] probs, label
+    [N,...,1] int."""
+    nc = input.shape[-1]
+
+    def f(p, y):
+        oh = jax.nn.one_hot(y[..., 0], nc, dtype=p.dtype)
+        dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * oh, dims)
+        union = jnp.sum(p, dims) + jnp.sum(oh, dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return op_call(f, input, label, name="dice_loss", n_diff=1)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def f(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y.astype(x.dtype) * x)), reduction)
+
+    return op_call(f, input, label, name="soft_margin_loss", n_diff=1)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    def f(x, y, *wt):
+        yf = y.astype(x.dtype)
+        term = yf * jax.nn.log_sigmoid(x) + (1 - yf) * jax.nn.log_sigmoid(-x)
+        if wt:
+            term = term * wt[0]
+        return _reduce(-jnp.mean(term, axis=-1), reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return op_call(f, *args, name="multi_label_soft_margin_loss", n_diff=1)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    def f(x, y, *wt):
+        n, c = x.shape
+        tgt = jnp.take_along_axis(x, y[:, None], axis=1)
+        m = jnp.maximum(0, margin - tgt + x) ** p
+        if wt:
+            m = m * wt[0][y][:, None]
+        m = m.at[jnp.arange(n), y].set(0)
+        return _reduce(jnp.sum(m, 1) / c, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return op_call(f, *args, name="multi_margin_loss", n_diff=1)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(x, y):
+        yf = y.astype(x.dtype)
+        if log_input:
+            loss = jnp.exp(x) - yf * x
+        else:
+            loss = x - yf * jnp.log(x + epsilon)
+        if full:
+            stirling = yf * jnp.log(jnp.maximum(yf, 1.0)) - yf + \
+                0.5 * jnp.log(2 * jnp.pi * jnp.maximum(yf, 1.0))
+            loss = loss + jnp.where(yf > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return op_call(f, input, label, name="poisson_nll_loss", n_diff=1)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def f(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y.astype(mu.dtype) - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * math.log(2 * math.pi)
+        return _reduce(loss, reduction)
+
+    return op_call(f, input, label, variance, name="gaussian_nll_loss",
+                   n_diff=1)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    if distance_function is not None:
+        d_ap = distance_function(input, positive)
+        d_an = distance_function(input, negative)
+        if swap:
+            d_pn = distance_function(positive, negative)
+            d_an = op_call(lambda a, b: jnp.minimum(a, b), d_an, d_pn,
+                           name="tm_swap")
+        return op_call(lambda ap, an: _reduce(
+            jnp.maximum(ap - an + margin, 0), reduction), d_ap, d_an,
+            name="triplet_margin_with_distance_loss")
+
+    def f(a, p, n):
+        d_ap = jnp.linalg.norm(a - p, axis=-1)
+        d_an = jnp.linalg.norm(a - n, axis=-1)
+        if swap:
+            d_an = jnp.minimum(d_an, jnp.linalg.norm(p - n, axis=-1))
+        return _reduce(jnp.maximum(d_ap - d_an + margin, 0), reduction)
+
+    return op_call(f, input, positive, negative,
+                   name="triplet_margin_with_distance_loss")
+
+
+def _default_tree_paths(num_classes):
+    """Complete-binary-tree codes for default hsigmoid (heap layout: leaf c
+    sits at heap position c + num_classes - 1; internal nodes 0..C-2)."""
+    depth = max(1, int(np.ceil(np.log2(max(num_classes, 2)))) + 1)
+    table = np.full((num_classes, depth), -1, dtype=np.int64)
+    code = np.zeros((num_classes, depth), dtype=np.float32)
+    for cidx in range(num_classes):
+        pos = cidx + num_classes - 1
+        path = []
+        while pos > 0:
+            parent = (pos - 1) // 2
+            path.append((parent, 1.0 if pos == 2 * parent + 2 else 0.0))
+            pos = parent
+        for d, (node, bit) in enumerate(reversed(path)):
+            table[cidx, d] = node
+            code[cidx, d] = bit
+    return table, code
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (≙ phi hsigmoid_loss_kernel): default
+    complete-binary-tree coding or custom (path_table, path_code)."""
+    if path_table is None:
+        tbl, code = _default_tree_paths(num_classes)
+        tbl_t, code_t = jnp.asarray(tbl), jnp.asarray(code)
+    else:
+        tbl_t = path_table._data if hasattr(path_table, "_data") else jnp.asarray(path_table)
+        code_t = path_code._data if hasattr(path_code, "_data") else jnp.asarray(path_code)
+        code_t = code_t.astype(jnp.float32)
+
+    def f(x, y, w, *b):
+        nodes = tbl_t[y]                      # [N, D]
+        codes = code_t[y]                     # [N, D]
+        valid = (nodes >= 0).astype(x.dtype)
+        safe_nodes = jnp.maximum(nodes, 0)
+        wn = w[safe_nodes]                    # [N, D, F]
+        logits = jnp.einsum("nf,ndf->nd", x, wn)
+        if b:
+            logits = logits + b[0].reshape(-1)[safe_nodes]
+        # label bit 1 → sigmoid(logit), 0 → 1-sigmoid  (BCE per node)
+        lose = -(codes * jax.nn.log_sigmoid(logits)
+                 + (1 - codes) * jax.nn.log_sigmoid(-logits))
+        return jnp.sum(lose * valid, axis=1, keepdims=True)
+
+    args = [input, label, weight] + ([bias] if bias is not None else [])
+    return op_call(f, *args, name="hsigmoid_loss")
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Efficient softmax approximation (≙ functional/loss.py
+    adaptive_log_softmax_with_loss): head covers the shortlist
+    [0, cutoffs[0]) plus one logit per tail cluster; each tail is a
+    (projection, cluster-word) factorized matmul pair. Returns
+    (per-sample target logprob, mean loss). Grads flow to input, head and
+    every tail weight (int labels are naturally non-differentiable)."""
+    cutoffs = list(cutoffs)
+    shortlist = cutoffs[0]
+    n_tails = len(tail_weights)
+    has_bias = head_bias is not None
+
+    def f(x, y, hw, *rest):
+        hb = rest[2 * n_tails] if has_bias else None
+        head_logits = x @ hw
+        if hb is not None:
+            head_logits = head_logits + hb
+        head_lsm = jax.nn.log_softmax(head_logits, axis=-1)
+        out = jnp.take_along_axis(
+            head_lsm, jnp.clip(y, 0, shortlist - 1)[:, None], axis=1)[:, 0]
+        result = jnp.where(y < shortlist, out, 0.0)
+        lo = shortlist
+        for i in range(n_tails):
+            proj, cls_w = rest[2 * i], rest[2 * i + 1]
+            hi = lo + cls_w.shape[-1]
+            in_cluster = (y >= lo) & (y < hi)
+            tail_lsm = jax.nn.log_softmax((x @ proj) @ cls_w, axis=-1)
+            rel = jnp.clip(y - lo, 0, cls_w.shape[-1] - 1)
+            contrib = head_lsm[:, shortlist + i] + jnp.take_along_axis(
+                tail_lsm, rel[:, None], axis=1)[:, 0]
+            result = jnp.where(in_cluster, contrib, result)
+            lo = hi
+        return result, -jnp.mean(result)
+
+    args = [input, label, head_weight]
+    for tw in tail_weights:
+        args.extend(tw)
+    if has_bias:
+        args.append(head_bias)
+    return op_call(f, *args, name="adaptive_log_softmax_with_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean", name=None):
+    """ArcFace/CosFace-style margin softmax (≙ phi margin_cross_entropy)."""
+
+    def f(lg, y):
+        cos = jnp.clip(lg, -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        tgt = jnp.cos(margin1 * theta + margin2) - margin3
+        oh = jax.nn.one_hot(y, lg.shape[-1], dtype=lg.dtype)
+        out = jnp.where(oh > 0, tgt, cos) * scale
+        lsm = jax.nn.log_softmax(out, axis=-1)
+        loss = -jnp.sum(oh * lsm, axis=-1, keepdims=True)
+        if reduction == "mean":
+            loss = jnp.mean(loss)
+        elif reduction == "sum":
+            loss = jnp.sum(loss)
+        if return_softmax:
+            return loss, jnp.exp(lsm)
+        return loss
+
+    return op_call(f, logits, label, name="margin_cross_entropy", n_diff=1)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T transducer loss (≙ phi warprnnt wrapper): log-domain alpha
+    recursion over the (T,U) lattice as a lax.scan over T with a row scan
+    over U — one compiled loop, batched via vmap."""
+
+    def f(lp, y, tl, ul):
+        logp = jax.nn.log_softmax(lp, axis=-1)   # [B,T,U+1,V]
+        B, T, U1, _V = logp.shape
+
+        def one(lpb, yb, tb, ub):
+            blank_lp = lpb[:, :, blank]                       # [T,U+1]
+            lab_lp = jnp.take_along_axis(
+                lpb[:, :-1, :], yb[None, :, None], axis=2)[..., 0]  # [T,U]
+
+            neg = -1e30
+
+            def row(prev_alpha, t):
+                # alpha over u for this t given alpha(t-1, ·)
+                from_top = jnp.where(t == 0,
+                                     jnp.where(jnp.arange(U1) == 0, 0.0, neg),
+                                     prev_alpha + blank_lp[jnp.maximum(t - 1, 0)])
+
+                def cell(carry, u):
+                    left = jnp.where(
+                        u == 0, neg,
+                        carry + lab_lp[t, jnp.maximum(u - 1, 0)])
+                    a = jnp.logaddexp(from_top[u], left)
+                    a = jnp.where((t == 0) & (u == 0), 0.0, a)
+                    return a, a
+
+                _, alpha_row = jax.lax.scan(cell, neg, jnp.arange(U1))
+                return alpha_row, alpha_row
+
+            _, rows = jax.lax.scan(row, jnp.full((U1,), neg), jnp.arange(T))
+            # total logprob: alpha(tl-1, ul) + emit-blank at (tl-1, ul)
+            a_final = rows[tb - 1, ub]
+            return -(a_final + blank_lp[tb - 1, ub])
+
+        losses = jax.vmap(one)(logp, y, tl, ul)
+        if reduction == "mean":
+            return jnp.mean(losses)
+        if reduction == "sum":
+            return jnp.sum(losses)
+        return losses
+
+    return op_call(f, input, label, input_lengths, label_lengths,
+                   name="rnnt_loss", n_diff=1)
+
+
+# --------------------------------------------------------------- seq decode
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace (≙ phi gather_tree_kernel): ids/parents
+    [T, B, beam] → full beam paths."""
+
+    def f(iv, pv):
+        T = iv.shape[0]
+
+        def step(next_beams, t):
+            # next_beams: [B, beam] — beam index each path occupies at t+1
+            cur = jnp.take_along_axis(iv[t], next_beams, axis=-1)
+            par = jnp.take_along_axis(pv[t], next_beams, axis=-1)
+            return par, cur
+
+        init = jnp.broadcast_to(jnp.arange(iv.shape[-1]), iv.shape[1:])
+        _, rev = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return jnp.flip(rev, 0)
+
+    return op_call(f, ids, parents, name="gather_tree", n_diff=0)
+
+
+# ------------------------------------------------------- attention wrappers
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         training=True, name=None):
+    """Packed-QKV flash attention (≙ nn/functional/flash_attention.py
+    flash_attn_qkvpacked): qkv [B, S, 3, H, D]."""
+    from . import scaled_dot_product_attention
+
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    out = scaled_dot_product_attention(q, k, v, None, dropout, causal,
+                                       training)
+    return (out, None) if return_softmax else (out, None)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                                max_seqlen_k, scale=None, dropout=0.0,
+                                causal=False, return_softmax=False,
+                                training=True, name=None):
+    """Varlen packed flash attention: total-token layout [total, 3, H, D]
+    with cu_seqlens boundaries. Lowered to a padded batch + mask (XLA needs
+    static shapes; padding to max_seqlen is the TPU-native strategy)."""
+    from . import scaled_dot_product_attention
+
+    cu = np.asarray(cu_seqlens_q._data if hasattr(cu_seqlens_q, "_data")
+                    else cu_seqlens_q)
+    lens = (cu[1:] - cu[:-1]).tolist()
+    b = len(lens)
+    s = int(max_seqlen_q)
+    outs = []
+    for i in range(b):
+        seg = qkv[int(cu[i]):int(cu[i + 1])]
+        q, k, v = seg[:, 0], seg[:, 1], seg[:, 2]
+        o = scaled_dot_product_attention(
+            q.unsqueeze(0), k.unsqueeze(0), v.unsqueeze(0), None, dropout,
+            causal, training)
+        outs.append(o.squeeze(0))
+    from ...ops.manipulation import concat
+
+    return concat(outs, axis=0), None
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, name=None):
+    """FlashMask sparse-causal attention (≙ flashmask_attention,
+    nn/functional/flash_attention.py): the row-index mask is expanded to a
+    dense additive mask, then fused by XLA. startend_row_indices
+    [B, H, S, 1] (causal LTS form): key column j masked for query rows
+    i >= start[j]."""
+    from . import scaled_dot_product_attention
+
+    if startend_row_indices is None:
+        return scaled_dot_product_attention(query, key, value, None, dropout,
+                                            causal)
+    s = query.shape[1]
+
+    def build(idx):
+        rows = jnp.arange(s)[None, None, :, None]     # query rows
+        start = jnp.swapaxes(idx, 2, 3)               # [B,H,1,S] per-column
+        mask = rows >= start                          # True → blocked
+        return jnp.where(mask, -jnp.inf, 0.0)
+
+    amask = op_call(build, startend_row_indices, name="flashmask_build",
+                    n_diff=0)
+    return scaled_dot_product_attention(query, key, value, amask, dropout,
+                                        causal)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention (≙ phi sparse_attention CUDA kernel). The
+    TPU-native path materializes the CSR pattern as an additive mask and
+    rides the fused softmax — correct semantics; the Pallas splash kernel
+    is the perf path for large S."""
+    s_q = query.shape[2]
+    s_k = key.shape[2]
+
+    def f(q, k, v, off, cols):
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(q.shape[-1])
+        # dense mask from CSR (pure jnp): nnz j belongs to the row whose
+        # offset window contains it
+        B, H = q.shape[0], q.shape[1]
+        mask = jnp.zeros((B, H, s_q, s_k), bool)
+        max_nnz = cols.shape[-1]
+
+        def fill(m_bh, off_bh, cols_bh):
+            rows = jnp.searchsorted(off_bh, jnp.arange(max_nnz), side="right") - 1
+            return m_bh.at[rows, cols_bh].set(True)
+
+        mask = jax.vmap(jax.vmap(fill))(mask, off, cols)
+        scores = jnp.where(mask, scores, -jnp.inf)
+        att = jax.nn.softmax(scores, axis=-1)
+        att = jnp.where(jnp.isnan(att), 0.0, att)
+        return jnp.einsum("bhqk,bhkd->bhqd", att, v)
+
+    return op_call(f, query, key, value, sparse_csr_offset,
+                   sparse_csr_columns, name="sparse_attention", n_diff=3)

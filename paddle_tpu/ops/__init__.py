@@ -225,15 +225,15 @@ _fill_inplace_random("cauchy_", _cauchy_sample)
 _fill_inplace_random("geometric_", _geometric_sample)
 
 
+def _where_x_first(x, condition, y, name=None):
+    return where(condition, x, y)
+
+
 def where_(condition, x, y, name=None):
     """In-place on x (reference ops.yaml marks where inplace x->out) — NOT
-    on the condition, so it can't ride the bulk first-arg sweep."""
-    out = where(condition, x, y)
-    x._assign_raw(out._data)
-    x._node = out._node
-    x._out_idx = out._out_idx
-    x.stop_gradient = x.stop_gradient and out.stop_gradient
-    return x
+    on the condition, so it can't ride the bulk first-arg sweep; routed
+    through inplace_variant for the shadow-alias tape rewiring."""
+    return _inplace_variant(_where_x_first)(x, condition, y)
 
 
 Tensor.where_ = where_

@@ -340,7 +340,7 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
     (≙ phi lu_unpack_kernel)."""
     n = x.shape[-2]
 
-    def f(lu_, piv):
+    def one(lu_, piv):
         lo = jnp.tril(lu_, -1) + jnp.eye(
             lu_.shape[-2], lu_.shape[-1], dtype=lu_.dtype)
         up = jnp.triu(lu_)
@@ -354,6 +354,12 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
         perm = jax.lax.fori_loop(0, pv.shape[-1], body, perm)
         p = jnp.eye(n, dtype=lu_.dtype)[perm].T
         return p, lo, up
+
+    def f(lu_, piv):
+        fn = one
+        for _ in range(lu_.ndim - 2):  # vmap over leading batch dims
+            fn = jax.vmap(fn)
+        return fn(lu_, piv)
 
     out = op_call(f, x, y, name="lu_unpack", n_diff=0)
     return out

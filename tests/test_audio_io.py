@@ -54,24 +54,36 @@ class TestWaveBackend:
 
 
 class TestAudioDatasets:
-    def test_esc50_folder(self, tmp_path):
-        _write_wavs(tmp_path, ["1-100032-A-0.wav", "1-100038-A-14.wav"])
-        ds = paddle.audio.datasets.ESC50(data_dir=str(tmp_path))
-        assert len(ds) == 2
-        feat, label = ds[0]
+    def test_esc50_fold_split(self, tmp_path):
+        # ESC50 filename leads with its fold: fold-1 goes to dev (split=1)
+        _write_wavs(tmp_path, ["1-100032-A-0.wav", "2-100038-A-14.wav"])
+        train = paddle.audio.datasets.ESC50(data_dir=str(tmp_path),
+                                            mode='train', split=1)
+        dev = paddle.audio.datasets.ESC50(data_dir=str(tmp_path),
+                                          mode='dev', split=1)
+        assert len(train) == 1 and len(dev) == 1
+        feat, label = dev[0]
         assert label == 0 and feat.shape == (1600,)
-        _feat, label1 = ds[1]
+        _feat, label1 = train[0]
         assert label1 == 14
 
     def test_tess_folder_with_features(self, tmp_path):
         _write_wavs(tmp_path, ["OAF_back_angry.wav", "OAF_bar_happy.wav"])
-        ds = paddle.audio.datasets.TESS(data_dir=str(tmp_path),
+        ds = paddle.audio.datasets.TESS(data_dir=str(tmp_path), mode='train',
+                                        n_folds=2, split=2,
                                         feat_type='mfcc', n_mfcc=13,
                                         n_fft=256)
+        # round-robin folds: index 0 → fold 1 (train when split=2)
+        assert len(ds) == 1
         feat, label = ds[0]
         assert label == paddle.audio.datasets.TESS.EMOTIONS.index('angry')
         assert feat.shape[0] == 13
         assert np.isfinite(feat).all()
+
+    def test_bad_mode_raises(self, tmp_path):
+        _write_wavs(tmp_path, ["1-1-A-0.wav"])
+        with pytest.raises(ValueError, match="mode"):
+            paddle.audio.datasets.ESC50(data_dir=str(tmp_path), mode='test')
 
     def test_missing_dir_raises(self):
         with pytest.raises(ValueError, match="required"):

@@ -1,0 +1,479 @@
+"""Extended nn surface parity — numerics vs torch (cpu) where torch has the
+op, else vs brute-force numpy (reference surfaces python/paddle/nn/__init__.py
++ nn/functional/__init__.py)."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestActivationsExtended:
+    def test_log_sigmoid_thresholded_relu(self):
+        x = np.random.RandomState(0).randn(4, 5).astype("float32")
+        np.testing.assert_allclose(_np(F.log_sigmoid(_t(x))),
+                                   tF.logsigmoid(torch.tensor(x)).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            _np(F.thresholded_relu(_t(x), threshold=0.3)),
+            np.where(x > 0.3, x, 0.0))
+
+    def test_functional_inplace_variants(self):
+        x = np.array([-1.0, 0.5], dtype="float32")
+        t = _t(x); F.tanh_(t)
+        np.testing.assert_allclose(_np(t), np.tanh(x), rtol=1e-6)
+        t2 = _t(x); F.leaky_relu_(t2, 0.1)
+        np.testing.assert_allclose(_np(t2), np.where(x > 0, x, 0.1 * x))
+        t3 = _t(x); F.hardtanh_(t3)
+        np.testing.assert_allclose(_np(t3), np.clip(x, -1, 1))
+        t4 = _t(x); F.elu_(t4)
+        np.testing.assert_allclose(_np(t4), np.where(x > 0, x, np.expm1(x)),
+                                   rtol=1e-6)
+
+    def test_softmax2d(self):
+        x = np.random.RandomState(1).randn(2, 3, 4, 4).astype("float32")
+        out = _np(nn.Softmax2D()(_t(x)))
+        np.testing.assert_allclose(out.sum(axis=1), np.ones((2, 4, 4)),
+                                   rtol=1e-5)
+
+
+class TestShapeLayers:
+    def test_channel_shuffle_matches_torch(self):
+        x = np.arange(2 * 6 * 2 * 2, dtype="float32").reshape(2, 6, 2, 2)
+        got = _np(F.channel_shuffle(_t(x), 3))
+        want = tF.channel_shuffle(torch.tensor(x), 3).numpy()
+        np.testing.assert_allclose(got, want)
+
+    def test_zeropads(self):
+        x = np.ones((1, 2, 3), dtype="float32")
+        assert list(nn.ZeroPad1D(2)(_t(x)).shape) == [1, 2, 7]
+        x3 = np.ones((1, 1, 2, 2, 2), dtype="float32")
+        assert list(nn.ZeroPad3D(1)(_t(x3)).shape) == [1, 1, 4, 4, 4]
+        x2 = np.ones((1, 1, 2, 2), dtype="float32")
+        out = _np(F.zeropad2d(_t(x2), [1, 0, 2, 0]))
+        assert out.shape == (1, 1, 4, 3) and out[0, 0, 0, 0] == 0
+
+    def test_pairwise_distance_matches_torch(self):
+        rs = np.random.RandomState(2)
+        a, b = rs.randn(5, 8).astype("float32"), rs.randn(5, 8).astype("float32")
+        got = _np(F.pairwise_distance(_t(a), _t(b)))
+        want = tF.pairwise_distance(torch.tensor(a), torch.tensor(b)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_fold_unfold_roundtrip_matches_torch(self):
+        rs = np.random.RandomState(3)
+        x = rs.randn(2, 3, 8, 8).astype("float32")
+        cols = _np(F.unfold(_t(x), 3, strides=2, paddings=1))
+        tcols = tF.unfold(torch.tensor(x), 3, padding=1, stride=2).numpy()
+        np.testing.assert_allclose(cols, tcols, rtol=1e-5)
+        back = _np(F.fold(_t(cols), (8, 8), 3, strides=2, paddings=1))
+        tback = tF.fold(torch.tensor(tcols), (8, 8), 3, padding=1,
+                        stride=2).numpy()
+        np.testing.assert_allclose(back, tback, rtol=1e-5)
+
+    def test_feature_alpha_dropout(self):
+        x = np.ones((4, 8, 5, 5), dtype="float32")
+        out = _np(F.feature_alpha_dropout(_t(x), p=0.5, training=True))
+        # whole channels share one value (dropped or kept)
+        per_chan = out.reshape(4, 8, -1)
+        assert (per_chan.std(axis=-1) < 1e-5).all()
+        got = F.feature_alpha_dropout(_t(x), p=0.5, training=False)
+        np.testing.assert_allclose(_np(got), x)
+
+
+class TestPoolingExtended:
+    def test_lp_pool_matches_torch(self):
+        rs = np.random.RandomState(4)
+        x = rs.rand(2, 3, 8, 8).astype("float32") + 0.1
+        got = _np(F.lp_pool2d(_t(x), 2.0, 2, stride=2))
+        want = tF.lp_pool2d(torch.tensor(x), 2.0, 2, stride=2).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+        x1 = rs.rand(2, 3, 10).astype("float32") + 0.1
+        got1 = _np(F.lp_pool1d(_t(x1), 3.0, 2, stride=2))
+        want1 = tF.lp_pool1d(torch.tensor(x1), 3.0, 2, stride=2).numpy()
+        np.testing.assert_allclose(got1, want1, rtol=1e-4)
+
+    def test_max_unpool2d_matches_torch(self):
+        rs = np.random.RandomState(5)
+        x = rs.randn(2, 3, 8, 8).astype("float32")
+        tout, tidx = tF.max_pool2d(torch.tensor(x), 2, stride=2,
+                                   return_indices=True)
+        got = _np(F.max_unpool2d(_t(tout.numpy()),
+                                 _t(tidx.numpy().astype("int64")), 2))
+        want = tF.max_unpool2d(tout, tidx, 2).numpy()
+        np.testing.assert_allclose(got, want)
+
+    def test_max_unpool1d_3d_shapes(self):
+        x = np.random.RandomState(6).randn(1, 2, 4).astype("float32")
+        idx = np.array([[[1, 5], [0, 7]]], dtype="int64")[:, :, :2]
+        out = F.max_unpool1d(_t(x[:, :, :2]), _t(idx), 2)
+        assert list(out.shape) == [1, 2, 4]
+        x3 = np.random.RandomState(7).randn(1, 1, 2, 2, 2).astype("float32")
+        i3 = np.arange(8).reshape(1, 1, 2, 2, 2).astype("int64") * 4
+        i3 = np.clip(i3, 0, 63)
+        out3 = F.max_unpool3d(_t(x3), _t(i3), 2)
+        assert list(out3.shape) == [1, 1, 4, 4, 4]
+
+    def test_functional_inplace_keeps_grad(self):
+        x = _t(np.array([0.3, -0.7], dtype="float32"))
+        x.stop_gradient = False
+        y = x * 2.0
+        F.tanh_(y)
+        y.sum().backward()
+        want = (1 - np.tanh([0.6, -1.4]) ** 2) * 2
+        np.testing.assert_allclose(_np(x.grad), want, rtol=1e-4)
+        # where_ same
+        x2 = _t(np.array([1.0, 2.0], dtype="float32"))
+        x2.stop_gradient = False
+        y2 = x2 * 2.0
+        cond = _t(np.array([True, False]))
+        paddle.where_(cond, y2, _t(np.array([9.0, 9.0], dtype="float32")))
+        y2.sum().backward()
+        np.testing.assert_allclose(_np(x2.grad), [2.0, 0.0])
+
+    def test_fractional_max_pool(self):
+        rs = np.random.RandomState(8)
+        x = rs.randn(2, 3, 16, 16).astype("float32")
+        out = F.fractional_max_pool2d(_t(x), 7, random_u=0.5)
+        assert list(out.shape) == [2, 3, 7, 7]
+        # every output is an input element and >= any nearby element mean
+        assert np.isin(_np(out), x).all()
+        out3 = F.fractional_max_pool3d(
+            _t(rs.randn(1, 2, 8, 8, 8).astype("float32")), 3, random_u=0.3)
+        assert list(out3.shape) == [1, 2, 3, 3, 3]
+
+    def test_fractional_max_pool_mask(self):
+        rs = np.random.RandomState(21)
+        x = rs.randn(1, 1, 8, 8).astype("float32")
+        vals, mask = F.fractional_max_pool2d(_t(x), 4, random_u=0.4,
+                                             return_mask=True)
+        flat = x[0, 0].reshape(-1)
+        np.testing.assert_allclose(flat[_np(mask)[0, 0]], _np(vals)[0, 0])
+
+    def test_lu_unpack_batched(self):
+        rs = np.random.RandomState(22)
+        a = rs.randn(3, 4, 4).astype("float32")
+        lu_t, piv = paddle.linalg.lu(_t(a))
+        p, lo, up = paddle.linalg.lu_unpack(lu_t, piv)
+        rebuilt = np.einsum("bij,bjk,bkl->bil", _np(p), _np(lo), _np(up))
+        np.testing.assert_allclose(rebuilt, a, rtol=1e-4, atol=1e-4)
+
+    def test_linalg_namespace_reexports(self):
+        for name in ("lu_unpack", "cholesky_inverse", "ormqr", "svd_lowrank"):
+            assert hasattr(paddle.linalg, name)
+
+
+class TestConvTranspose:
+    def test_conv1d_transpose_matches_torch(self):
+        rs = np.random.RandomState(9)
+        x = rs.randn(2, 4, 10).astype("float32")
+        w = rs.randn(4, 3, 5).astype("float32")  # [in, out, k]
+        got = _np(F.conv1d_transpose(_t(x), _t(w), stride=2, padding=1))
+        want = tF.conv_transpose1d(torch.tensor(x), torch.tensor(w),
+                                   stride=2, padding=1).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_conv3d_transpose_matches_torch(self):
+        rs = np.random.RandomState(10)
+        x = rs.randn(1, 2, 4, 4, 4).astype("float32")
+        w = rs.randn(2, 3, 3, 3, 3).astype("float32")
+        b = rs.randn(3).astype("float32")
+        got = _np(F.conv3d_transpose(_t(x), _t(w), _t(b), stride=2))
+        want = tF.conv_transpose3d(torch.tensor(x), torch.tensor(w),
+                                   torch.tensor(b), stride=2).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+        layer = nn.Conv3DTranspose(2, 3, 3, stride=2)
+        assert list(layer(_t(x)).shape) == list(want.shape)
+
+
+class TestVisionSampling:
+    @pytest.mark.parametrize("align", [True, False])
+    def test_affine_grid_matches_torch(self, align):
+        theta = np.array([[[1.0, 0.2, 0.1], [-0.1, 0.9, 0.3]]],
+                         dtype="float32")
+        got = _np(F.affine_grid(_t(theta), [1, 3, 5, 7],
+                                align_corners=align))
+        want = tF.affine_grid(torch.tensor(theta), [1, 3, 5, 7],
+                              align_corners=align).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("pad", ["zeros", "border", "reflection"])
+    def test_grid_sample_matches_torch(self, mode, pad):
+        rs = np.random.RandomState(11)
+        x = rs.randn(2, 3, 6, 8).astype("float32")
+        grid = (rs.rand(2, 5, 7, 2).astype("float32") * 2.4 - 1.2)
+        got = _np(F.grid_sample(_t(x), _t(grid), mode=mode, padding_mode=pad,
+                                align_corners=True))
+        want = tF.grid_sample(torch.tensor(x), torch.tensor(grid), mode=mode,
+                              padding_mode=pad, align_corners=True).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_grid_sample_grad_flows(self):
+        rs = np.random.RandomState(12)
+        x = _t(rs.randn(1, 2, 4, 4).astype("float32"))
+        x.stop_gradient = False
+        theta = _t(np.array([[[1.0, 0, 0], [0, 1.0, 0]]], dtype="float32"))
+        g = F.affine_grid(theta, [1, 2, 4, 4])
+        F.grid_sample(x, g).sum().backward()
+        assert np.isfinite(_np(x.grad)).all()
+
+
+class TestLossZoo:
+    def setup_method(self, _):
+        self.rs = np.random.RandomState(13)
+
+    def test_soft_margin_matches_torch(self):
+        x = self.rs.randn(6, 4).astype("float32")
+        y = np.sign(self.rs.randn(6, 4)).astype("float32")
+        got = _np(F.soft_margin_loss(_t(x), _t(y)))
+        want = tF.soft_margin_loss(torch.tensor(x), torch.tensor(y)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_multi_label_soft_margin_matches_torch(self):
+        x = self.rs.randn(5, 7).astype("float32")
+        y = (self.rs.rand(5, 7) > 0.5).astype("float32")
+        got = _np(F.multi_label_soft_margin_loss(_t(x), _t(y)))
+        want = tF.multilabel_soft_margin_loss(torch.tensor(x),
+                                              torch.tensor(y)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_multi_margin_matches_torch(self):
+        x = self.rs.randn(6, 5).astype("float32")
+        y = self.rs.randint(0, 5, 6).astype("int64")
+        got = _np(F.multi_margin_loss(_t(x), _t(y)))
+        want = tF.multi_margin_loss(torch.tensor(x), torch.tensor(y)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_poisson_gaussian_nll_match_torch(self):
+        x = self.rs.randn(8).astype("float32")
+        y = self.rs.poisson(2.0, 8).astype("float32")
+        got = _np(F.poisson_nll_loss(_t(x), _t(y), full=True))
+        want = tF.poisson_nll_loss(torch.tensor(x), torch.tensor(y),
+                                   full=True).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+        mu = self.rs.randn(8).astype("float32")
+        var = (self.rs.rand(8) + 0.1).astype("float32")
+        tgt = self.rs.randn(8).astype("float32")
+        got2 = _np(F.gaussian_nll_loss(_t(mu), _t(tgt), _t(var), full=True))
+        want2 = tF.gaussian_nll_loss(torch.tensor(mu), torch.tensor(tgt),
+                                     torch.tensor(var), full=True).numpy()
+        np.testing.assert_allclose(got2, want2, rtol=1e-4)
+
+    def test_triplet_with_distance_matches_torch(self):
+        a = self.rs.randn(5, 6).astype("float32")
+        p = self.rs.randn(5, 6).astype("float32")
+        n = self.rs.randn(5, 6).astype("float32")
+        got = _np(F.triplet_margin_with_distance_loss(_t(a), _t(p), _t(n),
+                                                      swap=True))
+        want = tF.triplet_margin_with_distance_loss(
+            torch.tensor(a), torch.tensor(p), torch.tensor(n),
+            swap=True).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_dice_loss(self):
+        p = np.array([[[0.9, 0.1], [0.2, 0.8]]], dtype="float32")
+        y = np.array([[[0], [1]]], dtype="int64")
+        got = float(_np(F.dice_loss(_t(p), _t(y))))
+        assert 0.0 < got < 0.3  # mostly-correct predictions → small loss
+
+    def test_ctc_loss_matches_torch(self):
+        T, B, C, U = 12, 3, 6, 4
+        logits = self.rs.randn(T, B, C).astype("float32")
+        labels = self.rs.randint(1, C, (B, U)).astype("int32")
+        in_len = np.array([12, 10, 8], dtype="int64")
+        lab_len = np.array([4, 3, 2], dtype="int64")
+        got = _np(F.ctc_loss(_t(logits), _t(labels), _t(in_len), _t(lab_len),
+                             blank=0, reduction="none"))
+        lsm = torch.tensor(logits).log_softmax(-1)
+        want = tF.ctc_loss(lsm, torch.tensor(labels.astype("int64")),
+                           torch.tensor(in_len), torch.tensor(lab_len),
+                           blank=0, reduction="none").numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    def test_ctc_loss_layer_and_grad(self):
+        T, B, C = 8, 2, 5
+        logits = _t(self.rs.randn(T, B, C).astype("float32"))
+        logits.stop_gradient = False
+        loss = nn.CTCLoss()(logits, _t(np.array([[1, 2], [3, 4]], "int32")),
+                            _t(np.array([8, 8], "int64")),
+                            _t(np.array([2, 2], "int64")))
+        loss.backward()
+        assert np.isfinite(_np(logits.grad)).all()
+
+    def test_rnnt_loss_brute_force(self):
+        # tiny lattice: T=2, U=1 (one label), V=3, blank=0
+        T, U, V = 2, 1, 3
+        lp = self.rs.randn(1, T, U + 1, V).astype("float32")
+        y = np.array([[1]], dtype="int32")
+        logp = np.log(np.exp(lp[0]) / np.exp(lp[0]).sum(-1, keepdims=True))
+        # paths: (blank@t0,u0 -> blank@t1,u0? no: need to emit label)
+        # valid monotone paths emitting y then blanks ending at (T-1, U):
+        # 1) emit y at (0,0), blank (0,1)->? alpha: standard transducer
+        p1 = logp[0, 0, 1] + logp[0, 1, 0] + logp[1, 1, 0]
+        p2 = logp[0, 0, 0] + logp[1, 0, 1] + logp[1, 1, 0]
+        want = -np.logaddexp(p1, p2)
+        got = float(_np(F.rnnt_loss(_t(lp), _t(y),
+                                    _t(np.array([T], "int64")),
+                                    _t(np.array([U], "int64")),
+                                    reduction="none")))
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_margin_cross_entropy(self):
+        # margins (1,0,0): reduces to scaled softmax CE on cos logits
+        cos = np.clip(self.rs.randn(4, 10) * 0.3, -1, 1).astype("float32")
+        y = self.rs.randint(0, 10, 4).astype("int64")
+        got = float(_np(F.margin_cross_entropy(_t(cos), _t(y), margin1=1.0,
+                                               margin2=0.0, margin3=0.0,
+                                               scale=10.0)))
+        want = tF.cross_entropy(torch.tensor(cos * 10.0),
+                                torch.tensor(y)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_hsigmoid_loss(self):
+        x = _t(self.rs.randn(6, 8).astype("float32"))
+        x.stop_gradient = False
+        y = _t(self.rs.randint(0, 10, 6).astype("int64"))
+        layer = nn.HSigmoidLoss(8, 10)
+        loss = layer(x, y)
+        assert list(loss.shape) == [6, 1]
+        assert (_np(loss) > 0).all()
+        loss.sum().backward()
+        assert np.isfinite(_np(x.grad)).all()
+
+    def test_adaptive_log_softmax_matches_torch(self):
+        in_f, n_cls, cutoffs = 8, 20, [4, 12]
+        ours = nn.AdaptiveLogSoftmaxWithLoss(in_f, n_cls, cutoffs,
+                                             div_value=2.0)
+        th = torch.nn.AdaptiveLogSoftmaxWithLoss(in_f, n_cls, cutoffs,
+                                                 div_value=2.0,
+                                                 head_bias=False)
+        # inject torch's weights into ours (torch Linear stores [out, in])
+        ours.head_weight.set_value(
+            _t(th.head.weight.detach().numpy().T.copy()))
+        for i, tail in enumerate(th.tail):
+            proj_w = tail[0].weight.detach().numpy().T.copy()
+            cls_w = tail[1].weight.detach().numpy().T.copy()
+            getattr(ours, f"tail_proj_{i}").set_value(_t(proj_w))
+            getattr(ours, f"tail_cls_{i}").set_value(_t(cls_w))
+        x = self.rs.randn(10, in_f).astype("float32")
+        y = self.rs.randint(0, n_cls, 10).astype("int64")
+        out, loss = ours(_t(x), _t(y))
+        tout, tloss = th(torch.tensor(x), torch.tensor(y))
+        np.testing.assert_allclose(_np(out), tout.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(float(_np(loss)), tloss.item(), rtol=1e-4)
+        # log_prob full table
+        np.testing.assert_allclose(
+            _np(ours.log_prob(_t(x))),
+            th.log_prob(torch.tensor(x)).detach().numpy(), rtol=1e-4,
+            atol=1e-5)
+
+
+class TestRNNInfra:
+    def test_simple_rnn_cell_and_rnn_wrapper(self):
+        rs = np.random.RandomState(14)
+        cell = nn.SimpleRNNCell(4, 6)
+        x = _t(rs.randn(3, 5, 4).astype("float32"))
+        out, final = nn.RNN(cell)(x)
+        assert list(out.shape) == [3, 5, 6]
+        # numpy recurrence with the same weights
+        wi, wh = _np(cell.weight_ih), _np(cell.weight_hh)
+        bi, bh = _np(cell.bias_ih), _np(cell.bias_hh)
+        h = np.zeros((3, 6), "float32")
+        xs = _np(x)
+        for t in range(5):
+            h = np.tanh(xs[:, t] @ wi.T + bi + h @ wh.T + bh)
+        np.testing.assert_allclose(_np(out)[:, -1], h, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(_np(final), h, rtol=1e-4, atol=1e-5)
+
+    def test_birnn(self):
+        rs = np.random.RandomState(15)
+        fw, bw = nn.SimpleRNNCell(4, 3), nn.SimpleRNNCell(4, 3)
+        x = _t(rs.randn(2, 6, 4).astype("float32"))
+        out, (sf, sb) = nn.BiRNN(fw, bw)(x)
+        assert list(out.shape) == [2, 6, 6]
+
+    def test_gather_tree(self):
+        # TF gather_tree docs example
+        ids = np.array([[[1, 2, 3]], [[4, 5, 6]], [[7, 8, 9]]], "int64")
+        parents = np.array([[[0, 0, 0]], [[0, 1, 1]], [[2, 1, 2]]], "int64")
+        got = _np(F.gather_tree(_t(ids), _t(parents)))
+        want = np.array([[[2, 2, 2]], [[6, 5, 6]], [[7, 8, 9]]])
+        np.testing.assert_array_equal(got, want)
+
+    def test_beam_search_decode(self):
+        rs = np.random.RandomState(16)
+        V, H = 7, 5
+
+        class ToyCell(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(1, H)
+                self.out = nn.Linear(H, V)
+
+            def forward(self, ids, states):
+                x = ids.astype("float32").unsqueeze(-1)
+                h = self.lin(x).tanh()
+                return self.out(h), states
+
+        cell = ToyCell()
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                   beam_size=3)
+        seq, scores = nn.dynamic_decode(dec, inits=None, max_step_num=4,
+                                        batch_size=2)
+        assert seq.shape[0] == 2 and seq.shape[-1] == 3
+        assert list(scores.shape) == [2, 3]
+
+
+class TestAttentionWrappers:
+    def test_flash_attn_qkvpacked(self):
+        rs = np.random.RandomState(17)
+        qkv = rs.randn(2, 8, 3, 2, 16).astype("float32")
+        out, _ = F.flash_attn_qkvpacked(_t(qkv), causal=True)
+        ref = _np(F.scaled_dot_product_attention(
+            _t(qkv[:, :, 0]), _t(qkv[:, :, 1]), _t(qkv[:, :, 2]),
+            is_causal=True))
+        np.testing.assert_allclose(_np(out), ref, rtol=1e-5)
+
+    def test_flash_attn_varlen_qkvpacked(self):
+        rs = np.random.RandomState(18)
+        total = 10
+        qkv = rs.randn(total, 3, 2, 8).astype("float32")
+        cu = np.array([0, 4, 10], dtype="int32")
+        out, _ = F.flash_attn_varlen_qkvpacked(_t(qkv), _t(cu), _t(cu), 6, 6)
+        assert list(out.shape) == [10, 2, 8]
+
+    def test_flashmask_attention(self):
+        rs = np.random.RandomState(19)
+        s = 6
+        q = rs.randn(1, s, 2, 8).astype("float32")
+        # mask: key column j blocked for rows >= start[j]; start=s → no mask
+        idx = np.full((1, 1, s, 1), s, dtype="int32")
+        out = F.flashmask_attention(_t(q), _t(q), _t(q),
+                                    startend_row_indices=_t(idx))
+        ref = _np(F.scaled_dot_product_attention(_t(q), _t(q), _t(q)))
+        np.testing.assert_allclose(_np(out), ref, rtol=1e-4, atol=1e-5)
+
+    def test_sparse_attention_matches_dense_when_full(self):
+        rs = np.random.RandomState(20)
+        B, H, S, D = 1, 2, 4, 8
+        q = rs.randn(B, H, S, D).astype("float32")
+        # full CSR pattern == dense attention
+        off = np.tile(np.arange(0, S * S + 1, S, dtype="int32"), (B, H, 1))
+        cols = np.tile(np.tile(np.arange(S, dtype="int32"), S), (B, H, 1))
+        got = _np(F.sparse_attention(_t(q), _t(q), _t(q), _t(off), _t(cols)))
+        qt = torch.tensor(q)
+        want = tF.scaled_dot_product_attention(qt, qt, qt).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
