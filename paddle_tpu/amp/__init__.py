@@ -246,3 +246,16 @@ class debugging:
         if bad:
             raise FloatingPointError(f"NaN/Inf in {op_type}:{var_name}")
         return tensor
+
+
+def is_float16_supported(device=None):
+    """fp16 compute support probe (≙ amp/auto_cast.py is_float16_supported).
+    TPUs compute natively in bf16; fp16 storage works but matmuls upcast."""
+    import jax
+
+    return jax.default_backend() in ("tpu", "gpu")
+
+
+def is_bfloat16_supported(device=None):
+    """bf16 is the native TPU training dtype."""
+    return True

@@ -109,3 +109,7 @@ define_flag("FLAGS_distributed_heartbeat_timeout", 600,
 define_flag("FLAGS_rpc_retry_times", 3, "rpc retry shim")
 define_flag("FLAGS_dataloader_use_shared_memory", True,
             "native shm ring transport for DataLoader workers")
+define_flag("FLAGS_enable_to_static", True,
+            "global to_static toggle (jit.enable_to_static)")
+define_flag("FLAGS_jit_code_level", 100, "SOT code-dump verbosity shim")
+define_flag("FLAGS_jit_verbosity", 0, "dy2static logging verbosity shim")

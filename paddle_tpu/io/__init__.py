@@ -20,6 +20,7 @@ from .sampler import (
     Sampler,
     SequenceSampler,
     RandomSampler,
+    SubsetRandomSampler,
     WeightedRandomSampler,
     BatchSampler,
     DistributedBatchSampler,

@@ -146,3 +146,26 @@ class DistributedBatchSampler(BatchSampler):
         return math.ceil(self.num_samples / self.batch_size)
 
 
+
+
+class SubsetRandomSampler(Sampler):
+    """≙ io/sampler.py SubsetRandomSampler: random order over a fixed index
+    subset."""
+
+    def __init__(self, indices):
+        if len(indices) == 0:
+            raise ValueError("indices must not be empty")
+        self.indices = list(indices)
+
+    def __iter__(self):
+        import numpy as _np
+
+        from ..core.rng import next_key
+
+        seed_words = _np.asarray(next_key()).astype(_np.uint32).ravel()
+        order = _np.random.default_rng(seed_words.tolist()).permutation(
+            len(self.indices))
+        return iter(self.indices[i] for i in order)
+
+    def __len__(self):
+        return len(self.indices)

@@ -1,2 +1,27 @@
 from .api import CompiledFunction, ignore_module, not_to_static, to_static
 from .save_load import load, save
+
+from .save_load import TranslatedLayer  # noqa: E402
+
+
+def enable_to_static(enable=True):
+    """Globally toggle to_static compilation (≙ jit/api.py enable_to_static:
+    when off, decorated functions run eagerly — the graph-break fallback
+    path, useful for debugging)."""
+    from ..core.flags import set_flags
+
+    set_flags({"FLAGS_enable_to_static": bool(enable)})
+
+
+def set_code_level(level=100):
+    """SOT code-dump verbosity shim (≙ jit/sot set_code_level). The tracing
+    frontend here is jax.jit; level is recorded for API parity."""
+    from ..core.flags import set_flags
+
+    set_flags({"FLAGS_jit_code_level": int(level)})
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    from ..core.flags import set_flags
+
+    set_flags({"FLAGS_jit_verbosity": int(level)})

@@ -136,7 +136,9 @@ class CompiledFunction:
         return _struct_key(struct) + "##" + spec
 
     def __call__(self, *args, **kwargs):
-        if self._fallback_eager:
+        from ..core.flags import flag
+
+        if self._fallback_eager or not flag("FLAGS_enable_to_static"):
             return self._fn(*args, **kwargs)
         leaves: list[Tensor] = []
         struct = _flatten((args, kwargs), leaves)

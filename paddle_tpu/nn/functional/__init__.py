@@ -1021,7 +1021,9 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     out = scaled_dot_product_attention(query, key, value, None, dropout, causal,
                                        training)
     if return_softmax:
-        return out, None
+        from .extended import _dense_softmax_weights
+
+        return out, _dense_softmax_weights(query, key, causal)
     return out, None
 
 

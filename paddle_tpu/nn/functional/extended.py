@@ -248,6 +248,17 @@ def _fractional_bounds(in_size, out_size, u):
     return b
 
 
+def _fractional_starts(in_size, out_size, k, u):
+    """torch-style pseudorandom window starts for fixed kernel_size k:
+    seq[i] = floor((i+u)·alpha) - floor(u·alpha), last pinned to in-k."""
+    if out_size == 1:
+        return np.array([0], np.int64)
+    alpha = (in_size - k) / (out_size - 1)
+    i = np.arange(out_size - 1)
+    seq = (np.floor((i + u) * alpha) - np.floor(u * alpha)).astype(np.int64)
+    return np.append(seq, in_size - k)
+
+
 def _fractional_pool(x, nd, output_size, kernel_size, random_u, opname,
                      return_mask=False):
     out_sz = _pair(output_size, nd)
@@ -258,19 +269,31 @@ def _fractional_pool(x, nd, output_size, kernel_size, random_u, opname,
         u = float(random_u)
         if not 0 < u < 1:
             raise ValueError(f"random_u must be in (0,1), got {random_u}")
-    bounds = [_fractional_bounds(spatial[i], out_sz[i], u) for i in range(nd)]
-    kmax = [int((b[1:] - b[:-1]).max()) for b in bounds]
 
-    # per-dim gather indices [out, kmax] with validity mask beyond window end
-    gidx, gmask = [], []
-    for d in range(nd):
-        b = bounds[d]
-        starts = b[:-1]
-        lens = b[1:] - b[:-1]
-        idx = starts[:, None] + np.arange(kmax[d])[None, :]
-        mask = np.arange(kmax[d])[None, :] < lens[:, None]
-        gidx.append(np.clip(idx, 0, spatial[d] - 1))
-        gmask.append(mask)
+    gidx, gmask, bounds = [], [], []
+    if kernel_size is not None:
+        ks = _pair(kernel_size, nd)
+        kmax = list(ks)
+        for d in range(nd):
+            starts = _fractional_starts(spatial[d], out_sz[d], ks[d], u)
+            bounds.append(np.append(starts, spatial[d]))  # starts for mask idx
+            idx = starts[:, None] + np.arange(ks[d])[None, :]
+            gidx.append(np.clip(idx, 0, spatial[d] - 1))
+            gmask.append(np.ones((out_sz[d], ks[d]), bool))
+    else:
+        bnds = [_fractional_bounds(spatial[i], out_sz[i], u)
+                for i in range(nd)]
+        kmax = [int((b[1:] - b[:-1]).max()) for b in bnds]
+        # per-dim gather indices [out, kmax], validity mask past window end
+        for d in range(nd):
+            b = bnds[d]
+            starts = b[:-1]
+            lens = b[1:] - b[:-1]
+            idx = starts[:, None] + np.arange(kmax[d])[None, :]
+            mask = np.arange(kmax[d])[None, :] < lens[:, None]
+            gidx.append(np.clip(idx, 0, spatial[d] - 1))
+            gmask.append(mask)
+            bounds.append(b)
 
     def f(a):
         # joint window gather: each spatial dim expands to (out_d, k_d)
@@ -432,10 +455,11 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                 span = 2 * (size - 1)
                 v = jnp.abs(jnp.mod(v, span))
                 return jnp.where(v > size - 1, span - v, v)
+            # reflect about the pixel EDGES (-0.5 and size-0.5), then clip
+            # into the valid center range (torch grid_sampler semantics)
             span = 2 * size
             v = jnp.mod(v + 0.5, span)
-            v = jnp.abs(v) - 0.5
-            v = jnp.where(v > size - 0.5, span - 1 - v - 0.5, v)
+            v = jnp.minimum(v, span - v) - 0.5
             return jnp.clip(v, 0, size - 1)
 
         if padding_mode == "reflection":
@@ -558,8 +582,9 @@ def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
             loss = loss + 0.5 * math.log(2 * math.pi)
         return _reduce(loss, reduction)
 
-    return op_call(f, input, label, variance, name="gaussian_nll_loss",
-                   n_diff=1)
+    # differentiable w.r.t. BOTH mean and variance (heteroscedastic heads
+    # train the variance); label is data and normally stop_gradient
+    return op_call(f, input, label, variance, name="gaussian_nll_loss")
 
 
 def triplet_margin_with_distance_loss(input, positive, negative,
@@ -741,7 +766,48 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
             _, rows = jax.lax.scan(row, jnp.full((U1,), neg), jnp.arange(T))
             # total logprob: alpha(tl-1, ul) + emit-blank at (tl-1, ul)
             a_final = rows[tb - 1, ub]
-            return -(a_final + blank_lp[tb - 1, ub])
+            base = -(a_final + blank_lp[tb - 1, ub])
+            if fastemit_lambda == 0.0:
+                return base
+
+            # FastEmit (Yu et al. 2021): scale the label-emission gradient
+            # by (1+λ) ⇔ add λ·L_emit with L_emit = -Σ sg(γ_emit)·lab_lp,
+            # γ_emit(t,u) = posterior of taking the emit transition. Needs
+            # the backward (beta) recursion over the same lattice.
+            def brow(next_beta, t):
+                # beta over u for this t given beta(t+1, ·)
+                from_down = jnp.where(
+                    t == tb - 1,
+                    jnp.where(jnp.arange(U1) == ub, blank_lp[t], neg),
+                    jnp.where(t < tb - 1, next_beta + blank_lp[t], neg))
+
+                def cell(carry, u_rev):
+                    u = U1 - 1 - u_rev
+                    right = jnp.where(
+                        (u + 1 <= ub),
+                        carry + lab_lp[t, jnp.minimum(u, lab_lp.shape[1] - 1)],
+                        neg)
+                    b = jnp.logaddexp(from_down[u], right)
+                    # at (tb-1, ub) the "from_down" already holds the final
+                    # blank; emit beyond ub impossible
+                    return b, b
+
+                _, beta_rev = jax.lax.scan(cell, neg, jnp.arange(U1))
+                beta_row = jnp.flip(beta_rev, 0)
+                return beta_row, beta_row
+
+            _, betas = jax.lax.scan(brow, jnp.full((U1,), neg),
+                                    jnp.arange(T - 1, -1, -1))
+            betas = jnp.flip(betas, 0)                        # [T, U1]
+            logZ = betas[0, 0]
+            # γ_emit(t,u) for u in [0, U): alpha(t,u)+lab(t,u)+beta(t,u+1)-Z
+            gam = jnp.exp(jnp.clip(
+                rows[:, :-1] + lab_lp + betas[:, 1:] - logZ, -60.0, 0.0))
+            gam = jax.lax.stop_gradient(gam)
+            valid = ((jnp.arange(T)[:, None] < tb)
+                     & (jnp.arange(U1 - 1)[None, :] < ub))
+            l_emit = -jnp.sum(jnp.where(valid, gam * lab_lp, 0.0))
+            return base + fastemit_lambda * l_emit
 
         losses = jax.vmap(one)(logp, y, tl, ul)
         if reduction == "mean":
@@ -776,6 +842,21 @@ def gather_tree(ids, parents, name=None):
 
 
 # ------------------------------------------------------- attention wrappers
+def _dense_softmax_weights(q, k, causal):
+    """[B,S,H,D] layout → attention weights [B,H,Sq,Sk] via the dense path
+    (only for return_softmax debugging — defeats the flash memory saving)."""
+
+    def f(qa, ka):
+        s = jnp.einsum("bqhd,bkhd->bhqk", qa, ka) / math.sqrt(qa.shape[-1])
+        if causal:
+            sq, sk = s.shape[-2], s.shape[-1]
+            m = jnp.tril(jnp.ones((sq, sk), bool))
+            s = jnp.where(m, s, -jnp.inf)
+        return jax.nn.softmax(s, axis=-1)
+
+    return op_call(f, q, k, name="attention_softmax")
+
+
 def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
                          training=True, name=None):
     """Packed-QKV flash attention (≙ nn/functional/flash_attention.py
@@ -787,7 +868,9 @@ def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
     v = qkv[:, :, 2]
     out = scaled_dot_product_attention(q, k, v, None, dropout, causal,
                                        training)
-    return (out, None) if return_softmax else (out, None)
+    if return_softmax:
+        return out, _dense_softmax_weights(q, k, causal)
+    return out, None
 
 
 def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,

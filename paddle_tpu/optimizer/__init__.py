@@ -492,6 +492,186 @@ class Lamb(Optimizer):
         p._assign_raw((base - lr_val * trust * upd).astype(p._data.dtype))
 
 
+class ASGD(Optimizer):
+    """Averaged SGD (≙ optimizer/asgd.py → phi asgd_kernel): keeps a running
+    average of the last `batch_num` gradients; the update uses the average."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        if batch_num <= 0:
+            raise ValueError("batch_num must be positive")
+        self._n = int(batch_num)
+
+    def _apply_one(self, p, g, lr_val, wd):
+        gd = g._data + _wd_grad(wd, p._data)
+        d = self._acc("d", p)                       # running mean of grads
+        step = self._acc("step", p, init=lambda: jnp.zeros((), jnp.float32))
+        if self._n > 1:
+            ys = self._acc("ys", p,
+                           init=lambda: jnp.zeros((self._n,) + tuple(p.shape),
+                                                  p._data.dtype))
+            slot = (step._data.astype(jnp.int32)) % self._n
+            old = ys._data[slot]
+            new_d = d._data + (gd - old) / self._n
+            ys._assign_raw(ys._data.at[slot].set(gd))
+        else:
+            new_d = gd
+        d._assign_raw(new_d)
+        step._assign_raw(step._data + 1)
+        p._assign_raw((p._data - lr_val * new_d).astype(p._data.dtype))
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (≙ optimizer/rprop.py → phi rprop_kernel):
+    sign-based per-element step sizes, grown on sign agreement and shrunk
+    on sign flips (flipped entries skip the update that round)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+        self._init_step = learning_rate
+
+    def _apply_one(self, p, g, lr_val, wd):
+        gd = g._data
+        prev = self._acc("prev_grad", p)
+        steps = self._acc("steps", p,
+                          init=lambda: jnp.full(tuple(p.shape),
+                                                self._init_step, jnp.float32))
+        sign = jnp.sign(gd * prev._data)
+        new_steps = jnp.where(
+            sign > 0, jnp.minimum(steps._data * self._eta_pos, self._lr_max),
+            jnp.where(sign < 0,
+                      jnp.maximum(steps._data * self._eta_neg, self._lr_min),
+                      steps._data))
+        eff_grad = jnp.where(sign < 0, 0.0, gd)
+        p._assign_raw((p._data - jnp.sign(eff_grad) * new_steps
+                       ).astype(p._data.dtype))
+        steps._assign_raw(new_steps)
+        prev._assign_raw(eff_grad)
+
+
 class LBFGS(Optimizer):
-    def __init__(self, *a, **k):
-        raise NotImplementedError("LBFGS: planned (jaxopt-style line search)")
+    """Limited-memory BFGS (≙ optimizer/lbfgs.py): two-loop recursion over a
+    host-side (s, y) history; the closure re-runs eagerly, so each inner
+    evaluation is itself a cached XLA program. line_search_fn='strong_wolfe'
+    is approximated with Armijo backtracking (documented deviation)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._max_iter = max_iter
+        self._max_eval = max_eval or max_iter * 5 // 4
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history = int(history_size)
+        self._line_search = line_search_fn
+        self._s, self._y = [], []
+
+    def _gather(self):
+        flat = jnp.concatenate([jnp.ravel(p._data.astype(jnp.float32))
+                                for p in self._parameters])
+        return flat
+
+    def _gather_grad(self):
+        return jnp.concatenate([
+            jnp.ravel((p.grad._data if p.grad is not None
+                       else jnp.zeros(tuple(p.shape))).astype(jnp.float32))
+            for p in self._parameters])
+
+    def _scatter(self, flat):
+        i = 0
+        for p in self._parameters:
+            n = int(np.prod(p.shape)) if p.shape else 1
+            p._assign_raw(flat[i:i + n].reshape(tuple(p.shape))
+                          .astype(p._data.dtype))
+            i += n
+
+    def _direction(self, grad):
+        # standard two-loop recursion
+        q = grad
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / (jnp.dot(y, s) + 1e-10)
+            a = rho * jnp.dot(s, q)
+            q = q - a * y
+            alphas.append((a, rho, s, y))
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            q = q * (jnp.dot(s, y) / (jnp.dot(y, y) + 1e-10))
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        return -q
+
+    @no_grad()
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure that recomputes "
+                             "the loss (reference lbfgs.py contract)")
+
+        def eval_closure():
+            from ..core.dispatch import enable_grad
+
+            self.clear_grad()
+            with enable_grad():
+                loss = closure()
+                # paddle contract: the closure just returns the loss; the
+                # optimizer drives the backward pass
+                loss.backward()
+            return float(np.asarray(loss._data))
+
+        loss = eval_closure()
+        evals = 1
+        for _ in range(self._max_iter):
+            flat = self._gather()
+            grad = self._gather_grad()
+            if float(jnp.max(jnp.abs(grad))) <= self._tol_grad:
+                break
+            d = self._direction(grad)
+            lr0 = float(self._lr_value())
+            t = lr0
+            if self._line_search is not None:
+                gtd = float(jnp.dot(grad, d))
+                ok = False
+                for _bt in range(10):  # Armijo backtracking
+                    self._scatter(flat + t * d)
+                    new_loss = eval_closure()
+                    evals += 1
+                    if new_loss <= loss + 1e-4 * t * gtd:
+                        ok = True
+                        break
+                    t *= 0.5
+                if not ok:
+                    self._scatter(flat)
+                    eval_closure()
+                    break
+            else:
+                self._scatter(flat + t * d)
+                new_loss = eval_closure()
+                evals += 1
+            new_grad = self._gather_grad()
+            s = t * d
+            y = new_grad - grad
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self._history:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if abs(new_loss - loss) < self._tol_change:
+                loss = new_loss
+                break
+            loss = new_loss
+            if evals >= self._max_eval:
+                break
+        return loss

@@ -231,7 +231,7 @@ class ReduceOnPlateau(LRScheduler):
         self.min_lr = min_lr
         self.epsilon = epsilon
         self.best = None
-        self.num_bad = 0
+        self.num_bad_epochs = 0
         self.cooldown_counter = 0
         self.base_lr = learning_rate
         self.last_lr = learning_rate
@@ -251,7 +251,7 @@ class ReduceOnPlateau(LRScheduler):
         self.last_epoch += 1
         if self.cooldown_counter > 0:
             self.cooldown_counter -= 1
-            self.num_bad = 0
+            self.num_bad_epochs = 0
         better = False
         if self.best is None:
             better = True
@@ -265,15 +265,15 @@ class ReduceOnPlateau(LRScheduler):
             better = v > thr
         if better:
             self.best = v
-            self.num_bad = 0
+            self.num_bad_epochs = 0
         else:
-            self.num_bad += 1
-        if self.num_bad > self.patience:
+            self.num_bad_epochs += 1
+        if self.num_bad_epochs > self.patience:
             new_lr = max(self.last_lr * self.factor, self.min_lr)
             if self.last_lr - new_lr > self.epsilon:
                 self.last_lr = new_lr
             self.cooldown_counter = self.cooldown
-            self.num_bad = 0
+            self.num_bad_epochs = 0
         self._push()
 
 
@@ -328,3 +328,25 @@ class CyclicLR(LRScheduler):
         elif self.mode == "exp_range":
             amp = amp * (self.exp_gamma ** self.last_epoch)
         return self.base_lr + amp
+
+
+class LinearLR(LRScheduler):
+    """≙ optimizer/lr.py LinearLR: factor interpolates linearly from
+    start_factor to end_factor over total_steps."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1.0 / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        if total_steps <= 0:
+            raise ValueError("total_steps must be positive")
+        if not 0 < start_factor <= 1:
+            raise ValueError("start_factor should be in (0, 1]")
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        step = min(max(self.last_epoch, 0), self.total_steps)
+        factor = self.start_factor + (self.end_factor - self.start_factor) * (
+            step / self.total_steps)
+        return self.base_lr * factor

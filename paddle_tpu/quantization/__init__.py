@@ -7,10 +7,11 @@ round-to-int8 with a straight-through estimator via jax.custom_vjp (XLA
 fuses the quant-dequant chain into the surrounding matmul).
 """
 from .config import QuantConfig
-from .observers import AbsmaxObserver, MinMaxObserver
+from .observers import AbsmaxObserver, BaseObserver, MinMaxObserver
 from .ptq import PTQ
 from .qat import QAT
-from .quanters import FakeQuanterWithAbsMax, fake_quant
+from .quanters import BaseQuanter, FakeQuanterWithAbsMax, fake_quant, quanter
 
 __all__ = ["QuantConfig", "PTQ", "QAT", "AbsmaxObserver", "MinMaxObserver",
+           "BaseObserver", "BaseQuanter", "quanter",
            "FakeQuanterWithAbsMax", "fake_quant"]

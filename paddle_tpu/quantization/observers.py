@@ -47,3 +47,11 @@ class MinMaxObserver(_ObserverLayer):
     def scales(self) -> float:
         bound = max(abs(self._min), abs(self._max), 1e-8)
         return bound / self.qmax
+
+
+class BaseObserver(_ObserverLayer):
+    """≙ quantization/base_observer.py BaseObserver: subclass contract is
+    forward (collect statistics) + scales()/zero_points()."""
+
+    def zero_points(self):
+        return 0.0

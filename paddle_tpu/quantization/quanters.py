@@ -61,3 +61,30 @@ class FakeQuanterWithAbsMax(Layer):
 
     def scales(self):
         return self._scale
+
+
+class BaseQuanter(Layer):
+    """≙ quantization/base_quanter.py BaseQuanter: trainable fake-quant
+    module contract (forward = quant-dequant with STE grads)."""
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return 0.0
+
+
+_QUANTER_REGISTRY = {}
+
+
+def quanter(name):
+    """Class decorator registering a quanter factory under `name`
+    (≙ quantization/factory.py quanter): the config system looks quanters
+    up by this name."""
+
+    def deco(cls):
+        _QUANTER_REGISTRY[name] = cls
+        cls._quanter_name = name
+        return cls
+
+    return deco

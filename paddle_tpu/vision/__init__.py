@@ -2,5 +2,6 @@
 from . import datasets
 from . import models
 from . import transforms
+from . import ops
 
 __all__ = ["models", "transforms", "datasets"]

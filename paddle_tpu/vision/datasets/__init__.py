@@ -1,3 +1,5 @@
 from .datasets import MNIST, Cifar10, Cifar100, FakeData, FashionMNIST
+from .folder import DatasetFolder, Flowers, ImageFolder, VOC2012
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
+           "DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]

@@ -1,0 +1,165 @@
+"""Folder-based datasets (≙ python/paddle/vision/datasets/folder.py
+DatasetFolder/ImageFolder + {flowers,voc2012}.py): local-file loaders for
+arbitrary class-per-subdirectory image trees — the input-pipeline tier, all
+host-side (PIL + numpy)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ['DatasetFolder', 'ImageFolder', 'Flowers', 'VOC2012']
+
+IMG_EXTENSIONS = ('.jpg', '.jpeg', '.png', '.ppm', '.bmp', '.pgm', '.tif',
+                  '.tiff', '.webp')
+
+
+def _pil_loader(path):
+    from PIL import Image
+
+    with open(path, 'rb') as f:
+        img = Image.open(f)
+        return img.convert('RGB')
+
+
+def has_valid_extension(filename, extensions=IMG_EXTENSIONS):
+    return filename.lower().endswith(tuple(extensions))
+
+
+class DatasetFolder(Dataset):
+    """root/class_x/xxx.ext layout → (sample, class_index)
+    (≙ folder.py DatasetFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _pil_loader
+        self.transform = transform
+        extensions = extensions or IMG_EXTENSIONS
+        classes = sorted(d.name for d in os.scandir(root) if d.is_dir())
+        if not classes:
+            raise ValueError(f"no class directories under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        check = is_valid_file or (
+            lambda p: has_valid_extension(p, extensions))
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _dirs, files in sorted(os.walk(cdir)):
+                for fn in sorted(files):
+                    path = os.path.join(dirpath, fn)
+                    if check(path):
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise ValueError(f"no valid files found under {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+
+class ImageFolder(Dataset):
+    """Flat (label-free) image folder → [sample] (≙ folder.py ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _pil_loader
+        self.transform = transform
+        extensions = extensions or IMG_EXTENSIONS
+        check = is_valid_file or (
+            lambda p: has_valid_extension(p, extensions))
+        self.samples = []
+        for dirpath, _dirs, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                path = os.path.join(dirpath, fn)
+                if check(path):
+                    self.samples.append(path)
+        if not self.samples:
+            raise ValueError(f"no valid files found under {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (≙ datasets/flowers.py) over locally provided
+    files: a directory of jpg images + the setid/label .mat files."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode='train', transform=None, download=False,
+                 backend='pil'):
+        if data_file is None or label_file is None or setid_file is None:
+            raise ValueError(
+                "Flowers: data_file (image dir), label_file (imagelabels.mat)"
+                " and setid_file (setid.mat) are required — downloads are "
+                "unavailable in this build")
+        from scipy.io import loadmat
+
+        key = {'train': 'trnid', 'valid': 'valid', 'test': 'tstid'}[mode]
+        self.indexes = loadmat(setid_file)[key].ravel()
+        self.labels = loadmat(label_file)['labels'].ravel()
+        self.data_dir = data_file
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.indexes)
+
+    def __getitem__(self, idx):
+        img_id = int(self.indexes[idx])
+        path = os.path.join(self.data_dir, f"image_{img_id:05d}.jpg")
+        img = np.asarray(_pil_loader(path))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[img_id - 1]) - 1
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs (≙ datasets/voc2012.py) over a
+    locally extracted VOCdevkit/VOC2012 tree."""
+
+    def __init__(self, data_file=None, mode='train', transform=None,
+                 download=False, backend='pil'):
+        if data_file is None or not os.path.isdir(data_file):
+            raise ValueError(
+                "VOC2012: data_file must point at the extracted "
+                "VOCdevkit/VOC2012 directory (downloads unavailable)")
+        list_name = {'train': 'train.txt', 'valid': 'val.txt',
+                     'test': 'val.txt', 'val': 'val.txt'}[mode]
+        list_path = os.path.join(data_file, 'ImageSets', 'Segmentation',
+                                 list_name)
+        with open(list_path) as f:
+            self.ids = [ln.strip() for ln in f if ln.strip()]
+        self.img_dir = os.path.join(data_file, 'JPEGImages')
+        self.seg_dir = os.path.join(data_file, 'SegmentationClass')
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.ids)
+
+    def __getitem__(self, idx):
+        from PIL import Image
+
+        name = self.ids[idx]
+        img = np.asarray(_pil_loader(os.path.join(self.img_dir,
+                                                  name + '.jpg')))
+        with open(os.path.join(self.seg_dir, name + '.png'), 'rb') as f:
+            label = np.asarray(Image.open(f))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label

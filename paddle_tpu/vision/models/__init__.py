@@ -9,7 +9,11 @@ from .resnet import (
     wide_resnet50_2,
     wide_resnet101_2,
     resnext50_32x4d,
+    resnext50_64x4d,
+    resnext101_32x4d,
     resnext101_64x4d,
+    resnext152_32x4d,
+    resnext152_64x4d,
 )
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .alexnet import AlexNet, alexnet
@@ -34,5 +38,13 @@ from .shufflenetv2 import (
     shufflenet_v2_x2_0,
 )
 from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
+from .shufflenetv2 import shufflenet_v2_swish
+from .mobilenetv3 import (
+    MobileNetV3Large,
+    MobileNetV3Small,
+    mobilenet_v3_large,
+    mobilenet_v3_small,
+)
+from .inceptionv3 import InceptionV3, inception_v3
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,157 @@
+"""InceptionV3 (≙ python/paddle/vision/models/inceptionv3.py architecture:
+factorized inception blocks A–E with grid reductions)."""
+from __future__ import annotations
+
+from ... import nn
+
+
+class _ConvBN(nn.Layer):
+    def __init__(self, in_c, out_c, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return F.relu(self.bn(self.conv(x)))
+
+
+def _cat(xs):
+    import paddle_tpu as paddle
+
+    return paddle.concat(xs, axis=1)
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 64, 1)
+        self.b5 = nn.Sequential(_ConvBN(in_c, 48, 1), _ConvBN(48, 64, 5,
+                                                              padding=2))
+        self.b3 = nn.Sequential(_ConvBN(in_c, 64, 1),
+                                _ConvBN(64, 96, 3, padding=1),
+                                _ConvBN(96, 96, 3, padding=1))
+        self.pool_conv = _ConvBN(in_c, pool_c, 1)
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b5(x), self.b3(x),
+                     self.pool_conv(self.pool(x))])
+
+
+class _ReductionA(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _ConvBN(in_c, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_ConvBN(in_c, 64, 1),
+                                 _ConvBN(64, 96, 3, padding=1),
+                                 _ConvBN(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _cat([self.b3(x), self.b3d(x), self.pool(x)])
+
+
+class _InceptionB(nn.Layer):
+    def __init__(self, in_c, mid):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 192, 1)
+        self.b7 = nn.Sequential(
+            _ConvBN(in_c, mid, 1), _ConvBN(mid, mid, (1, 7), padding=(0, 3)),
+            _ConvBN(mid, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            _ConvBN(in_c, mid, 1), _ConvBN(mid, mid, (7, 1), padding=(3, 0)),
+            _ConvBN(mid, mid, (1, 7), padding=(0, 3)),
+            _ConvBN(mid, mid, (7, 1), padding=(3, 0)),
+            _ConvBN(mid, 192, (1, 7), padding=(0, 3)))
+        self.pool_conv = _ConvBN(in_c, 192, 1)
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b7(x), self.b7d(x),
+                     self.pool_conv(self.pool(x))])
+
+
+class _ReductionB(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = nn.Sequential(_ConvBN(in_c, 192, 1),
+                                _ConvBN(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _ConvBN(in_c, 192, 1),
+            _ConvBN(192, 192, (1, 7), padding=(0, 3)),
+            _ConvBN(192, 192, (7, 1), padding=(3, 0)),
+            _ConvBN(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _cat([self.b3(x), self.b7(x), self.pool(x)])
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 320, 1)
+        self.b3_stem = _ConvBN(in_c, 384, 1)
+        self.b3_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bd_stem = nn.Sequential(_ConvBN(in_c, 448, 1),
+                                     _ConvBN(448, 384, 3, padding=1))
+        self.bd_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.bd_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.pool_conv = _ConvBN(in_c, 192, 1)
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.bd_stem(x)
+        return _cat([self.b1(x),
+                     _cat([self.b3_a(s), self.b3_b(s)]),
+                     _cat([self.bd_a(d), self.bd_b(d)]),
+                     self.pool_conv(self.pool(x))])
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _ConvBN(3, 32, 3, stride=2), _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            _ConvBN(64, 80, 1), _ConvBN(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.inception_a = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64))
+        self.reduction_a = _ReductionA(288)
+        self.inception_b = nn.Sequential(
+            _InceptionB(768, 128), _InceptionB(768, 160),
+            _InceptionB(768, 160), _InceptionB(768, 192))
+        self.reduction_b = _ReductionB(768)
+        self.inception_c = nn.Sequential(_InceptionC(1280), _InceptionC(2048))
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.reduction_a(self.inception_a(x))
+        x = self.reduction_b(self.inception_b(x))
+        x = self.inception_c(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights require network access; load a local "
+            "checkpoint with set_state_dict instead")
+    return InceptionV3(**kwargs)

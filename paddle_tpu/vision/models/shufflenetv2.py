@@ -13,8 +13,12 @@ def _channel_shuffle(x, groups):
     return paddle.reshape(x, [b, c, h, w])
 
 
+def _act_layer(act):
+    return nn.Swish() if act == "swish" else nn.ReLU()
+
+
 class _InvertedResidual(nn.Layer):
-    def __init__(self, inp, oup, stride):
+    def __init__(self, inp, oup, stride, act="relu"):
         super().__init__()
         self.stride = stride
         branch_features = oup // 2
@@ -25,19 +29,19 @@ class _InvertedResidual(nn.Layer):
                           bias_attr=False),
                 nn.BatchNorm2D(inp),
                 nn.Conv2D(inp, branch_features, 1, bias_attr=False),
-                nn.BatchNorm2D(branch_features), nn.ReLU(),
+                nn.BatchNorm2D(branch_features), _act_layer(act),
             )
         else:
             self.branch1 = None
         in2 = inp if stride > 1 else branch_features
         self.branch2 = nn.Sequential(
             nn.Conv2D(in2, branch_features, 1, bias_attr=False),
-            nn.BatchNorm2D(branch_features), nn.ReLU(),
+            nn.BatchNorm2D(branch_features), _act_layer(act),
             nn.Conv2D(branch_features, branch_features, 3, stride=stride,
                       padding=1, groups=branch_features, bias_attr=False),
             nn.BatchNorm2D(branch_features),
             nn.Conv2D(branch_features, branch_features, 1, bias_attr=False),
-            nn.BatchNorm2D(branch_features), nn.ReLU(),
+            nn.BatchNorm2D(branch_features), _act_layer(act),
         )
 
     def forward(self, x):
@@ -69,20 +73,20 @@ class ShuffleNetV2(nn.Layer):
         c0, c1, c2, c3, c_out = self._CFG[scale]
         self.conv1 = nn.Sequential(
             nn.Conv2D(3, c0, 3, stride=2, padding=1, bias_attr=False),
-            nn.BatchNorm2D(c0), nn.ReLU())
+            nn.BatchNorm2D(c0), _act_layer(act))
         self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
         stages = []
         in_c = c0
         for out_c, repeat in zip((c1, c2, c3), self._REPEATS):
-            blocks = [_InvertedResidual(in_c, out_c, 2)]
-            blocks += [_InvertedResidual(out_c, out_c, 1)
+            blocks = [_InvertedResidual(in_c, out_c, 2, act)]
+            blocks += [_InvertedResidual(out_c, out_c, 1, act)
                        for _ in range(repeat - 1)]
             stages.append(nn.Sequential(*blocks))
             in_c = out_c
         self.stage2, self.stage3, self.stage4 = stages
         self.conv5 = nn.Sequential(
             nn.Conv2D(in_c, c_out, 1, bias_attr=False),
-            nn.BatchNorm2D(c_out), nn.ReLU())
+            nn.BatchNorm2D(c_out), _act_layer(act))
         self.with_pool = with_pool
         self.num_classes = num_classes
         if with_pool:
@@ -132,3 +136,7 @@ def shufflenet_v2_x1_5(pretrained=False, **kw):
 
 def shufflenet_v2_x2_0(pretrained=False, **kw):
     return _shufflenet(2.0, pretrained, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.0, act="swish", **kw)

@@ -1,0 +1,753 @@
+"""paddle.vision.ops (≙ python/paddle/vision/ops.py:47 __all__; kernels:
+phi roi_align/roi_pool/psroi_pool/deformable_conv/yolo_box/yolo_loss/
+prior_box/box_coder + detection postprocessing).
+
+TPU-first split:
+- Dense, static-shape ops (roi_align/roi_pool/psroi_pool, deform_conv2d,
+  yolo_box, prior_box, box_coder) are jnp gather/matmul compositions —
+  differentiable, jit-able, MXU-friendly (deform_conv ends in one matmul).
+- Selection ops with data-dependent output sizes (nms, matrix_nms,
+  generate_proposals, distribute_fpn_proposals) run on host numpy, the
+  same postprocessing tier the reference runs them in.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op_call
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = [
+    'yolo_loss', 'yolo_box', 'prior_box', 'box_coder', 'deform_conv2d',
+    'DeformConv2D', 'distribute_fpn_proposals', 'generate_proposals',
+    'read_file', 'decode_jpeg', 'roi_pool', 'RoIPool', 'psroi_pool',
+    'PSRoIPool', 'roi_align', 'RoIAlign', 'nms', 'matrix_nms',
+]
+
+
+def _np(t):
+    return np.asarray(t._data if hasattr(t, "_data") else t)
+
+
+def _mk(a, stop_gradient=True):
+    return Tensor(jnp.asarray(a), _internal=True, stop_gradient=stop_gradient)
+
+
+# ------------------------------------------------------------------ RoI family
+def _bilinear_at(feat, y, x):
+    """feat [C,H,W]; y/x arbitrary-shape float coords → [C, *coords]."""
+    h, w = feat.shape[-2], feat.shape[-1]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy1, wx1 = y - y0, x - x0
+    out = 0.0
+    for dy, wy in ((0, 1 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1 - wx1), (1, wx1)):
+            yy = jnp.clip(y0 + dy, 0, h - 1).astype(jnp.int32)
+            xx = jnp.clip(x0 + dx, 0, w - 1).astype(jnp.int32)
+            valid = ((y0 + dy >= 0) & (y0 + dy <= h - 1)
+                     & (x0 + dx >= 0) & (x0 + dx <= w - 1))
+            out = out + feat[:, yy, xx] * (wy * wx * valid)[None]
+    return out
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """≙ phi roi_align_kernel: averaged bilinear samples per output bin."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    nboxes = boxes.shape[0]
+    ratio = sampling_ratio if sampling_ratio > 0 else 2
+
+    def f(feat, bxs, bnum):
+        # map each box to its batch image via the per-image box counts
+        img_of = jnp.searchsorted(jnp.cumsum(bnum), jnp.arange(nboxes),
+                                  side="right")
+        off = 0.5 if aligned else 0.0
+        x1 = bxs[:, 0] * spatial_scale - off
+        y1 = bxs[:, 1] * spatial_scale - off
+        x2 = bxs[:, 2] * spatial_scale - off
+        y2 = bxs[:, 3] * spatial_scale - off
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        gy = (jnp.arange(ph)[:, None] + (jnp.arange(ratio)[None, :] + 0.5)
+              / ratio)                       # [ph, r] in bin units
+        gx = (jnp.arange(pw)[:, None] + (jnp.arange(ratio)[None, :] + 0.5)
+              / ratio)
+
+        def one(bi, iy1, ix1, bh, bw, img):
+            ys = iy1 + gy * bh               # [ph, r]
+            xs = ix1 + gx * bw               # [pw, r]
+            yy = jnp.broadcast_to(ys[:, None, :, None], (ph, pw, ratio, ratio))
+            xx = jnp.broadcast_to(xs[None, :, None, :], (ph, pw, ratio, ratio))
+            vals = _bilinear_at(feat[img], yy, xx)     # [C, ph, pw, r, r]
+            return vals.mean(axis=(-1, -2))
+
+        return jax.vmap(one)(jnp.arange(nboxes), y1, x1, bin_h, bin_w,
+                             img_of)
+
+    return op_call(f, x, boxes, boxes_num, name="roi_align", n_diff=1)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """≙ phi roi_pool_kernel: max pooling per quantized bin."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    nboxes = boxes.shape[0]
+
+    def f(feat, bxs, bnum):
+        h, w = feat.shape[-2], feat.shape[-1]
+        img_of = jnp.searchsorted(jnp.cumsum(bnum), jnp.arange(nboxes),
+                                  side="right")
+        x1 = jnp.round(bxs[:, 0] * spatial_scale)
+        y1 = jnp.round(bxs[:, 1] * spatial_scale)
+        x2 = jnp.round(bxs[:, 2] * spatial_scale)
+        y2 = jnp.round(bxs[:, 3] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        # dense candidate grid large enough for any bin, masked per-bin
+        ky = jnp.arange(h)
+        kx = jnp.arange(w)
+
+        def one(idx):
+            img = img_of[idx]
+            bin_h = rh[idx] / ph
+            bin_w = rw[idx] / pw
+            ys = y1[idx] + jnp.arange(ph)[:, None] * bin_h   # bin starts
+            ye = y1[idx] + (jnp.arange(ph)[:, None] + 1) * bin_h
+            xs = x1[idx] + jnp.arange(pw)[:, None] * bin_w
+            xe = x1[idx] + (jnp.arange(pw)[:, None] + 1) * bin_w
+            in_y = ((ky[None, :] >= jnp.floor(ys)) & (ky[None, :] < jnp.ceil(ye))
+                    & (ky[None, :] >= 0) & (ky[None, :] < h))   # [ph, H]
+            in_x = ((kx[None, :] >= jnp.floor(xs)) & (kx[None, :] < jnp.ceil(xe))
+                    & (kx[None, :] >= 0) & (kx[None, :] < w))   # [pw, W]
+            m = in_y[:, None, :, None] & in_x[None, :, None, :]  # [ph,pw,H,W]
+            fv = feat[img][None, None]                          # [1,1,C,H,W]
+            masked = jnp.where(m[:, :, None], fv, -jnp.inf)
+            out = jnp.max(masked, axis=(-1, -2))                # [ph,pw,C]
+            return jnp.transpose(out, (2, 0, 1))
+
+        return jax.vmap(one)(jnp.arange(nboxes))
+
+    return op_call(f, x, boxes, boxes_num, name="roi_pool", n_diff=1)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """≙ phi psroi_pool_kernel: position-sensitive average pooling — bin
+    (i,j) reads channel group (i*pw+j)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    nboxes = boxes.shape[0]
+    c_in = x.shape[1]
+    if c_in % (ph * pw):
+        raise ValueError(f"channels {c_in} must be divisible by "
+                         f"output area {ph * pw}")
+    c_out = c_in // (ph * pw)
+
+    def f(feat, bxs, bnum):
+        h, w = feat.shape[-2], feat.shape[-1]
+        img_of = jnp.searchsorted(jnp.cumsum(bnum), jnp.arange(nboxes),
+                                  side="right")
+        x1 = bxs[:, 0] * spatial_scale
+        y1 = bxs[:, 1] * spatial_scale
+        rh = jnp.maximum(bxs[:, 3] * spatial_scale - y1, 0.1)
+        rw = jnp.maximum(bxs[:, 2] * spatial_scale - x1, 0.1)
+        ky = jnp.arange(h)
+        kx = jnp.arange(w)
+
+        def one(idx):
+            img = img_of[idx]
+            bin_h = rh[idx] / ph
+            bin_w = rw[idx] / pw
+            ys = y1[idx] + jnp.arange(ph)[:, None] * bin_h
+            ye = ys + bin_h
+            xs = x1[idx] + jnp.arange(pw)[:, None] * bin_w
+            xe = xs + bin_w
+            in_y = ((ky[None, :] >= jnp.floor(ys)) & (ky[None, :] < jnp.ceil(ye)))
+            in_x = ((kx[None, :] >= jnp.floor(xs)) & (kx[None, :] < jnp.ceil(xe)))
+            m = (in_y[:, None, :, None] & in_x[None, :, None, :]).astype(
+                feat.dtype)                                      # [ph,pw,H,W]
+            fv = feat[img].reshape(ph * pw, c_out, h, w)
+            fv = fv.reshape(ph, pw, c_out, h, w)
+            s = jnp.einsum("ijhw,ijchw->cij", m, fv)
+            cnt = jnp.maximum(m.sum(axis=(-1, -2)), 1.0)
+            return s / cnt[None]
+
+        return jax.vmap(one)(jnp.arange(nboxes))
+
+    return op_call(f, x, boxes, boxes_num, name="psroi_pool", n_diff=1)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.a = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, *self.a)
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.a = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.a[0], self.a[1],
+                         aligned=aligned)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.a = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, *self.a)
+
+
+# ------------------------------------------------------------ deformable conv
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """≙ phi deformable_conv_kernel (DCNv1 when mask is None, DCNv2 with
+    mask). Bilinear-samples each kernel tap at its offset position, then one
+    big matmul against the flattened weights (MXU-shaped)."""
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    kh, kw = weight.shape[-2], weight.shape[-1]
+
+    def f(a, off, w, *rest):
+        n, c, h, ww_ = a.shape
+        oh = (h + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        ow = (ww_ + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        msk = None
+        b = None
+        ri = 0
+        if mask is not None:
+            msk = rest[ri]; ri += 1
+        if bias is not None:
+            b = rest[ri]
+        off = off.reshape(n, deformable_groups, kh * kw, 2, oh, ow)
+        dy = off[:, :, :, 0]                             # [N,dg,K,oh,ow]
+        dx = off[:, :, :, 1]
+        cg = c // deformable_groups
+
+        # static base grids [K, oh, ow]: tap (ky,kx) at output cell (oy,ox)
+        by_np = (np.arange(oh)[None, None, :, None] * st[0] - pd[0]
+                 + (np.arange(kh) * dl[0])[:, None, None, None])
+        bx_np = (np.arange(ow)[None, None, None, :] * st[1] - pd[1]
+                 + (np.arange(kw) * dl[1])[None, :, None, None])
+        by = jnp.asarray(np.broadcast_to(by_np, (kh, kw, oh, ow))
+                         .reshape(kh * kw, oh, ow).astype(np.float32))
+        bx = jnp.asarray(np.broadcast_to(bx_np, (kh, kw, oh, ow))
+                         .reshape(kh * kw, oh, ow).astype(np.float32))
+
+        def per_image(feat, dyi, dxi, mski):
+            ys = by[None] + dyi                          # [dg,K,oh,ow]
+            xs = bx[None] + dxi
+
+            def per_group(fg, ysg, xsg, msg):
+                vals = _bilinear_at(fg, ysg, xsg)        # [cg, K, oh, ow]
+                if msg is not None:
+                    vals = vals * msg[None]
+                return vals
+
+            groups_feat = feat.reshape(deformable_groups, cg, h, ww_)
+            msgs = (mski if mski is not None
+                    else jnp.ones((deformable_groups, kh * kw, oh, ow),
+                                  feat.dtype))
+            cols = jax.vmap(per_group)(groups_feat, ys, xs, msgs)
+            return cols.reshape(c, kh * kw, oh, ow)
+
+        if msk is not None:
+            msk = msk.reshape(n, deformable_groups, kh * kw, oh, ow)
+            cols = jax.vmap(per_image)(a, dy, dx, msk)
+        else:
+            cols = jax.vmap(per_image)(a, dy, dx,
+                                       jnp.ones((n, deformable_groups,
+                                                 kh * kw, oh, ow), a.dtype))
+        # contraction: out[n,o,y,x] = sum_{c,k} w[o,c,k] · cols[n,c,k,y,x]
+        co = w.shape[0]
+        wf = w.reshape(groups, co // groups, (c // groups) * kh * kw)
+        colsg = cols.reshape(n, groups, (c // groups) * kh * kw, oh * ow)
+        out = jnp.einsum("gok,ngkp->ngop", wf, colsg).reshape(n, co, oh, ow)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1)
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return op_call(f, *args, name="deform_conv2d")
+
+
+class DeformConv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn.initializer import Uniform
+
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        std = 1.0 / math.sqrt(in_channels * ks[0] * ks[1])
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups) + ks,
+            default_initializer=Uniform(-std, std), attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), is_bias=True, attr=bias_attr)
+        self.a = (stride, padding, dilation, deformable_groups, groups)
+
+    def forward(self, x, offset, mask=None):
+        s, p, d, dg, g = self.a
+        return deform_conv2d(x, offset, self.weight, self.bias, s, p, d, dg,
+                             g, mask)
+
+
+# ----------------------------------------------------------------- YOLO family
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head output to boxes+scores (≙ phi yolo_box_kernel).
+    x: [N, an*(5+cls), H, W] → (boxes [N, an*H*W, 4], scores [N, an*H*W, cls])."""
+    an = len(anchors) // 2
+    anchors_np = np.asarray(anchors, np.float32).reshape(an, 2)
+
+    def f(p, imgs):
+        n, _, h, w = p.shape
+        p = p.reshape(n, an, 5 + class_num, h, w)
+        gx = jnp.arange(w)[None, None, None, :]
+        gy = jnp.arange(h)[None, None, :, None]
+        sig = jax.nn.sigmoid
+        bx = (sig(p[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2 + gx) / w
+        by = (sig(p[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2 + gy) / h
+        aw = jnp.asarray(anchors_np[:, 0])[None, :, None, None]
+        ah = jnp.asarray(anchors_np[:, 1])[None, :, None, None]
+        bw = jnp.exp(p[:, :, 2]) * aw / (downsample_ratio * w)
+        bh = jnp.exp(p[:, :, 3]) * ah / (downsample_ratio * h)
+        conf = sig(p[:, :, 4])
+        cls = sig(p[:, :, 5:]) * conf[:, :, None]
+        imgh = imgs[:, 0].astype(p.dtype)[:, None, None, None]
+        imgw = imgs[:, 1].astype(p.dtype)[:, None, None, None]
+        x1 = (bx - bw / 2) * imgw
+        y1 = (by - bh / 2) * imgh
+        x2 = (bx + bw / 2) * imgw
+        y2 = (by + bh / 2) * imgh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imgw - 1)
+            y1 = jnp.clip(y1, 0, imgh - 1)
+            x2 = jnp.clip(x2, 0, imgw - 1)
+            y2 = jnp.clip(y2, 0, imgh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+        mask = (conf > conf_thresh).astype(p.dtype)
+        scores = (cls * mask[:, :, None]).transpose(0, 1, 3, 4, 2) \
+            .reshape(n, -1, class_num)
+        return boxes, scores
+
+    return op_call(f, x, img_size, name="yolo_box", n_diff=1)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 training loss (≙ phi yolo_loss_kernel): coordinate MSE/BCE +
+    objectness BCE (with ignore mask) + class BCE, summed per image."""
+    an_all = np.asarray(anchors, np.float32).reshape(-1, 2)
+    an_idx = list(anchor_mask)
+    an = len(an_idx)
+    nb = gt_box.shape[1]
+
+    def f(p, gbox, glab, *gs):
+        n, _, h, w = p.shape
+        p = p.reshape(n, an, 5 + class_num, h, w)
+        sig = jax.nn.sigmoid
+        # targets: assign each gt box to best anchor (by wh IoU) + grid cell
+        gx, gy = gbox[..., 0], gbox[..., 1]      # center, normalized
+        gw, gh = gbox[..., 2], gbox[..., 3]
+        gi = jnp.clip((gx * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
+        # anchor match in wh space (stride units)
+        bw_ = gw[..., None] * w * downsample_ratio
+        bh_ = gh[..., None] * h * downsample_ratio
+        inter = jnp.minimum(bw_, an_all[None, None, :, 0]) * \
+            jnp.minimum(bh_, an_all[None, None, :, 1])
+        union = bw_ * bh_ + an_all[None, None, :, 0] * an_all[None, None, :, 1] \
+            - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)  # [N,B]
+        valid = (gw > 0)
+        obj_tgt = jnp.zeros((n, an, h, w))
+        losses = 0.0
+        for b_i in range(nb):  # static unroll over max gt boxes
+            sel = valid[:, b_i]
+            a_best = best[:, b_i]
+            in_mask = jnp.zeros((n,), bool)
+            local_a = jnp.zeros((n,), jnp.int32)
+            for k, amk in enumerate(an_idx):
+                hit = a_best == amk
+                in_mask = in_mask | hit
+                local_a = jnp.where(hit, k, local_a)
+            use = sel & in_mask
+            ii, jj = gi[:, b_i], gj[:, b_i]
+            bidx = jnp.arange(n)
+            pred = p[bidx, local_a, :, jj, ii]          # [N, 5+cls]
+            tx = gx[:, b_i] * w - ii
+            ty = gy[:, b_i] * h - jj
+            aw = jnp.asarray(an_all[:, 0])[local_a]
+            ah = jnp.asarray(an_all[:, 1])[local_a]
+            tw = jnp.log(jnp.maximum(
+                gw[:, b_i] * w * downsample_ratio / aw, 1e-9))
+            th = jnp.log(jnp.maximum(
+                gh[:, b_i] * h * downsample_ratio / ah, 1e-9))
+            scale = 2.0 - gw[:, b_i] * gh[:, b_i]
+            bce = lambda lg, t: jnp.maximum(lg, 0) - lg * t + \
+                jnp.log1p(jnp.exp(-jnp.abs(lg)))
+            lbox = scale * (bce(pred[:, 0], tx) + bce(pred[:, 1], ty)
+                            + jnp.square(pred[:, 2] - tw)
+                            + jnp.square(pred[:, 3] - th))
+            onehot = jax.nn.one_hot(glab[:, b_i], class_num)
+            if use_label_smooth:
+                delta = 1.0 / class_num
+                onehot = onehot * (1 - delta) + delta / class_num
+            lcls = jnp.sum(bce(pred[:, 5:], onehot), axis=-1)
+            wgt = gs[0][:, b_i] if gs else jnp.ones((n,))
+            losses = losses + jnp.sum(jnp.where(use, (lbox + lcls) * wgt, 0.0))
+            obj_tgt = obj_tgt.at[bidx, local_a, jj, ii].max(
+                jnp.where(use, 1.0, 0.0))
+        # objectness: positives → 1; others → 0 (ignore_thresh handled as
+        # hard 0 targets — the IoU-ignore refinement needs per-cell best IoU)
+        lobj = jnp.maximum(p[:, :, 4], 0) - p[:, :, 4] * obj_tgt + \
+            jnp.log1p(jnp.exp(-jnp.abs(p[:, :, 4])))
+        losses = losses + jnp.sum(lobj)
+        return jnp.full((n,), 1.0) * losses / n
+
+    args = [x, gt_box, gt_label] + ([gt_score] if gt_score is not None else [])
+    return op_call(f, *args, name="yolo_loss", n_diff=1)
+
+
+# ------------------------------------------------------------------- box math
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (≙ phi prior_box_kernel). Static geometry — computed
+    with numpy once, returned as Tensors."""
+    h, w = int(input.shape[2]), int(input.shape[3])
+    imh, imw = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or imw / w
+    step_h = steps[1] or imh / h
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    for j in range(h):
+        for i in range(w):
+            cx = (i + offset) * step_w
+            cy = (j + offset) * step_h
+            for k, ms in enumerate(np.atleast_1d(min_sizes)):
+                if min_max_aspect_ratios_order:
+                    order = [1.0]
+                    if max_sizes is not None:
+                        order.append(("max", k))
+                    order += [a for a in ars if abs(a - 1.0) > 1e-6]
+                else:
+                    order = list(ars)
+                    if max_sizes is not None:
+                        order.insert(1, ("max", k))
+                for a in order:
+                    if isinstance(a, tuple):
+                        bs = math.sqrt(ms * np.atleast_1d(max_sizes)[a[1]])
+                        bw = bh = bs / 2
+                    else:
+                        bw = ms * math.sqrt(a) / 2
+                        bh = ms / math.sqrt(a) / 2
+                    box = [(cx - bw) / imw, (cy - bh) / imh,
+                           (cx + bw) / imw, (cy + bh) / imh]
+                    if clip:
+                        box = [min(max(v, 0.0), 1.0) for v in box]
+                    boxes.append(box)
+    nper = len(boxes) // (h * w)
+    out = np.asarray(boxes, np.float32).reshape(h, w, nper, 4)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return _mk(out), _mk(var)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """Encode/decode boxes against priors (≙ phi box_coder_kernel)."""
+    norm = 0.0 if box_normalized else 1.0
+
+    def f(pb, tb, *pvar_arr):
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw / 2
+        pcy = pb[:, 1] + ph / 2
+        if isinstance(prior_box_var, (list, tuple)):
+            var = jnp.asarray(prior_box_var, tb.dtype)
+            vx, vy, vw, vh = var[0], var[1], var[2], var[3]
+        elif pvar_arr:
+            pv = pvar_arr[0]
+            vx, vy, vw, vh = pv[:, 0], pv[:, 1], pv[:, 2], pv[:, 3]
+        else:
+            vx = vy = vw = vh = 1.0
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw / 2
+            tcy = tb[:, 1] + th / 2
+            ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / vx
+            oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / vy
+            ow = jnp.log(tw[:, None] / pw[None, :]) / vw
+            oh = jnp.log(th[:, None] / ph[None, :]) / vh
+            return jnp.stack([ox, oy, ow, oh], axis=-1)
+        # decode: tb [N, M, 4] deltas against priors (axis=0: priors on M)
+        if axis == 0:
+            pcx_, pcy_, pw_, ph_ = (v[None, :] for v in (pcx, pcy, pw, ph))
+        else:
+            pcx_, pcy_, pw_, ph_ = (v[:, None] for v in (pcx, pcy, pw, ph))
+        dcx = vx * tb[..., 0] * pw_ + pcx_
+        dcy = vy * tb[..., 1] * ph_ + pcy_
+        dw = jnp.exp(vw * tb[..., 2]) * pw_
+        dh = jnp.exp(vh * tb[..., 3]) * ph_
+        return jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                          dcx + dw / 2 - norm, dcy + dh / 2 - norm], axis=-1)
+
+    args = [prior_box, target_box]
+    if not isinstance(prior_box_var, (list, tuple)) and prior_box_var is not None:
+        args.append(prior_box_var)
+    return op_call(f, *args, name="box_coder", n_diff=2)
+
+
+# --------------------------------------------- host-side selection/postprocess
+def _iou_matrix(a, b):
+    area_a = np.maximum(a[:, 2] - a[:, 0], 0) * np.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(b[:, 3] - b[:, 1], 0)
+    x1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    y1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    x2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    y2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    return inter / np.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-9)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Hard NMS (≙ phi nms_kernel + vision/ops.py nms wrapper): returns kept
+    indices. Host-side: output size is data-dependent."""
+    b = _np(boxes).astype(np.float64)
+    n = b.shape[0]
+    s = _np(scores).astype(np.float64) if scores is not None else None
+    order = np.argsort(-s) if s is not None else np.arange(n)
+
+    def run_nms(idxs):
+        keep = []
+        while len(idxs):
+            i = idxs[0]
+            keep.append(i)
+            if len(idxs) == 1:
+                break
+            ious = _iou_matrix(b[i:i + 1], b[idxs[1:]])[0]
+            idxs = idxs[1:][ious <= iou_threshold]
+        return keep
+
+    if category_idxs is None:
+        keep = run_nms(order)
+    else:
+        cats = _np(category_idxs)
+        keep = []
+        for c in (categories if categories is not None else np.unique(cats)):
+            sub = order[cats[order] == c]
+            keep.extend(run_nms(sub))
+        if s is not None:
+            keep = sorted(keep, key=lambda i: -s[i])
+    if top_k is not None:
+        keep = keep[:top_k]
+    return _mk(np.asarray(keep, np.int64))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Soft suppression via the IoU decay matrix (≙ phi matrix_nms_kernel).
+    Host-side postprocessing."""
+    bb = _np(bboxes)
+    sc = _np(scores)
+    n_img, n_cls = sc.shape[0], sc.shape[1]
+    outs, indices, nums = [], [], []
+    for im in range(n_img):
+        dets = []
+        for c in range(n_cls):
+            if c == background_label:
+                continue
+            s = sc[im, c]
+            sel = np.where(s > score_threshold)[0]
+            if not len(sel):
+                continue
+            order = sel[np.argsort(-s[sel])][:nms_top_k]
+            boxes_c = bb[im, order]
+            scores_c = s[order]
+            iou = _iou_matrix(boxes_c, boxes_c)
+            iou = np.triu(iou, 1)
+            iou_cmax = iou.max(0)
+            if use_gaussian:
+                decay = np.exp((iou_cmax ** 2 - iou ** 2) / gaussian_sigma)
+            else:
+                decay = (1 - iou) / np.maximum(1 - iou_cmax, 1e-9)
+            dec = decay.min(0)
+            new_scores = scores_c * dec
+            for k, oi in enumerate(order):
+                if new_scores[k] > post_threshold:
+                    dets.append((c, new_scores[k], *boxes_c[k], oi))
+        dets.sort(key=lambda d: -d[1])
+        dets = dets[:keep_top_k] if keep_top_k > 0 else dets
+        nums.append(len(dets))
+        for d in dets:
+            outs.append(d[:6])
+            indices.append(im * bb.shape[1] + int(d[6]))
+    out = np.asarray(outs, np.float32).reshape(-1, 6) if outs else \
+        np.zeros((0, 6), np.float32)
+    res = [_mk(out)]
+    if return_index:
+        res.append(_mk(np.asarray(indices, np.int64)))
+    if return_rois_num:
+        res.append(_mk(np.asarray(nums, np.int64)))
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Route RoIs to FPN levels by scale (≙ phi distribute_fpn_proposals).
+    Host-side (per-level counts are data-dependent)."""
+    rois = _np(fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    ws = np.maximum(rois[:, 2] - rois[:, 0] + off, 0)
+    hs = np.maximum(rois[:, 3] - rois[:, 1] + off, 0)
+    scale = np.sqrt(ws * hs)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    n_levels = max_level - min_level + 1
+    outs, restore = [], np.zeros(len(rois), np.int64)
+    order = []
+    lvl_rois_num = []
+    for i, l in enumerate(range(min_level, max_level + 1)):
+        idx = np.where(lvl == l)[0]
+        outs.append(_mk(rois[idx]))
+        order.extend(idx.tolist())
+        lvl_rois_num.append(_mk(np.asarray([len(idx)], np.int64)) if rois_num
+                            is not None else None)
+    restore[np.asarray(order, np.int64)] = np.arange(len(rois))
+    restore_t = _mk(restore.reshape(-1, 1))
+    if rois_num is not None:
+        return outs, restore_t, lvl_rois_num
+    return outs, restore_t
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (≙ phi generate_proposals_v2): decode anchors,
+    clip, filter small, NMS. Host-side postprocessing."""
+    sc = _np(scores)
+    deltas = _np(bbox_deltas)
+    anc = _np(anchors).reshape(-1, 4)
+    var = _np(variances).reshape(-1, 4)
+    imgs = _np(img_size)
+    n = sc.shape[0]
+    all_rois, all_nums, all_scores = [], [], []
+    off = 1.0 if pixel_offset else 0.0
+    for im in range(n):
+        s = sc[im].transpose(1, 2, 0).reshape(-1)
+        d = deltas[im].transpose(1, 2, 0).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s_k, d_k, a_k, v_k = s[order], d[order], anc[order % len(anc)], \
+            var[order % len(var)]
+        aw = a_k[:, 2] - a_k[:, 0] + off
+        ah = a_k[:, 3] - a_k[:, 1] + off
+        acx = a_k[:, 0] + aw / 2
+        acy = a_k[:, 1] + ah / 2
+        cx = v_k[:, 0] * d_k[:, 0] * aw + acx
+        cy = v_k[:, 1] * d_k[:, 1] * ah + acy
+        w_ = np.exp(np.minimum(v_k[:, 2] * d_k[:, 2], 10.0)) * aw
+        h_ = np.exp(np.minimum(v_k[:, 3] * d_k[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - w_ / 2, cy - h_ / 2,
+                          cx + w_ / 2 - off, cy + h_ / 2 - off], axis=1)
+        imh, imw = imgs[im, 0], imgs[im, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, imw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, imh - off)
+        keep_sz = np.where((boxes[:, 2] - boxes[:, 0] + off >= min_size) &
+                           (boxes[:, 3] - boxes[:, 1] + off >= min_size))[0]
+        boxes, s_k = boxes[keep_sz], s_k[keep_sz]
+        keep = []
+        idxs = np.arange(len(boxes))
+        while len(idxs) and len(keep) < post_nms_top_n:
+            i = idxs[0]
+            keep.append(i)
+            if len(idxs) == 1:
+                break
+            ious = _iou_matrix(boxes[i:i + 1], boxes[idxs[1:]])[0]
+            idxs = idxs[1:][ious <= nms_thresh]
+        all_rois.append(boxes[keep])
+        all_scores.append(s_k[keep])
+        all_nums.append(len(keep))
+    rois = _mk(np.concatenate(all_rois).astype(np.float32)
+               if all_rois else np.zeros((0, 4), np.float32))
+    rscores = _mk(np.concatenate(all_scores).astype(np.float32)
+                  if all_scores else np.zeros((0,), np.float32))
+    if return_rois_num:
+        return rois, rscores, _mk(np.asarray(all_nums, np.int64))
+    return rois, rscores
+
+
+# ------------------------------------------------------------------ image I/O
+def read_file(filename, name=None):
+    """Read raw bytes into a uint8 tensor (≙ phi read_file_kernel)."""
+    with open(filename, "rb") as fh:
+        data = np.frombuffer(fh.read(), np.uint8)
+    return _mk(data)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to [C,H,W] uint8 (≙ phi decode_jpeg via
+    nvjpeg; here PIL on host — image decode is input-pipeline work)."""
+    import io as _io
+
+    from PIL import Image
+
+    raw = bytes(_np(x).tobytes())
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return _mk(arr.copy())

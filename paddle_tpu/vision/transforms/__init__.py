@@ -29,3 +29,10 @@ __all__ = [
     "ContrastTransform", "to_tensor", "normalize", "resize", "hflip", "vflip",
     "center_crop", "crop",
 ]
+
+from .extended import (  # noqa: F401,E402 — surface-gap closure
+    ColorJitter, Grayscale, HueTransform, SaturationTransform, RandomAffine,
+    RandomRotation, RandomPerspective, RandomErasing,
+    adjust_brightness, adjust_contrast, adjust_saturation, adjust_hue,
+    to_grayscale, affine, rotate, perspective, pad, erase,
+)

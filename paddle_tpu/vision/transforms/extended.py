@@ -1,0 +1,414 @@
+"""Extended transforms closing the paddle.vision.transforms surface gap
+(≙ python/paddle/vision/transforms/{transforms,functional}.py: color ops,
+geometric warps, erasing). Host-side numpy data-prep, matching the tier the
+reference runs them in (PIL/cv2 backends); warps share one inverse-map
+bilinear sampler."""
+from __future__ import annotations
+
+import math
+import random
+
+import numpy as np
+
+from .transforms import BaseTransform, _as_array
+
+
+def _chw_guard(arr):
+    """Return (HWC array, was_uint8)."""
+    a = np.asarray(arr)
+    return a, a.dtype == np.uint8
+
+
+def _finish(out, was_uint8):
+    return np.clip(out, 0, 255).astype(np.uint8) if was_uint8 \
+        else out.astype("float32")
+
+
+# ------------------------------------------------------------------ color ops
+def adjust_brightness(img, brightness_factor):
+    a, u8 = _chw_guard(_as_array(img))
+    return _finish(a.astype("float32") * brightness_factor, u8)
+
+
+def adjust_contrast(img, contrast_factor):
+    a, u8 = _chw_guard(_as_array(img))
+    f = a.astype("float32")
+    # gray mean like PIL: luminance average
+    if f.ndim == 3 and f.shape[-1] == 3:
+        mean = (0.299 * f[..., 0] + 0.587 * f[..., 1]
+                + 0.114 * f[..., 2]).mean()
+    else:
+        mean = f.mean()
+    return _finish((f - mean) * contrast_factor + mean, u8)
+
+
+def adjust_saturation(img, saturation_factor):
+    a, u8 = _chw_guard(_as_array(img))
+    f = a.astype("float32")
+    gray = (0.299 * f[..., 0] + 0.587 * f[..., 1]
+            + 0.114 * f[..., 2])[..., None]
+    return _finish(gray + (f - gray) * saturation_factor, u8)
+
+
+def _rgb_to_hsv(f):
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    mx = np.max(f, -1)
+    mn = np.min(f, -1)
+    d = mx - mn
+    h = np.zeros_like(mx)
+    m = d > 0
+    rm = m & (mx == r)
+    gm = m & (mx == g) & ~rm
+    bm = m & ~rm & ~gm
+    h[rm] = ((g - b)[rm] / d[rm]) % 6
+    h[gm] = (b - r)[gm] / d[gm] + 2
+    h[bm] = (r - g)[bm] / d[bm] + 4
+    h = h / 6
+    s = np.where(mx > 0, d / np.maximum(mx, 1e-9), 0)
+    return h, s, mx
+
+
+def _hsv_to_rgb(h, s, v):
+    i = np.floor(h * 6) % 6
+    f = h * 6 - np.floor(h * 6)
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    out = np.zeros(h.shape + (3,), "float32")
+    for k, (rr, gg, bb) in enumerate(
+            [(v, t, p), (q, v, p), (p, v, t), (p, q, v), (t, p, v),
+             (v, p, q)]):
+        m = i == k
+        out[m, 0] = rr[m]
+        out[m, 1] = gg[m]
+        out[m, 2] = bb[m]
+    return out
+
+
+def adjust_hue(img, hue_factor):
+    """hue_factor in [-0.5, 0.5] — rotate the hue channel."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError(f"hue_factor {hue_factor} not in [-0.5, 0.5]")
+    a, u8 = _chw_guard(_as_array(img))
+    f = a.astype("float32") / (255.0 if u8 else 1.0)
+    h, s, v = _rgb_to_hsv(f)
+    h = (h + hue_factor) % 1.0
+    out = _hsv_to_rgb(h, s, v) * (255.0 if u8 else 1.0)
+    return _finish(out, u8)
+
+
+def to_grayscale(img, num_output_channels=1):
+    a, u8 = _chw_guard(_as_array(img))
+    f = a.astype("float32")
+    gray = 0.299 * f[..., 0] + 0.587 * f[..., 1] + 0.114 * f[..., 2]
+    out = np.repeat(gray[..., None], num_output_channels, -1)
+    return _finish(out, u8)
+
+
+# ------------------------------------------------------------- geometric warps
+def _inverse_warp(arr, inv_mat, fill=0):
+    """Bilinear sample arr (H,W[,C]) at inv_mat-mapped output coords.
+    inv_mat: 3x3 output→input homogeneous map."""
+    h, w = arr.shape[:2]
+    yy, xx = np.mgrid[0:h, 0:w].astype("float32")
+    ones = np.ones_like(xx)
+    coords = np.stack([xx.ravel(), yy.ravel(), ones.ravel()])
+    src = inv_mat @ coords
+    sx = src[0] / np.maximum(np.abs(src[2]), 1e-9) * np.sign(src[2])
+    sy = src[1] / np.maximum(np.abs(src[2]), 1e-9) * np.sign(src[2])
+    x0 = np.floor(sx)
+    y0 = np.floor(sy)
+    wx = sx - x0
+    wy = sy - y0
+    f = arr.astype("float32")
+    if f.ndim == 2:
+        f = f[:, :, None]
+    out = np.zeros((h * w, f.shape[2]), "float32")
+    for dy, wgt_y in ((0, 1 - wy), (1, wy)):
+        for dx, wgt_x in ((0, 1 - wx), (1, wx)):
+            xi = x0 + dx
+            yi = y0 + dy
+            valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+            xi_c = np.clip(xi, 0, w - 1).astype(np.int64)
+            yi_c = np.clip(yi, 0, h - 1).astype(np.int64)
+            vals = np.where(valid[:, None], f[yi_c, xi_c], fill)
+            out += vals * (wgt_y * wgt_x)[:, None]
+    out = out.reshape(h, w, -1)
+    if arr.ndim == 2:
+        out = out[:, :, 0]
+    return out
+
+
+def _affine_inv(center, angle, translate, scale, shear):
+    cx, cy = center
+    # PIL/paddle convention: positive angle = counter-clockwise; with the
+    # image y-axis pointing down that means negating the math angle
+    rot = math.radians(-angle)
+    sx, sy = (math.radians(s) for s in shear)
+    # forward: T(center) R S Shear T(-center) + translate; invert analytically
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+    m = np.array([[a * scale, b * scale, 0],
+                  [c * scale, d * scale, 0],
+                  [0, 0, 1]], "float64")
+    t_pre = np.array([[1, 0, cx + translate[0]], [0, 1, cy + translate[1]],
+                      [0, 0, 1]], "float64")
+    t_post = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]], "float64")
+    fwd = t_pre @ m @ t_post
+    return np.linalg.inv(fwd)
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="bilinear", fill=0, center=None):
+    a, u8 = _chw_guard(_as_array(img))
+    if isinstance(shear, (int, float)):
+        shear = (float(shear), 0.0)
+    h, w = a.shape[:2]
+    ctr = center if center is not None else ((w - 1) / 2, (h - 1) / 2)
+    inv = _affine_inv(ctr, angle, translate, scale, shear)
+    return _finish(_inverse_warp(a, inv, fill), u8)
+
+
+def rotate(img, angle, interpolation="bilinear", expand=False, center=None,
+           fill=0):
+    a, u8 = _chw_guard(_as_array(img))
+    h, w = a.shape[:2]
+    ctr = center if center is not None else ((w - 1) / 2, (h - 1) / 2)
+    if expand:
+        rot = math.radians(angle)
+        nw = int(abs(w * math.cos(rot)) + abs(h * math.sin(rot)) + 0.5)
+        nh = int(abs(h * math.cos(rot)) + abs(w * math.sin(rot)) + 0.5)
+        pad_y, pad_x = (nh - h) // 2 + 1, (nw - w) // 2 + 1
+        padw = [(pad_y, pad_y), (pad_x, pad_x)] + \
+            [(0, 0)] * (a.ndim - 2)
+        a = np.pad(a, padw, constant_values=fill)
+        h, w = a.shape[:2]
+        ctr = ((w - 1) / 2, (h - 1) / 2)
+    inv = _affine_inv(ctr, angle, (0, 0), 1.0, (0.0, 0.0))
+    return _finish(_inverse_warp(a, inv, fill), u8)
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    """Solve the 3x3 homography mapping endpoints→startpoints (inverse)."""
+    A, b = [], []
+    for (ex, ey), (sx, sy) in zip(endpoints, startpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        b.append(sx)
+        A.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b.append(sy)
+    coeffs = np.linalg.solve(np.asarray(A, "float64"),
+                             np.asarray(b, "float64"))
+    return np.append(coeffs, 1.0).reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints, interpolation="bilinear",
+                fill=0):
+    a, u8 = _chw_guard(_as_array(img))
+    inv = _perspective_coeffs(startpoints, endpoints)
+    return _finish(_inverse_warp(a, inv, fill), u8)
+
+
+# ----------------------------------------------------------------- pad / erase
+def pad(img, padding, fill=0, padding_mode="constant"):
+    p = padding if isinstance(padding, (list, tuple)) else [padding] * 4
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+    arr = _as_array(img)
+    cfg = [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(arr, cfg, constant_values=fill)
+    return np.pad(arr, cfg, mode=padding_mode)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase region [i:i+h, j:j+w] with value(s) v (≙ functional.erase).
+    Accepts HWC numpy or CHW Tensor like the reference."""
+    from ...core.tensor import Tensor
+
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+
+        from ...core.dispatch import op_call
+
+        def f(a, vv):
+            return a.at[..., i:i + h, j:j + w].set(
+                jnp.broadcast_to(vv, a[..., i:i + h, j:j + w].shape))
+
+        vt = v if isinstance(v, Tensor) else \
+            Tensor(np.asarray(v, "float32"), _internal=True)
+        return op_call(f, img, vt, name="erase")
+    arr = _as_array(img)
+    out = arr if inplace else arr.copy()
+    out[i:i + h, j:j + w] = v
+    return out
+
+
+# ----------------------------------------------------------- transform classes
+class ColorJitter(BaseTransform):
+    """≙ transforms.ColorJitter: random brightness/contrast/saturation/hue
+    in random order."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+        self.hue = hue
+
+    def _apply_image(self, img):
+        ops = []
+        if self.brightness:
+            f = random.uniform(max(0, 1 - self.brightness),
+                               1 + self.brightness)
+            ops.append(lambda im: adjust_brightness(im, f))
+        if self.contrast:
+            fc = random.uniform(max(0, 1 - self.contrast), 1 + self.contrast)
+            ops.append(lambda im: adjust_contrast(im, fc))
+        if self.saturation:
+            fs = random.uniform(max(0, 1 - self.saturation),
+                                1 + self.saturation)
+            ops.append(lambda im: adjust_saturation(im, fs))
+        if self.hue:
+            fh = random.uniform(-self.hue, self.hue)
+            ops.append(lambda im: adjust_hue(im, fh))
+        random.shuffle(ops)
+        for op in ops:
+            img = op(img)
+        return _as_array(img)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _as_array(img)
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _as_array(img)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="bilinear", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(
+            degrees, (int, float)) else tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        a = _as_array(img)
+        h, w = a.shape[:2]
+        angle = random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = random.uniform(*self.scale) if self.scale else 1.0
+        sh = (random.uniform(-self.shear, self.shear), 0.0) if isinstance(
+            self.shear, (int, float)) and self.shear else (0.0, 0.0)
+        return affine(a, angle, (tx, ty), sc, sh, fill=self.fill,
+                      center=self.center)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="bilinear", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(
+            degrees, (int, float)) else tuple(degrees)
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        return rotate(img, random.uniform(*self.degrees), expand=self.expand,
+                      center=self.center, fill=self.fill)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="bilinear", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return _as_array(img)
+        a = _as_array(img)
+        h, w = a.shape[:2]
+        d = self.distortion_scale
+        half_h, half_w = h // 2, w // 2
+        tl = (random.randint(0, int(d * half_w)),
+              random.randint(0, int(d * half_h)))
+        tr = (w - 1 - random.randint(0, int(d * half_w)),
+              random.randint(0, int(d * half_h)))
+        br = (w - 1 - random.randint(0, int(d * half_w)),
+              h - 1 - random.randint(0, int(d * half_h)))
+        bl = (random.randint(0, int(d * half_w)),
+              h - 1 - random.randint(0, int(d * half_h)))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        return perspective(a, start, [tl, tr, br, bl], fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        a = _as_array(img)
+        if random.random() >= self.prob:
+            return a
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = math.exp(random.uniform(math.log(self.ratio[0]),
+                                         math.log(self.ratio[1])))
+            eh = int(round(math.sqrt(target * ar)))
+            ew = int(round(math.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = random.randint(0, h - eh - 1)
+                j = random.randint(0, w - ew - 1)
+                v = self.value if not isinstance(self.value, str) else \
+                    np.random.randn(eh, ew, *a.shape[2:]).astype("float32")
+                return erase(a, i, j, eh, ew, v, self.inplace)
+        return a

@@ -153,6 +153,17 @@ class TestPoolingExtended:
             _t(rs.randn(1, 2, 8, 8, 8).astype("float32")), 3, random_u=0.3)
         assert list(out3.shape) == [1, 2, 3, 3, 3]
 
+    def test_fractional_max_pool_kernel_size_matches_torch(self):
+        rs = np.random.RandomState(31)
+        x = rs.randn(1, 2, 16, 16).astype("float32")
+        u = 0.37
+        got = _np(F.fractional_max_pool2d(_t(x), 5, kernel_size=3,
+                                          random_u=u))
+        want = tF.fractional_max_pool2d(
+            torch.tensor(x), 3, output_size=5,
+            _random_samples=torch.full((1, 2, 2), u)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
     def test_fractional_max_pool_mask(self):
         rs = np.random.RandomState(21)
         x = rs.randn(1, 1, 8, 8).astype("float32")
@@ -218,6 +229,17 @@ class TestVisionSampling:
                                 align_corners=True))
         want = tF.grid_sample(torch.tensor(x), torch.tensor(grid), mode=mode,
                               padding_mode=pad, align_corners=True).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    @pytest.mark.parametrize("pad", ["zeros", "border", "reflection"])
+    def test_grid_sample_matches_torch_no_align(self, pad):
+        rs = np.random.RandomState(30)
+        x = rs.randn(1, 2, 6, 8).astype("float32")
+        grid = (rs.rand(1, 4, 5, 2).astype("float32") * 3.0 - 1.5)
+        got = _np(F.grid_sample(_t(x), _t(grid), padding_mode=pad,
+                                align_corners=False))
+        want = tF.grid_sample(torch.tensor(x), torch.tensor(grid),
+                              padding_mode=pad, align_corners=False).numpy()
         np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
 
     def test_grid_sample_grad_flows(self):
@@ -312,6 +334,43 @@ class TestLossZoo:
         loss.backward()
         assert np.isfinite(_np(logits.grad)).all()
 
+    def test_gaussian_nll_variance_gets_grad(self):
+        rs = np.random.RandomState(32)
+        mu = _t(rs.randn(6).astype("float32"))
+        var = _t((rs.rand(6) + 0.2).astype("float32"))
+        mu.stop_gradient = False
+        var.stop_gradient = False
+        F.gaussian_nll_loss(mu, _t(rs.randn(6).astype("float32")),
+                            var).backward()
+        assert var.grad is not None and np.isfinite(_np(var.grad)).all()
+
+    def test_rnnt_fastemit_changes_grad_not_nan(self):
+        rs = np.random.RandomState(33)
+        lp = _t(rs.randn(1, 3, 3, 4).astype("float32"))
+        lp.stop_gradient = False
+        y = _t(np.array([[1, 2]], dtype="int32"))
+        args = (y, _t(np.array([3], "int64")), _t(np.array([2], "int64")))
+        loss0 = F.rnnt_loss(lp, *args, fastemit_lambda=0.0)
+        loss0.backward()
+        g0 = _np(lp.grad).copy()
+        lp.clear_gradient()
+        loss1 = F.rnnt_loss(lp, *args, fastemit_lambda=0.5)
+        loss1.backward()
+        g1 = _np(lp.grad)
+        assert np.isfinite(g1).all()
+        assert float(_np(loss1)) > float(_np(loss0))  # λ·L_emit is positive
+        assert np.abs(g1 - g0).max() > 1e-6  # regularizer changes grads
+
+    def test_flash_attn_return_softmax(self):
+        rs = np.random.RandomState(34)
+        qkv = rs.randn(1, 4, 3, 2, 8).astype("float32")
+        out, sm = F.flash_attn_qkvpacked(_t(qkv), causal=True,
+                                         return_softmax=True)
+        s = _np(sm)
+        assert s.shape == (1, 2, 4, 4)
+        np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+        assert (np.triu(s[0, 0], 1) == 0).all()  # causal mask applied
+
     def test_rnnt_loss_brute_force(self):
         # tiny lattice: T=2, U=1 (one label), V=3, blank=0
         T, U, V = 2, 1, 3
@@ -324,10 +383,10 @@ class TestLossZoo:
         p1 = logp[0, 0, 1] + logp[0, 1, 0] + logp[1, 1, 0]
         p2 = logp[0, 0, 0] + logp[1, 0, 1] + logp[1, 1, 0]
         want = -np.logaddexp(p1, p2)
-        got = float(_np(F.rnnt_loss(_t(lp), _t(y),
-                                    _t(np.array([T], "int64")),
-                                    _t(np.array([U], "int64")),
-                                    reduction="none")))
+        got = float(np.asarray(_np(F.rnnt_loss(
+            _t(lp), _t(y), _t(np.array([T], "int64")),
+            _t(np.array([U], "int64")), fastemit_lambda=0.0,
+            reduction="none"))).reshape(-1)[0])
         np.testing.assert_allclose(got, want, rtol=1e-4)
 
     def test_margin_cross_entropy(self):

@@ -141,3 +141,59 @@ def test_no_grad_params_skipped():
     paddle.sum(w1).backward()
     opt.step()  # w2 has no grad — must not crash
     np.testing.assert_allclose(w2.numpy(), np.ones(2))
+
+
+class TestNewOptimizers:
+    """ASGD / Rprop / LBFGS / LinearLR (reference optimizer/{asgd,rprop,
+    lbfgs}.py, optimizer/lr.py LinearLR)."""
+
+    def _fit(self, opt_cls, steps=30, **kw):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(1)
+        rs = np.random.RandomState(0)
+        lin = nn.Linear(4, 1)
+        opt = opt_cls(parameters=lin.parameters(), **kw)
+        X = paddle.to_tensor(rs.randn(64, 4).astype("float32"))
+        Y = paddle.to_tensor(
+            (np.asarray(X._data) @ np.ones((4, 1))).astype("float32"))
+        losses = []
+        for _ in range(steps):
+            loss = ((lin(X) - Y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss._data)))
+        return losses
+
+    def test_asgd_converges(self):
+        losses = self._fit(paddle.optimizer.ASGD, learning_rate=0.05,
+                           batch_num=4)
+        assert losses[-1] < losses[0] * 0.3
+
+    def test_rprop_converges(self):
+        losses = self._fit(paddle.optimizer.Rprop, learning_rate=0.01)
+        assert losses[-1] < losses[0] * 0.3
+
+    def test_lbfgs_quadratic(self):
+        target = np.random.RandomState(3).randn(6).astype("float32")
+        w = paddle.to_tensor(np.zeros(6, dtype="float32"))
+        w.stop_gradient = False
+        opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=25,
+                                     line_search_fn='strong_wolfe',
+                                     parameters=[w])
+        loss = opt.step(lambda: ((w - paddle.to_tensor(target)) ** 2).sum())
+        assert loss < 1e-4
+        np.testing.assert_allclose(np.asarray(w._data), target, atol=1e-2)
+
+    def test_linear_lr(self):
+        sched = paddle.optimizer.lr.LinearLR(0.1, total_steps=10,
+                                             start_factor=0.5)
+        vals = []
+        for _ in range(10):
+            vals.append(sched())
+            sched.step()
+        np.testing.assert_allclose(vals[0], 0.05, rtol=1e-6)
+        assert vals[-1] > vals[0]
+        sched.step()
+        np.testing.assert_allclose(sched(), 0.1, rtol=1e-6)

@@ -1,0 +1,229 @@
+"""paddle.vision.ops parity (≙ python/paddle/vision/ops.py:47) — numerics vs
+brute-force numpy references (torchvision unavailable in this image)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestRoIFamily:
+    def test_roi_align_constant_map(self):
+        # constant feature map → every pooled value equals that constant
+        x = np.full((1, 3, 16, 16), 7.0, dtype="float32")
+        boxes = np.array([[2.0, 2.0, 10.0, 10.0]], dtype="float32")
+        out = vops.roi_align(_t(x), _t(boxes),
+                             _t(np.array([1], "int32")), 4)
+        assert list(out.shape) == [1, 3, 4, 4]
+        np.testing.assert_allclose(_np(out), 7.0, rtol=1e-6)
+
+    def test_roi_align_linear_ramp(self):
+        # f(y,x) = x → pooled bin centers reproduce the ramp
+        w = np.arange(16, dtype="float32")
+        x = np.broadcast_to(w, (16, 16))[None, None].copy()
+        boxes = np.array([[4.0, 4.0, 12.0, 12.0]], dtype="float32")
+        out = _np(vops.roi_align(_t(x), _t(boxes),
+                                 _t(np.array([1], "int32")), 2,
+                                 sampling_ratio=2))[0, 0]
+        # aligned=True shifts by half a pixel: bin centers at x=3.5+{2,6}
+        np.testing.assert_allclose(out[0], [5.5, 9.5], rtol=1e-5)
+
+    def test_roi_pool_max_semantics(self):
+        x = np.zeros((1, 1, 8, 8), dtype="float32")
+        x[0, 0, 2, 2] = 5.0
+        x[0, 0, 6, 6] = 9.0
+        boxes = np.array([[0.0, 0.0, 7.0, 7.0]], dtype="float32")
+        out = _np(vops.roi_pool(_t(x), _t(boxes),
+                                _t(np.array([1], "int32")), 2))[0, 0]
+        assert out[0, 0] == 5.0 and out[1, 1] == 9.0
+
+    def test_psroi_pool_position_sensitivity(self):
+        # channel group g is constant g → output bin (i,j) = i*pw + j
+        ph = pw = 2
+        c = ph * pw
+        x = np.stack([np.full((8, 8), g, dtype="float32")
+                      for g in range(c)])[None]
+        boxes = np.array([[0.0, 0.0, 8.0, 8.0]], dtype="float32")
+        out = _np(vops.psroi_pool(_t(x), _t(boxes),
+                                  _t(np.array([1], "int32")), 2))[0, 0]
+        np.testing.assert_allclose(out, [[0, 1], [2, 3]])
+
+    def test_roi_layers(self):
+        x = _t(np.random.RandomState(0).randn(1, 4, 8, 8).astype("float32"))
+        boxes = _t(np.array([[1.0, 1.0, 6.0, 6.0]], "float32"))
+        bn = _t(np.array([1], "int32"))
+        assert list(vops.RoIAlign(3)(x, boxes, bn).shape) == [1, 4, 3, 3]
+        assert list(vops.RoIPool(3)(x, boxes, bn).shape) == [1, 4, 3, 3]
+        assert list(vops.PSRoIPool(2)(x, boxes, bn).shape) == [1, 1, 2, 2]
+
+    def test_roi_align_grad(self):
+        x = _t(np.random.RandomState(1).randn(1, 2, 8, 8).astype("float32"))
+        x.stop_gradient = False
+        out = vops.roi_align(x, _t(np.array([[1., 1., 6., 6.]], "float32")),
+                             _t(np.array([1], "int32")), 2)
+        out.sum().backward()
+        assert np.isfinite(_np(x.grad)).all() and np.abs(_np(x.grad)).sum() > 0
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_conv2d(self):
+        import paddle_tpu.nn.functional as F
+
+        rs = np.random.RandomState(2)
+        x = rs.randn(2, 3, 8, 8).astype("float32")
+        w = rs.randn(4, 3, 3, 3).astype("float32")
+        offset = np.zeros((2, 2 * 9, 6, 6), dtype="float32")
+        got = _np(vops.deform_conv2d(_t(x), _t(offset), _t(w)))
+        want = _np(F.conv2d(_t(x), _t(w)))
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_mask_scales_contributions(self):
+        rs = np.random.RandomState(3)
+        x = rs.randn(1, 2, 6, 6).astype("float32")
+        w = rs.randn(2, 2, 3, 3).astype("float32")
+        offset = np.zeros((1, 18, 4, 4), dtype="float32")
+        mask_half = np.full((1, 9, 4, 4), 0.5, dtype="float32")
+        got = _np(vops.deform_conv2d(_t(x), _t(offset), _t(w),
+                                     mask=_t(mask_half)))
+        base = _np(vops.deform_conv2d(_t(x), _t(offset), _t(w)))
+        np.testing.assert_allclose(got, base * 0.5, rtol=1e-4, atol=1e-5)
+
+    def test_layer(self):
+        layer = vops.DeformConv2D(3, 5, 3, padding=1)
+        x = _t(np.random.RandomState(4).randn(1, 3, 8, 8).astype("float32"))
+        off = _t(np.zeros((1, 18, 8, 8), dtype="float32"))
+        assert list(layer(x, off).shape) == [1, 5, 8, 8]
+
+
+class TestYolo:
+    def test_yolo_box_shapes_and_decode(self):
+        an = [10, 13, 16, 30]
+        x = np.zeros((1, 2 * 7, 4, 4), dtype="float32")  # 2 anchors, 2 cls
+        boxes, scores = vops.yolo_box(_t(x), _t(np.array([[64, 64]], "int32")),
+                                      an, 2, 0.01, 16)
+        assert list(boxes.shape) == [1, 32, 4]
+        assert list(scores.shape) == [1, 32, 2]
+        b = _np(boxes)
+        # zero logits → sigmoid 0.5 → center of each cell; check first box
+        # cell (0,0): cx = 0.5/4 * 64 = 8
+        cx = (b[0, 0, 0] + b[0, 0, 2]) / 2
+        np.testing.assert_allclose(cx, 8.0, atol=0.2)
+
+    def test_yolo_loss_runs_and_differentiates(self):
+        rs = np.random.RandomState(5)
+        x = _t(rs.randn(2, 2 * 7, 4, 4).astype("float32"))
+        x.stop_gradient = False
+        gt = np.zeros((2, 3, 4), dtype="float32")
+        gt[0, 0] = [0.5, 0.5, 0.3, 0.4]
+        lab = np.zeros((2, 3), dtype="int64")
+        loss = vops.yolo_loss(x, _t(gt), _t(lab), [10, 13, 16, 30], [0, 1],
+                              2, 0.7, 16)
+        loss.sum().backward()
+        assert np.isfinite(_np(x.grad)).all()
+
+
+class TestBoxMath:
+    def test_prior_box(self):
+        feat = _t(np.zeros((1, 8, 4, 4), "float32"))
+        img = _t(np.zeros((1, 3, 32, 32), "float32"))
+        boxes, var = vops.prior_box(feat, img, min_sizes=[8.0],
+                                    aspect_ratios=[1.0, 2.0], clip=True)
+        # per cell: ar 1.0 + ar 2.0 (no flip, no max_sizes) = 2 priors
+        assert list(boxes.shape) == [4, 4, 2, 4]
+        b = _np(boxes)
+        assert (b >= 0).all() and (b <= 1).all()
+        assert list(var.shape) == [4, 4, 2, 4]
+        # with max_sizes: one extra prior per cell
+        boxes2, _ = vops.prior_box(feat, img, min_sizes=[8.0],
+                                   max_sizes=[16.0], aspect_ratios=[1.0])
+        assert list(boxes2.shape) == [4, 4, 2, 4]
+
+    def test_box_coder_roundtrip(self):
+        priors = np.array([[10, 10, 30, 30], [20, 20, 60, 50]], "float32")
+        targets = np.array([[12, 14, 28, 32], [18, 22, 58, 44]], "float32")
+        enc = vops.box_coder(_t(priors), [0.1, 0.1, 0.2, 0.2], _t(targets))
+        # decode the diagonal (each target against its own prior)
+        diag = _np(enc)[np.arange(2), np.arange(2)][:, None, :]
+        dec = vops.box_coder(_t(priors), [0.1, 0.1, 0.2, 0.2],
+                             _t(np.transpose(diag, (1, 0, 2))),
+                             code_type="decode_center_size")
+        np.testing.assert_allclose(_np(dec)[0], targets, rtol=1e-3, atol=1e-2)
+
+
+class TestSelection:
+    def test_nms_basic(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                         dtype="float32")
+        scores = np.array([0.9, 0.8, 0.7], dtype="float32")
+        keep = _np(vops.nms(_t(boxes), 0.5, _t(scores)))
+        np.testing.assert_array_equal(keep, [0, 2])
+
+    def test_nms_categories(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], dtype="float32")
+        scores = np.array([0.9, 0.8], dtype="float32")
+        cats = np.array([0, 1], dtype="int64")
+        keep = _np(vops.nms(_t(boxes), 0.5, _t(scores), _t(cats), [0, 1]))
+        assert sorted(keep.tolist()) == [0, 1]  # different classes both kept
+
+    def test_matrix_nms(self):
+        bboxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                            [40, 40, 50, 50]]], dtype="float32")
+        scores = np.array([[[0.9, 0.85, 0.8]]], dtype="float32")  # 1 class
+        out, idx, num = vops.matrix_nms(_t(bboxes), _t(scores), 0.1, 0.05,
+                                        10, 10, background_label=-1,
+                                        return_index=True)
+        o = _np(out)
+        assert o.shape[1] == 6 and int(_np(num)[0]) == o.shape[0]
+        # far box keeps its full score; overlapped second box decayed
+        far = o[np.isclose(o[:, 2], 40).nonzero()[0]]
+        assert len(far) and far[0, 1] == pytest.approx(0.8, rel=1e-3)
+
+    def test_distribute_fpn_proposals(self):
+        rois = np.array([[0, 0, 16, 16], [0, 0, 100, 100], [0, 0, 300, 300]],
+                        dtype="float32")
+        outs, restore = vops.distribute_fpn_proposals(_t(rois), 2, 5, 4, 224)
+        assert len(outs) == 4
+        total = sum(o.shape[0] for o in outs)
+        assert total == 3
+        r = _np(restore).reshape(-1)
+        assert sorted(r.tolist()) == [0, 1, 2]
+
+    def test_generate_proposals(self):
+        rs = np.random.RandomState(6)
+        scores = rs.rand(1, 3, 4, 4).astype("float32")
+        deltas = (rs.randn(1, 12, 4, 4) * 0.1).astype("float32")
+        anchors = np.tile(np.array([[0, 0, 15, 15], [0, 0, 31, 31],
+                                    [0, 0, 7, 7]], "float32"), (16, 1))
+        var = np.ones_like(anchors)
+        rois, rscores, num = vops.generate_proposals(
+            _t(scores), _t(deltas), _t(np.array([[64, 64]], "float32")),
+            _t(anchors), _t(var), pre_nms_top_n=20, post_nms_top_n=5,
+            return_rois_num=True)
+        assert _np(rois).shape[1] == 4
+        assert _np(rois).shape[0] == int(_np(num)[0]) <= 5
+
+
+class TestImageIO:
+    def test_read_file_decode_jpeg(self, tmp_path):
+        from PIL import Image
+
+        # smooth gradient (random noise is destroyed by JPEG compression)
+        gy, gx = np.mgrid[0:16, 0:16]
+        arr = np.stack([gy * 16, gx * 16, (gy + gx) * 8], -1).astype("uint8")
+        p = str(tmp_path / "t.jpg")
+        Image.fromarray(arr).save(p, quality=95)
+        raw = vops.read_file(p)
+        assert _np(raw).dtype == np.uint8 and _np(raw).size > 100
+        img = vops.decode_jpeg(raw, mode="rgb")
+        assert list(img.shape) == [3, 16, 16]
+        # JPEG is lossy; just require rough agreement
+        diff = np.abs(_np(img).transpose(1, 2, 0).astype(int) - arr.astype(int))
+        assert diff.mean() < 12
