@@ -21,10 +21,33 @@ from .distributions import (
     Poisson,
     Uniform,
 )
+from .extended import (
+    Binomial,
+    Cauchy,
+    Chi2,
+    ContinuousBernoulli,
+    Dirichlet,
+    ExponentialFamily,
+    Independent,
+    LKJCholesky,
+    MultivariateNormal,
+    StudentT,
+    TransformedDistribution,
+)
 from .kl import kl_divergence, register_kl
+from . import transform
+from .transform import (
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+    Transform,
+)
 
 __all__ = [
     "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
     "Exponential", "Gamma", "Beta", "Laplace", "Gumbel", "LogNormal",
     "Multinomial", "Poisson", "Geometric", "kl_divergence", "register_kl",
-]
+    "Binomial", "Cauchy", "Chi2", "ContinuousBernoulli", "Dirichlet",
+    "ExponentialFamily", "Independent", "LKJCholesky", "MultivariateNormal",
+    "StudentT", "TransformedDistribution",
+] + transform.__all__

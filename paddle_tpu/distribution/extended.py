@@ -1,0 +1,520 @@
+"""Distribution surface completion (≙ python/paddle/distribution/
+{binomial,chi2,cauchy,continuous_bernoulli,dirichlet,multivariate_normal,
+student_t,lkj_cholesky,independent,transformed_distribution,
+exponential_family}.py): jnp/jax.random compositions through op_call."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+
+from ..core.dispatch import op_call
+from ..core.rng import next_key
+from ..core.tensor import Tensor
+from .distributions import Distribution, _shape, _t
+
+
+class ExponentialFamily(Distribution):
+    """Base marker for exponential-family distributions (≙ distribution/
+    exponential_family.py); entropy via Bregman identity is specialized in
+    subclasses here."""
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _t(total_count)
+        self.probs = _t(probs)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+    def sample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self._batch_shape)
+
+        def fn(n, p):
+            return jax.random.binomial(key, n.astype(jnp.float32), p,
+                                       shape=shp).astype(jnp.float32)
+
+        out = op_call(fn, self.total_count, self.probs, name="binomial_sample")
+        return out.detach()
+
+    def log_prob(self, value):
+        def fn(v, n, p):
+            logc = (jsp.gammaln(n + 1) - jsp.gammaln(v + 1)
+                    - jsp.gammaln(n - v + 1))
+            eps = 1e-12
+            return logc + v * jnp.log(p + eps) + (n - v) * jnp.log1p(-p + eps)
+
+        return op_call(fn, _t(value), self.total_count, self.probs,
+                       name="binomial_log_prob")
+
+    def entropy(self):
+        # sum over the finite support (exact, static n); support rides a
+        # NEW trailing axis so batched (n, p) broadcast correctly
+        n_max = int(np.asarray(self.total_count._data).max())
+        ks = jnp.arange(n_max + 1, dtype=jnp.float32)
+
+        def fn(n, p):
+            nb = n[..., None]
+            pb = p[..., None]
+            logc = (jsp.gammaln(nb + 1) - jsp.gammaln(ks + 1)
+                    - jsp.gammaln(jnp.maximum(nb - ks, 0) + 1))
+            eps = 1e-12
+            lp = logc + ks * jnp.log(pb + eps) \
+                + (nb - ks) * jnp.log1p(-pb + eps)
+            valid = ks <= nb
+            pk = jnp.where(valid, jnp.exp(lp), 0.0)
+            return -jnp.sum(pk * jnp.where(valid, lp, 0.0), axis=-1)
+
+        return op_call(fn, self.total_count, self.probs,
+                       name="binomial_entropy")
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self._batch_shape)
+
+        def fn(loc, scale):
+            return loc + scale * jax.random.cauchy(key, shp, jnp.float32)
+
+        return op_call(fn, self.loc, self.scale, name="cauchy_rsample")
+
+    def log_prob(self, value):
+        def fn(v, loc, scale):
+            z = (v - loc) / scale
+            return -jnp.log(jnp.pi * scale * (1 + z * z))
+
+        return op_call(fn, _t(value), self.loc, self.scale,
+                       name="cauchy_log_prob")
+
+    def cdf(self, value):
+        def fn(v, loc, scale):
+            return jnp.arctan((v - loc) / scale) / jnp.pi + 0.5
+
+        return op_call(fn, _t(value), self.loc, self.scale, name="cauchy_cdf")
+
+    def entropy(self):
+        return op_call(lambda s: jnp.log(4 * jnp.pi * s), self.scale,
+                       name="cauchy_entropy")
+
+
+class Chi2(Distribution):
+    """Chi-squared (Gamma(df/2, rate=1/2) — ≙ distribution/chi2.py)."""
+
+    def __init__(self, df, name=None):
+        self.df = _t(df)
+        super().__init__(tuple(self.df.shape))
+
+    @property
+    def mean(self):
+        return self.df
+
+    @property
+    def variance(self):
+        return self.df * 2.0
+
+    def sample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self._batch_shape)
+
+        def fn(df):
+            return 2.0 * jax.random.gamma(key, df / 2.0, shp, jnp.float32)
+
+        return op_call(fn, self.df, name="chi2_sample").detach()
+
+    def log_prob(self, value):
+        def fn(v, df):
+            k = df / 2.0
+            return ((k - 1) * jnp.log(v) - v / 2.0 - k * math.log(2.0)
+                    - jsp.gammaln(k))
+
+        return op_call(fn, _t(value), self.df, name="chi2_log_prob")
+
+    def entropy(self):
+        def fn(df):
+            k = df / 2.0
+            return (k + math.log(2.0) + jsp.gammaln(k)
+                    + (1 - k) * jsp.digamma(k))
+
+        return op_call(fn, self.df, name="chi2_entropy")
+
+
+class ContinuousBernoulli(Distribution):
+    """≙ distribution/continuous_bernoulli.py: [0,1]-supported pseudo-
+    Bernoulli with normalizing constant C(p)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _t(probs)
+        self._lims = lims
+        super().__init__(tuple(self.probs.shape))
+
+    def _log_C(self, p):
+        # log normalizer; taylor-stable near p=0.5
+        lo, hi = self._lims
+        safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+        cut = (safe < lo) | (safe > hi)
+        pc = jnp.where(cut, safe, 0.4)  # dummy away from 0.5 for stable log
+        log_norm = jnp.log(jnp.abs(2.0 * jnp.arctanh(1 - 2 * pc))) - \
+            jnp.log(jnp.abs(1 - 2 * pc))
+        taylor = math.log(2.0) + 4.0 / 3 * (safe - 0.5) ** 2 \
+            + 104.0 / 45 * (safe - 0.5) ** 4
+        return jnp.where(cut, log_norm, taylor)
+
+    def log_prob(self, value):
+        def fn(v, p):
+            eps = 1e-6
+            ps = jnp.clip(p, eps, 1 - eps)
+            return (v * jnp.log(ps) + (1 - v) * jnp.log1p(-ps)
+                    + self._log_C(ps))
+
+        return op_call(fn, _t(value), self.probs, name="cb_log_prob")
+
+    def sample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self._batch_shape)
+
+        def fn(p):
+            u = jax.random.uniform(key, shp, jnp.float32, 1e-6, 1 - 1e-6)
+            ps = jnp.clip(p, 1e-6, 1 - 1e-6)
+            mid = jnp.abs(ps - 0.5) < 1e-3
+            safe = jnp.where(mid, 0.4, ps)
+            icdf = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+                    / (jnp.log(safe) - jnp.log1p(-safe)))
+            return jnp.where(mid, u, icdf)
+
+        return op_call(fn, self.probs, name="cb_sample").detach()
+
+    @property
+    def mean(self):
+        def fn(p):
+            ps = jnp.clip(p, 1e-6, 1 - 1e-6)
+            mid = jnp.abs(ps - 0.5) < 1e-3
+            safe = jnp.where(mid, 0.4, ps)
+            m = safe / (2 * safe - 1) + 1.0 / (2 * jnp.arctanh(1 - 2 * safe))
+            return jnp.where(mid, 0.5, m)
+
+        return op_call(fn, self.probs, name="cb_mean")
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        shape = tuple(self.concentration.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        def fn(c):
+            return c / jnp.sum(c, -1, keepdims=True)
+
+        return op_call(fn, self.concentration, name="dirichlet_mean")
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = tuple(shape) + tuple(self.concentration.shape)
+
+        def fn(c):
+            return jax.random.dirichlet(key, c, shape=tuple(shape)
+                                        + self._batch_shape)
+
+        return op_call(fn, self.concentration, name="dirichlet_rsample")
+
+    def log_prob(self, value):
+        def fn(v, c):
+            return (jnp.sum((c - 1) * jnp.log(v), -1)
+                    + jsp.gammaln(jnp.sum(c, -1))
+                    - jnp.sum(jsp.gammaln(c), -1))
+
+        return op_call(fn, _t(value), self.concentration,
+                       name="dirichlet_log_prob")
+
+    def entropy(self):
+        def fn(c):
+            a0 = jnp.sum(c, -1)
+            k = c.shape[-1]
+            return (jnp.sum(jsp.gammaln(c), -1) - jsp.gammaln(a0)
+                    + (a0 - k) * jsp.digamma(a0)
+                    - jnp.sum((c - 1) * jsp.digamma(c), -1))
+
+        return op_call(fn, self.concentration, name="dirichlet_entropy")
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _t(loc)
+        given = [a is not None for a in (covariance_matrix, precision_matrix,
+                                         scale_tril)]
+        if sum(given) != 1:
+            raise ValueError("exactly one of covariance_matrix, "
+                             "precision_matrix, scale_tril must be given")
+        if covariance_matrix is not None:
+            self.covariance_matrix = _t(covariance_matrix)
+        elif precision_matrix is not None:
+            prec = _t(precision_matrix)
+            from ..ops.linalg import inv
+
+            self.covariance_matrix = inv(prec)
+        else:
+            st = _t(scale_tril)
+            from ..ops.linalg import matmul
+
+            self.covariance_matrix = matmul(st, st.mT)
+        d = self.loc.shape[-1]
+        super().__init__(tuple(self.loc.shape[:-1]), (d,))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        def fn(cov):
+            return jnp.diagonal(cov, axis1=-2, axis2=-1)
+
+        return op_call(fn, self.covariance_matrix, name="mvn_variance")
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = tuple(shape) + self._batch_shape + self._event_shape
+
+        def fn(loc, cov):
+            chol = jnp.linalg.cholesky(cov)
+            z = jax.random.normal(key, shp, jnp.float32)
+            return loc + jnp.einsum("...ij,...j->...i", chol, z)
+
+        return op_call(fn, self.loc, self.covariance_matrix,
+                       name="mvn_rsample")
+
+    def log_prob(self, value):
+        def fn(v, loc, cov):
+            d = v.shape[-1]
+            diff = v - loc
+            chol = jnp.linalg.cholesky(cov)
+            # broadcast the factor over value's batch dims (cho_solve
+            # requires matching batch shapes)
+            chol_b = jnp.broadcast_to(chol, diff.shape[:-1] + chol.shape[-2:])
+            sol = jax.scipy.linalg.cho_solve((chol_b, True), diff[..., None])
+            maha = jnp.sum(diff * sol[..., 0], -1)
+            logdet = 2 * jnp.sum(jnp.log(jnp.diagonal(
+                chol, axis1=-2, axis2=-1)), -1)
+            return -0.5 * (maha + logdet + d * math.log(2 * math.pi))
+
+        return op_call(fn, _t(value), self.loc, self.covariance_matrix,
+                       name="mvn_log_prob")
+
+    def entropy(self):
+        def fn(cov):
+            d = cov.shape[-1]
+            chol = jnp.linalg.cholesky(cov)
+            logdet = 2 * jnp.sum(jnp.log(jnp.diagonal(
+                chol, axis1=-2, axis2=-1)), -1)
+            return 0.5 * (d * (1 + math.log(2 * math.pi)) + logdet)
+
+        return op_call(fn, self.covariance_matrix, name="mvn_entropy")
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    def sample(self, shape=()):
+        key = next_key()
+        shp = _shape(shape, self._batch_shape)
+
+        def fn(df, loc, scale):
+            return loc + scale * jax.random.t(key, df, shp, jnp.float32)
+
+        return op_call(fn, self.df, self.loc, self.scale,
+                       name="studentt_sample").detach()
+
+    def log_prob(self, value):
+        def fn(v, df, loc, scale):
+            z = (v - loc) / scale
+            return (jsp.gammaln((df + 1) / 2) - jsp.gammaln(df / 2)
+                    - 0.5 * jnp.log(df * jnp.pi) - jnp.log(scale)
+                    - (df + 1) / 2 * jnp.log1p(z * z / df))
+
+        return op_call(fn, _t(value), self.df, self.loc, self.scale,
+                       name="studentt_log_prob")
+
+    def entropy(self):
+        def fn(df, scale):
+            h = ((df + 1) / 2 * (jsp.digamma((df + 1) / 2)
+                                 - jsp.digamma(df / 2))
+                 + 0.5 * jnp.log(df) + jsp.betaln(df / 2, 0.5))
+            return h + jnp.log(scale)
+
+        return op_call(fn, self.df, self.scale, name="studentt_entropy")
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over correlation-matrix Cholesky factors (≙ distribution/
+    lkj_cholesky.py; onion-method sampler)."""
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion",
+                 name=None):
+        if dim < 2:
+            raise ValueError("dim must be >= 2")
+        self.dim = dim
+        self.concentration = _t(concentration)
+        super().__init__(tuple(self.concentration.shape), (dim, dim))
+
+    def sample(self, shape=()):
+        key = next_key()
+        d = self.dim
+        shp = tuple(shape) + self._batch_shape
+
+        def fn(conc):
+            # onion method: build rows from beta-distributed radii
+            k1, k2 = jax.random.split(key)
+            chol = jnp.zeros(shp + (d, d), jnp.float32)
+            chol = chol.at[..., 0, 0].set(1.0)
+            beta0 = conc + (d - 2) / 2.0
+            keys = jax.random.split(k2, d - 1)
+            for i in range(1, d):
+                beta_conc = beta0 - (i - 1) / 2.0
+                y = jax.random.beta(keys[i - 1], i / 2.0, beta_conc, shp,
+                                    jnp.float32)
+                u = jax.random.normal(jax.random.fold_in(k1, i),
+                                      shp + (i,), jnp.float32)
+                u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+                w = jnp.sqrt(y)[..., None] * u
+                chol = chol.at[..., i, :i].set(w)
+                chol = chol.at[..., i, i].set(jnp.sqrt(1 - y))
+            return chol
+
+        return op_call(fn, self.concentration, name="lkj_sample").detach()
+
+    def log_prob(self, value):
+        d = self.dim
+
+        def fn(v, conc):
+            # torch LKJCholesky.log_prob formula (LKJ 2009, p.1999)
+            diag = jnp.diagonal(v, axis1=-2, axis2=-1)[..., 1:]
+            i = jnp.arange(2, d + 1, dtype=jnp.float32)
+            expo = 2 * (conc[..., None] - 1) + d - i
+            unnorm = jnp.sum(expo * jnp.log(diag), -1)
+            dm1 = d - 1
+            alpha = conc + 0.5 * dm1
+            normalize = (0.5 * dm1 * math.log(math.pi)
+                         + jsp.multigammaln(alpha - 0.5, dm1)
+                         - dm1 * jsp.gammaln(alpha))
+            return unnorm - normalize
+
+        return op_call(fn, _t(value), self.concentration,
+                       name="lkj_log_prob")
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (≙ distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank, name=None):
+        self.base = base
+        self.reinterpreted_batch_rank = reinterpreted_batch_rank
+        b = base.batch_shape
+        k = reinterpreted_batch_rank
+        if k > len(b):
+            raise ValueError("reinterpreted_batch_rank exceeds batch rank")
+        super().__init__(b[:len(b) - k], b[len(b) - k:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def _sum_rightmost(self, t, k):
+        if k == 0:
+            return t
+        from ..ops.reduction import sum as dense_sum
+
+        return dense_sum(t, axis=tuple(range(t.ndim - k, t.ndim)))
+
+    def log_prob(self, value):
+        return self._sum_rightmost(self.base.log_prob(value),
+                                   self.reinterpreted_batch_rank)
+
+    def entropy(self):
+        return self._sum_rightmost(self.base.entropy(),
+                                   self.reinterpreted_batch_rank)
+
+
+class TransformedDistribution(Distribution):
+    """Pushforward through invertible transforms (≙ distribution/
+    transformed_distribution.py). Transforms need forward/inverse/
+    forward_log_det_jacobian like paddle.distribution.Transform."""
+
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        from ..ops.math import subtract
+
+        y = value
+        log_det = None
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ld = t.forward_log_det_jacobian(x)
+            log_det = ld if log_det is None else log_det + ld
+            y = x
+        lp = self.base.log_prob(y)
+        return subtract(lp, log_det) if log_det is not None else lp

@@ -1,0 +1,317 @@
+"""paddle.distribution.transform (≙ python/paddle/distribution/
+transform.py:40 __all__): invertible bijectors with log-det-Jacobians, the
+building blocks of TransformedDistribution. Each forward/inverse/ldj is a
+jnp composition through op_call (differentiable, jit-able)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op_call
+from ..core.tensor import Tensor
+from .distributions import _t
+
+__all__ = [
+    'Transform', 'AbsTransform', 'AffineTransform', 'ChainTransform',
+    'ExpTransform', 'IndependentTransform', 'PowerTransform',
+    'ReshapeTransform', 'SigmoidTransform', 'SoftmaxTransform',
+    'StackTransform', 'StickBreakingTransform', 'TanhTransform',
+]
+
+
+class Transform:
+    _type = 'bijection'
+
+    def forward(self, x):
+        return op_call(self._forward, _t(x), name=type(self).__name__.lower())
+
+    def inverse(self, y):
+        return op_call(self._inverse, _t(y),
+                       name=type(self).__name__.lower() + "_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return op_call(self._fldj, _t(x),
+                       name=type(self).__name__.lower() + "_fldj")
+
+    def inverse_log_det_jacobian(self, y):
+        from ..ops.math import neg
+
+        return neg(self.forward_log_det_jacobian(self.inverse(y)))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # subclass hooks (raw jnp)
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    """y = |x| — surjection, inverse returns the positive branch."""
+    _type = 'surjection'
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _fldj(self, x):
+        return jnp.zeros_like(x)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def forward(self, x):
+        return op_call(lambda v, l, s: l + s * v, _t(x), self.loc, self.scale,
+                       name="affine")
+
+    def inverse(self, y):
+        return op_call(lambda v, l, s: (v - l) / s, _t(y), self.loc,
+                       self.scale, name="affine_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return op_call(
+            lambda v, s: jnp.broadcast_to(jnp.log(jnp.abs(s)), v.shape),
+            _t(x), self.scale, name="affine_fldj")
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def forward(self, x):
+        return op_call(lambda v, p: jnp.power(v, p), _t(x), self.power,
+                       name="power")
+
+    def inverse(self, y):
+        return op_call(lambda v, p: jnp.power(v, 1.0 / p), _t(y), self.power,
+                       name="power_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return op_call(
+            lambda v, p: jnp.log(jnp.abs(p * jnp.power(v, p - 1))),
+            _t(x), self.power, name="power_fldj")
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(jnp.clip(y, -1 + 1e-6, 1 - 1e-6))
+
+    def _fldj(self, x):
+        # log(1 - tanh(x)^2) = 2(log2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis; inverse = log (up to additive
+    constant, reference semantics)."""
+    _type = 'other'
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        raise NotImplementedError(
+            "SoftmaxTransform is not a bijection; no log-det-Jacobian")
+
+
+class ReshapeTransform(Transform):
+    _type = 'other'
+
+    def __init__(self, in_event_shape, out_event_shape):
+        import numpy as np
+
+        if int(np.prod(in_event_shape)) != int(np.prod(out_event_shape)):
+            raise ValueError("in/out event shapes must have equal size")
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _fldj(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        k = len(shape) - len(self.in_event_shape)
+        return tuple(shape[:k]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        k = len(shape) - len(self.out_event_shape)
+        return tuple(shape[:k]) + self.in_event_shape
+
+
+class StickBreakingTransform(Transform):
+    """R^k → open simplex^(k+1) via stick breaking."""
+    _type = 'other'
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zcum = jnp.cumprod(1 - z, axis=-1)
+        pad = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+        lower = jnp.concatenate([pad, zcum], -1)
+        zfull = jnp.concatenate([z, pad], -1)
+        return lower * zfull
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        cum = jnp.cumsum(y[..., :-1], -1)
+        rest = 1 - jnp.concatenate(
+            [jnp.zeros(y.shape[:-1] + (1,), y.dtype), cum[..., :-1]], -1)
+        z = y[..., :-1] / rest
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _fldj(self, x):
+        # torch identity: Σ_i (-x̃_i + logσ(x̃_i) + log y_i), y = forward(x)
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        xo = x - offset
+        y = self._forward(x)
+        return jnp.sum(-xo + jax.nn.log_sigmoid(xo)
+                       + jnp.log(y[..., :-1]), -1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ChainTransform(Transform):
+    _type = 'other'
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ld = t.forward_log_det_jacobian(x)
+            total = ld if total is None else total + ld
+            x = t.forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+
+class IndependentTransform(Transform):
+    """Sum the rightmost `reinterpreted_batch_rank` dims of the base
+    transform's log-det."""
+    _type = 'other'
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.k = reinterpreted_batch_rank
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ld = self.base.forward_log_det_jacobian(x)
+        from ..ops.reduction import sum as dense_sum
+
+        if self.k == 0:
+            return ld
+        return dense_sum(ld, axis=tuple(range(ld.ndim - self.k, ld.ndim)))
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] along slice i of `axis`."""
+    _type = 'other'
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _split(self, x):
+        from ..ops.extras import unstack
+
+        return unstack(x, axis=self.axis)
+
+    def forward(self, x):
+        from ..ops.manipulation import stack
+
+        parts = self._split(x)
+        return stack([t.forward(p) for t, p in zip(self.transforms, parts)],
+                     axis=self.axis)
+
+    def inverse(self, y):
+        from ..ops.manipulation import stack
+
+        parts = self._split(y)
+        return stack([t.inverse(p) for t, p in zip(self.transforms, parts)],
+                     axis=self.axis)
+
+    def forward_log_det_jacobian(self, x):
+        from ..ops.manipulation import stack
+
+        parts = self._split(x)
+        return stack([t.forward_log_det_jacobian(p)
+                      for t, p in zip(self.transforms, parts)],
+                     axis=self.axis)

@@ -72,3 +72,307 @@ __all__ = [
     "fused_rotary_position_embedding", "fused_dropout_add", "fused_linear",
     "fused_bias_act", "fused_multi_head_attention",
 ]
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """≙ incubate fused_matmul_bias (cublasLt epilogue fusion — XLA fuses
+    the bias add into the dot automatically)."""
+    from paddle_tpu.ops.linalg import matmul
+
+    out = matmul(x, y, transpose_x, transpose_y)
+    return out + bias if bias is not None else out
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu", name=None):
+    """≙ incubate fused_linear_activation: matmul + bias + act epilogue."""
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    if activation in ("none", None, ""):
+        return out
+    return getattr(F, activation)(out)
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True, mode='upscale_in_train',
+                                           name=None):
+    """≙ incubate fused_bias_dropout_residual_layer_norm: one logical op,
+    fused by XLA: LN(residual + dropout(x + bias))."""
+    if bias is not None:
+        x = x + bias
+    x = F.dropout(x, p=dropout_rate, training=training, mode=mode)
+    y = x + residual
+    return F.layer_norm(y, y.shape[-1:], weight=ln_scale, bias=ln_bias,
+                        epsilon=ln_epsilon)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      mode='upscale_in_train', name=None):
+    """≙ incubate fused_feedforward: the transformer FFN block
+    (LN ∘ residual ∘ dropout ∘ linear ∘ act ∘ linear [∘ LN])."""
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1:], weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln1_epsilon)
+    h = F.linear(x, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    h = F.dropout(h, p=dropout1_rate, training=training, mode=mode)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    h = F.dropout(h, p=dropout2_rate, training=training, mode=mode)
+    out = residual + h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1:], weight=ln2_scale,
+                           bias=ln2_bias, epsilon=ln2_epsilon)
+    return out
+
+
+def fused_moe(x, gate_weight, ffn1_weights, ffn2_weights, ffn1_biases=None,
+              ffn2_biases=None, moe_topk=2, norm_topk_prob=True, name=None):
+    """≙ incubate fused_moe (phi fusion/fused_moe_kernel): top-k gated
+    mixture of expert FFNs. Dense-compute formulation: every expert runs on
+    every token and the top-k gate mask selects — the MXU-friendly layout
+    (no dynamic shapes); the EP-sharded path lives in
+    paddle_tpu.incubate.distributed.models.moe."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.dispatch import op_call
+
+    n_exp = len(ffn1_weights)
+
+    def f(xv, gw, *ws):
+        w1s = ws[:n_exp]
+        w2s = ws[n_exp:2 * n_exp]
+        off = 2 * n_exp
+        b1s = ws[off:off + n_exp] if ffn1_biases is not None else [None] * n_exp
+        if ffn1_biases is not None:
+            off += n_exp
+        b2s = ws[off:off + n_exp] if ffn2_biases is not None else [None] * n_exp
+        logits = xv @ gw                                   # [..., E]
+        import jax
+
+        probs = jax.nn.softmax(logits, axis=-1)
+        topv, topi = jax.lax.top_k(probs, moe_topk)
+        if norm_topk_prob:
+            topv = topv / jnp.sum(topv, -1, keepdims=True)
+        out = jnp.zeros_like(xv)
+        for e in range(n_exp):
+            h = xv @ w1s[e]
+            if b1s[e] is not None:
+                h = h + b1s[e]
+            h = jax.nn.gelu(h)
+            h = h @ w2s[e]
+            if b2s[e] is not None:
+                h = h + b2s[e]
+            w = jnp.sum(jnp.where(topi == e, topv, 0.0), -1, keepdims=True)
+            out = out + w * h
+        return out
+
+    args = [x, gate_weight] + list(ffn1_weights) + list(ffn2_weights)
+    if ffn1_biases is not None:
+        args += list(ffn1_biases)
+    if ffn2_biases is not None:
+        args += list(ffn2_biases)
+    return op_call(f, *args, name="fused_moe")
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, qkv_out_scale=None,
+                               out_shift=None, seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype='default', name=None):
+    """≙ incubate masked_multihead_attention (single-token decode step with
+    KV cache): x [B, 3*H*D] packed qkv for ONE step; cache_kv
+    [2, B, H, MaxLen, D]. Returns (out [B, H*D], updated cache)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.dispatch import op_call
+
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv")
+    if sequence_lengths is None:
+        # the CUDA kernel tracks the timestep inside its cache object; a
+        # pure function cannot — writing to slot 0 every step would
+        # silently drop all history, so demand the lengths explicitly
+        raise ValueError(
+            "masked_multihead_attention needs sequence_lengths (the current "
+            "decode position per batch row) — the stateless XLA formulation "
+            "cannot infer the timestep from cache_kv")
+    nh = cache_kv.shape[2]
+    dh = cache_kv.shape[4]
+
+    def f(xv, cache, *rest):
+        b = xv.shape[0]
+        qkv = xv.reshape(b, 3, nh, dh)
+        if bias is not None:
+            qkv = qkv + rest[0].reshape(1, 3, nh, dh)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]      # [B, H, D]
+        # append to cache at position = current length (scalar per batch)
+        if sequence_lengths is not None:
+            pos = rest[-1].reshape(b)
+        else:
+            pos = jnp.zeros((b,), jnp.int32)
+        import jax
+
+        def upd(c_b, k_b, v_b, p):
+            c_b = c_b.at[0, :, p].set(k_b)
+            c_b = c_b.at[1, :, p].set(v_b)
+            return c_b
+
+        cache_b = jnp.swapaxes(cache, 0, 1)            # [B, 2, H, L, D]
+        cache_b = jax.vmap(upd)(cache_b, k, v, pos.astype(jnp.int32))
+        new_cache = jnp.swapaxes(cache_b, 0, 1)
+        keys = new_cache[0]                            # [B, H, L, D]
+        vals = new_cache[1]
+        scores = jnp.einsum("bhd,bhld->bhl", q, keys) / jnp.sqrt(
+            jnp.asarray(dh, xv.dtype))
+        ar = jnp.arange(keys.shape[2])
+        mask = ar[None, None, :] <= pos[:, None, None]
+        scores = jnp.where(mask, scores, -jnp.inf)
+        att = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhl,bhld->bhd", att, vals).reshape(b, nh * dh)
+        return out, new_cache
+
+    args = [x, cache_kv]
+    if bias is not None:
+        args.append(bias)
+    if sequence_lengths is not None:
+        args.append(sequence_lengths)
+    return op_call(f, *args, name="masked_multihead_attention", n_diff=2)
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size,
+                     name=None):
+    """≙ incubate blha_get_max_len: max sequence lengths feeding
+    block_multihead_attention."""
+    from paddle_tpu.ops.reduction import max as dense_max
+
+    return dense_max(seq_lens_encoder), dense_max(seq_lens_decoder)
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
+                              seq_lens_decoder, seq_lens_this_time,
+                              padding_offsets, cum_offsets, cu_seqlens_q,
+                              cu_seqlens_k, block_tables, *args, **kwargs):
+    """≙ incubate block_multihead_attention (paged-attention serving
+    kernel). The paged-KV layout is a CUDA serving artifact; this build's
+    decode path is masked_multihead_attention + dense caches. Raises with
+    that pointer rather than silently emulating the block table."""
+    raise NotImplementedError(
+        "block_multihead_attention's paged-KV block tables are a CUDA "
+        "serving-engine layout; use masked_multihead_attention (dense KV "
+        "cache) or nn.functional.scaled_dot_product_attention — the XLA "
+        "serving path keeps caches dense per sequence.")
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0, name=None):
+    """≙ incubate variable_length_memory_efficient_attention: batched
+    attention with per-sequence valid lengths — lowered to a dense mask
+    (padding is the TPU-native varlen strategy). query [B, H, S, D]."""
+    import math as _m
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.dispatch import op_call
+
+    def f(q, k, v, sl, kvl, *m):
+        sc = scale if scale is not None else 1.0 / _m.sqrt(q.shape[-1])
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sc
+        if m:
+            scores = scores + m[0]
+        kmask = (jnp.arange(k.shape[2])[None, None, None, :]
+                 < kvl[:, None, None, None])
+        scores = jnp.where(kmask, scores, -jnp.inf)
+        if causal:
+            cm = jnp.tril(jnp.ones((q.shape[2], k.shape[2]), bool))
+            scores = jnp.where(cm[None, None], scores, -jnp.inf)
+        att = jax.nn.softmax(scores, axis=-1)
+        att = jnp.where(jnp.isnan(att), 0.0, att)
+        return jnp.einsum("bhqk,bhkd->bhqd", att, v)
+
+    fargs = [query, key, value, seq_lens, kv_seq_lens]
+    if mask is not None:
+        fargs.append(mask)
+    return op_call(f, *fargs, name="varlen_mem_efficient_attention",
+                   n_diff=3)
+
+
+__all__ += [
+    "fused_matmul_bias", "fused_linear_activation",
+    "fused_bias_dropout_residual_layer_norm", "fused_feedforward",
+    "fused_moe", "masked_multihead_attention", "blha_get_max_len",
+    "block_multihead_attention", "variable_length_memory_efficient_attention",
+    "fused_multi_transformer",
+]
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-5, cache_kvs=None, time_step=None,
+                            attn_mask=None, dropout_rate=0.0, activation="gelu",
+                            training=False, mode='upscale_in_train',
+                            trans_qkvw=True, ring_id=-1, name=None):
+    """≙ incubate fused_multi_transformer (the serving megakernel stacking
+    N pre-LN transformer layers): expressed as the layer loop — XLA compiles
+    it into one program; the per-layer fusion work the CUDA kernel does by
+    hand falls out of the jit."""
+    n_layers = len(qkv_weights)
+    out = x
+    for i in range(n_layers):
+        residual = out
+        h = F.layer_norm(out, out.shape[-1:], weight=ln_scales[i],
+                         bias=ln_biases[i], epsilon=epsilon) \
+            if pre_layer_norm else out
+        b, s, hidden = h.shape
+        qkv = F.linear(h, qkv_weights[i].reshape([hidden, -1])
+                       if not trans_qkvw else
+                       qkv_weights[i].reshape([-1, hidden]).T,
+                       qkv_biases[i].reshape([-1])
+                       if qkv_biases is not None and qkv_biases[i] is not None
+                       else None)
+        qkv = qkv.reshape([b, s, 3, -1])
+        d_model = qkv.shape[-1]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        # single-head fallback when head count unknown: treat d_model as H*D
+        # with D=64 if divisible, else one head
+        dh = 64 if d_model % 64 == 0 else d_model
+        heads = d_model // dh
+        q = q.reshape([b, s, heads, dh])
+        k = k.reshape([b, s, heads, dh])
+        v = v.reshape([b, s, heads, dh])
+        att = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                             is_causal=attn_mask is None)
+        att = att.reshape([b, s, d_model])
+        att = F.linear(att, linear_weights[i],
+                       linear_biases[i] if linear_biases is not None else None)
+        out = residual + att
+        if not pre_layer_norm:
+            # post-LN: normalize AFTER the residual add (reference layout)
+            out = F.layer_norm(out, out.shape[-1:], weight=ln_scales[i],
+                               bias=ln_biases[i], epsilon=epsilon)
+        residual = out
+        h = F.layer_norm(out, out.shape[-1:], weight=ffn_ln_scales[i],
+                         bias=ffn_ln_biases[i], epsilon=epsilon) \
+            if pre_layer_norm else out
+        h = F.linear(h, ffn1_weights[i],
+                     ffn1_biases[i] if ffn1_biases is not None else None)
+        h = getattr(F, activation)(h)
+        h = F.linear(h, ffn2_weights[i],
+                     ffn2_biases[i] if ffn2_biases is not None else None)
+        out = residual + h
+        if not pre_layer_norm:
+            out = F.layer_norm(out, out.shape[-1:], weight=ffn_ln_scales[i],
+                               bias=ffn_ln_biases[i], epsilon=epsilon)
+    return out, cache_kvs

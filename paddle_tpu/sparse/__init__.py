@@ -240,3 +240,176 @@ def _install_tensor_methods():
 
 
 _install_tensor_methods()
+
+
+# --------------------------------------------------------- surface completion
+# (≙ python/paddle/sparse/{unary,binary,multiary}.py remaining exports)
+
+def asin(x, name=None):
+    return _unary(x, jnp.arcsin, "sparse_asin")
+
+
+def asinh(x, name=None):
+    return _unary(x, jnp.arcsinh, "sparse_asinh")
+
+
+def atan(x, name=None):
+    return _unary(x, jnp.arctan, "sparse_atan")
+
+
+def atanh(x, name=None):
+    return _unary(x, jnp.arctanh, "sparse_atanh")
+
+
+def sinh(x, name=None):
+    return _unary(x, jnp.sinh, "sparse_sinh")
+
+
+def tan(x, name=None):
+    return _unary(x, jnp.tan, "sparse_tan")
+
+
+def square(x, name=None):
+    return _unary(x, jnp.square, "sparse_square")
+
+
+def log1p(x, name=None):
+    return _unary(x, jnp.log1p, "sparse_log1p")
+
+
+def expm1(x, name=None):
+    return _unary(x, jnp.expm1, "sparse_expm1")
+
+
+def deg2rad(x, name=None):
+    return _unary(x, jnp.deg2rad, "sparse_deg2rad")
+
+
+def rad2deg(x, name=None):
+    return _unary(x, jnp.rad2deg, "sparse_rad2deg")
+
+
+def isnan(x, name=None):
+    _check_sparse(x)
+    vals = op_call(jnp.isnan, x._spvals, name="sparse_isnan")
+    return _build(vals, x._spidx, x._spshape)
+
+
+def divide(x, y, name=None):
+    return _ewise(x, y, jnp.divide, "sparse_divide")
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix × dense vector (≙ sparse/binary.py mv). Differentiable
+    w.r.t. both the sparse values and the vector (indices ride last,
+    excluded via n_diff)."""
+    _check_sparse(x)
+
+    def f(vals, v, idx):
+        rows = idx[:, 0]
+        cols = idx[:, 1]
+        contrib = vals * v[cols]
+        return jnp.zeros((x._spshape[0],), vals.dtype).at[rows].add(contrib)
+
+    return op_call(f, x._spvals, vec,
+                   Tensor(x._spidx, _internal=True, stop_gradient=True),
+                   name="sparse_mv", n_diff=2)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta·input + alpha·(x @ y) with sparse x (≙ sparse/multiary.py)."""
+    prod = matmul(x, y)
+    from ..ops.math import add as dense_add, scale
+
+    pd = prod if not is_sparse(prod) else to_dense(prod)
+    ind = input if not is_sparse(input) else to_dense(input)
+    return dense_add(scale(ind, beta), scale(pd, alpha))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    """Sparse reduce-sum (dense-aligned; result is dense like paddle when
+    reducing, sparse when axis is None? — paddle returns sparse; we return
+    a 0-d/reduced DENSE tensor for axis reductions and sparse scalar-like
+    for full sum, matching value semantics)."""
+    from ..ops.reduction import sum as dense_sum
+
+    return dense_sum(to_dense(x), axis=axis, dtype=dtype, keepdim=keepdim)
+
+
+def reshape(x, shape, name=None):
+    _check_sparse(x)
+    dense = to_dense(x)
+    from ..ops.manipulation import reshape as dense_reshape
+
+    return to_sparse_coo(dense_reshape(dense, shape))
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    _check_sparse(x)
+    dense = to_dense(x)
+    import builtins
+
+    def f(a):
+        sl = [builtins.slice(None)] * a.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            sl[ax % a.ndim] = builtins.slice(st, en)
+        return a[tuple(sl)]
+
+    out = op_call(f, dense, name="sparse_slice")
+    return to_sparse_coo(out)
+
+
+def coalesce(x, name=None):
+    """Merge duplicate indices (≙ sparse/creation.py coalesce)."""
+    _check_sparse(x)
+    idx = np.asarray(x._spidx)
+    uniq, inv = np.unique(idx, axis=0, return_inverse=True)
+
+    def f(vals):
+        return jnp.zeros((uniq.shape[0],) + vals.shape[1:],
+                         vals.dtype).at[jnp.asarray(inv)].add(vals)
+
+    vals = op_call(f, x._spvals, name="sparse_coalesce")
+    return _build(vals, uniq, x._spshape)
+
+
+def is_same_shape(x, y, name=None):
+    sx = tuple(x._spshape) if is_sparse(x) else tuple(x.shape)
+    sy = tuple(y._spshape) if is_sparse(y) else tuple(y.shape)
+    return sx == sy
+
+
+def mask_as(x, mask, name=None):
+    """Keep x's entries at mask's sparsity pattern (≙ sparse/unary.py
+    mask_as)."""
+    _check_sparse(mask)
+    dense = x if not is_sparse(x) else to_dense(x)
+    idx = Tensor(mask._spidx, _internal=True, stop_gradient=True)
+
+    def f(a, ind):
+        return a[tuple(ind[:, d] for d in range(ind.shape[1]))]
+
+    vals = op_call(f, dense, idx, name="sparse_mask_as", n_diff=1)
+    return _build(vals, mask._spidx, mask._spshape)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA over the densified matrix (≙ sparse pca_lowrank)."""
+    from ..ops.extras import svd_lowrank
+    from ..ops.reduction import mean as dense_mean
+
+    dense = to_dense(x) if is_sparse(x) else x
+    qq = q or min(6, *dense.shape[-2:])
+    if center:
+        from ..ops.math import subtract as dense_sub
+
+        m = dense_mean(dense, axis=-2, keepdim=True)
+        dense = dense_sub(dense, m)
+    return svd_lowrank(dense, q=qq, niter=niter)
+
+
+__all__ += [
+    "asin", "asinh", "atan", "atanh", "sinh", "tan", "square", "log1p",
+    "expm1", "deg2rad", "rad2deg", "isnan", "divide", "mv", "addmm", "sum",
+    "reshape", "slice", "coalesce", "is_same_shape", "mask_as", "pca_lowrank",
+]

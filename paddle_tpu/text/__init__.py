@@ -1,4 +1,14 @@
-"""paddle_tpu.text — NLP model zoo (≙ PaddleNLP models the BASELINE.json
-config ladder names: BERT/ERNIE fine-tune, GPT-3-medium, LLaMA-7B)."""
+"""paddle_tpu.text (≙ python/paddle/text): NLP datasets + ViterbiDecoder,
+plus the model zoo the BASELINE.json config ladder names (BERT/ERNIE
+fine-tune, GPT-3-medium, LLaMA-7B)."""
 from . import models
 from . import datasets
+from .datasets import (
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)
+from .viterbi import ViterbiDecoder, viterbi_decode
+
+__all__ = [
+    "Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14",
+    "WMT16", "ViterbiDecoder", "viterbi_decode", "models", "datasets",
+]

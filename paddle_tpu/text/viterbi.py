@@ -1,0 +1,84 @@
+"""ViterbiDecoder (≙ python/paddle/text/viterbi_decode.py → phi
+viterbi_decode_kernel): CRF max-sum decoding as one lax.scan over time —
+a single fused XLA loop, batched.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op_call
+from ..nn.layer_base import Layer
+
+__all__ = ['ViterbiDecoder', 'viterbi_decode']
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """potentials [B,T,N], transitions [N,N] (or [N+2,N+2] with BOS/EOS
+    rows when include_bos_eos_tag), lengths [B] → (scores [B], paths [B,T]).
+    Positions past each length repeat the last valid tag (reference
+    semantics: outputs are only meaningful up to `lengths`)."""
+
+    def f(emit, trans, lens):
+        b, t, n = emit.shape
+        if include_bos_eos_tag:
+            # reference convention: last two tag indices are BOS/EOS
+            bos, eos = n - 2, n - 1
+            start = trans[bos, :][None, :]       # BOS → tag
+            stop = trans[:, eos][None, :]        # tag → EOS
+        else:
+            start = jnp.zeros((1, n), emit.dtype)
+            stop = jnp.zeros((1, n), emit.dtype)
+
+        alpha0 = emit[:, 0] + start              # [B, N]
+
+        def step(carry, xs):
+            alpha, tstep = carry, xs
+            emit_t, idx = tstep
+            # scores[b, i, j] = alpha[b, i] + trans[i, j]
+            scores = alpha[:, :, None] + trans[None, :, :]
+            best_prev = jnp.argmax(scores, axis=1)           # [B, N]
+            best_score = jnp.max(scores, axis=1) + emit_t    # [B, N]
+            # past the sequence end: carry alpha forward unchanged
+            valid = (idx < lens)[:, None]
+            new_alpha = jnp.where(valid, best_score, alpha)
+            bp = jnp.where(valid, best_prev,
+                           jnp.broadcast_to(jnp.arange(n)[None, :], (b, n)))
+            return new_alpha, bp
+
+        idxs = jnp.arange(1, t)
+        alpha, backptrs = jax.lax.scan(
+            step, alpha0, (jnp.swapaxes(emit[:, 1:], 0, 1), idxs))
+        final = alpha + stop
+        scores = jnp.max(final, axis=-1)
+        last_tag = jnp.argmax(final, axis=-1)                # [B]
+
+        if t == 1:
+            return scores, last_tag[:, None].astype(jnp.int64)
+
+        def back(carry, bp):
+            # carry = tag at time s; bp[b, j] = best tag at s-1 given j at s
+            prev = jnp.take_along_axis(bp, carry[:, None], axis=1)[:, 0]
+            return prev, carry
+
+        first_tag, tags_rev = jax.lax.scan(back, last_tag,
+                                           jnp.flip(backptrs, 0))
+        # tags_rev rows: tag_{t-1}, ..., tag_1 → flip to tag_1..tag_{t-1}
+        tags = jnp.flip(jnp.swapaxes(tags_rev, 0, 1), 1)
+        path = jnp.concatenate([first_tag[:, None], tags], axis=1)
+        return scores, path.astype(jnp.int64)
+
+    return op_call(f, potentials, transition_params, lengths,
+                   name="viterbi_decode", n_diff=2)
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

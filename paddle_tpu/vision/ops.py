@@ -64,7 +64,14 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         output_size = (output_size, output_size)
     ph, pw = output_size
     nboxes = boxes.shape[0]
-    ratio = sampling_ratio if sampling_ratio > 0 else 2
+    # adaptive ratio must be static under XLA: bound it by the feature-map
+    # size (oversampling small RoIs only sharpens the average); reference
+    # uses ceil(roi_size/output) per box dynamically
+    if sampling_ratio > 0:
+        ratio = sampling_ratio
+    else:
+        ratio = max(2, min(8, int(math.ceil(max(x.shape[2] / ph,
+                                                x.shape[3] / pw)))))
 
     def f(feat, bxs, bnum):
         # map each box to its batch image via the per-image box counts
@@ -112,10 +119,10 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
         h, w = feat.shape[-2], feat.shape[-1]
         img_of = jnp.searchsorted(jnp.cumsum(bnum), jnp.arange(nboxes),
                                   side="right")
-        x1 = jnp.round(bxs[:, 0] * spatial_scale)
-        y1 = jnp.round(bxs[:, 1] * spatial_scale)
-        x2 = jnp.round(bxs[:, 2] * spatial_scale)
-        y2 = jnp.round(bxs[:, 3] * spatial_scale)
+        x1 = jnp.clip(jnp.round(bxs[:, 0] * spatial_scale), 0, w - 1)
+        y1 = jnp.clip(jnp.round(bxs[:, 1] * spatial_scale), 0, h - 1)
+        x2 = jnp.clip(jnp.round(bxs[:, 2] * spatial_scale), 0, w - 1)
+        y2 = jnp.clip(jnp.round(bxs[:, 3] * spatial_scale), 0, h - 1)
         rh = jnp.maximum(y2 - y1 + 1, 1.0)
         rw = jnp.maximum(x2 - x1 + 1, 1.0)
         # dense candidate grid large enough for any bin, masked per-bin
@@ -138,6 +145,9 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
             fv = feat[img][None, None]                          # [1,1,C,H,W]
             masked = jnp.where(m[:, :, None], fv, -jnp.inf)
             out = jnp.max(masked, axis=(-1, -2))                # [ph,pw,C]
+            # empty bins (fully clipped boxes) pool to 0, not -inf (phi
+            # roi_pool is_empty semantics)
+            out = jnp.where(jnp.isfinite(out), out, 0.0)
             return jnp.transpose(out, (2, 0, 1))
 
         return jax.vmap(one)(jnp.arange(nboxes))
@@ -395,7 +405,7 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         best = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)  # [N,B]
         valid = (gw > 0)
         obj_tgt = jnp.zeros((n, an, h, w))
-        losses = 0.0
+        losses = jnp.zeros((n,))
         for b_i in range(nb):  # static unroll over max gt boxes
             sel = valid[:, b_i]
             a_best = best[:, b_i]
@@ -429,15 +439,16 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
                 onehot = onehot * (1 - delta) + delta / class_num
             lcls = jnp.sum(bce(pred[:, 5:], onehot), axis=-1)
             wgt = gs[0][:, b_i] if gs else jnp.ones((n,))
-            losses = losses + jnp.sum(jnp.where(use, (lbox + lcls) * wgt, 0.0))
+            losses = losses + jnp.where(use, (lbox + lcls) * wgt, 0.0)
             obj_tgt = obj_tgt.at[bidx, local_a, jj, ii].max(
                 jnp.where(use, 1.0, 0.0))
         # objectness: positives → 1; others → 0 (ignore_thresh handled as
         # hard 0 targets — the IoU-ignore refinement needs per-cell best IoU)
         lobj = jnp.maximum(p[:, :, 4], 0) - p[:, :, 4] * obj_tgt + \
             jnp.log1p(jnp.exp(-jnp.abs(p[:, :, 4])))
-        losses = losses + jnp.sum(lobj)
-        return jnp.full((n,), 1.0) * losses / n
+        # per-image loss vector [N] like the reference yolo_loss output
+        losses = losses + jnp.sum(lobj, axis=(1, 2, 3))
+        return losses
 
     args = [x, gt_box, gt_label] + ([gt_score] if gt_score is not None else [])
     return op_call(f, *args, name="yolo_loss", n_diff=1)
@@ -613,10 +624,13 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
             iou = _iou_matrix(boxes_c, boxes_c)
             iou = np.triu(iou, 1)
             iou_cmax = iou.max(0)
+            # decay_ij compensates by the SUPPRESSOR i's own max overlap
+            # (iou_cmax[:, None]) — SOLOv2/phi matrix_nms formula
             if use_gaussian:
-                decay = np.exp((iou_cmax ** 2 - iou ** 2) / gaussian_sigma)
+                decay = np.exp((iou_cmax[:, None] ** 2 - iou ** 2)
+                               / gaussian_sigma)
             else:
-                decay = (1 - iou) / np.maximum(1 - iou_cmax, 1e-9)
+                decay = (1 - iou) / np.maximum(1 - iou_cmax[:, None], 1e-9)
             dec = decay.min(0)
             new_scores = scores_c * dec
             for k, oi in enumerate(order):

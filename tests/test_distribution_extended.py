@@ -1,0 +1,225 @@
+"""Extended distributions + transforms — numerics vs torch.distributions
+(reference python/paddle/distribution/)."""
+import numpy as np
+import pytest
+import torch
+import torch.distributions as td
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, dtype="float32"))
+
+
+class TestNewDistributions:
+    def setup_method(self, _):
+        paddle.seed(0)
+        self.rs = np.random.RandomState(0)
+
+    def test_binomial(self):
+        d = D.Binomial(_t(10.0), _t(0.3))
+        ref = td.Binomial(10, torch.tensor(0.3))
+        for v in (0.0, 3.0, 10.0):
+            np.testing.assert_allclose(
+                float(_np(d.log_prob(_t(v)))),
+                ref.log_prob(torch.tensor(v)).item(), rtol=1e-4)
+        np.testing.assert_allclose(float(_np(d.mean)), 3.0, rtol=1e-6)
+        s = _np(d.sample([500]))
+        assert 0 <= s.min() and s.max() <= 10
+        assert abs(s.mean() - 3.0) < 0.4
+        np.testing.assert_allclose(float(_np(d.entropy())),
+                                   ref.entropy().item(), rtol=1e-3)
+
+    def test_cauchy(self):
+        d = D.Cauchy(_t(1.0), _t(2.0))
+        ref = td.Cauchy(1.0, 2.0)
+        for v in (-1.0, 0.5, 4.0):
+            np.testing.assert_allclose(
+                float(_np(d.log_prob(_t(v)))),
+                ref.log_prob(torch.tensor(v)).item(), rtol=1e-5)
+            np.testing.assert_allclose(
+                float(_np(d.cdf(_t(v)))),
+                ref.cdf(torch.tensor(v)).item(), rtol=1e-5)
+        np.testing.assert_allclose(float(_np(d.entropy())),
+                                   ref.entropy().item(), rtol=1e-5)
+
+    def test_chi2(self):
+        d = D.Chi2(_t(5.0))
+        ref = td.Chi2(5.0)
+        for v in (0.5, 3.0, 8.0):
+            np.testing.assert_allclose(
+                float(_np(d.log_prob(_t(v)))),
+                ref.log_prob(torch.tensor(v)).item(), rtol=1e-4)
+        np.testing.assert_allclose(float(_np(d.entropy())),
+                                   ref.entropy().item(), rtol=1e-4)
+        s = _np(d.sample([800]))
+        assert abs(s.mean() - 5.0) < 0.5
+
+    def test_continuous_bernoulli(self):
+        d = D.ContinuousBernoulli(_t(0.3))
+        ref = td.ContinuousBernoulli(torch.tensor(0.3))
+        for v in (0.1, 0.5, 0.9):
+            np.testing.assert_allclose(
+                float(_np(d.log_prob(_t(v)))),
+                ref.log_prob(torch.tensor(v)).item(), rtol=1e-3)
+        np.testing.assert_allclose(float(_np(d.mean)),
+                                   ref.mean.item(), rtol=1e-3)
+
+    def test_dirichlet(self):
+        c = np.array([2.0, 3.0, 5.0], dtype="float32")
+        d = D.Dirichlet(_t(c))
+        ref = td.Dirichlet(torch.tensor(c))
+        v = np.array([0.2, 0.3, 0.5], dtype="float32")
+        np.testing.assert_allclose(float(_np(d.log_prob(_t(v)))),
+                                   ref.log_prob(torch.tensor(v)).item(),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(_np(d.mean), c / c.sum(), rtol=1e-5)
+        np.testing.assert_allclose(float(_np(d.entropy())),
+                                   ref.entropy().item(), rtol=1e-4)
+        s = _np(d.rsample([400]))
+        np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-4)
+        np.testing.assert_allclose(s.mean(0), c / c.sum(), atol=0.03)
+
+    def test_multivariate_normal(self):
+        loc = np.array([1.0, -1.0], dtype="float32")
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], dtype="float32")
+        d = D.MultivariateNormal(_t(loc), covariance_matrix=_t(cov))
+        ref = td.MultivariateNormal(torch.tensor(loc), torch.tensor(cov))
+        v = np.array([0.5, 0.2], dtype="float32")
+        np.testing.assert_allclose(float(_np(d.log_prob(_t(v)))),
+                                   ref.log_prob(torch.tensor(v)).item(),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(float(_np(d.entropy())),
+                                   ref.entropy().item(), rtol=1e-4)
+        np.testing.assert_allclose(_np(d.variance), np.diag(cov), rtol=1e-5)
+        s = _np(d.rsample([2000]))
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.25)
+
+    def test_student_t(self):
+        d = D.StudentT(_t(5.0), _t(1.0), _t(2.0))
+        ref = td.StudentT(5.0, 1.0, 2.0)
+        for v in (-2.0, 1.0, 3.0):
+            np.testing.assert_allclose(
+                float(_np(d.log_prob(_t(v)))),
+                ref.log_prob(torch.tensor(v)).item(), rtol=1e-4)
+        np.testing.assert_allclose(float(_np(d.entropy())),
+                                   ref.entropy().item(), rtol=1e-4)
+
+    def test_lkj_cholesky(self):
+        d = D.LKJCholesky(3, _t(1.5))
+        s = _np(d.sample())
+        assert s.shape == (3, 3)
+        # valid Cholesky of a correlation matrix: unit-diag product
+        corr = s @ s.T
+        np.testing.assert_allclose(np.diag(corr), 1.0, rtol=1e-5)
+        ref = td.LKJCholesky(3, 1.5)
+        v = np.asarray(ref.sample().numpy(), "float32")
+        np.testing.assert_allclose(float(_np(d.log_prob(_t(v)))),
+                                   ref.log_prob(torch.tensor(v)).item(),
+                                   rtol=1e-3)
+
+    def test_independent(self):
+        base = D.Normal(_t(np.zeros((4, 3))), _t(np.ones((4, 3))))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == (4,) and ind.event_shape == (3,)
+        v = self.rs.randn(4, 3).astype("float32")
+        got = _np(ind.log_prob(_t(v)))
+        ref = td.Independent(td.Normal(torch.zeros(4, 3), torch.ones(4, 3)),
+                             1).log_prob(torch.tensor(v)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_transformed_lognormal(self):
+        base = D.Normal(_t(0.0), _t(1.0))
+        d = D.TransformedDistribution(base, [D.ExpTransform()])
+        ref = td.TransformedDistribution(
+            td.Normal(0.0, 1.0), [td.ExpTransform()])
+        for v in (0.5, 1.5, 3.0):
+            np.testing.assert_allclose(
+                float(_np(d.log_prob(_t(v)))),
+                ref.log_prob(torch.tensor(v)).item(), rtol=1e-4)
+        paddle.seed(3)
+        s = _np(d.sample([500]))
+        assert (s > 0).all()
+
+
+class TestTransforms:
+    def setup_method(self, _):
+        self.rs = np.random.RandomState(1)
+
+    @pytest.mark.parametrize("ours,theirs", [
+        (lambda: D.ExpTransform(), lambda: td.ExpTransform()),
+        (lambda: D.SigmoidTransform(), lambda: td.SigmoidTransform()),
+        (lambda: D.TanhTransform(), lambda: td.TanhTransform()),
+        (lambda: D.AffineTransform(1.0, 2.5),
+         lambda: td.AffineTransform(1.0, 2.5)),
+    ])
+    def test_bijectors_match_torch(self, ours, theirs):
+        t, tt = ours(), theirs()
+        x = self.rs.randn(5).astype("float32") * 0.8
+        y = _np(t.forward(_t(x)))
+        yy = tt(torch.tensor(x)).numpy()
+        np.testing.assert_allclose(y, yy, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(_np(t.inverse(_t(y))), x, rtol=1e-3,
+                                   atol=1e-4)
+        np.testing.assert_allclose(
+            _np(t.forward_log_det_jacobian(_t(x))),
+            tt.log_abs_det_jacobian(torch.tensor(x),
+                                    torch.tensor(yy)).numpy(),
+            rtol=1e-4, atol=1e-5)
+
+    def test_power_transform(self):
+        t = D.PowerTransform(2.0)
+        x = np.array([1.0, 2.0, 3.0], dtype="float32")
+        np.testing.assert_allclose(_np(t.forward(_t(x))), x ** 2)
+        np.testing.assert_allclose(_np(t.inverse(_t(x ** 2))), x, rtol=1e-5)
+
+    def test_chain_and_independent(self):
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                                  D.ExpTransform()])
+        x = np.array([0.1, 0.5], dtype="float32")
+        np.testing.assert_allclose(_np(chain.forward(_t(x))),
+                                   np.exp(2 * x), rtol=1e-5)
+        np.testing.assert_allclose(_np(chain.inverse(_t(np.exp(2 * x)))), x,
+                                   rtol=1e-4)
+        it = D.IndependentTransform(D.ExpTransform(), 1)
+        ld = it.forward_log_det_jacobian(_t(np.ones((3, 4))))
+        assert list(ld.shape) == [3]
+
+    def test_stick_breaking(self):
+        t = D.StickBreakingTransform()
+        tt = td.StickBreakingTransform()
+        x = self.rs.randn(4).astype("float32")
+        y = _np(t.forward(_t(x)))
+        np.testing.assert_allclose(y, tt(torch.tensor(x)).numpy(), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(y.sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(_np(t.inverse(_t(y))), x, rtol=1e-3,
+                                   atol=1e-4)
+        np.testing.assert_allclose(
+            float(_np(t.forward_log_det_jacobian(_t(x)))),
+            tt.log_abs_det_jacobian(torch.tensor(x),
+                                    tt(torch.tensor(x))).item(), rtol=1e-4)
+
+    def test_reshape_and_stack(self):
+        t = D.ReshapeTransform((4,), (2, 2))
+        x = np.arange(4, dtype="float32")
+        assert list(t.forward(_t(x)).shape) == [2, 2]
+        assert t.forward_shape((7, 4)) == (7, 2, 2)
+        st = D.StackTransform([D.ExpTransform(), D.AffineTransform(0.0, 3.0)],
+                              axis=0)
+        x2 = np.array([[1.0, 2.0], [1.0, 2.0]], dtype="float32")
+        out = _np(st.forward(_t(x2)))
+        np.testing.assert_allclose(out[0], np.exp([1.0, 2.0]), rtol=1e-5)
+        np.testing.assert_allclose(out[1], [3.0, 6.0], rtol=1e-5)
+
+    def test_abs_and_softmax(self):
+        np.testing.assert_allclose(
+            _np(D.AbsTransform().forward(_t([-2.0, 3.0]))), [2.0, 3.0])
+        sm = _np(D.SoftmaxTransform().forward(_t([1.0, 2.0, 3.0])))
+        np.testing.assert_allclose(sm.sum(), 1.0, rtol=1e-6)

@@ -206,3 +206,192 @@ class TestStatic:
         with paddle.static.name_scope("foo"):
             y = paddle.rand([2])
         assert y.shape == [2]
+
+
+class TestSparseExtended:
+    """Sparse surface completion (reference sparse/{unary,binary,multiary})."""
+
+    def _coo(self, dense):
+        return paddle.sparse.to_sparse_coo(paddle.to_tensor(dense))
+
+    def test_unary_family(self):
+        d = np.array([[0.0, 0.5], [-0.25, 0.0]], dtype="float32")
+        sp = self._coo(d)
+        for name, ref in [("asin", np.arcsin), ("sinh", np.sinh),
+                          ("tan", np.tan), ("square", np.square),
+                          ("log1p", np.log1p), ("expm1", np.expm1),
+                          ("deg2rad", np.deg2rad), ("rad2deg", np.rad2deg)]:
+            out = getattr(paddle.sparse, name)(sp)
+            np.testing.assert_allclose(
+                np.asarray(paddle.sparse.to_dense(out)._data), ref(d),
+                rtol=1e-5, atol=1e-6, err_msg=name)
+
+    def test_mv_and_addmm(self):
+        d = np.array([[1.0, 0, 2], [0, 3, 0]], dtype="float32")
+        sp = self._coo(d)
+        v = paddle.to_tensor(np.array([1.0, 2, 3], dtype="float32"))
+        np.testing.assert_allclose(
+            np.asarray(paddle.sparse.mv(sp, v)._data), d @ [1, 2, 3],
+            rtol=1e-6)
+        y = paddle.to_tensor(np.ones((3, 2), dtype="float32"))
+        inp = paddle.to_tensor(np.ones((2, 2), dtype="float32"))
+        out = paddle.sparse.addmm(inp, sp, y, beta=2.0, alpha=1.0)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   2.0 + d @ np.ones((3, 2)), rtol=1e-6)
+
+    def test_sum_reshape_slice(self):
+        d = np.arange(12, dtype="float32").reshape(3, 4)
+        d[d % 3 != 0] = 0
+        sp = self._coo(d)
+        np.testing.assert_allclose(
+            np.asarray(paddle.sparse.sum(sp)._data), d.sum())
+        rs = paddle.sparse.reshape(sp, [4, 3])
+        np.testing.assert_allclose(
+            np.asarray(paddle.sparse.to_dense(rs)._data), d.reshape(4, 3))
+        sl = paddle.sparse.slice(sp, [0], [1], [3])
+        np.testing.assert_allclose(
+            np.asarray(paddle.sparse.to_dense(sl)._data), d[1:3])
+
+    def test_coalesce_merges_duplicates(self):
+        idx = np.array([[0, 0], [0, 0], [1, 1]], dtype="int64").T
+        vals = np.array([1.0, 2.0, 5.0], dtype="float32")
+        sp = paddle.sparse.sparse_coo_tensor(idx, vals, [2, 2])
+        co = paddle.sparse.coalesce(sp)
+        dense = np.asarray(paddle.sparse.to_dense(co)._data)
+        np.testing.assert_allclose(dense, [[3.0, 0], [0, 5.0]])
+
+    def test_mask_as_and_is_same_shape(self):
+        d = np.array([[1.0, 2], [3, 4]], dtype="float32")
+        mask = self._coo(np.array([[1.0, 0], [0, 1]], dtype="float32"))
+        out = paddle.sparse.mask_as(paddle.to_tensor(d), mask)
+        np.testing.assert_allclose(
+            np.asarray(paddle.sparse.to_dense(out)._data),
+            [[1.0, 0], [0, 4.0]])
+        assert paddle.sparse.is_same_shape(mask, out)
+
+    def test_pca_lowrank(self):
+        rs = np.random.RandomState(0)
+        d = (rs.randn(8, 3) @ rs.randn(3, 6)).astype("float32")
+        d[np.abs(d) < 0.5] = 0
+        u, s, v = paddle.sparse.pca_lowrank(self._coo(d), q=3)
+        assert list(u.shape) == [8, 3] and list(s.shape) == [3]
+
+
+class TestIncubateFusedOps:
+    """incubate.nn.functional fused-op surface (reference incubate/nn/
+    functional/) — parity vs unfused compositions."""
+
+    def test_fused_matmul_linear_activation(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(4, 8).astype("float32"))
+        w = paddle.to_tensor(rs.randn(8, 6).astype("float32"))
+        b = paddle.to_tensor(rs.randn(6).astype("float32"))
+        out = IF.fused_matmul_bias(x, w, b)
+        want = np.asarray(x._data) @ np.asarray(w._data) + np.asarray(b._data)
+        np.testing.assert_allclose(np.asarray(out._data), want, rtol=1e-5)
+        act = IF.fused_linear_activation(x, w, b, activation="relu")
+        np.testing.assert_allclose(np.asarray(act._data), np.maximum(want, 0),
+                                   rtol=1e-5)
+
+    def test_fused_feedforward_matches_composition(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        import paddle_tpu.nn.functional as F
+
+        rs = np.random.RandomState(1)
+        x = paddle.to_tensor(rs.randn(2, 3, 8).astype("float32"))
+        w1 = paddle.to_tensor(rs.randn(8, 16).astype("float32"))
+        w2 = paddle.to_tensor(rs.randn(16, 8).astype("float32"))
+        out = IF.fused_feedforward(x, w1, w2, dropout1_rate=0.0,
+                                   dropout2_rate=0.0, pre_layer_norm=False)
+        h = F.relu(F.linear(x, w1))
+        want = F.layer_norm(x + F.linear(h, w2), [8])
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(want._data), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_fused_moe_top1_selects_best_expert(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rs = np.random.RandomState(2)
+        # positive features → the all-100 gate column always wins → expert 1
+        x = paddle.to_tensor((rs.rand(5, 4) + 0.1).astype("float32"))
+        gw = paddle.to_tensor(np.array([[0., 100.], [0., 100.],
+                                        [0., 100.], [0., 100.]], "float32"))
+        w1s = [paddle.to_tensor(rs.randn(4, 8).astype("float32"))
+               for _ in range(2)]
+        w2s = [paddle.to_tensor(rs.randn(8, 4).astype("float32"))
+               for _ in range(2)]
+        out = IF.fused_moe(x, gw, w1s, w2s, moe_topk=1)
+        import jax.nn as jnn
+        import jax.numpy as jnp
+
+        h = jnn.gelu(np.asarray(x._data) @ np.asarray(w1s[1]._data))
+        want = np.asarray(h @ np.asarray(w2s[1]._data))
+        np.testing.assert_allclose(np.asarray(out._data), want, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_masked_multihead_attention_decode_steps(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rs = np.random.RandomState(3)
+        B, H, D, L = 2, 2, 4, 6
+        cache = paddle.to_tensor(np.zeros((2, B, H, L, D), "float32"))
+        lens = paddle.to_tensor(np.zeros((B,), "int32"))
+        xs = []
+        for step in range(3):
+            x = paddle.to_tensor(rs.randn(B, 3 * H * D).astype("float32"))
+            xs.append(np.asarray(x._data).reshape(B, 3, H, D))
+            lens_t = paddle.to_tensor(np.full((B,), step, "int32"))
+            out, cache = IF.masked_multihead_attention(
+                x, cache_kv=cache, sequence_lengths=lens_t)
+        # final out must equal full attention of q3 over k1..k3
+        q = xs[-1][:, 0]
+        ks = np.stack([s[:, 1] for s in xs], 2)   # [B, H, 3, D]
+        vs = np.stack([s[:, 2] for s in xs], 2)
+        sc = np.einsum("bhd,bhld->bhl", q, ks) / np.sqrt(D)
+        att = np.exp(sc) / np.exp(sc).sum(-1, keepdims=True)
+        want = np.einsum("bhl,bhld->bhd", att, vs).reshape(B, H * D)
+        np.testing.assert_allclose(np.asarray(out._data), want, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_varlen_attention_masks_padding(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rs = np.random.RandomState(4)
+        q = rs.randn(2, 2, 4, 8).astype("float32")
+        kvl = np.array([4, 2], "int32")
+        out = IF.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            paddle.to_tensor(np.array([4, 4], "int32")),
+            paddle.to_tensor(kvl))
+        # batch 1 attends only to first 2 keys: recompute manually
+        sc = np.einsum("hqd,hkd->hqk", q[1], q[1][:, :2]) / np.sqrt(8)
+        att = np.exp(sc) / np.exp(sc).sum(-1, keepdims=True)
+        want = np.einsum("hqk,hkd->hqd", att, q[1][:, :2])
+        np.testing.assert_allclose(np.asarray(out._data)[1], want, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_fused_multi_transformer_runs(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rs = np.random.RandomState(5)
+        hidden, layers = 16, 2
+        mk = lambda *s: paddle.to_tensor(rs.randn(*s).astype("float32") * 0.1)
+        out, _ = IF.fused_multi_transformer(
+            mk(1, 4, hidden),
+            [mk(hidden) for _ in range(layers)],
+            [mk(hidden) for _ in range(layers)],
+            [mk(hidden, 3 * hidden).T for _ in range(layers)],
+            [mk(3 * hidden) for _ in range(layers)],
+            [mk(hidden, hidden) for _ in range(layers)],
+            [mk(hidden) for _ in range(layers)],
+            [mk(hidden) for _ in range(layers)],
+            [mk(hidden) for _ in range(layers)],
+            [mk(hidden, 4 * hidden) for _ in range(layers)],
+            [mk(4 * hidden) for _ in range(layers)],
+            [mk(4 * hidden, hidden) for _ in range(layers)],
+            [mk(hidden) for _ in range(layers)])
+        assert list(out.shape) == [1, 4, hidden]
+        assert np.isfinite(np.asarray(out._data)).all()

@@ -4,6 +4,7 @@ import os
 import numpy as np
 import pytest
 
+import paddle_tpu as paddle
 from paddle_tpu.text.datasets import Conll05st, Imdb, UCIHousing
 
 
@@ -54,3 +55,70 @@ class TestImdb:
     def test_stub_datasets_raise(self):
         with pytest.raises(RuntimeError, match="conll05st"):
             Conll05st()
+
+
+class TestViterbi:
+    """ViterbiDecoder vs brute-force enumeration (reference
+    text/viterbi_decode.py -> phi viterbi_decode_kernel)."""
+
+    def _brute(self, emit, trans, length, start=None, stop=None):
+        import itertools
+
+        n = emit.shape[-1]
+        best, best_path = -1e30, None
+        for path in itertools.product(range(n), repeat=length):
+            s = emit[0, path[0]] + (start[path[0]] if start is not None else 0)
+            for t in range(1, length):
+                s += trans[path[t - 1], path[t]] + emit[t, path[t]]
+            s += stop[path[-1]] if stop is not None else 0
+            if s > best:
+                best, best_path = s, path
+        return best, list(best_path)
+
+    def test_matches_brute_force_no_bos(self):
+        rs = np.random.RandomState(0)
+        B, T, N = 2, 4, 3
+        emit = rs.randn(B, T, N).astype("float32")
+        trans = rs.randn(N, N).astype("float32")
+        lens = np.array([T, T], dtype="int64")
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(emit), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=False)
+        for b in range(B):
+            want_s, want_p = self._brute(emit[b], trans, T)
+            np.testing.assert_allclose(float(np.asarray(scores._data)[b]),
+                                       want_s, rtol=1e-4)
+            assert np.asarray(paths._data)[b].tolist() == want_p
+
+    def test_bos_eos_rows(self):
+        rs = np.random.RandomState(1)
+        B, T, N = 1, 3, 5  # tags 0..2 real, 3=BOS, 4=EOS
+        emit = rs.randn(B, T, N).astype("float32")
+        emit[:, :, 3:] = -1e4  # BOS/EOS unused as emissions
+        trans = rs.randn(N, N).astype("float32")
+        lens = np.array([T], dtype="int64")
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(emit), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=True)
+        want_s, want_p = self._brute(emit[0], trans, T, start=trans[3, :],
+                                     stop=trans[:, 4])
+        np.testing.assert_allclose(float(np.asarray(scores._data)[0]), want_s,
+                                   rtol=1e-4)
+        assert np.asarray(paths._data)[0].tolist() == want_p
+
+    def test_decoder_layer_and_lengths(self):
+        rs = np.random.RandomState(2)
+        emit = rs.randn(2, 5, 4).astype("float32")
+        trans = rs.randn(4, 4).astype("float32")
+        dec = paddle.text.ViterbiDecoder(paddle.to_tensor(trans),
+                                         include_bos_eos_tag=False)
+        scores, paths = dec(paddle.to_tensor(emit),
+                            paddle.to_tensor(np.array([5, 3], "int64")))
+        assert list(paths.shape) == [2, 5]
+        # shorter sequence must match its own full decode up to its length
+        s2, p2 = paddle.text.viterbi_decode(
+            paddle.to_tensor(emit[1:2, :3]), paddle.to_tensor(trans),
+            paddle.to_tensor(np.array([3], "int64")),
+            include_bos_eos_tag=False)
+        assert np.asarray(paths._data)[1, :3].tolist() == \
+            np.asarray(p2._data)[0].tolist()

@@ -227,3 +227,36 @@ class TestImageIO:
         # JPEG is lossy; just require rough agreement
         diff = np.abs(_np(img).transpose(1, 2, 0).astype(int) - arr.astype(int))
         assert diff.mean() < 12
+
+
+class TestReviewRegressions:
+    def test_matrix_nms_suppresses_overlaps(self):
+        # two heavy-overlap boxes: the weaker must decay below threshold
+        bboxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5]]],
+                          dtype="float32")
+        scores = np.array([[[0.9, 0.85]]], dtype="float32")
+        out = vops.matrix_nms(_t(bboxes), _t(scores), 0.1, 0.5, 10, 10,
+                              background_label=-1, return_rois_num=False)
+        o = _np(out)
+        assert o.shape[0] == 1  # only the stronger box survives post_threshold
+        assert o[0, 1] == pytest.approx(0.9, rel=1e-4)
+
+    def test_roi_pool_out_of_bounds_box_is_finite(self):
+        x = np.ones((1, 1, 8, 8), dtype="float32")
+        boxes = np.array([[-20.0, -20.0, -4.0, -4.0]], dtype="float32")
+        out = _np(vops.roi_pool(_t(x), _t(boxes),
+                                _t(np.array([1], "int32")), 2))
+        assert np.isfinite(out).all()
+
+    def test_yolo_loss_per_image_shape(self):
+        # identical (zero) predictions for all images → per-image loss
+        # differs ONLY through the gt assignment
+        x = _t(np.zeros((3, 14, 4, 4), dtype="float32"))
+        gt = np.zeros((3, 2, 4), dtype="float32")
+        gt[0, 0] = [0.5, 0.5, 0.3, 0.4]  # only image 0 has a gt box
+        loss = vops.yolo_loss(x, _t(gt), _t(np.zeros((3, 2), "int64")),
+                              [10, 13, 16, 30], [0, 1], 2, 0.7, 16)
+        v = _np(loss)
+        assert v.shape == (3,)
+        assert v[0] > v[1]  # image with the gt box pays box+cls loss too
+        np.testing.assert_allclose(v[1], v[2], rtol=1e-5)
