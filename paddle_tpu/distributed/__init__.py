@@ -61,4 +61,52 @@ from . import sharding
 from .sharding import group_sharded_parallel, save_group_sharded_model
 from .meta_parallel import DataParallel
 
+# surface completion (≙ reference distributed/__init__.py long tail)
+from . import io
+from .auto_parallel.api import DistAttr
+from .auto_parallel.parallelize import (
+    parallelize,
+    ColWiseParallel,
+    RowWiseParallel,
+    SequenceParallelBegin,
+    SequenceParallelEnd,
+    SequenceParallelEnable,
+    SequenceParallelDisable,
+)
+from .extended import (
+    set_mesh,
+    get_mesh,
+    ReduceType,
+    ParallelMode,
+    SplitPoint,
+    ShardingStage1,
+    ShardingStage2,
+    ShardingStage3,
+    PrepareLayerInput,
+    PrepareLayerOutput,
+    LocalLayer,
+    Strategy,
+    DistModel,
+    to_static,
+    shard_optimizer,
+    shard_scaler,
+    shard_dataloader,
+    to_distributed,
+    alltoall_single,
+    gather,
+    scatter_object_list,
+    wait,
+    get_backend,
+    is_available,
+    gloo_init_parallel_env,
+    gloo_barrier,
+    gloo_release,
+    split,
+    CountFilterEntry,
+    ProbabilityEntry,
+    ShowClickEntry,
+    InMemoryDataset,
+    QueueDataset,
+)
+
 __all__ = [n for n in dir() if not n.startswith("_")]

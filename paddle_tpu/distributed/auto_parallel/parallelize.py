@@ -93,6 +93,48 @@ class SequenceParallelEnd(PlanBase):
         layer.register_forward_post_hook(hook)
 
 
+class SequenceParallelEnable(PlanBase):
+    """Run the whole layer in sequence-parallel regime: shard the seq dim
+    on entry, keep it sharded on exit (≙ intermediate
+    SequenceParallelEnable)."""
+
+    def apply(self, layer, mesh):
+        from ..meta_parallel.mp_layers import _constraint
+        from jax.sharding import PartitionSpec as P
+
+        def pre(_lyr, ins):
+            return tuple(
+                _constraint(x, P(None, "mp")) if hasattr(x, "ndim")
+                and x.ndim >= 2 else x for x in ins)
+
+        layer.register_forward_pre_hook(pre)
+
+
+class SequenceParallelDisable(PlanBase):
+    """Run this layer OUTSIDE the sequence-parallel regime: gather the seq
+    dim before it, re-shard after (≙ intermediate SequenceParallelDisable)."""
+
+    def __init__(self, need_transpose: bool = True):
+        self.need_transpose = need_transpose
+
+    def apply(self, layer, mesh):
+        from ..meta_parallel.mp_layers import _clear_axis, _constraint
+        from jax.sharding import PartitionSpec as P
+
+        def pre(_lyr, ins):
+            return tuple(
+                _clear_axis(x, "mp") if hasattr(x, "ndim") and x.ndim >= 2
+                else x for x in ins)
+
+        def post(_lyr, _ins, out):
+            if hasattr(out, "ndim") and out.ndim >= 2:
+                return _constraint(out, P(None, "mp"))
+            return out
+
+        layer.register_forward_pre_hook(pre)
+        layer.register_forward_post_hook(post)
+
+
 def _place(layer, attr, mesh, spec):
     p = getattr(layer, attr, None)
     if p is None:

@@ -1,0 +1,453 @@
+"""Distributed surface completion (≙ python/paddle/distributed/__init__.py
+exports not yet covered): the intermediate parallelize-plan classes, the
+semi-auto to_static/DistModel path, sharded optimizer/dataloader wrappers,
+comm-API long tail, and PS-era config stubs (SURVEY §7 keeps PS as stubs)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+# ----------------------------------------------------------- global mesh state
+_GLOBAL_MESH = None
+
+
+def set_mesh(mesh):
+    """≙ paddle.distributed.set_mesh: install the global auto-parallel
+    ProcessMesh used by mesh-implicit APIs."""
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_mesh():
+    """≙ paddle.distributed.get_mesh (global-mesh variant)."""
+    return _GLOBAL_MESH
+
+
+# ------------------------------------------------------------- enums / markers
+class ReduceType:
+    """≙ auto_parallel ReduceType: reduction carried by Partial placements."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class ParallelMode:
+    """≙ fleet ParallelMode constants."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class SplitPoint:
+    """≙ intermediate API SplitPoint: where a pipeline stage boundary cuts."""
+    BEGINNING = "beginning"
+    END = "end"
+
+
+class ShardingStage1:
+    """≙ intermediate API ShardingStage1 plan marker (ZeRO-1: optimizer
+    state sharded)."""
+
+    def __init__(self, axis="dp", mesh=None):
+        self.level = "os"
+        self.axis = axis
+        self.mesh = mesh
+
+
+class ShardingStage2(ShardingStage1):
+    """ZeRO-2: optimizer state + gradients sharded."""
+
+    def __init__(self, axis="dp", mesh=None):
+        super().__init__(axis, mesh)
+        self.level = "os_g"
+
+
+class ShardingStage3(ShardingStage1):
+    """ZeRO-3: parameters too."""
+
+    def __init__(self, axis="dp", mesh=None):
+        super().__init__(axis, mesh)
+        self.level = "p_g_os"
+
+
+# ------------------------------------------------- plan classes (intermediate)
+class PrepareLayerInput:
+    """≙ intermediate PrepareLayerInput plan: run fn over a layer's inputs
+    (e.g. to shard/reshard activations entering the layer)."""
+
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, process_mesh, shard_weight=None, shard_bias=None):
+        if self.fn is None:
+            return
+        wrapped = self.fn(process_mesh=process_mesh)
+
+        def pre_hook(lyr, inputs):
+            return tuple(wrapped(x) if isinstance(x, Tensor) else x
+                         for x in inputs)
+
+        layer.register_forward_pre_hook(pre_hook)
+
+
+class PrepareLayerOutput(PrepareLayerInput):
+    """≙ intermediate PrepareLayerOutput plan."""
+
+    def apply(self, layer, process_mesh, shard_weight=None, shard_bias=None):
+        if self.fn is None:
+            return
+        wrapped = self.fn(process_mesh=process_mesh)
+
+        def post_hook(lyr, inputs, output):
+            return wrapped(output) if isinstance(output, Tensor) else output
+
+        layer.register_forward_post_hook(post_hook)
+
+
+from ..nn.layer_base import Layer as _Layer  # noqa: E402
+
+
+class LocalLayer(_Layer):
+    """≙ auto_parallel LocalLayer: marks a layer whose forward is computed
+    on LOCAL shards (inside shard_map) instead of the global view; the
+    out_dist_attrs describe how local outputs assemble globally.
+    Subclass and override forward, or pass fn."""
+
+    def __init__(self, fn=None, out_dist_attrs=None, grad_dist_attrs=None):
+        super().__init__()
+        self._local_fn = fn
+        self.out_dist_attrs = out_dist_attrs
+
+    def forward(self, *inputs):
+        if self._local_fn is None:
+            raise NotImplementedError(
+                "subclass LocalLayer and override forward, or pass fn")
+        return self._local_fn(*inputs)
+
+
+# ------------------------------------------------------ semi-auto static path
+class Strategy:
+    """≙ auto_parallel.Strategy: config bag for the to_static path
+    (sharding/amp/recompute/pipeline sub-configs as attribute namespaces)."""
+
+    class _Sub:
+        def __init__(self, **kw):
+            self.__dict__.update(kw)
+
+    def __init__(self, config=None):
+        cfg = config or {}
+
+        def sub(defaults, key):
+            return Strategy._Sub(**{**defaults, **cfg.get(key, {})})
+
+        self.sharding = sub(dict(enable=False, stage=1, degree=1), "sharding")
+        self.amp = sub(dict(enable=False, dtype="bfloat16", level="O1"),
+                       "amp")
+        self.recompute = sub(dict(enable=False), "recompute")
+        self.pipeline = sub(dict(enable=False, schedule_mode="1F1B",
+                                 micro_batch_size=1, accumulate_steps=1),
+                            "pipeline")
+        self.gradient_merge = sub(dict(enable=False, k_steps=1),
+                                  "gradient_merge")
+
+
+class DistModel:
+    """≙ auto_parallel DistModel (api.py to_static product): train()/eval()
+    mode switches + __call__ running one compiled step. The engine here is
+    jit.to_static over the GSPMD-sharded module."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None):
+        self.network = layer
+        self._loader = loader
+        self._loss = loss
+        self._optimizer = optimizer
+        self._strategy = strategy or Strategy()
+        self._mode = "train"
+        # one compiled program PER MODE: the backward/optimizer branch is
+        # resolved at trace time, so train and eval must not share a cache
+        # entry (CompiledFunction keys on input specs only)
+        from ..jit import to_static as _ts
+
+        def make_step(mode):
+            def step(*inputs):
+                out = self.network(*inputs[:-1]) if self._loss is not None \
+                    else self.network(*inputs)
+                if self._loss is not None:
+                    out = self._loss(out, inputs[-1])
+                    if mode == "train":
+                        out.backward()
+                        if self._optimizer is not None:
+                            self._optimizer.step()
+                            self._optimizer.clear_grad()
+                return out
+
+            return _ts(step)
+
+        self._steps = {m: make_step(m) for m in ("train", "eval", "predict")}
+
+    @property
+    def _step(self):
+        return self._steps[self._mode]
+
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+
+    def __call__(self, *args):
+        return self._step(*args)
+
+    def state_dict(self, mode="all"):
+        return self.network.state_dict()
+
+    def dist_main_program(self, mode=None):
+        return self._step  # the compiled step IS the program here
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              input_spec=None):
+    """≙ paddle.distributed.to_static (auto_parallel/api.py:2946): wrap the
+    dygraph loop into a DistModel whose step compiles via jax.jit with the
+    GSPMD shardings already carried by the parameters."""
+    return DistModel(layer, loader, loss, optimizer, strategy)
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """≙ paddle.distributed.shard_optimizer: make accumulator creation
+    placement-aware. shard_fn(accumulator_name, param, acc) -> sharded acc;
+    default ShardingStage1-style even split is a no-op here because GSPMD
+    propagates the parameter shardings onto the accumulators automatically
+    (NamedSharding flows through jnp.zeros_like in _acc)."""
+    if shard_fn is not None:
+        orig_acc = optimizer._acc
+
+        def acc(kind, p, init=None, dtype=None):
+            t = orig_acc(kind, p, init=init, dtype=dtype)
+            out = shard_fn(kind, p, t)
+            return out if out is not None else t
+
+        optimizer._acc = acc
+    return optimizer
+
+
+def shard_scaler(scaler):
+    """≙ paddle.distributed.shard_scaler: the GradScaler found-inf check is
+    already a global reduction under GSPMD — returned unchanged."""
+    return scaler
+
+
+class _ShardedDataLoader:
+    def __init__(self, loader, meshes, shard_dims=None, input_keys=None):
+        self._loader = loader
+        self._meshes = meshes
+        self._shard_dims = shard_dims
+        self._input_keys = input_keys
+
+    def _place(self, t):
+        from .auto_parallel.api import shard_tensor
+        from .auto_parallel import Replicate, Shard
+
+        mesh = self._meshes[0] if isinstance(self._meshes, (list, tuple)) \
+            else self._meshes
+        if self._shard_dims is not None:
+            # reference accepts a str or a per-mesh list of strs
+            dim = self._shard_dims[0] if isinstance(
+                self._shard_dims, (list, tuple)) else self._shard_dims
+            placements = [Shard(0) if d == dim else Replicate()
+                          for d in mesh.dim_names]
+        else:
+            placements = [Replicate() for _ in mesh.dim_names]
+        return shard_tensor(t, mesh, placements)
+
+    def __iter__(self):
+        for batch in self._loader:
+            if isinstance(batch, dict):
+                yield {k: self._place(v) if isinstance(v, Tensor) else v
+                       for k, v in batch.items()}
+            elif isinstance(batch, (list, tuple)):
+                yield type(batch)(self._place(v) if isinstance(v, Tensor)
+                                  else v for v in batch)
+            else:
+                yield self._place(batch)
+
+    def __len__(self):
+        return len(self._loader)
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
+    """≙ paddle.distributed.shard_dataloader: re-places each batch onto the
+    mesh (batch-dim sharded along `shard_dims`, else replicated)."""
+    return _ShardedDataLoader(dataloader, meshes, shard_dims, input_keys)
+
+
+def to_distributed(model, optimizer=None, dataloader=None, device_num=None,
+                   node_num=1, config=None):
+    """≙ incubate to_distributed: one-call parallelization — routes to the
+    intermediate parallelize() plan API over the global mesh."""
+    from .auto_parallel.parallelize import parallelize
+
+    model = parallelize(model, optimizer, config or {})
+    out = [model]
+    if optimizer is not None:
+        out.append(optimizer)
+    if dataloader is not None:
+        out.append(dataloader)
+    return tuple(out) if len(out) > 1 else out[0]
+
+
+# ------------------------------------------------------------- comm long tail
+def alltoall_single(out_tensor, in_tensor, out_split_sizes=None,
+                    in_split_sizes=None, group=None, sync_op=True):
+    """Alias of all_to_all_single (reference exports both names)."""
+    from .communication import all_to_all_single
+
+    return all_to_all_single(out_tensor, in_tensor, out_split_sizes,
+                             in_split_sizes, group, sync_op)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """≙ communication/gather.py: collect per-rank tensors at dst. Built on
+    all_gather (the XLA collective); non-dst ranks' lists are left empty
+    in multi-process mode, filled in single-controller mode."""
+    from .communication import all_gather, get_rank_in, _resolve_group
+
+    g = _resolve_group(group)
+    parts = all_gather(None, tensor, group=group)
+    if gather_list is not None:
+        rank = get_rank_in(g)
+        if rank == g.get_group_rank(dst) or g.nranks == 1:
+            gather_list.clear()
+            gather_list.extend(parts)
+        return gather_list
+    return parts
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """≙ communication/scatter.py scatter_object_list (single-controller:
+    each rank takes its slot)."""
+    from .communication import _resolve_group, get_rank_in
+
+    g = _resolve_group(group)
+    if in_object_list:
+        idx = get_rank_in(g)
+        out_object_list.clear()
+        out_object_list.append(in_object_list[idx if 0 <= idx <
+                                              len(in_object_list) else 0])
+    return out_object_list
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """≙ communication/wait.py: block until the tensor's producing program
+    finishes (XLA: block_until_ready — streams are XLA's concern)."""
+    data = tensor._data if isinstance(tensor, Tensor) else tensor
+    jax.block_until_ready(data)
+    return tensor
+
+
+def get_backend(group=None):
+    """The comm backend of this build is XLA's ICI/DCN collectives."""
+    return "XCCL_XLA"
+
+
+def is_available():
+    """≙ paddle.distributed.is_available: collectives are always compiled
+    in (XLA), so True whenever jax has at least one device."""
+    return len(jax.devices()) > 0
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU rendezvous shim (≙ gloo_init_parallel_env): the coordination
+    service replaces gloo; single-process is a no-op."""
+    from .parallel_env import init_parallel_env
+
+    init_parallel_env()
+
+
+def gloo_barrier():
+    from .communication import barrier
+
+    barrier()
+
+
+def gloo_release():
+    return None
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """≙ paddle.distributed.split (model-parallel layer splitter from the
+    static-graph era). The dygraph/TPU path expresses the same thing with
+    fleet.meta_parallel Column/RowParallelLinear + VocabParallelEmbedding
+    (GSPMD shards the weight); this entry point raises with that pointer
+    rather than creating hidden parameters."""
+    raise NotImplementedError(
+        "paddle.distributed.split creates hidden static-graph parameters; "
+        "use paddle_tpu.distributed.meta_parallel.ColumnParallelLinear / "
+        "RowParallelLinear / VocabParallelEmbedding — same math, explicit "
+        "parameters, GSPMD-sharded")
+
+
+# --------------------------------------------------------------- PS-era stubs
+class _PSEntry:
+    """Sparse-table accessor config carriers (≙ distributed/entry_attr.py) —
+    value objects; the brpc table they configure is out of TPU scope."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+    def _to_attr(self):
+        return repr(self.__dict__)
+
+
+class CountFilterEntry(_PSEntry):
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        super().__init__(count_filter=count_filter)
+
+
+class ProbabilityEntry(_PSEntry):
+    def __init__(self, probability):
+        if not 0 <= probability <= 1:
+            raise ValueError("probability must be in [0, 1]")
+        super().__init__(probability=probability)
+
+
+class ShowClickEntry(_PSEntry):
+    def __init__(self, show_name, click_name):
+        super().__init__(show_name=show_name, click_name=click_name)
+
+
+_PS_DATASET_MSG = (
+    "{} is the parameter-server MultiSlotDataFeed pipeline (brpc/C++ "
+    "dataset) — out of the TPU north-star scope (SURVEY §7); use "
+    "paddle.io.DataLoader / paddle.io.IterableDataset for input pipelines")
+
+
+class InMemoryDataset:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_PS_DATASET_MSG.format("InMemoryDataset"))
+
+
+class QueueDataset:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_PS_DATASET_MSG.format("QueueDataset"))

@@ -1,0 +1,55 @@
+"""paddle.distributed.io (≙ python/paddle/distributed/io.py): persistable
+save/load helpers for distributed programs. The dygraph/TPU equivalents are
+state_dict checkpoints; these entry points adapt them."""
+from __future__ import annotations
+
+import os
+
+__all__ = ['save_persistables', 'load_persistables',
+           'is_persistable', 'save_inference_model']
+
+
+def is_persistable(var):
+    """Parameters and buffers persist; activations don't."""
+    from ..core.tensor import Parameter, Tensor
+
+    return isinstance(var, Parameter) or (
+        isinstance(var, Tensor) and getattr(var, "persistable", False))
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    """Save a Layer's persistable state (≙ io.py save_persistables; the
+    `main_program` slot accepts a Layer here — there is no ProgramDesc)."""
+    from ..framework_io import save
+
+    if main_program is None or not hasattr(main_program, "state_dict"):
+        raise ValueError(
+            "save_persistables(main_program=...) must be a Layer in the "
+            "TPU-native build (no static Program objects)")
+    path = os.path.join(dirname, filename or "persistables.pdparams")
+    os.makedirs(dirname, exist_ok=True)
+    save(main_program.state_dict(), path)
+    return path
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    from ..framework_io import load
+
+    if main_program is None or not hasattr(main_program, "set_state_dict"):
+        raise ValueError(
+            "load_persistables(main_program=...) must be a Layer in the "
+            "TPU-native build")
+    path = os.path.join(dirname, filename or "persistables.pdparams")
+    main_program.set_state_dict(load(path))
+    return main_program
+
+
+def save_inference_model(dirname, feeded_var_names=None, target_vars=None,
+                         executor=None, main_program=None, **kw):
+    """Route to paddle.jit.save (StableHLO export) — the deployment format
+    of this build."""
+    raise NotImplementedError(
+        "use paddle.jit.save(layer, path) — inference export here is "
+        "AOT StableHLO via jit.save/load, not ProgramDesc files")

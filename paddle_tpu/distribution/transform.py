@@ -292,6 +292,11 @@ class StackTransform(Transform):
     def _split(self, x):
         from ..ops.extras import unstack
 
+        n = x.shape[self.axis]
+        if n != len(self.transforms):
+            raise ValueError(
+                f"StackTransform: {len(self.transforms)} transforms but "
+                f"{n} slices along axis {self.axis}")
         return unstack(x, axis=self.axis)
 
     def forward(self, x):

@@ -327,7 +327,13 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
     """≙ incubate fused_multi_transformer (the serving megakernel stacking
     N pre-LN transformer layers): expressed as the layer loop — XLA compiles
     it into one program; the per-layer fusion work the CUDA kernel does by
-    hand falls out of the jit."""
+    hand falls out of the jit. The cached-decode path (cache_kvs/time_step)
+    is not emulated — use masked_multihead_attention per layer."""
+    if cache_kvs is not None or time_step is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer cached decoding (cache_kvs/time_step) "
+            "is not emulated here — drive per-layer "
+            "masked_multihead_attention for the KV-cache decode loop")
     n_layers = len(qkv_weights)
     out = x
     for i in range(n_layers):
@@ -353,7 +359,9 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
         k = k.reshape([b, s, heads, dh])
         v = v.reshape([b, s, heads, dh])
         att = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
-                                             is_causal=attn_mask is None)
+                                             dropout_p=dropout_rate,
+                                             is_causal=attn_mask is None,
+                                             training=training)
         att = att.reshape([b, s, d_model])
         att = F.linear(att, linear_weights[i],
                        linear_biases[i] if linear_biases is not None else None)

@@ -327,12 +327,14 @@ def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
 
 
 def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
-    """Sparse reduce-sum (dense-aligned; result is dense like paddle when
-    reducing, sparse when axis is None? — paddle returns sparse; we return
-    a 0-d/reduced DENSE tensor for axis reductions and sparse scalar-like
-    for full sum, matching value semantics)."""
+    """Sparse reduce-sum; returns a dense tensor (value-equivalent to the
+    reference). axis=None never densifies — summing the stored values is
+    the whole reduction (zeros contribute nothing)."""
     from ..ops.reduction import sum as dense_sum
 
+    if axis is None:
+        _check_sparse(x)
+        return dense_sum(x._spvals, dtype=dtype, keepdim=keepdim)
     return dense_sum(to_dense(x), axis=axis, dtype=dtype, keepdim=keepdim)
 
 

@@ -61,3 +61,394 @@ def program_guard(*a, **k):
     raise NotImplementedError(
         "program_guard: use paddle.jit.to_static to capture a program")
     yield
+
+
+# --------------------------------------------------------- surface completion
+# (≙ python/paddle/static/__init__.py:71 __all__). Semantics that carry over
+# to eager/XLA execution are implemented; engine pieces that only exist for
+# ProgramDesc raise with the to_static pointer.
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """≙ static.gradients → dygraph paddle.grad."""
+    from ..core.engine import grad
+
+    ts = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return grad(ts, ins, grad_outputs=target_gradients, allow_unused=True)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """≙ static.append_backward: in define-by-run, backward() IS the
+    appended backward pass; returns (param, grad) pairs."""
+    loss.backward()
+    params = parameter_list or []
+    return [(p, p.grad) for p in params]
+
+
+from ..core.tensor import Tensor as Variable  # noqa: E402 — ≙ static
+# Variable: a true alias so both construction AND isinstance checks work
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Placeholder declaration → InputSpec (consumed by jit.to_static /
+    jit.save input signatures, the XLA analog of feed vars)."""
+    return InputSpec(shape=shape, dtype=dtype, name=name)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import numpy as _np
+
+    from ..ops.creation import full
+
+    t = full(shape, value, dtype=dtype)
+    t.persistable = persistable
+    if name:
+        t.name = name
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    import jax.numpy as _jnp
+
+    from ..core import dtype as _dtypes
+    from ..core.tensor import Parameter
+    from ..nn.initializer import Constant, XavierNormal
+
+    init = default_initializer or (Constant(0.0) if is_bias
+                                   else XavierNormal())
+    p = Parameter(_jnp.zeros(tuple(shape), _dtypes.convert_dtype(dtype)),
+                  _internal=True)
+    init(p)  # initializers fill a Parameter in place
+    if name:
+        p.name = name
+    return p
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve='ROC', num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    from ..metric import Auc
+
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(input, label)
+    import numpy as _np
+
+    from ..core.tensor import Tensor
+
+    return Tensor(_np.asarray(m.accumulate(), "float32"), _internal=True)
+
+
+def cpu_places(device_count=None):
+    import jax
+
+    from ..core.device import CPUPlace
+
+    try:
+        n_cpu = len(jax.devices("cpu"))
+    except RuntimeError:
+        n_cpu = 1
+    return [CPUPlace() for _ in range(device_count or n_cpu)]
+
+
+def cuda_places(device_ids=None):
+    """No CUDA in this build — the accelerator places are TPU chips."""
+    import jax
+
+    from ..core.device import TPUPlace
+
+    ids = device_ids if device_ids is not None else range(jax.device_count())
+    return [TPUPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return []
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Device pinning inside a program (XLA decides placement; the guard
+    exists for API parity and sets the default device when concrete)."""
+    yield
+
+
+class _Scope:
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_GLOBAL_SCOPE = _Scope()
+
+
+def global_scope():
+    return _GLOBAL_SCOPE
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _GLOBAL_SCOPE
+    prev, _GLOBAL_SCOPE = _GLOBAL_SCOPE, scope
+    try:
+        yield
+    finally:
+        _GLOBAL_SCOPE = prev
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase='both'):
+    """≙ static.Print operator: eager-prints and passes the tensor through."""
+    import numpy as _np
+
+    prefix = (message + " ") if message else ""
+    print(f"{prefix}{getattr(input, 'name', 'var')} "
+          f"shape={list(input.shape)} values="
+          f"{_np.asarray(input._data).ravel()[:summarize]}")
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """≙ static.py_func: in eager mode the python function just runs."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return func(*xs)
+
+
+class ExponentialMovingAverage:
+    """≙ static.ExponentialMovingAverage — real shadow-weight EMA usable in
+    eager/to_static training: update() after each step, apply()/restore()
+    around evaluation."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._params = None
+        self._step = 0
+
+    def _ensure(self, params):
+        import jax.numpy as _jnp
+
+        if self._params is None:
+            self._params = list(params)
+            for p in self._params:
+                self._shadow[id(p)] = _jnp.array(p._data)
+
+    def update(self, parameters=None):
+        import jax.numpy as _jnp
+
+        if parameters is not None or self._params is None:
+            import paddle_tpu as _paddle
+
+            if parameters is None:
+                raise ValueError("first update() needs `parameters`")
+            self._ensure(parameters)
+        self._step += 1
+        d = self._decay
+        for p in self._params:
+            s = self._shadow[id(p)]
+            self._shadow[id(p)] = d * s + (1 - d) * p._data
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        import jax.numpy as _jnp
+
+        for p in self._params or []:
+            self._backup[id(p)] = p._data
+            p._assign_raw(self._shadow[id(p)].astype(p._data.dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params or []:
+            if id(p) in self._backup:
+                p._assign_raw(self._backup.pop(id(p)))
+
+
+class WeightNormParamAttr:
+    """≙ static.WeightNormParamAttr (config carrier; weight-norm itself via
+    nn.utils on the dygraph path)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+def save(program, model_path, protocol=4, **configs):
+    """Layer-state save (the Program slot takes a Layer here)."""
+    from ..framework_io import save as _save
+
+    if hasattr(program, "state_dict"):
+        _save(program.state_dict(), model_path + ".pdparams")
+        return
+    raise ValueError("static.save expects a Layer in the TPU-native build")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    from ..framework_io import load as _load
+
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(_load(model_path + ".pdparams"))
+        return
+    raise ValueError("static.load expects a Layer in the TPU-native build")
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework_io import load as _load
+
+    return _load(model_path + ".pdparams")
+
+
+def set_program_state(program, state_dict):
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(state_dict)
+        return
+    raise ValueError("set_program_state expects a Layer here")
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+_PROGRAM_MSG = ("ProgramDesc serialization does not exist in the TPU-native "
+                "build — paddle.jit.save exports StableHLO; paddle.jit.load "
+                "restores it")
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    raise NotImplementedError(_PROGRAM_MSG)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    raise NotImplementedError(_PROGRAM_MSG)
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    raise NotImplementedError(_PROGRAM_MSG)
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor, **kwargs):
+    raise NotImplementedError(_PROGRAM_MSG)
+
+
+def deserialize_program(data):
+    raise NotImplementedError(_PROGRAM_MSG)
+
+
+def deserialize_persistables(program, data, executor):
+    raise NotImplementedError(_PROGRAM_MSG)
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    raise NotImplementedError(_PROGRAM_MSG)
+
+
+class Executor:
+    """≙ static.Executor shim: `run` executes a callable (the compiled
+    to_static function) — PirInterpreter's role belongs to XLA here."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if callable(program):
+            args = list((feed or {}).values())
+            return program(*args)
+        raise NotImplementedError(
+            "Executor.run expects a compiled callable (jit.to_static "
+            "product) — ProgramDesc execution is not part of this build")
+
+    def close(self):
+        return None
+
+
+class BuildStrategy:
+    """Config carrier (≙ static.BuildStrategy): XLA owns fusion decisions;
+    fields are accepted and recorded for parity."""
+
+    def __init__(self):
+        self.debug_graphviz_path = ""
+        self.enable_sequential_execution = False
+        self.fuse_broadcast_ops = False
+        self.fuse_elewise_add_act_ops = False
+        self.memory_optimize = True
+
+
+class CompiledProgram:
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __call__(self, *args, **kwargs):
+        return self._program(*args, **kwargs)
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU backends are not part of this build")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU backends are not part of this build")
+
+
+def ipu_shard_guard(*a, **k):
+    raise NotImplementedError("IPU backends are not part of this build")
+
+
+def set_ipu_shard(*a, **k):
+    raise NotImplementedError("IPU backends are not part of this build")
+
+
+def ctr_metric_bundle(*a, **k):
+    raise NotImplementedError(
+        "ctr_metric_bundle is parameter-server CTR tooling (out of TPU "
+        "scope); use paddle.metric.Auc")
+
+
+from . import nn  # noqa: E402,F401 — static.nn functional surface
+
+__all__ += [
+    'append_backward', 'gradients', 'Executor', 'global_scope', 'scope_guard',
+    'BuildStrategy', 'CompiledProgram', 'ipu_shard_guard',
+    'IpuCompiledProgram', 'IpuStrategy', 'Print', 'py_func',
+    'WeightNormParamAttr', 'ExponentialMovingAverage', 'data', 'save', 'load',
+    'save_inference_model', 'load_inference_model', 'serialize_program',
+    'serialize_persistables', 'save_to_file', 'deserialize_program',
+    'deserialize_persistables', 'load_from_file', 'normalize_program',
+    'load_program_state', 'set_program_state', 'cpu_places', 'cuda_places',
+    'xpu_places', 'Variable', 'create_global_var', 'accuracy', 'auc',
+    'device_guard', 'create_parameter', 'set_ipu_shard', 'ctr_metric_bundle',
+    'nn',
+]

@@ -1,0 +1,15 @@
+"""paddle.static.nn (≙ python/paddle/static/nn/): the static-graph layer
+builders map onto the functional nn surface in eager/XLA execution."""
+from ..nn import functional as F  # noqa: F401
+
+from ..nn.functional import (  # noqa: F401
+    conv2d, conv3d, batch_norm, layer_norm, group_norm, embedding,
+)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """≙ static.nn.fc: creates parameters on first call via a Linear layer
+    cached on the input's shape."""
+    raise NotImplementedError(
+        "static.nn.fc creates hidden parameters; use paddle.nn.Linear")

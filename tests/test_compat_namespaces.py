@@ -168,3 +168,182 @@ class TestMiscNamespaces:
             fleet.run_server()
         with pytest.raises(NotImplementedError):
             fleet.save_persistables()
+
+
+class TestStaticSurface:
+    """static-graph compat surface (reference static/__init__.py:71)."""
+
+    def test_gradients_and_append_backward(self):
+        x = paddle.to_tensor(np.array([2.0, 3.0], dtype="float32"))
+        x.stop_gradient = False
+        y = (x ** 2).sum()
+        (g,) = paddle.static.gradients(y, x)
+        np.testing.assert_allclose(np.asarray(g._data), [4.0, 6.0])
+
+    def test_ema(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        lin = nn.Linear(2, 2)
+        ema = paddle.static.ExponentialMovingAverage(0.5)
+        w0 = np.asarray(lin.weight._data).copy()
+        ema.update(lin.parameters())       # shadow = w0
+        lin.weight._assign_raw(np.zeros_like(w0))
+        ema.update()                       # shadow = 0.5*w0 + 0.5*0
+        with ema.apply():
+            applied = np.asarray(lin.weight._data).copy()
+        np.testing.assert_allclose(applied, 0.5 * w0, rtol=1e-5)
+        # restored after context
+        np.testing.assert_allclose(np.asarray(lin.weight._data), 0.0)
+
+    def test_misc_working_pieces(self):
+        spec = paddle.static.data("x", [None, 4], "float32")
+        assert spec.name == "x"
+        v = paddle.static.create_global_var([2, 2], 1.5, "float32")
+        np.testing.assert_allclose(np.asarray(v._data), 1.5)
+        p = paddle.static.create_parameter([3, 3], "float32")
+        assert list(p.shape) == [3, 3]
+        out = paddle.static.Print(v, message="test")
+        assert out is v
+        assert paddle.static.py_func(lambda a: a * 2, v, None) is not None
+        places = paddle.static.cuda_places()
+        assert isinstance(places, list)
+        with paddle.static.scope_guard(paddle.static.global_scope()):
+            pass
+
+    def test_engine_pieces_raise(self):
+        with pytest.raises(NotImplementedError):
+            paddle.static.save_inference_model("p", [], [])
+        with pytest.raises(NotImplementedError):
+            paddle.static.IpuStrategy()
+        ex = paddle.static.Executor()
+        assert ex.run(lambda: 42) == 42
+        with pytest.raises(NotImplementedError):
+            ex.run(program=None)
+
+
+class TestDistributedSurface:
+    def test_markers_and_enums(self):
+        import paddle_tpu.distributed as dist
+
+        assert dist.ReduceType.kRedSum == 0
+        assert dist.SplitPoint.END == "end"
+        s1 = dist.ShardingStage2()
+        assert s1.level == "os_g"
+        st = dist.Strategy({"sharding": {"enable": True, "stage": 2}})
+        assert st.sharding.enable and st.sharding.stage == 2
+
+    def test_mesh_state_and_backend(self):
+        import paddle_tpu.distributed as dist
+
+        mesh = dist.ProcessMesh([0], dim_names=["x"])
+        dist.set_mesh(mesh)
+        assert dist.get_mesh() is mesh
+        assert dist.get_backend().startswith("XCCL")
+        assert dist.is_available()
+
+    def test_comm_long_tail(self):
+        import paddle_tpu.distributed as dist
+
+        t = paddle.to_tensor(np.array([1.0, 2.0], dtype="float32"))
+        parts = dist.gather(t)
+        assert len(parts) >= 1
+        out = []
+        dist.scatter_object_list(out, [{"a": 1}])
+        assert out == [{"a": 1}]
+        assert dist.wait(t) is t
+
+    def test_to_static_distmodel_trains(self):
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+
+        paddle.seed(0)
+        rs = np.random.RandomState(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        loss_fn = lambda logits, y: F.cross_entropy(logits, y)
+        dm = dist.to_static(net, loss=loss_fn, optimizer=opt)
+        X = paddle.to_tensor(rs.randn(8, 4).astype("float32"))
+        Y = paddle.to_tensor(rs.randint(0, 2, 8).astype("int64"))
+        first = last = None
+        for i in range(12):
+            loss = dm(X, Y)
+            v = float(np.asarray(loss._data))
+            first = first or v
+            last = v
+        assert last < first
+
+    def test_ps_stubs(self):
+        import paddle_tpu.distributed as dist
+
+        e = dist.CountFilterEntry(5)
+        assert e.count_filter == 5
+        with pytest.raises(ValueError):
+            dist.ProbabilityEntry(2.0)
+        with pytest.raises(NotImplementedError):
+            dist.InMemoryDataset()
+        with pytest.raises(NotImplementedError):
+            dist.split(None, (4, 8), "linear")
+
+
+class TestReviewRegressions2:
+    def test_distmodel_eval_does_not_update_params(self):
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+
+        paddle.seed(0)
+        rs = np.random.RandomState(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=net.parameters())
+        dm = dist.to_static(net, loss=lambda o, y: F.cross_entropy(o, y),
+                            optimizer=opt)
+        X = paddle.to_tensor(rs.randn(8, 4).astype("float32"))
+        Y = paddle.to_tensor(rs.randint(0, 2, 8).astype("int64"))
+        for _ in range(5):   # train past compile threshold
+            dm(X, Y)
+        dm.eval()
+        w_before = np.asarray(net.weight._data).copy()
+        for _ in range(3):
+            dm(X, Y)
+        np.testing.assert_allclose(np.asarray(net.weight._data), w_before)
+        dm.train()
+        dm(X, Y)
+        assert not np.allclose(np.asarray(net.weight._data), w_before)
+
+    def test_local_layer_subclass(self):
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.nn as nn
+
+        class MyLocal(dist.LocalLayer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(3, 3)
+
+            def forward(self, x):
+                return self.lin(x)
+
+        m = MyLocal()
+        assert isinstance(m, dist.LocalLayer)
+        out = m(paddle.to_tensor(np.ones((2, 3), "float32")))
+        assert list(out.shape) == [2, 3]
+
+    def test_static_variable_isinstance(self):
+        t = paddle.to_tensor(np.ones(2, "float32"))
+        assert isinstance(t, paddle.static.Variable)
+
+    def test_sparse_full_sum_no_densify(self):
+        d = np.array([[1.0, 0], [0, 4.0]], "float32")
+        sp = paddle.sparse.to_sparse_coo(paddle.to_tensor(d))
+        np.testing.assert_allclose(
+            float(np.asarray(paddle.sparse.sum(sp)._data)), 5.0)
+
+    def test_stack_transform_length_check(self):
+        from paddle_tpu import distribution as D
+
+        st = D.StackTransform([D.ExpTransform()], axis=0)
+        with pytest.raises(ValueError, match="slices"):
+            st.forward(paddle.to_tensor(np.ones((3, 2), "float32")))
