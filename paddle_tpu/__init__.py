@@ -136,3 +136,105 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
     from .hapi.summary import flops as _f
 
     return _f(net, input_size, custom_ops, print_detail)
+
+# ---------------------------------------------------- top-level export closure
+# (≙ reference python/paddle/__init__.py long tail)
+import math as _math
+
+e = _math.e
+pi = _math.pi
+inf = float("inf")
+nan = float("nan")
+newaxis = None  # paddle.newaxis ≙ np.newaxis
+
+from .nn import ParamAttr  # noqa: E402
+from .distributed.meta_parallel import DataParallel  # noqa: E402
+from .core.device import CUDAPinnedPlace  # noqa: E402
+dtype = _np.dtype  # paddle.dtype: dtype objects ARE numpy dtypes here
+pstring = "pstring"  # string-tensor dtype tag (no string tensors yet)
+
+
+class LazyGuard:
+    """≙ paddle.LazyGuard (lazy parameter materialization). Parameters here
+    are created eagerly but cheaply (no device sync until first use), so the
+    guard is a transparent context kept for API parity."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """≙ paddle.set_printoptions → numpy print options (Tensor repr prints
+    via numpy)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def to_dlpack(x):
+    """≙ paddle.utils.dlpack.to_dlpack: returns an object implementing the
+    DLPack protocol (the jax.Array itself — zero copy; modern DLPack passes
+    protocol objects, not raw capsules)."""
+    return x._data
+
+
+def from_dlpack(ext):
+    """Accepts any object with __dlpack__ (torch/numpy/jax arrays, or the
+    product of to_dlpack)."""
+    import jax.numpy as _jnp
+
+    arr = _jnp.from_dlpack(ext)
+    return Tensor(arr, _internal=True, stop_gradient=True)
+
+
+def get_cuda_rng_state():
+    """CUDA alias of the device RNG state (the TPU key chain)."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    return set_rng_state(state)
+
+
+def disable_signal_handler():
+    """≙ paddle.disable_signal_handler: the XLA runtime installs no python
+    signal handlers — nothing to disable."""
+    return None
+
+
+def tolist(x):
+    return x.tolist()  # Tensor.tolist is defined in core/tensor.py
+
+
+def _cuda_lib_version_stub(_name):
+    def version():
+        return 0  # no CUDA libraries in the TPU-native build
+
+    version.__name__ = _name
+    version.__doc__ = f"{_name} version probe — CUDA-free build returns 0."
+    return version
+
+
+cublas = _cuda_lib_version_stub("cublas")
+cudnn = _cuda_lib_version_stub("cudnn")
+cufft = _cuda_lib_version_stub("cufft")
+curand = _cuda_lib_version_stub("curand")
+cusolver = _cuda_lib_version_stub("cusolver")
+cusparse = _cuda_lib_version_stub("cusparse")
+cuda_runtime = _cuda_lib_version_stub("cuda_runtime")
+cuda_nvrtc = _cuda_lib_version_stub("cuda_nvrtc")
+nvjitlink = _cuda_lib_version_stub("nvjitlink")
+

@@ -113,3 +113,26 @@ def is_compiled_with_cuda() -> bool:  # parity shim
 
 def is_compiled_with_tpu() -> bool:
     return _has_platform("tpu") or _has_platform("axon")
+
+
+class CUDAPinnedPlace(Place):
+    """≙ paddle CUDAPinnedPlace (page-locked host staging memory). Host↔TPU
+    transfers here always stage through pinned-equivalent buffers managed by
+    the XLA runtime, so this place is informational (host-device backed)."""
+
+    def __init__(self):
+        super().__init__(None)
+
+    @property
+    def jax_device(self):
+        import jax as _jax
+
+        if self._device is None:
+            try:
+                self._device = _jax.devices("cpu")[0]
+            except RuntimeError:
+                self._device = _jax.devices()[0]
+        return self._device
+
+    def __repr__(self):
+        return "Place(cuda_pinned)"

@@ -180,10 +180,14 @@ class DistModel:
         from ..jit import to_static as _ts
 
         def make_step(mode):
+            use_loss = mode in ("train", "eval")
+
             def step(*inputs):
-                out = self.network(*inputs[:-1]) if self._loss is not None \
+                # predict mode runs forward only — no label operand, no loss
+                out = self.network(*inputs[:-1]) \
+                    if (self._loss is not None and use_loss) \
                     else self.network(*inputs)
-                if self._loss is not None:
+                if self._loss is not None and use_loss:
                     out = self._loss(out, inputs[-1])
                     if mode == "train":
                         out.backward()
@@ -304,7 +308,7 @@ def to_distributed(model, optimizer=None, dataloader=None, device_num=None,
     intermediate parallelize() plan API over the global mesh."""
     from .auto_parallel.parallelize import parallelize
 
-    model = parallelize(model, optimizer, config or {})
+    model, optimizer = parallelize(model, optimizer, config or {})
     out = [model]
     if optimizer is not None:
         out.append(optimizer)

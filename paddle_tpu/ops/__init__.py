@@ -176,7 +176,7 @@ _INPLACE_BASES = (
     "logical_not logical_or logical_xor logit masked_fill masked_scatter "
     "mod multigammaln multiply nan_to_num neg not_equal pow polygamma "
     "put_along_axis relu remainder renorm rsqrt scatter_nd_add sin sinc "
-    "sinh subtract tan tanh trunc index_add log_normal square t "
+    "sinh subtract tan tanh trunc index_add log_normal square t erf expm1 "
     "tril triu"
 ).split()
 

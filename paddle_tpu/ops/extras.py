@@ -486,6 +486,83 @@ def create_tensor(dtype="float32", name=None, persistable=False):
                   stop_gradient=True)
 
 
+def positive(x, name=None):
+    """+x (identity with dtype checks — ≙ paddle.positive)."""
+    return op_call(lambda a: +a, x, name="positive")
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return op_call(lambda a, b: jnp.sum(a * b, axis=axis), x, y,
+                   name="vecdot")
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of rows (≙ paddle.pdist)."""
+    n = x.shape[0]
+    iu = np.triu_indices(n, 1)
+
+    def f(a):
+        d = a[:, None, :] - a[None, :, :]
+        if jnp.isinf(p):
+            full = jnp.max(jnp.abs(d), -1)
+        elif p == 0:
+            full = jnp.sum(d != 0, -1).astype(a.dtype)
+        else:
+            full = jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+        return full[iu]
+
+    return op_call(f, x, name="pdist")
+
+
+def cartesian_prod(x, name=None):
+    """Cartesian product of 1-D tensors (≙ paddle.cartesian_prod)."""
+    tensors = x if isinstance(x, (list, tuple)) else [x]
+
+    def f(*arrs):
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+    out = op_call(f, *tensors, name="cartesian_prod")
+    return out
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """r-combinations of a 1-D tensor's elements (≙ paddle.combinations)."""
+    import itertools as _it
+
+    n = x.shape[0]
+    combo = _it.combinations_with_replacement(range(n), r) \
+        if with_replacement else _it.combinations(range(n), r)
+    idx = np.array(list(combo), dtype=np.int64).reshape(-1, r)
+
+    def f(a):
+        return a[jnp.asarray(idx)]
+
+    return op_call(f, x, name="combinations")
+
+
+def standard_gamma(x, name=None):
+    """Sample Gamma(alpha=x, 1) elementwise (≙ paddle.standard_gamma)."""
+    from ..core.rng import next_key
+
+    key = next_key()
+    return op_call(lambda a: jax.random.gamma(key, a, dtype=jnp.float32)
+                   .astype(a.dtype), x, name="standard_gamma")
+
+
+def check_shape(x, expected_shape, name=None):
+    """Assert the runtime shape (≙ paddle.check_shape): static here."""
+    got = tuple(x.shape)
+    want = tuple(int(s) if s is not None else None for s in expected_shape)
+    if len(got) != len(want):
+        raise ValueError(f"check_shape failed: rank {len(got)} != "
+                         f"expected rank {len(want)} (got {got}, want {want})")
+    for g, w in zip(got, want):
+        if w is not None and w != -1 and g != w:
+            raise ValueError(f"check_shape failed: got {got}, expected {want}")
+    return x
+
+
 def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
          pad_mode="reflect", normalized=False, onesided=True, name=None):
     """Tensor-level alias of paddle.signal.stft."""

@@ -334,7 +334,12 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
 
     if axis is None:
         _check_sparse(x)
-        return dense_sum(x._spvals, dtype=dtype, keepdim=keepdim)
+        total = dense_sum(x._spvals, dtype=dtype)
+        if keepdim:
+            from ..ops.manipulation import reshape as dense_reshape
+
+            return dense_reshape(total, [1] * len(x._spshape))
+        return total
     return dense_sum(to_dense(x), axis=axis, dtype=dtype, keepdim=keepdim)
 
 

@@ -82,8 +82,12 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     """≙ static.append_backward: in define-by-run, backward() IS the
     appended backward pass; returns (param, grad) pairs."""
     loss.backward()
-    params = parameter_list or []
-    return [(p, p.grad) for p in params]
+    if parameter_list is None:
+        raise ValueError(
+            "append_backward needs parameter_list in the TPU-native build: "
+            "there is no global Program to enumerate parameters from — pass "
+            "model.parameters() (grads are on each Parameter.grad either way)")
+    return [(p, p.grad) for p in parameter_list]
 
 
 from ..core.tensor import Tensor as Variable  # noqa: E402 — ≙ static
@@ -98,8 +102,6 @@ def data(name, shape, dtype="float32", lod_level=0):
 
 def create_global_var(shape, value, dtype, persistable=False,
                       force_cpu=False, name=None):
-    import numpy as _np
-
     from ..ops.creation import full
 
     t = full(shape, value, dtype=dtype)
@@ -251,8 +253,6 @@ class ExponentialMovingAverage:
         import jax.numpy as _jnp
 
         if parameters is not None or self._params is None:
-            import paddle_tpu as _paddle
-
             if parameters is None:
                 raise ValueError("first update() needs `parameters`")
             self._ensure(parameters)
@@ -264,8 +264,6 @@ class ExponentialMovingAverage:
 
     @contextlib.contextmanager
     def apply(self, executor=None, need_restore=True):
-        import jax.numpy as _jnp
-
         for p in self._params or []:
             self._backup[id(p)] = p._data
             p._assign_raw(self._shadow[id(p)].astype(p._data.dtype))

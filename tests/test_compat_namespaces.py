@@ -347,3 +347,65 @@ class TestReviewRegressions2:
         st = D.StackTransform([D.ExpTransform()], axis=0)
         with pytest.raises(ValueError, match="slices"):
             st.forward(paddle.to_tensor(np.ones((3, 2), "float32")))
+
+
+class TestTopLevelClosure:
+    """Top-level export long tail (reference paddle/__init__.py)."""
+
+    def test_constants(self):
+        import math
+
+        assert paddle.pi == math.pi and paddle.e == math.e
+        assert paddle.inf == float("inf") and np.isnan(paddle.nan)
+        assert paddle.newaxis is None
+
+    def test_math_extras(self):
+        x = paddle.to_tensor(np.array([[0.0, 0.0], [3.0, 4.0]], "float32"))
+        np.testing.assert_allclose(np.asarray(paddle.pdist(x)._data), [5.0])
+        v = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        w = paddle.to_tensor(np.array([3.0, 4.0], "float32"))
+        np.testing.assert_allclose(
+            float(np.asarray(paddle.vecdot(v, w)._data)), 11.0)
+        cp = paddle.cartesian_prod([v, w])
+        assert list(cp.shape) == [4, 2]
+        cb = paddle.combinations(paddle.to_tensor(
+            np.array([1.0, 2.0, 3.0], "float32")))
+        np.testing.assert_allclose(np.asarray(cb._data),
+                                   [[1, 2], [1, 3], [2, 3]])
+        pos = paddle.positive(v)
+        np.testing.assert_allclose(np.asarray(pos._data), [1.0, 2.0])
+        paddle.seed(0)
+        g = paddle.standard_gamma(paddle.to_tensor(
+            np.full(200, 3.0, "float32")))
+        assert abs(float(np.asarray(g._data).mean()) - 3.0) < 0.5
+
+    def test_check_shape(self):
+        x = paddle.ones([2, 3])
+        assert paddle.check_shape(x, [2, 3]) is x
+        assert paddle.check_shape(x, [-1, 3]) is x
+        with pytest.raises(ValueError):
+            paddle.check_shape(x, [2, 4])
+
+    def test_dlpack_roundtrip_and_torch_interop(self):
+        import torch
+
+        t = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        back = paddle.from_dlpack(paddle.to_dlpack(t))
+        np.testing.assert_allclose(np.asarray(back._data), [1.0, 2.0])
+        tb = paddle.from_dlpack(torch.arange(3).float())
+        np.testing.assert_allclose(np.asarray(tb._data), [0.0, 1.0, 2.0])
+
+    def test_misc(self):
+        assert paddle.cudnn() == 0 and paddle.cublas() == 0
+        paddle.disable_signal_handler()
+        with paddle.LazyGuard():
+            pass
+        assert paddle.tolist(paddle.ones([2])) == [1.0, 1.0]
+        assert paddle.ones([2]).tolist() == [1.0, 1.0]
+        st = paddle.get_cuda_rng_state()
+        paddle.set_cuda_rng_state(st)
+        repr(paddle.CUDAPinnedPlace())
+        t = paddle.to_tensor(np.array([0.5], "float32"))
+        t.expm1_()
+        np.testing.assert_allclose(np.asarray(t._data), np.expm1([0.5]),
+                                   rtol=1e-6)
