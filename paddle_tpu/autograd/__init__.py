@@ -122,3 +122,32 @@ def jacobian(func, xs, batch_axis=None):
     import jax.tree_util as jtu
 
     return jtu.tree_map(lambda a: Tensor(a, _internal=True), j)
+
+
+class saved_tensors_hooks:
+    """≙ autograd.saved_tensors_hooks: intercept tensors saved for backward
+    (pack on save, unpack on first use — activation offloading/compression).
+
+    Scope note (TPU-native): the hooks apply to the FRAMEWORK-saved operand
+    buffers (GradNode ctx, used by double-grad re-derivation — active when
+    FLAGS_enable_double_grad is on). The primal vjp residuals are owned by
+    XLA inside compiled programs and are not visible to Python hooks; use
+    jax.checkpoint/remat (nn recompute) for residual memory pressure."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+        self._prev = None
+
+    def __enter__(self):
+        from ..core import dispatch as _dispatch
+
+        self._prev = _dispatch.saved_tensor_hooks
+        _dispatch.saved_tensor_hooks = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        from ..core import dispatch as _dispatch
+
+        _dispatch.saved_tensor_hooks = self._prev
+        return False

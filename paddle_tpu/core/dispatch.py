@@ -380,6 +380,12 @@ def _check_nan_inf(name, arrs):
                 raise FloatingPointError(f"Operator '{name}' output contains NaN/Inf")
 
 
+#: (pack, unpack) installed by autograd.saved_tensors_hooks; applied to the
+#: ctx-pinned operand buffers (the framework-visible saved tensors — the
+#: XLA-managed vjp residuals live in device memory outside hook scope)
+saved_tensor_hooks = None
+
+
 def _make_ctx(fn, datas, diff_idx):
     """Re-derivation ctx for create_graph. Differentiable operands are
     stored as None — _regrad rebuilds them from node.inputs, so the ctx
@@ -388,7 +394,26 @@ def _make_ctx(fn, datas, diff_idx):
     if not flag("FLAGS_enable_double_grad"):
         return None
     diff = set(diff_idx)
-    return (fn, [None if i in diff else d for i, d in enumerate(datas)])
+    kept = [None if i in diff else d for i, d in enumerate(datas)]
+    if saved_tensor_hooks is not None:
+        pack, unpack = saved_tensor_hooks
+        kept = [None if d is None else _PackedSaved(pack(d), unpack)
+                for d in kept]
+    return (fn, kept)
+
+
+class _PackedSaved:
+    """A ctx slot transformed by saved_tensors_hooks; unpacked lazily on
+    first re-derivation use."""
+
+    __slots__ = ("payload", "unpack")
+
+    def __init__(self, payload, unpack):
+        self.payload = payload
+        self.unpack = unpack
+
+    def get(self):
+        return self.unpack(self.payload)
 
 
 #: set by paddle_tpu.profiler while recording: callable(name) -> RecordEvent

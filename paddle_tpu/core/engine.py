@@ -105,6 +105,9 @@ def _regrad(node, cots):
     from .dispatch import op_call
 
     fn, datas = node.ctx
+    from .dispatch import _PackedSaved
+
+    datas = [d.get() if isinstance(d, _PackedSaved) else d for d in datas]
     diff_idx = node.diff_idx or []
     k = len(diff_idx)
     # float cotangents ride as op args (differentiable); float0 stay closed over

@@ -1,6 +1,191 @@
 """paddle.incubate — experimental APIs (≙ python/paddle/incubate)."""
+import contextlib as _contextlib
 from . import autograd
 from . import distributed
 from . import nn
 
 __all__ = ["autograd", "distributed", "nn"]
+
+# ------------------------------------------------------- surface completion
+# (≙ reference incubate/__init__.py __all__)
+from ..geometric import (  # noqa: F401 — graph ops graduated to geometric;
+    # incubate keeps the old names
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+from ..geometric import reindex_graph as graph_reindex  # noqa: F401
+from ..geometric import sample_neighbors as graph_sample_neighbors  # noqa: F401
+from ..geometric import send_u_recv as graph_send_recv  # noqa: F401
+from .. import inference  # noqa: F401
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (≙ incubate/operators/graph_khop_sampler):
+    chained sample_neighbors over the hop list — hop k expands only the
+    NEW frontier from hop k-1. Host-side like the rest of the sampling
+    tier. return_eids is not supported (edge ids are not tracked by the
+    host sampler) and raises rather than mis-binding outputs."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    from ..geometric import sample_neighbors
+
+    if return_eids:
+        raise NotImplementedError(
+            "graph_khop_sampler(return_eids=True) is not supported: the "
+            "host sampler does not track edge ids — use "
+            "geometric.sample_neighbors(..., eids=..., return_eids=True) "
+            "per hop")
+    frontier = input_nodes
+    seen = np.unique(np.asarray(input_nodes._data))
+    all_edges_src, all_edges_dst, counts = [], [], []
+    for k in sample_sizes:
+        nbrs, cnt = sample_neighbors(row, colptr, frontier, sample_size=k)
+        all_edges_src.append(np.asarray(nbrs._data))
+        all_edges_dst.append(np.repeat(np.asarray(frontier._data),
+                                       np.asarray(cnt._data)))
+        counts.append(cnt)
+        fresh = np.setdiff1d(np.asarray(nbrs._data), seen)
+        seen = np.union1d(seen, fresh)
+        frontier = Tensor(jnp.asarray(fresh), _internal=True,
+                          stop_gradient=True)
+    edges_src = Tensor(jnp.asarray(np.concatenate(all_edges_src)),
+                       _internal=True, stop_gradient=True)
+    edges_dst = Tensor(jnp.asarray(np.concatenate(all_edges_dst)),
+                       _internal=True, stop_gradient=True)
+    all_nodes = Tensor(jnp.asarray(seen), _internal=True, stop_gradient=True)
+    return edges_src, edges_dst, all_nodes, counts
+
+
+def identity_loss(x, reduction="none"):
+    """≙ incubate identity_loss: marks a tensor as a loss for IPU graphs;
+    here it reduces per `reduction` and passes through."""
+    from ..ops.reduction import mean as _mean, sum as _sum
+
+    if reduction in (0, "sum"):
+        return _sum(x)
+    if reduction in (1, "mean"):
+        return _mean(x)
+    return x
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """≙ incubate softmax_mask_fuse (fused CUDA kernel): softmax(x + mask)
+    — one XLA fusion."""
+    import jax
+
+    from ..core.dispatch import op_call
+
+    return op_call(lambda a, m: jax.nn.softmax(a + m, axis=-1), x, mask,
+                   name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """≙ incubate softmax_mask_fuse_upper_triangle: causal-masked softmax
+    (upper triangle excluded) — the flash-attention mask as one fusion."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import op_call
+
+    def f(a):
+        s = a.shape[-1]
+        m = jnp.tril(jnp.ones((a.shape[-2], s), bool))
+        return jax.nn.softmax(jnp.where(m, a, -jnp.inf), axis=-1)
+
+    return op_call(f, x, name="softmax_mask_fuse_upper_triangle")
+
+
+class LookAhead:
+    """≙ incubate.LookAhead optimizer wrapper (k steps fast weights, then
+    interpolate toward slow weights)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_count = 0
+        self._slow = {}
+
+    def step(self):
+        import jax.numpy as jnp
+
+        self.inner_optimizer.step()
+        self._step_count += 1
+        params = self.inner_optimizer._parameters
+        if self._step_count == 1:
+            for p in params:
+                self._slow[id(p)] = jnp.array(p._data)
+        if self._step_count % self.k == 0:
+            for p in params:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p._data - slow)
+                self._slow[id(p)] = slow
+                p._assign_raw(slow.astype(p._data.dtype))
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def state_dict(self):
+        return self.inner_optimizer.state_dict()
+
+    def set_state_dict(self, state):
+        self.inner_optimizer.set_state_dict(state)
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return None, None
+
+
+class ModelAverage:
+    """≙ incubate.ModelAverage: running average of parameters applied at
+    eval time (apply/restore), EMA-free arithmetic mean over a window."""
+
+    def __init__(self, average_window_rate, parameters=None, min_average_window=10000,
+                 max_average_window=10000, name=None):
+        self._params = list(parameters or [])
+        self._sum = {}
+        self._count = 0
+        self._backup = {}
+
+    def step(self):
+        import jax.numpy as jnp
+
+        self._count += 1
+        for p in self._params:
+            acc = self._sum.get(id(p))
+            self._sum[id(p)] = (p._data if acc is None else acc + p._data)
+
+    @_contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            self._backup[id(p)] = p._data
+            if self._count:
+                p._assign_raw((self._sum[id(p)] / self._count)
+                              .astype(p._data.dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._assign_raw(self._backup.pop(id(p)))
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return None, None
+
+
+__all__ += [
+    "segment_max", "segment_mean", "segment_min", "segment_sum",
+    "graph_reindex", "graph_sample_neighbors", "graph_send_recv",
+    "graph_khop_sampler", "identity_loss", "inference",
+    "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+    "LookAhead", "ModelAverage",
+]

@@ -492,7 +492,8 @@ def positive(x, name=None):
 
 
 def vecdot(x, y, axis=-1, name=None):
-    return op_call(lambda a, b: jnp.sum(a * b, axis=axis), x, y,
+    """Array-API vecdot: conjugating inner product along `axis`."""
+    return op_call(lambda a, b: jnp.sum(jnp.conj(a) * b, axis=axis), x, y,
                    name="vecdot")
 
 
@@ -517,13 +518,15 @@ def pdist(x, p=2.0, name=None):
 def cartesian_prod(x, name=None):
     """Cartesian product of 1-D tensors (≙ paddle.cartesian_prod)."""
     tensors = x if isinstance(x, (list, tuple)) else [x]
+    if len(tensors) == 1:
+        # torch/paddle convention: a single input returns the 1-D tensor
+        return tensors[0]
 
     def f(*arrs):
         grids = jnp.meshgrid(*arrs, indexing="ij")
         return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
 
-    out = op_call(f, *tensors, name="cartesian_prod")
-    return out
+    return op_call(f, *tensors, name="cartesian_prod")
 
 
 def combinations(x, r=2, with_replacement=False, name=None):

@@ -409,3 +409,103 @@ class TestTopLevelClosure:
         t.expm1_()
         np.testing.assert_allclose(np.asarray(t._data), np.expm1([0.5]),
                                    rtol=1e-6)
+
+
+class TestDeviceIncubateSurface:
+    def test_device_streams_and_probes(self):
+        import paddle_tpu.device as dev
+
+        assert not dev.gpu.is_available()
+        s = dev.Stream()
+        e = s.record_event()
+        assert e.query()
+        with dev.stream_guard(dev.Stream()):
+            assert dev.current_stream() is not None
+        assert dev.get_cudnn_version() is None
+        assert dev.is_compiled_with_distribute()
+        dev.synchronize()
+
+    def test_incubate_graph_aliases(self):
+        import paddle_tpu.incubate as inc
+
+        x = paddle.to_tensor(np.array([[1.0, 2], [3, 4], [5, 6]], "float32"))
+        ids = paddle.to_tensor(np.array([0, 0, 1], "int64"))
+        out = inc.segment_sum(x, ids)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   [[4.0, 6], [5, 6]])
+
+    def test_softmax_mask_fuse(self):
+        import paddle_tpu.incubate as inc
+
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(2, 4, 4).astype("float32"))
+        out = inc.softmax_mask_fuse_upper_triangle(x)
+        o = np.asarray(out._data)
+        np.testing.assert_allclose(o.sum(-1), 1.0, rtol=1e-5)
+        assert (np.triu(o[0], 1) == 0).all()
+
+    def test_lookahead_and_model_average(self):
+        import paddle_tpu.incubate as inc
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        lin = nn.Linear(3, 1)
+        base = paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=lin.parameters())
+        opt = inc.LookAhead(base, alpha=0.5, k=2)
+        X = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(16, 3).astype("float32"))
+        Y = paddle.to_tensor(np.ones((16, 1), "float32"))
+        first = last = None
+        for _ in range(10):
+            loss = ((lin(X) - Y) ** 2).mean()
+            loss.backward(); opt.step(); opt.clear_grad()
+            v = float(np.asarray(loss._data)); first = first or v; last = v
+        assert last < first
+        ma = inc.ModelAverage(0.15, parameters=lin.parameters())
+        w_now = np.asarray(lin.weight._data).copy()
+        ma.step()
+        lin.weight._assign_raw(w_now * 3)
+        ma.step()
+        with ma.apply():
+            np.testing.assert_allclose(np.asarray(lin.weight._data),
+                                       2 * w_now, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(lin.weight._data), 3 * w_now,
+                                   rtol=1e-5)
+
+    def test_graph_khop_sampler(self):
+        import paddle_tpu.incubate as inc
+
+        row = paddle.to_tensor(np.array([1, 2, 0, 0, 1], "int64"))
+        colptr = paddle.to_tensor(np.array([0, 2, 3, 5], "int64"))
+        nodes = paddle.to_tensor(np.array([0], "int64"))
+        src, dst, final_nodes, counts = inc.graph_khop_sampler(
+            row, colptr, nodes, [2, 1])
+        assert np.asarray(src._data).size >= 2
+
+
+class TestSavedTensorHooks:
+    def test_hooks_fire_on_ctx_saved_tensors(self):
+        import paddle_tpu.autograd as autograd
+
+        packed, unpacked = [], []
+
+        def pack(d):
+            packed.append(d)
+            return ("wrapped", d)
+
+        def unpack(payload):
+            unpacked.append(payload)
+            return payload[1]
+
+        paddle.set_flags({"FLAGS_enable_double_grad": True})
+        x = paddle.to_tensor(np.array([2.0], "float32"))
+        x.stop_gradient = False
+        idx = paddle.to_tensor(np.array([0], "int64"))  # non-diff operand
+        with autograd.saved_tensors_hooks(pack, unpack):
+            y = paddle.gather(x, idx)  # saves the int index in ctx
+        assert len(packed) >= 1  # pack ran at record time
+        # double-grad re-derivation consumes via unpack
+        (g,) = paddle.grad(y.sum(), x, create_graph=True)
+        assert len(unpacked) >= 1
+        np.testing.assert_allclose(np.asarray(g._data), [1.0])
