@@ -351,10 +351,28 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
         qkv = qkv.reshape([b, s, 3, -1])
         d_model = qkv.shape[-1]
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        # single-head fallback when head count unknown: treat d_model as H*D
-        # with D=64 if divisible, else one head
-        dh = 64 if d_model % 64 == 0 else d_model
-        heads = d_model // dh
+        # reference weight layout is 4-D: trans_qkvw [3, num_head, dim_head,
+        # dim_embed] / else [dim_embed, 3, num_head, dim_head] — the true
+        # head split is recoverable from the weight shape
+        wshape = list(qkv_weights[i].shape)
+        if len(wshape) == 4:
+            heads, dh = (wshape[1], wshape[2]) if trans_qkvw \
+                else (wshape[2], wshape[3])
+            if heads * dh != d_model:
+                raise ValueError(
+                    f"qkv weight shape {wshape} inconsistent with qkv "
+                    f"projection width {d_model}")
+        elif d_model % 64 == 0:
+            # genuinely 2-D weights carry no head info; the common default
+            dh = 64
+            heads = d_model // dh
+        else:
+            raise ValueError(
+                "fused_multi_transformer cannot derive the head split from "
+                f"2-D qkv weights of shape {wshape} (width {d_model} not a "
+                "multiple of 64); pass 4-D weights ([3, num_head, dim_head, "
+                "dim_embed] when trans_qkvw else [dim_embed, 3, num_head, "
+                "dim_head])")
         q = q.reshape([b, s, heads, dh])
         k = k.reshape([b, s, heads, dh])
         v = v.reshape([b, s, heads, dh])

@@ -1116,5 +1116,5 @@ from .extended import (  # noqa: F401,E402
     triplet_margin_with_distance_loss, hsigmoid_loss,
     adaptive_log_softmax_with_loss, margin_cross_entropy, rnnt_loss,
     gather_tree, flash_attn_qkvpacked, flash_attn_varlen_qkvpacked,
-    flashmask_attention, sparse_attention,
+    flash_attn_unpadded, flashmask_attention, sparse_attention,
 )

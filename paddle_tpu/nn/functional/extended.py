@@ -873,31 +873,96 @@ def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
     return out, None
 
 
+def _host_cu(x):
+    """cu_seqlens as host ints (concrete — these APIs run outside jit)."""
+    arr = np.asarray(x._data if hasattr(x, "_data") else x)
+    return arr.astype(np.int64)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen ("unpadded") flash attention ≙ reference flash_attn_unpadded
+    (/root/reference/python/paddle/nn/functional/flash_attention.py:815):
+    q/k/v in packed [total_tokens, H, D] layout with cu_seqlens boundaries.
+
+    TPU-native lowering (XLA needs static shapes): ONE gather scatters the
+    packed tokens into a [B, S_bucket, *] padded batch, attention runs
+    batched with a per-sequence validity mask, and ONE gather packs the
+    result back. S_bucket rounds max_seqlen up to a bucket
+    (jit.default_buckets), so streams of varying lengths reuse O(log S)
+    compiled programs — the same recompile-control the reference gets from
+    its varlen CUDA kernel's dynamic shapes."""
+    from ...jit.api import default_buckets
+
+    if return_softmax:
+        raise NotImplementedError(
+            "flash_attn_unpadded(return_softmax=True): ragged per-segment "
+            "weights; run flash_attn_qkvpacked on a padded batch to inspect "
+            "attention weights")
+    cu_q = _host_cu(cu_seqlens_q)
+    cu_k = _host_cu(cu_seqlens_k)
+    b = len(cu_q) - 1
+    total_q = int(cu_q[-1])
+    sq = default_buckets(int(max_seqlen_q))
+    sk = default_buckets(int(max_seqlen_k))
+    # scatter indices [B, S]: row b position i <- packed index cu[b]+i
+    iq = np.minimum(cu_q[:-1, None] + np.arange(sq)[None, :],
+                    total_q - 1).astype(np.int32)
+    ik = np.minimum(cu_k[:-1, None] + np.arange(sk)[None, :],
+                    int(cu_k[-1]) - 1).astype(np.int32)
+    lens_q = (cu_q[1:] - cu_q[:-1]).astype(np.int32)
+    lens_k = (cu_k[1:] - cu_k[:-1]).astype(np.int32)
+    # gather-back map: packed token t lives at (seq_id[t], pos[t])
+    tpos = np.arange(total_q)
+    seq_id = (np.searchsorted(cu_q, tpos, side="right") - 1).astype(np.int32)
+    pos = (tpos - cu_q[seq_id]).astype(np.int32)
+    sc = float(scale) if scale is not None else None
+    drop = dropout if training else 0.0
+
+    def f(qv, kv, vv, iq_, ik_, lq, lk, sid, pos_):
+        from .attention import _xla_sdpa
+        from ...core.rng import next_key as _nk
+
+        qp = qv[iq_]                      # [B, Sq, H, D]
+        kp = kv[ik_]
+        vp = vv[ik_]
+        if sc is not None:
+            d = qv.shape[-1]
+            qp = qp * jnp.asarray(sc * math.sqrt(d), qp.dtype)
+        kmask = (jnp.arange(sk)[None, :] < lk[:, None])   # [B, Sk]
+        mask = kmask[:, None, None, :]                     # [B, 1, 1, Sk]
+        if causal:
+            tri = jnp.tril(jnp.ones((sq, sk), bool), k=0)
+            mask = mask & tri[None, None, :, :]
+        out = _xla_sdpa(qp, kp, vp, mask, drop, False,
+                        None if drop == 0.0 else _nk())
+        return out[sid, pos_]             # back to packed [total, H, D]
+
+    out = op_call(f, query, key, value, iq, ik, lens_q, lens_k, seq_id, pos,
+                  name="flash_attn_unpadded", n_diff=3)
+    return out, None
+
+
 def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
                                 max_seqlen_k, scale=None, dropout=0.0,
                                 causal=False, return_softmax=False,
                                 training=True, name=None):
     """Varlen packed flash attention: total-token layout [total, 3, H, D]
-    with cu_seqlens boundaries. Lowered to a padded batch + mask (XLA needs
-    static shapes; padding to max_seqlen is the TPU-native strategy)."""
-    from . import scaled_dot_product_attention
-
-    cu = np.asarray(cu_seqlens_q._data if hasattr(cu_seqlens_q, "_data")
-                    else cu_seqlens_q)
-    lens = (cu[1:] - cu[:-1]).tolist()
-    b = len(lens)
-    s = int(max_seqlen_q)
-    outs = []
-    for i in range(b):
-        seg = qkv[int(cu[i]):int(cu[i + 1])]
-        q, k, v = seg[:, 0], seg[:, 1], seg[:, 2]
-        o = scaled_dot_product_attention(
-            q.unsqueeze(0), k.unsqueeze(0), v.unsqueeze(0), None, dropout,
-            causal, training)
-        outs.append(o.squeeze(0))
-    from ...ops.manipulation import concat
-
-    return concat(outs, axis=0), None
+    with cu_seqlens boundaries; routed through flash_attn_unpadded's
+    batched scatter→mask→gather lowering."""
+    if return_softmax:
+        raise NotImplementedError(
+            "flash_attn_varlen_qkvpacked(return_softmax=True): per-segment "
+            "softmax weights are ragged; use flash_attn_qkvpacked on padded "
+            "batches to inspect attention weights")
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale, dropout,
+                               causal, return_softmax, training=training,
+                               name=name)
 
 
 def flashmask_attention(query, key, value, startend_row_indices=None,

@@ -345,6 +345,27 @@ class Adam(Optimizer):
         self._epsilon = epsilon
         self._amsgrad = amsgrad
         self._decoupled_wd = False
+        self._use_multi_tensor = use_multi_tensor
+
+    def step(self):
+        """use_multi_tensor=True (eager): ONE jitted fused update over the
+        whole param pytree with donated buffers (≙ phi fused_adam_kernel.h)
+        instead of a python loop of per-param updates."""
+        if not getattr(self, "_use_multi_tensor", False):
+            return super().step()
+        from .fused import fused_adam_step
+
+        with no_grad():
+            pgs = self._collect_params_grads()
+            self._step_count += 1
+            self._step_t._assign_raw(self._step_t._data + 1.0)
+            lr_data = self._lr_value()
+            if fused_adam_step(self, pgs, lr_data):
+                return
+            # unsupported case: roll the counter back, take the base path
+            self._step_count -= 1
+            self._step_t._assign_raw(self._step_t._data - 1.0)
+        return super().step()
 
     def _apply_one(self, p, g, lr_val, wd):
         m = self._acc("moment1", p)
@@ -390,10 +411,11 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=0.01, lr_ratio=None,
                  apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
-                 multi_precision=False, amsgrad=False, name=None):
+                 multi_precision=False, amsgrad=False, use_multi_tensor=False,
+                 name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, lazy_mode, multi_precision,
-                         amsgrad=amsgrad)
+                         use_multi_tensor=use_multi_tensor, amsgrad=amsgrad)
         self._decoupled_wd = True
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio

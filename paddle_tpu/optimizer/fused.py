@@ -1,0 +1,161 @@
+"""Fused multi-tensor Adam/AdamW update.
+
+Reference parity: paddle's fused adam paths — the multi-tensor CUDA kernel
+(`/root/reference/paddle/phi/kernels/fused_adam_kernel.h`, one kernel launch
+updating many params) and the python chunking helper
+(`/root/reference/python/paddle/optimizer/fusion_utils.py`). TPU-native
+design: ONE jitted XLA program takes the whole (params, grads, moments)
+pytree, applies optional global-norm clipping and the Adam/AdamW update to
+every leaf, and returns the new state with input buffers DONATED — eager
+mode pays a single dispatch per step instead of ~4·P small ones, and the
+params/moments update in place in HBM like the reference's in-place kernels.
+
+Engaged by `Adam/AdamW(..., use_multi_tensor=True)` in eager mode; under
+`to_static` tracing the per-param path is kept (the whole step compiles into
+the train-step program anyway, where XLA does the same fusion).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..nn.clip import ClipGradByGlobalNorm
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _build_executor(n, b1, b2, eps, decoupled, amsgrad, clip_norm, has_master):
+    """Compile-once fused update. Positional buffer lists are donated:
+    bases (fp32 master or param), low-precision params (master mode),
+    moment1, moment2, [moment2_max]."""
+
+    def update(bases, lo_params, ms, vs, vmaxs, grads, wds, lrfs, step_t, lr):
+        if clip_norm is not None:
+            sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+            gnorm = jnp.sqrt(sq)
+            scale = jnp.minimum(clip_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+            grads = [(g * scale.astype(jnp.float32)).astype(g.dtype)
+                     for g in grads]
+        new_bases, new_lo, new_ms, new_vs, new_vmaxs = [], [], [], [], []
+        t = step_t
+        low = (jnp.float16, jnp.bfloat16)
+        for i in range(n):
+            base = bases[i]
+            # match the per-param path: low-precision params without a
+            # master still compute (and keep moments) in fp32
+            comp_dt = jnp.float32 if base.dtype in low else base.dtype
+            bc = base.astype(comp_dt)
+            gd = grads[i].astype(comp_dt)
+            lr_i = lr * lrfs[i]
+            if not decoupled:
+                gd = gd + wds[i] * bc
+            new_m = b1 * ms[i].astype(comp_dt) + (1 - b1) * gd
+            new_v = b2 * vs[i].astype(comp_dt) + (1 - b2) * jnp.square(gd)
+            mhat = new_m / (1 - b1 ** t)
+            if amsgrad:
+                new_vmax = jnp.maximum(vmaxs[i].astype(comp_dt), new_v)
+                vhat = new_vmax / (1 - b2 ** t)
+                new_vmaxs.append(new_vmax)
+            else:
+                vhat = new_v / (1 - b2 ** t)
+            step = lr_i * mhat / (jnp.sqrt(vhat) + eps)
+            newb = bc
+            if decoupled:
+                newb = newb * (1.0 - lr_i * wds[i])
+            newb = newb - step
+            new_bases.append(newb.astype(base.dtype))
+            if has_master:
+                new_lo.append(newb.astype(lo_params[i].dtype))
+            new_ms.append(new_m)
+            new_vs.append(new_v)
+        return new_bases, new_lo, new_ms, new_vs, new_vmaxs
+
+    return jax.jit(update, donate_argnums=(0, 1, 2, 3, 4))
+
+
+def fused_adam_step(opt, pgs, lr_data) -> bool:
+    """One fused update over every (param, grad) pair. Returns False when
+    this step can't take the fused path (tracing, exotic clip, L1 decay,
+    per-param hooks) — caller falls back to the per-param loop."""
+    from . import _wd_coeff  # late: circular import
+
+    clip = opt._grad_clip
+    clip_norm = None
+    if clip is not None:
+        if isinstance(clip, ClipGradByGlobalNorm):
+            clip_norm = float(clip.clip_norm)
+        else:
+            return False
+
+    params, grads, groups = [], [], []
+    for p, g, grp in pgs:
+        if g is None:
+            continue
+        params.append(p)
+        grads.append(g)
+        groups.append(grp)
+    if not params:
+        return True
+    if any(_is_tracer(p._data) or _is_tracer(g._data)
+           for p, g in zip(params, grads)):
+        return False
+
+    wds, lrfs = [], []
+    for p, grp in zip(params, groups):
+        wd = grp.get("weight_decay", opt._weight_decay)
+        if getattr(wd, "_kind", "l2") == "l1":
+            return False  # L1 penalty: keep the per-param path
+        c = _wd_coeff(wd)
+        decay_fun = getattr(opt, "_apply_decay_param_fun", None)
+        if decay_fun is not None and not decay_fun(p.name):
+            c = 0.0
+        lf = grp.get("learning_rate", 1.0)
+        lr_ratio = getattr(opt, "_lr_ratio", None)
+        if lr_ratio is not None:
+            lf = lf * lr_ratio(p)
+        wds.append(float(c))
+        lrfs.append(float(lf))
+
+    # materialize accumulators/masters (first step) BEFORE keying
+    masters = [opt._master(p) for p in params]
+    has_master = any(m is not None for m in masters)
+    if has_master and not all(m is not None for m in masters):
+        return False  # mixed master/non-master set: rare; per-param path
+    ms = [opt._acc("moment1", p) for p in params]
+    vs = [opt._acc("moment2", p) for p in params]
+    vmaxs = [opt._acc("moment2_max", p) for p in params] if opt._amsgrad else []
+
+    key = (tuple((tuple(p.shape), p.dtype.name) for p in params),
+           tuple(wds), tuple(lrfs),
+           opt._beta1, opt._beta2, opt._epsilon, opt._decoupled_wd,
+           opt._amsgrad, clip_norm, has_master)
+    cached = getattr(opt, "_fused_exec", None)
+    if cached is None or cached[0] != key:
+        exe = _build_executor(len(params), opt._beta1, opt._beta2,
+                              opt._epsilon, opt._decoupled_wd, opt._amsgrad,
+                              clip_norm, has_master)
+        opt._fused_exec = cached = (key, exe)
+    exe = cached[1]
+
+    bases = [(m._data if m is not None else p._data)
+             for p, m in zip(params, masters)]
+    lo = [p._data for p in params] if has_master else []
+    new_bases, new_lo, new_ms, new_vs, new_vmaxs = exe(
+        bases, lo, [m._data for m in ms], [v._data for v in vs],
+        [vm._data for vm in vmaxs], [g._data for g in grads],
+        wds, lrfs, opt._step_t._data, lr_data)
+
+    for i, p in enumerate(params):
+        if has_master:
+            masters[i]._assign_raw(new_bases[i])
+            p._assign_raw(new_lo[i])
+        else:
+            p._assign_raw(new_bases[i])
+        ms[i]._assign_raw(new_ms[i])
+        vs[i]._assign_raw(new_vs[i])
+        if opt._amsgrad:
+            vmaxs[i]._assign_raw(new_vmaxs[i])
+    return True

@@ -185,7 +185,12 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
         if center:
             out = out[..., n_fft // 2: out_len - n_fft // 2]
         if length is not None:
-            out = out[..., :length]
+            if length > out.shape[-1]:  # zero-pad the tail (torch/reference)
+                out = jnp.pad(
+                    out, [(0, 0)] * (out.ndim - 1) +
+                    [(0, length - out.shape[-1])])
+            else:
+                out = out[..., :length]
         return out
 
     return op_call(f, x, win, name="istft")

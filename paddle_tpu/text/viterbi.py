@@ -23,10 +23,10 @@ def viterbi_decode(potentials, transition_params, lengths,
     def f(emit, trans, lens):
         b, t, n = emit.shape
         if include_bos_eos_tag:
-            # reference convention: last two tag indices are BOS/EOS
-            bos, eos = n - 2, n - 1
-            start = trans[bos, :][None, :]       # BOS → tag
-            stop = trans[:, eos][None, :]        # tag → EOS
+            # reference convention (phi viterbi_decode kernel splits the
+            # transition ROWS): row n-1 = start tag, row n-2 = stop tag
+            start = trans[n - 1, :][None, :]     # BOS → tag
+            stop = trans[n - 2, :][None, :]      # tag → EOS
         else:
             start = jnp.zeros((1, n), emit.dtype)
             stop = jnp.zeros((1, n), emit.dtype)

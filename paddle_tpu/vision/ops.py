@@ -442,10 +442,39 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
             losses = losses + jnp.where(use, (lbox + lcls) * wgt, 0.0)
             obj_tgt = obj_tgt.at[bidx, local_a, jj, ii].max(
                 jnp.where(use, 1.0, 0.0))
-        # objectness: positives → 1; others → 0 (ignore_thresh handled as
-        # hard 0 targets — the IoU-ignore refinement needs per-cell best IoU)
+        # objectness: positives → 1; negatives → 0 EXCEPT cells whose decoded
+        # box overlaps some gt with IoU > ignore_thresh — those contribute no
+        # objectness loss (reference phi yolo_loss ignore mask)
+        gridx = jnp.arange(w, dtype=p.dtype)
+        gridy = jnp.arange(h, dtype=p.dtype)
+        aw_m = jnp.asarray([an_all[i, 0] for i in an_idx], p.dtype)
+        ah_m = jnp.asarray([an_all[i, 1] for i in an_idx], p.dtype)
+        px = (sig(p[:, :, 0]) + gridx[None, None, None, :]) / w
+        py = (sig(p[:, :, 1]) + gridy[None, None, :, None]) / h
+        pw = jnp.exp(p[:, :, 2]) * aw_m[None, :, None, None] / \
+            (w * downsample_ratio)
+        ph = jnp.exp(p[:, :, 3]) * ah_m[None, :, None, None] / \
+            (h * downsample_ratio)
+        px1, py1 = px - pw / 2, py - ph / 2
+        px2, py2 = px + pw / 2, py + ph / 2
+        best_iou = jnp.zeros_like(px)
+        for b_i in range(nb):  # best IoU of each cell vs every valid gt
+            gx1 = (gx[:, b_i] - gw[:, b_i] / 2)[:, None, None, None]
+            gy1 = (gy[:, b_i] - gh[:, b_i] / 2)[:, None, None, None]
+            gx2 = (gx[:, b_i] + gw[:, b_i] / 2)[:, None, None, None]
+            gy2 = (gy[:, b_i] + gh[:, b_i] / 2)[:, None, None, None]
+            iw = jnp.maximum(jnp.minimum(px2, gx2) - jnp.maximum(px1, gx1), 0)
+            ih = jnp.maximum(jnp.minimum(py2, gy2) - jnp.maximum(py1, gy1), 0)
+            inter_c = iw * ih
+            uni = pw * ph + (gw[:, b_i] * gh[:, b_i])[:, None, None, None] \
+                - inter_c
+            iou = jnp.where(valid[:, b_i][:, None, None, None],
+                            inter_c / jnp.maximum(uni, 1e-9), 0.0)
+            best_iou = jnp.maximum(best_iou, iou)
+        ignore = (best_iou > ignore_thresh) & (obj_tgt < 0.5)
         lobj = jnp.maximum(p[:, :, 4], 0) - p[:, :, 4] * obj_tgt + \
             jnp.log1p(jnp.exp(-jnp.abs(p[:, :, 4])))
+        lobj = jnp.where(ignore, 0.0, lobj)
         # per-image loss vector [N] like the reference yolo_loss output
         losses = losses + jnp.sum(lobj, axis=(1, 2, 3))
         return losses

@@ -378,12 +378,13 @@ class TestIncubateFusedOps:
 
         rs = np.random.RandomState(5)
         hidden, layers = 16, 2
+        nh, dh = 2, 8  # head split comes from the 4-D qkv weight layout
         mk = lambda *s: paddle.to_tensor(rs.randn(*s).astype("float32") * 0.1)
         out, _ = IF.fused_multi_transformer(
             mk(1, 4, hidden),
             [mk(hidden) for _ in range(layers)],
             [mk(hidden) for _ in range(layers)],
-            [mk(hidden, 3 * hidden).T for _ in range(layers)],
+            [mk(3, nh, dh, hidden) for _ in range(layers)],
             [mk(3 * hidden) for _ in range(layers)],
             [mk(hidden, hidden) for _ in range(layers)],
             [mk(hidden) for _ in range(layers)],

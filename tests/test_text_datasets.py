@@ -92,7 +92,8 @@ class TestViterbi:
 
     def test_bos_eos_rows(self):
         rs = np.random.RandomState(1)
-        B, T, N = 1, 3, 5  # tags 0..2 real, 3=BOS, 4=EOS
+        B, T, N = 1, 3, 5  # tags 0..2 real; reference phi kernel: row N-1
+        # of the transition matrix = start tag, row N-2 = stop tag
         emit = rs.randn(B, T, N).astype("float32")
         emit[:, :, 3:] = -1e4  # BOS/EOS unused as emissions
         trans = rs.randn(N, N).astype("float32")
@@ -100,8 +101,8 @@ class TestViterbi:
         scores, paths = paddle.text.viterbi_decode(
             paddle.to_tensor(emit), paddle.to_tensor(trans),
             paddle.to_tensor(lens), include_bos_eos_tag=True)
-        want_s, want_p = self._brute(emit[0], trans, T, start=trans[3, :],
-                                     stop=trans[:, 4])
+        want_s, want_p = self._brute(emit[0], trans, T, start=trans[N - 1, :],
+                                     stop=trans[N - 2, :])
         np.testing.assert_allclose(float(np.asarray(scores._data)[0]), want_s,
                                    rtol=1e-4)
         assert np.asarray(paths._data)[0].tolist() == want_p

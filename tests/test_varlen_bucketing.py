@@ -1,0 +1,174 @@
+"""Varlen / dynamic-shape policy tests (SURVEY §7 hard-part (3); VERDICT r2
+item 5): flash_attn_unpadded parity + to_static bucket_axes recompile control.
+
+Reference analog: varlen flash attention
+(/root/reference/python/paddle/nn/functional/flash_attention.py:815) and the
+SOT dynamic-shape guards; here varying lengths pad up to buckets so XLA
+compiles O(log L) specializations.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _dense_ref(q, k, v, causal, scale):
+    """Per-sequence dense attention on packed segments, numpy."""
+    d = q.shape[-1]
+    s = np.einsum("qhd,khd->hqk", q, k) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        m = np.tril(np.ones((sq, sk), bool))
+        s = np.where(m, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("hqk,khd->qhd", p, v)
+
+
+class TestFlashAttnUnpadded:
+    def _pack(self, lens, h=4, d=16, seed=0):
+        rs = np.random.RandomState(seed)
+        total = sum(lens)
+        q = rs.randn(total, h, d).astype("float32") * 0.5
+        k = rs.randn(total, h, d).astype("float32") * 0.5
+        v = rs.randn(total, h, d).astype("float32")
+        cu = np.cumsum([0] + list(lens)).astype("int32")
+        return q, k, v, cu
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_parity_vs_dense(self, causal):
+        lens = [5, 12, 1, 9]
+        q, k, v, cu = self._pack(lens)
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        out, _ = F.flash_attn_unpadded(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(cu), paddle.to_tensor(cu),
+            max(lens), max(lens), scale=scale, causal=causal)
+        got = np.asarray(out._data)
+        assert got.shape == q.shape
+        for b in range(len(lens)):
+            s, e = cu[b], cu[b + 1]
+            want = _dense_ref(q[s:e], k[s:e], v[s:e], causal, scale)
+            np.testing.assert_allclose(got[s:e], want, rtol=2e-4, atol=2e-5)
+
+    def test_custom_scale(self):
+        lens = [7, 3]
+        q, k, v, cu = self._pack(lens, seed=1)
+        out, _ = F.flash_attn_unpadded(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(cu), paddle.to_tensor(cu), 7, 7, scale=0.05)
+        got = np.asarray(out._data)
+        for b in range(2):
+            s, e = cu[b], cu[b + 1]
+            want = _dense_ref(q[s:e], k[s:e], v[s:e], False, 0.05)
+            np.testing.assert_allclose(got[s:e], want, rtol=2e-4, atol=2e-5)
+
+    def test_grad_flows(self):
+        lens = [6, 10]
+        q, k, v, cu = self._pack(lens, seed=2)
+        qt, kt, vt = (paddle.to_tensor(x) for x in (q, k, v))
+        for t in (qt, kt, vt):
+            t.stop_gradient = False
+        out, _ = F.flash_attn_unpadded(
+            qt, kt, vt, paddle.to_tensor(cu), paddle.to_tensor(cu),
+            10, 10, scale=0.25, causal=True)
+        out.sum().backward()
+        for t in (qt, kt, vt):
+            g = np.asarray(t.grad._data)
+            assert g.shape == q.shape and np.isfinite(g).all()
+        # numeric check on one element of q
+        eps = 1e-3
+        q2 = q.copy()
+        q2[3, 1, 2] += eps
+        out2, _ = F.flash_attn_unpadded(
+            paddle.to_tensor(q2), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(cu), paddle.to_tensor(cu), 10, 10,
+            scale=0.25, causal=True)
+        num = (float(np.asarray(out2._data).sum())
+               - float(np.asarray(out._data).sum())) / eps
+        np.testing.assert_allclose(np.asarray(qt.grad._data)[3, 1, 2], num,
+                                   rtol=5e-2, atol=1e-3)
+
+    def test_varlen_qkvpacked_routes_through(self):
+        lens = [4, 8]
+        q, k, v, cu = self._pack(lens, seed=3)
+        qkv = np.stack([q, k, v], axis=1)  # [total, 3, H, D]
+        out, aux = F.flash_attn_varlen_qkvpacked(
+            paddle.to_tensor(qkv), paddle.to_tensor(cu), paddle.to_tensor(cu),
+            8, 8)
+        assert aux is None
+        got = np.asarray(out._data)
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        for b in range(2):
+            s, e = cu[b], cu[b + 1]
+            want = _dense_ref(q[s:e], k[s:e], v[s:e], False, scale)
+            np.testing.assert_allclose(got[s:e], want, rtol=2e-4, atol=2e-5)
+
+
+class TestBucketedToStatic:
+    def test_50_lengths_4_specializations(self):
+        """50 random lengths must compile ≤4 specializations with eager
+        parity (VERDICT r2 item 5 'done' criterion)."""
+        from paddle_tpu.jit.api import BucketAxis
+
+        paddle.seed(0)
+        emb = paddle.nn.Embedding(64, 32)
+        head = paddle.nn.Linear(32, 64)
+
+        def loss_fn(ids, labels):
+            h = head(emb(ids))
+            return F.cross_entropy(h.reshape([-1, 64]),
+                                   labels.reshape([-1]),
+                                   ignore_index=-100, reduction="mean")
+
+        step = paddle.jit.to_static(
+            loss_fn,
+            bucket_axes={0: BucketAxis(1, 0, buckets=[64, 128, 192, 256]),
+                         1: BucketAxis(1, -100, buckets=[64, 128, 192, 256])})
+        rs = np.random.RandomState(5)
+        for i in range(50):
+            L = int(rs.randint(5, 257))
+            ids = paddle.to_tensor(rs.randint(0, 64, (2, L)).astype("int64"))
+            lab = paddle.to_tensor(rs.randint(0, 64, (2, L)).astype("int64"))
+            got = float(step(ids, lab))
+            want = float(loss_fn(ids, lab))
+            np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+        assert len(step._state) <= 4, list(step._state)
+
+    def test_default_buckets_shape(self):
+        from paddle_tpu.jit.api import default_buckets
+
+        assert default_buckets(1) == 1
+        assert default_buckets(5) == 8
+        assert default_buckets(512) == 512
+        assert default_buckets(513) == 1024
+        assert default_buckets(1500) == 1536
+
+    def test_tail_batch_bucketing_axis0(self):
+        """DataLoader tail batches (axis 0) round up too — padding rows with
+        an ignored label keeps the mean loss over real rows unaffected
+        only when reduction handles it; here we check recompile count."""
+        paddle.seed(0)
+        lin = paddle.nn.Linear(8, 3)
+
+        def fwd(x):
+            return lin(x).sum(axis=-1)
+
+        step = paddle.jit.to_static(fwd, bucket_axes={0: (0, 0.0)})
+        rs = np.random.RandomState(0)
+        for bs in [17, 9, 30, 3, 25, 14]:
+            x = paddle.to_tensor(rs.randn(bs, 8).astype("float32"))
+            out = step(x)
+            assert out.shape[0] >= bs  # padded rows returned; caller slices
+        assert len(step._state) <= 3, list(step._state)
+
+
+class TestBucketErrors:
+    def test_kwarg_bucket_arg_raises(self):
+        def f(x):
+            return x * 2
+
+        step = paddle.jit.to_static(f, bucket_axes={0: 1})
+        with pytest.raises(ValueError, match="positionally"):
+            step(x=paddle.to_tensor(np.ones((2, 3), "float32")))
