@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import weakref
 from typing import Any, Callable, Sequence
 
 import jax
@@ -27,6 +28,8 @@ import numpy as np
 
 from . import dtype as dtypes
 from .flags import flag
+from .lazy import LazyData as _LazyData
+from .lazy import current_lazy as _current_lazy
 
 _tls = threading.local()
 
@@ -80,14 +83,24 @@ class TraceContext:
     ._data (bound by the jit wrapper), so ops Just Work.
     """
 
-    def __init__(self, phase: str):
+    def __init__(self, phase: str, borrowed: bool = False):
         self.phase = phase
         self.captures: dict[int, Any] = {}  # id(tensor) -> tensor (ordered)
         self.mutated: dict[int, Any] = {}
+        # borrowed=True: this trace reuses a discovery from a DIFFERENT
+        # input signature (to_static share_discovery); concrete tensor reads
+        # here mean the borrowed capture set missed a tensor — it would be
+        # silently baked in as a constant, so record for a warning
+        self.borrowed = borrowed
+        self.folded: dict[int, Any] = {}
 
     def on_read(self, tensor):
-        if self.phase == "discover" and not isinstance(tensor._data, jax.core.Tracer):
+        if isinstance(tensor._data, jax.core.Tracer):
+            return
+        if self.phase == "discover":
             self.captures.setdefault(id(tensor), tensor)
+        elif self.borrowed:
+            self.folded.setdefault(id(tensor), tensor)
 
     def on_mutate(self, tensor):
         self.mutated.setdefault(id(tensor), tensor)
@@ -485,6 +498,29 @@ def _op_call_impl(fn: Callable, *args, name: str | None = None, n_diff: int | No
                     dtypes.is_floating_point(a.dtype)
                     or dtypes.is_complex(a.dtype)):  # fft/complex ops have VJPs
                 diff_idx.append(i)
+
+    # segmented lazy staging (to_static graph-break mode): record the op
+    # into the open segment instead of executing; see core/lazy.py
+    lazy = _current_lazy()
+    if lazy is not None:
+        staged = lazy.stage(fn, _fn_key(orig_fn), name, datas, diff_idx,
+                            target)
+        if staged is not None:
+            out_lazy, vjp_box, avals, single = staged
+            node = None
+            if vjp_box is not None:
+                node = GradNode(
+                    vjp_box, [args[i] for i in diff_idx],
+                    [(tuple(a.shape), a.dtype) for a in avals], single, name,
+                    diff_idx=list(diff_idx),
+                    ctx=_make_ctx(fn, datas, diff_idx))
+            out = out_lazy[0] if single else tuple(out_lazy)
+            wrapped = _wrap_outputs(out, node, name)
+            for t in ([wrapped] if single else list(wrapped)):
+                lazy.created.append(weakref.ref(t))
+            return wrapped
+        # un-stageable op: materialize lazy inputs, fall through to eager
+        datas = [d.get() if isinstance(d, _LazyData) else d for d in datas]
 
     use_cache = flag("FLAGS_use_compiled_eager")
 

@@ -64,6 +64,9 @@ define_flag("FLAGS_check_nan_inf", False, "scan every op output for nan/inf")
 define_flag("FLAGS_use_compiled_eager", True, "jit-compile per-op eager dispatch")
 define_flag("FLAGS_eager_cache_size", 4096, "per-op executable cache entries")
 define_flag("FLAGS_to_static_donate", True, "donate captured buffers in to_static")
+define_flag("FLAGS_to_static_segmented", True,
+            "on graph break, run segmented lazy execution (compiled XLA "
+            "segments bridged eagerly) instead of whole-function eager")
 define_flag("FLAGS_enable_double_grad", True,
             "record per-node re-derivation ctx for grad(create_graph=True); "
             "disable to shed the extra operand retention")

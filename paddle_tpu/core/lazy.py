@@ -1,0 +1,398 @@
+"""Segmented lazy execution — graph breaks without giving up compilation.
+
+Reference parity: the SOT bytecode JIT executes the *compilable prefix* of a
+function as a graph and resumes Python past a break
+(/root/reference/python/paddle/jit/sot/opcode_translator/executor/
+opcode_executor.py:320,1865). A bytecode simulator is the CUDA-era answer;
+the TPU-native answer is LazyTensor-style staging:
+
+  * ops funnel through `op_call` as usual, but under an active LazyContext
+    they are RECORDED, not executed — outputs are Tensors holding `LazyData`
+    placeholders (shape/dtype known via jax.eval_shape, no device work);
+  * the moment Python needs a concrete value (float(loss), .numpy(), bool,
+    any raw-jnp use of a staged buffer) the pending segment FLUSHES: the
+    recorded ops replay inside ONE jitted XLA program, every placeholder is
+    filled, and Python simply continues — a graph break costs one segment
+    boundary, not compilation;
+  * per-op vjp closures come out of the same compiled segment (jax.vjp
+    Partials are returnable pytrees), so autograd sees ordinary GradNodes.
+
+Python re-runs every call (side effects preserved — print/log still fire);
+device work runs as large compiled segments. Segment executables are cached
+by op-sequence signature (op keys + exact dataflow wiring), so steady-state
+calls execute compiled code only.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any
+
+import jax
+import numpy as np
+
+_tls = threading.local()
+
+
+def current_lazy():
+    return getattr(_tls, "lazy_ctx", None)
+
+
+@contextlib.contextmanager
+def lazy_context(ctx):
+    old = current_lazy()
+    _tls.lazy_ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _tls.lazy_ctx = old
+
+
+class LazyData:
+    """Placeholder for a staged op output. Knows its shape/dtype; any other
+    access materializes (flushes the owning segment) and delegates."""
+
+    __slots__ = ("seg", "src", "aval", "real", "__weakref__")
+
+    def __init__(self, seg, src, aval):
+        self.seg = seg
+        self.src = src          # (op_index, out_index) within the segment
+        self.aval = aval
+        self.real = None
+
+    # -- cheap metadata (no flush)
+    @property
+    def shape(self):
+        return self.aval.shape if self.real is None else self.real.shape
+
+    @property
+    def dtype(self):
+        return self.aval.dtype if self.real is None else self.real.dtype
+
+    @property
+    def ndim(self):
+        return len(self.aval.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self.aval.shape)) if self.aval.shape else 1
+
+    # -- materialization
+    def get(self):
+        if self.real is None:
+            self.seg.flush()
+            if self.real is None:
+                raise RuntimeError(
+                    "lazy segment flush failed earlier (see the original "
+                    "exception); this staged value was lost — re-run the "
+                    "computation")
+        return self.real
+
+    def astype(self, dt):
+        return self.get().astype(dt)
+
+    def __jax_array__(self):
+        return self.get()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self.get())
+        return a.astype(dtype) if dtype is not None else a
+
+    def __getattr__(self, name):  # only fires for attrs not defined above
+        return getattr(self.get(), name)
+
+    def __repr__(self):
+        state = "pending" if self.real is None else "flushed"
+        return f"LazyData({tuple(self.aval.shape)}, {self.aval.dtype}, {state})"
+
+
+def _fwd_dunder(name):
+    def f(self, *a, **k):
+        return getattr(self.get(), name)(*a, **k)
+
+    f.__name__ = name
+    return f
+
+
+for _n in ("__add__", "__radd__", "__sub__", "__rsub__", "__mul__",
+           "__rmul__", "__truediv__", "__rtruediv__", "__floordiv__",
+           "__rfloordiv__", "__mod__", "__rmod__", "__pow__", "__rpow__",
+           "__matmul__", "__rmatmul__", "__neg__", "__pos__", "__abs__",
+           "__getitem__", "__len__", "__iter__", "__float__", "__int__",
+           "__bool__", "__index__", "__eq__", "__ne__", "__lt__", "__le__",
+           "__gt__", "__ge__", "__and__", "__or__", "__xor__", "__invert__"):
+    setattr(LazyData, _n, _fwd_dunder(_n))
+
+
+class _VjpBox:
+    """GradNode.vjp_fn for a staged op: resolves to the real vjp Partial
+    (produced inside the compiled segment) on first backward use."""
+
+    __slots__ = ("seg", "vjp")
+
+    def __init__(self, seg):
+        self.seg = seg
+        self.vjp = None
+
+    def __call__(self, cot):
+        from .dispatch import _apply_vjp
+
+        if self.vjp is None:
+            self.seg.flush()
+            if self.vjp is None:
+                raise RuntimeError(
+                    "lazy segment flush failed earlier (see the original "
+                    "exception); this op's vjp was lost — re-run the "
+                    "forward computation")
+        if isinstance(cot, (tuple, list)):
+            cot = type(cot)(c.get() if isinstance(c, LazyData) else c
+                            for c in cot)
+        elif isinstance(cot, LazyData):
+            cot = cot.get()
+        return _apply_vjp(self.vjp, cot)
+
+
+class _OpRecord:
+    __slots__ = ("fn", "bindings", "diff_dyn", "out_lazy", "single_out",
+                 "vjp_box", "key")
+
+    def __init__(self, fn, bindings, diff_dyn, out_lazy, single_out,
+                 vjp_box, key):
+        self.fn = fn                  # statics folded; takes dynamic args
+        self.bindings = bindings      # ("L", (op_i, out_i)) | ("E", ext_i)
+        self.diff_dyn = diff_dyn      # diff positions among DYNAMIC args
+        self.out_lazy = out_lazy      # list[LazyData]
+        self.single_out = single_out
+        self.vjp_box = vjp_box
+        self.key = key
+
+
+#: segment executable cache: op-sequence signature -> jitted replay
+_seg_cache: dict = {}
+_seg_hits = 0
+_seg_misses = 0
+
+
+def seg_cache_info():
+    return {"entries": len(_seg_cache), "hits": _seg_hits,
+            "misses": _seg_misses}
+
+
+def seg_cache_clear():
+    global _seg_hits, _seg_misses
+    _seg_cache.clear()
+    _seg_hits = _seg_misses = 0
+
+
+class Segment:
+    """One replayable run of staged ops → a single jitted XLA program."""
+
+    __slots__ = ("ops", "ext", "ext_ids", "flushed", "ctx", "__weakref__")
+
+    def __init__(self, ctx):
+        self.ops: list[_OpRecord] = []
+        self.ext: list[Any] = []           # concrete external inputs
+        self.ext_ids: dict[int, int] = {}
+        self.flushed = False
+        self.ctx = ctx
+
+    def bind_ext(self, arr) -> int:
+        i = self.ext_ids.get(id(arr))
+        if i is None:
+            i = len(self.ext)
+            self.ext.append(arr)
+            self.ext_ids[id(arr)] = i
+        return i
+
+    # ------------------------------------------------------------ flush
+    def flush(self):
+        global _seg_hits, _seg_misses
+        if self.flushed:
+            return
+        self.flushed = True  # first, so re-entrant get() can't recurse
+        if self.ctx is not None and self.ctx.open_seg is self:
+            self.ctx.open_seg = None
+        if not self.ops:
+            return
+        if self.ctx is not None:
+            self.ctx.segments_flushed += 1
+        need_vjp = tuple(rec.vjp_box is not None for rec in self.ops)
+        sig = (tuple(rec.key for rec in self.ops), need_vjp,
+               tuple((tuple(a.shape), str(a.dtype)) for a in self.ext))
+        from .flags import flag
+
+        exe = _seg_cache.get(sig)
+        if exe is None:
+            _seg_misses += 1
+            limit = max(int(flag("FLAGS_eager_cache_size")), 1)
+            while len(_seg_cache) >= limit and _seg_cache:
+                _seg_cache.pop(next(iter(_seg_cache)))
+            exe = _build_replay(
+                tuple((rec.fn, tuple(rec.bindings), tuple(rec.diff_dyn),
+                       rec.single_out) for rec in self.ops), need_vjp)
+            _seg_cache[sig] = exe
+        else:
+            _seg_hits += 1
+        try:
+            outs, vjps = exe(self.ext)
+        finally:
+            ops, self.ops = self.ops, []
+            self.ext = []
+            self.ext_ids = {}
+        oi = vi = 0
+        for rec, has_vjp in zip(ops, need_vjp):
+            for ld in rec.out_lazy:
+                ld.real = outs[oi]
+                oi += 1
+            if has_vjp:
+                rec.vjp_box.vjp = vjps[vi]
+                vi += 1
+
+
+def _build_replay(opspecs, need_vjp):
+    """Compile-once replay over the recorded op graph. Captures only plain
+    (fn, bindings, diff_dyn, single_out) tuples — NOT the _OpRecord objects,
+    whose out_lazy/vjp_box fields are later filled with device buffers (a
+    cached closure over records would pin one whole run's outputs and vjp
+    residuals in HBM for the cache lifetime). Bindings address producers by
+    (op_index, out_index), so the wiring is positional and the executable is
+    reusable for any segment with the same signature."""
+
+    def replay(ext):
+        env: dict[tuple, Any] = {}
+        outs, vjps = [], []
+        for idx, ((fn, bindings, diff_dyn, single_out), has_vjp) in \
+                enumerate(zip(opspecs, need_vjp)):
+            vals = [env[b] if tag == "L" else ext[b] for tag, b in bindings]
+            if has_vjp:
+                def primal(*dv, _vals=vals, _fn=fn, _di=diff_dyn):
+                    vs = list(_vals)
+                    for j, v in zip(_di, dv):
+                        vs[j] = v
+                    return _fn(*vs)
+
+                out, vjp = jax.vjp(primal, *[vals[i] for i in diff_dyn])
+                vjps.append(vjp)
+            else:
+                out = fn(*vals)
+            flat = [out] if single_out else list(out)
+            for oi, o in enumerate(flat):
+                env[(idx, oi)] = o
+            outs.extend(flat)
+        return outs, vjps
+
+    return jax.jit(replay)
+
+
+class LazyContext:
+    """Active across one segmented to_static call."""
+
+    __slots__ = ("open_seg", "segments_flushed", "created")
+
+    def __init__(self):
+        self.open_seg: Segment | None = None
+        self.segments_flushed = 0
+        # weakrefs of every Tensor holding staged LazyData — after the final
+        # flush the caller swaps in the concrete buffers so no LazyData
+        # leaks out of the segmented call (a leaked one would defeat the
+        # compiled-eager cache's _is_dynamic check on later eager use)
+        self.created: list = []
+
+    def seg(self) -> Segment:
+        if self.open_seg is None or self.open_seg.flushed:
+            self.open_seg = Segment(self)
+        return self.open_seg
+
+    def flush_all(self):
+        if self.open_seg is not None and not self.open_seg.flushed:
+            self.open_seg.flush()
+
+    # -------------------------------------------------------------- stage
+    def stage(self, fn, fn_key, name, datas, diff_idx, target):
+        """Try to record the op. Returns (out_lazy, vjp_box, avals, single)
+        or None — caller materializes lazy inputs and runs eagerly."""
+        from . import dtype as dtypes
+        from .dispatch import _UNCACHABLE, _freeze, _is_dynamic
+
+        if fn_key is _UNCACHABLE:
+            return None
+        # under an active jax trace (e.g. a nested to_static compiling while
+        # the outer function runs segmented) tracers must NOT be staged as
+        # segment externals — let the op execute inside the enclosing trace
+        if any(isinstance(d, jax.core.Tracer) for d in datas):
+            return None
+        seg = self.seg()
+        op_idx = len(seg.ops)
+        bindings = []          # dynamic bindings, in dynamic-arg order
+        dyn_avals = []
+        key_parts = []
+        statics = []           # (position-in-fn-args, value)
+        orig_to_dyn = {}
+        n_dyn = 0
+        for i, d in enumerate(datas):
+            if isinstance(d, LazyData):
+                if d.real is not None:
+                    d = d.real
+                elif d.seg is not seg:
+                    d.seg.flush()   # cross-segment input: close the old one
+                    d = d.real
+                else:
+                    if dtypes.is_complex(np.dtype(d.aval.dtype)):
+                        return None  # complex grads: eager bridge path
+                    bindings.append(("L", d.src))
+                    dyn_avals.append(jax.ShapeDtypeStruct(d.aval.shape,
+                                                          d.aval.dtype))
+                    key_parts.append(("L",) + d.src)
+                    orig_to_dyn[i] = n_dyn
+                    n_dyn += 1
+                    continue
+            if _is_dynamic(d):
+                if dtypes.is_complex(np.dtype(d.dtype)):
+                    return None
+                ei = seg.bind_ext(d)
+                bindings.append(("E", ei))
+                dyn_avals.append(jax.ShapeDtypeStruct(d.shape, d.dtype))
+                key_parts.append(("E", ei, tuple(d.shape), str(d.dtype)))
+                orig_to_dyn[i] = n_dyn
+                n_dyn += 1
+            else:
+                fr = _freeze(d)
+                if fr is _UNCACHABLE:
+                    return None
+                statics.append((i, d))
+                key_parts.append(("S", fr))
+
+        if any(i not in orig_to_dyn for i in diff_idx):
+            return None  # differentiating a static operand: eager path
+
+        static_map = dict(statics)
+        n_args = len(datas)
+
+        def bound_fn(*dyn_vals, _fn=fn, _smap=static_map, _n=n_args):
+            vals = []
+            it = iter(dyn_vals)
+            for i in range(_n):
+                vals.append(_smap[i] if i in _smap else next(it))
+            return _fn(*vals)
+
+        try:
+            out_aval = jax.eval_shape(bound_fn, *dyn_avals)
+        except Exception:
+            return None
+        single = not isinstance(out_aval, (tuple, list))
+        flat_avals = [out_aval] if single else list(out_aval)
+        if not all(hasattr(a, "shape") for a in flat_avals):
+            return None
+        if any(dtypes.is_complex(np.dtype(a.dtype)) for a in flat_avals):
+            return None
+
+        out_lazy = [LazyData(seg, (op_idx, oi), a)
+                    for oi, a in enumerate(flat_avals)]
+        opkey = (fn_key, name, target, tuple(key_parts), tuple(diff_idx),
+                 single, len(flat_avals))
+        vjp_box = _VjpBox(seg) if diff_idx else None
+        rec = _OpRecord(bound_fn, bindings,
+                        [orig_to_dyn[i] for i in diff_idx], out_lazy,
+                        single, vjp_box, opkey)
+        seg.ops.append(rec)
+        return out_lazy, vjp_box, flat_avals, single

@@ -142,7 +142,7 @@ class BucketAxis:
 class CompiledFunction:
     def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
                  backend=None, full_graph=False, donate_buffers=None,
-                 bucket_axes: dict | None = None):
+                 bucket_axes: dict | None = None, share_discovery=False):
         functools.update_wrapper(self, fn)
         self._fn = fn
         self._cache: dict[str, Any] = {}
@@ -152,12 +152,21 @@ class CompiledFunction:
             else donate_buffers
         self._lock = threading.RLock()
         self._full_graph = full_graph
-        self._fallback_eager = False
+        self._fallback_eager = False   # whole-function eager (segmented off)
+        self._segmented = False        # graph-break → lazy segment mode
+        self._last_segments = 0
         # arg position -> BucketAxis (or (axis[, pad]) shorthand)
         self._bucket_axes = {
             k: (v if isinstance(v, BucketAxis) else
                 BucketAxis(*((v,) if isinstance(v, int) else tuple(v))))
             for k, v in (bucket_axes or {}).items()}
+        # share_discovery: the capture set (params/opt-state/rng — free
+        # variables) is shape-independent for shape-generic functions, so a
+        # NEW input signature can skip the two eager phases and reuse the
+        # last discovery — no eager pass at large shapes (an eager fp32
+        # warm-up at full batch can exceed HBM long before the compiled,
+        # donated program does). Prime with a tiny batch, then run big.
+        self._share_discovery = share_discovery
 
     # -- paddle API parity
     @property
@@ -208,15 +217,19 @@ class CompiledFunction:
             return self._fn(*args, **kwargs)
         if self._bucket_axes:
             args = self._apply_buckets(args)
+        if self._segmented:
+            return self._run_segmented(args, kwargs)
         leaves: list[Tensor] = []
         struct = _flatten((args, kwargs), leaves)
         key = self._key(struct, leaves)
         with self._lock:
             n = self._state.get(key, 0)
             self._state[key] = n + 1
-        if n == 0:
+        shared = (self._share_discovery and key not in self._discovered
+                  and self._discovered)
+        if n == 0 and not shared:
             return self._fn(*args, **kwargs)  # warm-up: lazy state creation
-        if n == 1:
+        if n == 1 and not shared:
             return self._discover(key, args, kwargs)
         spec = self._cache.get(key)
         if spec is None:
@@ -233,6 +246,10 @@ class CompiledFunction:
 
     def _compile_and_run(self, key, struct, leaves, args, kwargs, _retry=0):
         ctx = self._discovered.get(key)
+        borrowed = False
+        if ctx is None and self._share_discovery and self._discovered:
+            ctx = next(reversed(self._discovered.values()))
+            borrowed = True
         if ctx is None:
             return self._discover(key, args, kwargs)
         captures = [t for t in ctx.captures.values()]
@@ -248,7 +265,8 @@ class CompiledFunction:
         holder = {}
 
         def pure(arg_datas, ro_datas, mut_datas):
-            tctx = TraceContext("trace")
+            tctx = TraceContext("trace", borrowed=borrowed)
+            holder["tctx"] = tctx
             saved = [(t, t._data) for t in ro_caps + mut_caps]
             for t, d in zip(ro_caps, ro_datas):
                 t._data = d
@@ -291,6 +309,19 @@ class CompiledFunction:
                 ) from e
             import warnings
 
+            if flag("FLAGS_to_static_segmented"):
+                warnings.warn(
+                    f"to_static: graph break in "
+                    f"'{getattr(self._fn, '__name__', self._fn)}' "
+                    f"({type(e).__name__}); switching to segmented lazy "
+                    "execution — ops run as compiled XLA segments bridged "
+                    "eagerly at each concretization point. Python-level side "
+                    "effects before the break ran once during capture and "
+                    "run again this call.",
+                    stacklevel=3)
+                self._segmented = True
+                a, k = _unflatten(struct, leaves)
+                return self._run_segmented(a, k)
             warnings.warn(
                 f"to_static: graph break in "
                 f"'{getattr(self._fn, '__name__', self._fn)}' "
@@ -303,11 +334,52 @@ class CompiledFunction:
             a, k = _unflatten(struct, leaves)
             return self._fn(*a, **k)
 
+        folded = getattr(holder.get("tctx"), "folded", None)
+        if folded:
+            import warnings
+
+            names = [t.name for t in list(folded.values())[:5]]
+            warnings.warn(
+                "to_static(share_discovery=True): the borrowed discovery "
+                f"did not record tensor(s) {names} read by this trace — "
+                "their CURRENT values were baked into the compiled program "
+                "as constants; later updates to them will be ignored. "
+                "Disable share_discovery for this function if these must "
+                "stay live inputs.", stacklevel=3)
         spec.executable = jitted
         spec.out_struct = holder["out_struct"]
         spec.trace_muts = holder["trace_muts"]
         self._cache[key] = spec
         return self._finish(spec, out_datas, mut_out)
+
+    def _run_segmented(self, args, kwargs):
+        """Graph-break mode: re-run the Python with ops STAGED into lazy
+        segments; each concretization point (float()/numpy()/bool/raw-jnp
+        use) flushes one compiled XLA segment and Python continues — the
+        traceable regions stay compiled, the break is bridged eagerly
+        (core/lazy.py; ≙ SOT prefix-graph + resume,
+        /root/reference/python/paddle/jit/sot/opcode_translator/executor/
+        opcode_executor.py:320)."""
+        from ..core.lazy import LazyContext, LazyData, lazy_context
+
+        ctx = LazyContext()
+        with lazy_context(ctx):
+            out = self._fn(*args, **kwargs)
+            ctx.flush_all()
+        self._last_segments = ctx.segments_flushed
+        # swap concrete buffers into EVERY tensor staging created (params
+        # mutated mid-call included) — a LazyData leaking into later eager
+        # code would defeat the compiled-eager cache's dynamic-arg check
+        for ref in ctx.created:
+            t = ref()
+            if t is not None and isinstance(t._data, LazyData):
+                t._data = t._data.get()
+        leaves: list = []
+        _flatten(out, leaves)
+        for t in leaves:
+            if isinstance(t._data, LazyData):
+                t._data = t._data.get()
+        return out
 
     def _run(self, spec, struct, leaves):
         arg_datas = [t._data for t in leaves]
@@ -324,7 +396,8 @@ class CompiledFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
-              full_graph=False, bucket_axes=None, **kwargs):
+              full_graph=False, bucket_axes=None, share_discovery=False,
+              **kwargs):
     """Decorator/wrapper compiling a dygraph callable into one XLA program.
 
     full_graph=False (default, ≙ SOT): a trace failure (data-dependent Python
@@ -346,11 +419,13 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
         if isinstance(fn, Layer):
             layer = fn
             cf = CompiledFunction(layer.forward, input_spec, build_strategy, backend,
-                                  full_graph, bucket_axes=bucket_axes)
+                                  full_graph, bucket_axes=bucket_axes,
+                                  share_discovery=share_discovery)
             layer.forward = cf
             return layer
         return CompiledFunction(fn, input_spec, build_strategy, backend, full_graph,
-                                bucket_axes=bucket_axes)
+                                bucket_axes=bucket_axes,
+                                share_discovery=share_discovery)
 
     if function is not None:
         return wrap(function)

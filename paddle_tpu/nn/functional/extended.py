@@ -904,7 +904,6 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
             "attention weights")
     cu_q = _host_cu(cu_seqlens_q)
     cu_k = _host_cu(cu_seqlens_k)
-    b = len(cu_q) - 1
     total_q = int(cu_q[-1])
     sq = default_buckets(int(max_seqlen_q))
     sk = default_buckets(int(max_seqlen_k))
@@ -913,7 +912,6 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                     total_q - 1).astype(np.int32)
     ik = np.minimum(cu_k[:-1, None] + np.arange(sk)[None, :],
                     int(cu_k[-1]) - 1).astype(np.int32)
-    lens_q = (cu_q[1:] - cu_q[:-1]).astype(np.int32)
     lens_k = (cu_k[1:] - cu_k[:-1]).astype(np.int32)
     # gather-back map: packed token t lives at (seq_id[t], pos[t])
     tpos = np.arange(total_q)
@@ -922,7 +920,7 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     sc = float(scale) if scale is not None else None
     drop = dropout if training else 0.0
 
-    def f(qv, kv, vv, iq_, ik_, lq, lk, sid, pos_):
+    def f(qv, kv, vv, iq_, ik_, lk, sid, pos_):
         from .attention import _xla_sdpa
         from ...core.rng import next_key as _nk
 
@@ -941,7 +939,7 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         None if drop == 0.0 else _nk())
         return out[sid, pos_]             # back to packed [total, H, D]
 
-    out = op_call(f, query, key, value, iq, ik, lens_q, lens_k, seq_id, pos,
+    out = op_call(f, query, key, value, iq, ik, lens_k, seq_id, pos,
                   name="flash_attn_unpadded", n_diff=3)
     return out, None
 

@@ -171,13 +171,14 @@ class TestGraphBreakFallback:
 
         return f
 
-    def test_fallback_eager_when_not_full_graph(self):
+    def test_segmented_mode_when_not_full_graph(self):
         f = paddle.jit.to_static(self._breaker(), full_graph=False)
         x = paddle.to_tensor(np.ones((3,), "float32"))
         with warnings.catch_warnings(record=True) as w:
             warnings.simplefilter("always")
             outs = _compiled_calls(f, 4, x)
-        assert f._fallback_eager, "graph break must set the eager fallback"
+        assert f._segmented, \
+            "graph break must switch to segmented lazy execution"
         assert any("graph break" in str(m.message) for m in w)
         for o in outs:
             np.testing.assert_allclose(o.numpy(), 2 * np.ones((3,)))

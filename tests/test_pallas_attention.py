@@ -207,3 +207,35 @@ def test_ring_attention_grad_matches_full():
     for a, b_ in zip(g_ring, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    atol=5e-5, rtol=5e-5)
+
+
+def test_flash_dk_dv_parity_q_longer_than_kv():
+    """Regression: empty q rows (sq > sk, causal) have lse == -1e30 which
+    cancels the mask value inside exp(s - lse); p must be explicitly zeroed
+    in the masked branch or dk/dv pick up garbage contributions."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_attention import flash_attention_raw
+
+    B, H, SQ, SK, D = 1, 2, 160, 96, 32
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, H, SQ, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, H, SK, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, H, SK, D), jnp.float32)
+
+    def dense(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        rows = jnp.arange(SQ)[:, None]
+        cols = jnp.arange(SK)[None, :]
+        m = cols <= rows + (SK - SQ)
+        p = jax.nn.softmax(jnp.where(m, s, -1e30), -1)
+        p = jnp.where(m, p, 0)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    g1 = jax.grad(lambda q, k, v: flash_attention_raw(
+        q, k, v, causal=True).sum(), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: dense(q, k, v).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)

@@ -1,0 +1,206 @@
+"""Segmented lazy execution tests (VERDICT r2 item 4): a mid-body
+concretization (float()/numpy()/bool) must split the program into MULTIPLE
+compiled XLA segments with eager-parity numerics — not de-compile the whole
+function (≙ SOT prefix-graph execution + eager resume,
+/root/reference/python/paddle/jit/sot/opcode_translator/executor/
+opcode_executor.py:320,1865).
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _drive(f, n, *args):
+    outs = []
+    for _ in range(n):
+        outs.append(f(*args))
+    return outs
+
+
+class TestSegmentedExecution:
+    def test_midbody_float_break_multiple_segments(self):
+        """The VERDICT 'done' criterion: mid-body float() still executes
+        >1 compiled XLA segment with eager parity."""
+
+        def f(x, w):
+            y = paddle.matmul(x, w)
+            y = F.relu(y)
+            s = float(y.mean())          # concretization → graph break
+            if s > -1e9:                 # data-dependent Python control flow
+                z = paddle.matmul(y, w) + s
+            else:
+                z = y
+            return (z * 2).sum()
+
+        cf = paddle.jit.to_static(f)
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(4, 8).astype("float32"))
+        w = paddle.to_tensor(rs.randn(8, 8).astype("float32"))
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            outs = _drive(cf, 5, x, w)
+        assert cf._segmented, "graph break must enter segmented mode"
+        assert any("segmented" in str(m.message) for m in rec)
+        assert cf._last_segments >= 2, (
+            f"expected >1 compiled segment, got {cf._last_segments}")
+        want = float(f(x, w))
+        for o in outs:
+            np.testing.assert_allclose(float(o), want, rtol=1e-5)
+
+    def test_segment_cache_steady_state(self):
+        from paddle_tpu.core.lazy import seg_cache_info
+
+        def f(x):
+            a = x * 2 + 1
+            _ = float(a.sum())          # break
+            return (a * a).mean()
+
+        cf = paddle.jit.to_static(f)
+        x = paddle.to_tensor(np.ones((16,), "float32"))
+        _drive(cf, 3, x)                # warm-up/discover/break
+        before = seg_cache_info()
+        _drive(cf, 4, x)
+        after = seg_cache_info()
+        assert after["hits"] >= before["hits"] + 4, (before, after)
+        assert after["entries"] == before["entries"], (before, after)
+
+    def test_training_step_with_print_break(self):
+        """One float(loss) log line in a train step must not de-compile the
+        step: training still works and matches the eager run."""
+        paddle.seed(3)
+        net_a = nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 2))
+        paddle.seed(3)
+        net_b = nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 2))
+        opt_a = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net_a.parameters())
+        opt_b = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net_b.parameters())
+        rs = np.random.RandomState(1)
+        X = rs.randn(12, 6).astype("float32")
+        Y = rs.randint(0, 2, (12,)).astype("int64")
+        logged = []
+
+        def make_step(net, opt, log):
+            def step(x, y):
+                loss = F.cross_entropy(net(x), y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                if log is not None:
+                    log.append(float(loss))   # graph break mid-step
+                return loss
+
+            return step
+
+        step_a = paddle.jit.to_static(make_step(net_a, opt_a, logged))
+        step_b = make_step(net_b, opt_b, None)  # pure eager reference
+        xa, ya = paddle.to_tensor(X), paddle.to_tensor(Y)
+        la = [float(step_a(xa, ya)) for _ in range(6)]
+        lb = [float(step_b(xa, ya)) for _ in range(6)]
+        assert step_a._segmented
+        assert len(logged) >= 4  # side effect preserved every call
+        np.testing.assert_allclose(la, lb, rtol=1e-4, atol=1e-6)
+        assert la[-1] < la[0]
+        for pa, pb in zip(net_a.parameters(), net_b.parameters()):
+            np.testing.assert_allclose(np.asarray(pa._data),
+                                       np.asarray(pb._data),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_numpy_and_bool_breaks(self):
+        def f(x):
+            y = x * 3
+            arr = y.numpy()              # numpy() break
+            z = y + float(arr.sum())
+            if bool((z > 0).all()):      # bool break
+                return z.sum()
+            return z.mean()
+
+        cf = paddle.jit.to_static(f)
+        x = paddle.to_tensor(np.ones((4,), "float32"))
+        outs = _drive(cf, 4, x)
+        want = float(f(x))
+        for o in outs:
+            np.testing.assert_allclose(float(o), want, rtol=1e-5)
+
+    def test_grad_through_segments(self):
+        """Backward works when forward was staged across a break."""
+
+        def f(x):
+            y = (x * x).sum()
+            _ = float(y)                 # break between fwd ops
+            z = y * 3 + x.mean()
+            return z
+
+        cf = paddle.jit.to_static(f)
+        xv = np.arange(4, dtype="float32")
+        for _ in range(4):
+            x = paddle.to_tensor(xv)
+            x.stop_gradient = False
+            out = cf(x)
+            out.backward()
+        want = 2 * 3 * xv + 1.0 / 4
+        np.testing.assert_allclose(np.asarray(x.grad._data), want, rtol=1e-5)
+
+    def test_full_graph_still_raises(self):
+        def f(x):
+            if float(x.sum()) > 0:
+                return x * 2
+            return x
+
+        cf = paddle.jit.to_static(f, full_graph=True)
+        x = paddle.to_tensor(np.ones((2,), "float32"))
+        cf(x)
+        cf(x)
+        with pytest.raises(RuntimeError, match="full_graph=True"):
+            cf(x)
+
+    def test_flag_off_restores_eager_fallback(self):
+        paddle.set_flags({"FLAGS_to_static_segmented": False})
+        try:
+            def f(x):
+                _ = float(x.sum())
+                return x * 2
+
+            cf = paddle.jit.to_static(f)
+            x = paddle.to_tensor(np.ones((2,), "float32"))
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                _drive(cf, 4, x)
+            assert cf._fallback_eager and not cf._segmented
+        finally:
+            paddle.set_flags({"FLAGS_to_static_segmented": True})
+
+    def test_bucketing_applies_in_segmented_mode(self):
+        """A bucketed varlen function that graph-breaks must keep its
+        recompile control: buckets apply BEFORE segment staging."""
+        from paddle_tpu.jit.api import BucketAxis
+
+        lin = nn.Linear(4, 4)
+
+        def f(x):
+            y = lin(x)
+            _ = float(y.sum())      # break → segmented mode
+            return (y * y).mean()
+
+        cf = paddle.jit.to_static(
+            f, bucket_axes={0: BucketAxis(1, 0.0, buckets=[16, 32])})
+        rs = np.random.RandomState(0)
+        for L in [5, 9, 14, 20, 31, 7, 18]:
+            x = paddle.to_tensor(rs.randn(2, L, 4).astype("float32"))
+            out = cf(x)
+            assert np.isfinite(float(out))
+        assert cf._segmented
+        from paddle_tpu.core.lazy import _seg_cache
+
+        shapes = {sig[2] for sig in _seg_cache
+                  if isinstance(sig, tuple) and len(sig) >= 3}
+        # all staged ext shapes come from the two buckets only
+        for extsig in shapes:
+            for shp, _dt in extsig:
+                if len(shp) == 3:
+                    assert shp[1] in (16, 32), shp
