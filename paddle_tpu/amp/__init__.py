@@ -82,6 +82,19 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
 amp_guard = auto_cast
 
 
+@contextlib.contextmanager
+def amp_state_guard(state: "AmpState | None"):
+    """Reinstall a captured AmpState (recompute re-runs its block in
+    backward under the ORIGINAL forward's autocast state — reference
+    recompute saves amp level/dtype in its PyLayer ctx)."""
+    old = amp_state()
+    _tls.amp = state
+    try:
+        yield
+    finally:
+        _tls.amp = old
+
+
 def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
              master_weight=None, save_dtype=None):
     """O2: cast model params to the AMP dtype (paddle amp.decorate)."""

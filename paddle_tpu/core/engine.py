@@ -88,12 +88,35 @@ def flush_deferred(deferred: list) -> int:
                 if out is not None:
                     g = out._data if isinstance(out, Tensor) else out
             if not t.stop_gradient:
-                t._grad = Tensor(
-                    _accum(t._grad._data if t._grad is not None else None, g),
-                    _internal=True)
+                _write_grad_raw(t, g)
             n += 1
     deferred.clear()
     return n
+
+
+def _write_grad_raw(t, g_raw):
+    """Accumulate a raw array into t.grad IN PLACE (buffer swap on the
+    existing grad Tensor) so an active to_static trace records the write as
+    a program output — a step that ends with live grads (gradient merge's
+    accumulate program) must emit them, not leak tracers."""
+    if t._grad is None:
+        t._grad = Tensor(_accum(None, g_raw), _internal=True)
+        from .dispatch import current_trace
+
+        tr = current_trace()
+        if tr is not None:
+            tr.on_mutate(t._grad)
+    else:
+        t._grad._assign_raw(_accum(t._grad._data, g_raw))
+
+
+def _write_grad(t, g, accum_tensor, create_graph=False):
+    """Tensor-level variant: create_graph keeps the Tensor-add path (the
+    accumulation itself must be on the tape)."""
+    if create_graph:
+        t._grad = accum_tensor(t._grad, g)
+    else:
+        _write_grad_raw(t, g._data if isinstance(g, Tensor) else g)
 
 
 def _regrad(node, cots):
@@ -172,9 +195,7 @@ def run_backward(root: Tensor, grad_tensor=None, retain_graph: bool = False,
 
     if root._node is None:
         if restrict_to is None or id(root) in restrict_to:
-            root._grad = Tensor(
-                _accum(root._grad._data if root._grad is not None else None, seed),
-                _internal=True)
+            _write_grad_raw(root, seed)
         return
 
     # -- collect reachable graph + consumer counts
@@ -286,10 +307,10 @@ def run_backward(root: Tensor, grad_tensor=None, retain_graph: bool = False,
                 allowed = restrict_to is None or id(t) in restrict_to
                 if pn is None:
                     if not t.stop_gradient and allowed:
-                        t._grad = accum_tensor(t._grad, g)
+                        _write_grad(t, g, accum_tensor, create_graph)
                 else:
                     if t._retain_grads and allowed:
-                        t._grad = accum_tensor(t._grad, g)
+                        _write_grad(t, g, accum_tensor, create_graph)
                     if id(pn) in pending:
                         pending[id(pn)][t._out_idx] = accum_tensor(
                             pending[id(pn)][t._out_idx], g)

@@ -169,7 +169,12 @@ class Tensor:
 
     def clear_gradient(self, set_to_zero=False):
         if set_to_zero and self._grad is not None:
-            self._grad = Tensor(jnp.zeros_like(self._grad._data), _internal=True)
+            # IN-PLACE zero (buffer swap on the existing grad Tensor): under
+            # to_static the write registers as a program output, so compiled
+            # programs actually reset the accumulation buffer (gradient
+            # merge's apply program depends on this; `= None` is a python-
+            # level effect no compiled program can replay)
+            self._grad._assign_raw(jnp.zeros_like(self._grad._data))
         else:
             self._grad = None
 

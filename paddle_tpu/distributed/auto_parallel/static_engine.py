@@ -30,6 +30,29 @@ class Engine:
         self._steps: dict[str, object] = {}  # mode -> CompiledFunction
         self._n_inputs: int | None = None    # from inputs_spec (prepare)
         self._prepared = False
+        # pass-stack state (distributed/passes; set by the passes)
+        self._amp_ctx: dict | None = None
+        self._grad_scaler = None
+        self._grad_merge_k: int = 1
+        self._grad_merge_avg: bool = True
+        self._gm_counter = 0
+        self._passes_applied = False
+
+    def _apply_passes(self):
+        """Run the strategy's enabled passes over this engine (≙ the
+        reference parallelizer applying distributed/passes to the program,
+        auto_parallel/static/parallelizer_v2.py)."""
+        if self._passes_applied or self.strategy is None:
+            return
+        self._passes_applied = True
+        passes = getattr(self.strategy, "passes", None)
+        if passes is None:
+            return
+        from ..passes import PassContext
+
+        self.pass_context = PassContext()
+        for p in passes():
+            p.apply(self, self.pass_context)
 
     def _split(self, batch):
         """(inputs, labels) from one batch: inputs_spec wins; with no loss
@@ -45,30 +68,76 @@ class Engine:
     def prepare(self, inputs_spec=None, labels_spec=None, main_program=None,
                 startup_program=None, mode: str = "train"):
         """Build the compiled step for `mode` (lazy per-mode cache)."""
+        import contextlib
+
         import paddle_tpu as paddle
 
         if mode == "train" and self.optimizer is None:
             raise ValueError("Engine.prepare(mode='train') needs an optimizer")
         if inputs_spec is not None:
             self._n_inputs = len(_to_list(inputs_spec))
+        self._apply_passes()
+
+        def amp_ctx():
+            if self._amp_ctx is None:
+                return contextlib.nullcontext()
+            return paddle.amp.auto_cast(**self._amp_ctx)
 
         if mode == "train":
-            def step(*batch):
+            k = self._grad_merge_k
+            scaler = self._grad_scaler
+
+            def fwd_loss(batch):
                 ins, labels = self._split(batch)
-                out = self.model(*ins)
-                loss = self.loss(out, *labels) if self.loss else out
+                with amp_ctx():
+                    out = self.model(*ins)
+                    loss = self.loss(out, *labels) if self.loss else out
                 if loss.ndim > 0:
                     loss = loss.mean()
-                loss.backward()
-                self.optimizer.step()
-                self.optimizer.clear_grad()
                 return loss
+
+            def opt_apply():
+                if scaler is not None:
+                    scaler.step(self.optimizer)
+                    scaler.update()
+                else:
+                    self.optimizer.step()
+                # gradient merge: zero IN PLACE so the compiled apply
+                # program resets the accumulation buffers (None is a
+                # python-level effect outside the graph)
+                self.optimizer.clear_grad(set_to_zero=(k > 1))
+
+            if k > 1:
+                # gradient merge: TWO compiled programs (accumulate / apply)
+                # — no data-dependent control flow inside either graph
+                def step(*batch):
+                    loss = fwd_loss(batch)
+                    acc = loss / k if self._grad_merge_avg else loss
+                    if scaler is not None:
+                        acc = scaler.scale(acc)
+                    acc.backward()
+                    return loss
+
+                def apply_step():
+                    opt_apply()
+                    return self.optimizer._step_t
+
+                self._steps["train_apply"] = paddle.jit.to_static(apply_step)
+            else:
+                def step(*batch):
+                    loss = fwd_loss(batch)
+                    if scaler is not None:
+                        scaler.scale(loss).backward()
+                    else:
+                        loss.backward()
+                    opt_apply()
+                    return loss
         elif mode == "eval":
             def step(*batch):
                 from ...core.dispatch import no_grad
 
                 ins, labels = self._split(batch)
-                with no_grad():
+                with no_grad(), amp_ctx():
                     out = self.model(*ins)
                     loss = self.loss(out, *labels) if self.loss else out
                     if loss.ndim > 0:
@@ -78,7 +147,7 @@ class Engine:
             def step(*ins):
                 from ...core.dispatch import no_grad
 
-                with no_grad():
+                with no_grad(), amp_ctx():
                     return self.model(*ins)
 
         self._steps[mode] = paddle.jit.to_static(step)
@@ -129,6 +198,14 @@ class Engine:
         import paddle_tpu as paddle
 
         step = self._step_for("train")
+        apply_step = self._steps.get("train_apply")
+        k = self._grad_merge_k
+        if apply_step is not None:
+            # fresh accumulation window per fit(): reset the counter and
+            # ZERO leftover grad buffers in place (a prior fit may have
+            # ended mid-window; stale grads must not leak into this run)
+            self._gm_counter = 0
+            self.optimizer.clear_grad(set_to_zero=True)
         loader = self._loader(train_data, batch_size)
         history = {"loss": []}
         for _epoch in range(epochs):
@@ -136,6 +213,10 @@ class Engine:
                 batch = batch if isinstance(batch, (list, tuple)) else [batch]
                 batch = self._shard_batch(batch)
                 loss = step(*batch)
+                if apply_step is not None:
+                    self._gm_counter += 1
+                    if self._gm_counter % k == 0:
+                        apply_step()
                 history["loss"].append(float(loss.numpy()))
                 if num_iters is not None and it + 1 >= num_iters:
                     break

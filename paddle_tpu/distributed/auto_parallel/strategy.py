@@ -1,0 +1,68 @@
+"""Auto-parallel Strategy — feature-config bag for the static Engine.
+
+Reference parity: python/paddle/distributed/auto_parallel/strategy.py (+
+constants.py defaults): nested config objects with an `enable` switch each;
+consumed by the Engine's pass stack (paddle_tpu/distributed/passes/).
+"""
+from __future__ import annotations
+
+__all__ = ["Strategy"]
+
+
+class _Config:
+    def __init__(self, **defaults):
+        self.__dict__.update(defaults)
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.__dict__})"
+
+
+class Strategy:
+    """strategy = Strategy(); strategy.recompute.enable = True; ...
+    Engine(model, loss, opt, strategy=strategy)."""
+
+    def __init__(self, config=None):
+        self.auto_mode = "semi"
+        self.amp = _Config(enable=False, dtype="bfloat16", level="O1",
+                           custom_white_list=None, custom_black_list=None,
+                           init_loss_scaling=2.0 ** 15, use_grad_scaler=True)
+        self.recompute = _Config(enable=False, no_recompute_segments=[])
+        self.sharding = _Config(enable=False, stage=2, degree=1)
+        self.gradient_merge = _Config(enable=False, k_steps=2, avg=True)
+        self.pipeline = _Config(enable=False, schedule_mode="1F1B",
+                                micro_batch_size=1, accumulate_steps=1)
+        self.fused_passes = _Config(enable=False, fused_passes_list=[])
+        if config:
+            for k, v in dict(config).items():
+                if hasattr(self, k) and isinstance(getattr(self, k), _Config):
+                    getattr(self, k).__dict__.update(v)
+                else:
+                    setattr(self, k, v)
+
+    def passes(self):
+        """Materialize the enabled features as pass instances, reference
+        application order: amp -> recompute -> sharding -> gradient_merge
+        (≙ parallelizer_v2's pass application sequence)."""
+        from ..passes import new_pass
+
+        out = []
+        if self.amp.enable:
+            a = self.amp.to_dict()
+            a.pop("enable")
+            out.append(new_pass("amp", a))
+        if self.recompute.enable:
+            r = self.recompute.to_dict()
+            r.pop("enable")
+            out.append(new_pass("recompute", r))
+        if self.sharding.enable:
+            s = self.sharding.to_dict()
+            s.pop("enable")
+            out.append(new_pass("sharding", s))
+        if self.gradient_merge.enable:
+            g = self.gradient_merge.to_dict()
+            g.pop("enable")
+            out.append(new_pass("gradient_merge", g))
+        return out

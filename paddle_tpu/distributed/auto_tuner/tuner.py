@@ -65,7 +65,7 @@ class AutoTuner:
                  max_mp: int = 8, max_pp: int = 16,
                  sharding_stages=(0, 1, 2), micro_batches=(1, 2, 4, 8),
                  memory_limit_bytes: float | None = None,
-                 memory_model=None):
+                 memory_model=None, model_spec=None, chip_spec=None):
         self.n_chips = n_chips
         self.num_heads = num_heads
         self.num_layers = num_layers
@@ -76,6 +76,20 @@ class AutoTuner:
         self.micro_batches = tuple(micro_batches)
         self.memory_limit = memory_limit_bytes
         self.memory_model = memory_model
+        # analytic cost model (cost_model.py): when a ModelSpec is given,
+        # candidates are tried in predicted-step-time order and memory
+        # pruning defaults to the analytic predictor
+        self.model_spec = model_spec
+        self.chip_spec = chip_spec
+        if model_spec is not None and memory_model is None:
+            from .cost_model import predict_memory
+
+            self.memory_model = lambda c: predict_memory(
+                c, model_spec, self.global_batch)
+            if self.memory_limit is None:
+                from .cost_model import ChipSpec
+
+                self.memory_limit = (chip_spec or ChipSpec()).hbm_bytes
         self.history: list[Candidate] = []
 
     # ------------------------------------------------------------ search
@@ -112,9 +126,15 @@ class AutoTuner:
     def tune(self, trial_fn, max_trials: int | None = None) -> Candidate | None:
         """Run trials best-guess-first, return the best candidate."""
         cands = self.candidates()
-        # heuristic order: fewer pipeline stages, more dp first (cheap
-        # comms), bigger micro-batch last
-        cands.sort(key=lambda c: (c.pp, c.mp, c.micro_batch))
+        if self.model_spec is not None:
+            from .cost_model import rank_candidates
+
+            cands = rank_candidates(cands, self.model_spec, self.chip_spec,
+                                    self.global_batch)
+        else:
+            # heuristic order: fewer pipeline stages, more dp first (cheap
+            # comms), bigger micro-batch last
+            cands.sort(key=lambda c: (c.pp, c.mp, c.micro_batch))
         if max_trials is not None:
             cands = cands[:max_trials]
         best = None

@@ -38,6 +38,12 @@ def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
         return function(*args, **kwargs)
 
     rng_before = _rng._state()._data if preserve_rng_state else None
+    # the backward re-run must execute under the ORIGINAL forward's autocast
+    # state (reference recompute pins amp level/dtype in its PyLayer ctx) —
+    # otherwise re-run dtypes diverge from the recorded cotangent dtypes
+    from ....amp import amp_state, amp_state_guard
+
+    amp_before = amp_state()
 
     def run(diff_datas):
         saved = [(t, t._data) for t in diff_inputs]
@@ -47,7 +53,8 @@ def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
                 _rng._state()._data = rng_before
             for t, d in zip(diff_inputs, diff_datas):
                 t._data = d
-            out = function(*args, **kwargs)
+            with amp_state_guard(amp_before):
+                out = function(*args, **kwargs)
             single = not isinstance(out, (tuple, list))
             outs = [out] if single else list(out)
             return [o._data for o in outs], single

@@ -41,6 +41,10 @@ def _parse(argv):
     p.add_argument("--job_id", type=str, default="default")
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--np", type=str, default=None,
+                   help="elastic trainer range 'min:max' — on worker death "
+                        "the pod relaunches at the surviving world size "
+                        "(≙ fleet elastic np range)")
     p.add_argument("--elastic_level", type=int,
                    default=int(os.environ.get("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "0")))
     p.add_argument("training_script")
@@ -67,11 +71,12 @@ def _rank_env(base_env, rank, world, master, args, rpc_key):
 class Pod:
     """One node's worth of worker processes (≙ launch/job/pod.py)."""
 
-    def __init__(self, args, nproc, world, rank0):
+    def __init__(self, args, nproc, world, rank0, restarts=0):
         self.args = args
         self.nproc = nproc
         self.world = world
         self.rank0 = rank0
+        self.restarts = restarts
         self.procs: list[subprocess.Popen] = []
 
     def start(self):
@@ -92,10 +97,11 @@ class Pod:
             rank = self.rank0 + i
             logf = open(os.path.join(
                 self.args.log_dir, f"workerlog.{rank}"), "ab")
+            env = _rank_env(os.environ, rank, self.world, master,
+                            self.args, rpc_key)
+            env["PADDLE_RESTART_COUNT"] = str(self.restarts)
             p = subprocess.Popen(
-                cmd, env=_rank_env(os.environ, rank, self.world, master,
-                                   self.args, rpc_key),
-                stdout=logf, stderr=subprocess.STDOUT)
+                cmd, env=env, stdout=logf, stderr=subprocess.STDOUT)
             p._log = logf
             self.procs.append(p)
 
@@ -124,15 +130,32 @@ class Pod:
 
 
 def launch_pod(args) -> int:
-    """Run the pod with watch + restart (≙ CollectiveController.watch)."""
+    """Run the pod with watch + restart (≙ CollectiveController.watch).
+
+    With --np "min:max" and elastic_level > 0, a worker death RESHRINKS the
+    pod: the survivors' count becomes the new world size (single-host analog
+    of the reference ElasticManager dropping dead nodes,
+    fleet/elastic/manager.py:125); the relaunched ranks see
+    PADDLE_RESTART_COUNT > 0 and resume from the distributed checkpoint via
+    reshard-on-load."""
     nnodes = int(str(args.nnodes).split(":")[0])
     nproc = args.nproc_per_node or 1
     world = nnodes * nproc
+    min_world = world
+    if args.np:
+        if nnodes != 1:
+            raise SystemExit(
+                "--np (elastic trainer range) is single-node only: a "
+                "multi-node shrink must drop whole nodes (use --nnodes "
+                "'min:max' on the node dimension instead)")
+        lo, _, hi = str(args.np).partition(":")
+        min_world, world = int(lo), int(hi or lo)
     rank0 = args.node_rank * nproc
 
     restarts = 0
     while True:
-        pod = Pod(args, nproc, world, rank0)
+        local_n = world if nnodes == 1 else nproc
+        pod = Pod(args, local_n, world, rank0, restarts=restarts)
         pod.start()
         try:
             while True:
@@ -146,8 +169,20 @@ def launch_pod(args) -> int:
         except KeyboardInterrupt:
             pod.stop(signal.SIGINT)
             return 130
+        codes = [p.poll() for p in pod.procs]
+        failed = sum(1 for c in codes if c not in (None, 0))
         pod.stop()
         restarts += 1
+        if args.elastic_level > 0 and failed and world - failed >= min_world:
+            if restarts > args.max_restart:
+                print("[launch] elastic: max_restart exceeded",
+                      file=sys.stderr)
+                return 1
+            world -= failed
+            print(f"[launch] elastic: {failed} worker(s) died — relaunching "
+                  f"at world size {world} (restart {restarts})",
+                  file=sys.stderr)
+            continue
         if restarts > args.max_restart or args.elastic_level < 0:
             print(f"[launch] pod failed after {restarts - 1} restarts",
                   file=sys.stderr)

@@ -1,0 +1,186 @@
+"""Auto-parallel pass library — strategy-driven step-pipeline transforms.
+
+Reference parity: python/paddle/distributed/passes/ (auto_parallel_recompute,
+auto_parallel_amp/fp16, auto_parallel_sharding, auto_parallel_gradient_merge
+— pir/program rewrites driven by Strategy, applied by the static Engine,
+auto_parallel/static/engine.py:99 + parallelizer). TPU-native collapse: there
+is no ProgramDesc to rewrite — a "pass" here transforms the *step pipeline*
+(model wrapping, autocast context, optimizer wrapping, step splitting) before
+`to_static` compiles it into one XLA program:
+
+  recompute       -> wrap container children with fleet.utils.recompute
+                     (jax.checkpoint-style re-forward in backward)
+  amp             -> autocast level/dtype around forward+loss (+ GradScaler
+                     for fp16)
+  sharding        -> group_sharded optimizer stages 1/2/3 (ZeRO)
+  gradient_merge  -> split the train step into an accumulate-k program and
+                     an apply program (grad accumulation without breaks)
+
+`new_pass(name, attrs)` mirrors the reference factory; `Pass.apply(engine)`
+takes the Engine (our program container) instead of (main_prog, startup).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = ["new_pass", "PassBase", "PassContext", "RecomputePass", "AMPPass",
+           "ShardingPass", "GradientMergePass"]
+
+
+class PassContext:
+    def __init__(self):
+        self.attrs: dict[str, Any] = {}
+
+
+class PassBase:
+    name = "base"
+
+    def __init__(self, attrs=None):
+        self.attrs = dict(attrs or {})
+
+    def check_self(self) -> bool:
+        return True
+
+    def apply(self, engine, context: PassContext | None = None):
+        raise NotImplementedError
+
+
+class RecomputePass(PassBase):
+    """≙ auto_parallel_recompute.py: re-forward checkpointed segments in
+    backward instead of keeping activations. Segments = the entries of every
+    LayerList/Sequential container in the model (transformer blocks), minus
+    `no_recompute_segments` indices."""
+
+    name = "auto_parallel_recompute"
+
+    class _Target:
+        """recompute() discovers a block's parameters via .parameters();
+        a bare bound method has none, so grads to the layer's own weights
+        would silently vanish — this shim carries both the original forward
+        and the layer's parameter list."""
+
+        def __init__(self, layer, orig):
+            self._layer = layer
+            self._orig = orig
+
+        def __call__(self, *a, **kw):
+            return self._orig(*a, **kw)
+
+        def parameters(self):
+            return self._layer.parameters()
+
+    def apply(self, engine, context=None):
+        from ...nn.layer_base import Layer
+        from ...nn.layer.container import LayerList, Sequential
+        from ..fleet.utils import recompute
+
+        skip = set(self.attrs.get("no_recompute_segments", ()))
+        wrapped = []
+        seg_idx = 0  # GLOBAL segment numbering (reference semantics)
+
+        def wrap(layer):
+            nonlocal seg_idx
+            idx = seg_idx
+            seg_idx += 1
+            if idx in skip or getattr(layer, "_recompute_wrapped", False):
+                return
+            target = RecomputePass._Target(layer, layer.forward)
+
+            def fwd(*a, _t=target, **kw):
+                return recompute(_t, *a, **kw)
+
+            layer.forward = fwd
+            layer._recompute_wrapped = True
+            wrapped.append(layer)
+
+        def visit(layer):
+            """Wrap the children of the OUTERMOST containers only — a
+            wrapped segment must not contain nested recompute (the outer
+            re-forward would re-trigger the inner one, re-running inner
+            forwards once per nesting level)."""
+            if isinstance(layer, (LayerList, Sequential)):
+                for child in layer:
+                    if isinstance(child, Layer):
+                        wrap(child)
+                return  # do not descend into wrapped segments
+            for child in layer.children():
+                visit(child)
+
+        visit(engine.model)
+        if context is not None:
+            context.attrs["recomputed_segments"] = len(wrapped)
+        return engine
+
+
+class AMPPass(PassBase):
+    """≙ auto_parallel_amp.py / fp16 pass: the engine's forward+loss run
+    under autocast; fp16 adds a GradScaler (bf16 needs none)."""
+
+    name = "auto_parallel_amp"
+
+    def apply(self, engine, context=None):
+        dtype = self.attrs.get("dtype", "bfloat16")
+        level = self.attrs.get("level", "O1")
+        engine._amp_ctx = dict(
+            enable=True, dtype=dtype, level=level,
+            custom_white_list=self.attrs.get("custom_white_list"),
+            custom_black_list=self.attrs.get("custom_black_list"))
+        if dtype == "float16" and self.attrs.get("use_grad_scaler", True):
+            from ... import amp
+
+            engine._grad_scaler = amp.GradScaler(
+                init_loss_scaling=self.attrs.get("init_loss_scaling", 2.0**15))
+        return engine
+
+
+class ShardingPass(PassBase):
+    """≙ auto_parallel_sharding.py: ZeRO stage 1/2/3 via the group-sharded
+    optimizer wrappers over the sharding mesh axis."""
+
+    name = "auto_parallel_sharding"
+
+    def apply(self, engine, context=None):
+        from ..sharding import group_sharded_parallel
+
+        if engine.optimizer is None:
+            import warnings
+
+            warnings.warn("sharding pass skipped: engine has no optimizer "
+                          "(eval/predict-only engine)")
+            return engine
+        stage = int(self.attrs.get("stage", 2))
+        level = {1: "os", 2: "os_g", 3: "p_g_os"}[stage]
+        engine.model, engine.optimizer, _ = group_sharded_parallel(
+            engine.model, engine.optimizer, level=level)
+        return engine
+
+
+class GradientMergePass(PassBase):
+    """≙ auto_parallel_gradient_merge.py: accumulate grads for k_steps
+    micro-batches, then apply. The step splits into two compiled programs
+    (accumulate / apply) so no data-dependent control flow enters the
+    graph; Engine.fit drives the k-schedule."""
+
+    name = "auto_parallel_gradient_merge"
+
+    def apply(self, engine, context=None):
+        engine._grad_merge_k = int(self.attrs.get("k_steps", 2))
+        engine._grad_merge_avg = bool(self.attrs.get("avg", True))
+        return engine
+
+
+_PASSES = {
+    p.name: p
+    for p in (RecomputePass, AMPPass, ShardingPass, GradientMergePass)
+}
+
+
+def new_pass(name: str, pass_attrs=None) -> PassBase:
+    """Factory, reference-parity entry (paddle.distributed.passes.new_pass).
+    Accepts both reference names ('auto_parallel_recompute') and the short
+    forms ('recompute')."""
+    key = name if name in _PASSES else f"auto_parallel_{name}"
+    if key not in _PASSES:
+        raise ValueError(
+            f"unknown pass {name!r}; available: {sorted(_PASSES)}")
+    return _PASSES[key](pass_attrs)

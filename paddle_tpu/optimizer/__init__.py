@@ -379,15 +379,19 @@ class Adam(Optimizer):
             gd = gd + _wd_grad(wd, base.astype(comp_dt))
         t = self._step_t._data
         b1, b2 = self._beta1, self._beta2
-        new_m = b1 * m._data + (1 - b1) * gd
-        new_v = b2 * v._data + (1 - b2) * jnp.square(gd)
-        m._assign_raw(new_m)
-        v._assign_raw(new_v)
+        new_m = b1 * m._data.astype(comp_dt) + (1 - b1) * gd
+        new_v = b2 * v._data.astype(comp_dt) + (1 - b2) * jnp.square(gd)
+        # moments STAY in their accumulator dtype (p.dtype unless
+        # multi_precision) — compute is fp32, storage follows paddle
+        # semantics so a bf16-decorated model keeps bf16 optimizer state
+        # (how a ~1B model + AdamW fits one v5e chip)
+        m._assign_raw(new_m.astype(m._data.dtype))
+        v._assign_raw(new_v.astype(v._data.dtype))
         mhat = new_m / (1 - b1 ** t)
         if self._amsgrad:
             vmax = self._acc("moment2_max", p)
-            new_vmax = jnp.maximum(vmax._data, new_v)
-            vmax._assign_raw(new_vmax)
+            new_vmax = jnp.maximum(vmax._data.astype(comp_dt), new_v)
+            vmax._assign_raw(new_vmax.astype(vmax._data.dtype))
             vhat = new_vmax / (1 - b2 ** t)
         else:
             vhat = new_v / (1 - b2 ** t)

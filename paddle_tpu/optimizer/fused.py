@@ -58,7 +58,7 @@ def _build_executor(n, b1, b2, eps, decoupled, amsgrad, clip_norm, has_master):
             if amsgrad:
                 new_vmax = jnp.maximum(vmaxs[i].astype(comp_dt), new_v)
                 vhat = new_vmax / (1 - b2 ** t)
-                new_vmaxs.append(new_vmax)
+                new_vmaxs.append(new_vmax.astype(vmaxs[i].dtype))
             else:
                 vhat = new_v / (1 - b2 ** t)
             step = lr_i * mhat / (jnp.sqrt(vhat) + eps)
@@ -69,8 +69,10 @@ def _build_executor(n, b1, b2, eps, decoupled, amsgrad, clip_norm, has_master):
             new_bases.append(newb.astype(base.dtype))
             if has_master:
                 new_lo.append(newb.astype(lo_params[i].dtype))
-            new_ms.append(new_m)
-            new_vs.append(new_v)
+            # store moments back in their accumulator dtype (per-param path
+            # parity: compute fp32, storage follows the declared state dtype)
+            new_ms.append(new_m.astype(ms[i].dtype))
+            new_vs.append(new_v.astype(vs[i].dtype))
         return new_bases, new_lo, new_ms, new_vs, new_vmaxs
 
     return jax.jit(update, donate_argnums=(0, 1, 2, 3, 4))
