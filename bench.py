@@ -351,7 +351,18 @@ ALL = {
 }
 
 
+def run_one(name):
+    """Entry for the per-config subprocess (prints one JSON line)."""
+    t0 = time.perf_counter()
+    res = ALL[name]()
+    res["wall_s"] = round(time.perf_counter() - t0, 1)
+    print("BENCH_RESULT " + json.dumps(res))
+
+
 def main(argv):
+    import os
+    import subprocess
+
     import jax
 
     # default run = the BASELINE.md ladder + the bf16 variants (bf16 is the
@@ -361,28 +372,29 @@ def main(argv):
     which = [a.lstrip("-") for a in argv if a.lstrip("-") in ALL] or default
     details = {"platform": jax.devices()[0].platform,
                "device_count": jax.device_count(), "results": {}}
-    import gc
-
+    here = os.path.dirname(os.path.abspath(__file__))
     for name in which:
-        try:
-            t0 = time.perf_counter()
-            res = ALL[name]()
-            res["wall_s"] = round(time.perf_counter() - t0, 1)
+        # one SUBPROCESS per config: each starts with an empty chip (the
+        # reference op-benchmark harness isolates runs the same way; a prior
+        # config's pinned buffers or a previous OOM can't poison the next)
+        r = subprocess.run(
+            [sys.executable, "-c",
+             f"import sys; sys.path.insert(0, {here!r}); "
+             f"import bench; bench.run_one({name!r})"],
+            capture_output=True, text=True, cwd=here, timeout=3000)
+        res = None
+        for ln in r.stdout.splitlines():
+            if ln.startswith("BENCH_RESULT "):
+                res = json.loads(ln[len("BENCH_RESULT "):])
+        if res is not None:
             details["results"][name] = res
             print(f"[bench] {name}: {res}", file=sys.stderr)
-        except Exception as e:  # keep the headline printable no matter what
-            details["results"][name] = {"error": f"{type(e).__name__}: {e}"}
-            print(f"[bench] {name} FAILED: {e}", file=sys.stderr)
-        finally:
-            # each config must start with an empty chip: drop Tensor/GradNode
-            # cycles and the per-config compiled programs (they pin capture
-            # buffers — params/moments of the finished config)
-            gc.collect()
-            jax.clear_caches()
-            from paddle_tpu.core import dispatch as _dispatch
-
-            _dispatch.eager_cache_clear()
-            gc.collect()
+        else:
+            tail = (r.stderr or r.stdout).strip().splitlines()[-3:]
+            details["results"][name] = {"error": " | ".join(tail),
+                                        "rc": r.returncode}
+            print(f"[bench] {name} FAILED rc={r.returncode}: {tail}",
+                  file=sys.stderr)
 
     with open("BENCH_DETAILS.json", "w") as f:
         json.dump(details, f, indent=2)
