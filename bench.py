@@ -353,6 +353,12 @@ ALL = {
 
 def run_one(name):
     """Entry for the per-config subprocess (prints one JSON line)."""
+    import jax
+
+    # persistent compile cache: subprocess isolation must not mean
+    # recompiling the ladder every round
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_ccache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     t0 = time.perf_counter()
     res = ALL[name]()
     res["wall_s"] = round(time.perf_counter() - t0, 1)

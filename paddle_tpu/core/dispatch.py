@@ -382,15 +382,25 @@ def eager_cache_clear():
 def _check_nan_inf(name, arrs):
     import jax.numpy as jnp
 
+    def hit(msg):
+        # FLAGS_check_nan_inf_level >= 1: report, don't abort (reference
+        # nan_inf_utils level semantics)
+        if flag("FLAGS_check_nan_inf_level") >= 1:
+            import warnings
+
+            warnings.warn(msg)
+        else:
+            raise FloatingPointError(msg)
+
     for a in arrs:
-        if isinstance(a, jax.core.Tracer):
+        if isinstance(a, jax.core.Tracer) or isinstance(a, _LazyData):
             continue
         if dtypes.is_floating_point(a.dtype):
             if bool(jnp.any(~jnp.isfinite(a))):
-                raise FloatingPointError(f"Operator '{name}' output contains NaN/Inf")
+                hit(f"Operator '{name}' output contains NaN/Inf")
         elif dtypes.is_complex(a.dtype):
             if bool(jnp.any(~jnp.isfinite(a.real) | ~jnp.isfinite(a.imag))):
-                raise FloatingPointError(f"Operator '{name}' output contains NaN/Inf")
+                hit(f"Operator '{name}' output contains NaN/Inf")
 
 
 #: (pack, unpack) installed by autograd.saved_tensors_hooks; applied to the
@@ -572,6 +582,13 @@ def _op_call_impl(fn: Callable, *args, name: str | None = None, n_diff: int | No
 def _wrap_outputs(out, node, name):
     from .tensor import Tensor
 
+    if flag("FLAGS_benchmark"):
+        # benchmark mode: per-op completion barrier (≙ reference benchmark
+        # flag forcing synchronous kernel launches)
+        flat = [out] if not isinstance(out, (tuple, list)) else list(out)
+        for o in flat:
+            if isinstance(o, jax.Array) and not isinstance(o, jax.core.Tracer):
+                o.block_until_ready()
     if flag("FLAGS_check_nan_inf"):
         flat = [out] if not isinstance(out, (tuple, list)) else list(out)
         _check_nan_inf(name, [o for o in flat if hasattr(o, "dtype")])

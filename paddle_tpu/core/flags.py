@@ -71,6 +71,11 @@ define_flag("FLAGS_enable_double_grad", True,
             "record per-node re-derivation ctx for grad(create_graph=True); "
             "disable to shed the extra operand retention")
 define_flag("FLAGS_log_level", 0, "VLOG-style verbosity")
+define_flag("FLAGS_benchmark", False,
+            "benchmark mode: block until each op's outputs are ready "
+            "(per-op device sync, ≙ reference benchmark flag)")
+define_flag("FLAGS_check_nan_inf_level", 0,
+            "0: raise on nan/inf when FLAGS_check_nan_inf; >=1: warn only")
 define_flag("FLAGS_cudnn_deterministic", False, "parity shim; XLA is deterministic")
 define_flag("FLAGS_embedding_deterministic", False, "parity shim")
 define_flag("FLAGS_allocator_strategy", "xla", "parity shim; XLA owns allocation")
@@ -79,8 +84,6 @@ define_flag("FLAGS_allocator_strategy", "xla", "parity shim; XLA owns allocation
 # the commonly consumed ones are registered here so set_flags/get_flags and
 # FLAGS_* env seeding work for ported code — shims note where XLA makes the
 # knob moot).
-define_flag("FLAGS_check_nan_inf_level", 0, "0: raise on nan/inf; >0 thresholds")
-define_flag("FLAGS_benchmark", False, "sync-per-op benchmark mode shim")
 define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "shim; XLA GC owns buffers")
 define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92,
             "maps to XLA_PYTHON_CLIENT_MEM_FRACTION at init")
@@ -116,3 +119,8 @@ define_flag("FLAGS_enable_to_static", True,
             "global to_static toggle (jit.enable_to_static)")
 define_flag("FLAGS_jit_code_level", 100, "SOT code-dump verbosity shim")
 define_flag("FLAGS_jit_verbosity", 0, "dy2static logging verbosity shim")
+
+
+# the full reference flag surface (compat entries; must come after the
+# real-behavior definitions above so those win)
+from . import flags_compat as _flags_compat  # noqa: E402,F401

@@ -1,0 +1,339 @@
+"""Reference flag surface (compat layer).
+
+Reference parity: every PHI_DEFINE_EXPORTED_* flag from
+/root/reference/paddle/common/flags.cc (185 definitions) is registered here
+so `paddle.set_flags` / `paddle.get_flags` / `FLAGS_*` env vars accept the
+full reference surface. Flags whose subsystem is replaced wholesale on TPU
+(CUDA libraries, CINN, PIR, the allocator, PS/GPU-graph) are accepted and
+carried with a doc explaining the TPU-native analog; flags with real
+TPU-side behavior live in flags.py (check_nan_inf, benchmark, caches,
+to_static switches) and win over the entries below.
+"""
+from .flags import _REGISTRY, define_flag
+
+
+def _define(name, default, doc):
+    if name not in _REGISTRY:  # real-behavior definitions in flags.py win
+        define_flag(name, default, doc)
+
+
+_define("FLAGS_inner_op_parallelism", 0,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_paddle_num_threads", 1,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_enable_opt_get_features", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_enable_cublas_tensor_op_math", False,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_gemm_use_half_precision_compute_type", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_selected_gpus", '',
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_cublaslt_exhaustive_search_times", 0,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_enable_api_kernel_fallback", True,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_cudnn_exhaustive_search_times", -1,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_batch_norm_use_miopen", False,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_cudnn_batchnorm_spatial_persistent", False,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_communicator_max_merge_var_num", 20,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_communicator_is_sgd_optimizer", True,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_communicator_send_queue_size", 20,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_dist_threadpool_size", 0,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_fast_eager_deletion_mode", True,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_memory_fraction_of_eager_deletion", 1.0,
+            "accepted, no effect: device memory is managed by the XLA allocator (use XLA_PYTHON_CLIENT_MEM_FRACTION / _PREALLOCATE env vars)")
+_define("FLAGS_fraction_of_cpu_memory_to_use", 1,
+            "accepted, no effect: device memory is managed by the XLA allocator (use XLA_PYTHON_CLIENT_MEM_FRACTION / _PREALLOCATE env vars)")
+_define("FLAGS_fraction_of_cuda_pinned_memory_to_use", 0.5,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_initial_gpu_memory_in_mb", 0,
+            "accepted, no effect: device memory is managed by the XLA allocator (use XLA_PYTHON_CLIENT_MEM_FRACTION / _PREALLOCATE env vars)")
+_define("FLAGS_reallocate_gpu_memory_in_mb", 0,
+            "accepted, no effect: device memory is managed by the XLA allocator (use XLA_PYTHON_CLIENT_MEM_FRACTION / _PREALLOCATE env vars)")
+_define("FLAGS_auto_growth_chunk_size_in_mb", 0,
+            "accepted, no effect: device memory is managed by the XLA allocator (use XLA_PYTHON_CLIENT_MEM_FRACTION / _PREALLOCATE env vars)")
+_define("FLAGS_local_exe_sub_scope_limit", 256.0,
+            "accepted, no effect: the executor is the XLA runtime (SURVEY §7 L8)")
+_define("FLAGS_reader_queue_speed_test_mode", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_use_mkldnn", False,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_sort_sum_gradient", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_tracer_onednn_ops_on", '',
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_static_runtime_data_save_path", './',
+            "accepted, no effect: the executor is the XLA runtime (SURVEY §7 L8)")
+_define("FLAGS_tracer_onednn_ops_off", '',
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_check_kernel_launch", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_conv2d_disable_cudnn", False,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_use_fast_math", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_get_host_by_name_time", 120,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_save_static_runtime_data", False,
+            "accepted, no effect: the executor is the XLA runtime (SURVEY §7 L8)")
+_define("FLAGS_graph_load_in_parallel", False,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_enable_neighbor_list_use_uva", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_graph_neighbor_size_percent", 1.0,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_graph_metapath_split_opt", False,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_graph_get_neighbor_id", False,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_enable_exit_when_partial_worker", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_enable_adjust_op_order", 0,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_gpugraph_storage_mode", 1,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_run_kp_kernel", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_allow_cinn_ops", '',
+            "accepted, no effect: CINN's role (fusion/scheduling) is owned by XLA")
+_define("FLAGS_deny_cinn_ops", '',
+            "accepted, no effect: CINN's role (fusion/scheduling) is owned by XLA")
+_define("FLAGS_enable_cinn_compile_cache", True,
+            "accepted, no effect: CINN's role (fusion/scheduling) is owned by XLA")
+_define("FLAGS_cinn_compile_thread_num", -1,
+            "accepted, no effect: CINN's role (fusion/scheduling) is owned by XLA")
+_define("FLAGS_enable_interpretercore_launch_cinn", True,
+            "accepted, no effect: CINN's role (fusion/scheduling) is owned by XLA")
+_define("FLAGS_enable_cinn_auto_tune", False,
+            "accepted, no effect: CINN's role (fusion/scheduling) is owned by XLA")
+_define("FLAGS_cinn_specify_input_dynamic_dim", False,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_cinn_input_dynamic_dim_spec_file", '',
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_new_executor_use_cuda_graph", False,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_use_cuda_malloc_async_allocator", False,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_cuda_malloc_async_pool_memory_throttle_ratio", 0.8,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_auto_free_cudagraph_allocations_on_launch", True,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_executor_log_deps_every_microseconds", 0,
+            "accepted, no effect: the executor is the XLA runtime (SURVEY §7 L8)")
+_define("FLAGS_gpugraph_enable_hbm_table_collision_stat", False,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_cache_inference_while_scope", False,
+            "accepted, no effect: the executor is the XLA runtime (SURVEY §7 L8)")
+_define("FLAGS_gpugraph_hbm_table_load_factor", 0.75,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_gpugraph_enable_gpu_direct_access", False,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_gpugraph_enable_segment_merge_grads", False,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_gpugraph_merge_grads_segment_size", 128,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_gpugraph_slot_feasign_max_num", 5,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_gpugraph_dedup_pull_push_mode", 0,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_gpugraph_load_node_list_into_hbm", True,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_gpugraph_sparse_table_storage_mode", 0,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_enable_auto_detect_gpu_topo", True,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_enable_auto_rdma_trans", True,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_enable_tracker_all2all", False,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_enable_all2all_use_fp16", False,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_enable_sparse_inner_gather", False,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_gpugraph_debug_gpu_memory", False,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_graph_embedding_split_infer_mode", True,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_enable_graph_multi_node_sampling", False,
+            "accepted, no effect: PS/GPU-graph stack is out of north-star scope (SURVEY §7)")
+_define("FLAGS_query_dest_rank_by_multi_node", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_multi_node_sample_use_gpu_table", True,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_nccl_blocking_wait", False,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_benchmark_nccl", False,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_eager_communication_connection", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_tcp_max_syn_backlog", 2048,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_use_autotune", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_disable_dyshape_in_train", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_enable_cinn_accuracy_check", False,
+            "accepted, no effect: CINN's role (fusion/scheduling) is owned by XLA")
+_define("FLAGS_enable_fuse_parallel_matmul_pass", True,
+            "accepted, no effect: CINN's role (fusion/scheduling) is owned by XLA")
+_define("FLAGS_enable_fusion_fallback", False,
+            "accepted, no effect: CINN's role (fusion/scheduling) is owned by XLA")
+_define("FLAGS_enable_fusion_result_check", False,
+            "accepted, no effect: CINN's role (fusion/scheduling) is owned by XLA")
+_define("FLAGS_enable_transpose_iters_in_fusion", True,
+            "accepted, no effect: CINN's role (fusion/scheduling) is owned by XLA")
+_define("FLAGS_enable_reuse_iters_in_fusion", True,
+            "accepted, no effect: CINN's role (fusion/scheduling) is owned by XLA")
+_define("FLAGS_enable_append_iters_in_fusion", True,
+            "accepted, no effect: CINN's role (fusion/scheduling) is owned by XLA")
+_define("FLAGS_search_cache_max_number", 1000000,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_einsum_opt", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_enable_auto_layout_pass", False,
+            "accepted, no effect: CINN's role (fusion/scheduling) is owned by XLA")
+_define("FLAGS_npu_storage_format", False,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_enable_cudnn_frontend", False,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_cudnn_cache_saturation_count", 1,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_trt_ibuilder_cache", False,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_use_shm_cache", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_dataloader_use_file_descriptor", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_enable_pir_in_executor", False,
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")
+_define("FLAGS_enable_pir_with_pt_in_dy2st", True,
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")
+_define("FLAGS_logging_pir_py_code_dir", '',
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")
+_define("FLAGS_logging_pir_py_code_int_tensor_element_limit", 2048,
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")
+_define("FLAGS_logging_trunc_pir_py_code", True,
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")
+_define("FLAGS_logging_pir_py_code_dump_symbolic_dims", False,
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")
+_define("FLAGS_pir_interpreter_record_stream_for_gc_cache", False,
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")
+_define("FLAGS_enable_pir_in_executor_trace_run", False,
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")
+_define("FLAGS_pir_apply_inplace_pass", True,
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")
+_define("FLAGS_ir_inplace_kernel_blacklist", '',
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")
+_define("FLAGS_enable_record_memory", False,
+            "accepted, no effect: device memory is managed by the XLA allocator (use XLA_PYTHON_CLIENT_MEM_FRACTION / _PREALLOCATE env vars)")
+_define("FLAGS_eager_delete_scope", True,
+            "accepted, no effect: device memory is managed by the XLA allocator (use XLA_PYTHON_CLIENT_MEM_FRACTION / _PREALLOCATE env vars)")
+_define("FLAGS_host_trace_level", 1,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_multiple_of_cupti_buffer_size", 1,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_print_ir", False,
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")
+_define("FLAGS_prim_skip_dynamic", True,
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")
+_define("FLAGS_prim_enable_dynamic", False,
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")
+_define("FLAGS_prim_check_ops", False,
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")
+_define("FLAGS_prim_forward_blacklist", '',
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")
+_define("FLAGS_disable_logging_op_attr_list", '',
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_dynamic_static_unified_comm", True,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_enable_async_trace", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_async_trace_count", 5,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_use_auto_growth_pinned_allocator", False,
+            "accepted, no effect: device memory is managed by the XLA allocator (use XLA_PYTHON_CLIENT_MEM_FRACTION / _PREALLOCATE env vars)")
+_define("FLAGS_sync_after_alloc", False,
+            "accepted, no effect: device memory is managed by the XLA allocator (use XLA_PYTHON_CLIENT_MEM_FRACTION / _PREALLOCATE env vars)")
+_define("FLAGS_alloc_fill_value", -1,
+            "accepted, no effect: device memory is managed by the XLA allocator (use XLA_PYTHON_CLIENT_MEM_FRACTION / _PREALLOCATE env vars)")
+_define("FLAGS_pir_apply_shape_optimization_pass", False,
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")
+_define("FLAGS_pir_broadcast_tree_limit", 32,
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")
+_define("FLAGS_nvidia_package_dir", '',
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_cudnn_dir", '',
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_cublas_dir", '',
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_nccl_dir", '',
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_cupti_dir", '',
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_mklml_dir", '',
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_lapack_dir", '',
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_check_infer_symbolic", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_manually_trans_conv_filter", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_enable_cse_in_dy2st", True,
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")
+_define("FLAGS_cse_max_count", -1,
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")
+_define("FLAGS_enable_blaslt_global_search", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_cublaslt_device_best_config", '',
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_use_xqa_optim", False,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_cuda_core_int8_gemm", False,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_mkl_dir", '',
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_op_dir", '',
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_cusparselt_dir", '',
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_curand_dir", '',
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_cusolver_dir", '',
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_cusparse_dir", '',
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_win_cuda_bin_dir", '',
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_enable_collect_shape", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_accuracy_check_atol_fp32", 1e-6,
+            "tolerance consumed by paddle.amp.debugging accuracy comparison")
+_define("FLAGS_accuracy_check_rtol_fp32", 1e-6,
+            "tolerance consumed by paddle.amp.debugging accuracy comparison")
+_define("FLAGS_accuracy_check_atol_fp16", 1e-3,
+            "tolerance consumed by paddle.amp.debugging accuracy comparison")
+_define("FLAGS_accuracy_check_rtol_fp16", 1e-3,
+            "tolerance consumed by paddle.amp.debugging accuracy comparison")
+_define("FLAGS_accuracy_check_atol_bf16", 1e-3,
+            "tolerance consumed by paddle.amp.debugging accuracy comparison")
+_define("FLAGS_accuracy_check_rtol_bf16", 1e-3,
+            "tolerance consumed by paddle.amp.debugging accuracy comparison")
+_define("FLAGS_pinned_memory_as_cpu_backend", False,
+            "accepted, no effect: device memory is managed by the XLA allocator (use XLA_PYTHON_CLIENT_MEM_FRACTION / _PREALLOCATE env vars)")
+_define("FLAGS_trt_min_group_size", 3,
+            "accepted, no effect on TPU: CUDA/vendor-library subsystem is replaced by XLA")
+_define("FLAGS_fused_multi_transformer_op_use_mbfmha", False,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_multi_block_attention_min_partition_size", 1024,
+            "accepted for API compatibility (see doc for the TPU-native analog)")
+_define("FLAGS_save_cf_stack_op", False,
+            "accepted, no effect: PIR/ProgramDesc is replaced by jaxpr/StableHLO")

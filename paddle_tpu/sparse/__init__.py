@@ -29,6 +29,8 @@ __all__ = [
     "nn",
 ]
 
+# sparse conv/pool entry points also surface at paddle.sparse level
+
 
 def _data_of(x):
     return x._data if isinstance(x, Tensor) else x
@@ -420,3 +422,10 @@ __all__ += [
     "expm1", "deg2rad", "rad2deg", "isnan", "divide", "mv", "addmm", "sum",
     "reshape", "slice", "coalesce", "is_same_shape", "mask_as", "pca_lowrank",
 ]
+
+
+from .conv import (  # noqa: E402
+    avg_pool3d, conv2d, conv3d, max_pool3d, subm_conv2d, subm_conv3d)
+
+__all__ += ["conv2d", "conv3d", "subm_conv2d", "subm_conv3d",
+            "max_pool3d", "avg_pool3d"]

@@ -1,0 +1,207 @@
+"""Sparse convolution / pooling on COO site lists.
+
+Reference parity: paddle.sparse.nn.functional conv3d/subm_conv3d/conv2d +
+max_pool3d over SparseCooTensor (phi sparse kernels,
+/root/reference/paddle/phi/kernels/sparse/conv_kernel.h,
+gpu/conv_kernel.cu; layout NDHWC, weight [*k, C_in, C_out]).
+
+TPU-native design: the reference builds a "rulebook" (offset -> (in site,
+out site) pairs) on GPU; here the rulebook is built host-side from the
+concrete COO indices (numpy dict over coordinates), then the compute is ONE
+jitted program with static shapes: for each kernel offset (static unroll,
+<=27 for 3^3) gather the matching input rows, mask invalid, matmul with
+that offset's weight slice, accumulate. Grads flow through values and
+weight via the ordinary tape; XLA fuses the per-offset chain.
+"""
+from __future__ import annotations
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import op_call
+from ..core.tensor import Tensor
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _rulebook(coords, shape, ksize, stride, padding, dilation, subm):
+    """Host-side site matching. coords: [nnz, 1+dims] (batch + spatial).
+    Returns (out_coords [n_out, 1+dims], src [n_off, n_out] input row or -1,
+    out_spatial_shape)."""
+    dims = len(ksize)
+    spatial = [int(s) for s in shape[1:1 + dims]]
+    out_sp = [(spatial[i] + 2 * padding[i]
+               - dilation[i] * (ksize[i] - 1) - 1) // stride[i] + 1
+              for i in range(dims)]
+    offsets = list(itertools.product(*[range(k) for k in ksize]))
+    site = {tuple(c): i for i, c in enumerate(map(tuple, coords))}
+
+    if subm:
+        out_coords = coords
+    else:
+        outs = set()
+        for c in coords:
+            b, pos = int(c[0]), c[1:]
+            for off in offsets:
+                num = [pos[i] + padding[i] - dilation[i] * off[i]
+                       for i in range(dims)]
+                if all(n % stride[i] == 0 and
+                       0 <= n // stride[i] < out_sp[i]
+                       for i, n in enumerate(num)):
+                    outs.add((b,) + tuple(n // stride[i]
+                                          for i, n in enumerate(num)))
+        out_coords = np.array(sorted(outs), dtype=np.int64).reshape(
+            len(outs), 1 + dims)
+
+    n_out = len(out_coords)
+    src = np.full((len(offsets), max(n_out, 1)), -1, dtype=np.int64)
+    for oi, o in enumerate(out_coords):
+        b, pos = int(o[0]), o[1:]
+        for ki, off in enumerate(offsets):
+            inp = tuple(pos[i] * stride[i] - padding[i] + dilation[i] * off[i]
+                        for i in range(dims))
+            if all(0 <= inp[i] < spatial[i] for i in range(dims)):
+                j = site.get((b,) + inp)
+                if j is not None:
+                    src[ki, oi] = j
+    return out_coords, src, out_sp
+
+
+def _conv_impl(x, weight, bias, stride, padding, dilation, subm, dims,
+               name):
+    from . import _build
+
+    vals = x._spvals                       # [nnz, C_in] Tensor
+    coords = np.asarray(x._spidx)
+    shape = x._spshape                     # (N, *spatial, C_in)
+    wshape = list(weight.shape)            # [*k, C_in, C_out]
+    ksize = tuple(int(k) for k in wshape[:dims])
+    cin, cout = int(wshape[dims]), int(wshape[dims + 1])
+    stride = _tuplize(stride, dims)
+    padding = _tuplize(padding, dims)
+    dilation = _tuplize(dilation, dims)
+    if subm and (any(s != 1 for s in stride) or
+                 any(k % 2 == 0 for k in ksize)):
+        raise ValueError("submanifold conv needs stride 1 and odd kernels")
+
+    out_coords, src, out_sp = _rulebook(coords, shape, ksize, stride,
+                                        padding, dilation, subm)
+    n_off = src.shape[0]
+    nnz = max(int(vals.shape[0]), 1)
+
+    def fn(v, w, *rest):
+        srcs = rest[-1]
+        b = rest[0] if bias is not None else None
+        wf = w.reshape((n_off, cin, cout))
+        out = jnp.zeros((src.shape[1], cout), v.dtype)
+        for k in range(n_off):     # static unroll over kernel offsets
+            idx = srcs[k]
+            g = v[jnp.clip(idx, 0, nnz - 1)]
+            g = jnp.where((idx >= 0)[:, None], g, 0)
+            out = out + g.astype(v.dtype) @ wf[k].astype(v.dtype)
+        if b is not None:
+            out = out + b
+        return out
+
+    args = [vals, weight] + ([bias] if bias is not None else []) + \
+        [src.astype(np.int32)]
+    out_vals = op_call(fn, *args, name=name, n_diff=3 if bias is not None
+                       else 2)
+    out_shape = (shape[0],) + tuple(out_sp) + (cout,)
+    return _build(out_vals, out_coords, out_shape)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Sparse conv3d (≙ sparse conv3d, phi sparse/conv_kernel.h). Output
+    sites = all positions reached by any input site."""
+    if groups != 1:
+        raise NotImplementedError("sparse conv groups > 1")
+    return _conv_impl(x, weight, bias, stride, padding, dilation, False, 3,
+                      "sparse_conv3d")
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold conv3d: output sites == input sites (point clouds keep
+    their sparsity pattern; ≙ sparse subm_conv3d)."""
+    if groups != 1:
+        raise NotImplementedError("sparse conv groups > 1")
+    return _conv_impl(x, weight, bias, stride, padding, dilation, True, 3,
+                      "sparse_subm_conv3d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    if groups != 1:
+        raise NotImplementedError("sparse conv groups > 1")
+    return _conv_impl(x, weight, bias, stride, padding, dilation, False, 2,
+                      "sparse_conv2d")
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    if groups != 1:
+        raise NotImplementedError("sparse conv groups > 1")
+    return _conv_impl(x, weight, bias, stride, padding, dilation, True, 2,
+                      "sparse_subm_conv2d")
+
+
+def _pool_impl(x, ksize, stride, padding, dims, mode, name):
+    from . import _build
+
+    vals = x._spvals
+    coords = np.asarray(x._spidx)
+    shape = x._spshape
+    ksize = _tuplize(ksize, dims)
+    stride = _tuplize(stride if stride is not None else ksize, dims)
+    padding = _tuplize(padding, dims)
+    out_coords, src, out_sp = _rulebook(coords, shape, ksize, stride,
+                                        padding, (1,) * dims, False)
+    nnz = max(int(vals.shape[0]), 1)
+    n_off = src.shape[0]
+
+    def fn(v, srcs):
+        neg = jnp.asarray(-np.inf, v.dtype) if mode == "max" else 0.0
+        acc = jnp.full((src.shape[1], v.shape[-1]), neg, v.dtype) \
+            if mode == "max" else jnp.zeros((src.shape[1], v.shape[-1]),
+                                            v.dtype)
+        cnt = jnp.zeros((src.shape[1], 1), v.dtype)
+        for k in range(n_off):
+            idx = srcs[k]
+            g = v[jnp.clip(idx, 0, nnz - 1)]
+            valid = (idx >= 0)[:, None]
+            if mode == "max":
+                acc = jnp.maximum(acc, jnp.where(valid, g, neg))
+            else:
+                acc = acc + jnp.where(valid, g, 0)
+                cnt = cnt + valid.astype(v.dtype)
+        if mode == "max":
+            return acc
+        return acc / jnp.maximum(cnt, 1)
+
+    out_vals = op_call(fn, vals, src.astype(np.int32), name=name, n_diff=1)
+    out_shape = (shape[0],) + tuple(out_sp) + (int(vals.shape[-1]),)
+    return _build(out_vals, out_coords, out_shape)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    """Sparse max pooling (≙ sparse max_pool3d, phi sparse/pool_kernel.h);
+    max over the ACTIVE sites in each window."""
+    return _pool_impl(x, kernel_size, stride, padding, 3, "max",
+                      "sparse_max_pool3d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    """Average over the active sites in each window (paddle sparse
+    semantics: divisor = active count, not window volume)."""
+    return _pool_impl(x, kernel_size, stride, padding, 3, "avg",
+                      "sparse_avg_pool3d")

@@ -35,3 +35,131 @@ class Softmax(Layer):
         out = op_call(lambda o, m: jnp.where(m > 0, o, 0.0), out, mask,
                       name="mask_zero")
         return to_sparse_coo(out)
+
+
+# ---------------------------------------------------------------- functional
+class _Functional:
+    """paddle.sparse.nn.functional — conv/pool entry points (module-like)."""
+
+
+def _install_functional():
+    import types
+
+    from . import conv as _conv
+
+    functional = types.ModuleType("paddle_tpu.sparse.nn.functional")
+    for name in ("conv2d", "conv3d", "subm_conv2d", "subm_conv3d",
+                 "max_pool3d", "avg_pool3d"):
+        setattr(functional, name, getattr(_conv, name))
+
+    def relu(x, name=None):  # late: sparse/__init__ may still be loading
+        from . import relu as _relu
+
+        return _relu(x, name)
+
+    functional.relu = relu
+    import sys
+
+    sys.modules["paddle_tpu.sparse.nn.functional"] = functional
+    return functional
+
+
+functional = _install_functional()
+
+
+class _SparseConvBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False, dims=3,
+                 bias_attr=None, data_format=None):
+        super().__init__()
+        from .conv import _tuplize
+
+        self._dims = dims
+        self._subm = subm
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        k = _tuplize(kernel_size, dims)
+        import numpy as np
+
+        from ..core.tensor import Parameter
+
+        fan_in = in_channels * int(np.prod(k))
+        bound = 1.0 / np.sqrt(fan_in)
+        rs = np.random
+        self.weight = Parameter(
+            (rs.uniform(-bound, bound,
+                        k + (in_channels, out_channels))).astype("float32"))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = Parameter(
+                rs.uniform(-bound, bound, (out_channels,)).astype("float32"))
+
+    def forward(self, x):
+        from .conv import _conv_impl
+
+        name = ("sparse_subm_conv" if self._subm else "sparse_conv") + \
+            f"{self._dims}d"
+        return _conv_impl(x, self.weight, self.bias, self._stride,
+                          self._padding, self._dilation, self._subm,
+                          self._dims, name)
+
+
+class Conv3D(_SparseConvBase):
+    """≙ paddle.sparse.nn.Conv3D (phi sparse conv3d, NDHWC)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=False, dims=3,
+                         bias_attr=bias_attr)
+
+
+class SubmConv3D(_SparseConvBase):
+    """≙ paddle.sparse.nn.SubmConv3D — output sites == input sites."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=True, dims=3,
+                         bias_attr=bias_attr)
+
+
+class Conv2D(_SparseConvBase):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=False, dims=2,
+                         bias_attr=bias_attr)
+
+
+class SubmConv2D(_SparseConvBase):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=True, dims=2,
+                         bias_attr=bias_attr)
+
+
+class MaxPool3D(Layer):
+    """≙ paddle.sparse.nn.MaxPool3D over active sites."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self._k = kernel_size
+        self._s = stride
+        self._p = padding
+
+    def forward(self, x):
+        from .conv import max_pool3d
+
+        return max_pool3d(x, self._k, self._s, self._p)

@@ -174,3 +174,43 @@ class TestStubs:
         with pytest.raises(NotImplementedError, match="parameter-server"):
             fleet.UserDefinedRoleMaker(role="server")
         assert fleet.is_worker() and not fleet.is_server()
+
+
+class TestFlagSurface:
+    """Full reference flag surface (≙ flags.cc 185 PHI_DEFINE_EXPORTED_*)."""
+
+    def test_registry_covers_reference_names(self):
+        from paddle_tpu.core.flags import _REGISTRY
+
+        assert len(_REGISTRY) >= 185
+        for name in ("FLAGS_use_autotune", "FLAGS_allocator_strategy",
+                     "FLAGS_cudnn_deterministic", "FLAGS_host_trace_level",
+                     "FLAGS_accuracy_check_rtol_fp32", "FLAGS_use_cinn"):
+            paddle.get_flags([name])  # must not raise
+        # env-style set/get roundtrip
+        paddle.set_flags({"FLAGS_call_stack_level": 3})
+        assert paddle.get_flags("FLAGS_call_stack_level")[
+            "FLAGS_call_stack_level"] == 3
+
+    def test_check_nan_inf_level_warns_not_raises(self):
+        import warnings
+
+        paddle.set_flags({"FLAGS_check_nan_inf": True,
+                          "FLAGS_check_nan_inf_level": 1})
+        try:
+            x = paddle.to_tensor(np.array([1.0, np.inf], "float32"))
+            with warnings.catch_warnings(record=True) as rec:
+                warnings.simplefilter("always")
+                _ = x + 1  # op output contains inf → warn, not raise
+            assert any("NaN/Inf" in str(m.message) for m in rec)
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False,
+                              "FLAGS_check_nan_inf_level": 0})
+
+    def test_benchmark_flag_syncs(self):
+        paddle.set_flags({"FLAGS_benchmark": True})
+        try:
+            out = paddle.to_tensor(np.ones(4, "float32")) * 2
+            assert float(out.sum()) == 8.0
+        finally:
+            paddle.set_flags({"FLAGS_benchmark": False})
