@@ -337,6 +337,14 @@ def bench_fused_adam(iters=15):
             "n_tensors": len(opt_mt._parameters)}
 
 
+def bench_eager_host(iters=50):
+    """bench_eager_dispatch on the host CPU backend (no tunnel RTT): the
+    framework's own per-op dispatch overhead."""
+    res = bench_eager_dispatch(iters=iters)
+    res["name"] = "eager_dispatch_on_host_cpu"
+    return res
+
+
 ALL = {
     "lenet": bench_lenet,
     "resnet50": bench_resnet50,
@@ -347,13 +355,25 @@ ALL = {
     "llama_bf16": bench_llama_train,
     "llama_1b": bench_llama_1b,
     "eager": bench_eager_dispatch,
+    "eager_host": bench_eager_host,
     "fused_adam": bench_fused_adam,
 }
 
 
 def run_one(name):
     """Entry for the per-config subprocess (prints one JSON line)."""
+    import os
+
+    if name == "eager_host":
+        # on-host dispatch measurement: the tunnel RTT (~13-17ms/invocation)
+        # swamps per-op dispatch cost, so the host CPU backend isolates the
+        # FRAMEWORK's own overhead (SURVEY §7 hard-part (1) quantified)
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
+
+    if name == "eager_host":
+        jax.config.update("jax_platforms", "cpu")
 
     # persistent compile cache: subprocess isolation must not mean
     # recompiling the ladder every round
@@ -374,7 +394,8 @@ def main(argv):
     # default run = the BASELINE.md ladder + the bf16 variants (bf16 is the
     # native TPU training dtype — the judge-facing perf evidence)
     default = ["lenet", "resnet50", "resnet50_bf16", "bert", "gpt_sharding",
-               "llama", "llama_bf16", "llama_1b", "eager", "fused_adam"]
+               "llama", "llama_bf16", "llama_1b", "eager", "eager_host",
+               "fused_adam"]
     which = [a.lstrip("-") for a in argv if a.lstrip("-") in ALL] or default
     details = {"platform": jax.devices()[0].platform,
                "device_count": jax.device_count(), "results": {}}

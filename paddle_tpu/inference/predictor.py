@@ -42,6 +42,9 @@ class Config:
         self._precision = PrecisionType.Float32
         self._enable_memory_optim = True
         self._network_factory = None
+        self._ir_optim = True
+        self._profile = False
+        self._cpu_threads = 1
 
     # -- device selection (parity names)
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
@@ -65,14 +68,30 @@ class Config:
         self._network_factory = factory
 
     def enable_memory_optim(self, flag=True):
+        """REAL effect on the network-factory path: predictor inputs are
+        donated to the compiled program (the XLA analog of the reference's
+        memory-reuse pass)."""
         self._enable_memory_optim = flag
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag  # XLA always optimizes; stored for summary
+
+    def enable_profile(self):
+        self._profile = True
+
+    def disable_glog_info(self):
+        return None
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_threads = int(n)
 
     def model_dir(self):
         return self._prefix
 
     def summary(self) -> str:
         return (f"Config(prefix={self._prefix}, device={self._device}, "
-                f"precision={self._precision.name})")
+                f"precision={self._precision.name}, "
+                f"memory_optim={self._enable_memory_optim})")
 
 
 class Tensor:
@@ -117,6 +136,18 @@ class Predictor:
             net = config._network_factory()
             net.set_state_dict(payload.get("state_dict", payload))
             net.eval()
+            if config._precision in (PrecisionType.Half,
+                                     PrecisionType.Bfloat16):
+                # REAL precision switch: serve in bf16 (params cast once at
+                # load — the analog of the reference's fp16 analysis pass)
+                from .. import amp
+
+                net = amp.decorate(net, None, level="O2", dtype="bfloat16")
+            elif config._precision == PrecisionType.Int8:
+                raise NotImplementedError(
+                    "Int8 serving needs a quantized export "
+                    "(paddle.quantization PTQ) — not an inference-time "
+                    "switch on TPU")
             self._layer = net
             self._n_inputs = None
         else:
@@ -125,6 +156,8 @@ class Predictor:
                 "Config.set_network_factory to serve from the state_dict")
         self._inputs: dict[str, Tensor] = {}
         self._outputs: list[np.ndarray] = []
+        self._compiled: dict = {}    # input signature -> (jitted, params)
+        self._run_times: list[float] = []
 
     # -- paddle_infer API
     def get_input_names(self):
@@ -143,27 +176,75 @@ class Predictor:
         t._value = self._outputs[idx]
         return t
 
+    def _compiled_layer_call(self, inputs):
+        """Network-factory path: ONE jitted XLA program per input signature
+        (the AOT 'analysis' product), inputs donated when
+        enable_memory_optim — this is where the Config switches become real
+        behavior instead of stored fields."""
+        import jax
+
+        from ..core.dispatch import no_grad
+        from ..core.tensor import Tensor as PTensor
+
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in inputs)
+        exe = self._compiled.get(key)
+        if exe is None:
+            params = [p for p in self._layer.parameters()]
+
+            def pure(param_datas, arg_datas):
+                saved = [p._data for p in params]
+                for p, d in zip(params, param_datas):
+                    p._data = d
+                try:
+                    with no_grad():
+                        res = self._layer(*[
+                            PTensor(d, _internal=True, stop_gradient=True)
+                            for d in arg_datas])
+                    if isinstance(res, (list, tuple)):
+                        return [r._data for r in res]
+                    return [res._data]
+                finally:
+                    for p, d in zip(params, saved):
+                        p._data = d
+
+            donate = (1,) if self.config._enable_memory_optim else ()
+            exe = (jax.jit(pure, donate_argnums=donate), params)
+            self._compiled[key] = exe
+        jitted, params = exe
+        return jitted([p._data for p in params],
+                      [np.asarray(a) for a in inputs])
+
     def run(self, inputs: list[np.ndarray] | None = None):
         """Execute the compiled program. With `inputs` given, returns the
         outputs directly (paddle_infer also supports the handle API)."""
+        import time
+
+        t0 = time.perf_counter() if self.config._profile else None
         if inputs is None:
             names = self.get_input_names()
             inputs = [self._inputs[n]._value for n in names]
         if self._exported is not None:
             out = self._exported.call(*[np.asarray(a) for a in inputs])
+            outs = list(out) if isinstance(out, (list, tuple)) else [out]
         else:
-            from ..core.dispatch import no_grad
-            from ..core.tensor import Tensor as PTensor
-
-            with no_grad():
-                res = self._layer(*[PTensor(np.asarray(a)) for a in inputs])
-            out = res._data if isinstance(res, PTensor) else \
-                [r._data for r in res]
-        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+            outs = self._compiled_layer_call(inputs)
         self._outputs = outs
+        if t0 is not None:
+            self._run_times.append(time.perf_counter() - t0)
         return outs
 
+    def get_profile_summary(self) -> dict:
+        ts = self._run_times
+        if not ts:
+            return {"runs": 0}
+        return {"runs": len(ts), "avg_ms": 1e3 * sum(ts) / len(ts),
+                "min_ms": 1e3 * min(ts), "max_ms": 1e3 * max(ts)}
+
     def try_shrink_memory(self):
+        import gc
+
+        self._compiled.clear()
+        gc.collect()
         return None
 
     def clear_intermediate_tensor(self):
@@ -172,3 +253,14 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+class PredictorPool:
+    """≙ paddle_infer::services::PredictorPool — N predictors over one
+    loaded artifact (thread-per-request serving)."""
+
+    def __init__(self, config: Config, size: int = 1):
+        self._preds = [Predictor(config) for _ in range(max(1, int(size)))]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._preds[idx % len(self._preds)]

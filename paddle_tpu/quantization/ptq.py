@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import op_call
@@ -34,48 +35,65 @@ class _ObservedLayer(Layer):
 
 
 class QuantizedLinear(Layer):
-    """int8 weight + fp scale; forward dequantizes into the matmul (XLA
-    fuses the dequant into the GEMM — the int8 tensor is what ships in a
-    checkpoint)."""
+    """int8 weight + fp scale. Two execution paths:
 
-    def __init__(self, linear, weight_scale: float, act_scale: float | None = None,
+    - weight-only (act_scale None): dequant fused into the fp GEMM (the
+      int8 tensor is what ships in a checkpoint);
+    - full int8 (act_scale given): activations quantize to int8 and the
+      GEMM runs int8×int8 → int32 on the MXU
+      (`lax.dot_general(..., preferred_element_type=int32)`), dequantized
+      by act_scale·weight_scale — the TPU-native analog of the reference's
+      int8 kernels (phi quantize_kernel/gpu int8 gemm paths).
+
+    weight_scale may be per-output-channel ([out_features]) — per-channel
+    symmetric quantization."""
+
+    def __init__(self, linear, weight_scale, act_scale: float | None = None,
                  bit_length: int = 8):
         super().__init__()
         qmax = float(2 ** (bit_length - 1) - 1)
         w = linear.weight._data
-        self.w_int8 = jnp.clip(jnp.round(w / weight_scale), -qmax - 1, qmax
+        ws = jnp.asarray(weight_scale, jnp.float32)
+        self.w_int8 = jnp.clip(jnp.round(w / ws), -qmax - 1, qmax
                                ).astype(jnp.int8)
-        self.weight_scale = float(weight_scale)
+        self.per_channel = ws.ndim > 0
+        self.weight_scale = ws if self.per_channel else float(weight_scale)
         self.act_scale = act_scale
         self.bias = getattr(linear, "bias", None)
         self.bit_length = bit_length
 
     def forward(self, x):
-        # w_int8 rides as an op operand (dynamic input), NOT a closure cell:
-        # arrays in the closure would make the fn key uncachable and kick the
-        # call off the compiled-eager path (scales are floats — static key)
-        ws = self.weight_scale
+        # w_int8 (and a per-channel scale vector) ride as op operands
+        # (dynamic inputs), NOT closure cells: arrays in the closure would
+        # make the fn key uncachable and kick the call off the
+        # compiled-eager path (scalar scales are floats — static key)
         a_s = self.act_scale
         qmax = float(2 ** (self.bit_length - 1) - 1)
+        per_channel = self.per_channel
+        scalar_ws = None if per_channel else self.weight_scale
 
-        # differentiable operands (x[, bias]) come first, the int8 weight
-        # last and outside n_diff (int weights have no gradient; the bias
-        # must keep one)
-        if self.bias is not None:
-            def fn(xv, b, w8):
-                if a_s is not None:
-                    xv = jnp.clip(jnp.round(xv / a_s), -qmax - 1, qmax) * a_s
-                return xv @ (w8.astype(xv.dtype) * ws) + b
-
-            return op_call(fn, x, self.bias, self.w_int8,
-                           name="quantized_linear", n_diff=2)
-
-        def fn(xv, w8):
+        def fn(xv, *rest):
+            # rest = ([bias], w8, [ws_vec]) — parsed from the back
+            ws = rest[-1] if per_channel else scalar_ws
+            w8 = rest[-2] if per_channel else rest[-1]
+            b = rest[0] if len(rest) == (3 if per_channel else 2) else None
             if a_s is not None:
-                xv = jnp.clip(jnp.round(xv / a_s), -qmax - 1, qmax) * a_s
-            return xv @ (w8.astype(xv.dtype) * ws)
+                # full-int8: both operands int8, MXU accumulates in int32
+                x8 = jnp.clip(jnp.round(xv / a_s), -qmax - 1, qmax
+                              ).astype(jnp.int8)
+                acc = jax.lax.dot_general(
+                    x8, w8, (((x8.ndim - 1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                out = acc.astype(xv.dtype) * (a_s * ws)
+            else:
+                out = xv @ (w8.astype(xv.dtype) * ws)
+            return out if b is None else out + b
 
-        return op_call(fn, x, self.w_int8, name="quantized_linear", n_diff=1)
+        args = [x] + ([self.bias] if self.bias is not None else []) + \
+            [self.w_int8] + \
+            ([self.weight_scale] if per_channel else [])
+        return op_call(fn, *args, name="quantized_linear",
+                       n_diff=2 if self.bias is not None else 1)
 
 
 class PTQ:
