@@ -152,7 +152,7 @@ def bench_bert(iters=8, batch=32, seq=128):
             "achieved_tflops": flops / 1e12, "n_params": n_params}
 
 
-def bench_gpt_medium_sharding(iters=6, batch=8, seq=1024):
+def bench_gpt_medium_sharding(iters=6, batch=4, seq=1024):
     """Config-4: GPT-3-medium (~350M) with the ZeRO-2 (os_g) group-sharded
     machinery engaged — single-chip degenerate run: the sharding optimizer,
     reduce-scatter paths, and param-group plumbing all execute over a
@@ -240,7 +240,7 @@ def bench_llama_train(iters=6, batch=16, seq=1024, amp=True):
             "n_params": n_params}
 
 
-def bench_llama_1b(iters=4, batch=8, seq=1024):
+def bench_llama_1b(iters=4, batch=4, seq=1024):
     """Config-5 at REAL scale: ~1.14B params on one v5e chip — bf16 params
     (amp.decorate O2), bf16 AdamW moments, per-block recompute. 16 GB HBM
     budget: 2.3 (p) + 2.3 (m) + 2.3 (v) + 2.3 (grads) + activations."""
@@ -351,7 +351,7 @@ ALL = {
     "resnet50_bf16": lambda: bench_resnet50(amp=True),
     "bert": bench_bert,
     "gpt_sharding": bench_gpt_medium_sharding,
-    "llama": lambda: bench_llama_train(amp=False),
+    "llama": lambda: bench_llama_train(batch=8, amp=False),
     "llama_bf16": bench_llama_train,
     "llama_1b": bench_llama_1b,
     "eager": bench_eager_dispatch,
@@ -382,6 +382,7 @@ def run_one(name):
     t0 = time.perf_counter()
     res = ALL[name]()
     res["wall_s"] = round(time.perf_counter() - t0, 1)
+    res["platform"] = jax.devices()[0].platform
     print("BENCH_RESULT " + json.dumps(res))
 
 
@@ -389,7 +390,9 @@ def main(argv):
     import os
     import subprocess
 
-    import jax
+    # NOTE: the parent must NOT import/initialize jax — a live parent TPU
+    # client would hold HBM for the whole ladder and shrink what each
+    # per-config subprocess can allocate
 
     # default run = the BASELINE.md ladder + the bf16 variants (bf16 is the
     # native TPU training dtype — the judge-facing perf evidence)
@@ -397,8 +400,13 @@ def main(argv):
                "llama", "llama_bf16", "llama_1b", "eager", "eager_host",
                "fused_adam"]
     which = [a.lstrip("-") for a in argv if a.lstrip("-") in ALL] or default
-    details = {"platform": jax.devices()[0].platform,
-               "device_count": jax.device_count(), "results": {}}
+    details = {"platform": "per-config subprocess", "results": {}}
+    if os.path.exists("BENCH_DETAILS.json"):
+        try:  # partial reruns MERGE into the existing ladder results
+            with open("BENCH_DETAILS.json") as f:
+                details["results"] = json.load(f).get("results", {})
+        except Exception:
+            pass
     here = os.path.dirname(os.path.abspath(__file__))
     for name in which:
         # one SUBPROCESS per config: each starts with an empty chip (the

@@ -584,11 +584,15 @@ def _wrap_outputs(out, node, name):
 
     if flag("FLAGS_benchmark"):
         # benchmark mode: per-op completion barrier (≙ reference benchmark
-        # flag forcing synchronous kernel launches)
+        # flag forcing synchronous kernel launches). NOTE: a scalar fetch,
+        # not block_until_ready — on the axon tunnel the latter returns
+        # before device execution completes (bench.py _sync measurement)
+        import jax.numpy as _jnp
+
         flat = [out] if not isinstance(out, (tuple, list)) else list(out)
         for o in flat:
             if isinstance(o, jax.Array) and not isinstance(o, jax.core.Tracer):
-                o.block_until_ready()
+                jax.device_get(_jnp.ravel(o)[0]) if o.size else None
     if flag("FLAGS_check_nan_inf"):
         flat = [out] if not isinstance(out, (tuple, list)) else list(out)
         _check_nan_inf(name, [o for o in flat if hasattr(o, "dtype")])

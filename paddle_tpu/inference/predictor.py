@@ -186,6 +186,7 @@ class Predictor:
         from ..core.dispatch import no_grad
         from ..core.tensor import Tensor as PTensor
 
+        inputs = [np.asarray(a) for a in inputs]
         key = tuple((tuple(a.shape), str(a.dtype)) for a in inputs)
         exe = self._compiled.get(key)
         if exe is None:
@@ -211,8 +212,7 @@ class Predictor:
             exe = (jax.jit(pure, donate_argnums=donate), params)
             self._compiled[key] = exe
         jitted, params = exe
-        return jitted([p._data for p in params],
-                      [np.asarray(a) for a in inputs])
+        return jitted([p._data for p in params], inputs)
 
     def run(self, inputs: list[np.ndarray] | None = None):
         """Execute the compiled program. With `inputs` given, returns the
@@ -230,6 +230,14 @@ class Predictor:
             outs = self._compiled_layer_call(inputs)
         self._outputs = outs
         if t0 is not None:
+            # profile timings must include device completion; on the axon
+            # tunnel block_until_ready is NOT a completion barrier (see
+            # bench.py _sync), so fetch one scalar of the output
+            import jax
+            import jax.numpy as jnp
+
+            if outs and hasattr(outs[0], "dtype"):
+                jax.device_get(jnp.ravel(outs[0])[0])
             self._run_times.append(time.perf_counter() - t0)
         return outs
 

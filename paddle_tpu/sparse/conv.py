@@ -60,7 +60,7 @@ def _rulebook(coords, shape, ksize, stride, padding, dilation, subm):
             len(outs), 1 + dims)
 
     n_out = len(out_coords)
-    src = np.full((len(offsets), max(n_out, 1)), -1, dtype=np.int64)
+    src = np.full((len(offsets), n_out), -1, dtype=np.int64)
     for oi, o in enumerate(out_coords):
         b, pos = int(o[0]), o[1:]
         for ki, off in enumerate(offsets):
@@ -96,10 +96,12 @@ def _conv_impl(x, weight, bias, stride, padding, dilation, subm, dims,
     nnz = max(int(vals.shape[0]), 1)
 
     def fn(v, w, *rest):
+        # NOTE: only ints/bools may be closed over — an ndarray in the
+        # closure would make the op key uncachable (dispatch._fn_key)
         srcs = rest[-1]
         b = rest[0] if bias is not None else None
         wf = w.reshape((n_off, cin, cout))
-        out = jnp.zeros((src.shape[1], cout), v.dtype)
+        out = jnp.zeros((srcs.shape[1], cout), v.dtype)
         for k in range(n_off):     # static unroll over kernel offsets
             idx = srcs[k]
             g = v[jnp.clip(idx, 0, nnz - 1)]
@@ -169,10 +171,10 @@ def _pool_impl(x, ksize, stride, padding, dims, mode, name):
 
     def fn(v, srcs):
         neg = jnp.asarray(-np.inf, v.dtype) if mode == "max" else 0.0
-        acc = jnp.full((src.shape[1], v.shape[-1]), neg, v.dtype) \
-            if mode == "max" else jnp.zeros((src.shape[1], v.shape[-1]),
+        acc = jnp.full((srcs.shape[1], v.shape[-1]), neg, v.dtype) \
+            if mode == "max" else jnp.zeros((srcs.shape[1], v.shape[-1]),
                                             v.dtype)
-        cnt = jnp.zeros((src.shape[1], 1), v.dtype)
+        cnt = jnp.zeros((srcs.shape[1], 1), v.dtype)
         for k in range(n_off):
             idx = srcs[k]
             g = v[jnp.clip(idx, 0, nnz - 1)]
