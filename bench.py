@@ -119,8 +119,9 @@ def bench_resnet50(iters=10, batch=64, image=224, amp=False):
             "step_ms": dt * 1e3, "batch": batch, "achieved_tflops": flops / 1e12}
 
 
-def bench_bert(iters=8, batch=32, seq=128):
-    """Config-3: BERT-base fine-tune step, to_static, single device."""
+def bench_bert(iters=8, batch=32, seq=128, amp=False):
+    """Config-3: BERT-base fine-tune step, to_static, single device;
+    amp=True fine-tunes under bf16 autocast (O2)."""
     import paddle_tpu as paddle
     from paddle_tpu.text.models import BertConfig, BertForSequenceClassification
 
@@ -134,7 +135,8 @@ def bench_bert(iters=8, batch=32, seq=128):
 
     @paddle.jit.to_static(share_discovery=True)
     def train_step(x, y):
-        loss = model(x, labels=y)
+        with paddle.amp.auto_cast(enable=amp, dtype="bfloat16", level="O2"):
+            loss = model(x, labels=y)
         loss.backward()
         opt.step()
         opt.clear_grad()
@@ -147,7 +149,8 @@ def bench_bert(iters=8, batch=32, seq=128):
     dt = _timeit(lambda: train_step(ids, lab), iters=iters, warmup=3)
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     flops = 6 * n_params * batch * seq / dt
-    return {"name": "bert_base_finetune", "sequences_per_sec": batch / dt,
+    name = "bert_base_finetune_bf16" if amp else "bert_base_finetune"
+    return {"name": name, "sequences_per_sec": batch / dt,
             "step_ms": dt * 1e3, "batch": batch, "seq": seq,
             "achieved_tflops": flops / 1e12, "n_params": n_params}
 
@@ -240,7 +243,7 @@ def bench_llama_train(iters=6, batch=16, seq=1024, amp=True):
             "n_params": n_params}
 
 
-def bench_llama_1b(iters=4, batch=4, seq=1024):
+def bench_llama_1b(iters=4, batch=2, seq=1024):
     """Config-5 at REAL scale: ~1.14B params on one v5e chip — bf16 params
     (amp.decorate O2), bf16 AdamW moments, per-block recompute. 16 GB HBM
     budget: 2.3 (p) + 2.3 (m) + 2.3 (v) + 2.3 (grads) + activations."""
@@ -350,6 +353,7 @@ ALL = {
     "resnet50": bench_resnet50,
     "resnet50_bf16": lambda: bench_resnet50(amp=True),
     "bert": bench_bert,
+    "bert_bf16": lambda: bench_bert(amp=True),
     "gpt_sharding": bench_gpt_medium_sharding,
     "llama": lambda: bench_llama_train(batch=8, amp=False),
     "llama_bf16": bench_llama_train,
@@ -396,7 +400,8 @@ def main(argv):
 
     # default run = the BASELINE.md ladder + the bf16 variants (bf16 is the
     # native TPU training dtype — the judge-facing perf evidence)
-    default = ["lenet", "resnet50", "resnet50_bf16", "bert", "gpt_sharding",
+    default = ["lenet", "resnet50", "resnet50_bf16", "bert", "bert_bf16",
+               "gpt_sharding",
                "llama", "llama_bf16", "llama_1b", "eager", "eager_host",
                "fused_adam"]
     which = [a.lstrip("-") for a in argv if a.lstrip("-") in ALL] or default

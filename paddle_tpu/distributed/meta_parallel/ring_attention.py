@@ -18,6 +18,11 @@ Both are designed to be called INSIDE `shard_map` (or any context where the
 `sep` axis name is bound) on paddle-layout [batch, seq_local, heads, head_dim]
 shards, and are exact: numerics match full attention on the gathered sequence
 (tests/test_ring_attention.py).
+
+On TPU, `ulysses_attention`'s local attention (where its FLOPs live) rides
+the Pallas flash kernel for seq >= 256; pass `check_vma=False` to
+`jax.shard_map` when using it (pallas_call's out_shape carries no vma info —
+verified working on a real v5e).
 """
 from __future__ import annotations
 
@@ -112,15 +117,24 @@ def ulysses_attention(q, k, v, axis_name, causal=False):
     qt = jnp.swapaxes(qg, 1, 2)
     kt = jnp.swapaxes(kg, 1, 2)
     vt = jnp.swapaxes(vg, 1, 2)
-    s = _block_scores(qt, kt, 1.0 / math.sqrt(qt.shape[-1]))
-    if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        s = jnp.where(mask, s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    o = jax.lax.dot_general(
-        p, vt.astype(jnp.float32), (((3,), (2,)), ((0, 1), (0, 1))),
-        preferred_element_type=jnp.float32).astype(q.dtype)
-    og = jnp.swapaxes(o, 1, 2)                            # [B, S, H/n, D]
+    # the local attention over the FULL sequence is where ulysses spends its
+    # FLOPs — ride the Pallas flash kernel on TPU (long sequences are the
+    # whole point of the sep axis); small/odd shapes fall back to dense
+    if jax.default_backend() == "tpu" and qt.shape[2] >= 256 and \
+            qt.shape[2] % 128 == 0:
+        from ...ops.pallas_attention import flash_attention_raw
+
+        o = flash_attention_raw(qt, kt, vt, causal=causal).astype(jnp.float32)
+    else:
+        s = _block_scores(qt, kt, 1.0 / math.sqrt(qt.shape[-1]))
+        if causal:
+            sq, sk = s.shape[-2], s.shape[-1]
+            mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jax.lax.dot_general(
+            p, vt.astype(jnp.float32), (((3,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32)
+    og = jnp.swapaxes(o.astype(q.dtype), 1, 2)            # [B, S, H/n, D]
     return jax.lax.all_to_all(og, axis_name, split_axis=1,
                               concat_axis=2, tiled=True)  # [B, Sl, H, D]
