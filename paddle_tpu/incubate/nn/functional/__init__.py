@@ -402,3 +402,6 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
             out = F.layer_norm(out, out.shape[-1:], weight=ffn_ln_scales[i],
                                bias=ffn_ln_biases[i], epsilon=epsilon)
     return out, cache_kvs
+
+
+from .fused_loss import fused_linear_cross_entropy  # noqa: E402,F401

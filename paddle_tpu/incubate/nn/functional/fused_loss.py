@@ -1,0 +1,117 @@
+"""Fused linear + softmax-cross-entropy over vocab chunks.
+
+Reference parity: the fusion-library's softmax-with-cross-entropy kernels
+(/root/reference/paddle/phi/kernels/fusion/, cross_entropy_with_softmax) —
+the memory-bound tail of an LLM train step. TPU-native design: the lm_head
+GEMM and the CE reduction run chunk-by-chunk over the vocab inside one
+`lax.scan`, so the [tokens, vocab] logits tensor is NEVER materialized in
+HBM (at [16k, 32k] fp32 that is ~2 GB of traffic saved per direction);
+forward keeps only the online logsumexp state, backward recomputes each
+chunk's logits and emits (softmax - onehot) chunk-wise via a custom vjp.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fused_linear_cross_entropy"]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flce(h, w, labels, chunk):
+    loss, _ = _flce_fwd_impl(h, w, labels, chunk)
+    return loss
+
+
+def _flce_fwd_impl(h, w, labels, chunk):
+    n, hid = h.shape
+    v = w.shape[1]
+    nchunks = v // chunk
+    hf = h.astype(jnp.float32)
+
+    def step(carry, i):
+        m, s, lab_logit = carry
+        wc = jax.lax.dynamic_slice(w, (0, i * chunk), (hid, chunk))
+        logits = hf @ wc.astype(jnp.float32)               # [N, chunk]
+        cm = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, cm)
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        local = labels - i * chunk
+        inside = (local >= 0) & (local < chunk)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, chunk - 1)[:, None], axis=1)[:, 0]
+        lab_logit = jnp.where(inside, picked, lab_logit)
+        return (m_new, s, lab_logit), None
+
+    m0 = jnp.full((n,), -jnp.inf, jnp.float32)
+    s0 = jnp.zeros((n,), jnp.float32)
+    (m, s, lab_logit), _ = jax.lax.scan(
+        step, (m0, s0, jnp.zeros((n,), jnp.float32)), jnp.arange(nchunks))
+    lse = m + jnp.log(s)
+    loss = jnp.mean(lse - lab_logit)
+    return loss, (h, w, labels, lse)
+
+
+def _flce_fwd(h, w, labels, chunk):
+    loss, res = _flce_fwd_impl(h, w, labels, chunk)
+    return loss, res
+
+
+def _flce_bwd(chunk, res, g):
+    h, w, labels, lse = res
+    n, hid = h.shape
+    v = w.shape[1]
+    nchunks = v // chunk
+    hf = h.astype(jnp.float32)
+    scale = g / n
+
+    def step(dh, i):
+        wc = jax.lax.dynamic_slice(w, (0, i * chunk), (hid, chunk))
+        wcf = wc.astype(jnp.float32)
+        logits = hf @ wcf
+        p = jnp.exp(logits - lse[:, None])                 # softmax chunk
+        local = labels - i * chunk
+        inside = (local >= 0) & (local < chunk)
+        onehot = (jax.nn.one_hot(jnp.clip(local, 0, chunk - 1), chunk,
+                                 dtype=jnp.float32)
+                  * inside[:, None].astype(jnp.float32))
+        dlog = (p - onehot) * scale                        # [N, chunk]
+        dwc = hf.T @ dlog                                  # [H, chunk]
+        dh = dh + dlog @ wcf.T
+        return dh, dwc.astype(w.dtype)
+
+    dh, dws = jax.lax.scan(step, jnp.zeros((n, hid), jnp.float32),
+                           jnp.arange(nchunks))
+    # dws: [nchunks, H, chunk] -> [H, V]
+    dw = jnp.moveaxis(dws, 0, 1).reshape(hid, v)
+    return dh.astype(h.dtype), dw, None
+
+
+_flce.defvjp(_flce_fwd, _flce_bwd)
+
+
+def fused_linear_cross_entropy(hidden, weight, labels, chunk_size=8192,
+                               name=None):
+    """loss = mean CE(softmax(hidden @ weight), labels) without ever
+    materializing the [tokens, vocab] logits. hidden [..., H] flattens to
+    [N, H]; weight [H, V]; labels [...] int. Falls back to the plain path
+    when vocab isn't chunkable (V % chunk != 0 after clamping)."""
+    from ....core.dispatch import op_call
+    from ....nn import functional as F
+
+    v = int(weight.shape[-1])
+    chunk = min(int(chunk_size), v)
+    if v % chunk:
+        logits = hidden.reshape([-1, int(weight.shape[0])]).matmul(weight)
+        return F.cross_entropy(logits, labels.reshape([-1]),
+                               reduction="mean")
+
+    def fn(h2, w2, lab):
+        hh = h2.reshape(-1, h2.shape[-1])
+        return _flce(hh, w2, lab.reshape(-1).astype(jnp.int32), chunk)
+
+    return op_call(fn, hidden, weight, labels,
+                   name="fused_linear_cross_entropy", n_diff=2)

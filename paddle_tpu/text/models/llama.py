@@ -218,6 +218,17 @@ class LlamaForCausalLM(nn.Layer):
         import paddle_tpu as paddle
 
         hidden = self.model(input_ids, attn_mask)
+        if labels is not None and self.lm_head is not None and \
+                not self.config.tensor_parallel and \
+                self.config.vocab_size >= 4096 and \
+                self.config.vocab_size % 4096 == 0:
+            # fused lm_head+CE: the [tokens, vocab] logits tensor is never
+            # materialized (incubate/nn/functional/fused_loss.py) — the
+            # memory-bound tail of the train step
+            from ...incubate.nn.functional import fused_linear_cross_entropy
+
+            return fused_linear_cross_entropy(
+                hidden, self.lm_head.weight, labels, chunk_size=4096)
         if self.lm_head is None:
             logits = paddle.matmul(hidden, self.model.embed_tokens.weight,
                                    transpose_y=True)

@@ -458,3 +458,67 @@ class TestQuantizedExecution:
         got = q(x).numpy()
         # per-channel is tighter than per-tensor on skewed channels
         assert np.abs(got - ref).max() < 0.02 * np.abs(ref).max() + 0.01
+
+
+class TestFusedLinearCrossEntropy:
+    """Chunked lm_head+CE (≙ fusion cross_entropy_with_softmax kernels):
+    exact loss and grads WITHOUT materializing [tokens, vocab] logits."""
+
+    def _setup(self, n=12, h=16, v=64, seed=0):
+        rs = np.random.RandomState(seed)
+        hid = rs.randn(n, h).astype("float32")
+        w = rs.randn(h, v).astype("float32") * 0.1
+        lab = rs.randint(0, v, (n,)).astype("int64")
+        return hid, w, lab
+
+    def _plain(self, hid, w, lab):
+        import paddle_tpu.nn.functional as F
+
+        ht = paddle.to_tensor(hid); ht.stop_gradient = False
+        wt = paddle.to_tensor(w); wt.stop_gradient = False
+        loss = F.cross_entropy(ht.matmul(wt), paddle.to_tensor(lab),
+                               reduction="mean")
+        loss.backward()
+        return float(loss), np.asarray(ht.grad._data), np.asarray(wt.grad._data)
+
+    def test_exact_vs_plain(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        hid, w, lab = self._setup()
+        want_l, want_dh, want_dw = self._plain(hid, w, lab)
+        ht = paddle.to_tensor(hid); ht.stop_gradient = False
+        wt = paddle.to_tensor(w); wt.stop_gradient = False
+        loss = IF.fused_linear_cross_entropy(ht, wt, paddle.to_tensor(lab),
+                                             chunk_size=16)
+        loss.backward()
+        np.testing.assert_allclose(float(loss), want_l, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(ht.grad._data), want_dh,
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(wt.grad._data), want_dw,
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_3d_hidden_and_fallback(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        hid, w, lab = self._setup(n=12, v=60)  # 60 % 16 != 0 → fallback
+        ht = paddle.to_tensor(hid.reshape(3, 4, 16))
+        loss = IF.fused_linear_cross_entropy(
+            ht, paddle.to_tensor(w), paddle.to_tensor(lab.reshape(3, 4)),
+            chunk_size=16)
+        want, _, _ = self._plain(hid, w, lab)
+        np.testing.assert_allclose(float(loss), want, rtol=1e-5)
+
+    def test_under_to_static(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        hid, w, lab = self._setup(n=8, v=32)
+        wt = paddle.to_tensor(w); wt.stop_gradient = False
+
+        @paddle.jit.to_static
+        def step(h):
+            return IF.fused_linear_cross_entropy(
+                h, wt, paddle.to_tensor(lab[:8]), chunk_size=8)
+
+        ht = paddle.to_tensor(hid)
+        vals = [float(step(ht)) for _ in range(4)]
+        assert all(abs(v - vals[0]) < 1e-5 for v in vals)
