@@ -243,7 +243,7 @@ def bench_llama_train(iters=6, batch=16, seq=1024, amp=True):
             "n_params": n_params}
 
 
-def bench_llama_1b(iters=4, batch=4, seq=1024):
+def bench_llama_1b(iters=4, batch=2, seq=1024):
     """Config-5 at REAL scale: ~1.14B params on one v5e chip — bf16 params
     (amp.decorate O2), bf16 AdamW moments, per-block recompute. 16 GB HBM
     budget: 2.3 (p) + 2.3 (m) + 2.3 (v) + 2.3 (grads) + activations."""
