@@ -920,7 +920,13 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     sc = float(scale) if scale is not None else None
     drop = dropout if training else 0.0
 
+    # flash path: self-attention varlen (cu_q == cu_k) with no dropout uses
+    # the Pallas varlen kernel — key columns mask INSIDE the kernel
+    use_flash = (drop == 0.0 and np.array_equal(cu_q, cu_k) and sq == sk)
+
     def f(qv, kv, vv, iq_, ik_, lk, sid, pos_):
+        import jax as _jax
+
         from .attention import _xla_sdpa
         from ...core.rng import next_key as _nk
 
@@ -930,13 +936,21 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         if sc is not None:
             d = qv.shape[-1]
             qp = qp * jnp.asarray(sc * math.sqrt(d), qp.dtype)
-        kmask = (jnp.arange(sk)[None, :] < lk[:, None])   # [B, Sk]
-        mask = kmask[:, None, None, :]                     # [B, 1, 1, Sk]
-        if causal:
-            tri = jnp.tril(jnp.ones((sq, sk), bool), k=0)
-            mask = mask & tri[None, None, :, :]
-        out = _xla_sdpa(qp, kp, vp, mask, drop, False,
-                        None if drop == 0.0 else _nk())
+        if use_flash and _jax.default_backend() == "tpu":
+            from ...ops.pallas_attention import flash_attention_varlen_raw
+
+            out = flash_attention_varlen_raw(
+                jnp.swapaxes(qp, 1, 2), jnp.swapaxes(kp, 1, 2),
+                jnp.swapaxes(vp, 1, 2), lk, causal=causal)
+            out = jnp.swapaxes(out, 1, 2)
+        else:
+            kmask = (jnp.arange(sk)[None, :] < lk[:, None])   # [B, Sk]
+            mask = kmask[:, None, None, :]                    # [B, 1, 1, Sk]
+            if causal:
+                tri = jnp.tril(jnp.ones((sq, sk), bool), k=0)
+                mask = mask & tri[None, None, :, :]
+            out = _xla_sdpa(qp, kp, vp, mask, drop, False,
+                            None if drop == 0.0 else _nk())
         return out[sid, pos_]             # back to packed [total, H, D]
 
     out = op_call(f, query, key, value, iq, ik, lens_k, seq_id, pos,

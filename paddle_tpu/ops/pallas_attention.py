@@ -53,7 +53,7 @@ def _ceil_to(x: int, m: int) -> int:
 
 
 def _block_dispatch(compute, *, causal, qi, ki, nk, sq, sk,
-                    block_q, block_k):
+                    block_q, block_k, force_masked=False):
     """Shared interior/boundary dispatch for the three flash kernels.
 
     compute(masked): masked=False runs the lean path (no iota/compare/
@@ -61,7 +61,18 @@ def _block_dispatch(compute, *, causal, qi, ki, nk, sq, sk,
     masking; the VPU softmax chain is the kernel's cost). Blocks entirely
     above the diagonal are skipped. `qi`/`ki` are the q-block / kv-block
     program ids; causal visibility is `col <= row + (sk - sq)` (last q row
-    aligned with last kv col)."""
+    aligned with last kv col). force_masked (varlen): the kv bound is a
+    runtime value — every surviving block masks."""
+    if force_masked:
+        if causal:
+            row1_off = qi * block_q + block_q - 1 + (sk - sq)
+
+            @pl.when(ki * block_k <= row1_off)
+            def _fm():
+                compute(True)
+        else:
+            compute(True)
+        return
     sk_aligned = (sk % block_k) == 0
     if causal:
         row0_off = qi * block_q + (sk - sq)
@@ -96,11 +107,19 @@ def _block_dispatch(compute, *, causal, qi, ki, nk, sq, sk,
 
 # ----------------------------------------------------------------- forward
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
-                scale, causal, sq, sk, block_q, block_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs,
+                scale, causal, sq, sk, block_q, block_k, has_lens=False):
     # NOTE: program_id(2) is only materialized under `causal` — Mosaic on
     # real TPUs fails to legalize kernels carrying unused program-id-derived
     # values ('tpu.truncf'/'func.return'), so nothing dead may be traced.
+    # has_lens (varlen): an extra [1,128] lens_ref input carries this
+    # batch's kv length; every block takes the masked path with the dynamic
+    # bound (the flash-varlen kernel the reference ships as a CUDA variant,
+    # flash_attention.py:358).
+    if has_lens:
+        lens_ref, o_ref, lse_ref, acc, m_s, l_s = refs
+    else:
+        o_ref, lse_ref, acc, m_s, l_s = refs
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
     # only bound under causal (used in mask + block-skip predicate): an
@@ -128,7 +147,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
         if masked:
             cols = ki * block_k + \
                 jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = cols < sk
+            if has_lens:
+                mask = cols < lens_ref[0, 0, 0]
+            else:
+                mask = cols < sk
             if causal:
                 # causal offset aligns the last q row with the last kv col
                 rows = qi * block_q + \
@@ -157,7 +179,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
         l_s[:] = jnp.broadcast_to(l_new, l_s.shape)
 
     _block_dispatch(compute, causal=causal, qi=qi, ki=ki, nk=nk,
-                    sq=sq, sk=sk, block_q=block_q, block_k=block_k)
+                    sq=sq, sk=sk, block_q=block_q, block_k=block_k,
+                    force_masked=has_lens)
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -171,20 +194,28 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
             m_s[:, :1] + jnp.log(safe_l), lse_ref[0, 0].shape)
 
 
-def _flash_forward(q, k, v, causal, block_q, block_k):
+def _lens_lanes(lens, b):
+    """[B] int32 kv lengths -> [B, 8, 128] tile-replicated block input
+    (Mosaic requires the last two block dims be (8, 128)-aligned)."""
+    return jnp.broadcast_to(lens.astype(jnp.int32)[:, None, None],
+                            (b, 8, 128))
+
+
+def _flash_forward(q, k, v, causal, block_q, block_k, lens=None):
     """q,k,v: [B, H, S, D] (same H — GQA expanded by caller).
 
     Returns (o [B,H,S,D], lse_lanes [B,H,Sq_padded,1]) — per-row softmax
     stats (lane-replication for the TPU tiling happens inside the kernel
-    and is sliced away here to keep residuals small)."""
+    and is sliced away here to keep residuals small). lens: optional [B]
+    per-batch kv length (varlen)."""
     # paddle_tpu runs jax with x64 enabled; trace the pallas program with
     # x64 OFF so index-map/kernel literals stay i32/f32 (Mosaic cannot
     # legalize stray i64/f64 values on real TPUs)
     with jax.enable_x64(False):
-        return _flash_forward_x32(q, k, v, causal, block_q, block_k)
+        return _flash_forward_x32(q, k, v, causal, block_q, block_k, lens)
 
 
-def _flash_forward_x32(q, k, v, causal, block_q, block_k):
+def _flash_forward_x32(q, k, v, causal, block_q, block_k, lens=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     scale = 1.0 / math.sqrt(d)
@@ -195,18 +226,25 @@ def _flash_forward_x32(q, k, v, causal, block_q, block_k):
     kp = jnp.pad(k, ((0, 0), (0, 0), (0, sk_p - sk), (0, d_p - d)))
     vp = jnp.pad(v, ((0, 0), (0, 0), (0, sk_p - sk), (0, d_p - d)))
     nq, nk = sq_p // block_q, sk_p // block_k
+    has_lens = lens is not None
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, sq=sq, sk=sk,
-        block_q=block_q, block_k=block_k)
+        block_q=block_q, block_k=block_k, has_lens=has_lens)
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d_p), lambda b, h, qi, ki: (b, h, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, d_p), lambda b, h, qi, ki: (b, h, ki, 0)),
+        pl.BlockSpec((1, 1, block_k, d_p), lambda b, h, qi, ki: (b, h, ki, 0)),
+    ]
+    args = [qp, kp, vp]
+    if has_lens:
+        in_specs.append(
+            pl.BlockSpec((1, 8, 128), lambda b, h, qi, ki: (b, 0, 0)))
+        args.append(_lens_lanes(lens, b))
     o, lse = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d_p), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d_p), lambda b, h, qi, ki: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d_p), lambda b, h, qi, ki: (b, h, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d_p), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 128), lambda b, h, qi, ki: (b, h, qi, 0)),
@@ -221,15 +259,19 @@ def _flash_forward_x32(q, k, v, causal, block_q, block_k):
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
         interpret=_interpret(),
-    )(qp, kp, vp)
+    )(*args)
     # keep one lane in the residuals (128x smaller); backward re-broadcasts
     return o[:, :, :sq, :d], lse[:, :, :, :1]
 
 
 # ----------------------------------------------------------------- backward
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale, causal, sq, sk, block_q, block_k):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+                   scale, causal, sq, sk, block_q, block_k, has_lens=False):
+    if has_lens:
+        lens_ref, dq_ref, dq_acc = refs
+    else:
+        dq_ref, dq_acc = refs
     # like _fwd_kernel: nothing dead may be traced (Mosaic legalization)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -248,7 +290,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         if masked:
             cols = ki * block_k + \
                 jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = cols < sk
+            mask = (cols < lens_ref[0, 0, 0]) if has_lens else (cols < sk)
             if causal:
                 rows = qi * block_q + \
                     jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
@@ -267,16 +309,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     _block_dispatch(compute, causal=causal, qi=qi, ki=ki, nk=nk,
-                    sq=sq, sk=sk, block_q=block_q, block_k=block_k)
+                    sq=sq, sk=sk, block_q=block_q, block_k=block_k,
+                    force_masked=has_lens)
 
     @pl.when(ki == nk - 1)
     def _finish():
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *,
-                    scale, causal, sq, sk, block_q, block_k):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+                    scale, causal, sq, sk, block_q, block_k, has_lens=False):
+    if has_lens:
+        lens_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = refs
     # grid here is (b, h, ki, qi): kv blocks outer, q blocks inner
     ki = pl.program_id(2)
     qi = pl.program_id(3)
@@ -298,7 +344,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, 0][:, :1]
         if masked:
             cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = cols < sk
+            mask = (cols < lens_ref[0, 0, 0]) if has_lens else (cols < sk)
             if causal:
                 rows = qi * block_q + \
                     jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
@@ -323,7 +369,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     _block_dispatch(compute, causal=causal, qi=qi, ki=ki, nk=nk,
-                    sq=sq, sk=sk, block_q=block_q, block_k=block_k)
+                    sq=sq, sk=sk, block_q=block_q, block_k=block_k,
+                    force_masked=has_lens)
 
     @pl.when(qi == nq - 1)
     def _finish():
@@ -332,13 +379,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, o, lse_lanes, do, causal, block_q, block_k):
+def _flash_backward(q, k, v, o, lse_lanes, do, causal, block_q, block_k,
+                    lens=None):
     with jax.enable_x64(False):  # see _flash_forward
         return _flash_backward_x32(q, k, v, o, lse_lanes, do, causal,
-                                   block_q, block_k)
+                                   block_q, block_k, lens)
 
 
-def _flash_backward_x32(q, k, v, o, lse_lanes, do, causal, block_q, block_k):
+def _flash_backward_x32(q, k, v, o, lse_lanes, do, causal, block_q, block_k,
+                        lens=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     scale = 1.0 / math.sqrt(d)
@@ -356,37 +405,43 @@ def _flash_backward_x32(q, k, v, o, lse_lanes, do, causal, block_q, block_k):
         (b, h, sq_p, 128))
     nq, nk = sq_p // block_q, sk_p // block_k
 
+    has_lens = lens is not None
     common = dict(scale=scale, causal=causal, sq=sq, sk=sk,
-                  block_q=block_q, block_k=block_k)
+                  block_q=block_q, block_k=block_k, has_lens=has_lens)
     q_spec = pl.BlockSpec((1, 1, block_q, d_p), lambda b, h, qi, ki: (b, h, qi, 0))
     k_spec = pl.BlockSpec((1, 1, block_k, d_p), lambda b, h, qi, ki: (b, h, ki, 0))
     r_spec = pl.BlockSpec((1, 1, block_q, 128), lambda b, h, qi, ki: (b, h, qi, 0))
+    lens_spec = pl.BlockSpec((1, 8, 128), lambda b, h, qi, ki: (b, 0, 0))
+    extra = [_lens_lanes(lens, b)] if has_lens else []
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
         grid=(b, h, nq, nk),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec]
+        + ([lens_spec] if has_lens else []),
         out_specs=[q_spec],
         out_shape=[jax.ShapeDtypeStruct((b, h, sq_p, d_p), q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d_p), jnp.float32)],
         interpret=_interpret(),
-    )(qp, kp, vp, dop, lsep, deltap)[0]
+    )(qp, kp, vp, dop, lsep, deltap, *extra)[0]
 
     # dkv kernel: kv blocks outer, q blocks inner
     q_spec2 = pl.BlockSpec((1, 1, block_q, d_p), lambda b, h, ki, qi: (b, h, qi, 0))
     k_spec2 = pl.BlockSpec((1, 1, block_k, d_p), lambda b, h, ki, qi: (b, h, ki, 0))
     r_spec2 = pl.BlockSpec((1, 1, block_q, 128), lambda b, h, ki, qi: (b, h, qi, 0))
+    lens_spec2 = pl.BlockSpec((1, 8, 128), lambda b, h, ki, qi: (b, 0, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
         grid=(b, h, nk, nq),
-        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2]
+        + ([lens_spec2] if has_lens else []),
         out_specs=[k_spec2, k_spec2],
         out_shape=[jax.ShapeDtypeStruct((b, h, sk_p, d_p), k.dtype),
                    jax.ShapeDtypeStruct((b, h, sk_p, d_p), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d_p), jnp.float32),
                         pltpu.VMEM((block_k, d_p), jnp.float32)],
         interpret=_interpret(),
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(qp, kp, vp, dop, lsep, deltap, *extra)
     return (dq[:, :, :sq, :d], dk[:, :, :sk, :d], dv[:, :, :sk, :d])
 
 
@@ -411,6 +466,27 @@ def _flash_bwd_rule(causal, block_q, block_k, res, g):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_varlen(q, k, v, lens, causal, block_q, block_k):
+    o, _ = _flash_forward(q, k, v, causal, block_q, block_k, lens=lens)
+    return o
+
+
+def _flash_varlen_fwd(q, k, v, lens, causal, block_q, block_k):
+    o, lse = _flash_forward(q, k, v, causal, block_q, block_k, lens=lens)
+    return o, (q, k, v, o, lse, lens)
+
+
+def _flash_varlen_bwd(causal, block_q, block_k, res, g):
+    q, k, v, o, lse, lens = res
+    dq, dk, dv = _flash_backward(q, k, v, o, lse, g, causal, block_q,
+                                 block_k, lens=lens)
+    return dq, dk, dv, jnp.zeros(lens.shape, jax.dtypes.float0)
+
+
+_flash_varlen.defvjp(_flash_varlen_fwd, _flash_varlen_bwd)
+
+
 def flash_attention_raw(q, k, v, causal=False,
                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
     """jax-level flash attention on [B, H, S, D] arrays (GQA expanded here)."""
@@ -422,6 +498,25 @@ def flash_attention_raw(q, k, v, causal=False,
     bq = min(block_q, _ceil_to(q.shape[2], 128))
     bk = min(block_k, _ceil_to(k.shape[2], 128))
     return _flash(q, k, v, causal, bq, bk)
+
+
+def flash_attention_varlen_raw(q, k, v, kv_lens, causal=False,
+                               block_q=DEFAULT_BLOCK_Q,
+                               block_k=DEFAULT_BLOCK_K):
+    """Varlen flash: [B, H, S, D] padded batch + [B] int32 kv lengths —
+    key columns >= kv_lens[b] are masked INSIDE the kernel (the flash-varlen
+    path the reference ships as a CUDA variant, flash_attention.py:358).
+    Query rows beyond a sequence's length produce zeros; callers drop them.
+    """
+    hq, hk = q.shape[1], k.shape[1]
+    if hq != hk:
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    bq = min(block_q, _ceil_to(q.shape[2], 128))
+    bk = min(block_k, _ceil_to(k.shape[2], 128))
+    return _flash_varlen(q, k, v, jnp.asarray(kv_lens, jnp.int32), causal,
+                         bq, bk)
 
 
 def flash_attention_op(query, key, value, is_causal=False):
