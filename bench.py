@@ -52,6 +52,30 @@ def _timeit(step, iters=10, warmup=3):
     return (time.perf_counter() - t0) / iters
 
 
+def _timeit_median(step, iters=5, groups=5, warmup=3):
+    """Steadied protocol for host-jitter-sensitive (eager) configs: time
+    `groups` independent groups of `iters` steps, drop the min/max group,
+    return (median_dt, spread) where spread = (max-min)/median over the
+    kept groups. Eager throughput on a shared host swings run-to-run
+    (round 3 saw 7x: 314 vs 2244 img/s); median-of-groups makes the
+    reported number reproducible."""
+    for _ in range(warmup):
+        out = step()
+        _sync(out)
+    times = []
+    for _ in range(groups):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = step()
+        _sync(out)
+        times.append((time.perf_counter() - t0) / iters)
+    times.sort()
+    kept = times[1:-1] if len(times) > 2 else times
+    med = kept[len(kept) // 2]
+    spread = (kept[-1] - kept[0]) / med if med else 0.0
+    return med, round(spread, 3)
+
+
 def bench_lenet(iters=20):
     """Config-1: LeNet on synthetic MNIST, pure dygraph (per-op dispatch)."""
     import paddle_tpu as paddle
@@ -74,12 +98,13 @@ def bench_lenet(iters=20):
         opt.clear_grad()
         return loss
 
-    dt = _timeit(step, iters=iters, warmup=5)
+    dt, spread = _timeit_median(step, iters=max(4, iters // 4), groups=5,
+                                warmup=4)
     return {"name": "lenet_mnist_dygraph", "images_per_sec": batch / dt,
-            "step_ms": dt * 1e3, "batch": batch}
+            "step_ms": dt * 1e3, "batch": batch, "spread": spread}
 
 
-def bench_resnet50(iters=10, batch=64, image=224, amp=False):
+def bench_resnet50(iters=8, batch=128, image=224, amp=False):
     """Config-2: ResNet-50 train step under to_static (one XLA program);
     amp=True wraps the forward in bf16 autocast. Eager warm-up/discovery
     runs at batch 4 via share_discovery (a full-batch eager fp32 pass would
@@ -274,6 +299,82 @@ def bench_llama_1b(iters=4, batch=2, seq=1024):
             "n_params": n_params}
 
 
+def bench_decode(batch=8, prompt=128, new_tokens=256):
+    """Autoregressive decode throughput: KV-cached generation as ONE
+    compiled XLA program (text/generation.py ≙ masked_multihead_attention's
+    role). Reports decode tokens/sec (excludes prefill via a 2-token
+    calibration run)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                      num_hidden_layers=8, num_attention_heads=16,
+                      max_position_embeddings=prompt + new_tokens + 8)
+    model = LlamaForCausalLM(cfg)
+    model = paddle.amp.decorate(model, level="O2", dtype="bfloat16",
+                                master_weight=False)
+    model.eval()
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, 32000, (batch, prompt)).astype("int64"))
+
+    _sync(model.generate(ids, max_new_tokens=2))        # compile short
+    _sync(model.generate(ids, max_new_tokens=new_tokens))  # compile long
+    t0 = time.perf_counter()
+    out = model.generate(ids, max_new_tokens=new_tokens)
+    _sync(out)
+    t_long = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    _sync(model.generate(ids, max_new_tokens=2))
+    t_short = time.perf_counter() - t0
+    dt = max(t_long - t_short, 1e-6)
+    toks = batch * (new_tokens - 2)
+    return {"name": "llama_168m_bf16_decode", "decode_tokens_per_sec": toks / dt,
+            "ms_per_token_step": dt / (new_tokens - 2) * 1e3,
+            "batch": batch, "prompt": prompt, "new_tokens": new_tokens,
+            "wall_total_s": round(t_long, 2)}
+
+
+def bench_int8(iters=30, m=2048, k=4096, n=4096):
+    """Int8 quantized execution ON THE CHIP (VERDICT r3 Weak #6): the PTQ
+    QuantizedLinear full int8×int8→int32 MXU path vs the same GEMM in bf16.
+    Verifies the quantized path is actually faster/at-parity on real
+    hardware rather than silently dequantizing to float."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.quantization.ptq import QuantizedLinear
+
+    paddle.seed(0)
+    lin = paddle.nn.Linear(k, n)
+    w = np.asarray(lin.weight._data)
+    wscale = float(np.abs(w).max() / 127.0)
+    rs = np.random.RandomState(0)
+    x = rs.randn(m, k).astype("float32")
+    ascale = float(np.abs(x).max() / 127.0)
+    q = QuantizedLinear(lin, wscale, ascale)
+    xt = paddle.to_tensor(x)
+
+    dt_int8 = _timeit(lambda: q(xt), iters=iters, warmup=5)
+
+    wb = jnp.asarray(w.astype("float32"), jnp.bfloat16)
+    xb = jnp.asarray(x, jnp.bfloat16)
+    mm = jax.jit(lambda a, b: a @ b)
+    _ = jax.device_get(jnp.ravel(mm(xb, wb))[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = mm(xb, wb)
+    jax.device_get(jnp.ravel(out)[0])
+    dt_bf16 = (time.perf_counter() - t0) / iters
+
+    tops = 2 * m * k * n
+    return {"name": "int8_quantized_linear", "m_k_n": [m, k, n],
+            "int8_ms": dt_int8 * 1e3, "bf16_ms": dt_bf16 * 1e3,
+            "int8_tops": tops / dt_int8 / 1e12,
+            "bf16_tflops": tops / dt_bf16 / 1e12,
+            "speedup_vs_bf16": round(dt_bf16 / dt_int8, 2)}
+
+
 def bench_eager_dispatch(iters=50):
     """Micro-bench: per-op eager dispatch overhead (matmul chain), the
     SURVEY §7-1 hot loop — measured with the per-op executable cache off
@@ -351,13 +452,15 @@ def bench_eager_host(iters=50):
 ALL = {
     "lenet": bench_lenet,
     "resnet50": bench_resnet50,
-    "resnet50_bf16": lambda: bench_resnet50(amp=True),
+    "resnet50_bf16": lambda: bench_resnet50(batch=256, amp=True),
     "bert": bench_bert,
     "bert_bf16": lambda: bench_bert(amp=True),
     "gpt_sharding": bench_gpt_medium_sharding,
     "llama": lambda: bench_llama_train(batch=8, amp=False),
     "llama_bf16": bench_llama_train,
     "llama_1b": bench_llama_1b,
+    "decode": bench_decode,
+    "int8": bench_int8,
     "eager": bench_eager_dispatch,
     "eager_host": bench_eager_host,
     "fused_adam": bench_fused_adam,
@@ -390,6 +493,38 @@ def run_one(name):
     print("BENCH_RESULT " + json.dumps(res))
 
 
+def _headline(results):
+    """Best-available headline, preferring the flagship. vs_baseline
+    denominators are the round-3 self-measured numbers (BASELINE.md) —
+    the reference publishes no absolute figures, so the baseline is our
+    own prior round (same role as tools/ci_op_benchmark.sh's
+    develop-branch-relative gate). No silent metric substitution: if no
+    llama row has landed yet the metric name says exactly what it is."""
+    ll1b = results.get("llama_1b", {})
+    if "tokens_per_sec" in ll1b:
+        return {"metric": "llama_1b_bf16_tokens_per_sec",
+                "value": round(ll1b["tokens_per_sec"], 0),
+                "unit": "tokens/sec/chip",
+                # vs round-3 self-run: 13078 tok/s = 89.9 TFLOP/s (BASELINE.md)
+                "vs_baseline": round(ll1b["tokens_per_sec"] / 13078.0, 2)}
+    ll = results.get("llama_bf16", {})
+    if "tokens_per_sec" in ll:
+        return {"metric": "llama_168m_bf16_tokens_per_sec",
+                "value": round(ll["tokens_per_sec"], 0),
+                "unit": "tokens/sec/chip",
+                # vs round-3 self-run 83.0k tok/s (BASELINE.md)
+                "vs_baseline": round(ll["tokens_per_sec"] / 83006.0, 2)}
+    for name, baseline in [("gpt_sharding", 26890.0)]:
+        r = results.get(name, {})
+        if "tokens_per_sec" in r:
+            return {"metric": f"{name}_tokens_per_sec_PARTIAL_LADDER",
+                    "value": round(r["tokens_per_sec"], 0),
+                    "unit": "tokens/sec/chip",
+                    "vs_baseline": round(r["tokens_per_sec"] / baseline, 2)}
+    return {"metric": "ladder_incomplete_no_flagship_row", "value": 0.0,
+            "unit": "none", "vs_baseline": 0.0}
+
+
 def main(argv):
     import os
     import subprocess
@@ -398,12 +533,14 @@ def main(argv):
     # client would hold HBM for the whole ladder and shrink what each
     # per-config subprocess can allocate
 
-    # default run = the BASELINE.md ladder + the bf16 variants (bf16 is the
-    # native TPU training dtype — the judge-facing perf evidence)
-    default = ["lenet", "resnet50", "resnet50_bf16", "bert", "bert_bf16",
-               "gpt_sharding",
-               "llama", "llama_bf16", "llama_1b", "eager", "eager_host",
-               "fused_adam"]
+    # default run = the BASELINE.md ladder, FLAGSHIP FIRST: round 3 lost its
+    # headline numbers to a driver timeout because the ladder ran
+    # smallest-first and the llama rows never executed. The flagship rows run
+    # first and the headline JSON is re-printed after EVERY config, so a
+    # timeout's captured tail still carries the best-so-far headline.
+    default = ["llama_1b", "llama_bf16", "llama", "gpt_sharding",
+               "bert_bf16", "resnet50_bf16", "bert", "resnet50", "lenet",
+               "decode", "int8", "eager", "eager_host", "fused_adam"]
     which = [a.lstrip("-") for a in argv if a.lstrip("-") in ALL] or default
     details = {"platform": "per-config subprocess", "results": {}}
     if os.path.exists("BENCH_DETAILS.json"):
@@ -413,45 +550,41 @@ def main(argv):
         except Exception:
             pass
     here = os.path.dirname(os.path.abspath(__file__))
+    which = [n for n in which if n in ALL]
     for name in which:
         # one SUBPROCESS per config: each starts with an empty chip (the
         # reference op-benchmark harness isolates runs the same way; a prior
         # config's pinned buffers or a previous OOM can't poison the next)
-        r = subprocess.run(
-            [sys.executable, "-c",
-             f"import sys; sys.path.insert(0, {here!r}); "
-             f"import bench; bench.run_one({name!r})"],
-            capture_output=True, text=True, cwd=here, timeout=3000)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 f"import sys; sys.path.insert(0, {here!r}); "
+                 f"import bench; bench.run_one({name!r})"],
+                capture_output=True, text=True, cwd=here, timeout=1800)
+            rc, out, err = r.returncode, r.stdout, r.stderr
+        except subprocess.TimeoutExpired as e:
+            rc = 124
+            out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) \
+                else (e.stdout or "")
+            err = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) \
+                else (e.stderr or "")
         res = None
-        for ln in r.stdout.splitlines():
+        for ln in out.splitlines():
             if ln.startswith("BENCH_RESULT "):
                 res = json.loads(ln[len("BENCH_RESULT "):])
         if res is not None:
             details["results"][name] = res
             print(f"[bench] {name}: {res}", file=sys.stderr)
         else:
-            tail = (r.stderr or r.stdout).strip().splitlines()[-3:]
-            details["results"][name] = {"error": " | ".join(tail),
-                                        "rc": r.returncode}
-            print(f"[bench] {name} FAILED rc={r.returncode}: {tail}",
-                  file=sys.stderr)
+            tail = ((err or out).strip().splitlines() or ["<no output>"])[-3:]
+            details["results"][name] = {"error": " | ".join(tail), "rc": rc}
+            print(f"[bench] {name} FAILED rc={rc}: {tail}", file=sys.stderr)
 
-    with open("BENCH_DETAILS.json", "w") as f:
-        json.dump(details, f, indent=2)
-
-    ll = details["results"].get("llama_bf16", {})
-    if "tokens_per_sec" in ll:
-        headline = {"metric": "llama_168m_bf16_tokens_per_sec",
-                    "value": round(ll["tokens_per_sec"], 0),
-                    "unit": "tokens/sec/chip",
-                    # vs BENCH_r02's best llama row (42.0k tok/s, bf16)
-                    "vs_baseline": round(ll["tokens_per_sec"] / 42040.0, 2)}
-    else:
-        r50 = details["results"].get("resnet50", {})
-        headline = {"metric": "resnet50_train_images_per_sec",
-                    "value": round(r50.get("images_per_sec", 0.0), 2),
-                    "unit": "images/sec/chip", "vs_baseline": 1.0}
-    print(json.dumps(headline))
+        # INCREMENTAL contract: rewrite details + re-print the headline after
+        # every config — a driver timeout mid-ladder still captures both
+        with open("BENCH_DETAILS.json", "w") as f:
+            json.dump(details, f, indent=2)
+        print(json.dumps(_headline(details["results"])), flush=True)
 
 
 if __name__ == "__main__":

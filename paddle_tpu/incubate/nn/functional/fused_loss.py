@@ -19,13 +19,20 @@ import jax.numpy as jnp
 __all__ = ["fused_linear_cross_entropy"]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flce(h, w, labels, chunk):
-    loss, _ = _flce_fwd_impl(h, w, labels, chunk)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flce(h, w, labels, chunk, ignore_index):
+    loss, _ = _flce_fwd_impl(h, w, labels, chunk, ignore_index)
     return loss
 
 
-def _flce_fwd_impl(h, w, labels, chunk):
+def _valid_mask(labels, ignore_index):
+    # ignored tokens (ignore_index, or any negative label — the varlen
+    # bucketing collate pads labels with -100) contribute nothing to the
+    # loss or the gradient, and the mean divides by the non-ignored count
+    return (labels != ignore_index) & (labels >= 0)
+
+
+def _flce_fwd_impl(h, w, labels, chunk, ignore_index):
     n, hid = h.shape
     v = w.shape[1]
     nchunks = v // chunk
@@ -51,22 +58,26 @@ def _flce_fwd_impl(h, w, labels, chunk):
     (m, s, lab_logit), _ = jax.lax.scan(
         step, (m0, s0, jnp.zeros((n,), jnp.float32)), jnp.arange(nchunks))
     lse = m + jnp.log(s)
-    loss = jnp.mean(lse - lab_logit)
+    valid = _valid_mask(labels, ignore_index)
+    count = jnp.maximum(jnp.sum(valid), 1).astype(jnp.float32)
+    loss = jnp.sum(jnp.where(valid, lse - lab_logit, 0.0)) / count
     return loss, (h, w, labels, lse)
 
 
-def _flce_fwd(h, w, labels, chunk):
-    loss, res = _flce_fwd_impl(h, w, labels, chunk)
+def _flce_fwd(h, w, labels, chunk, ignore_index):
+    loss, res = _flce_fwd_impl(h, w, labels, chunk, ignore_index)
     return loss, res
 
 
-def _flce_bwd(chunk, res, g):
+def _flce_bwd(chunk, ignore_index, res, g):
     h, w, labels, lse = res
     n, hid = h.shape
     v = w.shape[1]
     nchunks = v // chunk
     hf = h.astype(jnp.float32)
-    scale = g / n
+    valid = _valid_mask(labels, ignore_index)
+    count = jnp.maximum(jnp.sum(valid), 1).astype(jnp.float32)
+    scale = (g / count) * valid.astype(jnp.float32)        # [N]
 
     def step(dh, i):
         wc = jax.lax.dynamic_slice(w, (0, i * chunk), (hid, chunk))
@@ -78,7 +89,7 @@ def _flce_bwd(chunk, res, g):
         onehot = (jax.nn.one_hot(jnp.clip(local, 0, chunk - 1), chunk,
                                  dtype=jnp.float32)
                   * inside[:, None].astype(jnp.float32))
-        dlog = (p - onehot) * scale                        # [N, chunk]
+        dlog = (p - onehot) * scale[:, None]               # [N, chunk]
         dwc = hf.T @ dlog                                  # [H, chunk]
         dh = dh + dlog @ wcf.T
         return dh, dwc.astype(w.dtype)
@@ -93,25 +104,44 @@ def _flce_bwd(chunk, res, g):
 _flce.defvjp(_flce_fwd, _flce_bwd)
 
 
+def _best_chunk(v, chunk_size):
+    """Pick the vocab chunk: the requested chunk_size when it divides v
+    exactly; otherwise the largest multiple-of-128 (TPU lane width) divisor
+    of v that keeps the scan <= 64 chunks — vocab 32000 @ 8192 -> 6400
+    (5 chunks). Returns 0 when no such divisor exists (e.g. 50304, whose
+    only small multiple-of-128 divisor is 384 — 131 tiny GEMMs would waste
+    the MXU — so the caller falls back to the plain logits path)."""
+    cs = min(int(chunk_size), v)
+    if v % cs == 0:
+        return cs
+    best = 0
+    for c in range(128, cs + 1, 128):
+        if v % c == 0 and v // c <= 64:
+            best = c
+    return best
+
+
 def fused_linear_cross_entropy(hidden, weight, labels, chunk_size=8192,
-                               name=None):
+                               ignore_index=-100, name=None):
     """loss = mean CE(softmax(hidden @ weight), labels) without ever
-    materializing the [tokens, vocab] logits. hidden [..., H] flattens to
-    [N, H]; weight [H, V]; labels [...] int. Falls back to the plain path
-    when vocab isn't chunkable (V % chunk != 0 after clamping)."""
+    materializing the [tokens, vocab] logits, excluding ignore_index (and
+    any negative) labels from both the loss mean and the gradient. hidden
+    [..., H] flattens to [N, H]; weight [H, V]; labels [...] int. Falls
+    back to the plain path when no good vocab chunking exists."""
     from ....core.dispatch import op_call
     from ....nn import functional as F
 
     v = int(weight.shape[-1])
-    chunk = min(int(chunk_size), v)
-    if v % chunk:
+    chunk = _best_chunk(v, chunk_size)
+    if not chunk:
         logits = hidden.reshape([-1, int(weight.shape[0])]).matmul(weight)
         return F.cross_entropy(logits, labels.reshape([-1]),
-                               reduction="mean")
+                               reduction="mean", ignore_index=ignore_index)
 
     def fn(h2, w2, lab):
         hh = h2.reshape(-1, h2.shape[-1])
-        return _flce(hh, w2, lab.reshape(-1).astype(jnp.int32), chunk)
+        return _flce(hh, w2, lab.reshape(-1).astype(jnp.int32), chunk,
+                     int(ignore_index))
 
     return op_call(fn, hidden, weight, labels,
                    name="fused_linear_cross_entropy", n_diff=2)

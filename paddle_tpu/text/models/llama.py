@@ -220,15 +220,17 @@ class LlamaForCausalLM(nn.Layer):
         hidden = self.model(input_ids, attn_mask)
         if labels is not None and self.lm_head is not None and \
                 not self.config.tensor_parallel and \
-                self.config.vocab_size >= 4096 and \
-                self.config.vocab_size % 4096 == 0:
+                self.config.vocab_size >= 4096:
             # fused lm_head+CE: the [tokens, vocab] logits tensor is never
             # materialized (incubate/nn/functional/fused_loss.py) — the
-            # memory-bound tail of the train step
+            # memory-bound tail of the train step. fused_linear_cross_entropy
+            # picks the largest multiple-of-128 chunk dividing the vocab
+            # (32000 -> 6400) and itself falls back to the plain path when
+            # no good chunking exists (e.g. GPT's 50304).
             from ...incubate.nn.functional import fused_linear_cross_entropy
 
             return fused_linear_cross_entropy(
-                hidden, self.lm_head.weight, labels, chunk_size=4096)
+                hidden, self.lm_head.weight, labels, chunk_size=8192)
         if self.lm_head is None:
             logits = paddle.matmul(hidden, self.model.embed_tokens.weight,
                                    transpose_y=True)
@@ -240,6 +242,21 @@ class LlamaForCausalLM(nn.Layer):
                 labels.reshape([-1]), reduction="mean")
             return loss
         return logits
+
+    def generate(self, input_ids, max_new_tokens=32, max_length=None,
+                 do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+                 eos_token_id=None, seed=None):
+        """KV-cached autoregressive decoding as ONE compiled XLA program
+        (prefill + lax.scan decode loop) — the role of the reference's
+        masked_multihead_attention decode kernel + PaddleNLP generate
+        (/root/reference/paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu).
+        See text/generation.py for the engine."""
+        from ..generation import generate as _generate
+
+        return _generate(self, input_ids, max_new_tokens=max_new_tokens,
+                         max_length=max_length, do_sample=do_sample,
+                         temperature=temperature, top_k=top_k, top_p=top_p,
+                         eos_token_id=eos_token_id, seed=seed)
 
 
 class _PipeEmbed(nn.Layer):

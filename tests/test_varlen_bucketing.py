@@ -90,6 +90,41 @@ class TestFlashAttnUnpadded:
         np.testing.assert_allclose(np.asarray(qt.grad._data)[3, 1, 2], num,
                                    rtol=5e-2, atol=1e-3)
 
+    def test_cross_attention_causal_bottom_right(self):
+        """Varlen CROSS-attention with len_q != len_k: causal mask must be
+        bottom-right aligned per sequence (query row i sees key cols
+        j <= i + len_k - len_q), matching the reference flash-attn
+        convention — NOT a top-left tril over the bucket shapes (ADVICE r3)."""
+        lens_q = [3, 5]
+        lens_k = [7, 6]
+        rs = np.random.RandomState(4)
+        h, d = 2, 16
+        q = rs.randn(sum(lens_q), h, d).astype("float32") * 0.5
+        k = rs.randn(sum(lens_k), h, d).astype("float32") * 0.5
+        v = rs.randn(sum(lens_k), h, d).astype("float32")
+        cu_q = np.cumsum([0] + lens_q).astype("int32")
+        cu_k = np.cumsum([0] + lens_k).astype("int32")
+        scale = 1.0 / np.sqrt(d)
+        out, _ = F.flash_attn_unpadded(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(cu_q), paddle.to_tensor(cu_k),
+            max(lens_q), max(lens_k), scale=scale, causal=True)
+        got = np.asarray(out._data)
+        for b in range(2):
+            sq_, sk_ = lens_q[b], lens_k[b]
+            qs, ks = q[cu_q[b]:cu_q[b + 1]], k[cu_k[b]:cu_k[b + 1]]
+            vs = v[cu_k[b]:cu_k[b + 1]]
+            s = np.einsum("qhd,khd->hqk", qs, ks) * scale
+            cols = np.arange(sk_)[None, :]
+            rows = np.arange(sq_)[:, None]
+            mask = cols <= rows + (sk_ - sq_)   # bottom-right aligned
+            s = np.where(mask[None], s, -1e30)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p = p / p.sum(-1, keepdims=True)
+            want = np.einsum("hqk,khd->qhd", p, vs)
+            np.testing.assert_allclose(got[cu_q[b]:cu_q[b + 1]], want,
+                                       rtol=2e-4, atol=2e-5)
+
     def test_varlen_qkvpacked_routes_through(self):
         lens = [4, 8]
         q, k, v, cu = self._pack(lens, seed=3)
