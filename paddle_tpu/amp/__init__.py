@@ -98,8 +98,26 @@ def amp_state_guard(state: "AmpState | None"):
 def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
              master_weight=None, save_dtype=None):
     """O2: cast model params to the AMP dtype (paddle amp.decorate)."""
+    ms = models if isinstance(models, (list, tuple)) else [models]
+    if save_dtype is not None:
+        # state_dict values are cast to save_dtype (reference decorate arg):
+        # installed as a state-dict hook so checkpoints save at the chosen
+        # precision while training dtypes are untouched
+        sd_dt = dtypes.convert_dtype(save_dtype)
+        for m in ms:
+            if not hasattr(m, "_state_dict_hooks"):
+                m._state_dict_hooks = {}
+
+            def _cast_hook(dest, _dt=sd_dt):
+                import collections
+
+                out = collections.OrderedDict()
+                for k, v in dest.items():
+                    out[k] = v.astype(_dt) if hasattr(v, "astype") else v
+                return out
+
+            m._state_dict_hooks[len(m._state_dict_hooks)] = _cast_hook
     if level == "O2":
-        ms = models if isinstance(models, (list, tuple)) else [models]
         for m in ms:
             m._to_dtype(dtypes.convert_dtype(dtype))
             for norm_layer in m.sublayers(include_self=True):

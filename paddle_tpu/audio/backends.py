@@ -95,7 +95,7 @@ def save(filepath, src, sample_rate, channels_first=True, encoding="PCM_16",
         channels_first = True
     if channels_first:
         data = data.T  # → [time, channels]
-    if bits_per_sample != 16:
+    if bits_per_sample != 16 or encoding != "PCM_16":
         raise NotImplementedError("wave backend writes 16-bit PCM only")
     pcm = np.clip(data, -1.0, 1.0)
     pcm = (pcm * 32767.0).astype(np.int16)

@@ -18,9 +18,10 @@ class Spectrogram(Layer):
         self.kw = dict(n_fft=n_fft, hop_length=hop_length,
                        win_length=win_length, window=window, power=power,
                        center=center, pad_mode=pad_mode)
+        self._out_dtype = dtype
 
     def forward(self, x):
-        return AF.spectrogram(x, **self.kw)
+        return AF.spectrogram(x, **self.kw).astype(self._out_dtype)
 
 
 class MelSpectrogram(Layer):
@@ -34,6 +35,7 @@ class MelSpectrogram(Layer):
                                 center)
         self.fbank = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
                                              htk, norm)
+        self._out_dtype = dtype
 
     def forward(self, x):
         s = self.spec(x)  # [..., bins, frames] (reference orientation)
@@ -42,7 +44,7 @@ class MelSpectrogram(Layer):
         def fn(sv, fbv):
             return fbv @ sv  # [..., n_mels, frames]
 
-        return op_call(fn, s, fb, name="mel_spectrogram")
+        return op_call(fn, s, fb, name="mel_spectrogram").astype(self._out_dtype)
 
 
 class LogMelSpectrogram(MelSpectrogram):

@@ -94,7 +94,10 @@ class PyLayer(metaclass=PyLayerMeta):
 
 
 def hessian(func, xs, batch_axis=None):
-    """Dense hessian via jax.hessian over raw buffers (functional API)."""
+    """Dense hessian via jax.hessian over raw buffers (functional API).
+    batch_axis=0 vmaps per-sample (reference autograd.hessian batch mode)."""
+    if batch_axis not in (None, 0):
+        raise ValueError("hessian: batch_axis must be None or 0")
     xs_is_seq = isinstance(xs, (list, tuple))
     arrs = [x._data for x in (xs if xs_is_seq else [xs])]
 
@@ -103,13 +106,26 @@ def hessian(func, xs, batch_axis=None):
         out = func(*t) if xs_is_seq else func(t[0])
         return out._data if isinstance(out, Tensor) else out
 
-    h = jax.hessian(f, argnums=tuple(range(len(arrs))))(*arrs)
+    hfn = jax.hessian(f, argnums=tuple(range(len(arrs))))
+    if batch_axis == 0:
+        hfn = jax.vmap(hfn)
+    h = hfn(*arrs)
     import jax.tree_util as jtu
 
-    return jtu.tree_map(lambda a: Tensor(a, _internal=True), h)
+    out = jtu.tree_map(lambda a: Tensor(a, _internal=True), h)
+    if not xs_is_seq and isinstance(out, tuple) and len(out) == 1:
+        out = out[0]
+        if isinstance(out, tuple) and len(out) == 1:
+            out = out[0]
+    return out
 
 
 def jacobian(func, xs, batch_axis=None):
+    """batch_axis=0 computes a PER-SAMPLE jacobian via vmap — output
+    [B, *out_shape, *in_shape-without-batch] instead of the dense
+    cross-sample jacobian (reference autograd.jacobian batch mode)."""
+    if batch_axis not in (None, 0):
+        raise ValueError("jacobian: batch_axis must be None or 0")
     xs_is_seq = isinstance(xs, (list, tuple))
     arrs = [x._data for x in (xs if xs_is_seq else [xs])]
 
@@ -118,10 +134,16 @@ def jacobian(func, xs, batch_axis=None):
         out = func(*t) if xs_is_seq else func(t[0])
         return out._data if isinstance(out, Tensor) else out
 
-    j = jax.jacrev(f, argnums=tuple(range(len(arrs))))(*arrs)
+    jfn = jax.jacrev(f, argnums=tuple(range(len(arrs))))
+    if batch_axis == 0:
+        jfn = jax.vmap(jfn)
+    j = jfn(*arrs)
     import jax.tree_util as jtu
 
-    return jtu.tree_map(lambda a: Tensor(a, _internal=True), j)
+    out = jtu.tree_map(lambda a: Tensor(a, _internal=True), j)
+    if not xs_is_seq and isinstance(out, tuple) and len(out) == 1:
+        out = out[0]
+    return out
 
 
 class saved_tensors_hooks:

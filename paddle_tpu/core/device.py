@@ -103,6 +103,18 @@ def current_place() -> Place:
     return _current_device or Place()
 
 
+def _validate_place(device) -> None:
+    """Accept a Place or a device string like 'cpu'/'gpu:0'/'tpu:0'; reject
+    anything unparseable (used by Layer.to / Tensor.to device args)."""
+    if isinstance(device, Place):
+        return
+    if not isinstance(device, str):
+        raise ValueError(f"unsupported device spec {device!r}")
+    name = device.split(":")[0]
+    if name not in ("cpu", "gpu", "tpu", "xpu", "npu", "custom_device", "axon"):
+        raise ValueError(f"unsupported device {device!r}")
+
+
 def device_count() -> int:
     return len(_accelerators())
 

@@ -119,6 +119,8 @@ define_flag("FLAGS_enable_to_static", True,
             "global to_static toggle (jit.enable_to_static)")
 define_flag("FLAGS_jit_code_level", 100, "SOT code-dump verbosity shim")
 define_flag("FLAGS_jit_verbosity", 0, "dy2static logging verbosity shim")
+define_flag("FLAGS_jit_log_to_stdout", False,
+            "mirror dy2static logs to stdout (set_verbosity also_to_stdout)")
 
 
 # the full reference flag surface (compat entries; must come after the

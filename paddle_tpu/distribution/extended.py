@@ -392,6 +392,10 @@ class LKJCholesky(Distribution):
                  name=None):
         if dim < 2:
             raise ValueError("dim must be >= 2")
+        if sample_method != "onion":
+            raise NotImplementedError(
+                f"LKJCholesky sample_method {sample_method!r}: only the "
+                "onion construction is implemented")
         self.dim = dim
         self.concentration = _t(concentration)
         super().__init__(tuple(self.concentration.shape), (dim, dim))

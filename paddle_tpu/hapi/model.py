@@ -54,10 +54,29 @@ class Model:
             raise TypeError("loss must be callable (a Loss layer or function)")
         self._loss = loss
         self._metrics = _to_list(metrics)
+        # amp_configs ≙ reference Model.prepare amp support: "O1"/"O2" or a
+        # dict with a "level" key; forward passes run under bf16 auto_cast
+        if amp_configs is None:
+            self._amp_level = "O0"
+        elif isinstance(amp_configs, str):
+            self._amp_level = amp_configs
+        elif isinstance(amp_configs, dict):
+            self._amp_level = amp_configs.get("level", "O1")
+        else:
+            raise TypeError("amp_configs must be None, str level, or dict")
+        if self._amp_level not in ("O0", "O1", "O2"):
+            raise ValueError(f"unsupported amp level {self._amp_level!r}")
         return self
 
+    def _amp_ctx(self):
+        import paddle_tpu as paddle
+
+        level = getattr(self, "_amp_level", "O0")
+        return paddle.amp.auto_cast(enable=level != "O0", dtype="bfloat16",
+                                    level=level if level != "O0" else "O1")
+
     def parameters(self, include_sublayers=True):
-        return self.network.parameters()
+        return self.network.parameters(include_sublayers=include_sublayers)
 
     # ------------------------------------------------------------ batches
     def train_batch(self, inputs, labels=None, update=True):
@@ -66,9 +85,10 @@ class Model:
         self.network.train()
         inputs = [_to_tensor(v) for v in _to_list(inputs)]
         labels = [_to_tensor(v) for v in _to_list(labels)]
-        outputs = self.network(*inputs)
-        losses = self._loss(*(_to_list(outputs) + labels)) if self._loss \
-            else outputs
+        with self._amp_ctx():
+            outputs = self.network(*inputs)
+            losses = self._loss(*(_to_list(outputs) + labels)) if self._loss \
+                else outputs
         loss_list = _to_list(losses)
         total = loss_list[0]
         for extra in loss_list[1:]:
@@ -171,19 +191,24 @@ class Model:
                  num_workers=0, callbacks=None, num_samples=None):
         loader = self._loader(eval_data, batch_size, False, num_workers)
         cbks = callbacks if hasattr(callbacks, "call") else config_callbacks(
-            callbacks, model=self, verbose=verbose,
+            callbacks, model=self, verbose=verbose, log_freq=log_freq,
             metrics=[m.name() for m in self._metrics])
         for m in self._metrics:
             m.reset()
         steps = len(loader) if hasattr(loader, "__len__") else None
         cbks.call("on_eval_begin", {"steps": steps})
         logs = {}
+        seen = 0
         for step, batch in enumerate(loader):
             cbks.call("on_eval_batch_begin", step)
             ins, labs = self._split_batch(batch)
             result = self.eval_batch(ins, labs)
             logs = self._logs(result, prefix="eval_")
             cbks.call("on_eval_batch_end", step, logs)
+            first = _to_list(ins)[0]
+            seen += int(first.shape[0]) if getattr(first, "shape", None) else 1
+            if num_samples is not None and seen >= num_samples:
+                break
         final = {}
         for m in self._metrics:
             final[m.name()] = m.accumulate()
@@ -194,7 +219,7 @@ class Model:
     def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
                 callbacks=None, verbose=1):
         loader = self._loader(test_data, batch_size, False, num_workers)
-        cbks = config_callbacks(callbacks, model=self, verbose=0)
+        cbks = config_callbacks(callbacks, model=self, verbose=verbose)
         cbks.call("on_predict_begin")
         outputs = []
         for step, batch in enumerate(loader):
@@ -261,6 +286,21 @@ class Model:
         from ..framework_io import load as _load
 
         state = _load(path + ".pdparams")
+        if skip_mismatch:
+            import warnings
+
+            current = {k: v for k, v in self.network.state_dict().items()}
+            kept = {}
+            for k, v in state.items():
+                cur = current.get(k)
+                vshape = tuple(getattr(v, "shape", ()) or ())
+                if cur is not None and tuple(cur.shape) != vshape:
+                    warnings.warn(
+                        f"skip loading {k}: shape {vshape} does not match "
+                        f"{tuple(cur.shape)}")
+                    continue
+                kept[k] = v
+            state = kept
         self.network.set_state_dict(state)
         opt_path = path + ".pdopt"
         if not reset_optimizer and self._optimizer is not None \

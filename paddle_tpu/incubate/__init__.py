@@ -148,15 +148,29 @@ class ModelAverage:
         self._params = list(parameters or [])
         self._sum = {}
         self._count = 0
+        self._total = 0
+        self._rate = float(average_window_rate)
+        self._min_w = int(min_average_window)
+        self._max_w = int(max_average_window)
         self._backup = {}
 
     def step(self):
-        import jax.numpy as jnp
-
+        # sliding window ≙ reference ModelAverage: window grows as
+        # rate·num_updates clamped to [min, max]; older contributions decay
+        # by rescaling once the window is full (the reference's sum_1/2/3
+        # block rotation is the same approximation)
+        self._total += 1
+        window = max(1, min(self._max_w,
+                            max(self._min_w, int(self._total * self._rate))))
         self._count += 1
         for p in self._params:
             acc = self._sum.get(id(p))
             self._sum[id(p)] = (p._data if acc is None else acc + p._data)
+        if self._count > window:
+            scale = window / self._count
+            for p in self._params:
+                self._sum[id(p)] = self._sum[id(p)] * scale
+            self._count = window
 
     @_contextlib.contextmanager
     def apply(self, executor=None, need_restore=True):

@@ -100,6 +100,18 @@ def gshard_gating(logits, capacity, second_policy="all"):
 
     g1 = jnp.sum(probs * mask1, axis=-1, keepdims=True)
     g2 = jnp.sum(probs * mask2, axis=-1, keepdims=True)
+    if second_policy == "none":
+        keep2 = jnp.zeros_like(keep2)
+    elif second_policy == "random":
+        # GShard paper: dispatch the 2nd expert stochastically with
+        # probability proportional to its gate (min(1, 2·g2))
+        from paddle_tpu.core.rng import next_key
+
+        u = jax.random.uniform(next_key(), (n, 1))
+        keep2 = keep2 & (u < jnp.clip(2.0 * g2, 0.0, 1.0))
+    elif second_policy != "all":
+        raise ValueError(
+            f"gshard second_policy must be all/none/random, got {second_policy!r}")
     denom = jnp.clip(g1 + g2, 1e-9, None)
     g1, g2 = g1 / denom, g2 / denom
 

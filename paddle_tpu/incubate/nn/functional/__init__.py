@@ -196,6 +196,21 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
 
     if cache_kv is None:
         raise ValueError("masked_multihead_attention requires cache_kv")
+    if qkv_out_scale is not None or out_shift is not None:
+        raise NotImplementedError(
+            "masked_multihead_attention int8 dequant path (qkv_out_scale/"
+            "out_shift): use quantization.ptq QuantizedLinear for int8")
+    if beam_cache_offset is not None:
+        raise NotImplementedError(
+            "masked_multihead_attention beam search cache offsets")
+    if rotary_tensor is not None or rotary_emb_dims:
+        raise NotImplementedError(
+            "masked_multihead_attention external rotary_tensor: the "
+            "generation engine (text/generation.py) applies RoPE from the "
+            "model config; pre-rotate q/k before calling this op")
+    if int(seq_len) != 1:
+        raise ValueError("masked_multihead_attention decodes ONE step "
+                         f"(seq_len=1), got {seq_len}")
     if sequence_lengths is None:
         # the CUDA kernel tracks the timestep inside its cache object; a
         # pure function cannot — writing to slot 0 every step would
@@ -208,16 +223,16 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
     dh = cache_kv.shape[4]
 
     def f(xv, cache, *rest):
+        it = iter(rest)
+        if compute_dtype not in ("default", None):
+            xv = xv.astype(compute_dtype)
         b = xv.shape[0]
         qkv = xv.reshape(b, 3, nh, dh)
         if bias is not None:
-            qkv = qkv + rest[0].reshape(1, 3, nh, dh)
+            qkv = qkv + next(it).reshape(1, 3, nh, dh).astype(qkv.dtype)
+        sm = next(it) if src_mask is not None else None
+        pos = next(it).reshape(b).astype(jnp.int32)
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]      # [B, H, D]
-        # append to cache at position = current length (scalar per batch)
-        if sequence_lengths is not None:
-            pos = rest[-1].reshape(b)
-        else:
-            pos = jnp.zeros((b,), jnp.int32)
         import jax
 
         def upd(c_b, k_b, v_b, p):
@@ -226,7 +241,7 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
             return c_b
 
         cache_b = jnp.swapaxes(cache, 0, 1)            # [B, 2, H, L, D]
-        cache_b = jax.vmap(upd)(cache_b, k, v, pos.astype(jnp.int32))
+        cache_b = jax.vmap(upd)(cache_b, k, v, pos)
         new_cache = jnp.swapaxes(cache_b, 0, 1)
         keys = new_cache[0]                            # [B, H, L, D]
         vals = new_cache[1]
@@ -235,6 +250,9 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
         ar = jnp.arange(keys.shape[2])
         mask = ar[None, None, :] <= pos[:, None, None]
         scores = jnp.where(mask, scores, -jnp.inf)
+        if sm is not None:
+            # additive mask [B, 1, 1, L] (or broadcastable) over cache cols
+            scores = scores + sm.reshape(b, 1, -1).astype(scores.dtype)
         att = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("bhl,bhld->bhd", att, vals).reshape(b, nh * dh)
         return out, new_cache
@@ -242,8 +260,9 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
     args = [x, cache_kv]
     if bias is not None:
         args.append(bias)
-    if sequence_lengths is not None:
-        args.append(sequence_lengths)
+    if src_mask is not None:
+        args.append(src_mask)
+    args.append(sequence_lengths)
     return op_call(f, *args, name="masked_multihead_attention", n_diff=2)
 
 

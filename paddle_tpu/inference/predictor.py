@@ -37,6 +37,7 @@ class Config:
         if prog_file is not None:
             self._prefix = prog_file[:-len(".stablehlo")] \
                 if prog_file.endswith(".stablehlo") else prog_file
+        self._check_params_file(params_file)
         self._device = "tpu"
         self._device_id = 0
         self._precision = PrecisionType.Float32
@@ -61,6 +62,22 @@ class Config:
     def set_model(self, prog_file, params_file=None):
         self._prefix = prog_file[:-len(".stablehlo")] \
             if prog_file.endswith(".stablehlo") else prog_file
+        self._check_params_file(params_file)
+
+    def _check_params_file(self, params_file):
+        """jit.save bundles weights with the StableHLO artifact at the same
+        prefix; a separate params_file is accepted for reference-API parity
+        but must agree with the program prefix."""
+        import os
+
+        if params_file is None or self._prefix is None:
+            return
+        base = os.path.splitext(params_file)[0]
+        if base != self._prefix:
+            raise ValueError(
+                f"params_file {params_file!r} does not match the program "
+                f"prefix {self._prefix!r}; this build loads weights from "
+                "the jit.save artifact at the program prefix")
 
     def set_network_factory(self, factory):
         """TPU extension: zero-arg callable rebuilding the network — the

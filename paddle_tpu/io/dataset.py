@@ -112,7 +112,14 @@ def random_split(dataset, lengths, generator=None):
         lengths = sizes
     if sum(lengths) != len(dataset):
         raise ValueError("sum of lengths must equal dataset size")
-    perm = np.random.permutation(len(dataset))
+    if generator is not None:
+        # generator: anything with a .seed attribute or an int-like seed,
+        # giving a reproducible split (reference random_split generator arg)
+        seed = getattr(generator, "seed", generator)
+        seed = seed() if callable(seed) else seed
+        perm = np.random.RandomState(int(seed)).permutation(len(dataset))
+    else:
+        perm = np.random.permutation(len(dataset))
     out, ofs = [], 0
     for l in lengths:
         out.append(Subset(dataset, perm[ofs:ofs + l].tolist()))

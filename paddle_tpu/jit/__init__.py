@@ -24,4 +24,5 @@ def set_code_level(level=100):
 def set_verbosity(level=0, also_to_stdout=False):
     from ..core.flags import set_flags
 
-    set_flags({"FLAGS_jit_verbosity": int(level)})
+    set_flags({"FLAGS_jit_verbosity": int(level),
+               "FLAGS_jit_log_to_stdout": bool(also_to_stdout)})

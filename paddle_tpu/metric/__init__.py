@@ -123,6 +123,10 @@ class Recall(Metric):
 class Auc(Metric):
     def __init__(self, curve="ROC", num_thresholds=4095, name=None):
         super().__init__()
+        if curve != "ROC":
+            raise ValueError(
+                f"Auc: only the ROC curve is implemented (got {curve!r}); "
+                "the reference kernel likewise supports ROC only")
         self._name = name or "auc"
         self.num_thresholds = num_thresholds
         self.reset()
@@ -165,4 +169,10 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
     if lab.ndim == 2 and lab.shape[-1] == 1:
         lab = lab.squeeze(-1)
     corr = (np.asarray(idx.numpy()) == lab[..., None]).any(-1)
+    # legacy out-params: when given, they receive the running counts
+    # (reference static accuracy op accumulates into them)
+    if correct is not None:
+        correct.set_value(np.asarray(corr.sum(), np.int64))
+    if total is not None:
+        total.set_value(np.asarray(corr.size, np.int64))
     return Tensor(np.asarray(corr.mean(), np.float32))

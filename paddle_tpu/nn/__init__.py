@@ -69,5 +69,4 @@ class ParamAttr:
         self.need_clip = need_clip
 
 
-def utils_weight_norm(*a, **k):
-    raise NotImplementedError("weight_norm: planned")
+from . import utils  # noqa: E402,F401

@@ -81,6 +81,10 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=Fals
             total = jnp.power(sum(jnp.sum(jnp.power(jnp.abs(p.grad._data.astype(jnp.float32)),
                                                     norm_type)) for p in params),
                               1.0 / norm_type)
+        if error_if_nonfinite and not bool(jnp.isfinite(total)):
+            raise RuntimeError(
+                "The total norm of gradients is non-finite, so it cannot "
+                "be clipped (clip_grad_norm_ error_if_nonfinite=True)")
         scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-12), 1.0)
         for p in params:
             p.grad._assign_raw((p.grad._data * scale).astype(p.grad._data.dtype))

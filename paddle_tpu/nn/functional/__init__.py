@@ -371,21 +371,53 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None
     ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
     red_axes = tuple(i for i in range(2, x.ndim)) if ch_axis == 1 else tuple(
         i for i in range(1, x.ndim - 1))
+    use_running = not use_input_stats
+    if use_running and (running_mean is None or running_var is None):
+        raise ValueError(
+            "instance_norm(use_input_stats=False) requires running_mean "
+            "and running_var")
 
-    def f(a, *wb):
-        m = jnp.mean(a, axis=red_axes, keepdims=True)
-        v = jnp.var(a, axis=red_axes, keepdims=True)
-        out = (a - m) * jax.lax.rsqrt(v + eps)
+    track = not use_running and running_mean is not None \
+        and running_var is not None
+    stat_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+
+    def f(a, *extra):
+        it = iter(extra)
         shape = [1] * a.ndim
         shape[ch_axis] = -1
-        if len(wb) >= 1:
-            out = out * wb[0].reshape(shape)
-        if len(wb) >= 2:
-            out = out + wb[1].reshape(shape)
+        if use_running:
+            m = next(it).reshape(shape)
+            v = next(it).reshape(shape)
+        else:
+            m = jnp.mean(a, axis=red_axes, keepdims=True)
+            v = jnp.var(a, axis=red_axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + eps)
+        if weight is not None:
+            out = out * next(it).reshape(shape)
+        if bias is not None:
+            out = out + next(it).reshape(shape)
+        if track:
+            return out, jnp.mean(a, axis=stat_axes), jnp.var(a, axis=stat_axes)
         return out
 
-    args = [x] + [t for t in (weight, bias) if t is not None]
-    return op_call(f, *args, name="instance_norm")
+    args = [x]
+    if use_running:
+        args += [running_mean, running_var]
+    args += [t for t in (weight, bias) if t is not None]
+    if not track:
+        return op_call(f, *args, name="instance_norm")
+    out, bm, bv = op_call(f, *args, name="instance_norm")
+    # track running stats with the reference momentum convention
+    from ...core.dispatch import no_grad
+
+    with no_grad():
+        running_mean._assign_raw(
+            running_mean._data * momentum
+            + bm._data.astype(running_mean._data.dtype) * (1 - momentum))
+        running_var._assign_raw(
+            running_var._data * momentum
+            + bv._data.astype(running_var._data.dtype) * (1 - momentum))
+    return out
 
 
 def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW",
@@ -557,7 +589,7 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
 
 
 def _pool(x, kernel, stride, padding, nd, kind, data_format, ceil_mode=False,
-          exclusive=True, count_include_pad=False):
+          exclusive=True, divisor_override=None):
     ks = _pair(kernel, nd)
     st = _pair(stride if stride is not None else kernel, nd)
     pd = _pair(padding, nd)
@@ -567,15 +599,32 @@ def _pool(x, kernel, stride, padding, nd, kind, data_format, ceil_mode=False,
     strides = [1] * x.ndim
     pads = [(0, 0)] * x.ndim
     for i in range(nd):
+        in_s = int(x.shape[spatial_first + i])
+        hi = pd[i]
+        if ceil_mode:
+            # ceil output size needs extra RIGHT padding so the last
+            # (partial) window exists; for max it pads -inf (never wins),
+            # for avg-exclusive the count window excludes it. A window that
+            # would START in the right padding is dropped (torch/paddle rule).
+            num = in_s + 2 * pd[i] - ks[i]
+            out_des = -(-num // st[i]) + 1
+            if (out_des - 1) * st[i] >= in_s + pd[i]:
+                out_des -= 1
+            # exact right pad for out_des windows; any value in
+            # [exact, exact+st) yields the same count, so clamp to >= 0
+            # (reduce_window rejects negative padding)
+            hi = max(0, (out_des - 1) * st[i] + ks[i] - in_s - pd[i])
         window[spatial_first + i] = ks[i]
         strides[spatial_first + i] = st[i]
-        pads[spatial_first + i] = (pd[i], pd[i])
+        pads[spatial_first + i] = (pd[i], hi)
 
     def f(a):
         if kind == "max":
             init = -jnp.inf if dtypes.is_floating_point(a.dtype) else jnp.iinfo(a.dtype).min
             return jax.lax.reduce_window(a, init, jax.lax.max, window, strides, pads)
         s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
+        if divisor_override is not None:
+            return s / float(divisor_override)
         if exclusive and any(p[0] or p[1] for p in pads):
             ones = jnp.ones_like(a)
             cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
@@ -585,18 +634,56 @@ def _pool(x, kernel, stride, padding, nd, kind, data_format, ceil_mode=False,
     return op_call(f, x, name=f"{kind}_pool{nd}d")
 
 
+def _max_pool_with_mask(x, kernel, stride, padding, nd, ceil_mode, opname):
+    """max_pool*(return_mask=True) ≙ reference max_pool2d_with_index
+    (/root/reference/python/paddle/nn/functional/pooling.py:1284): returns
+    (out, mask) with mask = argmax position flattened over the input's
+    spatial dims, the format max_unpool* consumes."""
+    from .extended import _window_max_pool
+
+    ks = _pair(kernel, nd)
+    st = _pair(stride if stride is not None else kernel, nd)
+    pd = _pair(padding, nd)
+    starts_list, lens_list = [], []
+    for i in range(nd):
+        in_s = int(x.shape[2 + i])
+        num = in_s + 2 * pd[i] - ks[i]
+        out = (-(-num // st[i]) if ceil_mode else num // st[i]) + 1
+        if ceil_mode and (out - 1) * st[i] >= in_s + pd[i]:
+            out -= 1
+        starts_list.append(np.arange(out) * st[i] - pd[i])
+        lens_list.append(np.full(out, ks[i], np.int64))
+    return _window_max_pool(x, nd, starts_list, lens_list, opname,
+                            return_mask=True)
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        if not data_format.startswith("NC"):
+            raise ValueError("max_pool1d(return_mask=True) requires NCL")
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 1,
+                                   ceil_mode, "max_pool1d_with_index")
     return _pool(x, kernel_size, stride, padding, 1, "max", data_format, ceil_mode)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        if not data_format.startswith("NC"):
+            raise ValueError("max_pool2d(return_mask=True) requires NCHW")
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 2,
+                                   ceil_mode, "max_pool2d_with_index")
     return _pool(x, kernel_size, stride, padding, 2, "max", data_format, ceil_mode)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        if not data_format.startswith("NC"):
+            raise ValueError("max_pool3d(return_mask=True) requires NCDHW")
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 3,
+                                   ceil_mode, "max_pool3d_with_index")
     return _pool(x, kernel_size, stride, padding, 3, "max", data_format, ceil_mode)
 
 
@@ -608,14 +695,18 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    if divisor_override is not None and float(divisor_override) <= 0:
+        raise ValueError("divisor_override must be > 0")
     return _pool(x, kernel_size, stride, padding, 2, "avg", data_format, ceil_mode,
-                 exclusive)
+                 exclusive, divisor_override)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    if divisor_override is not None and float(divisor_override) <= 0:
+        raise ValueError("divisor_override must be > 0")
     return _pool(x, kernel_size, stride, padding, 3, "avg", data_format, ceil_mode,
-                 exclusive)
+                 exclusive, divisor_override)
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):
@@ -630,15 +721,43 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
     return _adaptive_pool(x, output_size, 3, "avg", data_format)
 
 
+def _adaptive_max_with_mask(x, output_size, nd, opname):
+    """adaptive_max_pool*(return_mask=True): window o along dim d covers
+    [floor(o·in/O), ceil((o+1)·in/O)); indices flattened over input
+    spatial dims (reference pooling.py:1795)."""
+    from .extended import _window_max_pool
+
+    out_sz = _pair(output_size, nd)
+    starts_list, lens_list = [], []
+    for i in range(nd):
+        in_s = int(x.shape[2 + i])
+        o = out_sz[i] if out_sz[i] is not None else in_s
+        starts = (np.arange(o) * in_s) // o
+        ends = ((np.arange(o) + 1) * in_s + o - 1) // o
+        starts_list.append(starts)
+        lens_list.append(ends - starts)
+    return _window_max_pool(x, nd, starts_list, lens_list, opname,
+                            return_mask=True)
+
+
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_with_mask(x, output_size, 1,
+                                       "adaptive_max_pool1d_with_index")
     return _adaptive_pool(x, output_size, 1, "max", "NCL")
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_with_mask(x, output_size, 2,
+                                       "adaptive_max_pool2d_with_index")
     return _adaptive_pool(x, output_size, 2, "max", "NCHW")
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_with_mask(x, output_size, 3,
+                                       "adaptive_max_pool3d_with_index")
     return _adaptive_pool(x, output_size, 3, "max", "NCDHW")
 
 
@@ -697,37 +816,111 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     from ...ops.manipulation import pad as _pad
 
     if isinstance(pad, (list, tuple)) and len(pad) == 2 * (x.ndim - 2) and x.ndim >= 3:
-        # paddle nn.functional.pad: pads innermost spatial dims, given
-        # [d_front, d_back, ..., w_left, w_right] for NC* layouts (reversed pairs)
-        nd = x.ndim - 2
-        pairs = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(nd)]
-        pairs = pairs[::-1] if data_format.startswith("NC") else pairs[::-1]
-        width = [(0, 0), (0, 0)] + pairs[::-1] if data_format.startswith("NC") else \
-            [(0, 0)] + pairs[::-1] + [(0, 0)]
+        # paddle nn.functional.pad: [w_left, w_right, h_top, h_bottom, ...] —
+        # pair i applies to the i-th spatial dim FROM THE END (torch/paddle
+        # convention; round-3's double reversal put the W pad on H)
+        nd = x.ndim
+        k = len(pad) // 2
+        width = [(0, 0)] * nd
+        last_spatial = nd - 1 if data_format.startswith("NC") else nd - 2
+        for i in range(k):
+            width[last_spatial - i] = (int(pad[2 * i]), int(pad[2 * i + 1]))
         flat = [v for pr in width for v in pr]
         return _pad(x, flat, mode=mode, value=value)
     return _pad(x, pad, mode=mode, value=value)
 
 
+def _resample_taps(in_s, out_s, mode, align_corners, align_mode):
+    """Static per-dim tap (index, weight) arrays for separable resampling.
+    Coordinate mapping per reference interpolate semantics
+    (/root/reference/python/paddle/nn/functional/common.py interpolate):
+      align_corners=True : src = i·(in-1)/(out-1)
+      align_corners=False, align_mode=0 (half-pixel): src = (i+.5)·s - .5
+      align_corners=False, align_mode=1 (asymmetric): src = i·s
+    Returns list of (idx[out], w[out]) taps."""
+    i = np.arange(out_s, dtype=np.float64)
+    if align_corners:
+        src = i * ((in_s - 1) / max(out_s - 1, 1))
+    elif align_mode == 1 and mode in ("linear", "bilinear", "trilinear"):
+        src = i * (in_s / out_s)
+    else:
+        src = (i + 0.5) * (in_s / out_s) - 0.5
+    if mode == "nearest":
+        # paddle nearest: floor of the asymmetric map (align_corners=False),
+        # rounding of the corner-aligned map otherwise
+        if align_corners:
+            idx = np.round(src)
+        else:
+            idx = np.floor(i * (in_s / out_s))
+        return [(np.clip(idx, 0, in_s - 1).astype(np.int64),
+                 np.ones(out_s))]
+    if mode in ("linear", "bilinear", "trilinear"):
+        i0 = np.floor(src)
+        frac = src - i0
+        return [(np.clip(i0, 0, in_s - 1).astype(np.int64), 1.0 - frac),
+                (np.clip(i0 + 1, 0, in_s - 1).astype(np.int64), frac)]
+    if mode == "bicubic":
+        a = -0.75  # Keys kernel, torch/paddle coefficient
+
+        def w(d):
+            d = np.abs(d)
+            return np.where(
+                d <= 1, ((a + 2) * d - (a + 3)) * d * d + 1,
+                np.where(d < 2, (((d - 5) * d + 8) * d - 4) * a, 0.0))
+
+        i0 = np.floor(src)
+        taps = []
+        for t in range(-1, 3):
+            taps.append((np.clip(i0 + t, 0, in_s - 1).astype(np.int64),
+                         w(src - (i0 + t))))
+        return taps
+    raise ValueError(f"interpolate: unsupported mode {mode!r}")
+
+
 def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
                 align_mode=0, data_format="NCHW", name=None):
+    nchw = data_format.startswith("NC")
+    nd = x.ndim - 2
+    spatial = tuple(int(s) for s in (x.shape[2:] if nchw else x.shape[1:-1]))
+    if size is not None:
+        out_sz = _pair(size, nd)
+        out_sz = tuple(int(spatial[i] if out_sz[i] is None else out_sz[i])
+                       for i in range(nd))
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+            [scale_factor] * nd
+        out_sz = tuple(int(s * f_) for s, f_ in zip(spatial, sf))
+
+    if mode == "area":
+        # area ≙ adaptive average pooling (reference routes it the same way)
+        if not nchw:
+            from ...ops.manipulation import transpose as _tp
+
+            perm_in = [0, nd + 1] + list(range(1, nd + 1))
+            perm_out = [0] + list(range(2, nd + 2)) + [1]
+            return _tp(_adaptive_pool(_tp(x, perm_in), out_sz, nd, "avg",
+                                      "NC"), perm_out)
+        return _adaptive_pool(x, out_sz, nd, "avg", "NC")
+
+    taps = [_resample_taps(spatial[d], out_sz[d], mode, align_corners,
+                           align_mode) for d in range(nd)]
+
     def f(a):
-        nchw = data_format.startswith("NC")
         if not nchw:
             a = jnp.moveaxis(a, -1, 1)
-        spatial = a.shape[2:]
-        if size is not None:
-            out_sz = _pair(size, len(spatial))
-        else:
-            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
-                [scale_factor] * len(spatial)
-            out_sz = tuple(int(s * f_) for s, f_ in zip(spatial, sf))
-        m = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
-             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
-        out = jax.image.resize(a, a.shape[:2] + out_sz, method=m)
+        for d in range(nd):
+            ax = 2 + d
+            acc = None
+            for idx, w in taps[d]:
+                g = jnp.take(a, jnp.asarray(idx), axis=ax)
+                wshape = [1] * g.ndim
+                wshape[ax] = -1
+                term = g * jnp.asarray(w, g.dtype).reshape(wshape)
+                acc = term if acc is None else acc + term
+            a = acc
         if not nchw:
-            out = jnp.moveaxis(out, 1, -1)
-        return out
+            a = jnp.moveaxis(a, 1, -1)
+        return a
 
     return op_call(f, x, name="interpolate")
 
@@ -740,24 +933,32 @@ def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=Fals
 
 def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
     r = upscale_factor
+    nchw = data_format == "NCHW"
 
     def f(a):
+        if not nchw:
+            a = jnp.moveaxis(a, -1, 1)
         n, c, h, w = a.shape
         out = a.reshape(n, c // (r * r), r, r, h, w)
         out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
-        return out.reshape(n, c // (r * r), h * r, w * r)
+        out = out.reshape(n, c // (r * r), h * r, w * r)
+        return out if nchw else jnp.moveaxis(out, 1, -1)
 
     return op_call(f, x, name="pixel_shuffle")
 
 
 def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
     r = downscale_factor
+    nchw = data_format == "NCHW"
 
     def f(a):
+        if not nchw:
+            a = jnp.moveaxis(a, -1, 1)
         n, c, h, w = a.shape
         out = a.reshape(n, c, h // r, r, w // r, r)
         out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
-        return out.reshape(n, c * r * r, h // r, w // r)
+        out = out.reshape(n, c * r * r, h // r, w // r)
+        return out if nchw else jnp.moveaxis(out, 1, -1)
 
     return op_call(f, x, name="pixel_unshuffle")
 
@@ -1054,7 +1255,11 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
 
 
 def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    nchw = data_format == "NCHW"
+
     def f(a):
+        if not nchw:
+            a = jnp.moveaxis(a, -1, 1)
         nt, c, h, w = a.shape
         n = nt // seg_num
         v = a.reshape(n, seg_num, c, h, w)
@@ -1063,7 +1268,8 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
         right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
                                  v[:, :-1, fold:2 * fold]], axis=1)
         rest = v[:, :, 2 * fold:]
-        return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+        out = jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+        return out if nchw else jnp.moveaxis(out, 1, -1)
 
     return op_call(f, x, name="temporal_shift")
 

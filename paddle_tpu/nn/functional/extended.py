@@ -259,41 +259,30 @@ def _fractional_starts(in_size, out_size, k, u):
     return np.append(seq, in_size - k)
 
 
-def _fractional_pool(x, nd, output_size, kernel_size, random_u, opname,
+def _window_max_pool(x, nd, starts_list, lens_list, opname,
                      return_mask=False):
-    out_sz = _pair(output_size, nd)
-    spatial = tuple(x.shape[2:])
-    if random_u is None:
-        u = float(jax.random.uniform(next_key(), ()))
-    else:
-        u = float(random_u)
-        if not 0 < u < 1:
-            raise ValueError(f"random_u must be in (0,1), got {random_u}")
-
-    gidx, gmask, bounds = [], [], []
-    if kernel_size is not None:
-        ks = _pair(kernel_size, nd)
-        kmax = list(ks)
-        for d in range(nd):
-            starts = _fractional_starts(spatial[d], out_sz[d], ks[d], u)
-            bounds.append(np.append(starts, spatial[d]))  # starts for mask idx
-            idx = starts[:, None] + np.arange(ks[d])[None, :]
-            gidx.append(np.clip(idx, 0, spatial[d] - 1))
-            gmask.append(np.ones((out_sz[d], ks[d]), bool))
-    else:
-        bnds = [_fractional_bounds(spatial[i], out_sz[i], u)
-                for i in range(nd)]
-        kmax = [int((b[1:] - b[:-1]).max()) for b in bnds]
-        # per-dim gather indices [out, kmax], validity mask past window end
-        for d in range(nd):
-            b = bnds[d]
-            starts = b[:-1]
-            lens = b[1:] - b[:-1]
-            idx = starts[:, None] + np.arange(kmax[d])[None, :]
-            mask = np.arange(kmax[d])[None, :] < lens[:, None]
-            gidx.append(np.clip(idx, 0, spatial[d] - 1))
-            gmask.append(mask)
-            bounds.append(b)
+    """Max pooling over arbitrary per-dim STATIC windows — the shared
+    engine behind fractional_max_pool, max_pool(return_mask=True) and
+    adaptive_max_pool(return_mask=True). Per dim d: window o covers input
+    positions [starts_list[d][o], starts_list[d][o] + lens_list[d][o]);
+    positions outside [0, spatial[d]) (e.g. left padding) are masked to
+    -inf and never selected. Returns vals or (vals, flat-input-index) with
+    indices flattened over the UNPADDED spatial dims (paddle
+    max_pool2d_with_index semantics,
+    /root/reference/python/paddle/nn/functional/pooling.py:1284)."""
+    spatial = tuple(int(s) for s in x.shape[2:])
+    out_sz = [len(starts_list[d]) for d in range(nd)]
+    gidx, gmask, kmax = [], [], []
+    for d in range(nd):
+        starts = np.asarray(starts_list[d], np.int64)
+        lens = np.asarray(lens_list[d], np.int64)
+        km = int(lens.max())
+        kmax.append(km)
+        idx = starts[:, None] + np.arange(km)[None, :]
+        valid = (np.arange(km)[None, :] < lens[:, None]) \
+            & (idx >= 0) & (idx < spatial[d])
+        gidx.append(np.clip(idx, 0, spatial[d] - 1))
+        gmask.append(valid)
 
     def f(a):
         # joint window gather: each spatial dim expands to (out_d, k_d)
@@ -326,13 +315,41 @@ def _fractional_pool(x, nd, output_size, kernel_size, random_u, opname,
             rem = rem // kmax[d]
             osh = [1] * arg.ndim
             osh[2 + d] = out_sz[d]
-            starts_d = jnp.asarray(bounds[d][:-1].astype(np.int32)).reshape(osh)
+            starts_d = jnp.asarray(
+                np.asarray(starts_list[d], np.int32)).reshape(osh)
             absolute = starts_d + off.astype(jnp.int32)
             stride = int(np.prod(spatial[d + 1:], initial=1))
             flat_idx = flat_idx + absolute * stride
         return vals, flat_idx
 
     return op_call(f, x, name=opname)
+
+
+def _fractional_pool(x, nd, output_size, kernel_size, random_u, opname,
+                     return_mask=False):
+    out_sz = _pair(output_size, nd)
+    spatial = tuple(x.shape[2:])
+    if random_u is None:
+        u = float(jax.random.uniform(next_key(), ()))
+    else:
+        u = float(random_u)
+        if not 0 < u < 1:
+            raise ValueError(f"random_u must be in (0,1), got {random_u}")
+
+    starts_list, lens_list = [], []
+    if kernel_size is not None:
+        ks = _pair(kernel_size, nd)
+        for d in range(nd):
+            starts = _fractional_starts(spatial[d], out_sz[d], ks[d], u)
+            starts_list.append(starts)
+            lens_list.append(np.full(out_sz[d], ks[d], np.int64))
+    else:
+        for d in range(nd):
+            b = _fractional_bounds(spatial[d], out_sz[d], u)
+            starts_list.append(b[:-1])
+            lens_list.append(b[1:] - b[:-1])
+    return _window_max_pool(x, nd, starts_list, lens_list, opname,
+                            return_mask)
 
 
 def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
@@ -358,12 +375,27 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
     if data_format not in ("NCL", "NLC"):
         raise ValueError(f"bad data_format {data_format}")
     xin = x if data_format == "NCL" else x.transpose([0, 2, 1])
+    st = _pair(stride, 1)[0]
+    pd = _pair(padding, 1)[0]
+    dl = _pair(dilation, 1)[0]
+    opad = _pair(output_padding, 1)[0]
+    if output_size is not None:
+        # output_size disambiguates the transposed-conv length; derive the
+        # equivalent output_padding (reference conv1d_transpose semantics)
+        osz = output_size[-1] if isinstance(output_size, (list, tuple)) \
+            else int(output_size)
+        lin = int(xin.shape[-1])
+        k = int(weight.shape[-1])
+        base = (lin - 1) * st - 2 * pd + dl * (k - 1) + 1
+        opad = int(osz) - base
+        if not 0 <= opad < st and opad != 0:
+            raise ValueError(
+                f"output_size {osz} is not reachable: base length {base}, "
+                f"stride {st}")
     x4 = unsqueeze(xin, 2)            # [N, C, 1, L]
     w4 = unsqueeze(weight, 2)         # [in, out/g, 1, k]
-    out = conv2d_transpose(x4, w4, bias, (1, _pair(stride, 1)[0]),
-                           (0, _pair(padding, 1)[0]),
-                           (0, _pair(output_padding, 1)[0]), groups,
-                           (1, _pair(dilation, 1)[0]), "NCHW")
+    out = conv2d_transpose(x4, w4, bias, (1, st), (0, pd), (0, opad), groups,
+                           (1, dl), "NCHW")
     out = squeeze(out, 2)
     return out if data_format == "NCL" else out.transpose([0, 2, 1])
 
@@ -913,6 +945,7 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     ik = np.minimum(cu_k[:-1, None] + np.arange(sk)[None, :],
                     int(cu_k[-1]) - 1).astype(np.int32)
     lens_k = (cu_k[1:] - cu_k[:-1]).astype(np.int32)
+    lens_q = (cu_q[1:] - cu_q[:-1]).astype(np.int32)
     # gather-back map: packed token t lives at (seq_id[t], pos[t])
     tpos = np.arange(total_q)
     seq_id = (np.searchsorted(cu_q, tpos, side="right") - 1).astype(np.int32)
@@ -924,7 +957,7 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     # the Pallas varlen kernel — key columns mask INSIDE the kernel
     use_flash = (drop == 0.0 and np.array_equal(cu_q, cu_k) and sq == sk)
 
-    def f(qv, kv, vv, iq_, ik_, lk, sid, pos_):
+    def f(qv, kv, vv, iq_, ik_, lk, lq, sid, pos_):
         import jax as _jax
 
         from .attention import _xla_sdpa
@@ -947,13 +980,20 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
             kmask = (jnp.arange(sk)[None, :] < lk[:, None])   # [B, Sk]
             mask = kmask[:, None, None, :]                    # [B, 1, 1, Sk]
             if causal:
-                tri = jnp.tril(jnp.ones((sq, sk), bool), k=0)
-                mask = mask & tri[None, None, :, :]
+                # bottom-right aligned PER SEQUENCE using actual lengths
+                # (reference semantics for cross-attention varlen: query row
+                # i of sequence b sees key cols j <= i + len_k[b] - len_q[b];
+                # a bucket-level tril would misalign whenever the q/k buckets
+                # or per-sequence lengths differ)
+                rows = jnp.arange(sq)[None, :, None]
+                cols = jnp.arange(sk)[None, None, :]
+                tri = cols <= rows + (lk - lq)[:, None, None]  # [B, Sq, Sk]
+                mask = mask & tri[:, None, :, :]
             out = _xla_sdpa(qp, kp, vp, mask, drop, False,
                             None if drop == 0.0 else _nk())
         return out[sid, pos_]             # back to packed [total, H, D]
 
-    out = op_call(f, query, key, value, iq, ik, lens_k, seq_id, pos,
+    out = op_call(f, query, key, value, iq, ik, lens_k, lens_q, seq_id, pos,
                   name="flash_attn_unpadded", n_diff=3)
     return out, None
 
@@ -1011,8 +1051,10 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
     is the perf path for large S."""
     s_q = query.shape[2]
     s_k = key.shape[2]
+    has_kpm = key_padding_mask is not None
+    has_am = attn_mask is not None
 
-    def f(q, k, v, off, cols):
+    def f(q, k, v, off, cols, *masks):
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(q.shape[-1])
         # dense mask from CSR (pure jnp): nnz j belongs to the row whose
         # offset window contains it
@@ -1025,10 +1067,21 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
             return m_bh.at[rows, cols_bh].set(True)
 
         mask = jax.vmap(jax.vmap(fill))(mask, off, cols)
+        it = iter(masks)
+        if has_kpm:
+            # [B, S_k], 0 → key position masked out (reference kernel doc)
+            kpm = next(it)
+            mask = mask & (kpm[:, None, None, :] != 0)
+        if has_am:
+            # [S_q, S_k] additive-style 0/1 mask, 0 → pair masked
+            am = next(it)
+            mask = mask & (am[None, None, :, :] != 0)
         scores = jnp.where(mask, scores, -jnp.inf)
         att = jax.nn.softmax(scores, axis=-1)
         att = jnp.where(jnp.isnan(att), 0.0, att)
         return jnp.einsum("bhqk,bhkd->bhqd", att, v)
 
+    extra = [t for t in (key_padding_mask, attn_mask) if t is not None]
     return op_call(f, query, key, value, sparse_csr_offset,
-                   sparse_csr_columns, name="sparse_attention", n_diff=3)
+                   sparse_csr_columns, *extra, name="sparse_attention",
+                   n_diff=3)

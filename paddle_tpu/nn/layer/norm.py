@@ -131,10 +131,13 @@ class GroupNorm(Layer):
 
 
 class InstanceNorm1D(Layer):
+    # `momentum` is accepted-unused by the reference layer as well: paddle
+    # InstanceNorm*D layers track no running statistics
     def __init__(self, num_features, epsilon=1e-5, momentum=0.9, weight_attr=None,
                  bias_attr=None, data_format="NCL", name=None):
         super().__init__()
         self._epsilon = epsilon
+        self._data_format = data_format
         self.scale = None
         self.bias = None
         if weight_attr is not False:
@@ -144,19 +147,23 @@ class InstanceNorm1D(Layer):
             self.bias = self.create_parameter((num_features,), is_bias=True)
 
     def forward(self, x):
-        return F.instance_norm(x, weight=self.scale, bias=self.bias, eps=self._epsilon)
+        return F.instance_norm(x, weight=self.scale, bias=self.bias,
+                               eps=self._epsilon,
+                               data_format=self._data_format)
 
 
 class InstanceNorm2D(InstanceNorm1D):
     def __init__(self, num_features, epsilon=1e-5, momentum=0.9, weight_attr=None,
                  bias_attr=None, data_format="NCHW", name=None):
-        super().__init__(num_features, epsilon, momentum, weight_attr, bias_attr)
+        super().__init__(num_features, epsilon, momentum, weight_attr, bias_attr,
+                         data_format)
 
 
 class InstanceNorm3D(InstanceNorm1D):
     def __init__(self, num_features, epsilon=1e-5, momentum=0.9, weight_attr=None,
                  bias_attr=None, data_format="NCDHW", name=None):
-        super().__init__(num_features, epsilon, momentum, weight_attr, bias_attr)
+        super().__init__(num_features, epsilon, momentum, weight_attr, bias_attr,
+                         data_format)
 
 
 class LocalResponseNorm(Layer):
@@ -171,6 +178,60 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, *a, **k):
+    """Spectral normalization of an input WEIGHT tensor (≙ reference
+    nn/layer/norm.py SpectralNorm: forward(weight) -> weight / sigma_max,
+    sigma estimated by power iteration on persistent u/v buffers)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 dtype="float32", name=None):
         super().__init__()
-        raise NotImplementedError("SpectralNorm: planned")
+        import numpy as _np
+
+        import jax.numpy as _jnp
+
+        from ...core.tensor import Tensor as _T
+
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = epsilon
+        h = int(weight_shape[dim])
+        w = int(_np.prod(weight_shape)) // h
+        rs = _np.random.RandomState(0)
+        u = rs.randn(h).astype(dtype)
+        v = rs.randn(w).astype(dtype)
+        self.register_buffer("weight_u", _T(_jnp.asarray(
+            u / (_np.linalg.norm(u) + epsilon)), _internal=True,
+            stop_gradient=True))
+        self.register_buffer("weight_v", _T(_jnp.asarray(
+            v / (_np.linalg.norm(v) + epsilon)), _internal=True,
+            stop_gradient=True))
+
+    def forward(self, weight):
+        import jax.numpy as _jnp
+
+        from ...core.dispatch import no_grad, op_call
+
+        dim, eps = self._dim, self._eps
+
+        def _mat(wv):
+            if dim != 0:
+                wv = _jnp.moveaxis(wv, dim, 0)
+            return wv.reshape(wv.shape[0], -1)
+
+        with no_grad():
+            wm = _mat(weight._data)
+            u, v = self.weight_u._data, self.weight_v._data
+            for _ in range(max(1, self._power_iters)):
+                v = wm.T @ u
+                v = v / (_jnp.linalg.norm(v) + eps)
+                u = wm @ v
+                u = u / (_jnp.linalg.norm(u) + eps)
+            self.weight_u._assign_raw(u)
+            self.weight_v._assign_raw(v)
+            uc, vc = u, v
+
+        def f(wv):
+            sigma = uc @ _mat(wv) @ vc
+            return wv / _jnp.maximum(sigma, eps)
+
+        return op_call(f, weight, name="spectral_norm")

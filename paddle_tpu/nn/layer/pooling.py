@@ -16,6 +16,7 @@ class _Pool(Layer):
         self.ceil_mode = ceil_mode
         self.return_mask = return_mask
         self.exclusive = exclusive
+        self.divisor_override = divisor_override
         self.data_format = data_format
         self.output_size = output_size
 
@@ -47,14 +48,16 @@ class AvgPool1D(_Pool):
 class AvgPool2D(_Pool):
     def forward(self, x):
         return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            self.ceil_mode, self.exclusive, None,
+                            self.ceil_mode, self.exclusive,
+                            self.divisor_override,
                             self.data_format or "NCHW")
 
 
 class AvgPool3D(_Pool):
     def forward(self, x):
         return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
-                            self.ceil_mode, self.exclusive, None,
+                            self.ceil_mode, self.exclusive,
+                            self.divisor_override,
                             self.data_format or "NCDHW")
 
 
@@ -91,24 +94,27 @@ class AdaptiveMaxPool1D(Layer):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__()
         self.output_size = output_size
+        self.return_mask = return_mask
 
     def forward(self, x):
-        return F.adaptive_max_pool1d(x, self.output_size)
+        return F.adaptive_max_pool1d(x, self.output_size, self.return_mask)
 
 
 class AdaptiveMaxPool2D(Layer):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__()
         self.output_size = output_size
+        self.return_mask = return_mask
 
     def forward(self, x):
-        return F.adaptive_max_pool2d(x, self.output_size)
+        return F.adaptive_max_pool2d(x, self.output_size, self.return_mask)
 
 
 class AdaptiveMaxPool3D(Layer):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__()
         self.output_size = output_size
+        self.return_mask = return_mask
 
     def forward(self, x):
-        return F.adaptive_max_pool3d(x, self.output_size)
+        return F.adaptive_max_pool3d(x, self.output_size, self.return_mask)

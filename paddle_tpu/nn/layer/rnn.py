@@ -37,12 +37,16 @@ class _RNNBase(Layer):
                 in_sz = input_size if layer == 0 else hidden_size * self.bidirect
                 sfx = f"_l{layer}" + ("_reverse" if d else "")
                 w_ih = self.create_parameter((gate_mult * hidden_size, in_sz),
+                                             attr=weight_ih_attr,
                                              default_initializer=Uniform(-std, std))
                 w_hh = self.create_parameter((gate_mult * hidden_size, hidden_size),
+                                             attr=weight_hh_attr,
                                              default_initializer=Uniform(-std, std))
                 b_ih = self.create_parameter((gate_mult * hidden_size,), is_bias=True,
+                                             attr=bias_ih_attr,
                                              default_initializer=Uniform(-std, std))
                 b_hh = self.create_parameter((gate_mult * hidden_size,), is_bias=True,
+                                             attr=bias_hh_attr,
                                              default_initializer=Uniform(-std, std))
                 self.add_parameter(f"weight_ih{sfx}", w_ih)
                 self.add_parameter(f"weight_hh{sfx}", w_hh)
@@ -88,14 +92,25 @@ class _RNNBase(Layer):
         has_cell = mode == "LSTM"
         step = self._cell(mode)
         weights = [tuple(getattr(self, n) for n in names) for names in self._all_weights]
+        has_init = initial_states is not None
+        has_len = sequence_length is not None
 
-        def run(x, *flat_w):
+        def run(x, *extra):
+            it = iter(extra)
+            init_h = init_c = lens = None
+            if has_init:
+                init_h = next(it)            # [L*D, B, H]
+                if has_cell:
+                    init_c = next(it)
+            if has_len:
+                lens = next(it)              # [B]
+            flat_w = list(it)
             # x: [B, T, C] (or [T, B, C] if time_major)
             if self.time_major:
                 xt = x
             else:
                 xt = jnp.swapaxes(x, 0, 1)  # [T, B, C]
-            b = xt.shape[1]
+            T, b = xt.shape[0], xt.shape[1]
             wi = iter(flat_w)
             layer_in = xt
             last_h, last_c = [], []
@@ -103,14 +118,39 @@ class _RNNBase(Layer):
                 outs_dir = []
                 for d in range(self.bidirect):
                     w_ih, w_hh, b_ih, b_hh = next(wi), next(wi), next(wi), next(wi)
-                    h0 = jnp.zeros((b, self.hidden_size), x.dtype)
-                    carry = (h0, jnp.zeros_like(h0)) if has_cell else (h0,)
+                    li = layer * self.bidirect + d
+                    if has_init:
+                        h0 = init_h[li].astype(x.dtype)
+                        c0 = init_c[li].astype(x.dtype) if has_cell else None
+                    else:
+                        h0 = jnp.zeros((b, self.hidden_size), x.dtype)
+                        c0 = jnp.zeros_like(h0) if has_cell else None
+                    carry = (h0, c0) if has_cell else (h0,)
                     seq = jnp.flip(layer_in, 0) if d == 1 else layer_in
+                    if lens is not None:
+                        # valid-step mask [T, B]: padded steps keep the carry
+                        # and emit zeros; a reversed scan walks the padding
+                        # first, passing h0 through until the valid suffix —
+                        # equivalent to reversing only the valid segment
+                        # (reference rnn sequence_length semantics)
+                        tidx = jnp.arange(T)[:, None]
+                        valid = (tidx < lens[None, :]) if d == 0 else \
+                            (jnp.flip(tidx, 0) < lens[None, :])
 
-                    def body(c, xt_):
-                        return step(c, xt_, w_ih, w_hh, b_ih, b_hh)
+                        def body(c, inp):
+                            xt_, m = inp
+                            c2, y = step(c, xt_, w_ih, w_hh, b_ih, b_hh)
+                            mm = m[:, None]
+                            c3 = tuple(jnp.where(mm, n, o)
+                                       for n, o in zip(c2, c))
+                            return c3, jnp.where(mm, y, 0.0)
 
-                    carry, ys = jax.lax.scan(body, carry, seq)
+                        carry, ys = jax.lax.scan(body, carry, (seq, valid))
+                    else:
+                        def body(c, xt_):
+                            return step(c, xt_, w_ih, w_hh, b_ih, b_hh)
+
+                        carry, ys = jax.lax.scan(body, carry, seq)
                     if d == 1:
                         ys = jnp.flip(ys, 0)
                     outs_dir.append(ys)
@@ -125,8 +165,17 @@ class _RNNBase(Layer):
                 return out, hs, jnp.stack(last_c)
             return out, hs
 
+        extra = []
+        if has_init:
+            if has_cell:
+                extra += [initial_states[0], initial_states[1]]
+            else:
+                extra.append(initial_states if not isinstance(
+                    initial_states, (list, tuple)) else initial_states[0])
+        if has_len:
+            extra.append(sequence_length)
         flat = [w for ws in weights for w in ws]
-        res = op_call(run, inputs, *flat, name=mode.lower())
+        res = op_call(run, inputs, *extra, *flat, name=mode.lower())
         if has_cell:
             out, h, c = res
             return out, (h, c)

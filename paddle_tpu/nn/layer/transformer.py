@@ -12,10 +12,18 @@ from .norm import LayerNorm
 
 class MultiHeadAttention(Layer):
     """Paddle layout: query [batch, seq, embed]; internally [B, S, H, D] to hit
-    the flash path."""
+    the flash path.
 
-    Cache = tuple
-    StaticCache = tuple
+    Cache protocol ≙ reference nn/layer/transformer.py:176 — `Cache` holds
+    incremental (growing) projected k/v for decoder self-attention;
+    `StaticCache` holds fixed k/v computed once from encoder memory for
+    cross-attention. Cached tensors here are [B, S, H, D] (this layer's
+    internal layout)."""
+
+    import collections as _collections
+
+    Cache = _collections.namedtuple("Cache", ["k", "v"])
+    StaticCache = _collections.namedtuple("StaticCache", ["k", "v"])
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
                  need_weights=False, weight_attr=None, bias_attr=None):
@@ -36,32 +44,63 @@ class MultiHeadAttention(Layer):
         b, s, _ = x.shape
         return reshape(x, [b, s, self.num_heads, self.head_dim])
 
+    def compute_kv(self, key, value):
+        return (self._split_heads(self.k_proj(key)),
+                self._split_heads(self.v_proj(value)))
+
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
         key = query if key is None else key
         value = query if value is None else value
         q = self._split_heads(self.q_proj(query))
-        k = self._split_heads(self.k_proj(key))
-        v = self._split_heads(self.v_proj(value))
-        if cache is not None:
-            pk, pv = cache
-            k = concat([pk, k], axis=1)
-            v = concat([pv, v], axis=1)
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask,
-            dropout_p=self.dropout, is_causal=False, training=self.training)
+        out_cache = None
+        if isinstance(cache, MultiHeadAttention.StaticCache):
+            k, v = cache.k, cache.v  # fixed encoder memory projections
+            out_cache = cache
+        else:
+            k, v = self.compute_kv(key, value)
+            if cache is not None:
+                k = concat([cache[0], k], axis=1)
+                v = concat([cache[1], v], axis=1)
+                out_cache = MultiHeadAttention.Cache(k, v)
+        if self.need_weights:
+            import math as _m
+
+            from ...ops.linalg import matmul as _mm
+
+            qh = transpose(q, [0, 2, 1, 3])  # [B, H, Sq, D]
+            kh = transpose(k, [0, 2, 1, 3])
+            vh = transpose(v, [0, 2, 1, 3])
+            scores = _mm(qh, kh, transpose_y=True) * (1.0 / _m.sqrt(self.head_dim))
+            if attn_mask is not None:
+                scores = scores + attn_mask
+            weights = F.softmax(scores, axis=-1)
+            out = transpose(_mm(weights, vh), [0, 2, 1, 3])
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask,
+                dropout_p=self.dropout, is_causal=False, training=self.training)
         b, s = out.shape[0], out.shape[1]
         out = reshape(out, [b, s, self.embed_dim])
         out = self.out_proj(out)
+        outs = [out]
+        if self.need_weights:
+            outs.append(weights)
         if cache is not None:
-            return out, (k, v)
-        return out
+            outs.append(out_cache)
+        return out if len(outs) == 1 else tuple(outs)
 
     def gen_cache(self, key, value=None, type=None):
         from ...ops.creation import zeros
 
+        if type is MultiHeadAttention.StaticCache:
+            return MultiHeadAttention.StaticCache(
+                *self.compute_kv(key, key if value is None else value))
+        if value is not None:  # pre-projected k/v handed in directly
+            return MultiHeadAttention.Cache(key, value)
         b = key.shape[0]
-        return (zeros([b, 0, self.num_heads, self.head_dim], dtype=key.dtype),
-                zeros([b, 0, self.num_heads, self.head_dim], dtype=key.dtype))
+        return MultiHeadAttention.Cache(
+            zeros([b, 0, self.num_heads, self.head_dim], dtype=key.dtype),
+            zeros([b, 0, self.num_heads, self.head_dim], dtype=key.dtype))
 
 
 class TransformerEncoderLayer(Layer):
@@ -86,7 +125,11 @@ class TransformerEncoderLayer(Layer):
         residual = src
         if self.normalize_before:
             src = self.norm1(src)
-        src = self.self_attn(src, src, src, attn_mask=src_mask)
+        if cache is not None:
+            src, new_cache = self.self_attn(src, src, src, attn_mask=src_mask,
+                                            cache=cache)
+        else:
+            src = self.self_attn(src, src, src, attn_mask=src_mask)
         src = residual + self.dropout1(src)
         if not self.normalize_before:
             src = self.norm1(src)
@@ -97,7 +140,10 @@ class TransformerEncoderLayer(Layer):
         src = residual + self.dropout2(src)
         if not self.normalize_before:
             src = self.norm2(src)
-        return src
+        return src if cache is None else (src, new_cache)
+
+    def gen_cache(self, src):
+        return self.self_attn.gen_cache(src)
 
 
 class TransformerEncoder(Layer):
@@ -110,13 +156,21 @@ class TransformerEncoder(Layer):
         self.num_layers = num_layers
         self.norm = norm
 
-    def forward(self, src, src_mask=None):
+    def forward(self, src, src_mask=None, cache=None):
         out = src
-        for layer in self.layers:
-            out = layer(out, src_mask=src_mask)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if cache is not None:
+                out, nc = layer(out, src_mask=src_mask, cache=cache[i])
+                new_caches.append(nc)
+            else:
+                out = layer(out, src_mask=src_mask)
         if self.norm is not None:
             out = self.norm(out)
-        return out
+        return out if cache is None else (out, new_caches)
+
+    def gen_cache(self, src):
+        return [layer.gen_cache(src) for layer in self.layers]
 
 
 class TransformerDecoderLayer(Layer):
@@ -126,11 +180,13 @@ class TransformerDecoderLayer(Layer):
         super().__init__()
         self.normalize_before = normalize_before
         self.self_attn = MultiHeadAttention(d_model, nhead,
-                                            attn_dropout if attn_dropout is not None else dropout)
+                                            attn_dropout if attn_dropout is not None else dropout,
+                                            weight_attr=weight_attr, bias_attr=bias_attr)
         self.cross_attn = MultiHeadAttention(d_model, nhead,
-                                             attn_dropout if attn_dropout is not None else dropout)
-        self.linear1 = Linear(d_model, dim_feedforward)
-        self.linear2 = Linear(dim_feedforward, d_model)
+                                             attn_dropout if attn_dropout is not None else dropout,
+                                             weight_attr=weight_attr, bias_attr=bias_attr)
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)
         self.norm1 = LayerNorm(d_model, epsilon=layer_norm_eps)
         self.norm2 = LayerNorm(d_model, epsilon=layer_norm_eps)
         self.norm3 = LayerNorm(d_model, epsilon=layer_norm_eps)
@@ -141,17 +197,30 @@ class TransformerDecoderLayer(Layer):
         self.activation = getattr(F, activation)
 
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
+        # cache = (incremental Cache for self-attn, StaticCache for
+        # cross-attn), per reference TransformerDecoderLayer semantics
+        inc_cache, static_cache = cache if cache is not None else (None, None)
+        new_inc = None
         residual = tgt
         if self.normalize_before:
             tgt = self.norm1(tgt)
-        tgt = self.self_attn(tgt, attn_mask=tgt_mask)
+        if inc_cache is not None:
+            tgt, new_inc = self.self_attn(tgt, attn_mask=tgt_mask,
+                                          cache=inc_cache)
+        else:
+            tgt = self.self_attn(tgt, attn_mask=tgt_mask)
         tgt = residual + self.dropout1(tgt)
         if not self.normalize_before:
             tgt = self.norm1(tgt)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm2(tgt)
-        tgt = self.cross_attn(tgt, memory, memory, attn_mask=memory_mask)
+        if static_cache is not None:
+            tgt, _ = self.cross_attn(tgt, memory, memory,
+                                     attn_mask=memory_mask,
+                                     cache=static_cache)
+        else:
+            tgt = self.cross_attn(tgt, memory, memory, attn_mask=memory_mask)
         tgt = residual + self.dropout2(tgt)
         if not self.normalize_before:
             tgt = self.norm2(tgt)
@@ -162,7 +231,12 @@ class TransformerDecoderLayer(Layer):
         tgt = residual + self.dropout3(tgt)
         if not self.normalize_before:
             tgt = self.norm3(tgt)
-        return tgt
+        return tgt if cache is None else (tgt, (new_inc, static_cache))
+
+    def gen_cache(self, memory):
+        return (self.self_attn.gen_cache(memory),
+                self.cross_attn.gen_cache(memory, memory,
+                                          type=MultiHeadAttention.StaticCache))
 
 
 class TransformerDecoder(Layer):
@@ -173,13 +247,24 @@ class TransformerDecoder(Layer):
         self.num_layers = num_layers
         self.norm = norm
 
-    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None):
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
         out = tgt
-        for layer in self.layers:
-            out = layer(out, memory, tgt_mask=tgt_mask, memory_mask=memory_mask)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if cache is not None:
+                out, nc = layer(out, memory, tgt_mask=tgt_mask,
+                                memory_mask=memory_mask, cache=cache[i])
+                new_caches.append(nc)
+            else:
+                out = layer(out, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)
         if self.norm is not None:
             out = self.norm(out)
-        return out
+        return out if cache is None else (out, new_caches)
+
+    def gen_cache(self, memory, do_zip=False):
+        cache = [layer.gen_cache(memory) for layer in self.layers]
+        return list(zip(*cache)) if do_zip else cache
 
 
 class Transformer(Layer):
@@ -196,7 +281,8 @@ class Transformer(Layer):
         else:
             enc_layer = TransformerEncoderLayer(d_model, nhead, dim_feedforward, dropout,
                                                 activation, attn_dropout, act_dropout,
-                                                normalize_before)
+                                                normalize_before, weight_attr,
+                                                bias_attr)
             self.encoder = TransformerEncoder(enc_layer, num_encoder_layers,
                                               LayerNorm(d_model) if normalize_before else None)
         if custom_decoder is not None:
@@ -204,7 +290,8 @@ class Transformer(Layer):
         else:
             dec_layer = TransformerDecoderLayer(d_model, nhead, dim_feedforward, dropout,
                                                 activation, attn_dropout, act_dropout,
-                                                normalize_before)
+                                                normalize_before, weight_attr,
+                                                bias_attr)
             self.decoder = TransformerDecoder(dec_layer, num_decoder_layers,
                                               LayerNorm(d_model) if normalize_before else None)
 

@@ -198,16 +198,35 @@ class Layer:
     def state_dict(self, destination=None, include_sublayers=True,
                    structured_name_prefix="", use_hook=True):
         dest = destination if destination is not None else collections.OrderedDict()
-        for name, p in self.named_parameters(prefix=structured_name_prefix):
-            dest[name] = p
-        for name, _, layer in self._walk(prefix=structured_name_prefix):
-            for bname, b in layer._buffers.items():
-                if b is not None and bname not in layer._non_persistable_buffer_names:
-                    dest[f"{_}{bname}" if _ else bname] = b
+        if include_sublayers:
+            for name, p in self.named_parameters(prefix=structured_name_prefix):
+                dest[name] = p
+            for name, _, layer in self._walk(prefix=structured_name_prefix):
+                for bname, b in layer._buffers.items():
+                    if b is not None and bname not in layer._non_persistable_buffer_names:
+                        dest[f"{_}{bname}" if _ else bname] = b
+        else:
+            for name, p in self._parameters.items():
+                if p is not None:
+                    dest[f"{structured_name_prefix}{name}"] = p
+            for bname, b in self._buffers.items():
+                if b is not None and bname not in self._non_persistable_buffer_names:
+                    dest[f"{structured_name_prefix}{bname}"] = b
+        if use_hook:
+            for hook in getattr(self, "_state_dict_hooks", {}).values():
+                out = hook(dest)
+                if out is not None:
+                    dest = out
         return dest
 
     def set_state_dict(self, state_dict, use_structured_name=True):
-        own = self.state_dict()
+        # hooks (e.g. amp save_dtype's cast) return COPIES; loading must
+        # target the live parameters
+        own = self.state_dict(use_hook=False)
+        if not use_structured_name:
+            # keys are raw parameter .name attributes, not structured paths
+            by_name = {getattr(p, "name", None): k for k, p in own.items()}
+            state_dict = {by_name.get(k, k): v for k, v in state_dict.items()}
         missing, unexpected = [], []
         for k, v in state_dict.items():
             if k in own:
@@ -237,6 +256,13 @@ class Layer:
 
     # ------------------------------------------------------------ movement
     def to(self, device=None, dtype=None, blocking=None):
+        # device is validated but placement is a no-op: this process owns one
+        # logical XLA device and the runtime manages residency (`blocking`
+        # likewise — transfers are async under XLA's dependency tracking)
+        if device is not None:
+            from ..core.device import _validate_place
+
+            _validate_place(device)
         if dtype is not None:
             self._to_dtype(dtypes.convert_dtype(dtype))
         return self

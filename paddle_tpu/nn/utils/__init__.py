@@ -1,0 +1,176 @@
+"""paddle.nn.utils ≙ /root/reference/python/paddle/nn/utils/__init__.py:
+weight_norm / remove_weight_norm (weight_norm_hook.py), spectral_norm
+(spectral_norm_hook.py), parameters_to_vector / vector_to_parameters
+(transform_parameters.py), clip_grad_norm_ / clip_grad_value_.
+
+TPU-native mechanics: the reparameterizations install forward-PRE-hooks that
+recompute the effective weight from the decomposed parameters with dispatched
+ops, so gradients flow to (g, v) through the tape and the whole computation
+traces into the compiled step under to_static.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import no_grad, op_call
+from ...core.tensor import Tensor
+from ..clip import clip_grad_norm_, clip_grad_value_  # noqa: F401
+
+__all__ = [
+    "weight_norm", "remove_weight_norm", "spectral_norm",
+    "parameters_to_vector", "vector_to_parameters",
+    "clip_grad_norm_", "clip_grad_value_",
+]
+
+
+def _norm_except(w, dim):
+    """||w|| reduced over every axis except `dim` (keepdims)."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(w * w))
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+
+
+def _wn_compute(g, v, dim):
+    def f(gv, vv):
+        return gv * vv / jnp.maximum(_norm_except(vv, dim), 1e-12)
+
+    return op_call(f, g, v, name="weight_norm_recompute")
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize `layer.<name>` as g * v/||v|| (Salimans & Kingma).
+    ≙ reference weight_norm_hook.py: the effective weight is recomputed in
+    a forward-pre-hook each call."""
+    if hasattr(layer, f"{name}_g"):
+        raise ValueError(f"weight_norm already applied to {name}")
+    w = getattr(layer, name)
+    if name not in layer._parameters:
+        raise ValueError(f"{name} is not a Parameter of the layer")
+    with no_grad():
+        vdata = w._data
+        gdata = np.asarray(_norm_except(vdata, dim))
+    from ...core.tensor import Parameter
+
+    g = Parameter(jnp.asarray(gdata), _internal=True)
+    v = Parameter(vdata, _internal=True)
+    del layer._parameters[name]
+    if name in layer.__dict__:
+        del layer.__dict__[name]
+    layer.add_parameter(f"{name}_g", g)
+    layer.add_parameter(f"{name}_v", v)
+
+    def hook(lyr, inputs):
+        object.__setattr__(lyr, name,
+                           _wn_compute(getattr(lyr, f"{name}_g"),
+                                       getattr(lyr, f"{name}_v"), dim))
+        return inputs
+
+    # prime once so the attribute exists before any forward
+    hook(layer, None)
+    h = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_hooks = getattr(layer, "_weight_norm_hooks", {})
+    layer._weight_norm_hooks[name] = (h, dim)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"weight_norm not applied to {name}")
+    h, dim = hooks.pop(name)
+    h.remove()
+    g = layer._parameters.pop(f"{name}_g")
+    v = layer._parameters.pop(f"{name}_v")
+    from ...core.tensor import Parameter
+
+    with no_grad():
+        wdata = np.asarray(_wn_compute(g, v, dim)._data)
+    if name in layer.__dict__:
+        del layer.__dict__[name]
+    layer.add_parameter(name, Parameter(jnp.asarray(wdata), _internal=True))
+    return layer
+
+
+def _sn_reshape(w, dim):
+    """Move `dim` to the front and flatten the rest → [d, prod(rest)]."""
+    if dim != 0:
+        w = jnp.moveaxis(w, dim, 0)
+    return w.reshape(w.shape[0], -1)
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Divide `layer.<name>` by its largest singular value, estimated by
+    power iteration on a persistent `u` vector (≙ spectral_norm_hook.py)."""
+    if dim is None:
+        # paddle/torch default: dim 1 for transposed-conv-style layers
+        dim = 1 if type(layer).__name__ in (
+            "Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose",
+            "Linear") else 0
+    w = getattr(layer, name)
+    wm = _sn_reshape(w._data, dim)
+    rs = np.random.RandomState(0)
+    u0 = rs.randn(wm.shape[0]).astype(np.asarray(wm).dtype)
+    u0 /= np.linalg.norm(u0) + eps
+    layer.register_buffer(f"{name}_u", Tensor(jnp.asarray(u0),
+                                              _internal=True,
+                                              stop_gradient=True),
+                          persistable=True)
+    orig = layer._parameters.pop(name)
+    if name in layer.__dict__:
+        del layer.__dict__[name]
+    layer.add_parameter(f"{name}_orig", orig)
+
+    def hook(lyr, inputs):
+        worig = getattr(lyr, f"{name}_orig")
+        ub = getattr(lyr, f"{name}_u")
+        with no_grad():
+            wm_ = _sn_reshape(worig._data, dim)
+            u = ub._data
+            for _ in range(max(1, int(n_power_iterations))):
+                v = wm_.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = wm_ @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            ub._assign_raw(u)
+            vconst, uconst = v, u
+
+        def f(wv):
+            sigma = uconst @ _sn_reshape(wv, dim) @ vconst
+            return wv / jnp.maximum(sigma, eps)
+
+        object.__setattr__(lyr, name,
+                           op_call(f, worig, name="spectral_norm_recompute"))
+        return inputs
+
+    hook(layer, None)
+    layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """Concatenate flattened parameters into one 1-D Tensor
+    (≙ transform_parameters.py)."""
+    ps = list(parameters)
+
+    def f(*arrs):
+        return jnp.concatenate([a.reshape(-1) for a in arrs])
+
+    return op_call(f, *ps, name="parameters_to_vector")
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Slice a flat vector back into the given parameters (in-place)."""
+    ps = list(parameters)
+    with no_grad():
+        data = vec._data
+        ofs = 0
+        for p in ps:
+            n = int(np.prod(p.shape))
+            p._assign_raw(data[ofs:ofs + n].reshape(tuple(p.shape))
+                          .astype(p._data.dtype))
+            ofs += n
+    if ofs != int(data.shape[0]):
+        raise ValueError("vector length does not match total parameter size")

@@ -184,11 +184,20 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
                      default_initializer=None):
     from ..core.tensor import Parameter
 
-    if default_initializer is not None:
+    attr_init = getattr(attr, "initializer", None) if attr is not None \
+        else None
+    if default_initializer is not None and attr_init is None:
         t = default_initializer(shape, dtype)
         data = t._data if isinstance(t, Tensor) else jnp.asarray(t)
     else:
         data = jnp.zeros(_shape(shape), dtypes.convert_dtype(dtype)) if is_bias else \
             jax.random.normal(jax.random.PRNGKey(0), _shape(shape)).astype(
                 dtypes.convert_dtype(dtype)) * 0.02
-    return Parameter(data, _internal=True)
+    p = Parameter(data, _internal=True)
+    if attr_init is not None:
+        # ParamAttr initializer takes priority (reference semantics);
+        # nn.initializer instances mutate the parameter in place
+        attr_init(p)
+    if attr is not None and getattr(attr, "trainable", True) is False:
+        p.stop_gradient = True
+    return p

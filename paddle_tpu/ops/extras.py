@@ -361,8 +361,12 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
             fn = jax.vmap(fn)
         return fn(lu_, piv)
 
-    out = op_call(f, x, y, name="lu_unpack", n_diff=0)
-    return out
+    p_, l_, u_ = op_call(f, x, y, name="lu_unpack", n_diff=0)
+    # the unpack_* switches suppress computing/returning the matching parts
+    # (reference lu_unpack attrs); suppressed slots return None
+    return (p_ if unpack_pivots else None,
+            l_ if unpack_ludata else None,
+            u_ if unpack_ludata else None)
 
 
 def ormqr(x, tau, other, left=True, transpose=False, name=None):
@@ -453,31 +457,59 @@ def svd_lowrank(x, q=6, niter=2, M=None, name=None):
 
 def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
                    k=0, mode="truncated", return_top=False, name=None):
-    """Nucleus sampling per row (≙ phi top_p_sampling fused kernel):
-    keep the smallest prefix of sorted probs with cumsum ≥ p, renormalize,
-    sample. Returns (sampled scores, sampled ids)."""
+    """Nucleus sampling per row (≙ phi top_p_sampling fused kernel,
+    /root/reference/python/paddle/tensor/search.py:1402): keep the smallest
+    prefix of sorted probs with cumsum ≥ p (optionally also top-k truncated
+    and threshold-filtered), renormalize, sample. Returns (scores, ids), or
+    (scores, ids, topk_scores, topk_ids) when return_top."""
     from ..core.rng import next_key
 
-    key = next_key()
+    if mode != "truncated":
+        raise NotImplementedError(
+            "top_p_sampling(mode='non-truncated') is not supported; the "
+            "truncated nucleus strategy is the shipped path")
+    key = jax.random.PRNGKey(int(seed)) if seed >= 0 else next_key()
+    kk = int(k)
+    thr = threshold._data if hasattr(threshold, "_data") else threshold
+    tseed = topp_seed._data if hasattr(topp_seed, "_data") else topp_seed
 
-    def f(probs, p):
+    def f(probs, p, *opt):
         srt = jnp.sort(probs, axis=-1)[..., ::-1]
         idx = jnp.argsort(probs, axis=-1)[..., ::-1]
         cum = jnp.cumsum(srt, axis=-1)
-        keep = cum - srt < p  # first index where cumsum(prev) >= p is cut
+        pcol = p.reshape(-1, 1) if p.ndim else p
+        keep = cum - srt < pcol  # first index where cumsum(prev) >= p is cut
+        pos = jnp.arange(srt.shape[-1])
+        if kk > 0:
+            keep = keep & (pos[None, :] < kk)
+        it = iter(opt)
+        if thr is not None:
+            t = next(it)
+            keep = keep & (srt >= t.reshape(-1, 1))
+        keep = keep.at[..., 0].set(True)  # never empty: top-1 survives
         masked = jnp.where(keep, srt, 0.0)
         masked = masked / jnp.sum(masked, axis=-1, keepdims=True)
         flat = masked.reshape(-1, masked.shape[-1])
-        keys = jax.random.split(key, flat.shape[0])
+        if tseed is not None:
+            t2 = next(it)
+            keys = jax.vmap(lambda s: jax.random.PRNGKey(s.astype(jnp.int64)
+                                                         .astype(jnp.uint32)))(
+                t2.reshape(-1))
+        else:
+            keys = jax.random.split(key, flat.shape[0])
         picks = jax.vmap(
-            lambda kk, pp: jax.random.choice(kk, pp.shape[-1], p=pp))(
+            lambda kk_, pp: jax.random.choice(kk_, pp.shape[-1], p=pp))(
             keys, flat)
         picks = picks.reshape(masked.shape[:-1])
         ids = jnp.take_along_axis(idx, picks[..., None], axis=-1)[..., 0]
         scores = jnp.take_along_axis(probs, ids[..., None], axis=-1)[..., 0]
-        return scores, ids[..., None]
+        if not return_top:
+            return scores, ids[..., None]
+        nt = max(kk, 1)
+        return (scores, ids[..., None], srt[..., :nt], idx[..., :nt])
 
-    return op_call(f, x, ps, name="top_p_sampling", n_diff=0)
+    extra = [t for t in (threshold, topp_seed) if t is not None]
+    return op_call(f, x, ps, *extra, name="top_p_sampling", n_diff=0)
 
 
 def create_tensor(dtype="float32", name=None, persistable=False):

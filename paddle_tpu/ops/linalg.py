@@ -106,7 +106,16 @@ def eig(x, name=None):
 
 
 def eigh(x, UPLO="L", name=None):
-    return op_call(lambda a: tuple(jnp.linalg.eigh(a, symmetrize_input=True)), x, name="eigh")
+    # UPLO selects which triangle holds the matrix: mirror it across the
+    # diagonal rather than averaging, per reference eigh semantics
+    def f(a):
+        i = jnp.arange(a.shape[-1])
+        keep = i[:, None] >= i[None, :] if UPLO == "L" else \
+            i[:, None] <= i[None, :]
+        sym = jnp.where(keep, a, jnp.swapaxes(jnp.conj(a), -1, -2))
+        return tuple(jnp.linalg.eigh(sym, symmetrize_input=False))
+
+    return op_call(f, x, name="eigh")
 
 
 def eigvals(x, name=None):
@@ -114,7 +123,14 @@ def eigvals(x, name=None):
 
 
 def eigvalsh(x, UPLO="L", name=None):
-    return op_call(jnp.linalg.eigvalsh, x, name="eigvalsh")
+    def f(a):
+        i = jnp.arange(a.shape[-1])
+        keep = i[:, None] >= i[None, :] if UPLO == "L" else \
+            i[:, None] <= i[None, :]
+        sym = jnp.where(keep, a, jnp.swapaxes(jnp.conj(a), -1, -2))
+        return jnp.linalg.eigvalsh(sym)
+
+    return op_call(f, x, name="eigvalsh")
 
 
 def inverse(x, name=None):
@@ -165,6 +181,12 @@ def lstsq(x, y, rcond=None, driver=None, name=None):
 
 
 def lu(x, pivot=True, get_infos=False, name=None):
+    if not pivot:
+        raise NotImplementedError(
+            "lu(pivot=False): XLA's LU is always partial-pivoted "
+            "(jax.scipy.linalg.lu_factor); the unpivoted factorization "
+            "is numerically unstable and unsupported here")
+
     def f(a):
         lu_, piv = jax.scipy.linalg.lu_factor(a)
         return lu_, (piv + 1).astype(jnp.int32)
@@ -198,7 +220,10 @@ def corrcoef(x, rowvar=True, name=None):
 
 
 def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
-    return op_call(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0),
+    fw = fweights._data if hasattr(fweights, "_data") else fweights
+    aw = aweights._data if hasattr(aweights, "_data") else aweights
+    return op_call(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                                     fweights=fw, aweights=aw),
                    x, name="cov")
 
 

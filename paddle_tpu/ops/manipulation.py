@@ -277,7 +277,15 @@ def index_put(x, indices, value, accumulate=False, name=None):
 
 def take_along_axis(arr, indices, axis, broadcast=True, name=None):
     def f(a, idx):
-        return jnp.take_along_axis(a, idx.astype(jnp.int32), axis=axis)
+        idx = idx.astype(jnp.int32)
+        if broadcast:
+            return jnp.take_along_axis(a, idx, axis=axis)
+        # broadcast=False ≙ torch.gather: output takes indices' exact
+        # shape, size-1 dims are NOT expanded against arr
+        ax = axis % a.ndim
+        ii = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+        ii[ax] = idx
+        return a[tuple(ii)]
 
     return op_call(f, arr, indices, name="take_along_axis", n_diff=1)
 
@@ -285,10 +293,28 @@ def take_along_axis(arr, indices, axis, broadcast=True, name=None):
 def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
                    broadcast=True, name=None):
     def f(a, idx, v):
+        ax = axis % a.ndim
         idx = idx.astype(jnp.int32)
         if not isinstance(v, jnp.ndarray) or v.ndim == 0:
             v = jnp.broadcast_to(v, idx.shape).astype(a.dtype)
-        at = _along_axis_at(a, idx, axis)
+        if broadcast:
+            # reference semantics: indices/values broadcast against arr's
+            # shape on every dim except `axis`
+            tgt = a.shape[:ax] + (idx.shape[ax] if idx.ndim == a.ndim
+                                  else idx.shape[-1],) + a.shape[ax + 1:]
+            idx = jnp.broadcast_to(idx, tgt)
+            v = jnp.broadcast_to(v, tgt).astype(a.dtype)
+        if not include_self and reduce != "assign":
+            # excluded original values: scattered positions start from the
+            # reduction identity instead of a's value
+            flt = jnp.issubdtype(a.dtype, jnp.floating)
+            lo = -jnp.inf if flt else jnp.iinfo(a.dtype).min
+            hi = jnp.inf if flt else jnp.iinfo(a.dtype).max
+            ident = {"add": 0, "sum": 0, "mul": 1, "multiply": 1,
+                     "amax": lo, "amin": hi, "mean": 0}[reduce]
+            a = _along_axis_at(a, idx, ax).set(
+                jnp.full(idx.shape, ident, a.dtype))
+        at = _along_axis_at(a, idx, ax)
         if reduce == "assign":
             return at.set(v)
         if reduce in ("add", "sum"):
@@ -299,6 +325,12 @@ def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=Tru
             return at.max(v)
         if reduce == "amin":
             return at.min(v)
+        if reduce == "mean":
+            summed = at.add(v)
+            base = jnp.full(a.shape, 1 if include_self else 0, jnp.int32)
+            cnt = _along_axis_at(base, idx, ax).add(jnp.ones(idx.shape,
+                                                             jnp.int32))
+            return summed / jnp.maximum(cnt, 1).astype(a.dtype)
         raise ValueError(reduce)
 
     if isinstance(values, Tensor):
@@ -394,12 +426,18 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
         nd = a.ndim
         if len(padv) == 2 * nd:
             width = [(padv[2 * i], padv[2 * i + 1]) for i in range(nd)]
+        elif len(padv) == 2 * (nd - 2) and nd >= 3 \
+                and not data_format.startswith("NC"):
+            # channel-last (NLC/NHWC/NDHWC): the spatial dims sit at 1..nd-2
+            k = len(padv) // 2
+            width = [(0, 0)] + [(padv[2 * i], padv[2 * i + 1])
+                                for i in range(k)][::-1] + [(0, 0)]
         else:
-            # paddle convention: pad applies to last len(pad)//2 dims, reversed pairs
+            # paddle convention: pair i applies to the i-th dim from the end
             k = len(padv) // 2
             width = [(0, 0)] * (nd - k) + [
                 (padv[2 * i], padv[2 * i + 1]) for i in range(k)
-            ]
+            ][::-1]
         if mode == "constant":
             return jnp.pad(a, width, constant_values=value)
         jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
@@ -423,7 +461,11 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False,
                     return_counts=return_counts, axis=axis)
     if not isinstance(res, tuple):
         res = (res,)
-    outs = [Tensor(jnp.asarray(r), _internal=True) for r in res]
+    # `dtype` governs the index-typed outputs (indices/inverse/counts),
+    # not the values (reference tensor/manipulation.py unique)
+    idt = np.dtype(dtype)
+    outs = [Tensor(jnp.asarray(r if i == 0 else r.astype(idt)),
+                   _internal=True) for i, r in enumerate(res)]
     return outs[0] if len(outs) == 1 else tuple(outs)
 
 
@@ -434,13 +476,14 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
         a = a.reshape(-1)
         keep = np.concatenate([[True], a[1:] != a[:-1]])
         out = a[keep]
+        idt = np.dtype(dtype)
         outs = [Tensor(jnp.asarray(out), _internal=True)]
         if return_inverse:
-            inv = np.cumsum(keep) - 1
+            inv = (np.cumsum(keep) - 1).astype(idt)
             outs.append(Tensor(jnp.asarray(inv), _internal=True))
         if return_counts:
             idx = np.flatnonzero(keep)
-            cnt = np.diff(np.append(idx, a.size))
+            cnt = np.diff(np.append(idx, a.size)).astype(idt)
             outs.append(Tensor(jnp.asarray(cnt), _internal=True))
         return outs[0] if len(outs) == 1 else tuple(outs)
     raise NotImplementedError("unique_consecutive with axis")
@@ -456,8 +499,14 @@ def sort(x, axis=-1, descending=False, stable=False, name=None):
 
 def argsort(x, axis=-1, descending=False, stable=False, name=None):
     def f(a):
-        idx = jnp.argsort(a, axis=axis, stable=True)
-        return jnp.flip(idx, axis=axis).astype(jnp.int64) if descending else idx.astype(jnp.int64)
+        if not descending:
+            return jnp.argsort(a, axis=axis, stable=True).astype(jnp.int64)
+        if stable:
+            # flipping a stable ascending argsort reverses tie order; a
+            # stable DESCENDING sort must keep ties in original order
+            return jnp.argsort(-a, axis=axis, stable=True).astype(jnp.int64)
+        return jnp.flip(jnp.argsort(a, axis=axis, stable=True),
+                        axis=axis).astype(jnp.int64)
 
     return op_call(f, x, name="argsort", n_diff=0)
 

@@ -75,10 +75,16 @@ negative = globals()["neg"]
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
     s, b = scale, bias
+    acts = {None: lambda v: v, "relu": jax.nn.relu, "tanh": jnp.tanh,
+            "sigmoid": jax.nn.sigmoid, "softmax": jax.nn.softmax,
+            "gelu": jax.nn.gelu, "leaky_relu": jax.nn.leaky_relu}
+    if act not in acts:
+        raise ValueError(f"scale: unsupported act {act!r}")
+    fn = acts[act]
     if bias_after_scale:
-        out = op_call(lambda a: a * s + b, x, name="scale")
+        out = op_call(lambda a: fn(a * s + b), x, name="scale")
     else:
-        out = op_call(lambda a: (a + b) * s, x, name="scale")
+        out = op_call(lambda a: fn((a + b) * s), x, name="scale")
     return out
 
 
@@ -177,6 +183,10 @@ def logcumsumexp(x, axis=None, dtype=None, name=None):
     ax = norm_axis(axis)
 
     def f(a):
+        if dtype is not None:
+            from ..core import dtype as _dtypes
+
+            a = a.astype(_dtypes.convert_dtype(dtype))
         a2 = a.reshape(-1) if ax is None else a
         axx = 0 if ax is None else ax
 

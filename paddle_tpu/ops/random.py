@@ -16,6 +16,13 @@ def _mk(data):
     return Tensor(data, _internal=True)
 
 
+def _key(seed):
+    """seed=0 means "draw from the global stateful stream" (reference
+    convention, python/paddle/tensor/random.py); a nonzero seed pins the
+    op to a reproducible key independent of global RNG state."""
+    return next_key() if not seed else jax.random.PRNGKey(int(seed))
+
+
 def rand(shape, dtype=None, name=None):
     return _mk(jax.random.uniform(next_key(), _shape(shape), _dt(dtype)))
 
@@ -25,12 +32,12 @@ def randn(shape, dtype=None, name=None):
 
 
 def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
-    return _mk(jax.random.uniform(next_key(), _shape(shape), _dt(dtype),
+    return _mk(jax.random.uniform(_key(seed), _shape(shape), _dt(dtype),
                                   minval=min, maxval=max))
 
 
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
-    x._assign_raw(jax.random.uniform(next_key(), tuple(x.shape), x._data.dtype,
+    x._assign_raw(jax.random.uniform(_key(seed), tuple(x.shape), x._data.dtype,
                                      minval=min, maxval=max))
     return x
 
@@ -50,7 +57,7 @@ def normal_(x, mean=0.0, std=1.0, name=None):
 
 
 def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
-    return _mk(jax.random.normal(next_key(), _shape(shape), _dt(dtype)) * std + mean)
+    return _mk(jax.random.normal(_key(seed), _shape(shape), _dt(dtype)) * std + mean)
 
 
 def standard_normal(shape, dtype=None, name=None):

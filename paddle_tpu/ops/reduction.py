@@ -91,7 +91,30 @@ def var(x, axis=None, unbiased=True, keepdim=False, name=None):
 
 def median(x, axis=None, keepdim=False, mode="avg", name=None):
     ax = norm_axis(axis)
-    return op_call(lambda a: jnp.median(a, axis=ax, keepdims=keepdim), x, name="median")
+    if mode == "avg":
+        return op_call(lambda a: jnp.median(a, axis=ax, keepdims=keepdim),
+                       x, name="median")
+    if mode != "min":
+        raise ValueError(f"median mode must be 'avg' or 'min', got {mode!r}")
+
+    # mode='min': even-length inputs take the LOWER middle element; with an
+    # integer axis the reference also returns its index
+    def f(a):
+        if ax is None:
+            flat = a.reshape(-1)
+            val = jnp.sort(flat)[(flat.shape[0] - 1) // 2]
+            return val.reshape((1,) * a.ndim) if keepdim else val
+        mid = (a.shape[ax] - 1) // 2
+        order = jnp.argsort(a, axis=ax)
+        ind = jnp.take(order, mid, axis=ax)
+        val = jnp.take_along_axis(a, jnp.expand_dims(ind, ax), axis=ax)
+        if not keepdim:
+            val = jnp.squeeze(val, axis=ax)
+        else:
+            ind = jnp.expand_dims(ind, ax)
+        return val, ind.astype(jnp.int64)
+
+    return op_call(f, x, name="median_min", n_diff=0)
 
 
 def nanmedian(x, axis=None, keepdim=False, name=None):

@@ -195,7 +195,17 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
         loss.backward()
-        self.step()
+        if parameters is not None:
+            # reference semantics: only the listed parameters are updated
+            keep = {id(p) for p in parameters}
+            saved = self._parameters
+            self._parameters = [p for p in saved if id(p) in keep]
+            try:
+                self.step()
+            finally:
+                self._parameters = saved
+        else:
+            self.step()
         return None, None
 
     def _decay_l2(self, data, wd):

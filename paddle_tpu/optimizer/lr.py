@@ -248,7 +248,7 @@ class ReduceOnPlateau(LRScheduler):
         from ..core.tensor import Tensor
 
         v = metrics.item() if isinstance(metrics, Tensor) else float(metrics)
-        self.last_epoch += 1
+        self.last_epoch = epoch if epoch is not None else self.last_epoch + 1
         if self.cooldown_counter > 0:
             self.cooldown_counter -= 1
             self.num_bad_epochs = 0
@@ -287,6 +287,7 @@ class OneCycleLR(LRScheduler):
         self.end_lr = end_learning_rate
         self.phase_pct = phase_pct
         self.anneal = anneal_strategy
+        self.three_phase = three_phase
         super().__init__(self.initial_lr, last_epoch, verbose)
 
     def _interp(self, start, end, pct):
@@ -297,6 +298,16 @@ class OneCycleLR(LRScheduler):
     def get_lr(self):
         step = self.last_epoch
         up = int(self.phase_pct * self.total_steps)
+        if self.three_phase:
+            # warmup → symmetric cooldown back to initial_lr → anneal to end
+            if step <= up:
+                return self._interp(self.initial_lr, self.max_lr,
+                                    step / max(up, 1))
+            if step <= 2 * up:
+                return self._interp(self.max_lr, self.initial_lr,
+                                    (step - up) / max(up, 1))
+            pct = (step - 2 * up) / max(self.total_steps - 2 * up, 1)
+            return self._interp(self.initial_lr, self.end_lr, min(pct, 1.0))
         if step <= up:
             return self._interp(self.initial_lr, self.max_lr, step / max(up, 1))
         pct = (step - up) / max(self.total_steps - up, 1)
@@ -312,6 +323,9 @@ class CyclicLR(LRScheduler):
         self.down = step_size_down or step_size_up
         self.mode = mode
         self.exp_gamma = exp_gamma
+        self.scale_fn = scale_fn
+        self.scale_mode = scale_mode if scale_fn is not None else \
+            ("iterations" if mode == "exp_range" else "cycle")
         super().__init__(base_learning_rate, last_epoch, verbose)
 
     def get_lr(self):
@@ -323,6 +337,11 @@ class CyclicLR(LRScheduler):
         else:
             pct = 1 - (pos - self.up) / self.down
         amp = (self.max_lr - self.base_lr) * pct
+        if self.scale_fn is not None:
+            # custom scaling overrides the built-in mode (reference CyclicLR:
+            # scale_mode picks the scale_fn argument — cycle count or step)
+            arg = cycle if self.scale_mode == "cycle" else self.last_epoch
+            return self.base_lr + amp * self.scale_fn(arg)
         if self.mode == "triangular2":
             amp = amp / (2 ** cycle)
         elif self.mode == "exp_range":

@@ -233,11 +233,19 @@ def export_chrome_tracing(dir_name: str, worker_name: str | None = None):
 
 
 def export_protobuf(dir_name: str, worker_name: str | None = None):
-    """Parity shim: the xplane protobuf is written by jax.profiler itself
-    into the Profiler's log_dir; this returns a handler pointing there."""
+    """The xplane protobuf is written by jax.profiler into the Profiler's
+    log_dir; this handler copies the capture into `dir_name` (optionally
+    under a `worker_name` subdirectory, reference tensorboard layout)."""
 
     def handle(prof):
-        prof._chrome_trace_path = prof._log_dir
+        import os
+        import shutil
+
+        dest = os.path.join(dir_name, worker_name) if worker_name else dir_name
+        os.makedirs(dest, exist_ok=True)
+        if getattr(prof, "_log_dir", None) and os.path.isdir(prof._log_dir):
+            shutil.copytree(prof._log_dir, dest, dirs_exist_ok=True)
+        prof._chrome_trace_path = dest
 
     return handle
 

@@ -101,6 +101,10 @@ class PTQ:
         self.config = config
 
     def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
         from ..nn import Linear
 
         for name, child in list(model.named_sublayers()):
@@ -115,6 +119,10 @@ class PTQ:
         return model
 
     def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
         for name, child in list(model.named_sublayers()):
             if isinstance(child, _ObservedLayer):
                 w_scale = child.weight_observer.scales() \

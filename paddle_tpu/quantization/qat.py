@@ -36,6 +36,10 @@ class QAT:
         self.config = config
 
     def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
         from ..nn import Linear
         from .ptq import _warn_unsupported
 
@@ -52,6 +56,10 @@ class QAT:
         return model
 
     def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
         for name, child in list(model.named_sublayers()):
             if isinstance(child, _QATLinear):
                 if child.weight_quanter is None:

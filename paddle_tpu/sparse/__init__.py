@@ -117,6 +117,10 @@ def to_sparse_coo(x, sparse_dim=None) -> Tensor:
     """Dense -> COO. The value gather is dispatched, so gradients flow back
     into the dense source."""
     arr = _data_of(x)
+    if sparse_dim is not None and int(sparse_dim) != len(arr.shape):
+        raise NotImplementedError(
+            "to_sparse_coo: hybrid tensors (sparse_dim < ndim, dense value "
+            "blocks) are not supported; all dims are sparse")
     snapshot = np.asarray(jax.device_get(arr))
     idx = np.argwhere(snapshot != 0)
     gather = tuple(jnp.asarray(idx[:, d]) for d in range(idx.shape[1]))
@@ -209,10 +213,16 @@ def neg(x, name=None):
 def cast(x, index_dtype=None, value_dtype=None, name=None):
     from ..core import dtype as dtypes
 
-    if value_dtype is None:
-        return x
-    return _unary(x, lambda v: v.astype(dtypes.convert_dtype(value_dtype)),
-                  "sparse_cast")
+    out = x
+    if value_dtype is not None:
+        out = _unary(out, lambda v: v.astype(dtypes.convert_dtype(value_dtype)),
+                     "sparse_cast")
+    if index_dtype is not None and getattr(out, "_spidx", None) is not None:
+        if out is x:
+            # cast must be pure: never mutate the input's indices
+            out = _build(x._spvals, x._spidx, x._spshape)
+        out._spidx = out._spidx.astype(dtypes.convert_dtype(index_dtype))
+    return out
 
 
 def transpose(x, perm, name=None):

@@ -25,6 +25,8 @@ class UCIHousing(Dataset):
     """Boston housing regression table (13 features + target per row)."""
 
     def __init__(self, data_file=None, mode="train", download=False):
+        if download:
+            _no_download("UCIHousing", "housing.data")
         if data_file is None:
             _no_download("UCIHousing", "housing.data")
         raw = np.loadtxt(data_file).astype("float32")
@@ -50,6 +52,8 @@ class Imdb(Dataset):
     extracted directory). Builds the vocabulary from the train split."""
 
     def __init__(self, data_file=None, mode="train", cutoff=150, download=False):
+        if download:
+            _no_download("Imdb", "aclImdb_v1.tar.gz (or the extracted dir)")
         if data_file is None:
             _no_download("Imdb", "aclImdb_v1.tar.gz (or the extracted dir)")
         self.mode = mode

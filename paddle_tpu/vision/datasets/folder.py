@@ -104,6 +104,13 @@ class Flowers(Dataset):
     def __init__(self, data_file=None, label_file=None, setid_file=None,
                  mode='train', transform=None, download=False,
                  backend='pil'):
+        if download:
+            raise NotImplementedError(
+                "download=True: this build has no network access; provide "
+                "the local files instead")
+        if backend not in (None, "pil"):
+            raise ValueError(f"unsupported image backend {backend!r}; "
+                             "this build decodes with PIL")
         if data_file is None or label_file is None or setid_file is None:
             raise ValueError(
                 "Flowers: data_file (image dir), label_file (imagelabels.mat)"
@@ -135,6 +142,13 @@ class VOC2012(Dataset):
 
     def __init__(self, data_file=None, mode='train', transform=None,
                  download=False, backend='pil'):
+        if download:
+            raise NotImplementedError(
+                "download=True: this build has no network access; provide "
+                "the local files instead")
+        if backend not in (None, "pil"):
+            raise ValueError(f"unsupported image backend {backend!r}; "
+                             "this build decodes with PIL")
         if data_file is None or not os.path.isdir(data_file):
             raise ValueError(
                 "VOC2012: data_file must point at the extracted "

@@ -18,6 +18,8 @@ class BasicBlock(nn.Layer):
         norm_layer = norm_layer or nn.BatchNorm2D
         if groups != 1 or base_width != 64:
             raise ValueError("BasicBlock only supports groups=1 and base_width=64")
+        if dilation > 1:
+            raise NotImplementedError("Dilation > 1 not supported in BasicBlock")
         self.conv1 = nn.Conv2D(inplanes, planes, 3, padding=1, stride=stride,
                                bias_attr=False)
         self.bn1 = norm_layer(planes)
@@ -102,6 +104,12 @@ class ResNet(nn.Layer):
     def _make_layer(self, block, planes, blocks, stride=1, dilate=False):
         norm_layer = self._norm_layer
         downsample = None
+        previous_dilation = self.dilation
+        if dilate:
+            # replace stride with dilation (dilated-backbone mode used by
+            # segmentation heads; torchvision-compatible semantics)
+            self.dilation *= stride
+            stride = 1
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1, stride=stride,
@@ -110,13 +118,14 @@ class ResNet(nn.Layer):
             )
         layers = [
             block(self.inplanes, planes, stride, downsample, self.groups,
-                  self.base_width, self.dilation, norm_layer)
+                  self.base_width, previous_dilation, norm_layer)
         ]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(
                 block(self.inplanes, planes, groups=self.groups,
-                      base_width=self.base_width, norm_layer=norm_layer))
+                      base_width=self.base_width, dilation=self.dilation,
+                      norm_layer=norm_layer))
         return nn.Sequential(*layers)
 
     def forward(self, x):

@@ -139,4 +139,4 @@ def shufflenet_v2_x2_0(pretrained=False, **kw):
 
 
 def shufflenet_v2_swish(pretrained=False, **kw):
-    return ShuffleNetV2(scale=1.0, act="swish", **kw)
+    return _shufflenet(1.0, pretrained, act="swish", **kw)

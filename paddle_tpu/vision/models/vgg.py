@@ -61,8 +61,8 @@ class VGG(nn.Layer):
 def _vgg(arch, cfg, batch_norm, pretrained=False, **kwargs):
     if pretrained:
         raise NotImplementedError(
-            "pretrained weights require network access; load a local "
-            "checkpoint with set_state_dict instead")
+            f"pretrained weights for {arch!r} require network access; load "
+            "a local checkpoint with set_state_dict instead")
     return VGG(make_layers(cfgs[cfg], batch_norm=batch_norm), **kwargs)
 
 

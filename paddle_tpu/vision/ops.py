@@ -339,6 +339,10 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
              iou_aware_factor=0.5, name=None):
     """Decode YOLOv3 head output to boxes+scores (≙ phi yolo_box_kernel).
     x: [N, an*(5+cls), H, W] → (boxes [N, an*H*W, 4], scores [N, an*H*W, cls])."""
+    if iou_aware:
+        raise NotImplementedError(
+            "yolo_box(iou_aware=True): the IoU-aware channel layout is not "
+            "supported; run with iou_aware=False")
     an = len(anchors) // 2
     anchors_np = np.asarray(anchors, np.float32).reshape(an, 2)
 
@@ -381,6 +385,9 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
               use_label_smooth=True, scale_x_y=1.0, name=None):
     """YOLOv3 training loss (≙ phi yolo_loss_kernel): coordinate MSE/BCE +
     objectness BCE (with ignore mask) + class BCE, summed per image."""
+    if float(scale_x_y) != 1.0:
+        raise NotImplementedError(
+            "yolo_loss(scale_x_y != 1.0) is not supported")
     an_all = np.asarray(anchors, np.float32).reshape(-1, 2)
     an_idx = list(anchor_mask)
     an = len(an_idx)
@@ -582,14 +589,17 @@ def box_coder(prior_box, prior_box_var, target_box,
 
 
 # --------------------------------------------- host-side selection/postprocess
-def _iou_matrix(a, b):
-    area_a = np.maximum(a[:, 2] - a[:, 0], 0) * np.maximum(a[:, 3] - a[:, 1], 0)
-    area_b = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(b[:, 3] - b[:, 1], 0)
+def _iou_matrix(a, b, offset=0.0):
+    # offset=1 for integer pixel boxes (normalized=False in the reference)
+    area_a = np.maximum(a[:, 2] - a[:, 0] + offset, 0) \
+        * np.maximum(a[:, 3] - a[:, 1] + offset, 0)
+    area_b = np.maximum(b[:, 2] - b[:, 0] + offset, 0) \
+        * np.maximum(b[:, 3] - b[:, 1] + offset, 0)
     x1 = np.maximum(a[:, None, 0], b[None, :, 0])
     y1 = np.maximum(a[:, None, 1], b[None, :, 1])
     x2 = np.minimum(a[:, None, 2], b[None, :, 2])
     y2 = np.minimum(a[:, None, 3], b[None, :, 3])
-    inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    inter = np.maximum(x2 - x1 + offset, 0) * np.maximum(y2 - y1 + offset, 0)
     return inter / np.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-9)
 
 
@@ -650,7 +660,8 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
             order = sel[np.argsort(-s[sel])][:nms_top_k]
             boxes_c = bb[im, order]
             scores_c = s[order]
-            iou = _iou_matrix(boxes_c, boxes_c)
+            iou = _iou_matrix(boxes_c, boxes_c,
+                              offset=0.0 if normalized else 1.0)
             iou = np.triu(iou, 1)
             iou_cmax = iou.max(0)
             # decay_ij compensates by the SUPPRESSOR i's own max overlap
@@ -716,6 +727,10 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
                        pixel_offset=False, return_rois_num=False, name=None):
     """RPN proposal generation (≙ phi generate_proposals_v2): decode anchors,
     clip, filter small, NMS. Host-side postprocessing."""
+    if float(eta) != 1.0:
+        raise NotImplementedError(
+            "generate_proposals(eta != 1): adaptive-threshold NMS is not "
+            "supported")
     sc = _np(scores)
     deltas = _np(bbox_deltas)
     anc = _np(anchors).reshape(-1, 4)

@@ -106,9 +106,9 @@ def to_grayscale(img, num_output_channels=1):
 
 
 # ------------------------------------------------------------- geometric warps
-def _inverse_warp(arr, inv_mat, fill=0):
-    """Bilinear sample arr (H,W[,C]) at inv_mat-mapped output coords.
-    inv_mat: 3x3 output→input homogeneous map."""
+def _inverse_warp(arr, inv_mat, fill=0, interpolation="bilinear"):
+    """Sample arr (H,W[,C]) at inv_mat-mapped output coords, bilinear or
+    nearest. inv_mat: 3x3 output→input homogeneous map."""
     h, w = arr.shape[:2]
     yy, xx = np.mgrid[0:h, 0:w].astype("float32")
     ones = np.ones_like(xx)
@@ -116,10 +116,18 @@ def _inverse_warp(arr, inv_mat, fill=0):
     src = inv_mat @ coords
     sx = src[0] / np.maximum(np.abs(src[2]), 1e-9) * np.sign(src[2])
     sy = src[1] / np.maximum(np.abs(src[2]), 1e-9) * np.sign(src[2])
-    x0 = np.floor(sx)
-    y0 = np.floor(sy)
-    wx = sx - x0
-    wy = sy - y0
+    if interpolation == "nearest":
+        x0 = np.round(sx)
+        y0 = np.round(sy)
+        wx = np.zeros_like(sx)
+        wy = np.zeros_like(sy)
+    elif interpolation == "bilinear":
+        x0 = np.floor(sx)
+        y0 = np.floor(sy)
+        wx = sx - x0
+        wy = sy - y0
+    else:
+        raise ValueError(f"unsupported interpolation {interpolation!r}")
     f = arr.astype("float32")
     if f.ndim == 2:
         f = f[:, :, None]
@@ -168,7 +176,7 @@ def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
     h, w = a.shape[:2]
     ctr = center if center is not None else ((w - 1) / 2, (h - 1) / 2)
     inv = _affine_inv(ctr, angle, translate, scale, shear)
-    return _finish(_inverse_warp(a, inv, fill), u8)
+    return _finish(_inverse_warp(a, inv, fill, interpolation), u8)
 
 
 def rotate(img, angle, interpolation="bilinear", expand=False, center=None,
@@ -187,7 +195,7 @@ def rotate(img, angle, interpolation="bilinear", expand=False, center=None,
         h, w = a.shape[:2]
         ctr = ((w - 1) / 2, (h - 1) / 2)
     inv = _affine_inv(ctr, angle, (0, 0), 1.0, (0.0, 0.0))
-    return _finish(_inverse_warp(a, inv, fill), u8)
+    return _finish(_inverse_warp(a, inv, fill, interpolation), u8)
 
 
 def _perspective_coeffs(startpoints, endpoints):
@@ -207,7 +215,7 @@ def perspective(img, startpoints, endpoints, interpolation="bilinear",
                 fill=0):
     a, u8 = _chw_guard(_as_array(img))
     inv = _perspective_coeffs(startpoints, endpoints)
-    return _finish(_inverse_warp(a, inv, fill), u8)
+    return _finish(_inverse_warp(a, inv, fill, interpolation), u8)
 
 
 # ----------------------------------------------------------------- pad / erase
@@ -323,6 +331,7 @@ class RandomAffine(BaseTransform):
         self.translate = translate
         self.scale = scale
         self.shear = shear
+        self.interpolation = interpolation
         self.fill = fill
         self.center = center
 
@@ -337,7 +346,8 @@ class RandomAffine(BaseTransform):
         sc = random.uniform(*self.scale) if self.scale else 1.0
         sh = (random.uniform(-self.shear, self.shear), 0.0) if isinstance(
             self.shear, (int, float)) and self.shear else (0.0, 0.0)
-        return affine(a, angle, (tx, ty), sc, sh, fill=self.fill,
+        return affine(a, angle, (tx, ty), sc, sh,
+                      interpolation=self.interpolation, fill=self.fill,
                       center=self.center)
 
 
@@ -349,10 +359,12 @@ class RandomRotation(BaseTransform):
             degrees, (int, float)) else tuple(degrees)
         self.expand = expand
         self.center = center
+        self.interpolation = interpolation
         self.fill = fill
 
     def _apply_image(self, img):
-        return rotate(img, random.uniform(*self.degrees), expand=self.expand,
+        return rotate(img, random.uniform(*self.degrees),
+                      interpolation=self.interpolation, expand=self.expand,
                       center=self.center, fill=self.fill)
 
 
@@ -362,6 +374,7 @@ class RandomPerspective(BaseTransform):
         super().__init__(keys)
         self.prob = prob
         self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
         self.fill = fill
 
     def _apply_image(self, img):
@@ -380,7 +393,8 @@ class RandomPerspective(BaseTransform):
         bl = (random.randint(0, int(d * half_w)),
               h - 1 - random.randint(0, int(d * half_h)))
         start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
-        return perspective(a, start, [tl, tr, br, bl], fill=self.fill)
+        return perspective(a, start, [tl, tr, br, bl],
+                           interpolation=self.interpolation, fill=self.fill)
 
 
 class RandomErasing(BaseTransform):

@@ -43,6 +43,9 @@ def to_tensor(img, data_format="CHW"):
 
 def normalize(img, mean, std, data_format="CHW", to_rgb=False):
     arr = _as_array(img).astype("float32")
+    if to_rgb:
+        # input channels are BGR (cv2-decoded images); flip to RGB first
+        arr = arr[::-1] if data_format == "CHW" else arr[..., ::-1]
     return _np_normalize(arr, mean, std, data_format)
 
 
@@ -158,19 +161,25 @@ class Normalize(BaseTransform):
         self.mean = list(mean)
         self.std = list(std)
         self.data_format = data_format
+        self.to_rgb = to_rgb
+
+    def _flip(self, arr):
+        if not self.to_rgb:
+            return arr
+        return arr[::-1] if self.data_format == "CHW" else arr[..., ::-1]
 
     def _apply_image(self, img):
         from ...core.tensor import Tensor
 
         if isinstance(img, Tensor):
-            arr = img.numpy()
+            arr = self._flip(img.numpy())
             out = _np_normalize(arr, self.mean[:arr.shape[0]] if self.data_format == "CHW"
                                 else self.mean, self.std[:arr.shape[0]] if self.data_format == "CHW"
                                 else self.std, self.data_format)
             import paddle_tpu as paddle
 
             return paddle.to_tensor(out.astype("float32"))
-        arr = _as_array(img).astype("float32")
+        arr = self._flip(_as_array(img).astype("float32"))
         c = arr.shape[0] if self.data_format == "CHW" else arr.shape[-1]
         return _np_normalize(arr, self.mean[:c], self.std[:c], self.data_format)
 
@@ -193,6 +202,14 @@ class RandomCrop(BaseTransform):
         self.padding = padding
         self.pad_if_needed = pad_if_needed
         self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _np_pad(self, arr, cfg):
+        if self.padding_mode == "constant":
+            return np.pad(arr, cfg, constant_values=self.fill)
+        mode = {"reflect": "reflect", "edge": "edge",
+                "symmetric": "symmetric"}[self.padding_mode]
+        return np.pad(arr, cfg, mode=mode)
 
     def _apply_image(self, img):
         arr = _as_array(img)
@@ -200,13 +217,13 @@ class RandomCrop(BaseTransform):
         if self.padding:
             p = self.padding if isinstance(self.padding, (list, tuple)) \
                 else [self.padding] * 4
-            arr = np.pad(arr, [(p[1], p[3]), (p[0], p[2])] +
-                         [(0, 0)] * (arr.ndim - 2), constant_values=self.fill)
+            arr = self._np_pad(arr, [(p[1], p[3]), (p[0], p[2])] +
+                               [(0, 0)] * (arr.ndim - 2))
         h, w = arr.shape[:2]
         if self.pad_if_needed and (h < th or w < tw):
             ph, pw = max(0, th - h), max(0, tw - w)
-            arr = np.pad(arr, [(0, ph), (0, pw)] + [(0, 0)] * (arr.ndim - 2),
-                         constant_values=self.fill)
+            arr = self._np_pad(arr, [(0, ph), (0, pw)] +
+                               [(0, 0)] * (arr.ndim - 2))
             h, w = arr.shape[:2]
         top = random.randint(0, h - th)
         left = random.randint(0, w - tw)

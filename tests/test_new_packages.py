@@ -522,3 +522,57 @@ class TestFusedLinearCrossEntropy:
         ht = paddle.to_tensor(hid)
         vals = [float(step(ht)) for _ in range(4)]
         assert all(abs(v - vals[0]) < 1e-5 for v in vals)
+
+    def test_ignore_index_parity(self):
+        """-100 labels (varlen bucketing pad_value) are excluded from the
+        loss mean AND the gradient — parity vs F.cross_entropy, which
+        ignores them natively (ADVICE r3: the fused scan used to treat
+        -100 as 'no chunk matched' and push all probabilities down)."""
+        import paddle_tpu.incubate.nn.functional as IF
+        import paddle_tpu.nn.functional as F
+
+        hid, w, lab = self._setup(n=12, v=64)
+        lab[3] = -100
+        lab[7] = -100
+        ht = paddle.to_tensor(hid); ht.stop_gradient = False
+        wt = paddle.to_tensor(w); wt.stop_gradient = False
+        want = F.cross_entropy(ht.matmul(wt), paddle.to_tensor(lab),
+                               reduction="mean")
+        want.backward()
+        want_dh = np.asarray(ht.grad._data).copy()
+        want_dw = np.asarray(wt.grad._data).copy()
+
+        ht2 = paddle.to_tensor(hid); ht2.stop_gradient = False
+        wt2 = paddle.to_tensor(w); wt2.stop_gradient = False
+        loss = IF.fused_linear_cross_entropy(ht2, wt2, paddle.to_tensor(lab),
+                                             chunk_size=16)
+        loss.backward()
+        np.testing.assert_allclose(float(loss), float(want), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(ht2.grad._data), want_dh,
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(wt2.grad._data), want_dw,
+                                   rtol=1e-4, atol=1e-6)
+        # ignored rows must get EXACTLY zero hidden-grad
+        assert np.abs(np.asarray(ht2.grad._data)[[3, 7]]).max() == 0.0
+
+    def test_all_ignored_is_finite(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        hid, w, lab = self._setup(n=6, v=32)
+        lab[:] = -100
+        loss = IF.fused_linear_cross_entropy(
+            paddle.to_tensor(hid), paddle.to_tensor(w),
+            paddle.to_tensor(lab), chunk_size=16)
+        assert float(loss) == 0.0
+
+    def test_chunk_selection_32000(self):
+        """vocab 32000 (every in-repo LLaMA config) must take the FUSED
+        path: 8192 doesn't divide it, the picker drops to 6400 (5 chunks).
+        50304 (GPT) has no sane chunk -> 0 -> plain fallback."""
+        from paddle_tpu.incubate.nn.functional.fused_loss import _best_chunk
+
+        assert _best_chunk(32000, 8192) == 6400
+        assert _best_chunk(32768, 8192) == 8192
+        assert _best_chunk(50304, 8192) == 0
+        assert _best_chunk(64, 16) == 16
+        assert _best_chunk(60, 16) == 0

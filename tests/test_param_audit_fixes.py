@@ -1,0 +1,349 @@
+"""Regression tests for the round-4 accepted-but-unused parameter sweep
+(VERDICT r3 Weak #5 + ADVICE): every previously-silent kwarg either works
+(parity-tested here, torch as oracle where applicable) or raises.
+
+The audit itself is enforced by tools/audit_unused_params.py (0 FAILING,
+report committed as PARAM_AUDIT.md).
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+class TestPadOrdering:
+    """Round-3 bug: the W pad landed on H (double reversal)."""
+
+    @pytest.mark.parametrize("shape,pd", [
+        ((2, 1, 3, 4), (1, 2, 0, 0)),
+        ((2, 1, 3, 4), (1, 2, 3, 4)),
+        ((2, 1, 2, 3, 4), (1, 2, 3, 4, 5, 6)),
+        ((2, 3, 5), (1, 2)),
+    ])
+    def test_parity_vs_torch(self, shape, pd):
+        x = np.arange(np.prod(shape), dtype="float32").reshape(shape)
+        got = _np(F.pad(paddle.to_tensor(x), list(pd)))
+        want = TF.pad(torch.from_numpy(x), pd).numpy()
+        np.testing.assert_array_equal(got, want)
+
+    def test_reflect(self):
+        x = np.arange(24, dtype="float32").reshape(1, 2, 3, 4)
+        got = _np(F.pad(paddle.to_tensor(x), [1, 1, 1, 1], mode="reflect"))
+        want = TF.pad(torch.from_numpy(x), (1, 1, 1, 1), mode="reflect")
+        np.testing.assert_array_equal(got, want.numpy())
+
+    def test_nhwc(self):
+        x = np.arange(24, dtype="float32").reshape(1, 3, 4, 2)
+        got = _np(F.pad(paddle.to_tensor(x), [1, 2, 3, 4],
+                        data_format="NHWC"))
+        xc = np.moveaxis(x, -1, 1)
+        want = np.moveaxis(
+            TF.pad(torch.from_numpy(xc), (1, 2, 3, 4)).numpy(), 1, -1)
+        np.testing.assert_array_equal(got, want)
+
+
+class TestInterpolate:
+    @pytest.mark.parametrize("mode,ac", [
+        ("nearest", False), ("bilinear", False), ("bilinear", True),
+        ("bicubic", False), ("bicubic", True), ("area", False),
+    ])
+    def test_2d_parity(self, mode, ac):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 3, 7, 9).astype("float32")
+        kw = {} if mode in ("nearest", "area") else {"align_corners": ac}
+        got = _np(F.interpolate(paddle.to_tensor(x), size=[13, 5],
+                                mode=mode, **kw))
+        want = TF.interpolate(torch.from_numpy(x), size=(13, 5), mode=mode,
+                              **kw).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_trilinear(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(1, 2, 4, 5, 6).astype("float32")
+        got = _np(F.interpolate(paddle.to_tensor(x), size=[8, 3, 9],
+                                mode="trilinear", data_format="NCDHW"))
+        want = TF.interpolate(torch.from_numpy(x), size=(8, 3, 9),
+                              mode="trilinear").numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_align_mode_1_differs_from_0(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(1, 1, 5, 5).astype("float32")
+        m0 = _np(F.interpolate(paddle.to_tensor(x), size=[7, 7],
+                               mode="bilinear", align_mode=0))
+        m1 = _np(F.interpolate(paddle.to_tensor(x), size=[7, 7],
+                               mode="bilinear", align_mode=1))
+        assert np.abs(m0 - m1).max() > 1e-4
+
+
+class TestRNNVarlenAndStates:
+    def _torch_twin(self, lstm, layers, bidir):
+        tl = torch.nn.LSTM(lstm.input_size, lstm.hidden_size,
+                           num_layers=layers, bidirectional=bidir,
+                           batch_first=True)
+        sd = {}
+        for layer in range(layers):
+            for d in range(2 if bidir else 1):
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                for nm in ["weight_ih", "weight_hh", "bias_ih", "bias_hh"]:
+                    sd[f"{nm}{sfx}"] = torch.from_numpy(
+                        np.asarray(getattr(lstm, f"{nm}{sfx}")._data).copy())
+        tl.load_state_dict(sd)
+        return tl
+
+    def test_initial_states_and_lengths(self):
+        from paddle_tpu import nn
+
+        paddle.seed(0)
+        lstm = nn.LSTM(8, 16, num_layers=2, direction="bidirect")
+        tl = self._torch_twin(lstm, 2, True)
+        rs = np.random.RandomState(0)
+        x = rs.randn(3, 5, 8).astype("float32")
+        h0 = rs.randn(4, 3, 16).astype("float32")
+        c0 = rs.randn(4, 3, 16).astype("float32")
+        lens = np.array([5, 3, 1], "int64")
+        out, (h, c) = lstm(paddle.to_tensor(x),
+                           (paddle.to_tensor(h0), paddle.to_tensor(c0)),
+                           sequence_length=paddle.to_tensor(lens))
+        packed = torch.nn.utils.rnn.pack_padded_sequence(
+            torch.from_numpy(x), torch.from_numpy(lens), batch_first=True)
+        pout, (ph, pc) = tl(packed, (torch.from_numpy(h0),
+                                     torch.from_numpy(c0)))
+        pout, _ = torch.nn.utils.rnn.pad_packed_sequence(
+            pout, batch_first=True, total_length=5)
+        np.testing.assert_allclose(_np(out), pout.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(_np(h), ph.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(_np(c), pc.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestTransformerCache:
+    def test_decoder_incremental_matches_full(self):
+        from paddle_tpu import nn
+
+        paddle.seed(0)
+        d, h = 16, 4
+        dec = nn.TransformerDecoderLayer(d, h, 32, dropout=0.0)
+        dec.eval()
+        rs = np.random.RandomState(0)
+        mem = paddle.to_tensor(rs.randn(2, 5, d).astype("float32"))
+        tgt = rs.randn(2, 6, d).astype("float32")
+        m = np.full((6, 6), -np.inf, "float32")
+        m[np.tril_indices(6)] = 0.0
+        full = dec(paddle.to_tensor(tgt), mem, tgt_mask=paddle.to_tensor(m))
+        cache = dec.gen_cache(mem)
+        outs = []
+        for t in range(6):
+            o, cache = dec(paddle.to_tensor(tgt[:, t:t + 1]), mem,
+                           cache=cache)
+            outs.append(_np(o))
+        inc = np.concatenate(outs, axis=1)
+        np.testing.assert_allclose(inc, _np(full), rtol=1e-4, atol=1e-5)
+
+    def test_mha_need_weights(self):
+        from paddle_tpu import nn
+
+        paddle.seed(0)
+        mha = nn.MultiHeadAttention(16, 4, need_weights=True)
+        q = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 3, 16).astype("float32"))
+        out, w = mha(q, q, q)
+        assert tuple(w.shape) == (2, 4, 3, 3)
+        np.testing.assert_allclose(_np(w).sum(-1), 1.0, rtol=1e-5)
+
+    def test_static_cache_cross_attention(self):
+        from paddle_tpu import nn
+
+        paddle.seed(0)
+        mha = nn.MultiHeadAttention(16, 4, dropout=0.0)
+        mha.eval()
+        rs = np.random.RandomState(2)
+        mem = paddle.to_tensor(rs.randn(2, 5, 16).astype("float32"))
+        q = paddle.to_tensor(rs.randn(2, 3, 16).astype("float32"))
+        plain = mha(q, mem, mem)
+        sc = mha.gen_cache(mem, mem, type=nn.MultiHeadAttention.StaticCache)
+        cached, sc2 = mha(q, mem, mem, cache=sc)
+        np.testing.assert_allclose(_np(cached), _np(plain), rtol=1e-5,
+                                   atol=1e-6)
+        assert sc2 is sc
+
+
+class TestOpsKwargs:
+    def test_median_min_mode(self):
+        x = paddle.to_tensor(np.array([[1.0, 3.0, 2.0, 4.0]], "float32"))
+        v, idx = paddle.median(x, axis=1, mode="min")
+        assert float(v._data[0]) == 2.0 and int(idx._data[0]) == 2
+        tv, tidx = torch.median(torch.tensor([[1.0, 3.0, 2.0, 4.0]]), dim=1)
+        assert float(tv[0]) == float(v._data[0])
+        assert int(tidx[0]) == int(idx._data[0])
+
+    def test_argsort_stable_descending(self):
+        x = np.array([2.0, 1.0, 2.0, 1.0], "float32")
+        got = _np(paddle.argsort(paddle.to_tensor(x), descending=True,
+                                 stable=True))
+        np.testing.assert_array_equal(got, [0, 2, 1, 3])
+
+    def test_put_along_axis_include_self(self):
+        a = np.array([[1.0, 2.0], [3.0, 4.0]], "float32")
+        idx = np.array([[0, 0]], "int64")
+        v = np.array([[10.0, 20.0]], "float32")
+        got = _np(paddle.put_along_axis(
+            paddle.to_tensor(a), paddle.to_tensor(idx), paddle.to_tensor(v),
+            axis=0, reduce="add", include_self=False, broadcast=False))
+        want = a.copy()
+        want[0] = [10.0, 20.0]  # original row excluded from the reduction
+        np.testing.assert_allclose(got, want)
+
+    def test_take_along_axis_no_broadcast(self):
+        a = np.arange(12, dtype="float32").reshape(3, 4)
+        idx = np.array([[1], [0], [2]], "int64")
+        got = _np(paddle.take_along_axis(paddle.to_tensor(a),
+                                         paddle.to_tensor(idx), axis=1,
+                                         broadcast=False))
+        want = torch.gather(torch.from_numpy(a),
+                            1, torch.from_numpy(idx)).numpy()
+        np.testing.assert_array_equal(got, want)
+
+    def test_eigh_uplo(self):
+        rs = np.random.RandomState(0)
+        a = rs.randn(4, 4).astype("float32")
+        wl, _ = paddle.linalg.eigh(paddle.to_tensor(a), UPLO="L")
+        wu, _ = paddle.linalg.eigh(paddle.to_tensor(a), UPLO="U")
+        np.testing.assert_allclose(
+            _np(wl), np.linalg.eigvalsh(np.tril(a) + np.tril(a, -1).T),
+            rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            _np(wu), np.linalg.eigvalsh(np.triu(a) + np.triu(a, 1).T),
+            rtol=1e-4, atol=1e-5)
+
+    def test_cov_weights(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(3, 8).astype("float64")
+        fw = np.array([1, 2, 1, 1, 3, 1, 1, 2])
+        aw = rs.rand(8)
+        got = _np(paddle.linalg.cov(paddle.to_tensor(x),
+                                    fweights=paddle.to_tensor(fw),
+                                    aweights=paddle.to_tensor(aw)))
+        want = np.cov(x, fweights=fw, aweights=aw)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_seeded_uniform_reproducible(self):
+        a = _np(paddle.uniform([4, 4], seed=7))
+        b = _np(paddle.uniform([4, 4], seed=7))
+        c = _np(paddle.uniform([4, 4], seed=8))
+        np.testing.assert_array_equal(a, b)
+        assert np.abs(a - c).max() > 0
+
+    def test_scale_act(self):
+        x = paddle.to_tensor(np.array([-1.0, 2.0], "float32"))
+        got = _np(paddle.scale(x, scale=2.0, bias=0.0, act="relu"))
+        np.testing.assert_allclose(got, [0.0, 4.0])
+
+    def test_lu_requires_pivot(self):
+        with pytest.raises(NotImplementedError):
+            paddle.linalg.lu(paddle.to_tensor(np.eye(3, dtype="float32")),
+                             pivot=False)
+
+    def test_unique_index_dtype(self):
+        x = paddle.to_tensor(np.array([3, 1, 3], "int64"))
+        out, inv = paddle.unique(x, return_inverse=True, dtype="int32")
+        assert str(inv.dtype).endswith("int32")
+
+
+class TestMiscFixes:
+    def test_clip_grad_norm_nonfinite_raises(self):
+        from paddle_tpu import nn
+
+        p = paddle.to_tensor(np.ones(3, "float32"))
+        p.stop_gradient = False
+        (p * float("inf")).sum().backward()
+        with pytest.raises(RuntimeError):
+            nn.utils.clip_grad_norm_([p], 1.0, error_if_nonfinite=True)
+
+    def test_instance_norm_running_stats(self):
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(2, 3, 4, 4).astype("float32"))
+        rm = paddle.to_tensor(np.zeros(3, "float32"))
+        rv = paddle.to_tensor(np.ones(3, "float32"))
+        out = F.instance_norm(x, running_mean=rm, running_var=rv,
+                              use_input_stats=False)
+        want = _np(x) / np.sqrt(1.0 + 1e-5)
+        np.testing.assert_allclose(_np(out), want, rtol=1e-5)
+        # tracking mode updates the buffers
+        before = _np(rm).copy()
+        F.instance_norm(x, running_mean=rm, running_var=rv,
+                        use_input_stats=True, momentum=0.5)
+        assert np.abs(_np(rm) - before).max() > 0
+
+    def test_batch_jacobian(self):
+        from paddle_tpu.autograd import jacobian
+
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(5, 3).astype("float32"))
+        j = jacobian(lambda t: t * t, x, batch_axis=0)
+        assert tuple(j.shape) == (5, 3, 3)
+
+    def test_cyclic_lr_scale_fn(self):
+        import paddle_tpu.optimizer.lr as lr
+
+        s = lr.CyclicLR(0.1, 0.5, step_size_up=4, scale_fn=lambda c: 0.5,
+                        scale_mode="cycle")
+        vals = []
+        for _ in range(8):
+            s.step()
+            vals.append(s())
+        assert max(vals) <= 0.1 + (0.5 - 0.1) * 0.5 + 1e-9
+
+    def test_one_cycle_three_phase(self):
+        import paddle_tpu.optimizer.lr as lr
+
+        s2 = lr.OneCycleLR(1.0, 100, phase_pct=0.3, three_phase=True)
+        s1 = lr.OneCycleLR(1.0, 100, phase_pct=0.3, three_phase=False)
+        for _ in range(50):
+            s1.step()
+            s2.step()
+        assert abs(s1() - s2()) > 1e-6
+
+    def test_quantize_not_inplace(self):
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import PTQ, QuantConfig
+        from paddle_tpu.quantization.observers import AbsmaxObserver
+
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(4, 4))
+        cfg = QuantConfig(activation=None, weight=None)
+        cfg.add_type_config(nn.Linear, activation=AbsmaxObserver(),
+                            weight=AbsmaxObserver())
+        q = PTQ(cfg).quantize(m, inplace=False)
+        assert type(m[0]).__name__ == "Linear"  # original untouched
+        assert type(q[0]).__name__ != "Linear"
+
+    def test_model_average_window(self):
+        from paddle_tpu.incubate import ModelAverage
+
+        p = paddle.to_tensor(np.zeros(1, "float32"))
+        ma = ModelAverage(1.0, parameters=[p], min_average_window=2,
+                          max_average_window=3)
+        for v in [1.0, 2.0, 3.0, 40.0]:
+            p._assign_raw(np.full(1, v, "float32"))
+            ma.step()
+        with ma.apply():
+            # window capped at 3: the first value's weight decayed
+            assert float(p._data[0]) > (1 + 2 + 3 + 40) / 4 - 5
+
+    def test_random_split_generator(self):
+        from paddle_tpu.io import random_split
+
+        ds = list(range(10))
+        a1 = random_split(ds, [5, 5], generator=3)
+        a2 = random_split(ds, [5, 5], generator=3)
+        assert [x for x in a1[0]] == [x for x in a2[0]]

@@ -1,0 +1,190 @@
+"""Pooling return_mask / ceil_mode / divisor_override parity vs torch.
+
+VERDICT r3 Weak #5: `max_pool2d(x, k, return_mask=True)` silently returned a
+bare Tensor — callers unpacking `out, idx = ...` got the batch dim iterated
+away. These tests pin the whole accepted-kwarg surface of the pooling ops to
+torch (same index convention as the reference: argmax flattened over the
+input's spatial dims, /root/reference/python/paddle/nn/functional/pooling.py:1284).
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+class TestMaxPoolReturnMask:
+    @pytest.mark.parametrize("ks,st,pd,ceil", [
+        (2, None, 0, False),
+        (3, 2, 1, False),
+        (3, 2, 1, True),
+        (2, 3, 0, True),
+    ])
+    def test_max_pool2d_parity(self, ks, st, pd, ceil):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 3, 9, 11).astype("float32")
+        out, idx = F.max_pool2d(paddle.to_tensor(x), ks, st, pd,
+                                return_mask=True, ceil_mode=ceil)
+        tout, tidx = TF.max_pool2d(torch.from_numpy(x), ks, st, pd,
+                                   ceil_mode=ceil, return_indices=True)
+        np.testing.assert_allclose(_np(out), tout.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(_np(idx), tidx.numpy())
+        # value path (return_mask=False) must agree with the masked path
+        plain = F.max_pool2d(paddle.to_tensor(x), ks, st, pd,
+                             ceil_mode=ceil)
+        np.testing.assert_allclose(_np(plain), tout.numpy(), rtol=1e-6)
+
+    def test_max_pool1d_parity(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(2, 4, 17).astype("float32")
+        out, idx = F.max_pool1d(paddle.to_tensor(x), 3, 2, 1,
+                                return_mask=True)
+        tout, tidx = TF.max_pool1d(torch.from_numpy(x), 3, 2, 1,
+                                   return_indices=True)
+        np.testing.assert_allclose(_np(out), tout.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(_np(idx), tidx.numpy())
+
+    def test_max_pool3d_parity(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(2, 2, 6, 7, 8).astype("float32")
+        out, idx = F.max_pool3d(paddle.to_tensor(x), 2, 2, 0,
+                                return_mask=True)
+        tout, tidx = TF.max_pool3d(torch.from_numpy(x), 2, 2, 0,
+                                   return_indices=True)
+        np.testing.assert_allclose(_np(out), tout.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(_np(idx), tidx.numpy())
+
+    def test_unpool_roundtrip(self):
+        """The produced mask must be consumable by max_unpool2d."""
+        rs = np.random.RandomState(3)
+        x = rs.randn(2, 3, 8, 8).astype("float32")
+        out, idx = F.max_pool2d(paddle.to_tensor(x), 2, 2,
+                                return_mask=True)
+        recon = F.max_unpool2d(out, idx, 2, 2)
+        tout, tidx = TF.max_pool2d(torch.from_numpy(x), 2, 2,
+                                   return_indices=True)
+        trecon = TF.max_unpool2d(tout, tidx, 2, 2)
+        np.testing.assert_allclose(_np(recon), trecon.numpy(), rtol=1e-6)
+
+    def test_layer_forwards_mask(self):
+        from paddle_tpu import nn
+
+        x = paddle.to_tensor(
+            np.random.RandomState(4).randn(2, 3, 8, 8).astype("float32"))
+        out, idx = nn.MaxPool2D(2, return_mask=True)(x)
+        assert tuple(out.shape) == (2, 3, 4, 4)
+        assert tuple(idx.shape) == (2, 3, 4, 4)
+
+    def test_nhwc_with_mask_raises(self):
+        x = paddle.to_tensor(np.zeros((2, 8, 8, 3), "float32"))
+        with pytest.raises(ValueError):
+            F.max_pool2d(x, 2, return_mask=True, data_format="NHWC")
+
+
+class TestAdaptiveMaxPoolReturnMask:
+    @pytest.mark.parametrize("osz", [(4, 4), (3, 5), (7, 7)])
+    def test_adaptive2d_parity(self, osz):
+        rs = np.random.RandomState(5)
+        x = rs.randn(2, 3, 13, 17).astype("float32")
+        out, idx = F.adaptive_max_pool2d(paddle.to_tensor(x), list(osz),
+                                         return_mask=True)
+        tout, tidx = TF.adaptive_max_pool2d(torch.from_numpy(x), osz,
+                                            return_indices=True)
+        np.testing.assert_allclose(_np(out), tout.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(_np(idx), tidx.numpy())
+
+    def test_adaptive1d_parity(self):
+        rs = np.random.RandomState(6)
+        x = rs.randn(2, 4, 19).astype("float32")
+        out, idx = F.adaptive_max_pool1d(paddle.to_tensor(x), 5,
+                                         return_mask=True)
+        tout, tidx = TF.adaptive_max_pool1d(torch.from_numpy(x), 5,
+                                            return_indices=True)
+        np.testing.assert_allclose(_np(out), tout.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(_np(idx), tidx.numpy())
+
+    def test_adaptive3d_parity(self):
+        rs = np.random.RandomState(7)
+        x = rs.randn(1, 2, 9, 10, 11).astype("float32")
+        out, idx = F.adaptive_max_pool3d(paddle.to_tensor(x), (3, 4, 5),
+                                         return_mask=True)
+        tout, tidx = TF.adaptive_max_pool3d(torch.from_numpy(x), (3, 4, 5),
+                                            return_indices=True)
+        np.testing.assert_allclose(_np(out), tout.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(_np(idx), tidx.numpy())
+
+    def test_layer_forwards_mask(self):
+        from paddle_tpu import nn
+
+        x = paddle.to_tensor(
+            np.random.RandomState(8).randn(2, 3, 12, 12).astype("float32"))
+        out, idx = nn.AdaptiveMaxPool2D(4, return_mask=True)(x)
+        assert tuple(out.shape) == (2, 3, 4, 4)
+        assert tuple(idx.shape) == (2, 3, 4, 4)
+
+
+class TestAvgPoolKwargs:
+    @pytest.mark.parametrize("div", [1, 3, 7.0])
+    def test_divisor_override_parity(self, div):
+        rs = np.random.RandomState(9)
+        x = rs.randn(2, 3, 8, 10).astype("float32")
+        got = F.avg_pool2d(paddle.to_tensor(x), 2, 2, 0,
+                           divisor_override=div)
+        want = TF.avg_pool2d(torch.from_numpy(x), 2, 2, 0,
+                             divisor_override=int(div))
+        np.testing.assert_allclose(_np(got), want.numpy(), rtol=1e-5)
+
+    def test_divisor_override_3d(self):
+        rs = np.random.RandomState(10)
+        x = rs.randn(1, 2, 4, 6, 8).astype("float32")
+        got = F.avg_pool3d(paddle.to_tensor(x), 2, 2, 0, divisor_override=5)
+        want = TF.avg_pool3d(torch.from_numpy(x), 2, 2, 0,
+                             divisor_override=5)
+        np.testing.assert_allclose(_np(got), want.numpy(), rtol=1e-5)
+
+    def test_divisor_override_invalid(self):
+        x = paddle.to_tensor(np.zeros((1, 1, 4, 4), "float32"))
+        with pytest.raises(ValueError):
+            F.avg_pool2d(x, 2, divisor_override=0)
+
+    def test_layer_divisor_override(self):
+        from paddle_tpu import nn
+
+        rs = np.random.RandomState(11)
+        x = rs.randn(1, 2, 6, 6).astype("float32")
+        got = nn.AvgPool2D(2, divisor_override=2)(paddle.to_tensor(x))
+        want = TF.avg_pool2d(torch.from_numpy(x), 2, divisor_override=2)
+        np.testing.assert_allclose(_np(got), want.numpy(), rtol=1e-5)
+
+    @pytest.mark.parametrize("ceil", [False, True])
+    def test_avg_ceil_mode_parity(self, ceil):
+        rs = np.random.RandomState(12)
+        x = rs.randn(2, 3, 9, 9).astype("float32")
+        got = F.avg_pool2d(paddle.to_tensor(x), 3, 2, 1, ceil_mode=ceil)
+        want = TF.avg_pool2d(torch.from_numpy(x), 3, 2, 1, ceil_mode=ceil,
+                             count_include_pad=False)
+        np.testing.assert_allclose(_np(got), want.numpy(), rtol=1e-5)
+
+
+class TestMaxPoolCeilMode:
+    """ceil_mode was silently dropped by _pool before round 4."""
+
+    @pytest.mark.parametrize("shape,ks,st,pd", [
+        ((2, 3, 9, 9), 3, 2, 0),
+        ((2, 3, 10, 7), 2, 3, 1),
+        ((1, 1, 5, 5), 3, 3, 0),
+    ])
+    def test_max_ceil_parity(self, shape, ks, st, pd):
+        rs = np.random.RandomState(13)
+        x = rs.randn(*shape).astype("float32")
+        got = F.max_pool2d(paddle.to_tensor(x), ks, st, pd, ceil_mode=True)
+        want = TF.max_pool2d(torch.from_numpy(x), ks, st, pd,
+                             ceil_mode=True)
+        assert _np(got).shape == tuple(want.shape)
+        np.testing.assert_allclose(_np(got), want.numpy(), rtol=1e-6)
