@@ -1,0 +1,114 @@
+"""KV-cached generation engine tests (VERDICT r3 Missing #1).
+
+The decode path must be bit-identical to the non-cached forward: greedy
+generate == argmax over the full-forward logits at every step. Reference
+role: masked_multihead_attention decode kernel + the generate loop
+(/root/reference/paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+
+def _tiny(vocab=128, kv_heads=None):
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=vocab, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=kv_heads,
+                      max_position_embeddings=64)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _naive_greedy(m, prompt, n):
+    seq = prompt.copy()
+    for _ in range(n):
+        nxt = np.asarray(m(paddle.to_tensor(seq))._data)[:, -1].argmax(-1)
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+    return seq
+
+
+class TestGenerate:
+    def test_greedy_parity_vs_full_forward(self):
+        m = _tiny()
+        prompt = np.random.RandomState(0).randint(0, 128, (2, 5)).astype("int64")
+        out = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                    max_new_tokens=6)._data)
+        np.testing.assert_array_equal(out, _naive_greedy(m, prompt, 6))
+
+    def test_gqa_parity(self):
+        m = _tiny(vocab=64, kv_heads=2)
+        prompt = np.random.RandomState(1).randint(0, 64, (1, 4)).astype("int64")
+        out = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                    max_new_tokens=4)._data)
+        np.testing.assert_array_equal(out, _naive_greedy(m, prompt, 4))
+
+    def test_sampling_reproducible_and_in_topk(self):
+        m = _tiny()
+        prompt = np.random.RandomState(2).randint(0, 128, (2, 5)).astype("int64")
+        kw = dict(max_new_tokens=5, do_sample=True, top_k=10,
+                  temperature=0.8, seed=3)
+        s1 = np.asarray(m.generate(paddle.to_tensor(prompt), **kw)._data)
+        s2 = np.asarray(m.generate(paddle.to_tensor(prompt), **kw)._data)
+        np.testing.assert_array_equal(s1, s2)
+        # every sampled token must be inside the step's true top-k=10:
+        # spot-check step 0 against the full forward
+        logits = np.asarray(m(paddle.to_tensor(prompt))._data)[:, -1]
+        topk = np.argsort(-logits, axis=-1)[:, :10]
+        for b in range(2):
+            assert s1[b, prompt.shape[1]] in topk[b]
+
+    def test_top_p_only(self):
+        m = _tiny()
+        prompt = np.random.RandomState(3).randint(0, 128, (2, 3)).astype("int64")
+        out = m.generate(paddle.to_tensor(prompt), max_new_tokens=4,
+                         do_sample=True, top_p=0.9, seed=1)
+        assert tuple(out.shape) == (2, 7)
+
+    def test_eos_stops_and_pads(self):
+        m = _tiny()
+        prompt = np.random.RandomState(4).randint(0, 128, (1, 4)).astype("int64")
+        # force eos = the first greedily generated token -> stops immediately
+        first = _naive_greedy(m, prompt, 1)[0, -1]
+        out = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                    max_new_tokens=8,
+                                    eos_token_id=int(first))._data)
+        assert out.shape[1] == prompt.shape[1] + 1
+        assert out[0, -1] == first
+
+    def test_max_length_alias(self):
+        m = _tiny()
+        prompt = np.random.RandomState(5).randint(0, 128, (1, 4)).astype("int64")
+        out = m.generate(paddle.to_tensor(prompt), max_length=9)
+        assert tuple(out.shape) == (1, 9)
+
+    def test_1d_prompt(self):
+        m = _tiny()
+        out = m.generate(paddle.to_tensor(
+            np.array([1, 2, 3], "int64")), max_new_tokens=3)
+        assert tuple(out.shape) == (1, 6)
+
+    def test_invalid_max_new_tokens(self):
+        m = _tiny()
+        with pytest.raises(ValueError):
+            m.generate(paddle.to_tensor(np.array([[1, 2]], "int64")),
+                       max_length=1)
+
+    def test_cache_invalidated_by_training_step(self):
+        """A parameter update must invalidate the stacked-weight cache."""
+        m = _tiny()
+        prompt = np.random.RandomState(6).randint(0, 128, (1, 4)).astype("int64")
+        out1 = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                     max_new_tokens=3)._data)
+        opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=m.parameters())
+        loss = m(paddle.to_tensor(prompt), paddle.to_tensor(prompt))
+        loss.backward()
+        opt.step()
+        out2 = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                     max_new_tokens=3)._data)
+        np.testing.assert_array_equal(out2, _naive_greedy(m, prompt, 3))
+        del out1  # values may or may not differ; parity after update is the check
