@@ -615,3 +615,139 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
 
     return _istft(x, n_fft, hop_length, win_length, window, center,
                   normalized, onesided, length, return_complex, name)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance per batch row (≙ phi edit_distance kernel,
+    /root/reference/paddle/phi/kernels/impl/edit_distance_kernel_impl.h).
+    input/label: int token tensors [B, L] (or 1-D). Host-side DP: the output
+    size and loop bounds are data-dependent. Returns (distance [B, 1],
+    sequence_num)."""
+    def _raw(t):
+        return t._data if hasattr(t, "_data") else t
+
+    hyp = np.asarray(_raw(input))
+    ref = np.asarray(_raw(label))
+    if hyp.ndim == 1:
+        hyp = hyp[None]
+    if ref.ndim == 1:
+        ref = ref[None]
+    hl = np.asarray(_raw(input_length)).reshape(-1) if input_length is not None \
+        else np.full(hyp.shape[0], hyp.shape[1], np.int64)
+    rl = np.asarray(_raw(label_length)).reshape(-1) if label_length is not None \
+        else np.full(ref.shape[0], ref.shape[1], np.int64)
+    ignored = set(ignored_tokens or ())
+    out = np.zeros((hyp.shape[0], 1), np.float32)
+    for b in range(hyp.shape[0]):
+        h = [t for t in hyp[b, :hl[b]] if t not in ignored]
+        r = [t for t in ref[b, :rl[b]] if t not in ignored]
+        m, n = len(h), len(r)
+        d = np.arange(n + 1, dtype=np.float64)
+        for i in range(1, m + 1):
+            prev = d.copy()
+            d[0] = i
+            for j in range(1, n + 1):
+                d[j] = min(prev[j] + 1, d[j - 1] + 1,
+                           prev[j - 1] + (h[i - 1] != r[j - 1]))
+        dist = d[n]
+        if normalized:
+            dist = dist / max(n, 1)
+        out[b, 0] = dist
+    return (Tensor(jnp.asarray(out), _internal=True, stop_gradient=True),
+            Tensor(jnp.asarray(np.int64(hyp.shape[0])), _internal=True,
+                   stop_gradient=True))
+
+
+def hinge_loss(input, label, name=None):
+    """Elementwise hinge loss max(0, 1 - input·label) (≙ phi
+    hinge_loss_kernel; label ∈ {0,1} is mapped to ±1 per the reference)."""
+    return op_call(
+        lambda x, y: jnp.maximum(0.0, 1.0 - x * (2.0 * y - 1.0)),
+        input, label, name="hinge_loss", n_diff=1)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """In-place diagonal fill (≙ phi fill_diagonal kernel). 2-D: diagonal
+    at `offset`; wrap=True restarts the diagonal every ncols rows for tall
+    matrices (torch/paddle semantics). >2-D: all dims must match; fills
+    x[i, i, ..., i]."""
+    a = x._data
+    if a.ndim == 2:
+        h, w = a.shape
+        rows = np.arange(h)
+        cols = rows + offset
+        if wrap and h > w:
+            cols = cols % (w + 1)
+            keep = cols < w
+        else:
+            keep = (cols >= 0) & (cols < w)
+        rr, cc = rows[keep], cols[keep]
+        x._assign_raw(a.at[jnp.asarray(rr), jnp.asarray(cc)].set(value))
+        return x
+    n = min(a.shape)
+    idx = tuple(jnp.arange(n) for _ in range(a.ndim))
+    x._assign_raw(a.at[idx].set(value))
+    return x
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Out-of-place: write tensor y along the (dim1, dim2) diagonal.
+    y's last dim runs along the diagonal; its leading dims are the
+    remaining (non-diagonal) dims of x in order (reference layout)."""
+    d1, d2 = dim1 % x.ndim, dim2 % x.ndim
+    n1, n2 = int(x.shape[d1]), int(x.shape[d2])
+    rows = np.arange(n1)
+    keep = (rows + offset >= 0) & (rows + offset < n2)
+    rr = jnp.asarray(rows[keep])
+    cc = rr + offset
+
+    def f(a, v):
+        # move the non-diag dims first, diag dims last → index the pair
+        rest = [i for i in range(a.ndim) if i not in (d1, d2)]
+        at = jnp.transpose(a, rest + [d1, d2])      # [..., n1, n2]
+        vv = v[..., :rr.shape[0]]
+        at = at.at[..., rr, cc].set(vv)
+        inv = np.argsort(rest + [d1, d2])
+        return jnp.transpose(at, inv)
+
+    return op_call(f, x, y, name="fill_diagonal_tensor", n_diff=1)
+
+
+def fill_diagonal_tensor_(x, y, offset=0, dim1=0, dim2=1, name=None):
+    out = fill_diagonal_tensor(x, y, offset, dim1, dim2)
+    x._assign_raw(out._data)
+    return x
+
+
+def shuffle_batch(x, seed=0, name=None):
+    """Random permutation of dim 0 (legacy shuffle_batch op). Host-side
+    permutation (data-independent order must be materialized)."""
+    n = int(x.shape[0])
+    perm = (np.random.RandomState(seed) if seed else np.random).permutation(n)
+    pj = jnp.asarray(perm)
+    return op_call(lambda a: a[pj], x, name="shuffle_batch")
+
+
+def truncated_gaussian_random(shape, mean=0.0, std=1.0, a=-2.0, b=2.0,
+                              dtype="float32", seed=0, name=None):
+    """Gaussian truncated to [a, b] std units (≙ phi
+    truncated_gaussian_random kernel; backs initializer.TruncatedNormal)."""
+    from ..core.rng import next_key
+
+    key = jax.random.PRNGKey(int(seed)) if seed else next_key()
+    val = jax.random.truncated_normal(
+        key, a, b, tuple(int(s) for s in shape)).astype(np.dtype(dtype))
+    return Tensor(val * std + mean, _internal=True)
+
+
+def affine_channel(x, scale, bias, data_format="NCHW", name=None):
+    """Per-channel affine y = x*scale[C] + bias[C] (≙ phi affine_channel)."""
+    ch_axis = 1 if data_format == "NCHW" else -1
+
+    def f(a, s, b):
+        shape = [1] * a.ndim
+        shape[ch_axis] = -1
+        return a * s.reshape(shape) + b.reshape(shape)
+
+    return op_call(f, x, scale, bias, name="affine_channel")

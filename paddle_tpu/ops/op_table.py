@@ -31,6 +31,7 @@ class OpSpec:
     domain: tuple = (-2.0, 2.0)      # sample range for float inputs
     domains: tuple | None = None     # per-input ranges (overrides domain)
     int_inputs: tuple = ()           # positions sampled as ints
+    no_grad_inputs: tuple = ()       # float positions with no defined grad
     ref: Callable | None = None      # independent NumPy reference
     shape: tuple = (2, 3)
     shapes: tuple | None = None      # per-input shapes
@@ -416,3 +417,6 @@ def ensure_populated():
     if not _populated:
         _populated = True
         _populate()
+        from .op_table_ext import populate_ext
+
+        populate_ext()

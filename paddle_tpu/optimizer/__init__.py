@@ -297,6 +297,55 @@ class Adagrad(Optimizer):
         p._assign_raw(p._data - lr_val * gd / (jnp.sqrt(new_acc) + self._epsilon))
 
 
+class DecayedAdagrad(Optimizer):
+    """Adagrad with an exponentially decayed accumulator (≙ phi
+    decayed_adagrad kernel, /root/reference/paddle/phi/kernels/
+    decayed_adagrad_kernel.h): acc = decay·acc + (1-decay)·g²."""
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _apply_one(self, p, g, lr_val, wd):
+        acc = self._acc("moment", p)
+        gd = g._data + _wd_grad(wd, p._data)
+        new_acc = self._decay * acc._data + (1 - self._decay) * jnp.square(gd)
+        acc._assign_raw(new_acc)
+        p._assign_raw(p._data - lr_val * gd / (jnp.sqrt(new_acc) + self._epsilon))
+
+
+class Ftrl(Optimizer):
+    """FTRL-proximal (McMahan 2013) (≙ phi ftrl kernel,
+    /root/reference/paddle/phi/kernels/ftrl_kernel.h): per-coordinate
+    adaptive step with L1/L2 proximal regularization."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._l1 = l1
+        self._l2 = l2
+        self._lr_power = lr_power
+
+    def _apply_one(self, p, g, lr_val, wd):
+        sq = self._acc("squared", p)     # n: sum of g²
+        lin = self._acc("linear", p)     # z
+        gd = g._data + _wd_grad(wd, p._data)
+        new_sq = sq._data + jnp.square(gd)
+        lp = self._lr_power
+        sigma = (jnp.power(new_sq, -lp) - jnp.power(sq._data, -lp)) / lr_val
+        new_lin = lin._data + gd - sigma * p._data
+        sq._assign_raw(new_sq)
+        lin._assign_raw(new_lin)
+        quad = jnp.power(new_sq, -lp) / lr_val + 2.0 * self._l2
+        pre = jnp.clip(new_lin, -self._l1, self._l1) - new_lin
+        p._assign_raw(jnp.where(jnp.abs(new_lin) > self._l1,
+                                pre / quad, jnp.zeros_like(p._data)))
+
+
 class RMSProp(Optimizer):
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
                  centered=False, parameters=None, weight_decay=None, grad_clip=None,

@@ -28,6 +28,7 @@ __all__ = [
     'DeformConv2D', 'distribute_fpn_proposals', 'generate_proposals',
     'read_file', 'decode_jpeg', 'roi_pool', 'RoIPool', 'psroi_pool',
     'PSRoIPool', 'roi_align', 'RoIAlign', 'nms', 'matrix_nms',
+    'box_clip', 'bipartite_match',
 ]
 
 
@@ -809,3 +810,63 @@ def decode_jpeg(x, mode="unchanged", name=None):
     else:
         arr = arr.transpose(2, 0, 1)
     return _mk(arr.copy())
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image bounds (≙ phi box_clip_kernel). input
+    [N, B, 4] or [B, 4] xyxy; im_info [N, 3] (h, w, scale)."""
+    def f(boxes, info):
+        squeeze = boxes.ndim == 2
+        bx = boxes[None] if squeeze else boxes
+        h = info[:, 0, None, None] / info[:, 2, None, None] - 1.0
+        w = info[:, 1, None, None] / info[:, 2, None, None] - 1.0
+        x1 = jnp.clip(bx[..., 0:1], 0.0, w)
+        y1 = jnp.clip(bx[..., 1:2], 0.0, h)
+        x2 = jnp.clip(bx[..., 2:3], 0.0, w)
+        y2 = jnp.clip(bx[..., 3:4], 0.0, h)
+        out = jnp.concatenate([x1, y1, x2, y2], axis=-1)
+        return out[0] if squeeze else out
+
+    return op_call(f, input, im_info, name="box_clip", n_diff=1)
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=None,
+                    name=None):
+    """Greedy bipartite matching of columns (predictions) to rows (ground
+    truth) by descending distance (≙ phi bipartite_match kernel). Host-side:
+    the greedy loop is data-dependent. Returns (match_indices [1, C],
+    match_dist [1, C])."""
+    d = np.asarray(dist_matrix._data if hasattr(dist_matrix, "_data")
+                   else dist_matrix)
+    if d.ndim == 2:
+        d = d[None]
+    n, rows, cols = d.shape
+    all_idx = np.full((n, cols), -1, np.int64)
+    all_dist = np.zeros((n, cols), np.float32)
+    for b in range(n):
+        dm = d[b].copy()
+        row_used = np.zeros(rows, bool)
+        col_used = np.zeros(cols, bool)
+        # bipartite phase: repeatedly take the global max pair
+        for _ in range(min(rows, cols)):
+            r, c = np.unravel_index(np.argmax(
+                np.where(row_used[:, None] | col_used[None, :], -np.inf, dm)),
+                dm.shape)
+            if not np.isfinite(dm[r, c]) or dm[r, c] <= 0:
+                break
+            all_idx[b, c] = r
+            all_dist[b, c] = dm[r, c]
+            row_used[r] = True
+            col_used[c] = True
+        if match_type == "per_prediction":
+            thr = 0.5 if dist_threshold is None else float(dist_threshold)
+            for c in range(cols):
+                if not col_used[c]:
+                    r = int(np.argmax(d[b][:, c]))
+                    if d[b][r, c] >= thr:
+                        all_idx[b, c] = r
+                        all_dist[b, c] = d[b][r, c]
+    from ..core.tensor import Tensor as _T
+
+    return (_T(jnp.asarray(all_idx), _internal=True, stop_gradient=True),
+            _T(jnp.asarray(all_dist), _internal=True, stop_gradient=True))

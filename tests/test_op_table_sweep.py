@@ -54,8 +54,9 @@ def test_grad_fp32(spec):
     op_test.py check_grad discipline)."""
     arrays = spec.sample_inputs(seed=1)
     ts = [paddle.to_tensor(a) for a in arrays]
+    skip = set(spec.int_inputs) | set(spec.no_grad_inputs)
     for i, t in enumerate(ts):
-        if i not in spec.int_inputs:
+        if i not in skip:
             t.stop_gradient = False
     out = spec.fn(*ts, **spec.kwargs)
     if isinstance(out, (tuple, list)):
@@ -69,7 +70,7 @@ def test_grad_fp32(spec):
     eps = 1e-3
     checked = 0
     for i, t in enumerate(ts):
-        if i in spec.int_inputs:
+        if i in skip:
             continue
         g = t.grad
         assert g is not None, f"no grad for input {i} of {spec.name}"
@@ -116,6 +117,7 @@ def test_grad_bf16_consistency(spec):
     arrays = spec.sample_inputs(seed=3)
 
     def grads(cast_bf16):
+        skip = set(spec.int_inputs) | set(spec.no_grad_inputs)
         ts = []
         for i, a in enumerate(arrays):
             if i in spec.int_inputs:
@@ -124,14 +126,15 @@ def test_grad_bf16_consistency(spec):
                 t = paddle.to_tensor(
                     np.asarray(jnp.asarray(a, jnp.bfloat16)) if cast_bf16
                     else a)
-                t.stop_gradient = False
+                if i not in skip:
+                    t.stop_gradient = False
                 ts.append(t)
         out = spec.fn(*ts, **spec.kwargs)
         if isinstance(out, (tuple, list)):
             out = out[0]
         out.sum().backward()
         return [np.asarray(t.grad._data.astype(jnp.float32))
-                for i, t in enumerate(ts) if i not in spec.int_inputs]
+                for i, t in enumerate(ts) if i not in skip]
 
     g32 = grads(False)
     gb = grads(True)

@@ -59,8 +59,8 @@ def build_surface():
         if not name.startswith("_"):
             surface.setdefault(name, "incubate.F")
     for modname in ("linalg", "fft", "sparse", "signal", "geometric",
-                    "incubate", "distributed", "optimizer", "metric",
-                    "vision", "text", "audio"):
+                    "incubate", "distributed", "distribution", "optimizer",
+                    "metric", "vision", "text", "audio"):
         mod = getattr(paddle, modname, None)
         if mod is None:
             continue
@@ -70,6 +70,22 @@ def build_surface():
     for name in dir(paddle.vision.ops):
         if not name.startswith("_"):
             surface.setdefault(name, "vision.ops")
+    # deep namespaces the shallow getattr loop can't reach
+    import paddle_tpu.amp as _amp
+    import paddle_tpu.device as _device
+    import paddle_tpu.nn.utils as _nnutils
+    import paddle_tpu.quantization as _quant
+    from paddle_tpu.incubate.distributed.models.moe import moe_layer as _moe
+    from paddle_tpu.quantization import ptq as _ptq
+
+    _ampdbg = _amp.debugging
+    for mod, tag in ((_quant, "quantization"), (_ptq, "quantization.ptq"),
+                     (_amp, "amp"), (_ampdbg, "amp.debugging"),
+                     (_device, "device"), (_nnutils, "nn.utils"),
+                     (_moe, "incubate.moe")):
+        for name in dir(mod):
+            if not name.startswith("_"):
+                surface.setdefault(name, tag)
     # case-insensitive view: reference op names are snake_case while e.g.
     # optimizers surface as classes (adamw_ -> AdamW)
     lower = {}
@@ -114,6 +130,78 @@ RENAMES = {
     "embedding_with_scaled_gradient": "embedding",
     "repeat_interleave_with_tensor_index": "repeat_interleave",
     "sigmoid_cross_entropy_with_logits": "binary_cross_entropy_with_logits",
+    # ---- round-4 additions: same functionality under this framework's name
+    "unpool": "max_unpool2d", "unpool3d": "max_unpool3d",
+    "max_pool2d_with_index": "max_pool2d",   # return_mask=True path
+    "max_pool3d_with_index": "max_pool3d",
+    "pool2d": "max_pool2d", "pool3d": "max_pool3d",
+    "p_norm": "norm", "l1_norm": "norm", "squared_l2_norm": "norm",
+    "split_with_num": "split",
+    "truncated_gaussian_random": "truncated_gaussian_random",
+    "uniform_inplace": "uniform_",
+    "uniform_random_batch_size_like": "uniform",
+    "full_batch_size_like": "full_like", "full_int_array": "full",
+    "full_with_tensor": "full", "shape64": "shape",
+    "view_dtype": "view", "view_shape": "view", "view_slice": "as_strided",
+    "copy_to": "to", "share_data": "detach",
+    "assign_out_": "assign", "assign_value_": "assign",
+    "trans_layout": "transpose",
+    "memory_efficient_attention": "scaled_dot_product_attention",
+    "calc_reduced_attn_scores": "scaled_dot_product_attention",
+    "merged_adam_": "Adam",        # use_multi_tensor fused path
+    "merged_momentum_": "Momentum",
+    "coalesce_tensor": "Adam",     # multi-tensor buffer fusion lives there
+    "update_loss_scaling_": "GradScaler",
+    "average_accumulates_": "ModelAverage",
+    "c_allreduce_sum": "all_reduce", "mp_allreduce_sum": "all_reduce",
+    "c_concat": "all_gather", "c_scatter": "scatter", "c_split": "split",
+    "c_identity": "identity",
+    "partial_allgather": "all_gather", "partial_concat": "concat",
+    "partial_sum": "add_n", "sync_calc_stream": "synchronize",
+    "warpctc": "ctc_loss", "warprnnt": "rnnt_loss",
+    "im2sequence": "unfold", "gru_unit": "GRUCell",
+    "attention_lstm": "LSTM",
+    "fused_batch_norm_act": "batch_norm",
+    "fused_bn_add_activation": "batch_norm",
+    "fused_softmax_mask_upper_triangle": "softmax",
+    "conv2d_transpose_bias": "conv2d_transpose",
+    "matrix_rank_atol_rtol": "matrix_rank",
+    "set_value_with_tensor": "set_value",
+    "index_select_strided": "index_select",
+    "accuracy_check": "allclose",
+    "check_numerics": "check_numerics",
+    "disable_check_model_nan_inf": "check_numerics",
+    "enable_check_model_nan_inf": "check_numerics",
+    "segment_pool": "segment_sum",
+    "shuffle_channel": "channel_shuffle", "shuffle_batch": "shuffle_batch",
+    "multiclass_nms3": "matrix_nms",
+    "yolo_box_head": "yolo_box", "yolo_box_post": "yolo_box",
+    "collect_fpn_proposals": "distribute_fpn_proposals",
+    "data": "to_tensor", "depend": "to_tensor",
+    "fill_diagonal": "fill_diagonal_",
+    "fill_diagonal_tensor": "fill_diagonal_tensor",
+    # quantization framework covers the fake-quant kernel family
+    "fake_quantize_abs_max": "FakeQuanterWithAbsMax",
+    "fake_quantize_dequantize_abs_max": "FakeQuanterWithAbsMax",
+    "fake_quantize_dequantize_moving_average_abs_max":
+        "FakeQuanterWithAbsMax",
+    "fake_quantize_moving_average_abs_max": "FakeQuanterWithAbsMax",
+    "fake_quantize_range_abs_max": "FakeQuanterWithAbsMax",
+    "fake_channel_wise_quantize_abs_max": "FakeQuanterWithAbsMax",
+    "fake_channel_wise_quantize_dequantize_abs_max":
+        "FakeQuanterWithAbsMax",
+    "fake_channel_wise_dequantize_max_abs": "QuantizedLinear",
+    "fake_dequantize_max_abs": "QuantizedLinear",
+    "dequantize_abs_max": "QuantizedLinear",
+    "weight_only_linear": "QuantizedLinear",
+    "weight_quantize": "QuantizedLinear",
+    "weight_dequantize": "QuantizedLinear",
+    "llm_int8_linear": "QuantizedLinear",
+    "apply_per_channel_scale": "QuantizedLinear",
+    # MoE routing machinery lives inside the gates / EP layer
+    "number_count": "MoELayer", "limit_by_capacity": "MoELayer",
+    "prune_gate_by_capacity": "MoELayer", "assign_pos": "MoELayer",
+    "random_routing": "MoELayer",
 }
 
 
