@@ -375,17 +375,23 @@ def bench_int8(iters=30, m=2048, k=4096, n=4096):
             "speedup_vs_bf16": round(dt_bf16 / dt_int8, 2)}
 
 
-def bench_eager_dispatch(iters=50):
+def bench_eager_dispatch(iters=50, size=256):
     """Micro-bench: per-op eager dispatch overhead (matmul chain), the
     SURVEY §7-1 hot loop — measured with the per-op executable cache off
     (uncached jax.vjp re-trace) and on (jitted fwd/vjp pairs, the analog of
-    KernelFactory's precompiled kernels)."""
+    KernelFactory's precompiled kernels).
+
+    `size` matters for honesty: on the HOST CPU backend a 256-square matmul
+    costs ~340 us of actual compute inside the timed region, swamping
+    dispatch (round 3 reported that as '502 us dispatch overhead'). The
+    eager_host row therefore runs size=16 so the number isolates the
+    FRAMEWORK's per-op cost."""
     import paddle_tpu as paddle
     from paddle_tpu.core import dispatch
 
     paddle.seed(0)
-    x = paddle.rand([256, 256])
-    w = paddle.rand([256, 256])
+    x = paddle.rand([size, size])
+    w = paddle.rand([size, size])
     w.stop_gradient = False
     n_ops = 20
 
@@ -442,9 +448,10 @@ def bench_fused_adam(iters=15):
 
 
 def bench_eager_host(iters=50):
-    """bench_eager_dispatch on the host CPU backend (no tunnel RTT): the
-    framework's own per-op dispatch overhead."""
-    res = bench_eager_dispatch(iters=iters)
+    """bench_eager_dispatch on the host CPU backend (no tunnel RTT), with
+    tiny operands so compute is negligible: the framework's own per-op
+    dispatch overhead (VERDICT r3 Weak #4 target: <=150 us/op cached)."""
+    res = bench_eager_dispatch(iters=iters, size=16)
     res["name"] = "eager_dispatch_on_host_cpu"
     return res
 

@@ -169,16 +169,27 @@ def _complexify_vjp(vjp_fn, single_out):
     return wrapped
 
 
-def _needs_complex_bridge(avals, datas, diff_idx):
-    import numpy as _np2
+_COMPLEX_DTYPE_MEMO: dict = {}
 
-    if any(_np2.issubdtype(_np2.dtype(dt), _np2.complexfloating)
-           for _, dt in avals):
-        return True
-    return any(
-        hasattr(datas[i], "dtype")
-        and _np2.issubdtype(_np2.dtype(datas[i].dtype), _np2.complexfloating)
-        for i in diff_idx)
+
+def _is_complex_dtype(dt) -> bool:
+    r = _COMPLEX_DTYPE_MEMO.get(dt)
+    if r is None:
+        r = np.issubdtype(np.dtype(dt), np.complexfloating)
+        _COMPLEX_DTYPE_MEMO[dt] = r
+    return r
+
+
+def _needs_complex_bridge(avals, datas, diff_idx):
+    for _, dt in avals:
+        if _is_complex_dtype(dt):
+            return True
+    for i in diff_idx:
+        d = datas[i]
+        dt = getattr(d, "dtype", None)
+        if dt is not None and _is_complex_dtype(dt):
+            return True
+    return False
 
 
 def _is_tensor(x) -> bool:

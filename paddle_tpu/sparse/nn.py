@@ -163,3 +163,138 @@ class MaxPool3D(Layer):
         from .conv import max_pool3d
 
         return max_pool3d(x, self._k, self._s, self._p)
+
+
+class BatchNorm(Layer):
+    """Batch normalization over the VALUES of a channel-last SparseCooTensor
+    (≙ /root/reference/python/paddle/sparse/nn/layer/norm.py:35, which
+    reuses BatchNorm1D on the nnz-values view). Statistics are computed per
+    channel over the nonzero entries only; indices pass through unchanged."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        if data_format not in ("NDHWC", "NHWC"):
+            raise ValueError(
+                "sparse BatchNorm only supports channel-last layouts "
+                f"(NDHWC/NHWC), got {data_format}")
+        from ..nn import BatchNorm1D
+
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon, weight_attr=weight_attr,
+                               bias_attr=bias_attr,
+                               use_global_stats=use_global_stats)
+
+    def forward(self, x):
+        from . import _build, _check_sparse
+
+        _check_sparse(x)
+        vals = x._spvals
+        if vals.ndim >= 2:
+            # hybrid layout: values already [nnz, C] — the reference's
+            # exact values-view BN
+            out_vals = self._bn(vals)
+        else:
+            # all-sparse COO: group values by their channel coordinate
+            # (last index dim) and normalize per channel over that
+            # channel's nonzeros — the values-view semantics generalized
+            out_vals = self._bn_by_channel(vals, x._spidx)
+        out = _build(out_vals, x._spidx, x._spshape)
+        if getattr(x, "_csr", None) is not None:
+            out._csr = x._csr
+        return out
+
+    def _bn_by_channel(self, vals, spidx):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from paddle_tpu.core.dispatch import no_grad, op_call
+        from paddle_tpu.core.tensor import Tensor
+
+        bn = self._bn
+        c = bn._num_features
+        ch = np.asarray(spidx[:, -1]).astype(np.int64)
+        ch_t = Tensor(jnp.asarray(ch), _internal=True, stop_gradient=True)
+        training = self.training and not bn._use_global_stats
+        eps = bn._epsilon
+
+        import jax
+
+        if training:
+            def f(v, chv, w, b):
+                cnt = jnp.maximum(
+                    jax.ops.segment_sum(jnp.ones_like(v), chv, c), 1.0)
+                m = jax.ops.segment_sum(v, chv, c) / cnt
+                var = jax.ops.segment_sum(jnp.square(v), chv, c) / cnt \
+                    - jnp.square(m)
+                out = (v - m[chv]) * jax.lax.rsqrt(var[chv] + eps)
+                return out * w[chv] + b[chv], m, var
+
+            out, m, var = op_call(f, vals, ch_t, bn.weight, bn.bias,
+                                  name="sparse_batch_norm")
+            with no_grad():
+                mom = bn._momentum
+                bn._mean._assign_raw(bn._mean._data * mom
+                                     + m._data * (1 - mom))
+                bn._variance._assign_raw(bn._variance._data * mom
+                                         + var._data * (1 - mom))
+            return out
+
+        def f(v, chv, rm, rv, w, b):
+            out = (v - rm[chv]) * jax.lax.rsqrt(rv[chv] + eps)
+            return out * w[chv] + b[chv]
+
+        return op_call(f, vals, ch_t, bn._mean, bn._variance, bn.weight,
+                       bn.bias, name="sparse_batch_norm_eval")
+
+
+class SyncBatchNorm(BatchNorm):
+    """≙ sparse.nn.SyncBatchNorm: under the single-controller mesh design
+    batch statistics are computed over the global (replicated or sharded)
+    values view, so the dense SyncBatchNorm semantics carry over."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        if isinstance(layer, BatchNorm) and not isinstance(layer,
+                                                           SyncBatchNorm):
+            new = SyncBatchNorm(layer._bn._num_features)
+            new._bn = layer._bn
+            return new
+        for name, sub in getattr(layer, "_sub_layers", {}).items():
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+def _sparse_attention(query, key, value, sparse_mask, key_padding_mask=None,
+                      attn_mask=None, name=None):
+    """softmax(QK^T/sqrt(d) masked to sparse_mask's CSR pattern) V
+    (≙ sparse/nn/functional/transformer.py attention). q/k/v dense
+    [B, H, S, D]; sparse_mask CSR with dense shape [B*H, S, S] (or one
+    shared [S, S] pattern)."""
+    import numpy as np
+
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.nn.functional.extended import sparse_attention as _sa
+    import jax.numpy as jnp
+
+    b, h, s, _ = (int(v) for v in query.shape)
+    csr = getattr(sparse_mask, "_csr", None)
+    if csr is None:
+        raise TypeError("sparse_mask must be a SparseCsrTensor")
+    crows, cols = csr
+    crows = np.asarray(crows)
+    cols = np.asarray(cols)
+    if crows.ndim == 1 and crows.shape[0] == s + 1:
+        offs = np.broadcast_to(crows, (b, h, s + 1))
+        colm = np.broadcast_to(cols, (b, h, cols.shape[0]))
+    else:
+        offs = crows.reshape(b, h, s + 1)
+        colm = cols.reshape(b, h, -1)
+    return _sa(query, key, value,
+               Tensor(jnp.asarray(offs), _internal=True, stop_gradient=True),
+               Tensor(jnp.asarray(colm), _internal=True, stop_gradient=True),
+               key_padding_mask, attn_mask)
+
+
+functional.attention = _sparse_attention

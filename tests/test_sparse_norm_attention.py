@@ -1,0 +1,128 @@
+"""Sparse BatchNorm / SyncBatchNorm / attention (VERDICT r3 Missing #4).
+
+Parity oracle: dense computations restricted to the nonzero entries —
+sparse BN must match BatchNorm1D over the values view
+(/root/reference/python/paddle/sparse/nn/layer/norm.py:35 does exactly
+that), sparse attention must match dense softmax(QK/sqrt d)V under the
+CSR mask (functional/transformer.py attention).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _coo_random(shape=(2, 4, 3), density=0.5, seed=0):
+    rs = np.random.RandomState(seed)
+    dense = rs.randn(*shape).astype("float32")
+    dense[rs.rand(*shape) >= density] = 0.0
+    return dense
+
+
+class TestSparseBatchNorm:
+    def test_values_parity_per_channel(self):
+        dense = _coo_random((10, 4))          # [N, C] channel-last
+        sp = paddle.to_tensor(dense).to_sparse_coo(2)
+        paddle.seed(0)
+        bn = sparse.nn.BatchNorm(4)
+        out = bn(sp)
+        # oracle: per-channel stats over that channel's nonzero values
+        # (the values-view BN of the reference, generalized to all-sparse
+        # COO where each nonzero carries one channel coordinate)
+        idx = np.asarray(sp.indices()._data)          # [ndim, nnz]
+        vals = np.asarray(sp.values()._data)
+        ch = idx[-1]
+        want = np.empty_like(vals)
+        for ci in range(4):
+            v = vals[ch == ci]
+            m, va = v.mean(), v.var()
+            want[ch == ci] = (v - m) / np.sqrt(va + 1e-5)
+        np.testing.assert_allclose(np.asarray(out.values()._data), want,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(out.indices()._data), idx)
+
+    def test_running_stats_update(self):
+        dense = _coo_random((20, 3), seed=1)
+        sp = paddle.to_tensor(dense).to_sparse_coo(2)
+        bn = sparse.nn.BatchNorm(3)
+        bn.train()
+        before = np.asarray(bn._bn._mean._data).copy()
+        bn(sp)
+        assert np.abs(np.asarray(bn._bn._mean._data) - before).max() > 0
+
+    def test_channel_first_raises(self):
+        with pytest.raises(ValueError):
+            sparse.nn.BatchNorm(3, data_format="NCDHW")
+
+    def test_sync_batchnorm_convert(self):
+        bn = sparse.nn.BatchNorm(4)
+        sync = sparse.nn.SyncBatchNorm.convert_sync_batchnorm(bn)
+        assert isinstance(sync, sparse.nn.SyncBatchNorm)
+        dense = _coo_random((6, 4), seed=2)
+        out = sync(paddle.to_tensor(dense).to_sparse_coo(2))
+        assert out.is_sparse()
+
+
+class TestSparseAttention:
+    def _setup(self, b=1, h=2, s=4, d=8, seed=0):
+        rs = np.random.RandomState(seed)
+        q = rs.randn(b, h, s, d).astype("float32") * 0.5
+        k = rs.randn(b, h, s, d).astype("float32") * 0.5
+        v = rs.randn(b, h, s, d).astype("float32")
+        return q, k, v
+
+    def test_parity_vs_dense_masked(self):
+        b, h, s, d = 1, 2, 4, 8
+        q, k, v = self._setup(b, h, s, d)
+        # causal CSR pattern shared across batch*heads
+        crows = np.array([0, 1, 3, 6, 10], "int64")
+        cols = np.concatenate([np.arange(i + 1) for i in range(s)])
+        mask_dense = np.tril(np.ones((s, s), "float32"))
+        sm = sparse.sparse_csr_tensor(crows, cols,
+                                      np.ones(len(cols), "float32"),
+                                      (s, s))
+        out = sparse.nn.functional.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            sm)
+        scores = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+        scores = np.where(mask_dense[None, None] > 0, scores, -np.inf)
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        want = np.einsum("bhqk,bhkd->bhqd", p, v)
+        np.testing.assert_allclose(np.asarray(out._data), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_key_padding_mask(self):
+        b, h, s, d = 1, 1, 4, 8
+        q, k, v = self._setup(b, h, s, d, seed=1)
+        crows = np.array([0, 4, 8, 12, 16], "int64")
+        cols = np.tile(np.arange(s), s)
+        sm = sparse.sparse_csr_tensor(crows, cols,
+                                      np.ones(16, "float32"), (s, s))
+        kpm = np.array([[1.0, 1.0, 0.0, 1.0]], "float32")  # key 2 masked
+        out = sparse.nn.functional.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            sm, key_padding_mask=paddle.to_tensor(kpm))
+        scores = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+        scores[..., 2] = -np.inf
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        want = np.einsum("bhqk,bhkd->bhqd", p, v)
+        np.testing.assert_allclose(np.asarray(out._data), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_grad_flows(self):
+        b, h, s, d = 1, 1, 4, 8
+        q, k, v = self._setup(b, h, s, d, seed=2)
+        crows = np.array([0, 1, 3, 6, 10], "int64")
+        cols = np.concatenate([np.arange(i + 1) for i in range(s)])
+        sm = sparse.sparse_csr_tensor(crows, cols,
+                                      np.ones(len(cols), "float32"), (s, s))
+        qt = paddle.to_tensor(q)
+        qt.stop_gradient = False
+        out = sparse.nn.functional.attention(
+            qt, paddle.to_tensor(k), paddle.to_tensor(v), sm)
+        out.sum().backward()
+        g = np.asarray(qt.grad._data)
+        assert g.shape == q.shape and np.isfinite(g).all()
