@@ -121,6 +121,9 @@ define_flag("FLAGS_jit_code_level", 100, "SOT code-dump verbosity shim")
 define_flag("FLAGS_jit_verbosity", 0, "dy2static logging verbosity shim")
 define_flag("FLAGS_jit_log_to_stdout", False,
             "mirror dy2static logs to stdout (set_verbosity also_to_stdout)")
+define_flag("FLAGS_flash_autotune", True,
+            "runtime autotune of Pallas flash attention block sizes per "
+            "shape family (≙ phi autotune/auto_tune_base.h)")
 
 
 # the full reference flag surface (compat entries; must come after the

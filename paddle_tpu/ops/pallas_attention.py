@@ -487,14 +487,73 @@ def _flash_varlen_bwd(causal, block_q, block_k, res, g):
 _flash_varlen.defvjp(_flash_varlen_fwd, _flash_varlen_bwd)
 
 
+_TUNE_CACHE: dict = {}
+#: candidate (block_q, block_k) pairs, ordered by prior; the autotuner
+#: measures each on the first sighting of a shape family and pins the best
+#: (≙ reference conv/attention runtime autotuning,
+#: /root/reference/paddle/phi/kernels/autotune/auto_tune_base.h)
+_TUNE_CANDIDATES = ((512, 1024), (256, 1024), (512, 512), (1024, 1024),
+                    (256, 512))
+
+
+def _autotune_blocks(q, k, v, causal):
+    """Pick (block_q, block_k) for this (sq, sk, d, dtype, causal) family.
+    Off the TPU (interpret mode) or when FLAGS_flash_autotune is off, the
+    measured v5e default is used. Probes run fwd+bwd once per candidate on
+    first use; the winner is cached for the process."""
+    from ..core.flags import flag
+
+    sq, sk, d = q.shape[2], k.shape[2], q.shape[3]
+    key = (sq, sk, d, str(q.dtype), causal)
+    hit = _TUNE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    if _interpret() or isinstance(q, jax.core.Tracer) \
+            or not flag("FLAGS_flash_autotune"):
+        return (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+    import time as _time
+
+    best, best_t = (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K), float("inf")
+    for bq_c, bk_c in _TUNE_CANDIDATES:
+        bq = min(bq_c, _ceil_to(sq, 128))
+        bk = min(bk_c, _ceil_to(sk, 128))
+        if (bq, bk) in {(min(c[0], _ceil_to(sq, 128)),
+                         min(c[1], _ceil_to(sk, 128)))
+                        for c in _TUNE_CANDIDATES[:_TUNE_CANDIDATES.index(
+                            (bq_c, bk_c))]}:
+            continue  # clamping collapsed this candidate into an earlier one
+        try:
+            fn = jax.jit(lambda a, b, c2, _bq=bq, _bk=bk: jax.grad(
+                lambda aa: jnp.sum(_flash(aa, b, c2, causal, _bq, _bk)
+                                   .astype(jnp.float32)))(a))
+            out = fn(q, k, v)
+            jax.device_get(jnp.ravel(out)[0])
+            t0 = _time.perf_counter()
+            for _ in range(2):
+                out = fn(q, k, v)
+            jax.device_get(jnp.ravel(out)[0])
+            dt = _time.perf_counter() - t0
+        except Exception:
+            continue
+        if dt < best_t:
+            best, best_t = (bq, bk), dt
+    _TUNE_CACHE[key] = best
+    return best
+
+
 def flash_attention_raw(q, k, v, causal=False,
-                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    """jax-level flash attention on [B, H, S, D] arrays (GQA expanded here)."""
+                        block_q=None, block_k=None):
+    """jax-level flash attention on [B, H, S, D] arrays (GQA expanded here).
+    block_q/block_k default to the per-shape autotuned choice."""
     hq, hk = q.shape[1], k.shape[1]
     if hq != hk:
         rep = hq // hk
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
+    if block_q is None or block_k is None:
+        tq, tk = _autotune_blocks(q, k, v, causal)
+        block_q = block_q or tq
+        block_k = block_k or tk
     bq = min(block_q, _ceil_to(q.shape[2], 128))
     bk = min(block_k, _ceil_to(k.shape[2], 128))
     return _flash(q, k, v, causal, bq, bk)
@@ -519,9 +578,33 @@ def flash_attention_varlen_raw(q, k, v, kv_lens, causal=False,
                          bq, bk)
 
 
+def ensure_tuned(b, h, sq, sk, d, dtype, causal):
+    """Eagerly autotune the block choice for a shape family using synthetic
+    operands. Called from framework code BEFORE entering any trace (jit
+    traces can only consult the cache); a no-op off-TPU, on repeat shapes,
+    or with FLAGS_flash_autotune off."""
+    from ..core.flags import flag
+
+    key = (sq, sk, d, str(jnp.dtype(dtype)), causal)
+    if key in _TUNE_CACHE or _interpret() or not flag("FLAGS_flash_autotune"):
+        return _TUNE_CACHE.get(key, (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K))
+    kk = jax.random.PRNGKey(0)
+    # one head is enough to rank block choices; keeps probe cost tiny
+    q = jax.random.normal(kk, (1, 1, sq, d), jnp.dtype(dtype))
+    k = jax.random.normal(kk, (1, 1, sk, d), jnp.dtype(dtype))
+    v = jax.random.normal(kk, (1, 1, sk, d), jnp.dtype(dtype))
+    return _autotune_blocks(q, k, v, causal)
+
+
 def flash_attention_op(query, key, value, is_causal=False):
     """Framework-level op on paddle-layout [B, S, H, D] Tensors; tape-recorded."""
     from ..core.dispatch import op_call
+
+    qd = query._data if hasattr(query, "_data") else query
+    if not isinstance(qd, jax.core.Tracer) and not _interpret():
+        kd = key._data if hasattr(key, "_data") else key
+        ensure_tuned(int(qd.shape[0]), int(qd.shape[2]), int(qd.shape[1]),
+                     int(kd.shape[1]), int(qd.shape[3]), qd.dtype, is_causal)
 
     def f(q, k, v):
         qt = jnp.swapaxes(q, 1, 2)
