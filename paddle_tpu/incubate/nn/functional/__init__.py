@@ -279,15 +279,67 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
                               seq_lens_decoder, seq_lens_this_time,
                               padding_offsets, cum_offsets, cu_seqlens_q,
                               cu_seqlens_k, block_tables, *args, **kwargs):
-    """≙ incubate block_multihead_attention (paged-attention serving
-    kernel). The paged-KV layout is a CUDA serving artifact; this build's
-    decode path is masked_multihead_attention + dense caches. Raises with
-    that pointer rather than silently emulating the block table."""
-    raise NotImplementedError(
-        "block_multihead_attention's paged-KV block tables are a CUDA "
-        "serving-engine layout; use masked_multihead_attention (dense KV "
-        "cache) or nn.functional.scaled_dot_product_attention — the XLA "
-        "serving path keeps caches dense per sequence.")
+    """≙ incubate block_multihead_attention
+    (/root/reference/paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu):
+    paged-attention DECODE step over block-table KV caches.
+
+    TPU-native lowering: block gather + dense masked attention, all static
+    shapes (the CUDA kernel's pointer-chasing becomes two XLA gathers).
+    Supports the serving decode case — every sequence contributes ONE new
+    token (seq_lens_this_time == 1); the prefill/encoder case belongs to
+    the flash path (generation engine prefill). Shapes:
+      qkv         [B, 3*H*D]   one fused step per sequence
+      key_cache   [max_blocks, H, block_size, D] (value_cache alike)
+      block_tables[B, max_blocks_per_seq] int32 block ids
+      seq_lens_decoder [B] tokens already in cache for each sequence
+    Returns (out [B, H*D], key_cache, value_cache) with the new token
+    written at position seq_lens_decoder[b]."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.core.dispatch import op_call
+
+    stt = np.asarray(seq_lens_this_time._data
+                     if hasattr(seq_lens_this_time, "_data")
+                     else seq_lens_this_time)
+    if not (stt == 1).all():
+        raise NotImplementedError(
+            "block_multihead_attention: only the decode step "
+            "(seq_lens_this_time == 1) is supported; run prefill through "
+            "the generation engine's flash path")
+    nh = int(key_cache.shape[1])
+    bs = int(key_cache.shape[2])
+    dh = int(key_cache.shape[3])
+    max_bpseq = int(block_tables.shape[1])
+
+    def f(x, kc, vc, lens, tables):
+        b = x.shape[0]
+        q, k, v = jnp.split(x.reshape(b, 3, nh, dh), 3, axis=1)
+        q, k, v = q[:, 0], k[:, 0], v[:, 0]          # [B, H, D]
+        pos = lens.astype(jnp.int32)                 # write index per seq
+        blk = jnp.take_along_axis(tables.astype(jnp.int32),
+                                  (pos // bs)[:, None], axis=1)[:, 0]
+        off = pos % bs
+        # scatter the new token into its block
+        kc = kc.at[blk, :, off].set(k)
+        vc = vc.at[blk, :, off].set(v)
+        # gather each sequence's blocks -> [B, H, max_bpseq*bs, D]
+        tb = jnp.clip(tables.astype(jnp.int32), 0, kc.shape[0] - 1)
+        keys = jnp.swapaxes(kc[tb], 1, 2).reshape(b, nh, max_bpseq * bs, dh)
+        vals = jnp.swapaxes(vc[tb], 1, 2).reshape(b, nh, max_bpseq * bs, dh)
+        scores = jnp.einsum("bhd,bhtd->bht", q, keys) / jnp.sqrt(
+            jnp.asarray(dh, jnp.float32)).astype(q.dtype)
+        valid = jnp.arange(max_bpseq * bs)[None, :] <= pos[:, None]
+        scores = jnp.where(valid[:, None, :], scores,
+                           jnp.finfo(scores.dtype).min)
+        att = jax.nn.softmax(scores.astype(jnp.float32),
+                             axis=-1).astype(q.dtype)
+        out = jnp.einsum("bht,bhtd->bhd", att, vals).reshape(b, nh * dh)
+        return out, kc, vc
+
+    return op_call(f, qkv, key_cache, value_cache, seq_lens_decoder,
+                   block_tables, name="block_multihead_attention", n_diff=3)
 
 
 def variable_length_memory_efficient_attention(query, key, value, seq_lens,

@@ -112,3 +112,60 @@ class TestGenerate:
                                      max_new_tokens=3)._data)
         np.testing.assert_array_equal(out2, _naive_greedy(m, prompt, 3))
         del out1  # values may or may not differ; parity after update is the check
+
+
+class TestBlockMultiheadAttention:
+    """Paged-KV decode attention (≙ block_multi_head_attention_kernel.cu):
+    block-table gather + masked attention must match dense attention over
+    the sequence history."""
+
+    def test_decode_parity_and_cache_write(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rs = np.random.RandomState(0)
+        B, H, D, BS, NBLK = 2, 2, 8, 4, 8
+        kc = np.zeros((NBLK, H, BS, D), "float32")
+        vc = np.zeros((NBLK, H, BS, D), "float32")
+        tables = np.array([[0, 1, -1], [2, 3, -1]], "int32")
+        lens = np.array([5, 2], "int64")
+        hist_k = rs.randn(B, 12, H, D).astype("float32")
+        hist_v = rs.randn(B, 12, H, D).astype("float32")
+        for b in range(B):
+            for t in range(lens[b]):
+                blk = tables[b][t // BS]
+                kc[blk, :, t % BS] = hist_k[b, t]
+                vc[blk, :, t % BS] = hist_v[b, t]
+        qkv = rs.randn(B, 3 * H * D).astype("float32")
+        out, kc2, vc2 = IF.block_multihead_attention(
+            paddle.to_tensor(qkv), paddle.to_tensor(kc),
+            paddle.to_tensor(vc), paddle.to_tensor(np.zeros(B, "int64")),
+            paddle.to_tensor(lens), paddle.to_tensor(np.ones(B, "int64")),
+            None, None, None, None, paddle.to_tensor(tables))
+        got = np.asarray(out._data)
+        x = qkv.reshape(B, 3, H, D)
+        q, k, v = x[:, 0], x[:, 1], x[:, 2]
+        for b in range(B):
+            ks = np.concatenate([hist_k[b, :lens[b]], k[b][None]], 0)
+            vs = np.concatenate([hist_v[b, :lens[b]], v[b][None]], 0)
+            s = np.einsum("hd,thd->ht", q[b], ks) / np.sqrt(D)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p = p / p.sum(-1, keepdims=True)
+            want = np.einsum("ht,thd->hd", p, vs).reshape(-1)
+            np.testing.assert_allclose(got[b], want, rtol=1e-4, atol=1e-5)
+        blk, off = tables[0][5 // BS], 5 % BS
+        np.testing.assert_allclose(np.asarray(kc2._data)[blk, :, off],
+                                   k[0], rtol=1e-6)
+
+    def test_prefill_raises(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        with pytest.raises(NotImplementedError):
+            IF.block_multihead_attention(
+                paddle.to_tensor(np.zeros((1, 48), "float32")),
+                paddle.to_tensor(np.zeros((2, 2, 4, 8), "float32")),
+                paddle.to_tensor(np.zeros((2, 2, 4, 8), "float32")),
+                paddle.to_tensor(np.array([4], "int64")),
+                paddle.to_tensor(np.array([0], "int64")),
+                paddle.to_tensor(np.array([4], "int64")),
+                None, None, None, None,
+                paddle.to_tensor(np.array([[0]], "int32")))
