@@ -476,3 +476,135 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
 
 
 from .fused_loss import fused_linear_cross_entropy  # noqa: E402,F401
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1,
+                    name=None):
+    """Quantize a weight matrix to int8 with per-out-channel absmax scales
+    (≙ phi weight_quantize_kernel,
+    /root/reference/paddle/phi/kernels/gpu/weight_quantize_kernel.cu).
+    Returns (int8 weight, fp scales). int4 packs two nibbles per byte on
+    CUDA; on TPU int4 storage has no MXU path, so int4 requests quantize
+    at int8 resolution with the int4 value range."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.dispatch import op_call
+
+    if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
+        raise ValueError(f"weight_quantize: unknown algo {algo!r}")
+    qmax = 7.0 if algo == "weight_only_int4" else 127.0
+
+    def f(w):
+        scale = jnp.max(jnp.abs(w), axis=0) / qmax
+        q = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-9)), -qmax, qmax)
+        return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+    return op_call(f, x, name="weight_quantize", n_diff=0)
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16",
+                      name=None):
+    """int8 weight + scales -> float weight (≙ phi weight_dequantize)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import dtype as dtypes
+    from paddle_tpu.core.dispatch import op_call
+
+    dt = dtypes.convert_dtype(out_dtype)
+
+    def f(q, s):
+        return (q.astype(jnp.float32) * s[None, :]).astype(dt)
+
+    return op_call(f, x, scale, name="weight_dequantize", n_diff=0)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1,
+                       name=None):
+    """y = x @ dequant(weight) + bias with int8-stored weights
+    (≙ phi weight_only_linear_kernel — the serving memory-bound GEMM).
+    The weight dequant fuses into the GEMM under XLA; activations stay in
+    their original float dtype."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.dispatch import op_call
+
+    if weight_scale is None:
+        raise ValueError("weight_only_linear requires weight_scale")
+
+    def f(a, w, s, *b):
+        wf = w.astype(a.dtype) * s[None, :].astype(a.dtype)
+        out = a @ wf
+        if b:
+            out = out + b[0]
+        return out
+
+    args = [x, weight, weight_scale] + ([bias] if bias is not None else [])
+    return op_call(f, *args, name="weight_only_linear", n_diff=1)
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0, name=None):
+    """LLM.int8() (Dettmers 2022) mixed-precision GEMM (≙ phi
+    llm_int8_linear_kernel): outlier activation columns (|x| > threshold)
+    run in float against the dequantized weight rows; the rest runs
+    int8×int8→int32."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.dispatch import op_call
+
+    if weight_scale is None:
+        raise ValueError("llm_int8_linear requires weight_scale")
+
+    def f(a, w, s, *b):
+        af = a.astype(jnp.float32)
+        col_max = jnp.max(jnp.abs(af), axis=tuple(range(af.ndim - 1)))
+        outlier = col_max > threshold                      # [K]
+        # int8 path over the regular columns
+        a_scale = jnp.maximum(jnp.max(jnp.abs(
+            jnp.where(outlier[None, :], 0.0, af)), axis=-1, keepdims=True),
+            1e-6) / 127.0
+        qa = jnp.clip(jnp.round(af / a_scale), -127, 127).astype(jnp.int8)
+        qa = jnp.where(outlier[None, :], 0, qa)
+        qw = jnp.where(outlier[:, None], 0, w)
+        reg = jax.lax.dot_general(
+            qa, qw, (((qa.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32).astype(jnp.float32)
+        reg = reg * a_scale * s[None, :]
+        # float path over the outlier columns
+        wf = w.astype(jnp.float32) * s[None, :]
+        out = reg + jnp.where(outlier[None, :], af, 0.0) @ jnp.where(
+            outlier[:, None], wf, 0.0)
+        if b:
+            out = out + b[0].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    import jax
+
+    args = [x, weight, weight_scale] + ([bias] if bias is not None else [])
+    return op_call(f, *args, name="llm_int8_linear", n_diff=1)
+
+
+def memory_efficient_attention(query, key, value, bias=None, cu_seqlens_q=None,
+                               cu_seqlens_k=None, max_seqlen_q=None,
+                               max_seqlen_k=None, causal=False, dropout_p=0.0,
+                               scale=None, training=True, name=None):
+    """≙ incubate memory_efficient_attention (the CUTLASS kernel family,
+    /root/reference/paddle/phi/kernels/fusion/cutlass/memory_efficient_attention/):
+    on TPU the memory-efficient algorithm IS flash attention — route to the
+    Pallas/XLA fused path. query/key/value [B, S, H, D]."""
+    import math as _m
+
+    if cu_seqlens_q is not None:
+        out, _ = F.flash_attn_unpadded(
+            query, key, value, cu_seqlens_q, cu_seqlens_k,
+            max_seqlen_q, max_seqlen_k, scale=scale, dropout=dropout_p,
+            causal=causal, training=training)
+        return out
+    q = query
+    if scale is not None:
+        d = int(query.shape[-1])
+        q = query * (scale * _m.sqrt(d))
+    return F.scaled_dot_product_attention(
+        q, key, value, attn_mask=bias, dropout_p=dropout_p,
+        is_causal=causal, training=training)

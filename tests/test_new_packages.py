@@ -576,3 +576,64 @@ class TestFusedLinearCrossEntropy:
         assert _best_chunk(50304, 8192) == 0
         assert _best_chunk(64, 16) == 16
         assert _best_chunk(60, 16) == 0
+
+
+class TestWeightOnlyLinear:
+    """Round-4 incubate quant-GEMM surface (≙ phi weight_only_linear /
+    llm_int8_linear / weight_quantize kernels)."""
+
+    def _setup(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rs = np.random.RandomState(0)
+        w = rs.randn(16, 8).astype("float32")
+        x = rs.randn(4, 16).astype("float32")
+        qw, sc = IF.weight_quantize(paddle.to_tensor(w))
+        return IF, x, w, qw, sc
+
+    def test_quantize_dequantize_roundtrip(self):
+        IF, x, w, qw, sc = self._setup()
+        assert str(qw.dtype).endswith("int8")
+        wd = IF.weight_dequantize(qw, sc, out_dtype="float32")
+        assert np.abs(np.asarray(wd._data) - w).max() \
+            < np.abs(w).max() / 100
+
+    def test_weight_only_linear_close(self):
+        IF, x, w, qw, sc = self._setup()
+        out = IF.weight_only_linear(paddle.to_tensor(x), qw,
+                                    weight_scale=sc)
+        ref = x @ w
+        assert np.abs(np.asarray(out._data) - ref).max() \
+            < 0.02 * np.abs(ref).max()
+
+    def test_llm_int8_outlier_columns(self):
+        IF, x, w, qw, sc = self._setup()
+        x2 = x.copy()
+        x2[:, 3] *= 20.0  # outlier column runs in float
+        out = IF.llm_int8_linear(paddle.to_tensor(x2), qw,
+                                 weight_scale=sc, threshold=6.0)
+        ref = x2 @ w
+        assert np.abs(np.asarray(out._data) - ref).max() \
+            < 0.03 * np.abs(ref).max()
+
+    def test_memory_efficient_attention_is_sdpa(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        import paddle_tpu.nn.functional as F
+
+        q = np.random.RandomState(1).randn(2, 5, 2, 8).astype("float32")
+        out = IF.memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            causal=True)
+        want = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            is_causal=True)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(want._data), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_weight_only_grad_flows(self):
+        IF, x, w, qw, sc = self._setup()
+        xt = paddle.to_tensor(x)
+        xt.stop_gradient = False
+        IF.weight_only_linear(xt, qw, weight_scale=sc).sum().backward()
+        assert np.isfinite(np.asarray(xt.grad._data)).all()
