@@ -47,6 +47,7 @@ class _GenSpec:
     temperature: float
     eos_token_id: int
     tie_embeddings: bool
+    arch: str = "llama"  # "llama" (RMSNorm+RoPE+SwiGLU) | "gpt" (LN+wpe+GELU)
 
 
 def _rms_norm(x, w, eps):
@@ -165,9 +166,71 @@ def _layer_forward_decode(x, lw, kc, vc, pos, spec: _GenSpec, cos, sin):
     return x + mlp, kc, vc
 
 
+def _layer_norm(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - m), axis=-1, keepdims=True)
+    return ((xf - m) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w + b
+
+
+def _gpt_layer_prefill(x, lw, spec: _GenSpec):
+    """Pre-LN GPT block over the full prompt. x [B, S, H]."""
+    from ..ops.pallas_attention import flash_attention_raw
+
+    b, s, h = x.shape
+    hn = _layer_norm(x, lw["ln1_w"], lw["ln1_b"], spec.rms_eps)
+    qkv = (hn.reshape(b * s, h) @ lw["qkv"]).reshape(
+        b, s, 3, spec.num_heads, spec.head_dim)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if jax.default_backend() == "tpu" and s >= 128:
+        out = flash_attention_raw(
+            jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+            jnp.swapaxes(v, 1, 2), causal=True)
+        out = jnp.swapaxes(out, 1, 2)
+    else:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) \
+            / math.sqrt(spec.head_dim)
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores,
+                           jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32),
+                               axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    x = x + (out.reshape(b * s, h) @ lw["o"]).reshape(b, s, h)
+    hn = _layer_norm(x, lw["ln2_w"], lw["ln2_b"], spec.rms_eps)
+    mlp = jax.nn.gelu(hn.reshape(b * s, h) @ lw["fc_in"],
+                      approximate=False) @ lw["fc_out"]
+    return x + mlp.reshape(b, s, h), (k, v)
+
+
+def _gpt_layer_decode(x, lw, kc, vc, pos, spec: _GenSpec):
+    """Pre-LN GPT block for a seq-1 query. x [B, H]."""
+    b, h = x.shape
+    hn = _layer_norm(x, lw["ln1_w"], lw["ln1_b"], spec.rms_eps)
+    qkv = (hn @ lw["qkv"]).reshape(b, 3, spec.num_heads, spec.head_dim)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    z = jnp.int32(0)
+    kc = jax.lax.dynamic_update_slice(kc, k[:, None], (z, pos, z, z))
+    vc = jax.lax.dynamic_update_slice(vc, v[:, None], (z, pos, z, z))
+    scores = jnp.einsum("bhd,bthd->bht", q, kc) / math.sqrt(spec.head_dim)
+    valid = jnp.arange(kc.shape[1]) <= pos
+    scores = jnp.where(valid[None, None, :], scores,
+                       jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bht,bthd->bhd", probs, vc)
+    x = x + out.reshape(b, h) @ lw["o"]
+    hn = _layer_norm(x, lw["ln2_w"], lw["ln2_b"], spec.rms_eps)
+    return x + jax.nn.gelu(hn @ lw["fc_in"],
+                           approximate=False) @ lw["fc_out"], kc, vc
+
+
 def _logits(x, params, spec: _GenSpec):
     """x [B, H] -> [B, V]."""
-    x = _rms_norm(x, params["final_ln"], spec.rms_eps)
+    if spec.arch == "gpt":
+        x = _layer_norm(x, params["final_ln"], params["final_ln_b"],
+                        spec.rms_eps)
+    else:
+        x = _rms_norm(x, params["final_ln"], spec.rms_eps)
     head = params["embed"].T if spec.tie_embeddings else params["lm_head"]
     return (x.astype(jnp.float32) @ head.astype(jnp.float32))
 
@@ -179,13 +242,18 @@ def _generate_program(params, ids, spec: _GenSpec, rng_key):
     b, s = ids.shape
     total = s + spec.max_new_tokens
     dtype = params["embed"].dtype
-    cos, sin = params["rope_cos"], params["rope_sin"]
+    gpt = spec.arch == "gpt"
+    if gpt:
+        x = params["embed"][ids] + params["wpe"][None, :s]
 
-    x = params["embed"][ids]                          # [B, S, H]
+        def pre(xc, lw):
+            return _gpt_layer_prefill(xc, lw, spec)
+    else:
+        cos, sin = params["rope_cos"], params["rope_sin"]
+        x = params["embed"][ids]                      # [B, S, H]
 
-    def pre(xc, lw):
-        xo, (k, v) = _layer_forward_prefill(xc, lw, spec, cos, sin)
-        return xo, (k, v)
+        def pre(xc, lw):
+            return _layer_forward_prefill(xc, lw, spec, cos, sin)
 
     x, (ks, vs) = jax.lax.scan(pre, x, params["layers"])
     # static-shaped cache for the whole generation
@@ -201,11 +269,17 @@ def _generate_program(params, ids, spec: _GenSpec, rng_key):
     def step(carry, _):
         tok, kc, vc, pos, key, finished = carry
         xt = params["embed"][tok].astype(dtype)       # [B, H]
+        if gpt:
+            xt = xt + params["wpe"][pos]
 
         def layer(xc, per_layer):
             lw, kcl, vcl = per_layer
-            xo, kcl, vcl = _layer_forward_decode(xc, lw, kcl, vcl, pos,
-                                                 spec, cos, sin)
+            if gpt:
+                xo, kcl, vcl = _gpt_layer_decode(xc, lw, kcl, vcl, pos,
+                                                 spec)
+            else:
+                xo, kcl, vcl = _layer_forward_decode(xc, lw, kcl, vcl, pos,
+                                                     spec, cos, sin)
             return xo, (kcl, vcl)
 
         xt, (kc, vc) = jax.lax.scan(layer, xt, (params["layers"], kc, vc))
@@ -274,6 +348,44 @@ def _stacked_params(model):
     return params
 
 
+def _stacked_params_gpt(model):
+    """GPT-family extraction: LN weights/biases, fused qkv, learned wpe."""
+    cfg = model.config
+    sd = {k: v for k, v in model.state_dict().items()}
+    key = (id(model),) + tuple(sorted(id(v._data) for v in sd.values()))
+    hit = _STACK_CACHE.get(id(model))
+    if hit is not None and hit[0] == key:
+        return hit[1]
+
+    def w(name):
+        return sd[name]._data
+
+    layers = {"ln1_w": [], "ln1_b": [], "qkv": [], "o": [], "ln2_w": [],
+              "ln2_b": [], "fc_in": [], "fc_out": []}
+    for i in range(cfg.num_hidden_layers):
+        base = f"blocks.{i}."
+        layers["ln1_w"].append(w(base + "ln_1.weight"))
+        layers["ln1_b"].append(w(base + "ln_1.bias"))
+        layers["qkv"].append(w(base + "attn.qkv_proj.weight"))
+        layers["o"].append(w(base + "attn.out_proj.weight"))
+        layers["ln2_w"].append(w(base + "ln_2.weight"))
+        layers["ln2_b"].append(w(base + "ln_2.bias"))
+        layers["fc_in"].append(w(base + "fc_in.weight"))
+        layers["fc_out"].append(w(base + "fc_out.weight"))
+    params = {
+        "embed": w("wte.weight"),
+        "wpe": w("wpe.weight"),
+        "final_ln": w("ln_f.weight"),
+        "final_ln_b": w("ln_f.bias"),
+        "lm_head": w("lm_head.weight"),
+        "layers": {k: jnp.stack(v) for k, v in layers.items()},
+    }
+    _STACK_CACHE[id(model)] = (key, params)
+    while len(_STACK_CACHE) > _STACK_CACHE_MAX:
+        _STACK_CACHE.pop(next(iter(_STACK_CACHE)))
+    return params
+
+
 def generate(model, input_ids, max_new_tokens=32, max_length=None,
              do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
              eos_token_id=None, seed=None):
@@ -295,15 +407,33 @@ def generate(model, input_ids, max_new_tokens=32, max_length=None,
         max_new_tokens = int(max_length) - ids.shape[1]
     if max_new_tokens <= 0:
         raise ValueError("max_new_tokens must be positive")
-    spec = _GenSpec(
-        num_layers=cfg.num_hidden_layers, num_heads=cfg.num_attention_heads,
-        num_kv_heads=cfg.num_key_value_heads, head_dim=cfg.head_dim,
-        rope_theta=cfg.rope_theta, rms_eps=cfg.rms_norm_eps,
-        max_new_tokens=int(max_new_tokens), do_sample=bool(do_sample),
-        top_k=int(top_k), top_p=float(top_p), temperature=float(temperature),
-        eos_token_id=int(eos_token_id if eos_token_id is not None else -1),
-        tie_embeddings=bool(cfg.tie_word_embeddings))
-    params = _stacked_params(model)
+    arch = "gpt" if type(model).__name__.startswith("GPT") else "llama"
+    if arch == "gpt":
+        nh = cfg.num_attention_heads
+        spec = _GenSpec(
+            num_layers=cfg.num_hidden_layers, num_heads=nh, num_kv_heads=nh,
+            head_dim=cfg.hidden_size // nh, rope_theta=0.0,
+            rms_eps=cfg.layer_norm_eps,
+            max_new_tokens=int(max_new_tokens), do_sample=bool(do_sample),
+            top_k=int(top_k), top_p=float(top_p),
+            temperature=float(temperature),
+            eos_token_id=int(eos_token_id if eos_token_id is not None
+                             else -1),
+            tie_embeddings=False, arch="gpt")
+        params = _stacked_params_gpt(model)
+    else:
+        spec = _GenSpec(
+            num_layers=cfg.num_hidden_layers,
+            num_heads=cfg.num_attention_heads,
+            num_kv_heads=cfg.num_key_value_heads, head_dim=cfg.head_dim,
+            rope_theta=cfg.rope_theta, rms_eps=cfg.rms_norm_eps,
+            max_new_tokens=int(max_new_tokens), do_sample=bool(do_sample),
+            top_k=int(top_k), top_p=float(top_p),
+            temperature=float(temperature),
+            eos_token_id=int(eos_token_id if eos_token_id is not None
+                             else -1),
+            tie_embeddings=bool(cfg.tie_word_embeddings))
+        params = _stacked_params(model)
     if seed is not None:
         key = jax.random.PRNGKey(int(seed))
     else:

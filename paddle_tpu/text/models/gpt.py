@@ -96,3 +96,16 @@ class GPTForCausalLM(nn.Layer):
             return F.cross_entropy(logits.reshape([-1, self.config.vocab_size]),
                                    labels.reshape([-1]), reduction="mean")
         return logits
+
+    def generate(self, input_ids, max_new_tokens=32, max_length=None,
+                 do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+                 eos_token_id=None, seed=None):
+        """KV-cached decoding as one compiled XLA program (see
+        text/generation.py; gpt arch: LayerNorm + learned positions +
+        fused-qkv pre-LN blocks)."""
+        from ..generation import generate as _generate
+
+        return _generate(self, input_ids, max_new_tokens=max_new_tokens,
+                         max_length=max_length, do_sample=do_sample,
+                         temperature=temperature, top_k=top_k, top_p=top_p,
+                         eos_token_id=eos_token_id, seed=seed)
