@@ -300,17 +300,29 @@ _STACK_CACHE: dict = {}
 _STACK_CACHE_MAX = 2  # stacked weights are a full model-size copy; bound it
 
 
-def _stacked_params(model):
-    """Extract + stack per-layer weights [L, ...] for lax.scan. Cached by
-    the identity of the underlying buffers (buffer-swap mutation changes
-    ids, so a training step invalidates the cache)."""
-    cfg = model.config
+def _cached_extract(model, extract_fn):
+    """Stack-cache wrapper: key = identity of every underlying buffer
+    (buffer-swap mutation changes ids, so a training step invalidates)."""
     sd = {k: v for k, v in model.state_dict().items()}
     key = (id(model),) + tuple(sorted(id(v._data) for v in sd.values()))
     hit = _STACK_CACHE.get(id(model))
     if hit is not None and hit[0] == key:
         return hit[1]
+    params = extract_fn(sd)
+    _STACK_CACHE[id(model)] = (key, params)
+    while len(_STACK_CACHE) > _STACK_CACHE_MAX:
+        _STACK_CACHE.pop(next(iter(_STACK_CACHE)))
+    return params
 
+
+def _stacked_params(model):
+    """Extract + stack per-layer weights [L, ...] for lax.scan (cached,
+    see _cached_extract)."""
+    cfg = model.config
+    return _cached_extract(model, lambda sd: _extract_llama(cfg, sd))
+
+
+def _extract_llama(cfg, sd):
     def w(name):
         return sd[name]._data
 
@@ -342,21 +354,16 @@ def _stacked_params(model):
                                else "float32")
     params["rope_cos"] = jnp.asarray(cos, params["embed"].dtype)
     params["rope_sin"] = jnp.asarray(sin, params["embed"].dtype)
-    _STACK_CACHE[id(model)] = (key, params)
-    while len(_STACK_CACHE) > _STACK_CACHE_MAX:
-        _STACK_CACHE.pop(next(iter(_STACK_CACHE)))
     return params
 
 
 def _stacked_params_gpt(model):
     """GPT-family extraction: LN weights/biases, fused qkv, learned wpe."""
     cfg = model.config
-    sd = {k: v for k, v in model.state_dict().items()}
-    key = (id(model),) + tuple(sorted(id(v._data) for v in sd.values()))
-    hit = _STACK_CACHE.get(id(model))
-    if hit is not None and hit[0] == key:
-        return hit[1]
+    return _cached_extract(model, lambda sd: _extract_gpt(cfg, sd))
 
+
+def _extract_gpt(cfg, sd):
     def w(name):
         return sd[name]._data
 
@@ -380,9 +387,6 @@ def _stacked_params_gpt(model):
         "lm_head": w("lm_head.weight"),
         "layers": {k: jnp.stack(v) for k, v in layers.items()},
     }
-    _STACK_CACHE[id(model)] = (key, params)
-    while len(_STACK_CACHE) > _STACK_CACHE_MAX:
-        _STACK_CACHE.pop(next(iter(_STACK_CACHE)))
     return params
 
 
@@ -407,7 +411,16 @@ def generate(model, input_ids, max_new_tokens=32, max_length=None,
         max_new_tokens = int(max_length) - ids.shape[1]
     if max_new_tokens <= 0:
         raise ValueError("max_new_tokens must be positive")
-    arch = "gpt" if type(model).__name__.startswith("GPT") else "llama"
+    total = ids.shape[1] + int(max_new_tokens)
+    if total > int(cfg.max_position_embeddings):
+        # positional tables (wpe / rope) end here; indexing past them would
+        # silently clamp to the last row under jit
+        raise ValueError(
+            f"prompt ({ids.shape[1]}) + max_new_tokens ({max_new_tokens}) "
+            f"= {total} exceeds max_position_embeddings "
+            f"({cfg.max_position_embeddings})")
+    # models declare their engine arch; default is the llama layout
+    arch = getattr(model, "_gen_arch", "llama")
     if arch == "gpt":
         nh = cfg.num_attention_heads
         spec = _GenSpec(

@@ -64,6 +64,7 @@ class GPTBlock(nn.Layer):
 
 
 class GPTForCausalLM(nn.Layer):
+    _gen_arch = "gpt"  # generation-engine layout (text/generation.py)
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.config = config

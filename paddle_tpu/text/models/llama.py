@@ -216,6 +216,7 @@ class LlamaModel(nn.Layer):
 
 
 class LlamaForCausalLM(nn.Layer):
+    _gen_arch = "llama"  # generation-engine layout (text/generation.py)
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config

@@ -97,6 +97,12 @@ class TestGenerate:
             m.generate(paddle.to_tensor(np.array([[1, 2]], "int64")),
                        max_length=1)
 
+    def test_exceeding_position_table_raises(self):
+        m = _tiny()  # max_position_embeddings=64
+        with pytest.raises(ValueError):
+            m.generate(paddle.to_tensor(np.array([[1, 2, 3]], "int64")),
+                       max_new_tokens=62)
+
     def test_cache_invalidated_by_training_step(self):
         """A parameter update must invalidate the stacked-weight cache."""
         m = _tiny()
