@@ -234,7 +234,7 @@ def _llama_step(model, opt, level):
     return train_step
 
 
-def bench_llama_train(iters=6, batch=16, seq=1024, amp=True):
+def bench_llama_train(iters=6, batch=24, seq=1024, amp=True):
     """Config-5 single-chip proxy: 168M-param LLaMA-architecture causal LM
     (honestly named — BENCH_r02's 'llama_1b_proxy' was this exact model).
     bf16 O2 + Pallas flash attention."""
