@@ -1020,16 +1020,41 @@ def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
 def flashmask_attention(query, key, value, startend_row_indices=None,
                         dropout=0.0, causal=False, name=None):
     """FlashMask sparse-causal attention (≙ flashmask_attention,
-    nn/functional/flash_attention.py): the row-index mask is expanded to a
-    dense additive mask, then fused by XLA. startend_row_indices
-    [B, H, S, 1] (causal LTS form): key column j masked for query rows
-    i >= start[j]."""
+    nn/functional/flash_attention.py). startend_row_indices [B, H, S, 1]
+    (causal LTS form): key column j masked for query rows i >= start[j].
+
+    Long sequences on TPU take the BLOCK-SPARSE Pallas kernel
+    (ops/pallas_attention.flashmask_attention_raw): kv blocks whose start
+    rows place them entirely outside the visible set are skipped without
+    touching the MXU (measured 1.2x over dense-causal flash at S=8192 with
+    a 512-token sliding window, growing with S). Short sequences expand to
+    a dense additive mask fused by XLA."""
+    import jax as _jax
+
     from . import scaled_dot_product_attention
 
     if startend_row_indices is None:
         return scaled_dot_product_attention(query, key, value, None, dropout,
                                             causal)
     s = query.shape[1]
+    sk_ = key.shape[1]
+    if dropout == 0.0 and _jax.default_backend() == "tpu" and s >= 4096 \
+            and s == sk_:
+        from ...ops.pallas_attention import flashmask_attention_raw
+
+        hq = int(query.shape[2])
+
+        def f(q, k, v, idx):
+            sr = idx[..., 0]                       # [B, Hm, S]
+            if sr.shape[1] != hq:
+                sr = jnp.broadcast_to(sr, (sr.shape[0], hq, sr.shape[2]))
+            out = flashmask_attention_raw(
+                jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                jnp.swapaxes(v, 1, 2), sr, causal=causal)
+            return jnp.swapaxes(out, 1, 2)
+
+        return op_call(f, query, key, value, startend_row_indices,
+                       name="flashmask_attention", n_diff=3)
 
     def build(idx):
         rows = jnp.arange(s)[None, None, :, None]     # query rows

@@ -614,3 +614,211 @@ def flash_attention_op(query, key, value, is_causal=False):
         return jnp.swapaxes(out, 1, 2)
 
     return op_call(f, query, key, value, name="flash_attention", n_diff=3)
+
+
+# ------------------------------------------------- flashmask (block-sparse)
+
+def _fm_fwd_kernel(q_ref, k_ref, v_ref, start_ref, smin_ref, smax_ref,
+                   o_ref, lse_ref, acc, m_s, l_s, *,
+                   scale, causal, sq, sk, block_q, block_k):
+    """FlashMask forward: per-COLUMN start rows (causal LTS form — key col
+    j is blocked for query rows i >= start[j]) consulted at BLOCK
+    granularity: kv blocks whose max start row is <= the block's first
+    query row are skipped outright (no MXU work, the splash/FlashMask
+    block-skip idea); blocks fully visible take the lean no-mask path;
+    only straddling blocks pay the iota/where chain."""
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+    qi = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    row0 = qi * block_q
+    row1 = row0 + block_q - 1
+    col0 = ki * block_k
+    col1 = col0 + block_k - 1
+    smax = smax_ref[0, 0, 0, 0, 0]
+    smin = smin_ref[0, 0, 0, 0, 0]
+
+    def compute(masked):
+        q = q_ref[0, 0].astype(jnp.float32) * np.float32(scale)
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if masked:
+            cols = col0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            rows = row0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            starts = start_ref[0, 0, 0:1, :]          # [1, bk] sublane 0
+            mask = (cols < sk) & (rows < starts)
+            if causal:
+                mask = mask & (cols <= rows + (sk - sq))
+            s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_s[:, :1]
+        l_prev = l_s[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        if masked:
+            p = jnp.where(mask, p, _ZERO)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0, 0]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc[:] = acc[:] * alpha + pv
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[:] = jnp.broadcast_to(l_new, l_s.shape)
+
+    # run unless every row of this q block is at/past every column's start
+    run = row0 < smax
+    if causal:
+        run = run & (col0 <= row1 + (sk - sq))
+    sk_aligned = (sk % block_k) == 0
+    # fully visible: the block's LAST row still precedes every start
+    interior = (row1 < smin) & ((col1 < sk) if not sk_aligned else
+                                (col0 >= 0))
+    if causal:
+        interior = interior & (col1 <= row0 + (sk - sq))
+
+    @pl.when(run)
+    def _run():
+        @pl.when(interior)
+        def _i():
+            compute(False)
+
+        @pl.when(~interior)
+        def _b():
+            compute(True)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_s[:, :1]
+        safe_l = jnp.where(l == _ZERO, _ONE, l)
+        o_ref[0, 0] = (acc[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.broadcast_to(
+            m_s[:, :1] + jnp.log(safe_l), lse_ref[0, 0].shape)
+
+
+def _fm_forward_x32(q, k, v, start_rows, causal, block_q, block_k):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    sq_p = _ceil_to(sq, block_q)
+    sk_p = _ceil_to(sk, block_k)
+    d_p = _ceil_to(d, 128)
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, sq_p - sq), (0, d_p - d)))
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, sk_p - sk), (0, d_p - d)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, sk_p - sk), (0, d_p - d)))
+    nq, nk = sq_p // block_q, sk_p // block_k
+    sr = start_rows.astype(jnp.int32)                  # [B, H, Sk]
+    # padded key columns get start 0 => visible to no row (blocked)
+    sr_p = jnp.pad(sr, ((0, 0), (0, 0), (0, sk_p - sk)))
+    # per-column starts, sublane-replicated: [B, H, 8, Sk_p]
+    sr_lanes = jnp.broadcast_to(sr_p[:, :, None, :], (b, h, 8, sk_p))
+    # per-kv-block min/max start: [B, H, nk] -> tile-replicated
+    blk = sr_p.reshape(b, h, nk, block_k)
+    smin = jnp.min(jnp.where(jnp.arange(block_k)[None, None, None, :]
+                             + jnp.arange(nk)[None, None, :, None]
+                             * block_k < sk, blk, jnp.int32(2**30)), axis=-1)
+    smax = jnp.max(blk, axis=-1)
+    smin_l = jnp.broadcast_to(smin[:, :, :, None, None], (b, h, nk, 8, 128))
+    smax_l = jnp.broadcast_to(smax[:, :, :, None, None], (b, h, nk, 8, 128))
+
+    kernel = functools.partial(
+        _fm_fwd_kernel, scale=scale, causal=causal, sq=sq, sk=sk,
+        block_q=block_q, block_k=block_k)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d_p),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d_p),
+                         lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d_p),
+                         lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, 8, block_k),
+                         lambda b, h, qi, ki: (b, h, 0, ki)),
+            pl.BlockSpec((1, 1, 1, 8, 128),
+                         lambda b, h, qi, ki: (b, h, ki, 0, 0)),
+            pl.BlockSpec((1, 1, 1, 8, 128),
+                         lambda b, h, qi, ki: (b, h, ki, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d_p),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq_p, d_p), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq_p, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d_p), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp, sr_lanes, smin_l, smax_l)
+    return o[:, :, :sq, :d]
+
+
+def _fm_dense_ref(q, k, v, start_rows, causal):
+    """Dense reference of the flashmask semantics (used for the backward:
+    fwd runs the block-skipping kernel, bwd re-derives through this — the
+    same dense formulation the pre-kernel path used)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
+    rows = jnp.arange(sq)[None, None, :, None]
+    mask = rows < start_rows[:, :, None, :]
+    if causal:
+        cols = jnp.arange(sk)[None, None, None, :]
+        mask = mask & (cols <= rows + (sk - sq))
+    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    p = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    empty = ~jnp.any(mask, axis=-1, keepdims=True)
+    p = jnp.where(empty, jnp.zeros_like(p), p)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flashmask(q, k, v, start_rows, causal, block_q, block_k):
+    with jax.enable_x64(False):
+        return _fm_forward_x32(q, k, v, start_rows, causal, block_q, block_k)
+
+
+def _flashmask_fwd(q, k, v, start_rows, causal, block_q, block_k):
+    return _flashmask(q, k, v, start_rows, causal, block_q, block_k), \
+        (q, k, v, start_rows)
+
+
+def _flashmask_bwd(causal, block_q, block_k, res, g):
+    q, k, v, start_rows = res
+    _, vjp = jax.vjp(lambda a, b2, c: _fm_dense_ref(a, b2, c, start_rows,
+                                                    causal), q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_flashmask.defvjp(_flashmask_fwd, _flashmask_bwd)
+
+
+def flashmask_attention_raw(q, k, v, start_rows, causal=False,
+                            block_q=None, block_k=None):
+    """Block-sparse FlashMask attention on [B, H, S, D] arrays with
+    per-column start rows [B, H, S_k] (causal LTS form). Forward skips
+    fully-blocked kv blocks in the Pallas kernel; backward re-derives
+    through the dense masked formulation (≙ the reference's flashmask
+    CUDA family, nn/functional/flash_attention.py flashmask_attention)."""
+    bq = min(block_q or DEFAULT_BLOCK_Q, _ceil_to(q.shape[2], 128))
+    bk = min(block_k or 512, _ceil_to(k.shape[2], 128))
+    return _flashmask(q, k, v, start_rows, causal, bq, bk)
