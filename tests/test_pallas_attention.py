@@ -357,3 +357,144 @@ class TestFlashMaskKernel:
                                          block_q=128, block_k=128)
         want = pa._fm_dense_ref(q, k, v, start, True)
         assert float(jnp.max(jnp.abs(out - want))) < 2e-5
+
+    def test_bwd_no_dense_path(self, monkeypatch):
+        """VERDICT r4 Missing #1: the backward must run the block-skipping
+        Pallas kernels, never the dense O(S^2) reference."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import pallas_attention as pa
+
+        q, k, v, start = self._setup(seed=4)
+
+        def boom(*a, **kw):
+            raise AssertionError("dense flashmask reference reached from "
+                                 "the backward path")
+
+        monkeypatch.setattr(pa, "_fm_dense_ref", boom)
+        g = jax.grad(lambda qq: jnp.sum(pa.flashmask_attention_raw(
+            qq, k, v, start, causal=True, block_q=128, block_k=128) ** 2))(q)
+        assert bool(jnp.all(jnp.isfinite(g)))
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_fully_blocked_columns(self, causal):
+        """start=0 columns are invisible to every row: dk/dv there must be
+        exactly zero and dq must still match the dense formulation."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import pallas_attention as pa
+
+        q, k, v, start = self._setup(seed=5)
+        start = start.at[:, :, :128].set(0)  # first kv block fully blocked
+
+        def lk(qq, kk, vv):
+            return jnp.sum(pa.flashmask_attention_raw(
+                qq, kk, vv, start, causal=causal,
+                block_q=128, block_k=128) ** 2)
+
+        def ld(qq, kk, vv):
+            return jnp.sum(pa._fm_dense_ref(qq, kk, vv, start, causal) ** 2)
+
+        gk = jax.grad(lk, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(ld, argnums=(0, 1, 2))(q, k, v)
+        assert float(jnp.max(jnp.abs(gk[1][:, :, :128]))) == 0.0
+        assert float(jnp.max(jnp.abs(gk[2][:, :, :128]))) == 0.0
+        for a, b in zip(gk, gd):
+            assert float(jnp.max(jnp.abs(a - b))) < 5e-4
+
+    def test_grads_sliding_window(self):
+        """Sliding-window starts (the pattern the block-skip is built for):
+        fwd+bwd parity against dense at a window that blocks most blocks."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import pallas_attention as pa
+
+        q, k, v, _ = self._setup(seed=6)
+        s = q.shape[2]
+        W = 64
+        start = jnp.broadcast_to(
+            jnp.asarray((np.arange(s) + W).clip(0, s).astype("int32"))
+            [None, None, :], (q.shape[0], q.shape[1], s))
+
+        def lk(qq, kk, vv):
+            return jnp.sum(pa.flashmask_attention_raw(
+                qq, kk, vv, start, causal=True,
+                block_q=128, block_k=128) ** 2)
+
+        def ld(qq, kk, vv):
+            return jnp.sum(pa._fm_dense_ref(qq, kk, vv, start, True) ** 2)
+
+        gk = jax.grad(lk, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(ld, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gk, gd):
+            assert float(jnp.max(jnp.abs(a - b))) < 5e-4
+
+    def test_grads_noncausal(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import pallas_attention as pa
+
+        q, k, v, start = self._setup(seed=7)
+
+        def lk(qq, kk, vv):
+            return jnp.sum(pa.flashmask_attention_raw(
+                qq, kk, vv, start, causal=False,
+                block_q=128, block_k=128) ** 2)
+
+        def ld(qq, kk, vv):
+            return jnp.sum(pa._fm_dense_ref(qq, kk, vv, start, False) ** 2)
+
+        gk = jax.grad(lk, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(ld, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gk, gd):
+            assert float(jnp.max(jnp.abs(a - b))) < 5e-4
+
+
+class TestTuneCachePersistence:
+    """VERDICT r4 Weak #6: the flash block-autotune cache must survive
+    process restarts (disk cache next to the XLA compile cache) and a
+    second process must not re-probe."""
+
+    def test_disk_roundtrip_and_no_reprobe(self, tmp_path, monkeypatch):
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import pallas_attention as pa
+
+        path = str(tmp_path / "flash_tune_cache.json")
+        monkeypatch.setattr(pa, "_tune_cache_path", lambda: path)
+        key = (1024, 1024, 64, "float32", True)
+        monkeypatch.setattr(pa, "_TUNE_CACHE", {key: (256, 512)})
+        pa._tune_cache_store()
+
+        # "fresh process": empty in-memory cache, disk not yet loaded
+        monkeypatch.setattr(pa, "_TUNE_CACHE", {})
+        monkeypatch.setattr(pa, "_TUNE_DISK_LOADED", False)
+        # off-interpret so _autotune_blocks takes the real tuning path; if
+        # it re-probed, every candidate would fail on CPU (interpret=False)
+        # and it would fall back to the DEFAULT blocks, not (256, 512)
+        monkeypatch.setattr(pa, "_interpret", lambda: False)
+        q = jnp.zeros((1, 1, 1024, 64), jnp.float32)
+        got = pa._autotune_blocks(q, q, q, True)
+        assert got == (256, 512)
+
+    @pytest.mark.parametrize("payload", [
+        "{not json",                                  # invalid JSON
+        '"[1, 2]"',                                   # top-level non-dict
+        '{"1024|1024|64|float32|True": 9}',           # non-list value
+        '{"bad key": [1, 2]}',                        # malformed key
+    ])
+    def test_corrupt_cache_ignored(self, tmp_path, monkeypatch, payload):
+        from paddle_tpu.ops import pallas_attention as pa
+
+        path = str(tmp_path / "flash_tune_cache.json")
+        with open(path, "w") as f:
+            f.write(payload)
+        monkeypatch.setattr(pa, "_tune_cache_path", lambda: path)
+        monkeypatch.setattr(pa, "_TUNE_CACHE", {})
+        monkeypatch.setattr(pa, "_TUNE_DISK_LOADED", False)
+        pa._tune_cache_load()  # must not raise
+        assert pa._TUNE_CACHE == {}
