@@ -25,7 +25,7 @@ from .dispatch import current_trace, no_grad
 
 class Tensor:
     __slots__ = (
-        "_data",
+        "_data_buf",
         "stop_gradient",
         "_grad",
         "_node",
@@ -35,11 +35,30 @@ class Tensor:
         "_retain_grads",
         "_hooks",
         "_dist_attr",
+        "_buf_version",
         "__weakref__",
         "__dict__",
     )
 
     _iid = 0
+    # globally-monotonic buffer-state counter: every construction AND every
+    # buffer swap draws a fresh value, so no two buffer states ever share a
+    # version — unlike id(), which CPython reuses after free (caches keying
+    # on id() alone could silently serve stale weights). The bump lives in
+    # the `_data` property setter so EVERY buffer swap in the codebase
+    # (to_static _finish, checkpoint load, optimizer lr writes, ...) bumps
+    # it — not just the _assign_raw funnel.
+    _next_buf_version = 0
+
+    @property
+    def _data(self):
+        return self._data_buf
+
+    @_data.setter
+    def _data(self, value):
+        self._data_buf = value
+        Tensor._next_buf_version += 1
+        self._buf_version = Tensor._next_buf_version
 
     def __init__(self, data, dtype=None, place=None, stop_gradient=True, _internal=False):
         if _internal:

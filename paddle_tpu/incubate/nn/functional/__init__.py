@@ -494,12 +494,21 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1,
         raise ValueError(f"weight_quantize: unknown algo {algo!r}")
     qmax = 7.0 if algo == "weight_only_int4" else 127.0
 
-    def f(w):
-        scale = jnp.max(jnp.abs(w), axis=0) / qmax
-        q = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-9)), -qmax, qmax)
-        return q.astype(jnp.int8), scale.astype(jnp.float32)
+    return op_call(lambda w: weight_quantize_raw(w, qmax), x,
+                   name="weight_quantize", n_diff=0)
 
-    return op_call(f, x, name="weight_quantize", n_diff=0)
+
+def weight_quantize_raw(w, qmax=127.0):
+    """Raw-jnp per-output-channel absmax int8 quantizer for a [K, N]
+    weight: (q int8, scale f32 [N]). The SINGLE quantization rule shared by
+    the public weight_quantize op and the generation engine's weight-only
+    serving path (text/generation.py) — one rule, no numeric drift."""
+    import jax.numpy as jnp
+
+    wf = w.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(wf), axis=0) / qmax
+    q = jnp.clip(jnp.round(wf / jnp.maximum(scale, 1e-9)), -qmax, qmax)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
 
 
 def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16",

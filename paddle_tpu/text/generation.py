@@ -48,6 +48,11 @@ class _GenSpec:
     eos_token_id: int
     tie_embeddings: bool
     arch: str = "llama"  # "llama" (RMSNorm+RoPE+SwiGLU) | "gpt" (LN+wpe+GELU)
+    # "none" | "int8": weight-only per-output-channel int8 on the layer
+    # matmuls + lm_head (≙ weight_only_linear's serving role) — decode is
+    # HBM-bandwidth-bound, so halving weight bytes is the win; activations
+    # stay bf16 and XLA fuses the int8->bf16 convert into the matmul tiles
+    weight_quant: str = "none"
 
 
 def _rms_norm(x, w, eps):
@@ -74,6 +79,25 @@ def _rope_tables_np(max_len, head_dim, theta, dtype):
 
 def _repeat_kv(x, rep, axis):
     return x if rep == 1 else jnp.repeat(x, rep, axis=axis)
+
+
+def _mm(x, w):
+    """x @ w where w is either a dense array or a weight-only-int8 pair
+    (w8 int8 [K,N], scale f32 [N]); per-output-channel scale commutes with
+    the contraction: x @ (w8*ws) == (x @ w8) * ws."""
+    if isinstance(w, tuple):
+        w8, ws = w
+        return (x @ w8.astype(x.dtype)) * ws.astype(x.dtype)
+    return x @ w
+
+
+def _quantize_w(w):
+    """Per-output-channel symmetric int8 for a [K, N] weight — delegates to
+    the public weight_quantize rule so serving and the quant API can never
+    drift numerically."""
+    from ..incubate.nn.functional import weight_quantize_raw
+
+    return weight_quantize_raw(w)
 
 
 def _sample_token(logits, key, spec: _GenSpec):
@@ -104,9 +128,9 @@ def _layer_forward_prefill(x, lw, spec: _GenSpec, cos, sin):
     b, s, h = x.shape
     hn = _rms_norm(x, lw["input_ln"], spec.rms_eps)
     flat = hn.reshape(b * s, h)
-    q = (flat @ lw["q"]).reshape(b, s, spec.num_heads, spec.head_dim)
-    k = (flat @ lw["k"]).reshape(b, s, spec.num_kv_heads, spec.head_dim)
-    v = (flat @ lw["v"]).reshape(b, s, spec.num_kv_heads, spec.head_dim)
+    q = _mm(flat, lw["q"]).reshape(b, s, spec.num_heads, spec.head_dim)
+    k = _mm(flat, lw["k"]).reshape(b, s, spec.num_kv_heads, spec.head_dim)
+    v = _mm(flat, lw["v"]).reshape(b, s, spec.num_kv_heads, spec.head_dim)
     c = cos[None, :s, None, :]
     sn = sin[None, :s, None, :]
     q = _rope(q, c, sn)
@@ -128,10 +152,11 @@ def _layer_forward_prefill(x, lw, spec: _GenSpec, cos, sin):
         probs = jax.nn.softmax(scores.astype(jnp.float32),
                                axis=-1).astype(q.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
-    attn = out.reshape(b * s, spec.num_heads * spec.head_dim) @ lw["o"]
+    attn = _mm(out.reshape(b * s, spec.num_heads * spec.head_dim), lw["o"])
     x = x + attn.reshape(b, s, h)
     hn = _rms_norm(x, lw["post_ln"], spec.rms_eps).reshape(b * s, h)
-    mlp = (jax.nn.silu(hn @ lw["gate"]) * (hn @ lw["up"])) @ lw["down"]
+    mlp = _mm(jax.nn.silu(_mm(hn, lw["gate"])) * _mm(hn, lw["up"]),
+              lw["down"])
     return x + mlp.reshape(b, s, h), (k, v)
 
 
@@ -140,9 +165,9 @@ def _layer_forward_decode(x, lw, kc, vc, pos, spec: _GenSpec, cos, sin):
     x [B, H]; kc/vc [B, T, H_kv, D]; pos scalar (current write index)."""
     b, h = x.shape
     hn = _rms_norm(x, lw["input_ln"], spec.rms_eps)
-    q = (hn @ lw["q"]).reshape(b, spec.num_heads, spec.head_dim)
-    k = (hn @ lw["k"]).reshape(b, spec.num_kv_heads, spec.head_dim)
-    v = (hn @ lw["v"]).reshape(b, spec.num_kv_heads, spec.head_dim)
+    q = _mm(hn, lw["q"]).reshape(b, spec.num_heads, spec.head_dim)
+    k = _mm(hn, lw["k"]).reshape(b, spec.num_kv_heads, spec.head_dim)
+    v = _mm(hn, lw["v"]).reshape(b, spec.num_kv_heads, spec.head_dim)
     c = jax.lax.dynamic_slice(cos, (pos, jnp.int32(0)), (1, spec.head_dim))
     sn = jax.lax.dynamic_slice(sin, (pos, jnp.int32(0)), (1, spec.head_dim))
     q = _rope(q, c[None], sn[None])
@@ -159,10 +184,11 @@ def _layer_forward_decode(x, lw, kc, vc, pos, spec: _GenSpec, cos, sin):
                        jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     out = jnp.einsum("bht,bthd->bhd", probs, vr)
-    attn = out.reshape(b, spec.num_heads * spec.head_dim) @ lw["o"]
+    attn = _mm(out.reshape(b, spec.num_heads * spec.head_dim), lw["o"])
     x = x + attn
     hn = _rms_norm(x, lw["post_ln"], spec.rms_eps)
-    mlp = (jax.nn.silu(hn @ lw["gate"]) * (hn @ lw["up"])) @ lw["down"]
+    mlp = _mm(jax.nn.silu(_mm(hn, lw["gate"])) * _mm(hn, lw["up"]),
+              lw["down"])
     return x + mlp, kc, vc
 
 
@@ -231,13 +257,26 @@ def _logits(x, params, spec: _GenSpec):
                         spec.rms_eps)
     else:
         x = _rms_norm(x, params["final_ln"], spec.rms_eps)
-    head = params["embed"].T if spec.tie_embeddings else params["lm_head"]
-    return (x.astype(jnp.float32) @ head.astype(jnp.float32))
+    if spec.tie_embeddings:
+        return x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    head = params["lm_head"]
+    if isinstance(head, tuple):
+        w8, ws = head
+        return (x.astype(jnp.float32) @ w8.astype(jnp.float32)) \
+            * ws.astype(jnp.float32)
+    return x.astype(jnp.float32) @ head.astype(jnp.float32)
 
 
 @functools.partial(jax.jit, static_argnums=(2,), donate_argnums=())
-def _generate_program(params, ids, spec: _GenSpec, rng_key):
-    """The fused prefill+decode program. ids [B, S] int32.
+def _generate_program(params, ids, spec: _GenSpec, rng_key, true_len):
+    """The fused prefill+decode program. ids [B, S_bucket] int32, right-
+    padded to the prompt bucket; `true_len` (traced scalar) is the real
+    prompt length, so the program is keyed by (bucket, B, spec) — a serving
+    stream compiles O(log S) programs, not one per distinct prompt length.
+    Padded prefill positions produce garbage K/V at cache slots
+    [true_len, S_bucket); decode writes start at true_len and the
+    `arange <= pos` mask never reaches an unwritten slot, so the garbage is
+    progressively overwritten and never attended to.
     Returns tokens [B, max_new_tokens] int32."""
     b, s = ids.shape
     total = s + spec.max_new_tokens
@@ -261,7 +300,9 @@ def _generate_program(params, ids, spec: _GenSpec, rng_key):
     kcache = jnp.pad(ks, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
     vcache = jnp.pad(vs, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
 
-    logits0 = _logits(x[:, -1], params, spec)
+    # the last REAL prompt position, not the last padded one
+    x_last = jax.lax.dynamic_slice_in_dim(x, true_len - 1, 1, axis=1)[:, 0]
+    logits0 = _logits(x_last, params, spec)
     key0, sub = jax.random.split(rng_key)
     tok0 = _sample_token(logits0, sub, spec)
     finished0 = tok0 == spec.eos_token_id
@@ -290,39 +331,51 @@ def _generate_program(params, ids, spec: _GenSpec, rng_key):
         finished = finished | (nxt == spec.eos_token_id)
         return (nxt, kc, vc, pos + 1, key, finished), tok
 
-    (_, _, _, _, _, _), toks = jax.lax.scan(
-        step, (tok0, kcache, vcache, jnp.int32(s), key0, finished0),
-        None, length=spec.max_new_tokens)
-    return jnp.swapaxes(toks, 0, 1)                   # [B, new]
+    # scan max_new_tokens-1 steps and append the final carried token: the
+    # last sampled token needs no forward pass of its own (a full-length
+    # scan would run one dead per-layer forward whose sample is discarded)
+    (last_tok, _, _, _, _, _), toks = jax.lax.scan(
+        step, (tok0, kcache, vcache, true_len.astype(jnp.int32), key0,
+               finished0),
+        None, length=spec.max_new_tokens - 1)
+    toks = jnp.swapaxes(toks, 0, 1)                   # [B, new-1]
+    return jnp.concatenate([toks, last_tok[:, None]], axis=1)
 
 
 _STACK_CACHE: dict = {}
 _STACK_CACHE_MAX = 2  # stacked weights are a full model-size copy; bound it
 
 
-def _cached_extract(model, extract_fn):
-    """Stack-cache wrapper: key = identity of every underlying buffer
-    (buffer-swap mutation changes ids, so a training step invalidates)."""
+def _cached_extract(model, extract_fn, tag=""):
+    """Stack-cache wrapper: key = per-buffer monotonic version
+    (Tensor._buf_version — bumped by every construction and every
+    buffer-swap mutation, never reused). id() is deliberately NOT part of
+    the key: CPython reuses freed addresses, so a training step followed by
+    allocation could produce the same id set and silently serve stale
+    stacked weights."""
     sd = {k: v for k, v in model.state_dict().items()}
-    key = (id(model),) + tuple(sorted(id(v._data) for v in sd.values()))
-    hit = _STACK_CACHE.get(id(model))
+    key = (tag,) + tuple((k, sd[k]._buf_version) for k in sorted(sd))
+    hit = _STACK_CACHE.get((id(model), tag))
     if hit is not None and hit[0] == key:
         return hit[1]
     params = extract_fn(sd)
-    _STACK_CACHE[id(model)] = (key, params)
+    _STACK_CACHE[(id(model), tag)] = (key, params)
     while len(_STACK_CACHE) > _STACK_CACHE_MAX:
         _STACK_CACHE.pop(next(iter(_STACK_CACHE)))
     return params
 
 
-def _stacked_params(model):
+def _stacked_params(model, weight_quant="none"):
     """Extract + stack per-layer weights [L, ...] for lax.scan (cached,
-    see _cached_extract)."""
+    see _cached_extract). weight_quant="int8" stores the seven layer
+    matmul weights and lm_head as weight-only int8 pairs (see _mm)."""
     cfg = model.config
-    return _cached_extract(model, lambda sd: _extract_llama(cfg, sd))
+    return _cached_extract(
+        model, lambda sd: _extract_llama(cfg, sd, weight_quant),
+        tag=weight_quant)
 
 
-def _extract_llama(cfg, sd):
+def _extract_llama(cfg, sd, weight_quant="none"):
     def w(name):
         return sd[name]._data
 
@@ -340,13 +393,23 @@ def _extract_llama(cfg, sd):
         layers["down"].append(w(base + "mlp.down_proj.weight"))
         layers["input_ln"].append(w(base + "input_layernorm.weight"))
         layers["post_ln"].append(w(base + "post_attention_layernorm.weight"))
+    quant = weight_quant == "int8"
+
+    def stack(k, vals):
+        stacked = jnp.stack(vals)
+        if quant and k not in ("input_ln", "post_ln"):
+            # vmap the per-channel quantizer over the layer axis
+            return jax.vmap(_quantize_w)(stacked)
+        return stacked
+
     params = {
         "embed": w(prefix + "embed_tokens.weight"),
         "final_ln": w(prefix + "norm.weight"),
-        "layers": {k: jnp.stack(v) for k, v in layers.items()},
+        "layers": {k: stack(k, v) for k, v in layers.items()},
     }
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = w("lm_head.weight")
+        head = w("lm_head.weight")
+        params["lm_head"] = _quantize_w(head) if quant else head
     cos, sin = _rope_tables_np(cfg.max_position_embeddings, cfg.head_dim,
                                cfg.rope_theta,
                                np.dtype(params["embed"].dtype).name
@@ -392,7 +455,7 @@ def _extract_gpt(cfg, sd):
 
 def generate(model, input_ids, max_new_tokens=32, max_length=None,
              do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-             eos_token_id=None, seed=None):
+             eos_token_id=None, seed=None, weight_quant="none"):
     """Autoregressive generation with a static KV cache, greedy or sampled.
 
     Returns a Tensor [B, prompt_len + n_generated] (prompt included, like
@@ -421,6 +484,12 @@ def generate(model, input_ids, max_new_tokens=32, max_length=None,
             f"({cfg.max_position_embeddings})")
     # models declare their engine arch; default is the llama layout
     arch = getattr(model, "_gen_arch", "llama")
+    if weight_quant not in ("none", "int8"):
+        raise ValueError(f"weight_quant must be 'none' or 'int8', got "
+                         f"{weight_quant!r}")
+    if arch == "gpt" and weight_quant != "none":
+        raise NotImplementedError(
+            "weight-only int8 generation is wired for the llama arch only")
     if arch == "gpt":
         nh = cfg.num_attention_heads
         spec = _GenSpec(
@@ -445,15 +514,28 @@ def generate(model, input_ids, max_new_tokens=32, max_length=None,
             temperature=float(temperature),
             eos_token_id=int(eos_token_id if eos_token_id is not None
                              else -1),
-            tie_embeddings=bool(cfg.tie_word_embeddings))
-        params = _stacked_params(model)
+            tie_embeddings=bool(cfg.tie_word_embeddings),
+            weight_quant=str(weight_quant))
+        params = _stacked_params(model, weight_quant=str(weight_quant))
     if seed is not None:
         key = jax.random.PRNGKey(int(seed))
     else:
         from ..core.rng import next_key
 
         key = next_key()
-    toks = _generate_program(params, jnp.asarray(ids), spec, key)
+    # pad the prompt up to its bucket so the compiled program is keyed by
+    # (bucket, B, spec): O(log S) compilations per serving stream. The
+    # bucket is clamped so the padded total still fits the position tables.
+    from ..jit.api import default_buckets
+
+    s_true = ids.shape[1]
+    bucket = min(default_buckets(s_true),
+                 int(cfg.max_position_embeddings) - int(max_new_tokens))
+    bucket = max(bucket, s_true)
+    ids_padded = np.pad(ids, ((0, 0), (0, bucket - s_true))) \
+        if bucket > s_true else ids
+    toks = _generate_program(params, jnp.asarray(ids_padded), spec, key,
+                             jnp.int32(s_true))
     toks = np.asarray(jax.device_get(toks))
     if eos_token_id is not None:
         # trim columns past the point where every row finished

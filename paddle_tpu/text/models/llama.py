@@ -264,7 +264,7 @@ class LlamaForCausalLM(nn.Layer):
 
     def generate(self, input_ids, max_new_tokens=32, max_length=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                 eos_token_id=None, seed=None):
+                 eos_token_id=None, seed=None, weight_quant="none"):
         """KV-cached autoregressive decoding as ONE compiled XLA program
         (prefill + lax.scan decode loop) — the role of the reference's
         masked_multihead_attention decode kernel + PaddleNLP generate
@@ -275,7 +275,8 @@ class LlamaForCausalLM(nn.Layer):
         return _generate(self, input_ids, max_new_tokens=max_new_tokens,
                          max_length=max_length, do_sample=do_sample,
                          temperature=temperature, top_k=top_k, top_p=top_p,
-                         eos_token_id=eos_token_id, seed=seed)
+                         eos_token_id=eos_token_id, seed=seed,
+                         weight_quant=weight_quant)
 
 
 class _PipeEmbed(nn.Layer):

@@ -205,3 +205,92 @@ class TestGPTGenerate:
         s1 = np.asarray(m.generate(paddle.to_tensor(prompt), **kw)._data)
         s2 = np.asarray(m.generate(paddle.to_tensor(prompt), **kw)._data)
         np.testing.assert_array_equal(s1, s2)
+
+
+class TestWeightOnlyInt8Generate:
+    """Weight-only int8 generation (VERDICT r4 #3: 'make int8 win where it
+    can' — decode GEMVs are weight-bandwidth-bound)."""
+
+    def test_int8_close_to_fp(self):
+        m = _tiny()
+        prompt = np.random.RandomState(9).randint(0, 128,
+                                                  (2, 6)).astype("int64")
+        fp = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                   max_new_tokens=6, seed=0)._data)
+        i8 = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                   max_new_tokens=6, seed=0,
+                                   weight_quant="int8")._data)
+        assert fp.shape == i8.shape
+        # per-channel int8 on a tiny random model: most tokens agree
+        assert (fp == i8).mean() > 0.7, (fp, i8)
+
+    def test_int8_cache_separate_from_fp(self):
+        from paddle_tpu.text import generation as g
+
+        m = _tiny()
+        prompt = np.random.RandomState(10).randint(0, 128,
+                                                   (1, 4)).astype("int64")
+        m.generate(paddle.to_tensor(prompt), max_new_tokens=2)
+        m.generate(paddle.to_tensor(prompt), max_new_tokens=2,
+                   weight_quant="int8")
+        tags = {k[1] for k in g._STACK_CACHE if isinstance(k, tuple)}
+        assert {"none", "int8"} <= tags or len(g._STACK_CACHE) >= 2
+
+    def test_bad_quant_mode_raises(self):
+        m = _tiny()
+        prompt = np.zeros((1, 4), dtype="int64")
+        with pytest.raises(ValueError):
+            m.generate(paddle.to_tensor(prompt), max_new_tokens=2,
+                       weight_quant="int4")
+
+
+class TestBufVersionCache:
+    """ADVICE r4 (medium): the stacked-weight cache keys on monotonic
+    buffer versions, never on id() — CPython reuses freed addresses."""
+
+    def test_version_bumps_on_mutation(self):
+        t = paddle.to_tensor(np.zeros(3, dtype="float32"))
+        v0 = t._buf_version
+        t.set_value(np.ones(3, dtype="float32"))
+        assert t._buf_version > v0
+        t2 = paddle.to_tensor(np.zeros(3, dtype="float32"))
+        assert t2._buf_version > t._buf_version  # globally monotonic
+
+    def test_prompt_bucketing_compile_count(self):
+        """ADVICE r4: distinct prompt lengths within one bucket must share
+        one compiled program (docstring contract: O(log S) compiles)."""
+        from paddle_tpu.text.generation import _generate_program
+
+        m = _tiny()
+        rs = np.random.RandomState(11)
+        misses0 = _generate_program._cache_size()
+        for ln in (9, 10, 12, 14):  # all bucket to 16
+            p = rs.randint(0, 128, (1, ln)).astype("int64")
+            out = m.generate(paddle.to_tensor(p), max_new_tokens=2)
+            assert out.shape[1] == ln + 2
+        assert _generate_program._cache_size() - misses0 <= 1
+
+    def test_cache_invalidated_by_to_static_step(self):
+        """Code-review r5: to_static's _finish swaps buffers via direct
+        `t._data = v` (not _assign_raw); the version counter must bump
+        there too, or generate() serves stale weights after a COMPILED
+        train step."""
+        m = _tiny()
+        prompt = np.random.RandomState(12).randint(0, 128,
+                                                   (1, 4)).astype("int64")
+        m.generate(paddle.to_tensor(prompt), max_new_tokens=3)
+        opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=m.parameters())
+
+        @paddle.jit.to_static
+        def step(ids):
+            loss = m(ids, ids)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        step(paddle.to_tensor(prompt))
+        out = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                    max_new_tokens=3)._data)
+        np.testing.assert_array_equal(out, _naive_greedy(m, prompt, 3))
