@@ -192,10 +192,17 @@ def _needs_complex_bridge(avals, datas, diff_idx):
     return False
 
 
-def _is_tensor(x) -> bool:
-    from .tensor import Tensor
+_TENSOR_CLS = None
 
-    return isinstance(x, Tensor)
+
+def _is_tensor(x) -> bool:
+    # the Tensor class is bound lazily ONCE: an in-function import costs a
+    # sys.modules lookup per call, and this predicate runs for every operand
+    # of every eager op (the SURVEY §7-1 hot loop)
+    global _TENSOR_CLS
+    if _TENSOR_CLS is None:
+        from .tensor import Tensor as _TENSOR_CLS  # noqa: F811
+    return isinstance(x, _TENSOR_CLS)
 
 
 # ------------------------------------------------- eager executable cache
@@ -304,29 +311,50 @@ def _apply_vjp(vjp_fn, cot):
 def _build_entry(fn, datas, diff_idx, dyn_pos):
     """Compile-once closure over the static operands (they're in the key)."""
     raw = [None if i in dyn_pos else d for i, d in enumerate(datas)]
-    if not diff_idx:
-        def call(*dyn):
-            vals = list(raw)
-            for p, v in zip(dyn_pos, dyn):
-                vals[p] = v
-            return fn(*vals)
 
-        return ("nograd", jax.jit(call))
-
-    def fwd(*dyn):
+    def _vals(dyn):
         vals = list(raw)
         for p, v in zip(dyn_pos, dyn):
             vals[p] = v
+        return vals
 
+    if not diff_idx:
+        def call(*dyn):
+            return fn(*_vals(dyn))
+
+        return ("nograd", jax.jit(call))
+
+    def _primal_over(vals):
         def primal(*ds):
             vs = list(vals)
             for i, dv in zip(diff_idx, ds):
                 vs[i] = dv
             return fn(*vs)
 
-        return jax.vjp(primal, *[vals[i] for i in diff_idx])
+        return primal
 
-    return ("grad", jax.jit(fwd))
+    def fwd(*dyn):
+        vals = _vals(dyn)
+        return jax.vjp(_primal_over(vals), *[vals[i] for i in diff_idx])
+
+    # deferred-vjp pair (FLAGS_eager_defer_vjp, default on): forward runs
+    # the lean fwd-only executable — a jit call returning a vjp closure
+    # costs ~2x a plain call in pytree packaging (measured on host CPU:
+    # 103 vs 55 us) and eager dispatch overhead is the metric here.
+    # Backward re-derives the vjp INSIDE one jitted call (fwd recompute +
+    # cotangent application fused by XLA). Trade: ~1 extra forward of this
+    # op's FLOPs in backward — negligible for the dispatch-bound regime
+    # eager mode serves; compute-bound training runs under to_static where
+    # none of this path exists.
+    def fwd_only(*dyn):
+        return fn(*_vals(dyn))
+
+    def bwd(dyn, cot):
+        vals = _vals(dyn)
+        _, vjp = jax.vjp(_primal_over(vals), *[vals[i] for i in diff_idx])
+        return vjp(cot)
+
+    return ("grad", jax.jit(fwd), jax.jit(fwd_only), jax.jit(bwd))
 
 
 def _cached_dispatch(fn, fn_id, name, datas, diff_idx, target):
@@ -357,11 +385,23 @@ def _cached_dispatch(fn, fn_id, name, datas, diff_idx, target):
         _eager_cache[key] = entry
     else:
         _eager_hits += 1
-    kind, jitted = entry
+    kind, jitted, *defer = entry
     dyn = [datas[p] for p in dyn_pos]
     try:
         if kind == "nograd":
             return jitted(*dyn), None
+        if defer and flag("FLAGS_eager_defer_vjp"):
+            fwd_only, bwd = defer
+            out = fwd_only(*dyn)
+            dyn_t = tuple(dyn)
+
+            def deferred(cot, _b=bwd, _d=dyn_t):
+                if _has_float0(cot):  # float0 can't cross a jit boundary
+                    with jax.disable_jit():
+                        return _b(_d, cot)
+                return _b(_d, cot)
+
+            return out, deferred
         out, vjp_fn = jitted(*dyn)
         return out, (lambda cot, _v=vjp_fn: _apply_vjp(_v, cot))
     except GRAPH_BREAK_ERRORS:
@@ -475,8 +515,6 @@ def op_call(fn: Callable, *args, name: str | None = None, n_diff: int | None = N
 
 
 def _op_call_impl(fn: Callable, *args, name: str | None = None, n_diff: int | None = None):
-    from .tensor import Tensor
-
     name = name or getattr(fn, "__name__", "op")
     trace = current_trace()
 
@@ -591,7 +629,10 @@ def _op_call_impl(fn: Callable, *args, name: str | None = None, n_diff: int | No
 
 
 def _wrap_outputs(out, node, name):
-    from .tensor import Tensor
+    global _TENSOR_CLS
+    if _TENSOR_CLS is None:
+        from .tensor import Tensor as _TENSOR_CLS  # noqa: F811
+    Tensor = _TENSOR_CLS
 
     if flag("FLAGS_benchmark"):
         # benchmark mode: per-op completion barrier (≙ reference benchmark

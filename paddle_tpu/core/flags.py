@@ -63,6 +63,11 @@ def flag(name: str):
 define_flag("FLAGS_check_nan_inf", False, "scan every op output for nan/inf")
 define_flag("FLAGS_use_compiled_eager", True, "jit-compile per-op eager dispatch")
 define_flag("FLAGS_eager_cache_size", 4096, "per-op executable cache entries")
+define_flag("FLAGS_eager_defer_vjp", True,
+            "eager grad ops run a lean fwd-only executable; the vjp is "
+            "re-derived inside one jitted backward call (trades ~1 extra "
+            "fwd of the op's FLOPs in backward for ~2x cheaper per-op "
+            "dispatch — see core/dispatch._build_entry)")
 define_flag("FLAGS_to_static_donate", True, "donate captured buffers in to_static")
 define_flag("FLAGS_to_static_segmented", True,
             "on graph break, run segmented lazy execution (compiled XLA "
