@@ -1020,8 +1020,12 @@ def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
 def flashmask_attention(query, key, value, startend_row_indices=None,
                         dropout=0.0, causal=False, name=None):
     """FlashMask sparse-causal attention (≙ flashmask_attention,
-    nn/functional/flash_attention.py). startend_row_indices [B, H, S, 1]
-    (causal LTS form): key column j masked for query rows i >= start[j].
+    nn/functional/flash_attention.py). startend_row_indices
+    [B, H, S, {1,2,4}]: causal accepts [LTS] (key column j masked for query
+    rows i >= start[j]) and [LTS, LTE]; non-causal accepts [LTS, UTE] and
+    [LTS, LTE, UTS, UTE]. The single-column causal form rides the
+    block-sparse Pallas kernel (fwd + bwd); the start+end forms lower to a
+    dense additive mask fused by XLA.
 
     Long sequences on TPU take the BLOCK-SPARSE Pallas kernel
     (ops/pallas_attention.flashmask_attention_raw): kv blocks whose start
@@ -1038,8 +1042,18 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
                                             causal)
     s = query.shape[1]
     sk_ = key.shape[1]
+    nc = int(startend_row_indices.shape[-1])
+    allowed = (1, 2) if causal else (2, 4)
+    if nc not in allowed:
+        raise ValueError(
+            f"flashmask_attention: startend_row_indices last dim must be "
+            f"{allowed} for causal={causal}, got {nc} "
+            f"(≙ flashmask_attention shape contract, "
+            f"nn/functional/flash_attention.py)")
+    # the block-sparse kernel understands only the single-column causal LTS
+    # form; multi-column start+end forms take the dense-mask path below
     if dropout == 0.0 and _jax.default_backend() == "tpu" and s >= 4096 \
-            and s == sk_:
+            and s == sk_ and nc == 1:
         from ...ops.pallas_attention import flashmask_attention_raw
 
         hq = int(query.shape[2])
@@ -1058,9 +1072,25 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
 
     def build(idx):
         rows = jnp.arange(s)[None, None, :, None]     # query rows
-        start = jnp.swapaxes(idx, 2, 3)               # [B,H,1,S] per-column
-        mask = rows >= start                          # True → blocked
-        return jnp.where(mask, -jnp.inf, 0.0)
+
+        def col(j):                                   # [B,H,1,S] per-column
+            return jnp.swapaxes(idx[..., j:j + 1], 2, 3)
+
+        # reference column semantics: causal [LTS] / [LTS, LTE];
+        # non-causal [LTS, UTE] / [LTS, LTE, UTS, UTE] — a key column j is
+        # BLOCKED for query rows inside the named bands
+        if causal:
+            if nc == 1:
+                blocked = rows >= col(0)
+            else:
+                blocked = (rows >= col(0)) & (rows < col(1))
+        else:
+            if nc == 2:
+                blocked = (rows >= col(0)) | (rows < col(1))
+            else:
+                blocked = ((rows >= col(0)) & (rows < col(1))) \
+                    | ((rows >= col(2)) & (rows < col(3)))
+        return jnp.where(blocked, -jnp.inf, 0.0)
 
     amask = op_call(build, startend_row_indices, name="flashmask_build",
                     n_diff=0)

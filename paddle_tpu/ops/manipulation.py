@@ -160,7 +160,10 @@ broadcast_to = expand
 def broadcast_tensors(inputs, name=None):
     shapes = [tuple(t.shape) for t in inputs]
     target = np.broadcast_shapes(*shapes)
-    return [op_call(lambda a: jnp.broadcast_to(a, target), t, name="broadcast_tensors")
+    # differentiable (grad of broadcast = sum over the expanded axes), like
+    # the reference broadcast_tensors_grad
+    return [op_call(lambda a: jnp.broadcast_to(a, target), t,
+                    name="broadcast_tensors", n_diff=1)
             for t in inputs]
 
 
@@ -503,8 +506,16 @@ def argsort(x, axis=-1, descending=False, stable=False, name=None):
             return jnp.argsort(a, axis=axis, stable=True).astype(jnp.int64)
         if stable:
             # flipping a stable ascending argsort reverses tie order; a
-            # stable DESCENDING sort must keep ties in original order
-            return jnp.argsort(-a, axis=axis, stable=True).astype(jnp.int64)
+            # stable DESCENDING sort must keep ties in original order.
+            # The negate trick is float-only: for unsigned ints -a wraps
+            # (0 stays the minimum) and INT_MIN negates to itself. Bitwise
+            # NOT (~a = -a-1) is a wrap-free order-reversing bijection for
+            # every integer dtype, incl. bool.
+            if jnp.issubdtype(a.dtype, jnp.integer) or a.dtype == jnp.bool_:
+                key = jnp.invert(a)
+            else:
+                key = -a
+            return jnp.argsort(key, axis=axis, stable=True).astype(jnp.int64)
         return jnp.flip(jnp.argsort(a, axis=axis, stable=True),
                         axis=axis).astype(jnp.int64)
 

@@ -518,8 +518,11 @@ class TestAttentionWrappers:
         rs = np.random.RandomState(19)
         s = 6
         q = rs.randn(1, s, 2, 8).astype("float32")
-        # mask: key column j blocked for rows >= start[j]; start=s → no mask
-        idx = np.full((1, 1, s, 1), s, dtype="int32")
+        # non-causal takes the [LTS, UTE] form (reference shape contract);
+        # LTS=s and UTE=0 block nothing -> plain sdpa
+        lts = np.full((1, 1, s), s, dtype="int32")
+        ute = np.zeros((1, 1, s), dtype="int32")
+        idx = np.stack([lts, ute], axis=-1)
         out = F.flashmask_attention(_t(q), _t(q), _t(q),
                                     startend_row_indices=_t(idx))
         ref = _np(F.scaled_dot_product_attention(_t(q), _t(q), _t(q)))
@@ -536,3 +539,80 @@ class TestAttentionWrappers:
         qt = torch.tensor(q)
         want = tF.scaled_dot_product_attention(qt, qt, qt).numpy()
         np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+class TestFlashMaskMultiColumn:
+    """Reference flashmask_attention start+end column forms (ADVICE r4):
+    causal [LTS, LTE]; non-causal [LTS, UTE] and [LTS, LTE, UTS, UTE].
+    Column bands vary per key column and keep the diagonal visible so no
+    query row is fully masked."""
+
+    def _dense(self, q, blocked):
+        import torch
+        import torch.nn.functional as tF
+
+        qt = torch.tensor(np.swapaxes(q, 1, 2))
+        m = torch.where(torch.tensor(blocked), -torch.inf, 0.0)
+        out = tF.scaled_dot_product_attention(qt, qt, qt, attn_mask=m)
+        return np.swapaxes(out.numpy(), 1, 2)
+
+    def test_causal_lts_lte(self):
+        rs = np.random.RandomState(30)
+        s = 8
+        q = rs.randn(1, s, 2, 8).astype("float32")
+        j = np.arange(s)
+        lts = (j + 1).clip(0, s).astype("int32")       # band rows j+1..j+2
+        lte = (j + 3).clip(0, s).astype("int32")
+        idx = np.stack([np.tile(lts, (1, 1, 1)),
+                        np.tile(lte, (1, 1, 1))], axis=-1)
+        out = F.flashmask_attention(_t(q), _t(q), _t(q), _t(idx), causal=True)
+        rows = j[:, None]
+        cols = j[None, :]
+        blocked = ((rows >= lts[None, :]) & (rows < lte[None, :])) \
+            | (cols > rows)
+        np.testing.assert_allclose(_np(out), self._dense(q, blocked),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_noncausal_lts_ute(self):
+        rs = np.random.RandomState(31)
+        s = 8
+        q = rs.randn(1, s, 2, 8).astype("float32")
+        j = np.arange(s)
+        lts = (j + 2).clip(0, s).astype("int32")   # rows >= j+2 blocked
+        ute = (j - 1).clip(0, s).astype("int32")   # rows <  j-1 blocked
+        idx = np.stack([np.tile(lts, (1, 1, 1)),
+                        np.tile(ute, (1, 1, 1))], axis=-1)
+        out = F.flashmask_attention(_t(q), _t(q), _t(q), _t(idx),
+                                    causal=False)
+        rows = j[:, None]
+        blocked = (rows >= lts[None, :]) | (rows < ute[None, :])
+        np.testing.assert_allclose(_np(out), self._dense(q, blocked),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_noncausal_four_column(self):
+        rs = np.random.RandomState(32)
+        s = 8
+        q = rs.randn(1, s, 2, 8).astype("float32")
+        j = np.arange(s)
+        lts = (j + 1).clip(0, s).astype("int32")   # band1: rows j+1..j+2
+        lte = (j + 3).clip(0, s).astype("int32")
+        uts = (j - 3).clip(0, s).astype("int32")   # band2: rows j-3..j-2
+        ute = (j - 1).clip(0, s).astype("int32")
+        idx = np.stack([np.tile(c, (1, 1, 1))
+                        for c in (lts, lte, uts, ute)], axis=-1)
+        out = F.flashmask_attention(_t(q), _t(q), _t(q), _t(idx),
+                                    causal=False)
+        rows = j[:, None]
+        blocked = ((rows >= lts[None, :]) & (rows < lte[None, :])) \
+            | ((rows >= uts[None, :]) & (rows < ute[None, :]))
+        np.testing.assert_allclose(_np(out), self._dense(q, blocked),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_bad_column_count_raises(self):
+        idx = np.zeros((1, 1, 4, 3), dtype="int32")
+        q = np.zeros((1, 4, 1, 8), dtype="float32")
+        with pytest.raises(ValueError):
+            F.flashmask_attention(_t(q), _t(q), _t(q), _t(idx), causal=True)
+        idx4 = np.zeros((1, 1, 4, 4), dtype="int32")
+        with pytest.raises(ValueError):
+            F.flashmask_attention(_t(q), _t(q), _t(q), _t(idx4), causal=True)

@@ -233,3 +233,23 @@ def test_scalar_tensor_interop():
     np.testing.assert_allclose((x / 2).numpy(), x.numpy() / 2)
     np.testing.assert_allclose((x ** 2).numpy(), x.numpy() ** 2)
     np.testing.assert_allclose((-x).numpy(), -x.numpy())
+
+
+def test_argsort_descending_stable_integers():
+    """ADVICE r4: -a wraps for unsigned ints (0 stays minimum) and INT_MIN
+    negates to itself; stable descending must use a wrap-free key."""
+    for dt in ("uint8", "int32", "int64"):
+        a = np.array([3, 0, 5, 0, 3, 1], dtype=dt)
+        if dt != "uint8":
+            a[1] = np.iinfo(dt).min
+        idx = paddle.argsort(paddle.to_tensor(a), descending=True,
+                             stable=True).numpy()
+        vals = a[idx].astype(np.int64)
+        assert (np.diff(vals) <= 0).all(), (dt, vals)
+        for v in np.unique(a):  # ties keep original order (stability)
+            pos = idx[a[idx] == v]
+            assert (np.diff(pos) > 0).all(), (dt, v, pos)
+    b = np.array([True, False, True, False])
+    ib = paddle.argsort(paddle.to_tensor(b), descending=True,
+                        stable=True).numpy()
+    assert list(ib) == [0, 2, 1, 3]
