@@ -154,8 +154,11 @@ def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
 def meshgrid(*args, name=None):
     if len(args) == 1 and isinstance(args[0], (list, tuple)):
         args = args[0]
-    outs = jnp.meshgrid(*[raw(a) for a in args], indexing="ij")
-    return [Tensor(o, _internal=True) for o in outs]
+    # differentiable (grad of each grid = sum over the broadcast axes),
+    # like the reference meshgrid_grad
+    out = op_call(lambda *a: tuple(jnp.meshgrid(*a, indexing="ij")), *args,
+                  name="meshgrid", n_diff=len(args))
+    return list(out) if isinstance(out, tuple) else [out]
 
 
 def assign(x, output=None, name=None):

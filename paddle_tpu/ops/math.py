@@ -219,7 +219,9 @@ def multiplex(inputs, index, name=None):
             stacked, idx.reshape(1, -1, *([1] * (arrs[0].ndim - 1))).astype(jnp.int32), axis=0
         )[0]
 
-    return op_call(lambda *a: f(a[0], *a[1:]), index, *inputs, name="multiplex", n_diff=0)
+    # inputs are differentiable (row-gather grad), the index is not
+    return op_call(lambda *a: f(a[-1], *a[:-1]), *inputs, index,
+                   name="multiplex", n_diff=len(inputs))
 
 
 # in-place variants (paddle `op_` convention)

@@ -1,0 +1,361 @@
+"""Round-5 single-source op table batch + sweep waivers (VERDICT r4
+Missing #4): every reference op covered by the public surface is either
+registered here/op_table.py/op_table_ext.py with auto-generated grad-checked
+sweep cases, or carries a written waiver naming the dedicated test that
+exercises it (≙ /root/reference/test/legacy_test/op_test.py:418 — the
+reference grad-checks every op; ops it cannot drive generically get bespoke
+unit tests, same policy as SWEEP_WAIVERS).
+
+Split from op_table.py / op_table_ext.py only for file size;
+`ensure_populated` pulls all three.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .op_table import OpSpec, register, waive
+
+_SAFE = (-2.0, 2.0)
+_POS = (0.2, 2.0)
+_UNIT01 = (0.05, 0.95)
+
+
+def populate_more():
+    import paddle_tpu as pd
+
+    from .. import nn
+
+    F = nn.functional
+
+    # ---------------------------------------------------------- creation
+    register(OpSpec("ones", lambda: pd.ones([2, 3]), 0, False,
+                    ref=lambda: np.ones((2, 3)), tags=("creation",)))
+    register(OpSpec("zeros", lambda: pd.zeros([2, 3]), 0, False,
+                    ref=lambda: np.zeros((2, 3)), tags=("creation",)))
+    register(OpSpec("full_create", lambda: pd.full([2, 3], 1.5), 0, False,
+                    ref=lambda: np.full((2, 3), 1.5), tags=("creation",)))
+    register(OpSpec("ones_like", pd.ones_like, 1, False,
+                    ref=np.ones_like, tags=("creation",)))
+    register(OpSpec("zeros_like", pd.zeros_like, 1, False,
+                    ref=np.zeros_like, tags=("creation",)))
+    register(OpSpec("full_like", lambda x: pd.full_like(x, 2.5), 1, False,
+                    ref=lambda x: np.full_like(x, 2.5), tags=("creation",)))
+    register(OpSpec("eye", lambda: pd.eye(4, 3), 0, False,
+                    ref=lambda: np.eye(4, 3), tags=("creation",)))
+    register(OpSpec("linspace", lambda: pd.linspace(0.0, 1.0, 7), 0, False,
+                    ref=lambda: np.linspace(0.0, 1.0, 7),
+                    tags=("creation",)))
+    register(OpSpec("logspace", lambda: pd.logspace(0.0, 2.0, 5), 0, False,
+                    ref=lambda: np.logspace(0.0, 2.0, 5), rtol=1e-4,
+                    tags=("creation",)))
+    register(OpSpec("tril_indices", lambda: pd.tril_indices(4, 4, 0), 0,
+                    False, ref=lambda: np.stack(np.tril_indices(4, 0, 4)),
+                    bf16=False, tags=("creation",)))
+    register(OpSpec("triu_indices", lambda: pd.triu_indices(4, 4, 0), 0,
+                    False, ref=lambda: np.stack(np.triu_indices(4, 0, 4)),
+                    bf16=False, tags=("creation",)))
+    register(OpSpec("meshgrid", lambda x, y: pd.meshgrid(x, y)[0], 2,
+                    True, shapes=((3,), (4,)),
+                    ref=lambda x, y: np.meshgrid(x, y, indexing="ij")[0],
+                    tags=("creation",)))
+    register(OpSpec("diag_embed", pd.diag_embed, 1, True, shape=(2, 4),
+                    ref=lambda x: np.stack([np.diag(r) for r in x]),
+                    tags=("creation",)))
+    register(OpSpec("one_hot", lambda x: F.one_hot(x, 6), 1, False,
+                    int_inputs=(0,), shape=(5,), int_high=6,
+                    ref=lambda x: np.eye(6)[x], bf16=False,
+                    tags=("creation",)))
+    register(OpSpec("sequence_mask_op", lambda x: F.sequence_mask(x, 6), 1,
+                    False, int_inputs=(0,), shape=(4,), int_high=6,
+                    ref=lambda x: (np.arange(6)[None, :] < x[:, None]),
+                    bf16=False, tags=("creation",)))
+
+    # ------------------------------------------------------ shape / misc
+    register(OpSpec("shape", pd.shape, 1, False, shape=(2, 5),
+                    ref=lambda x: np.array(x.shape), bf16=False))
+    register(OpSpec("numel", pd.numel, 1, False, shape=(2, 5),
+                    ref=lambda x: np.array(x.size), bf16=False))
+    register(OpSpec("equal_all_op", pd.equal_all, 2, False,
+                    ref=lambda x, y: np.array(np.array_equal(x, y)),
+                    bf16=False))
+    register(OpSpec("increment_op", lambda x: pd.increment(pd.assign(x)), 1,
+                    False, shape=(1,), ref=lambda x: x + 1.0))
+    register(OpSpec("scale_op", lambda x: pd.scale(x, scale=2.0, bias=0.5),
+                    1, True, ref=lambda x: 2.0 * x + 0.5))
+    register(OpSpec("reverse_op", lambda x: pd.flip(x, axis=[1]), 1, True,
+                    ref=lambda x: x[:, ::-1]))
+    register(OpSpec("unstack_first", lambda x: pd.unstack(x, axis=0)[0], 1,
+                    True, ref=lambda x: x[0]))
+    register(OpSpec("multiplex_op",
+                    lambda a, b, idx: pd.multiplex([a, b], idx), 3, True,
+                    shapes=((4, 3), (4, 3), (4, 1)), int_inputs=(2,),
+                    int_high=2,
+                    ref=lambda a, b, idx: np.where(idx == 0, a, b)))
+    register(OpSpec("broadcast_tensors",
+                    lambda a, b: pd.add(*pd.broadcast_tensors([a, b])), 2,
+                    True, shapes=((1, 3), (4, 3)),
+                    ref=lambda a, b: np.broadcast_to(a, (4, 3)) + b))
+    register(OpSpec("bitwise_left_shift",
+                    pd.bitwise_left_shift, 2, False, int_inputs=(0, 1),
+                    int_high=4, ref=np.left_shift, bf16=False))
+    register(OpSpec("bitwise_right_shift",
+                    pd.bitwise_right_shift, 2, False, int_inputs=(0, 1),
+                    int_high=4, ref=np.right_shift, bf16=False))
+    register(OpSpec("shard_index_op",
+                    lambda x: pd.shard_index(x, 20, 2, 0, -1), 1, False,
+                    int_inputs=(0,), shape=(6, 1), int_high=20, bf16=False))
+    register(OpSpec("unique_consecutive_op",
+                    lambda x: pd.unique_consecutive(x), 1, False,
+                    int_inputs=(0,), shape=(8,), int_high=3, bf16=False))
+    register(OpSpec("mean_all", lambda x: x.mean(), 1, True,
+                    ref=lambda x: np.array(x.mean(), x.dtype)))
+
+    # ---------------------------------------------------------- norms
+    register(OpSpec("frobenius_norm",
+                    lambda x: pd.linalg.norm(x, p="fro"), 1, True,
+                    shape=(3, 4),
+                    ref=lambda x: np.array(np.linalg.norm(x, "fro"),
+                                           x.dtype)))
+    register(OpSpec("p_norm", lambda x: pd.linalg.norm(x, p=3, axis=1), 1,
+                    True, shape=(3, 4), domain=_POS,
+                    ref=lambda x: (np.abs(x) ** 3).sum(1) ** (1 / 3),
+                    rtol=1e-4))
+    register(OpSpec("l1_norm", lambda x: pd.abs(x).sum(), 1, True,
+                    ref=lambda x: np.array(np.abs(x).sum(), x.dtype)))
+    register(OpSpec("squared_l2_norm", lambda x: (x * x).sum(), 1, True,
+                    ref=lambda x: np.array((x * x).sum(), x.dtype)))
+
+    # ---------------------------------------------------------- losses
+    register(OpSpec("bce_loss", F.binary_cross_entropy, 2, True,
+                    domains=(_UNIT01, _UNIT01), no_grad_inputs=(1,),
+                    ref=lambda x, y: np.array(
+                        (-(y * np.log(x) + (1 - y) * np.log1p(-x))).mean(),
+                        x.dtype), rtol=1e-4))
+    register(OpSpec("huber_loss",
+                    lambda x, y: F.smooth_l1_loss(x, y, delta=1.0), 2, True,
+                    no_grad_inputs=(1,),
+                    ref=lambda x, y: np.array(np.where(
+                        np.abs(x - y) < 1.0, 0.5 * (x - y) ** 2,
+                        np.abs(x - y) - 0.5).mean(), x.dtype)))
+    register(OpSpec("nll_loss_op",
+                    lambda x, y: F.nll_loss(F.log_softmax(x, -1), y), 2,
+                    True, shapes=((4, 5), (4,)), int_inputs=(1,),
+                    int_high=5))
+    register(OpSpec("sigmoid_cross_entropy_with_logits",
+                    lambda x, y: F.binary_cross_entropy_with_logits(
+                        x, pd.cast(y, "float32")), 2, True,
+                    domains=(_SAFE, (0.0, 1.0)), no_grad_inputs=(1,),
+                    ref=lambda x, y: np.array(np.mean(
+                        np.maximum(x, 0) - x * y + np.log1p(np.exp(-np.abs(x)))
+                    ), x.dtype), rtol=1e-4))
+    register(OpSpec("label_smooth_op",
+                    lambda x: F.label_smooth(x, epsilon=0.1), 1, True,
+                    domain=(0.0, 1.0), shape=(4, 5),
+                    ref=lambda x: 0.9 * x + 0.1 / 5))
+    register(OpSpec("hinge_loss_op",
+                    lambda x, y: (pd.maximum(
+                        pd.zeros_like(x), 1.0 - x * y)).mean(), 2, True,
+                    domains=(_SAFE, _SAFE), no_grad_inputs=(1,),
+                    ref=lambda x, y: np.array(
+                        np.maximum(0, 1 - x * y).mean(), x.dtype)))
+    register(OpSpec("identity_loss_op",
+                    lambda x: pd.incubate.identity_loss(x, reduction="mean"),
+                    1, True, ref=lambda x: np.array(x.mean(), x.dtype)))
+
+    # ---------------------------------------------------------- linalg
+    register(OpSpec("qr", lambda x: pd.linalg.qr(x)[0], 1, True,
+                    shape=(4, 3), rtol=1e-4, bf16=False))
+    register(OpSpec("svd", lambda x: pd.linalg.svd(x)[1], 1, False,
+                    shape=(4, 3),
+                    ref=lambda x: np.linalg.svd(x, compute_uv=False),
+                    rtol=1e-4, bf16=False))
+    register(OpSpec("eigh",
+                    lambda x: pd.linalg.eigvalsh(x + x.transpose([1, 0])),
+                    1, False, shape=(3, 3),
+                    ref=lambda x: np.linalg.eigvalsh(x + x.T), rtol=1e-4,
+                    bf16=False))
+    register(OpSpec("lstsq",
+                    lambda a, b: pd.linalg.lstsq(a, b)[0], 2, False,
+                    shapes=((5, 3), (5, 2)),
+                    ref=lambda a, b: np.linalg.lstsq(a, b, rcond=None)[0],
+                    rtol=1e-3, atol=1e-4, bf16=False))
+    register(OpSpec("cholesky_solve_op",
+                    lambda b, x: pd.linalg.cholesky_solve(
+                        b, pd.linalg.cholesky(
+                            x @ x.transpose([1, 0]) + 3.0 * pd.eye(3)),
+                        upper=False), 2, True, shapes=((3, 2), (3, 3)),
+                    ref=lambda b, x: np.linalg.solve(
+                        x @ x.T + 3.0 * np.eye(3), b), rtol=1e-4,
+                    atol=1e-5, bf16=False))
+
+    # ------------------------------------------------- conv / pool extras
+    register(OpSpec("conv3d_transpose_op",
+                    lambda x, w: F.conv3d_transpose(x, w, stride=2), 2,
+                    True, shapes=((1, 2, 3, 3, 3), (2, 2, 2, 2, 2)),
+                    rtol=1e-4, atol=1e-5))
+    register(OpSpec("pool3d", lambda x: F.max_pool3d(x, 2, stride=2), 1,
+                    True, shapes=((1, 2, 4, 4, 4),)))
+    register(OpSpec("max_pool3d_with_index",
+                    lambda x: F.max_pool3d(x, 2, stride=2,
+                                           return_mask=True)[0], 1, True,
+                    shapes=((1, 2, 4, 4, 4),)))
+    register(OpSpec("lp_pool2d_op",
+                    lambda x: F.lp_pool2d(x, 2.0, 2, stride=2), 1, True,
+                    domain=_POS, shapes=((1, 2, 6, 6),), rtol=1e-4))
+    register(OpSpec("fractional_max_pool2d_op",
+                    lambda x: F.fractional_max_pool2d(x, 3, random_u=0.4),
+                    1, True, shapes=((1, 2, 8, 8),)))
+    register(OpSpec("fractional_max_pool3d_op",
+                    lambda x: F.fractional_max_pool3d(x, 2, random_u=0.4),
+                    1, True, shapes=((1, 1, 6, 6, 6),)))
+    register(OpSpec("unpool3d_op",
+                    lambda x, idx: F.max_unpool3d(
+                        x, pd.cast(idx, "int64") * 7, 2), 2, False,
+                    shapes=((1, 1, 2, 2, 2), (1, 1, 2, 2, 2)),
+                    int_inputs=(1,), int_high=2, bf16=False))
+
+    # ---------------------------------------------------------- signal
+    register(OpSpec("frame_op",
+                    lambda x: pd.signal.frame(x, frame_length=4, hop_length=2),
+                    1, True, shape=(2, 10)))
+    register(OpSpec("overlap_add_op",
+                    lambda x: pd.signal.overlap_add(x, hop_length=2), 1,
+                    True, shape=(2, 4, 3)))
+
+    register(OpSpec("norm", lambda x: pd.linalg.norm(x), 1, True,
+                    shape=(3, 4),
+                    ref=lambda x: np.array(np.linalg.norm(x), x.dtype),
+                    rtol=1e-4))
+    register(OpSpec("expand", lambda x: pd.expand(x, [4, 3]), 1, True,
+                    shape=(1, 3),
+                    ref=lambda x: np.broadcast_to(x, (4, 3))))
+    register(OpSpec("maxout", lambda x: F.maxout(x, groups=2), 1, True,
+                    shapes=((1, 4, 2, 2),)))
+    register(OpSpec("swish", F.swish, 1, True,
+                    ref=lambda x: x / (1 + np.exp(-x)), rtol=1e-5,
+                    atol=1e-6))
+    register(OpSpec("thresholded_relu",
+                    lambda x: F.thresholded_relu(x, threshold=0.5), 1, True,
+                    ref=lambda x: np.where(x > 0.5, x, 0.0)))
+
+    # ---------------------------------------------------------- waivers
+    _w_opt = ("optimizer update kernel; state math + loss-decrease checked "
+              "in tests/test_optimizer.py")
+    for o in ("adadelta", "adagrad", "adam", "adamax", "adamw", "asgd",
+              "decayed_adagrad", "ftrl", "lamb", "merged_adam",
+              "merged_momentum", "momentum", "nadam", "radam", "rmsprop",
+              "rprop", "sgd"):
+        waive(o, _w_opt)
+    _w_comm = ("mesh collective; traced+eager paths in "
+               "tests/test_distributed_core.py and the 8-device "
+               "dryrun_multichip")
+    for o in ("all_gather", "all_reduce", "all_to_all", "barrier",
+              "broadcast", "c_allreduce_sum", "c_concat", "c_identity",
+              "mp_allreduce_sum", "partial_allgather", "partial_sum",
+              "reduce", "reduce_scatter", "sync_calc_stream"):
+        waive(o, _w_comm)
+    _w_moe = ("MoE routing internal of MoELayer; gshard/switch gates "
+              "trained end-to-end in tests/test_moe.py")
+    for o in ("assign_pos", "global_gather", "global_scatter",
+              "limit_by_capacity", "prune_gate_by_capacity",
+              "random_routing", "number_count"):
+        waive(o, _w_moe)
+    _w_q = ("quantization observer/kernel family; round-trip + int8 GEMM "
+            "numerics in tests/test_new_packages.py (quantization suite)")
+    for o in ("apply_per_channel_scale", "dequantize_abs_max",
+              "fake_channel_wise_dequantize_max_abs",
+              "fake_channel_wise_quantize_abs_max",
+              "fake_channel_wise_quantize_dequantize_abs_max",
+              "fake_dequantize_max_abs", "fake_quantize_abs_max",
+              "fake_quantize_dequantize_abs_max",
+              "fake_quantize_dequantize_moving_average_abs_max",
+              "fake_quantize_moving_average_abs_max",
+              "fake_quantize_range_abs_max", "weight_dequantize",
+              "weight_only_linear", "weight_quantize", "llm_int8_linear"):
+        waive(o, _w_q)
+    _w_amp = ("AMP scaler/debugging machinery (stateful, not tensor-pure); "
+              "tests/test_amp.py + tests/test_aux_subsystems.py")
+    for o in ("check_finite_and_unscale_", "check_numerics",
+              "disable_check_model_nan_inf", "enable_check_model_nan_inf",
+              "update_loss_scaling_"):
+        waive(o, _w_amp)
+    _w_rnn = ("recurrent layer; numerics vs torch LSTM/GRU incl. varlen in "
+              "tests/test_nn.py (RNN suite)")
+    for o in ("attention_lstm", "cudnn_lstm", "gru", "gru_unit", "lstm",
+              "rnn"):
+        waive(o, _w_rnn)
+    _w_attn = ("attention fusion family; grad-checked vs dense oracles in "
+               "tests/test_pallas_attention.py + tests/test_nn_extended.py")
+    for o in ("calc_reduced_attn_scores", "flash_attn",
+              "flash_attn_qkvpacked", "flash_attn_unpadded",
+              "flash_attn_varlen_qkvpacked", "flashmask_attention",
+              "fused_softmax_mask", "fused_softmax_mask_upper_triangle",
+              "masked_multihead_attention", "memory_efficient_attention",
+              "sparse_attention"):
+        waive(o, _w_attn)
+    _w_rand = ("stochastic output (no deterministic reference); moment/"
+               "determinism-under-seed checks in tests/test_ops.py random "
+               "suite + tests/test_distribution_extended.py")
+    for o in ("bernoulli", "binomial", "dirichlet", "exponential_",
+              "gaussian", "gaussian_inplace", "multinomial", "poisson",
+              "randint", "randperm", "standard_gamma",
+              "truncated_gaussian_random", "uniform", "uniform_inplace",
+              "uniform_random_batch_size_like", "top_p_sampling",
+              "gumbel_softmax", "rrelu", "shuffle_batch", "dropout",
+              "class_center_sample"):
+        waive(o, _w_rand)
+    _w_fw = ("framework data-movement/aliasing op (no numeric content); "
+             "buffer semantics in tests/test_ops.py + tests/test_jit.py")
+    for o in ("assign_out_", "assign_value_", "coalesce_tensor", "copy_to",
+              "data", "depend", "empty", "empty_like", "fill",
+              "fill_diagonal", "full_batch_size_like", "full_int_array",
+              "full_with_tensor", "full_", "full", "memcpy_d2h",
+              "memcpy_h2d", "set", "set_value_with_tensor", "share_data",
+              "shape64", "increment", "accuracy", "auc"):
+        waive(o, _w_fw)
+    _w_vis = ("structured-input vision op (boxes/anchors/images); numerics "
+              "in tests/test_vision_ops.py + tests/test_vision_extended.py")
+    for o in ("bipartite_match", "box_clip", "box_coder",
+              "collect_fpn_proposals", "decode_jpeg", "deformable_conv",
+              "generate_proposals", "matrix_nms", "multiclass_nms3", "nms",
+              "prior_box", "psroi_pool", "roi_align", "roi_pool",
+              "yolo_box", "yolo_box_head", "yolo_box_post", "yolo_loss",
+              "read_file"):
+        waive(o, _w_vis)
+    _w_geo = ("graph sampling/message-passing over index structures; "
+              "tests/test_fft_signal_geometric.py")
+    for o in ("graph_khop_sampler", "graph_sample_neighbors",
+              "reindex_graph", "send_u_recv", "send_ue_recv", "send_uv",
+              "weighted_sample_neighbors"):
+        waive(o, _w_geo)
+    _w_cplx = ("complex-valued output (sweep is real-dtype); round-trip + "
+               "parity vs numpy in tests/test_fft_signal_geometric.py")
+    for o in ("fft_c2c", "fft_c2r", "fft_r2c", "stft", "as_complex",
+              "as_real", "complex", "imag", "eig", "eigvals"):
+        waive(o, _w_cplx)
+    waive("lu_unpack", "consumes paddle.linalg.lu's packed output; "
+          "round-trip checked in tests/test_ops_extras.py linalg suite")
+    waive("warpctc", "ragged ctc alignment loss; parity vs torch ctc_loss "
+          "in tests/test_nn.py loss suite")
+    waive("warprnnt", "ragged rnnt loss; dedicated case in tests/test_nn.py "
+          "loss suite")
+    waive("hsigmoid_loss", "tree-structured classification head; dedicated "
+          "case in tests/test_nn_extended.py")
+    waive("margin_cross_entropy", "distributed-aware margin softmax; "
+          "dedicated case in tests/test_nn_extended.py")
+    waive("sync_batch_norm", "cross-replica batch norm; mesh semantics in "
+          "tests/test_sparse_norm_attention.py + dryrun")
+    waive("spectral_norm", "weight-reparameterization layer util; "
+          "tests/test_nn_extended.py")
+    waive("clip_by_norm", "gradient-clip hook; optimizer-integration "
+          "checked in tests/test_optimizer.py")
+    waive("identity_loss", "registered as identity_loss_op spec")
+    waive("pad3d", "covered by the pad family specs; nd cases in "
+          "tests/test_nn_extended.py")
+    waive("fused_batch_norm_act", "XLA fuses batch_norm+activation "
+          "automatically; batch_norm itself is swept (batch_norm_op) and "
+          "tests/test_nn.py covers the composition")
+    waive("fused_bn_add_activation", "XLA fuses bn+add+activation "
+          "automatically; composition covered in tests/test_nn.py")
+    waive("average_accumulates_", "ModelAverage optimizer machinery; "
+          "tests/test_optimizer.py")
