@@ -17,7 +17,7 @@ to model code (SURVEY §5.7). Here it is first-class, TPU-native:
 Both are designed to be called INSIDE `shard_map` (or any context where the
 `sep` axis name is bound) on paddle-layout [batch, seq_local, heads, head_dim]
 shards, and are exact: numerics match full attention on the gathered sequence
-(tests/test_ring_attention.py).
+(tests/test_pallas_attention.py, ring/Ulysses parity cases).
 
 On TPU, `ulysses_attention`'s local attention (where its FLOPs live) rides
 the Pallas flash kernel for seq >= 256; pass `check_vma=False` to
