@@ -257,11 +257,29 @@ class SGD(Optimizer):
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
-                 multi_precision=False, name=None):
+                 multi_precision=False, use_multi_tensor=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
                          multi_precision)
         self._momentum = momentum
         self._nesterov = use_nesterov
+        self._use_multi_tensor = use_multi_tensor
+
+    def step(self):
+        """use_multi_tensor=True (eager): ONE jitted fused update over the
+        whole param pytree with donated buffers (≙ phi merged_momentum_)
+        instead of a python loop of per-param updates."""
+        if not getattr(self, "_use_multi_tensor", False):
+            return super().step()
+        from .fused import fused_momentum_step
+
+        with no_grad():
+            pgs = self._collect_params_grads()
+            self._step_count += 1
+            lr_data = self._lr_value()
+            if fused_momentum_step(self, pgs, lr_data):
+                return
+            self._step_count -= 1
+        return super().step()
 
     def _apply_one(self, p, g, lr_val, wd):
         v = self._acc("velocity", p)

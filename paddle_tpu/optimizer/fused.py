@@ -161,3 +161,101 @@ def fused_adam_step(opt, pgs, lr_data) -> bool:
         if opt._amsgrad:
             vmaxs[i]._assign_raw(new_vmaxs[i])
     return True
+
+
+def _build_momentum_executor(n, mu, nesterov, clip_norm, has_master):
+    """Compile-once fused Momentum update (≙ phi merged_momentum kernel):
+    bases / low-precision params / velocities are donated."""
+
+    def update(bases, lo_params, vels, grads, wds, lrfs, lr):
+        if clip_norm is not None:
+            sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+            gnorm = jnp.sqrt(sq)
+            scale = jnp.minimum(clip_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+            grads = [(g * scale.astype(jnp.float32)).astype(g.dtype)
+                     for g in grads]
+        new_bases, new_lo, new_vels = [], [], []
+        low = (jnp.float16, jnp.bfloat16)
+        for i in range(n):
+            base = bases[i]
+            comp_dt = jnp.float32 if base.dtype in low else base.dtype
+            bc = base.astype(comp_dt)
+            gd = grads[i].astype(comp_dt) + wds[i] * bc
+            new_v = mu * vels[i].astype(comp_dt) + gd
+            upd = gd + mu * new_v if nesterov else new_v
+            newb = bc - lr * lrfs[i] * upd
+            new_bases.append(newb.astype(base.dtype))
+            if has_master:
+                new_lo.append(newb.astype(lo_params[i].dtype))
+            new_vels.append(new_v.astype(vels[i].dtype))
+        return new_bases, new_lo, new_vels
+
+    return jax.jit(update, donate_argnums=(0, 1, 2))
+
+
+def fused_momentum_step(opt, pgs, lr_data) -> bool:
+    """One fused update over every (param, grad) pair for Momentum/SGD-with-
+    momentum. Returns False when the fused path doesn't apply (tracing,
+    exotic clip, L1 decay) — caller falls back to the per-param loop."""
+    from . import _wd_coeff  # late: circular import
+
+    clip = opt._grad_clip
+    clip_norm = None
+    if clip is not None:
+        if isinstance(clip, ClipGradByGlobalNorm):
+            clip_norm = float(clip.clip_norm)
+        else:
+            return False
+
+    params, grads, groups = [], [], []
+    for p, g, grp in pgs:
+        if g is None:
+            continue
+        params.append(p)
+        grads.append(g)
+        groups.append(grp)
+    if not params:
+        return True
+    if any(_is_tracer(p._data) or _is_tracer(g._data)
+           for p, g in zip(params, grads)):
+        return False
+
+    wds, lrfs = [], []
+    for p, grp in zip(params, groups):
+        wd = grp.get("weight_decay", opt._weight_decay)
+        if getattr(wd, "_kind", "l2") == "l1":
+            return False
+        wds.append(float(_wd_coeff(wd)))
+        lrfs.append(float(grp.get("learning_rate", 1.0)))
+
+    masters = [opt._master(p) for p in params]
+    has_master = any(m is not None for m in masters)
+    if has_master and not all(m is not None for m in masters):
+        return False
+    vels = [opt._acc("velocity", p) for p in params]
+
+    key = (tuple((tuple(p.shape), p.dtype.name) for p in params),
+           tuple(wds), tuple(lrfs), opt._momentum, opt._nesterov,
+           clip_norm, has_master)
+    cached = getattr(opt, "_fused_exec", None)
+    if cached is None or cached[0] != key:
+        exe = _build_momentum_executor(len(params), opt._momentum,
+                                       opt._nesterov, clip_norm, has_master)
+        opt._fused_exec = cached = (key, exe)
+    exe = cached[1]
+
+    bases = [(m._data if m is not None else p._data)
+             for p, m in zip(params, masters)]
+    lo = [p._data for p in params] if has_master else []
+    new_bases, new_lo, new_vels = exe(
+        bases, lo, [v._data for v in vels], [g._data for g in grads],
+        wds, lrfs, lr_data)
+
+    for i, p in enumerate(params):
+        if has_master:
+            masters[i]._assign_raw(new_bases[i])
+            p._assign_raw(new_lo[i])
+        else:
+            p._assign_raw(new_bases[i])
+        vels[i]._assign_raw(new_vels[i])
+    return True

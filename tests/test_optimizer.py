@@ -285,3 +285,34 @@ class TestFusedMultiTensor:
             np.testing.assert_allclose(a.astype("float32"),
                                        b.astype("float32"),
                                        rtol=1e-6, atol=1e-7)
+
+
+def test_fused_momentum_matches_per_param():
+    """Momentum(use_multi_tensor=True) (≙ merged_momentum_) must be
+    numerically identical to the per-param loop across nesterov/wd."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    def train(mt, nesterov, wd):
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+        opt = paddle.optimizer.Momentum(
+            learning_rate=0.05, momentum=0.9, use_nesterov=nesterov,
+            weight_decay=wd, parameters=net.parameters(),
+            use_multi_tensor=mt)
+        X = paddle.to_tensor(
+            np.random.RandomState(0).randn(32, 8).astype("float32"))
+        Y = paddle.to_tensor(
+            np.random.RandomState(1).randint(0, 3, (32,)).astype("int64"))
+        for _ in range(5):
+            loss = F.cross_entropy(net(X), Y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return [np.asarray(p._data) for p in net.parameters()]
+
+    for nesterov in (False, True):
+        for wd in (None, 0.01):
+            for a, b in zip(train(False, nesterov, wd),
+                            train(True, nesterov, wd)):
+                np.testing.assert_allclose(a, b, rtol=2e-6, atol=1e-7)
