@@ -27,9 +27,48 @@ import jax
 import numpy as np
 
 from . import dtype as dtypes
+from . import flags as _flags_mod
 from .flags import flag
 from .lazy import LazyData as _LazyData
 from .lazy import current_lazy as _current_lazy
+
+
+class _HotFlags:
+    """Per-generation snapshot of the flags the dispatch hot loop reads
+    4-5 times per op; refreshed whenever set_flags bumps the generation."""
+
+    __slots__ = ("gen", "use_cache", "defer_vjp", "benchmark",
+                 "check_nan_inf", "double_grad")
+
+    def __init__(self):
+        # slots pre-populated so a concurrent reader that races a refresh
+        # never sees unset attributes; gen starts stale so the first
+        # _hot_flags() call refreshes
+        self.gen = -1
+        self.use_cache = self.defer_vjp = self.double_grad = True
+        self.benchmark = self.check_nan_inf = False
+
+    def refresh(self):
+        # read the generation FIRST and publish it LAST: if set_flags runs
+        # mid-refresh, gen stays stale and the next reader re-refreshes
+        gen = _flags_mod.generation
+        self.use_cache = flag("FLAGS_use_compiled_eager")
+        self.defer_vjp = flag("FLAGS_eager_defer_vjp")
+        self.benchmark = flag("FLAGS_benchmark")
+        self.check_nan_inf = flag("FLAGS_check_nan_inf")
+        self.double_grad = flag("FLAGS_enable_double_grad")
+        self.gen = gen
+        return self
+
+
+_HOT_FLAGS = _HotFlags()
+
+
+def _hot_flags():
+    hf = _HOT_FLAGS
+    if hf.gen != _flags_mod.generation:
+        hf.refresh()
+    return hf
 
 _tls = threading.local()
 
@@ -191,6 +230,14 @@ def _needs_complex_bridge(avals, datas, diff_idx):
             return True
     return False
 
+
+#: raw jax/numpy dtypes with meaningful VJPs (floats + complex — fft ops
+#: have complex VJPs); frozen set of the dtype OBJECTS jax actually attaches
+#: to arrays, so the hot diff-scan avoids np.dtype construction
+_DIFF_DTYPES = frozenset(
+    np.dtype(n) for n in ("float16", "bfloat16", "float32", "float64",
+                          "float8_e4m3fn", "float8_e5m2",
+                          "complex64", "complex128"))
 
 _TENSOR_CLS = None
 
@@ -357,17 +404,26 @@ def _build_entry(fn, datas, diff_idx, dyn_pos):
     return ("grad", jax.jit(fwd), jax.jit(fwd_only), jax.jit(bwd))
 
 
-def _cached_dispatch(fn, fn_id, name, datas, diff_idx, target):
+def _cached_dispatch(fn, fn_id, name, datas, diff_idx, target,
+                     dyn_pos=None, has_tracer=None):
     """Returns (out, vjp_or_None) via the executable cache, or None to fall
-    back to the uncached path (unhashable statics / trace failure)."""
+    back to the uncached path (unhashable statics / trace failure).
+    dyn_pos/has_tracer may be precomputed by the caller's operand scan
+    (one pass instead of three over the hot loop's operands)."""
     global _eager_hits, _eager_misses
-    for d in datas:
-        if isinstance(d, jax.core.Tracer):
-            return None
-    dyn_pos = tuple(i for i, d in enumerate(datas) if _is_dynamic(d))
-    statics = tuple(
-        _freeze(d) for i, d in enumerate(datas) if i not in set(dyn_pos)
-    )
+    if has_tracer is None:
+        has_tracer = any(isinstance(d, jax.core.Tracer) for d in datas)
+    if has_tracer:
+        return None
+    if dyn_pos is None:
+        dyn_pos = tuple(i for i, d in enumerate(datas) if _is_dynamic(d))
+    if len(dyn_pos) == len(datas):  # common case: every operand dynamic
+        statics = ()
+    else:
+        dyn_set = set(dyn_pos)
+        statics = tuple(
+            _freeze(d) for i, d in enumerate(datas) if i not in dyn_set
+        )
     if fn_id is _UNCACHABLE or any(s is _UNCACHABLE for s in statics):
         return None
     key = (fn_id, name, target, dyn_pos, tuple(diff_idx), statics)
@@ -390,7 +446,7 @@ def _cached_dispatch(fn, fn_id, name, datas, diff_idx, target):
     try:
         if kind == "nograd":
             return jitted(*dyn), None
-        if defer and flag("FLAGS_eager_defer_vjp"):
+        if defer and _hot_flags().defer_vjp:
             fwd_only, bwd = defer
             out = fwd_only(*dyn)
             dyn_t = tuple(dyn)
@@ -465,7 +521,7 @@ def _make_ctx(fn, datas, diff_idx):
     stored as None — _regrad rebuilds them from node.inputs, so the ctx
     pins only the non-diff operands (and most of those are already alive
     in the vjp residuals)."""
-    if not flag("FLAGS_enable_double_grad"):
+    if not _hot_flags().double_grad:
         return None
     diff = set(diff_idx)
     kept = [None if i in diff else d for i, d in enumerate(datas)]
@@ -518,14 +574,24 @@ def _op_call_impl(fn: Callable, *args, name: str | None = None, n_diff: int | No
     name = name or getattr(fn, "__name__", "op")
     trace = current_trace()
 
+    # ONE pass over the operands collects buffers, dynamic positions and
+    # tracer-ness (the eager hot loop previously re-scanned three times)
     datas = []
-    for a in args:
+    dyn_pos_l = []
+    has_tracer = False
+    for i, a in enumerate(args):
         if _is_tensor(a):
             if trace is not None:
                 trace.on_read(a)
-            datas.append(a._data)
+            d = a._data_buf
         else:
-            datas.append(a)
+            d = a
+        datas.append(d)
+        if isinstance(d, (jax.Array, np.ndarray)):
+            dyn_pos_l.append(i)
+            if isinstance(d, jax.core.Tracer):
+                has_tracer = True
+    dyn_pos = tuple(dyn_pos_l)
 
     # AMP O1/O2 input casting (paddle: amp_auto_cast.h logic inlined in ad_funcs)
     global _amp_dtype_for
@@ -553,9 +619,10 @@ def _op_call_impl(fn: Callable, *args, name: str | None = None, n_diff: int | No
     diff_idx = []
     if grad_enabled():
         for i, a in enumerate(args[:limit]):
-            if _is_tensor(a) and not a.stop_gradient and (
-                    dtypes.is_floating_point(a.dtype)
-                    or dtypes.is_complex(a.dtype)):  # fft/complex ops have VJPs
+            # raw-dtype membership check: the Tensor.dtype property builds
+            # a fresh np.dtype per access — measurable in this hot loop
+            if _is_tensor(a) and not a.stop_gradient \
+                    and getattr(a._data, "dtype", None) in _DIFF_DTYPES:
                 diff_idx.append(i)
 
     # segmented lazy staging (to_static graph-break mode): record the op
@@ -580,19 +647,23 @@ def _op_call_impl(fn: Callable, *args, name: str | None = None, n_diff: int | No
             return wrapped
         # un-stageable op: materialize lazy inputs, fall through to eager
         datas = [d.get() if isinstance(d, _LazyData) else d for d in datas]
+        # materialization changes which operands are dynamic: recompute
+        dyn_pos = has_tracer = None
 
-    use_cache = flag("FLAGS_use_compiled_eager")
+    use_cache = _hot_flags().use_cache
 
     if not diff_idx:
         if use_cache:
-            cached = _cached_dispatch(fn, _fn_key(orig_fn), name, datas, [], target)
+            cached = _cached_dispatch(fn, _fn_key(orig_fn), name, datas, [],
+                                      target, dyn_pos, has_tracer)
             if cached is not None:
                 return _wrap_outputs(cached[0], None, name)
         out = fn(*datas)
         return _wrap_outputs(out, None, name)
 
     if use_cache:
-        cached = _cached_dispatch(fn, _fn_key(orig_fn), name, datas, diff_idx, target)
+        cached = _cached_dispatch(fn, _fn_key(orig_fn), name, datas,
+                                  diff_idx, target, dyn_pos, has_tracer)
         if cached is not None:
             out, vjp_fn = cached
             single = not isinstance(out, (tuple, list))
@@ -634,7 +705,8 @@ def _wrap_outputs(out, node, name):
         from .tensor import Tensor as _TENSOR_CLS  # noqa: F811
     Tensor = _TENSOR_CLS
 
-    if flag("FLAGS_benchmark"):
+    hf = _hot_flags()
+    if hf.benchmark:
         # benchmark mode: per-op completion barrier (≙ reference benchmark
         # flag forcing synchronous kernel launches). NOTE: a scalar fetch,
         # not block_until_ready — on the axon tunnel the latter returns
@@ -645,12 +717,18 @@ def _wrap_outputs(out, node, name):
         for o in flat:
             if isinstance(o, jax.Array) and not isinstance(o, jax.core.Tracer):
                 jax.device_get(_jnp.ravel(o)[0]) if o.size else None
-    if flag("FLAGS_check_nan_inf"):
+    if hf.check_nan_inf:
         flat = [out] if not isinstance(out, (tuple, list)) else list(out)
         _check_nan_inf(name, [o for o in flat if hasattr(o, "dtype")])
     if _op_stat_fn is not None:
         flat = [out] if not isinstance(out, (tuple, list)) else list(out)
         _op_stat_fn(name, [o for o in flat if hasattr(o, "dtype")])
+
+    if not isinstance(out, (tuple, list)):  # single output: the hot shape
+        t = Tensor(out, stop_gradient=node is None, _internal=True)
+        if node is not None:
+            t._node = node
+        return t
 
     def mk(o, idx):
         t = Tensor(o, stop_gradient=node is None, _internal=True)
@@ -659,6 +737,4 @@ def _wrap_outputs(out, node, name):
             t._out_idx = idx
         return t
 
-    if not isinstance(out, (tuple, list)):
-        return mk(out, 0)
     return tuple(mk(o, i) for i, o in enumerate(out))

@@ -34,7 +34,13 @@ def _parse(text: str, default):
     return text
 
 
+#: bumped on every flag write — hot paths snapshot flag values keyed by
+#: this generation instead of paying registry lookups per op (dispatch.py)
+generation = 0
+
+
 def set_flags(flags: dict):
+    global generation
     for k, v in flags.items():
         if not k.startswith("FLAGS_"):
             k = "FLAGS_" + k
@@ -42,6 +48,9 @@ def set_flags(flags: dict):
             _REGISTRY[k] = {"value": v, "default": v, "doc": "(ad-hoc)"}
         else:
             _REGISTRY[k]["value"] = v
+    # bump AFTER the writes: snapshot readers keyed on the generation must
+    # never observe the new generation with old registry values
+    generation += 1
 
 
 def get_flags(flags) -> dict:
