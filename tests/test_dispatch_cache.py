@@ -113,3 +113,16 @@ def test_amp_target_in_key():
     y32 = paddle.matmul(x, w)
     assert str(y16.dtype).endswith("bfloat16")
     assert str(y32.dtype).endswith("float32")
+
+
+def test_deferred_vjp_retain_graph_twice():
+    """The deferred backward executable must be reusable: backward with
+    retain_graph=True followed by a second backward accumulates 2x grads
+    through the same cached entries."""
+    x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], dtype="float32"))
+    x.stop_gradient = False
+    y = paddle.matmul(x, x).sum()
+    y.backward(retain_graph=True)
+    g1 = np.asarray(x.grad._data).copy()
+    y.backward()
+    np.testing.assert_allclose(np.asarray(x.grad._data), 2 * g1, rtol=1e-6)
