@@ -138,6 +138,16 @@ define_flag("FLAGS_jit_log_to_stdout", False,
 define_flag("FLAGS_flash_autotune", True,
             "runtime autotune of Pallas flash attention block sizes per "
             "shape family (≙ phi autotune/auto_tune_base.h)")
+define_flag("FLAGS_flash_tune_bwd_split", True,
+            "autotune backward (dq/dkv) flash block sizes separately from "
+            "the forward's instead of reusing the forward winner")
+define_flag("FLAGS_flce_chunk_axis", "auto",
+            "fused_linear_cross_entropy chunk axis: vocab | tokens | auto "
+            "(auto = vocab when a multiple-of-128 divisor exists, else "
+            "tokens — tools/sweep_ce_chunk.py measures the ladder)")
+define_flag("FLAGS_flce_token_chunk", 1024,
+            "token-chunk size for the sequence-chunked fused CE path "
+            "(tokens per [chunk, H] @ [H, V] GEMM; <= 0 disables)")
 
 
 # the full reference flag surface (compat entries; must come after the

@@ -22,9 +22,46 @@ def _is_diff(t) -> bool:
             and dtypes.is_floating_point(t.dtype))
 
 
+def _resolve_remat_policy(policy):
+    """Map a policy spec to a jax.checkpoint policy callable. Strings name
+    the curated policies; a callable passes through (any
+    jax.checkpoint_policies.* combinator works)."""
+    import jax
+
+    if callable(policy):
+        return policy
+    if policy == "flash_resident":
+        # attention-resident remat: the flash-attention kernel outputs +
+        # softmax stats stay resident across fwd/bwd (checkpoint_name'd in
+        # ops/pallas_attention.py), everything else — qkv/o/MLP GEMMs,
+        # norms, rope, residual adds — rematerializes in the backward. The
+        # backward never re-runs the forward flash kernel, which full-block
+        # remat pays once per layer (PERF.md round 6).
+        from ....ops.pallas_attention import FLASH_RESIDUAL_NAMES
+
+        return jax.checkpoint_policies.save_only_these_names(
+            *FLASH_RESIDUAL_NAMES)
+    if policy == "nothing":
+        return jax.checkpoint_policies.nothing_saveable
+    if policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(
+        f"unknown recompute policy {policy!r}; expected 'flash_resident', "
+        "'nothing', 'dots' or a jax.checkpoint_policies callable")
+
+
 def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
-              **kwargs):
-    """Run `function(*args)` without storing activations; recompute in backward."""
+              policy=None, **kwargs):
+    """Run `function(*args)` without storing activations; recompute in backward.
+
+    policy: optional jax.checkpoint rematerialization policy (string name or
+    jax.checkpoint_policies callable). With a policy the forward runs under
+    `jax.vjp(jax.checkpoint(f, policy=...))` ONCE at call time and the
+    policy-selected residuals are kept; the backward replays only the
+    non-saved part of the traced computation. 'flash_resident' keeps the
+    Pallas flash-attention outputs resident while rematerializing the cheap
+    GEMM/pointwise chains (≙ PaddleNLP recompute_granularity ladder's
+    core_attn tier, done with names instead of module boundaries)."""
     if not grad_enabled():
         return function(*args, **kwargs)
 
@@ -63,21 +100,46 @@ def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
                 t._data = d
             _rng._state()._data = saved_rng
 
-    with no_grad():
-        out_datas, single = run([t._data for t in diff_inputs])
-
     import jax
 
-    def vjp_fn(cot):
+    if policy is not None:
+        # policy mode: trace NOW through jax.checkpoint so the policy keeps
+        # its named residuals (e.g. flash outputs) from the ORIGINAL
+        # forward; backward replays only the non-saved computation. The
+        # no-policy path below instead defers jax.vjp to backward time and
+        # holds zero residuals.
+        pol = _resolve_remat_policy(policy)
+        single_cell = []
+
         def f(*dd):
-            datas, _ = run(list(dd))
+            datas, single = run(list(dd))
+            if not single_cell:
+                single_cell.append(single)
             return tuple(datas)
 
-        primals = [t._data for t in diff_inputs]
         with no_grad():
-            _, vjp = jax.vjp(f, *primals)
+            outs_t, vjp0 = jax.vjp(jax.checkpoint(f, policy=pol),
+                                   *[t._data for t in diff_inputs])
+        out_datas, single = list(outs_t), single_cell[0]
+
+        def vjp_fn(cot):
             cots = (cot,) if single else tuple(cot)
-            return vjp(cots)
+            with no_grad():
+                return vjp0(tuple(cots))
+    else:
+        with no_grad():
+            out_datas, single = run([t._data for t in diff_inputs])
+
+        def vjp_fn(cot):
+            def f(*dd):
+                datas, _ = run(list(dd))
+                return tuple(datas)
+
+            primals = [t._data for t in diff_inputs]
+            with no_grad():
+                _, vjp = jax.vjp(f, *primals)
+                cots = (cot,) if single else tuple(cot)
+                return vjp(cots)
 
     avals = [(d.shape, d.dtype) for d in out_datas]
     node = GradNode(vjp_fn, diff_inputs, avals, single, "recompute")

@@ -34,6 +34,15 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
+def _axis_size(axis_name) -> int:
+    """Static size of a bound mesh axis. jax >= 0.5 has lax.axis_size;
+    on 0.4.x psum over a Python int constant-folds to the same value."""
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:  # pragma: no cover — jax 0.4.x
+        return int(jax.lax.psum(1, axis_name))
+
+
 def _block_scores(q, k, scale):
     # q: [B,H,Sq,D] k: [B,H,Sk,D] -> f32 [B,H,Sq,Sk]
     return jax.lax.dot_general(
@@ -47,7 +56,7 @@ def ring_attention(q, k, v, axis_name, causal=False):
     global [B, S, H, D]; shard i holds rows [i*S_local, (i+1)*S_local).
     Must run where `axis_name` is bound (inside shard_map over the sep axis).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     # internal layout [B,H,S,D]
     qt = jnp.swapaxes(q, 1, 2)
@@ -103,7 +112,7 @@ def ulysses_attention(q, k, v, axis_name, causal=False):
     """All-to-all sequence parallelism: re-shard seq->heads, dense local
     attention over the FULL sequence on num_heads/sep heads, re-shard back.
     q,k,v: [B, S_local, H, D]; requires H % sep_degree == 0."""
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     if q.shape[2] % n:
         raise ValueError(f"ulysses needs heads % sep == 0, got {q.shape[2]} % {n}")
     if k.shape[2] != q.shape[2]:  # GQA: expand kv heads before the transpose

@@ -1,13 +1,27 @@
-"""Fused linear + softmax-cross-entropy over vocab chunks.
+"""Fused linear + softmax-cross-entropy over vocab OR token chunks.
 
 Reference parity: the fusion-library's softmax-with-cross-entropy kernels
 (/root/reference/paddle/phi/kernels/fusion/, cross_entropy_with_softmax) —
 the memory-bound tail of an LLM train step. TPU-native design: the lm_head
-GEMM and the CE reduction run chunk-by-chunk over the vocab inside one
-`lax.scan`, so the [tokens, vocab] logits tensor is NEVER materialized in
-HBM (at [16k, 32k] fp32 that is ~2 GB of traffic saved per direction);
-forward keeps only the online logsumexp state, backward recomputes each
-chunk's logits and emits (softmax - onehot) chunk-wise via a custom vjp.
+GEMM and the CE reduction run chunk-by-chunk inside one `lax.scan`, so the
+[tokens, vocab] logits tensor is NEVER materialized in HBM (at [16k, 32k]
+fp32 that is ~2 GB of traffic saved per direction); forward keeps only the
+per-token logsumexp, backward recomputes each chunk's logits and emits
+(softmax - onehot) chunk-wise via a custom vjp.
+
+Two chunk axes, same contract:
+
+  vocab-chunked (the round-4 path) — scan over vocab slices with an online
+  logsumexp; needs a multiple-of-128 divisor of the vocab (32000 -> 6400),
+  so vocabs like GPT's 50304 used to fall back to the full logits buffer.
+
+  token-chunked (round 6) — scan over TOKEN slices: each chunk runs one
+  [chunk, H] @ [H, V] GEMM in the operands' own dtype with f32 MXU
+  accumulation (bf16 stays bf16 in HBM — the [H, V] weight is never
+  upcast) and reduces its CE rows in f32. Works for ANY vocab — ragged
+  token counts pad with an ignored label — so the fused path now also
+  covers vocab 50304. Chunk size is the FLAGS_flce_token_chunk sweep knob
+  (tools/sweep_ce_chunk.py measures the ladder on the chip).
 """
 from __future__ import annotations
 
@@ -104,14 +118,99 @@ def _flce_bwd(chunk, ignore_index, res, g):
 _flce.defvjp(_flce_fwd, _flce_bwd)
 
 
+# ------------------------------------------------- token-chunked variant
+
+def _dot_f32(a, b, dims):
+    """dot_general in the operands' common dtype with f32 accumulation —
+    bf16 operands hit the MXU at full rate and the [H, V] weight is never
+    upcast to f32 in HBM (the vocab path pays that upcast per chunk)."""
+    ct = jnp.promote_types(a.dtype, b.dtype)
+    return jax.lax.dot_general(a.astype(ct), b.astype(ct), (dims, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flce_tok(h, w, labels, chunk_n, ignore_index):
+    loss, _ = _flce_tok_fwd_impl(h, w, labels, chunk_n, ignore_index)
+    return loss
+
+
+def _flce_tok_fwd_impl(h, w, labels, chunk_n, ignore_index):
+    n, hid = h.shape
+    v = w.shape[1]
+    nchunks = n // chunk_n
+
+    def step(_, i):
+        hc = jax.lax.dynamic_slice(h, (i * chunk_n, 0), (chunk_n, hid))
+        logits = _dot_f32(hc, w, ((1,), (0,)))             # [cn, V] f32
+        m = jnp.max(logits, axis=-1)
+        lse_c = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1))
+        lab_c = jax.lax.dynamic_slice(labels, (i * chunk_n,), (chunk_n,))
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(lab_c, 0, v - 1)[:, None], axis=1)[:, 0]
+        return None, (lse_c, picked)
+
+    _, (lses, picks) = jax.lax.scan(step, None, jnp.arange(nchunks))
+    lse = lses.reshape(-1)                                 # [N] f32
+    lab_logit = picks.reshape(-1)
+    valid = _valid_mask(labels, ignore_index)
+    count = jnp.maximum(jnp.sum(valid), 1).astype(jnp.float32)
+    # invalid rows picked a clipped label; the where() discards them
+    loss = jnp.sum(jnp.where(valid, lse - lab_logit, 0.0)) / count
+    return loss, (h, w, labels, lse)
+
+
+def _flce_tok_fwd(h, w, labels, chunk_n, ignore_index):
+    loss, res = _flce_tok_fwd_impl(h, w, labels, chunk_n, ignore_index)
+    return loss, res
+
+
+def _flce_tok_bwd(chunk_n, ignore_index, res, g):
+    h, w, labels, lse = res
+    n, hid = h.shape
+    v = w.shape[1]
+    nchunks = n // chunk_n
+    valid = _valid_mask(labels, ignore_index)
+    count = jnp.maximum(jnp.sum(valid), 1).astype(jnp.float32)
+    scale = (g / count) * valid.astype(jnp.float32)        # [N]
+
+    def step(carry, i):
+        dh, dw = carry
+        hc = jax.lax.dynamic_slice(h, (i * chunk_n, 0), (chunk_n, hid))
+        logits = _dot_f32(hc, w, ((1,), (0,)))             # recompute [cn, V]
+        lse_c = jax.lax.dynamic_slice(lse, (i * chunk_n,), (chunk_n,))
+        p = jnp.exp(logits - lse_c[:, None])
+        lab_c = jax.lax.dynamic_slice(labels, (i * chunk_n,), (chunk_n,))
+        onehot = jax.nn.one_hot(jnp.clip(lab_c, 0, v - 1), v,
+                                dtype=jnp.float32)
+        sc = jax.lax.dynamic_slice(scale, (i * chunk_n,), (chunk_n,))
+        # rows with sc == 0 (ignored/padded) zero out the clipped onehot too
+        dlog = ((p - onehot) * sc[:, None]).astype(w.dtype)  # [cn, V]
+        dh_c = _dot_f32(dlog, w, ((1,), (1,)))             # [cn, H] f32
+        dh = jax.lax.dynamic_update_slice(
+            dh, dh_c.astype(h.dtype), (i * chunk_n, 0))
+        dw = dw + _dot_f32(hc, dlog, ((0,), (0,)))         # [H, V] f32 acc
+        return (dh, dw), None
+
+    dh0 = jnp.zeros((n, hid), h.dtype)
+    dw0 = jnp.zeros((hid, v), jnp.float32)
+    (dh, dw), _ = jax.lax.scan(step, (dh0, dw0), jnp.arange(nchunks))
+    return dh, dw.astype(w.dtype), None
+
+
+_flce_tok.defvjp(_flce_tok_fwd, _flce_tok_bwd)
+
+
 def _best_chunk(v, chunk_size):
     """Pick the vocab chunk: the requested chunk_size when it divides v
     exactly; otherwise the largest multiple-of-128 (TPU lane width) divisor
     of v that keeps the scan <= 64 chunks — vocab 32000 @ 8192 -> 6400
     (5 chunks). Returns 0 when no such divisor exists (e.g. 50304, whose
     only small multiple-of-128 divisor is 384 — 131 tiny GEMMs would waste
-    the MXU — so the caller falls back to the plain logits path)."""
+    the MXU — so the caller switches to the token-chunked path)."""
     cs = min(int(chunk_size), v)
+    if cs <= 0:
+        return 0
     if v % cs == 0:
         return cs
     best = 0
@@ -122,17 +221,53 @@ def _best_chunk(v, chunk_size):
 
 
 def fused_linear_cross_entropy(hidden, weight, labels, chunk_size=8192,
-                               ignore_index=-100, name=None):
+                               ignore_index=-100, name=None,
+                               chunk_axis=None, token_chunk=None):
     """loss = mean CE(softmax(hidden @ weight), labels) without ever
     materializing the [tokens, vocab] logits, excluding ignore_index (and
     any negative) labels from both the loss mean and the gradient. hidden
-    [..., H] flattens to [N, H]; weight [H, V]; labels [...] int. Falls
-    back to the plain path when no good vocab chunking exists."""
+    [..., H] flattens to [N, H]; weight [H, V]; labels [...] int.
+
+    chunk_axis: "vocab" (online-lse over vocab slices), "tokens" (full-
+    vocab GEMM per token slice), or None/"auto" — FLAGS_flce_chunk_axis
+    decides, preferring the vocab path when a good multiple-of-128 divisor
+    exists and the token path otherwise (50304-style vocabs stay fused
+    instead of falling back to full logits). token_chunk defaults to
+    FLAGS_flce_token_chunk (the tools/sweep_ce_chunk.py knob). Setting
+    chunk_size <= 0 with chunk_axis="vocab" forces the unfused full-logits
+    path (the sweep baseline)."""
     from ....core.dispatch import op_call
+    from ....core.flags import flag
     from ....nn import functional as F
 
     v = int(weight.shape[-1])
+    axis = chunk_axis or str(flag("FLAGS_flce_chunk_axis"))
+    if token_chunk is None:
+        token_chunk = int(flag("FLAGS_flce_token_chunk"))
     chunk = _best_chunk(v, chunk_size)
+    if axis == "auto":
+        axis = "vocab" if chunk else "tokens"
+    if axis == "tokens" and token_chunk > 0:
+        # honor the requested size exactly (tools/sweep_ce_chunk.py measures
+        # unclamped sizes — a deployed flag must reproduce the sweep)
+        cn = min(int(token_chunk), 1 << 20)
+
+        def fn_tok(h2, w2, lab):
+            hh = h2.reshape(-1, h2.shape[-1])
+            ll = lab.reshape(-1).astype(jnp.int32)
+            n = hh.shape[0]
+            c = min(cn, n)
+            pad = (-n) % c
+            if pad:
+                # padded rows carry a negative label -> excluded from the
+                # mean, zero-scaled in the gradient; jnp.pad's transpose
+                # slices their dh rows back off automatically
+                hh = jnp.pad(hh, ((0, pad), (0, 0)))
+                ll = jnp.pad(ll, (0, pad), constant_values=-1)
+            return _flce_tok(hh, w2, ll, c, int(ignore_index))
+
+        return op_call(fn_tok, hidden, weight, labels,
+                       name="fused_linear_cross_entropy", n_diff=2)
     if not chunk:
         logits = hidden.reshape([-1, int(weight.shape[0])]).matmul(weight)
         return F.cross_entropy(logits, labels.reshape([-1]),

@@ -1054,9 +1054,19 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
     # form; multi-column start+end forms take the dense-mask path below
     if dropout == 0.0 and _jax.default_backend() == "tpu" and s >= 4096 \
             and s == sk_ and nc == 1:
-        from ...ops.pallas_attention import flashmask_attention_raw
+        from ...ops.pallas_attention import (ensure_tuned_flashmask,
+                                             flashmask_attention_raw)
 
         hq = int(query.shape[2])
+        qd = query._data if hasattr(query, "_data") else query
+        idxd = startend_row_indices._data \
+            if hasattr(startend_row_indices, "_data") else startend_row_indices
+        if not isinstance(qd, _jax.core.Tracer) \
+                and not isinstance(idxd, _jax.core.Tracer):
+            # pre-trace autotune (jit traces can only consult the cache)
+            ensure_tuned_flashmask(int(qd.shape[1]), int(qd.shape[1]),
+                                   int(qd.shape[3]), qd.dtype, causal,
+                                   idxd[..., 0])
 
         def f(q, k, v, idx):
             sr = idx[..., 0]                       # [B, Hm, S]

@@ -31,6 +31,11 @@ try:  # pltpu imports fail cleanly on backends without TPU support
 except ImportError:  # pragma: no cover
     pltpu = None
 
+try:  # jax >= 0.5 exposes the x64 context manager at top level
+    _enable_x64 = jax.enable_x64
+except AttributeError:  # pragma: no cover — 0.4.x
+    from jax.experimental import enable_x64 as _enable_x64
+
 # measured on v5e (b8 h16 s1024 d64): 128x128 blocks ran at 3.0 TFLOP/s —
 # grid-overhead/VPU-bound; 512x1024 reached 5.9 before mask specialization
 DEFAULT_BLOCK_Q = 512
@@ -211,7 +216,7 @@ def _flash_forward(q, k, v, causal, block_q, block_k, lens=None):
     # paddle_tpu runs jax with x64 enabled; trace the pallas program with
     # x64 OFF so index-map/kernel literals stay i32/f32 (Mosaic cannot
     # legalize stray i64/f64 values on real TPUs)
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         return _flash_forward_x32(q, k, v, causal, block_q, block_k, lens)
 
 
@@ -381,7 +386,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 
 def _flash_backward(q, k, v, o, lse_lanes, do, causal, block_q, block_k,
                     lens=None):
-    with jax.enable_x64(False):  # see _flash_forward
+    with _enable_x64(False):  # see _flash_forward
         return _flash_backward_x32(q, k, v, o, lse_lanes, do, causal,
                                    block_q, block_k, lens)
 
@@ -447,20 +452,46 @@ def _flash_backward_x32(q, k, v, o, lse_lanes, do, causal, block_q, block_k,
 
 # ----------------------------------------------------------- differentiable op
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, block_q, block_k):
+#: residual names consulted by the attention-resident remat policy
+#: (fleet recompute(policy="flash_resident")): under
+#: jax.checkpoint(save_only_these_names(*FLASH_RESIDUAL_NAMES)) the flash
+#: outputs + softmax stats are SAVED across fwd/bwd, so the rematerialized
+#: backward never re-runs the forward flash kernel — only the cheap
+#: surrounding GEMM/pointwise chains are recomputed (q/k/v regenerate from
+#: the qkv projections). Outside a checkpoint context checkpoint_name is
+#: the identity, so naming costs nothing on the normal path.
+FLASH_RESIDUAL_NAMES = ("flash_attn_out", "flash_attn_lse")
+
+
+def _name_flash_residuals(o, lse):
+    from jax.ad_checkpoint import checkpoint_name
+
+    return (checkpoint_name(o, FLASH_RESIDUAL_NAMES[0]),
+            checkpoint_name(lse, FLASH_RESIDUAL_NAMES[1]))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, bwd_block_q=None,
+           bwd_block_k=None):
+    # bwd_block_q/bwd_block_k: block sizes for the dq/dkv kernels — the
+    # backward's best block shape differs from the forward's at long
+    # sequence (round-6 autotune), defaulting to the forward's choice
     o, _ = _flash_forward(q, k, v, causal, block_q, block_k)
     return o
 
 
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, bwd_block_q=None,
+                    bwd_block_k=None):
     o, lse = _flash_forward(q, k, v, causal, block_q, block_k)
+    o, lse = _name_flash_residuals(o, lse)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(causal, block_q, block_k, res, g):
+def _flash_bwd_rule(causal, block_q, block_k, bwd_block_q, bwd_block_k,
+                    res, g):
     q, k, v, o, lse = res
-    return _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k)
+    return _flash_backward(q, k, v, o, lse, g, causal,
+                           bwd_block_q or block_q, bwd_block_k or block_k)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -474,6 +505,7 @@ def _flash_varlen(q, k, v, lens, causal, block_q, block_k):
 
 def _flash_varlen_fwd(q, k, v, lens, causal, block_q, block_k):
     o, lse = _flash_forward(q, k, v, causal, block_q, block_k, lens=lens)
+    o, lse = _name_flash_residuals(o, lse)
     return o, (q, k, v, o, lse, lens)
 
 
@@ -494,6 +526,41 @@ _TUNE_CACHE: dict = {}
 #: /root/reference/paddle/phi/kernels/autotune/auto_tune_base.h)
 _TUNE_CANDIDATES = ((512, 1024), (256, 1024), (512, 512), (1024, 1024),
                     (256, 512))
+#: long-sequence candidates (sq or sk >= 4096): the 512x1024 default was
+#: tuned at s1024 and is wrong at s4096/s8192 — longer kv blocks amortize
+#: the per-grid-step overhead over the much larger kv axis, and the probe
+#: machinery discards anything that overflows VMEM on this chip
+_TUNE_CANDIDATES_LONG = ((512, 1024), (1024, 1024), (512, 2048),
+                         (1024, 2048), (256, 2048), (2048, 1024),
+                         (512, 512))
+#: ceiling accepted from the DISK cache: a poisoned/corrupt entry may not
+#: force Mosaic failures (ADVICE round 5) — anything outside
+#: [128, _TUNE_BLOCK_MAX] multiples of 128 is dropped on load
+_TUNE_BLOCK_MAX = 4096
+
+
+def _tune_candidates(sq, sk):
+    return _TUNE_CANDIDATES_LONG if max(sq, sk) >= 4096 else _TUNE_CANDIDATES
+
+
+def _valid_blocks(vv):
+    """True iff vv is a loadable tune-cache value: a (block_q, block_k) or
+    (fwd_q, fwd_k, bwd_q, bwd_k) sequence of positive multiples of 128 no
+    larger than _TUNE_BLOCK_MAX (the validated shape of every candidate the
+    tuner itself can emit)."""
+    if not isinstance(vv, (list, tuple)) or len(vv) not in (2, 4):
+        return False
+    return all(isinstance(x, int) and not isinstance(x, bool)
+               and 0 < x <= _TUNE_BLOCK_MAX and x % 128 == 0 for x in vv)
+
+
+def _norm4(hit):
+    """Normalize a tune-cache value to the 4-tuple (fwd_q, fwd_k, bwd_q,
+    bwd_k) contract — legacy 2-element entries reuse the fwd pair for the
+    backward. None passes through (caller falls back to defaults)."""
+    if hit is None:
+        return None
+    return tuple(hit) if len(hit) == 4 else (*hit, *hit)
 #: probe failures that mean "this candidate doesn't compile/fit here"
 #: (Mosaic lowering rejections, VMEM overflow) — anything else propagates
 try:
@@ -504,15 +571,37 @@ _PROBE_ERRORS = (ValueError, NotImplementedError, _PROBE_RT_ERROR)
 
 
 def _tune_cache_path():
-    """Disk location of the tune cache — next to the XLA compile cache so
-    a fresh process reuses both (no re-probe, no re-compile)."""
+    """Disk location of the tune cache. USER-scoped by default
+    (~/.cache/paddle_tpu) rather than the world-writable /tmp compile-cache
+    dir — a cross-user-poisoned entry must not be able to pin bad block
+    shapes (ADVICE round 5); override with PADDLE_TPU_TUNE_CACHE_DIR."""
     import os
 
-    base = jax.config.jax_compilation_cache_dir or "/tmp/jax_ccache"
-    return os.path.join(base, "flash_tune_cache.json")
+    base = os.environ.get("PADDLE_TPU_TUNE_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu")
+    return os.path.join(base, "flash_tune_cache_v2.json")
 
 
 _TUNE_DISK_LOADED = False
+
+
+def _parse_tune_entries(payload):
+    """{key-string: blocks} pairs -> validated {key-tuple: blocks-tuple}.
+    Keys are 'kind|sq|sk|d|dtype|causal'; values must pass _valid_blocks
+    (positive multiples of 128) — anything else is dropped, never raised:
+    a poisoned disk entry costs at most a re-tune."""
+    out = {}
+    if not isinstance(payload, dict):
+        return out
+    for ks, vv in payload.items():
+        try:
+            kind, sq, sk, d, dt, causal = ks.split("|")
+            key = (kind, int(sq), int(sk), int(d), dt, causal == "True")
+        except (ValueError, AttributeError):
+            continue
+        if _valid_blocks(vv):
+            out[key] = tuple(vv)
+    return out
 
 
 def _tune_cache_load():
@@ -521,22 +610,14 @@ def _tune_cache_load():
         return
     _TUNE_DISK_LOADED = True
     import json
-    import os
 
-    path = _tune_cache_path()
-    if not os.path.exists(path):
-        return
     try:
-        with open(path) as f:
-            for ks, vv in json.load(f).items():
-                sq, sk, d, dt, causal = ks.split("|")
-                _TUNE_CACHE.setdefault(
-                    (int(sq), int(sk), int(d), dt, causal == "True"),
-                    tuple(vv))
-    except (OSError, ValueError, TypeError, AttributeError):
-        # corrupt/concurrent write OR structurally-wrong-but-valid JSON
-        # (non-dict top level, non-list values): fall back to re-tuning
-        pass
+        with open(_tune_cache_path()) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return  # missing or corrupt/concurrent write: re-tune
+    for key, vv in _parse_tune_entries(payload).items():
+        _TUNE_CACHE.setdefault(key, vv)
 
 
 def _tune_cache_store():
@@ -547,8 +628,17 @@ def _tune_cache_store():
     path = _tune_cache_path()
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        payload = {"|".join(map(str, k)): list(v)
-                   for k, v in _TUNE_CACHE.items()}
+        # merge-on-store: re-load and union so concurrent tuners working on
+        # different shape families stop dropping each other's entries
+        # (ADVICE round 5); in-process results win on conflict
+        merged = {}
+        try:
+            with open(path) as f:
+                merged.update(_parse_tune_entries(json.load(f)))
+        except (OSError, ValueError):
+            pass
+        merged.update(_TUNE_CACHE)
+        payload = {"|".join(map(str, k)): list(v) for k, v in merged.items()}
         fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
         with os.fdopen(fd, "w") as f:
             json.dump(payload, f)
@@ -557,57 +647,92 @@ def _tune_cache_store():
         pass
 
 
+def _probe_time(fn, *args):
+    """Median-of-groups timing of a compiled probe (single 2-iteration
+    timings over the axon tunnel swing ±3x — bench.py:55). Returns inf when
+    the candidate doesn't compile/fit (Mosaic rejection, VMEM overflow)."""
+    import statistics
+    import time as _time
+
+    try:
+        out = fn(*args)
+        jax.device_get(jnp.ravel(out)[0])  # compile + warm
+        groups = []
+        for _ in range(3):
+            t0 = _time.perf_counter()
+            for _ in range(2):
+                out = fn(*args)
+            jax.device_get(jnp.ravel(out)[0])
+            groups.append(_time.perf_counter() - t0)
+        return statistics.median(groups)
+    except _PROBE_ERRORS:
+        return float("inf")
+
+
+def _rank_candidates(sq, sk, probe):
+    """Measure every (clamped, deduped) candidate pair with `probe(bq, bk)`
+    and return the fastest, or None when none compiled."""
+    cands = _tune_candidates(sq, sk)
+    seen = set()
+    best, best_t = None, float("inf")
+    for bq_c, bk_c in cands:
+        bq = min(bq_c, _ceil_to(sq, 128))
+        bk = min(bk_c, _ceil_to(sk, 128))
+        if (bq, bk) in seen:
+            continue  # clamping collapsed this candidate into an earlier one
+        seen.add((bq, bk))
+        dt = probe(bq, bk)
+        if dt < best_t:
+            best, best_t = (bq, bk), dt
+    return best
+
+
 def _autotune_blocks(q, k, v, causal):
-    """Pick (block_q, block_k) for this (sq, sk, d, dtype, causal) family.
-    Off the TPU (interpret mode) or when FLAGS_flash_autotune is off, the
-    measured v5e default is used. Probes run fwd+bwd per candidate on first
-    sighting using the bench median-of-groups protocol (single 2-iteration
-    timings over the axon tunnel swing ±3x — bench.py:55); the winner is
-    cached in-process AND on disk next to the XLA compile cache."""
+    """Pick (fwd_block_q, fwd_block_k, bwd_block_q, bwd_block_k) for this
+    (sq, sk, d, dtype, causal) family. Off the TPU (interpret mode) or when
+    FLAGS_flash_autotune is off, the measured v5e default is used.
+
+    Round-6 shape: candidates are SEQ-LENGTH-KEYED (the 512x1024 default
+    was tuned at s1024 and loses at s4096/s8192 where longer kv blocks
+    amortize grid overhead), and with FLAGS_flash_tune_bwd_split the
+    backward dq/dkv kernels are tuned separately — stage 1 ranks
+    forward-only probes, stage 2 ranks fwd+bwd probes with the forward
+    pinned to its winner (the bwd kernels' arithmetic-intensity profile
+    differs: 5 matmuls per block pair vs the forward's 2). Winners are
+    cached in-process AND in the user-scoped disk cache."""
     from ..core.flags import flag
 
     sq, sk, d = q.shape[2], k.shape[2], q.shape[3]
-    key = (sq, sk, d, str(q.dtype), causal)
-    hit = _TUNE_CACHE.get(key)
+    key = ("flash", sq, sk, d, str(q.dtype), causal)
+    hit = _norm4(_TUNE_CACHE.get(key))
     if hit is not None:
         return hit
     if _interpret() or isinstance(q, jax.core.Tracer) \
             or not flag("FLAGS_flash_autotune"):
-        return (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+        return (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+                DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
     _tune_cache_load()
-    hit = _TUNE_CACHE.get(key)
+    hit = _norm4(_TUNE_CACHE.get(key))
     if hit is not None:
         return hit
-    import statistics
-    import time as _time
 
-    best, best_t = (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K), float("inf")
-    for bq_c, bk_c in _TUNE_CANDIDATES:
-        bq = min(bq_c, _ceil_to(sq, 128))
-        bk = min(bk_c, _ceil_to(sk, 128))
-        if (bq, bk) in {(min(c[0], _ceil_to(sq, 128)),
-                         min(c[1], _ceil_to(sk, 128)))
-                        for c in _TUNE_CANDIDATES[:_TUNE_CANDIDATES.index(
-                            (bq_c, bk_c))]}:
-            continue  # clamping collapsed this candidate into an earlier one
-        try:
-            fn = jax.jit(lambda a, b, c2, _bq=bq, _bk=bk: jax.grad(
-                lambda aa: jnp.sum(_flash(aa, b, c2, causal, _bq, _bk)
-                                   .astype(jnp.float32)))(a))
-            out = fn(q, k, v)
-            jax.device_get(jnp.ravel(out)[0])  # compile + warm
-            groups = []
-            for _ in range(3):
-                t0 = _time.perf_counter()
-                for _ in range(2):
-                    out = fn(q, k, v)
-                jax.device_get(jnp.ravel(out)[0])
-                groups.append(_time.perf_counter() - t0)
-            dt = statistics.median(groups)
-        except _PROBE_ERRORS:
-            continue
-        if dt < best_t:
-            best, best_t = (bq, bk), dt
+    def probe_fwd(bq, bk):
+        fn = jax.jit(lambda a, b, c2: _flash(a, b, c2, causal, bq, bk))
+        return _probe_time(fn, q, k, v)
+
+    fwd = _rank_candidates(sq, sk, probe_fwd) \
+        or (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+    bwd = fwd
+    if flag("FLAGS_flash_tune_bwd_split"):
+        def probe_bwd(bq, bk):
+            fn = jax.jit(lambda a, b, c2: jax.grad(
+                lambda aa: jnp.sum(
+                    _flash(aa, b, c2, causal, fwd[0], fwd[1], bq, bk)
+                    .astype(jnp.float32)))(a))
+            return _probe_time(fn, q, k, v)
+
+        bwd = _rank_candidates(sq, sk, probe_bwd) or fwd
+    best = (*fwd, *bwd)
     _TUNE_CACHE[key] = best
     _tune_cache_store()
     return best
@@ -616,18 +741,24 @@ def _autotune_blocks(q, k, v, causal):
 def flash_attention_raw(q, k, v, causal=False,
                         block_q=None, block_k=None):
     """jax-level flash attention on [B, H, S, D] arrays (GQA expanded here).
-    block_q/block_k default to the per-shape autotuned choice."""
+    block_q/block_k default to the per-shape autotuned choice — the
+    autotuner keys candidates by sequence length and tunes the backward
+    dq/dkv block pair separately from the forward's (explicit block_q/
+    block_k pin BOTH directions, the pre-round-6 behavior)."""
     hq, hk = q.shape[1], k.shape[1]
     if hq != hk:
         rep = hq // hk
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
+    cap_q = _ceil_to(q.shape[2], 128)
+    cap_k = _ceil_to(k.shape[2], 128)
     if block_q is None or block_k is None:
-        tq, tk = _autotune_blocks(q, k, v, causal)
-        block_q = block_q or tq
-        block_k = block_k or tk
-    bq = min(block_q, _ceil_to(q.shape[2], 128))
-    bk = min(block_k, _ceil_to(k.shape[2], 128))
+        tq, tk, tbq, tbk = _autotune_blocks(q, k, v, causal)
+        return _flash(q, k, v, causal,
+                      min(block_q or tq, cap_q), min(block_k or tk, cap_k),
+                      min(block_q or tbq, cap_q), min(block_k or tbk, cap_k))
+    bq = min(block_q, cap_q)
+    bk = min(block_k, cap_k)
     return _flash(q, k, v, causal, bq, bk)
 
 
@@ -657,9 +788,13 @@ def ensure_tuned(b, h, sq, sk, d, dtype, causal):
     or with FLAGS_flash_autotune off."""
     from ..core.flags import flag
 
-    key = (sq, sk, d, str(jnp.dtype(dtype)), causal)
+    key = ("flash", sq, sk, d, str(jnp.dtype(dtype)), causal)
     if key in _TUNE_CACHE or _interpret() or not flag("FLAGS_flash_autotune"):
-        return _TUNE_CACHE.get(key, (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K))
+        hit = _norm4(_TUNE_CACHE.get(key))
+        if hit is not None:
+            return hit
+        return (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+                DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
     kk = jax.random.PRNGKey(0)
     # one head is enough to rank block choices; keeps probe cost tiny
     q = jax.random.normal(kk, (1, 1, sq, d), jnp.dtype(dtype))
@@ -1075,29 +1210,101 @@ def _fm_backward_x32(q, k, v, o, lse_lanes, do, start_rows, causal,
     return (dq[:, :, :sq, :d], dk[:, :, :sk, :d], dv[:, :, :sk, :d])
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flashmask(q, k, v, start_rows, causal, block_q, block_k):
-    with jax.enable_x64(False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flashmask(q, k, v, start_rows, causal, block_q, block_k,
+               bwd_block_q=None, bwd_block_k=None):
+    with _enable_x64(False):
         o, _ = _fm_forward_x32(q, k, v, start_rows, causal, block_q, block_k)
     return o
 
 
-def _flashmask_fwd(q, k, v, start_rows, causal, block_q, block_k):
-    with jax.enable_x64(False):
+def _flashmask_fwd(q, k, v, start_rows, causal, block_q, block_k,
+                   bwd_block_q=None, bwd_block_k=None):
+    with _enable_x64(False):
         o, lse = _fm_forward_x32(q, k, v, start_rows, causal,
                                  block_q, block_k)
+    o, lse = _name_flash_residuals(o, lse)
     return o, (q, k, v, o, lse, start_rows)
 
 
-def _flashmask_bwd(causal, block_q, block_k, res, g):
+def _flashmask_bwd(causal, block_q, block_k, bwd_block_q, bwd_block_k,
+                   res, g):
     q, k, v, o, lse, start_rows = res
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         dq, dk, dv = _fm_backward_x32(q, k, v, o, lse, g, start_rows,
-                                      causal, block_q, block_k)
+                                      causal, bwd_block_q or block_q,
+                                      bwd_block_k or block_k)
     return dq, dk, dv, jnp.zeros(start_rows.shape, jax.dtypes.float0)
 
 
 _flashmask.defvjp(_flashmask_fwd, _flashmask_bwd)
+
+
+def _autotune_blocks_fm(q, k, v, start_rows, causal):
+    """FlashMask twin of _autotune_blocks (cache kind 'flashmask'): the
+    block-sparse kernels' best shape depends on the mask's blocked fraction
+    as well as seq length, so they get their own probe family. Defaults
+    (512, 512) off-TPU/in-trace — smaller kv blocks keep skippable
+    granularity fine for sliding-window patterns."""
+    from ..core.flags import flag
+
+    sq, sk, d = q.shape[2], k.shape[2], q.shape[3]
+    key = ("flashmask", sq, sk, d, str(q.dtype), causal)
+    hit = _norm4(_TUNE_CACHE.get(key))
+    if hit is not None:
+        return hit
+    if _interpret() or isinstance(q, jax.core.Tracer) \
+            or isinstance(start_rows, jax.core.Tracer) \
+            or not flag("FLAGS_flash_autotune"):
+        return (DEFAULT_BLOCK_Q, 512, DEFAULT_BLOCK_Q, 512)
+    _tune_cache_load()
+    hit = _norm4(_TUNE_CACHE.get(key))
+    if hit is not None:
+        return hit
+
+    def probe_fwd(bq, bk):
+        fn = jax.jit(lambda a, b, c2, sr: _flashmask(a, b, c2, sr, causal,
+                                                     bq, bk))
+        return _probe_time(fn, q, k, v, start_rows)
+
+    fwd = _rank_candidates(sq, sk, probe_fwd) or (DEFAULT_BLOCK_Q, 512)
+    bwd = fwd
+    if flag("FLAGS_flash_tune_bwd_split"):
+        def probe_bwd(bq, bk):
+            fn = jax.jit(lambda a, b, c2, sr: jax.grad(
+                lambda aa: jnp.sum(
+                    _flashmask(aa, b, c2, sr, causal, fwd[0], fwd[1],
+                               bq, bk).astype(jnp.float32)))(a))
+            return _probe_time(fn, q, k, v, start_rows)
+
+        bwd = _rank_candidates(sq, sk, probe_bwd) or fwd
+    best = (*fwd, *bwd)
+    _TUNE_CACHE[key] = best
+    _tune_cache_store()
+    return best
+
+
+def ensure_tuned_flashmask(sq, sk, d, dtype, causal, start_rows):
+    """Eagerly autotune the FlashMask block choice for a shape family
+    BEFORE entering a trace (the functional flashmask_attention path runs
+    the kernel under jit, where only the cache can be consulted). Probes
+    one head with the caller's actual start rows so the blocked fraction
+    the tuner sees matches the workload; no-op off-TPU / on repeat shapes /
+    with FLAGS_flash_autotune off."""
+    from ..core.flags import flag
+
+    key = ("flashmask", sq, sk, d, str(jnp.dtype(dtype)), causal)
+    if key in _TUNE_CACHE or _interpret() or not flag("FLAGS_flash_autotune"):
+        hit = _norm4(_TUNE_CACHE.get(key))
+        if hit is not None:
+            return hit
+        return (DEFAULT_BLOCK_Q, 512, DEFAULT_BLOCK_Q, 512)
+    kk = jax.random.PRNGKey(0)
+    q = jax.random.normal(kk, (1, 1, sq, d), jnp.dtype(dtype))
+    k = jax.random.normal(kk, (1, 1, sk, d), jnp.dtype(dtype))
+    v = jax.random.normal(kk, (1, 1, sk, d), jnp.dtype(dtype))
+    sr = jnp.asarray(start_rows, jnp.int32)[:1, :1, :]
+    return _autotune_blocks_fm(q, k, v, sr, causal)
 
 
 def flashmask_attention_raw(q, k, v, start_rows, causal=False,
@@ -1107,7 +1314,18 @@ def flashmask_attention_raw(q, k, v, start_rows, causal=False,
     backward skip fully-blocked kv blocks in Pallas kernels; the backward
     reuses the forward's LSE so no [Sq,Sk] softmax is ever materialized
     (≙ the reference's fused fwd+bwd flashmask CUDA family,
-    nn/functional/flash_attention.py flashmask_attention)."""
-    bq = min(block_q or DEFAULT_BLOCK_Q, _ceil_to(q.shape[2], 128))
-    bk = min(block_k or 512, _ceil_to(k.shape[2], 128))
+    nn/functional/flash_attention.py flashmask_attention). Block sizes
+    default to the per-shape autotuned choice (cache kind 'flashmask',
+    fwd and bwd tuned separately); explicit block_q/block_k pin both."""
+    cap_q = _ceil_to(q.shape[2], 128)
+    cap_k = _ceil_to(k.shape[2], 128)
+    if block_q is None or block_k is None:
+        tq, tk, tbq, tbk = _autotune_blocks_fm(q, k, v, start_rows, causal)
+        return _flashmask(q, k, v, start_rows, causal,
+                          min(block_q or tq, cap_q),
+                          min(block_k or tk, cap_k),
+                          min(block_q or tbq, cap_q),
+                          min(block_k or tbk, cap_k))
+    bq = min(block_q, cap_q)
+    bk = min(block_k, cap_k)
     return _flashmask(q, k, v, start_rows, causal, bq, bk)

@@ -27,6 +27,29 @@ def _is_tracer(x) -> bool:
     return isinstance(x, jax.core.Tracer)
 
 
+#: test seam: called between the donating jitted update and the commit of
+#: its results (tests/test_flagship_perf.py raises KeyboardInterrupt here
+#: to prove the commit still lands)
+_interrupt_test_hook = None
+
+
+def _guarded_update(exe, args, commit):
+    """Run a DONATING jitted update and commit its results to the framework
+    tensors in a finally block. The inputs (params/moments) are donated —
+    dead the moment `exe` dispatches — so a KeyboardInterrupt landing
+    between the call returning and the last `_assign_raw` must not leave
+    optimizer state pointing at deleted buffers (ADVICE round 5): once
+    results exist, the commit runs even if the interrupt arrives first."""
+    out = None
+    try:
+        out = exe(*args)
+        if _interrupt_test_hook is not None:
+            _interrupt_test_hook()
+    finally:
+        if out is not None:
+            commit(out)
+
+
 def _build_executor(n, b1, b2, eps, decoupled, amsgrad, clip_norm, has_master):
     """Compile-once fused update. Positional buffer lists are donated:
     bases (fp32 master or param), low-precision params (master mode),
@@ -145,21 +168,24 @@ def fused_adam_step(opt, pgs, lr_data) -> bool:
     bases = [(m._data if m is not None else p._data)
              for p, m in zip(params, masters)]
     lo = [p._data for p in params] if has_master else []
-    new_bases, new_lo, new_ms, new_vs, new_vmaxs = exe(
-        bases, lo, [m._data for m in ms], [v._data for v in vs],
-        [vm._data for vm in vmaxs], [g._data for g in grads],
-        wds, lrfs, opt._step_t._data, lr_data)
 
-    for i, p in enumerate(params):
-        if has_master:
-            masters[i]._assign_raw(new_bases[i])
-            p._assign_raw(new_lo[i])
-        else:
-            p._assign_raw(new_bases[i])
-        ms[i]._assign_raw(new_ms[i])
-        vs[i]._assign_raw(new_vs[i])
-        if opt._amsgrad:
-            vmaxs[i]._assign_raw(new_vmaxs[i])
+    def commit(out):
+        new_bases, new_lo, new_ms, new_vs, new_vmaxs = out
+        for i, p in enumerate(params):
+            if has_master:
+                masters[i]._assign_raw(new_bases[i])
+                p._assign_raw(new_lo[i])
+            else:
+                p._assign_raw(new_bases[i])
+            ms[i]._assign_raw(new_ms[i])
+            vs[i]._assign_raw(new_vs[i])
+            if opt._amsgrad:
+                vmaxs[i]._assign_raw(new_vmaxs[i])
+
+    _guarded_update(
+        exe, (bases, lo, [m._data for m in ms], [v._data for v in vs],
+              [vm._data for vm in vmaxs], [g._data for g in grads],
+              wds, lrfs, opt._step_t._data, lr_data), commit)
     return True
 
 
@@ -247,15 +273,18 @@ def fused_momentum_step(opt, pgs, lr_data) -> bool:
     bases = [(m._data if m is not None else p._data)
              for p, m in zip(params, masters)]
     lo = [p._data for p in params] if has_master else []
-    new_bases, new_lo, new_vels = exe(
-        bases, lo, [v._data for v in vels], [g._data for g in grads],
-        wds, lrfs, lr_data)
 
-    for i, p in enumerate(params):
-        if has_master:
-            masters[i]._assign_raw(new_bases[i])
-            p._assign_raw(new_lo[i])
-        else:
-            p._assign_raw(new_bases[i])
-        vels[i]._assign_raw(new_vels[i])
+    def commit(out):
+        new_bases, new_lo, new_vels = out
+        for i, p in enumerate(params):
+            if has_master:
+                masters[i]._assign_raw(new_bases[i])
+                p._assign_raw(new_lo[i])
+            else:
+                p._assign_raw(new_bases[i])
+            vels[i]._assign_raw(new_vels[i])
+
+    _guarded_update(
+        exe, (bases, lo, [v._data for v in vels], [g._data for g in grads],
+              wds, lrfs, lr_data), commit)
     return True

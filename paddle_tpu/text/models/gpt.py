@@ -92,7 +92,19 @@ class GPTForCausalLM(nn.Layer):
         x = self.wte(input_ids) + self.wpe(pos)
         for blk in self.blocks:
             x = blk(x)
-        logits = self.lm_head(self.ln_f(x))
+        hidden = self.ln_f(x)
+        if labels is not None and not self.config.tensor_parallel and \
+                self.config.vocab_size >= 4096:
+            # fused lm_head+CE — 50304 has no usable multiple-of-128 vocab
+            # divisor, so this takes the TOKEN-chunked path (round 6):
+            # full-vocab GEMM per token slice, [tokens, 50304] logits never
+            # materialized (the plain path below spends ~412 MB of f32
+            # logits traffic per direction at b4 s1024)
+            from ...incubate.nn.functional import fused_linear_cross_entropy
+
+            return fused_linear_cross_entropy(
+                hidden, self.lm_head.weight, labels, chunk_size=8192)
+        logits = self.lm_head(hidden)
         if labels is not None:
             return F.cross_entropy(logits.reshape([-1, self.config.vocab_size]),
                                    labels.reshape([-1]), reduction="mean")

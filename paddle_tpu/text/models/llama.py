@@ -48,7 +48,12 @@ class LlamaConfig:
     # "full": rematerialize the whole decoder block (max memory savings,
     # recomputes flash attention in backward). "mlp": keep attention
     # activations resident and rematerialize only the MLP — saves one flash
-    # forward per layer in the backward at ~60 MB/layer extra residency
+    # forward per layer in the backward at ~60 MB/layer extra residency.
+    # "flash_resident": full-block remat under a jax.checkpoint policy that
+    # keeps ONLY the flash-attention outputs + softmax stats resident
+    # (~B·S·H bf16 per layer) while the qkv/o/MLP GEMM and pointwise chains
+    # rematerialize — near-"full" memory at "mlp"-like backward cost; the
+    # round-6 memory lever that unlocks flagship batch 4
     # (≙ PaddleNLP recompute_granularity full/full_attn/core_attn ladder)
     recompute_granularity: str = "full"
 
@@ -198,13 +203,17 @@ class LlamaModel(nn.Layer):
             from ...distributed.meta_parallel.sp_utils import ScatterOp
 
             x = ScatterOp.apply(x, axis=1)
-        full_remat = self.config.use_recompute and \
-            self.config.recompute_granularity == "full"
+        gran = self.config.recompute_granularity if self.config.use_recompute \
+            else None
         for layer in self.layers:
-            if full_remat:
+            if gran == "full":
                 from ...distributed.fleet.utils import recompute
 
                 x = recompute(layer, x, attn_mask)
+            elif gran == "flash_resident":
+                from ...distributed.fleet.utils import recompute
+
+                x = recompute(layer, x, attn_mask, policy="flash_resident")
             else:
                 x = layer(x, attn_mask)
         x = self.norm(x)
@@ -242,10 +251,12 @@ class LlamaForCausalLM(nn.Layer):
                 self.config.vocab_size >= 4096:
             # fused lm_head+CE: the [tokens, vocab] logits tensor is never
             # materialized (incubate/nn/functional/fused_loss.py) — the
-            # memory-bound tail of the train step. fused_linear_cross_entropy
-            # picks the largest multiple-of-128 chunk dividing the vocab
-            # (32000 -> 6400) and itself falls back to the plain path when
-            # no good chunking exists (e.g. GPT's 50304).
+            # memory-bound tail of the train step. The chunk axis follows
+            # FLAGS_flce_chunk_axis: "auto" picks the vocab-chunked path
+            # (32000 -> 6400) here and the token(sequence)-chunked path for
+            # vocabs with no good divisor (GPT's 50304); the token chunk
+            # size is the FLAGS_flce_token_chunk sweep knob
+            # (tools/sweep_ce_chunk.py).
             from ...incubate.nn.functional import fused_linear_cross_entropy
 
             return fused_linear_cross_entropy(

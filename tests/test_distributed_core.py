@@ -11,7 +11,11 @@ import jax
 import jax.numpy as jnp
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax >= 0.5
+    from jax import shard_map
+except ImportError:  # pragma: no cover — 0.4.x
+    from jax.experimental.shard_map import shard_map
 
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist

@@ -466,8 +466,8 @@ class TestTuneCachePersistence:
 
         path = str(tmp_path / "flash_tune_cache.json")
         monkeypatch.setattr(pa, "_tune_cache_path", lambda: path)
-        key = (1024, 1024, 64, "float32", True)
-        monkeypatch.setattr(pa, "_TUNE_CACHE", {key: (256, 512)})
+        key = ("flash", 1024, 1024, 64, "float32", True)
+        monkeypatch.setattr(pa, "_TUNE_CACHE", {key: (256, 512, 256, 1024)})
         pa._tune_cache_store()
 
         # "fresh process": empty in-memory cache, disk not yet loaded
@@ -475,11 +475,16 @@ class TestTuneCachePersistence:
         monkeypatch.setattr(pa, "_TUNE_DISK_LOADED", False)
         # off-interpret so _autotune_blocks takes the real tuning path; if
         # it re-probed, every candidate would fail on CPU (interpret=False)
-        # and it would fall back to the DEFAULT blocks, not (256, 512)
+        # and it would fall back to the DEFAULT blocks, not this pair
         monkeypatch.setattr(pa, "_interpret", lambda: False)
         q = jnp.zeros((1, 1, 1024, 64), jnp.float32)
         got = pa._autotune_blocks(q, q, q, True)
-        assert got == (256, 512)
+        assert got == (256, 512, 256, 1024)
+
+        # a legacy 2-element entry normalizes to (fwd, fwd)
+        monkeypatch.setattr(
+            pa, "_TUNE_CACHE", {key: (256, 512)})
+        assert pa._autotune_blocks(q, q, q, True) == (256, 512, 256, 512)
 
     @pytest.mark.parametrize("payload", [
         "{not json",                                  # invalid JSON

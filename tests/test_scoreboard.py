@@ -1,0 +1,83 @@
+"""Scoreboard integrity gate as a test (VERDICT r5 Weak #1).
+
+tools/check_scoreboard.py parses every throughput/TFLOP claim in README.md
++ PERF.md + BASELINE.md and asserts each matches the committed official
+record (BENCH_DETAILS.json) within tolerance. The regression case replays
+round 5's actual drift — "4914 img/s ... (`BENCH_DETAILS.json` lenet)"
+against a committed 2,086 — and asserts the gate catches it.
+"""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import check_scoreboard  # noqa: E402
+
+
+def test_repo_scoreboard_consistent():
+    failures = check_scoreboard.check()
+    assert failures == [], "\n".join(failures)
+
+
+def _mini_repo(tmp_path, perf_text, lenet_img_s=2085.58):
+    details = {"results": {"lenet": {"name": "lenet_mnist_dygraph",
+                                     "images_per_sec": lenet_img_s,
+                                     "step_ms": 61.4, "batch": 128,
+                                     "spread": 0.172}}}
+    (tmp_path / "BENCH_DETAILS.json").write_text(json.dumps(details))
+    (tmp_path / "PERF.md").write_text(perf_text)
+    (tmp_path / "README.md").write_text("# nothing\n")
+    return tmp_path
+
+
+def test_catches_round5_lenet_drift(tmp_path):
+    # the EXACT round-5 drift line (PERF.md:287 per the verdict)
+    repo = _mini_repo(tmp_path, (
+        "multi-tensor Momentum (`use_multi_tensor=True` ≙ merged_momentum_:"
+        " one\njitted donated update replaces ~10 per-param invocations/step)"
+        " → 4914\nimg/s, spread 0.007 (`BENCH_DETAILS.json` lenet)."
+        " Bit-identical to the\nper-param path.\n"))
+    failures = check_scoreboard.check(repo=str(repo))
+    assert len(failures) == 1
+    assert "4914" in failures[0] and "lenet" in failures[0]
+
+
+def test_accepts_matching_claim(tmp_path):
+    repo = _mini_repo(tmp_path, (
+        "LeNet dygraph runs at 2086 img/s, spread 0.172\n"
+        "(`BENCH_DETAILS.json` lenet).\n"))
+    assert check_scoreboard.check(repo=str(repo)) == []
+
+
+def test_arrow_lhs_is_not_a_claim(tmp_path):
+    # "A -> B unit": A is the prior round's number, only B is checked
+    repo = _mini_repo(tmp_path, (
+        "LeNet improved 999 → 2086 img/s this round\n"
+        "(`BENCH_DETAILS.json` lenet).\n"))
+    assert check_scoreboard.check(repo=str(repo)) == []
+
+
+def test_k_suffix_and_ranges(tmp_path):
+    repo = _mini_repo(tmp_path, (
+        "throughput ~2.0-2.1k img/s (`BENCH_DETAILS.json` lenet)\n"))
+    assert check_scoreboard.check(repo=str(repo)) == []
+
+
+def test_readme_wide_rule(tmp_path):
+    repo = _mini_repo(tmp_path, "nothing here\n")
+    (repo / "README.md").write_text(
+        "LeNet dygraph reaches 4914 img/s on one chip\n")
+    failures = check_scoreboard.check(repo=str(repo))
+    assert len(failures) == 1 and "README.md" in failures[0]
+
+
+def test_tolerance_is_tight_enough():
+    # 2x drift must never slip through the 5% tolerance
+    assert not check_scoreboard._matches(4914, 4914, [2085.58],
+                                         check_scoreboard.RTOL)
+    assert check_scoreboard._matches(2086, 2086, [2085.58],
+                                     check_scoreboard.RTOL)

@@ -1,0 +1,141 @@
+"""Scoreboard integrity gate (VERDICT r5 Weak #1 — third round of drift).
+
+Every throughput/TFLOP claim in README.md + PERF.md + BASELINE.md must match
+the committed official record (`BENCH_DETAILS.json`) within tolerance. Two
+rules:
+
+  1. CITATION-ANCHORED (all three docs): any line citing
+     `BENCH_DETAILS.json <config>` opens a +/-2-line window; every
+     throughput-unit number in the window must match a numeric field of the
+     cited config(s) — or of any config when the citation names no key.
+     This is exactly the check that would have caught round 5's "4,914
+     img/s ... (`BENCH_DETAILS.json` lenet)" against the committed 2,086.
+
+  2. README-WIDE: README.md is the current-state scoreboard, so every
+     throughput-unit number anywhere in it must match SOME numeric field
+     of the official record (historical tables live in BASELINE.md/PERF.md,
+     not README).
+
+Conventions understood: `19.9k` suffixes, `81-83k` ranges, `63.6 →` arrow
+prefixes (the left side of an arrow is the prior round's number — only the
+right side is a current claim), commas, `**bold**`/`~` decoration. Checked
+units: tokens/s(ec), tok/s, img/s, images/sec, seq/s(ec), TFLOP/s. Times
+(ms), bandwidth and memory figures are derived quantities and out of scope.
+
+Run directly (exit 1 on drift) or via tests/test_scoreboard.py (quick tier).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOCS = ("README.md", "PERF.md", "BASELINE.md")
+RTOL = 0.05  # docs round aggressively ("19.9k" for 19,925)
+
+_UNIT = (r"(?:tokens?/s(?:ec)?|tok/s|img/s|images?/sec|img/sec|"
+         r"seq/s(?:ec)?|sequences/sec|TFLOP/s)")
+_NUM = r"\d[\d,]*(?:\.\d+)?"
+#: a number (or a-b range) with optional k suffix, immediately followed by a
+#: checked unit; leading ~ / ** decoration tolerated
+_CLAIM = re.compile(
+    rf"[~*]*({_NUM})(?:\s*[-–]\s*({_NUM}))?(k?)[*]*\s*({_UNIT})\b")
+#: "<number> →" / "<number> ->": the left side of an improvement arrow is
+#: the PRIOR round's value, not a claim about the current record
+_ARROW_LHS = re.compile(rf"{_NUM}k?\s*(?:→|->)")
+_CITE = re.compile(r"BENCH_DETAILS\.json[`'\"]*[\s,]*((?:[a-z0-9_]+)?)")
+
+
+def _leaves(obj, out):
+    if isinstance(obj, dict):
+        for v in obj.values():
+            _leaves(v, out)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _leaves(v, out)
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        out.append(float(obj))
+
+
+def _numbers_of(results, keys):
+    vals = []
+    for k in keys:
+        _leaves(results.get(k, {}), vals)
+    return [v for v in vals if v > 0]
+
+
+def _claims(text):
+    """(lo, hi, unit) claims in `text`, arrow left-hand sides removed."""
+    text = _ARROW_LHS.sub("", text)
+    out = []
+    for m in _CLAIM.finditer(text):
+        lo = float(m.group(1).replace(",", ""))
+        hi = float(m.group(2).replace(",", "")) if m.group(2) else lo
+        if m.group(3) == "k":
+            lo, hi = lo * 1e3, hi * 1e3
+        out.append((lo, hi, m.group(4)))
+    return out
+
+
+def _matches(lo, hi, values, rtol):
+    return any(lo * (1 - rtol) <= v <= hi * (1 + rtol) for v in values)
+
+
+def check(repo=REPO, details_path=None, rtol=RTOL):
+    """Returns a list of failure strings (empty = scoreboard consistent)."""
+    details_path = details_path or os.path.join(repo, "BENCH_DETAILS.json")
+    with open(details_path) as f:
+        results = json.load(f).get("results", {})
+    all_keys = list(results)
+    failures = []
+    for doc in DOCS:
+        path = os.path.join(repo, doc)
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            lines = f.read().splitlines()
+        for i, line in enumerate(lines):
+            cites = _CITE.findall(line)
+            if not cites:
+                continue
+            keys = [c for c in cites if c in results]
+            window = "\n".join(lines[max(0, i - 2):i + 3])
+            values = _numbers_of(results, keys or all_keys)
+            for lo, hi, unit in _claims(window):
+                if not _matches(lo, hi, values, rtol):
+                    failures.append(
+                        f"{doc}:{i + 1}: claim '{lo:g}"
+                        + (f"-{hi:g}" if hi != lo else "")
+                        + f" {unit}' near citation of "
+                        + (f"{keys}" if keys else "BENCH_DETAILS.json")
+                        + " matches no committed value")
+        if doc == "README.md":
+            for i, line in enumerate(lines):
+                values = _numbers_of(results, all_keys)
+                for lo, hi, unit in _claims(line):
+                    if not _matches(lo, hi, values, rtol):
+                        failures.append(
+                            f"{doc}:{i + 1}: claim '{lo:g}"
+                            + (f"-{hi:g}" if hi != lo else "")
+                            + f" {unit}' matches no value in the committed "
+                            "official record (BENCH_DETAILS.json)")
+    return failures
+
+
+def main(argv=None):
+    failures = check()
+    for fl in failures:
+        print("SCOREBOARD DRIFT:", fl)
+    if failures:
+        print(f"{len(failures)} drifted claim(s); docs must quote "
+              "BENCH_DETAILS.json (the committed official record)")
+        return 1
+    print("scoreboard consistent: every checked doc claim matches "
+          "BENCH_DETAILS.json")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -24,17 +24,35 @@ def _sync(t):
     jax.device_get(jnp.ravel(t._data if hasattr(t, "_data") else t)[0])
 
 
-def main(batch=8, seq=1024, logdir="/tmp/llama_trace"):
+def main(batch=8, seq=1024, logdir="/tmp/llama_trace", config="168m",
+         remat="mlp"):
+    """config="168m" (default) profiles the proxy; config="1b" profiles the
+    REAL 1.14B flagship step (pass batch/remat to match the bench row, e.g.
+    `python tools/profile_llama.py 4 1024 /tmp/t 1b flash_resident`) — the
+    round-6 xplane capture that drives the PERF.md breakdown."""
     import paddle_tpu as paddle
     from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
 
     paddle.seed(0)
-    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
-                      intermediate_size=2816, num_hidden_layers=8,
-                      num_attention_heads=16, max_position_embeddings=seq)
+    if config == "1b":
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=20,
+                          num_attention_heads=16,
+                          max_position_embeddings=seq,
+                          use_recompute=True, recompute_granularity=remat)
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=8,
+                          num_attention_heads=16,
+                          max_position_embeddings=seq)
     model = LlamaForCausalLM(cfg)
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters())
+    if config == "1b":
+        # match the bench_llama_1b row: bf16 params + bf16 AdamW moments
+        model, opt = paddle.amp.decorate(model, opt, level="O2",
+                                         dtype="bfloat16",
+                                         master_weight=False)
     rs = np.random.RandomState(0)
     ids = paddle.to_tensor(rs.randint(0, 32000, (batch, seq)).astype("int64"))
     small = paddle.to_tensor(rs.randint(0, 32000, (1, 128)).astype("int64"))
@@ -77,4 +95,9 @@ def main(batch=8, seq=1024, logdir="/tmp/llama_trace"):
 
 
 if __name__ == "__main__":
-    main()
+    a = sys.argv[1:]
+    main(batch=int(a[0]) if len(a) > 0 else 8,
+         seq=int(a[1]) if len(a) > 1 else 1024,
+         logdir=a[2] if len(a) > 2 else "/tmp/llama_trace",
+         config=a[3] if len(a) > 3 else "168m",
+         remat=a[4] if len(a) > 4 else "mlp")
