@@ -341,6 +341,33 @@ def _is_dynamic(v) -> bool:
     return isinstance(v, (jax.Array, np.ndarray))
 
 
+def _bwd_used_mask(bwd_raw, dyn, cot):
+    """Which positions of `dyn` the deferred-vjp recompute actually reads.
+
+    Reverse liveness over the (untraced) bwd jaxpr: start from the output
+    vars, walk equations backwards, mark an equation's inputs live when any
+    of its outputs are. Equations with sub-jaxprs are treated atomically
+    (all inputs live) — conservative, never drops a needed operand. E.g.
+    add: nothing read (mask all-False); mul: both read. Returns None when
+    the jaxpr can't be built (unusual cotangents) — caller keeps all."""
+    try:
+        closed = jax.make_jaxpr(bwd_raw)(tuple(dyn), cot)
+    except Exception:
+        return None
+    jaxpr = closed.jaxpr
+    live = {v for v in jaxpr.outvars if isinstance(v, jax.core.Var)}
+    for eqn in reversed(jaxpr.eqns):
+        if any(ov in live for ov in eqn.outvars):
+            for iv in eqn.invars:
+                if isinstance(iv, jax.core.Var):
+                    live.add(iv)
+    return tuple(v in live for v in jaxpr.invars[:len(dyn)])
+
+
+def _dyn_sig(dyn):
+    return tuple((tuple(d.shape), str(d.dtype)) for d in dyn)
+
+
 def _has_float0(cot) -> bool:
     leaves = cot if isinstance(cot, (tuple, list)) else (cot,)
     return any(getattr(c, "dtype", None) == jax.dtypes.float0 for c in leaves)
@@ -401,7 +428,10 @@ def _build_entry(fn, datas, diff_idx, dyn_pos):
         _, vjp = jax.vjp(_primal_over(vals), *[vals[i] for i in diff_idx])
         return vjp(cot)
 
-    return ("grad", jax.jit(fwd), jax.jit(fwd_only), jax.jit(bwd))
+    # trailing dict: per-shape-signature mask of which dyn operands the vjp
+    # recompute actually reads (ADVICE r5: don't pin every forward operand
+    # until backward); filled lazily by _bwd_used_mask on first backward
+    return ("grad", jax.jit(fwd), jax.jit(fwd_only), jax.jit(bwd), bwd, {})
 
 
 def _cached_dispatch(fn, fn_id, name, datas, diff_idx, target,
@@ -447,15 +477,39 @@ def _cached_dispatch(fn, fn_id, name, datas, diff_idx, target,
         if kind == "nograd":
             return jitted(*dyn), None
         if defer and _hot_flags().defer_vjp:
-            fwd_only, bwd = defer
+            fwd_only, bwd, bwd_raw, masks = defer
             out = fwd_only(*dyn)
-            dyn_t = tuple(dyn)
+            # pin only the operands the vjp recompute reads (known after the
+            # first backward of this signature); unused positions are
+            # rebuilt as zeros at backward time — values can't matter, the
+            # bwd program provably never reads them
+            sig = _dyn_sig(dyn)
+            mask = masks.get(sig)
+            if mask is None:
+                kept = tuple(dyn)
+                avals = None
+            else:
+                kept = tuple(d if m else None for d, m in zip(dyn, mask))
+                avals = tuple(None if m else (d.shape, d.dtype)
+                              for d, m in zip(dyn, mask))
 
-            def deferred(cot, _b=bwd, _d=dyn_t):
+            def deferred(cot, _b=bwd, _k=kept, _a=avals, _raw=bwd_raw,
+                         _ms=masks, _sig=sig):
+                import jax.numpy as jnp
+
+                if _a is None:
+                    d = _k
+                    if not _has_float0(cot) and _sig not in _ms:
+                        m = _bwd_used_mask(_raw, d, cot)
+                        if m is not None:
+                            _ms[_sig] = m
+                else:
+                    d = tuple(k if k is not None else jnp.zeros(*a)
+                              for k, a in zip(_k, _a))
                 if _has_float0(cot):  # float0 can't cross a jit boundary
                     with jax.disable_jit():
-                        return _b(_d, cot)
-                return _b(_d, cot)
+                        return _b(d, cot)
+                return _b(d, cot)
 
             return out, deferred
         out, vjp_fn = jitted(*dyn)

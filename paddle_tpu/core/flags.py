@@ -76,7 +76,11 @@ define_flag("FLAGS_eager_defer_vjp", True,
             "eager grad ops run a lean fwd-only executable; the vjp is "
             "re-derived inside one jitted backward call (trades ~1 extra "
             "fwd of the op's FLOPs in backward for ~2x cheaper per-op "
-            "dispatch — see core/dispatch._build_entry)")
+            "dispatch — see core/dispatch._build_entry). Operand "
+            "retention: the deferred closure pins only the forward "
+            "operands the vjp recompute provably reads (per-signature "
+            "jaxpr liveness mask, computed on the first backward; until "
+            "then one closure pins all operands — see _bwd_used_mask)")
 define_flag("FLAGS_to_static_donate", True, "donate captured buffers in to_static")
 define_flag("FLAGS_to_static_segmented", True,
             "on graph break, run segmented lazy execution (compiled XLA "
@@ -148,6 +152,22 @@ define_flag("FLAGS_flce_chunk_axis", "auto",
 define_flag("FLAGS_flce_token_chunk", 1024,
             "token-chunk size for the sequence-chunked fused CE path "
             "(tokens per [chunk, H] @ [H, V] GEMM; <= 0 disables)")
+define_flag("FLAGS_dy2static", True,
+            "to_static capture-time AST rewrite of tensor-predicate "
+            "if/while/for into lax.cond/while_loop/scan "
+            "(jit/dy2static); off = pre-dy2static behavior (any "
+            "data-dependent control flow is a graph break)")
+define_flag("FLAGS_dy2static_speculate", True,
+            "during to_static discovery, abstractly trace the UNTAKEN "
+            "branch of converted ifs so tensors it reads are recorded as "
+            "captures instead of being baked as constants at trace time")
+define_flag("FLAGS_jit_debug_program", False,
+            "retain each to_static specialization's traceable closure so "
+            "CompiledFunction.program_text() can print its jaxpr (pins "
+            "the compile-call args; tests/tools only)")
+define_flag("FLAGS_lazy_break_sites", True,
+            "record the user file:line that forces each segmented-lazy "
+            "flush (graph-break sites, tools/report_graph_breaks.py)")
 
 
 # the full reference flag surface (compat entries; must come after the

@@ -138,7 +138,7 @@ class _VjpBox:
         from .dispatch import _apply_vjp
 
         if self.vjp is None:
-            self.seg.flush()
+            self.seg.flush(reason="backward")
             if self.vjp is None:
                 raise RuntimeError(
                     "lazy segment flush failed earlier (see the original "
@@ -184,6 +184,33 @@ def seg_cache_clear():
     _seg_hits = _seg_misses = 0
 
 
+import os as _os
+
+_PKG_DIR = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+
+
+def _user_site():
+    """file:line of the nearest stack frame OUTSIDE paddle_tpu — the user
+    code whose concretization forced this flush (a graph-break site for
+    tools/report_graph_breaks.py). Frames in generated dy2static code keep
+    their synthetic '<dy2static ...>' filename, which is still useful."""
+    import sys
+
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if fn.startswith("<dy2static"):
+            # generated code: report the ORIGINAL source file (the embedded
+            # "<dy2static /path/file.py:firstline>" tag)
+            orig = fn[len("<dy2static "):].rstrip(">")
+            return (f"{_os.path.basename(orig)} (in converted "
+                    f"'{f.f_code.co_name}')", f.f_code.co_name)
+        if not fn.startswith(_PKG_DIR):
+            return f"{_os.path.basename(fn)}:{f.f_lineno}", f.f_code.co_name
+        f = f.f_back
+    return "<unknown>", "<unknown>"
+
+
 class Segment:
     """One replayable run of staged ops → a single jitted XLA program."""
 
@@ -205,7 +232,7 @@ class Segment:
         return i
 
     # ------------------------------------------------------------ flush
-    def flush(self):
+    def flush(self, reason="concretization"):
         global _seg_hits, _seg_misses
         if self.flushed:
             return
@@ -216,6 +243,15 @@ class Segment:
             return
         if self.ctx is not None:
             self.ctx.segments_flushed += 1
+            from .flags import flag as _flag
+
+            # the end-of-call flush_all is the normal drain, not a graph
+            # break — only mid-call concretizations are break sites
+            if _flag("FLAGS_lazy_break_sites") and not self.ctx.closing:
+                loc, fn_name = _user_site()
+                self.ctx.break_sites.append(
+                    {"loc": loc, "in": fn_name, "kind": reason,
+                     "ops_in_segment": len(self.ops)})
         need_vjp = tuple(rec.vjp_box is not None for rec in self.ops)
         sig = (tuple(rec.key for rec in self.ops), need_vjp,
                tuple((tuple(a.shape), str(a.dtype)) for a in self.ext))
@@ -287,11 +323,15 @@ def _build_replay(opspecs, need_vjp):
 class LazyContext:
     """Active across one segmented to_static call."""
 
-    __slots__ = ("open_seg", "segments_flushed", "created")
+    __slots__ = ("open_seg", "segments_flushed", "created", "break_sites",
+                 "closing")
 
     def __init__(self):
         self.open_seg: Segment | None = None
         self.segments_flushed = 0
+        # graph-break bookkeeping: the user site that forced each flush
+        self.break_sites: list = []
+        self.closing = False
         # weakrefs of every Tensor holding staged LazyData — after the final
         # flush the caller swaps in the concrete buffers so no LazyData
         # leaks out of the segmented call (a leaked one would defeat the
@@ -304,8 +344,12 @@ class LazyContext:
         return self.open_seg
 
     def flush_all(self):
-        if self.open_seg is not None and not self.open_seg.flushed:
-            self.open_seg.flush()
+        self.closing = True
+        try:
+            if self.open_seg is not None and not self.open_seg.flushed:
+                self.open_seg.flush()
+        finally:
+            self.closing = False
 
     # -------------------------------------------------------------- stage
     def stage(self, fn, fn_key, name, datas, diff_idx, target):
@@ -334,7 +378,8 @@ class LazyContext:
                 if d.real is not None:
                     d = d.real
                 elif d.seg is not seg:
-                    d.seg.flush()   # cross-segment input: close the old one
+                    # cross-segment input: close the old one
+                    d.seg.flush(reason="cross-segment-input")
                     d = d.real
                 else:
                     if dtypes.is_complex(np.dtype(d.aval.dtype)):

@@ -36,6 +36,7 @@ class Tensor:
         "_hooks",
         "_dist_attr",
         "_buf_version",
+        "_seq",
         "__weakref__",
         "__dict__",
     )
@@ -70,6 +71,10 @@ class Tensor:
         self._node = None
         self._out_idx = 0
         Tensor._iid += 1
+        # creation-order stamp: dy2static uses it to tell tensors that
+        # existed BEFORE a converted branch ran (external reads to thread
+        # as op operands) from intermediates the branch itself created
+        self._seq = Tensor._iid
         self.name = f"tensor_{Tensor._iid}"
         self.persistable = False
         self._retain_grads = False

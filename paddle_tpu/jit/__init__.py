@@ -2,6 +2,7 @@ from .api import CompiledFunction, ignore_module, not_to_static, to_static
 from .save_load import load, save
 
 from .save_load import TranslatedLayer  # noqa: E402
+from . import dy2static  # noqa: E402 — ≙ paddle.jit.dy2static
 
 
 def enable_to_static(enable=True):

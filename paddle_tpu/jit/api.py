@@ -91,7 +91,7 @@ def _struct_key(struct):
 
 class _Specialization:
     __slots__ = ("captures", "ro_caps", "mut_caps", "executable", "out_struct",
-                 "n_out_leaves", "trace_muts")
+                 "n_out_leaves", "trace_muts", "debug")
 
 
 #: exception types that mean "this program can't be captured as one graph"
@@ -167,6 +167,12 @@ class CompiledFunction:
         # warm-up at full batch can exceed HBM long before the compiled,
         # donated program does). Prime with a tiny batch, then run big.
         self._share_discovery = share_discovery
+        # dy2static: the AST-rewritten capture function (lazily built) and
+        # its transform report; _break_reason records why capture fell back
+        self._cap_fn = None
+        self._dy2st_report = None
+        self._break_reason: str | None = None
+        self._last_break_sites: list = []
 
     # -- paddle API parity
     @property
@@ -175,6 +181,59 @@ class CompiledFunction:
 
     def concrete_program(self):
         return None
+
+    # -- dy2static capture function
+    def _capture_fn(self):
+        """The function all phases actually run: the dy2static AST rewrite
+        of self._fn when it applies (tensor-predicate if/while/for become
+        lax.cond/while_loop/scan at trace time, plain Python otherwise),
+        else self._fn unchanged."""
+        if self._cap_fn is None:
+            if flag("FLAGS_dy2static"):
+                from .dy2static import convert_to_static
+
+                self._cap_fn, self._dy2st_report = convert_to_static(self._fn)
+            else:
+                self._cap_fn = self._fn
+                from .dy2static.diagnostics import TransformReport
+
+                self._dy2st_report = TransformReport(
+                    getattr(self._fn, "__name__", "<callable>"))
+                self._dy2st_report.skip_reason = "FLAGS_dy2static disabled"
+        return self._cap_fn
+
+    def graph_break_report(self) -> dict:
+        """Capture-coverage introspection (tools/report_graph_breaks.py):
+        transform report, capture outcome, fallback reason, and — in
+        segmented mode — the concretization sites that split segments."""
+        self._capture_fn()
+        return {
+            "function": getattr(self._fn, "__name__", str(self._fn)),
+            "transform": self._dy2st_report,
+            "compiled": bool(self._cache) and not self._segmented
+            and not self._fallback_eager,
+            "segmented": self._segmented,
+            "eager": self._fallback_eager,
+            "break_reason": self._break_reason,
+            "break_sites": list(self._last_break_sites),
+            "segments": self._last_segments,
+        }
+
+    def program_text(self, key: str | None = None) -> str:
+        """Jaxpr of a compiled specialization (requires
+        FLAGS_jit_debug_program=1 at compile time). For asserting capture
+        properties — e.g. that a tensor `if` really lowered to `cond`."""
+        if not self._cache:
+            raise RuntimeError("program_text: nothing compiled yet")
+        spec = self._cache[key] if key is not None \
+            else next(iter(self._cache.values()))
+        dbg = getattr(spec, "debug", None)
+        if dbg is None:
+            raise RuntimeError(
+                "program_text needs FLAGS_jit_debug_program=1 before the "
+                "compiling call (paddle.set_flags)")
+        pure, avals = dbg
+        return str(jax.make_jaxpr(pure)(*avals))
 
     def __get__(self, instance, owner):
         if instance is None:
@@ -228,7 +287,9 @@ class CompiledFunction:
         shared = (self._share_discovery and key not in self._discovered
                   and self._discovered)
         if n == 0 and not shared:
-            return self._fn(*args, **kwargs)  # warm-up: lazy state creation
+            # warm-up: lazy state creation (already through the dy2static
+            # rewrite so all phases share one code path)
+            return self._capture_fn()(*args, **kwargs)
         if n == 1 and not shared:
             return self._discover(key, args, kwargs)
         spec = self._cache.get(key)
@@ -239,8 +300,9 @@ class CompiledFunction:
     # ------------------------------------------------------------ phases
     def _discover(self, key, args, kwargs):
         ctx = TraceContext("discover")
+        cap = self._capture_fn()
         with trace_context(ctx):
-            out = self._fn(*args, **kwargs)
+            out = cap(*args, **kwargs)
         self._discovered[key] = ctx
         return out
 
@@ -263,6 +325,7 @@ class CompiledFunction:
         spec.ro_caps = ro_caps
         spec.mut_caps = mut_caps
         holder = {}
+        cap_fn = self._capture_fn()
 
         def pure(arg_datas, ro_datas, mut_datas):
             tctx = TraceContext("trace", borrowed=borrowed)
@@ -279,7 +342,7 @@ class CompiledFunction:
                     arg_tensors.append(nt)
                 a, k = _unflatten(struct, arg_tensors)
                 with trace_context(tctx):
-                    out = self._fn(*a, **k)
+                    out = cap_fn(*a, **k)
                 out_leaves: list = []
                 out_struct = _flatten(out, out_leaves)
                 # mutations observed at trace time (superset-safe)
@@ -297,23 +360,31 @@ class CompiledFunction:
         arg_datas = [t._data for t in leaves]
         ro_datas = [t._data for t in ro_caps]
         mut_datas = [t._data for t in mut_caps]
+        from .dy2static.diagnostics import Dy2StFallback, classify_graph_break
+
         try:
             out_datas, mut_out = jitted(arg_datas, ro_datas, mut_datas)
-        except _GRAPH_BREAK_ERRORS as e:
+        except (Dy2StFallback,) + _GRAPH_BREAK_ERRORS as e:
+            fn_name = getattr(self._fn, "__name__", str(self._fn))
+            reason = classify_graph_break(e)
+            loc = getattr(e, "loc", None)
+            self._break_reason = (f"{loc}: {reason}" if loc else reason)
             if self._full_graph:
                 raise RuntimeError(
-                    f"to_static(full_graph=True): '{getattr(self._fn, '__name__', self._fn)}' "
-                    f"cannot be captured as one graph ({type(e).__name__}). "
-                    "Remove data-dependent Python control flow (use lax.cond/where) "
-                    "or pass full_graph=False to fall back to eager."
+                    f"to_static(full_graph=True): '{fn_name}' cannot be "
+                    f"captured as one graph — {self._break_reason}. "
+                    "Tensor-dependent if/while/for is captured "
+                    "automatically (lax.cond/while_loop/scan); this "
+                    "construct is one of the unsupported cases (run "
+                    "tools/report_graph_breaks.py for every site), or pass "
+                    "full_graph=False to fall back."
                 ) from e
             import warnings
 
             if flag("FLAGS_to_static_segmented"):
                 warnings.warn(
-                    f"to_static: graph break in "
-                    f"'{getattr(self._fn, '__name__', self._fn)}' "
-                    f"({type(e).__name__}); switching to segmented lazy "
+                    f"to_static: graph break in '{fn_name}' — "
+                    f"{self._break_reason}; switching to segmented lazy "
                     "execution — ops run as compiled XLA segments bridged "
                     "eagerly at each concretization point. Python-level side "
                     "effects before the break ran once during capture and "
@@ -323,16 +394,15 @@ class CompiledFunction:
                 a, k = _unflatten(struct, leaves)
                 return self._run_segmented(a, k)
             warnings.warn(
-                f"to_static: graph break in "
-                f"'{getattr(self._fn, '__name__', self._fn)}' "
-                f"({type(e).__name__}); falling back to eager execution. "
+                f"to_static: graph break in '{fn_name}' — "
+                f"{self._break_reason}; falling back to eager execution. "
                 "Tensor state from the failed capture was rolled back, but "
                 "Python-level side effects before the break ran once during "
                 "capture and will run again eagerly this call.",
                 stacklevel=3)
             self._fallback_eager = True
             a, k = _unflatten(struct, leaves)
-            return self._fn(*a, **k)
+            return self._capture_fn()(*a, **k)
 
         folded = getattr(holder.get("tctx"), "folded", None)
         if folded:
@@ -349,6 +419,13 @@ class CompiledFunction:
         spec.executable = jitted
         spec.out_struct = holder["out_struct"]
         spec.trace_muts = holder["trace_muts"]
+        spec.debug = None
+        if flag("FLAGS_jit_debug_program"):
+            def avals(ds):
+                return [jax.ShapeDtypeStruct(d.shape, d.dtype) for d in ds]
+
+            spec.debug = (pure, (avals(arg_datas), avals(ro_datas),
+                                 avals(mut_datas)))
         self._cache[key] = spec
         return self._finish(spec, out_datas, mut_out)
 
@@ -363,10 +440,12 @@ class CompiledFunction:
         from ..core.lazy import LazyContext, LazyData, lazy_context
 
         ctx = LazyContext()
+        cap = self._capture_fn()
         with lazy_context(ctx):
-            out = self._fn(*args, **kwargs)
+            out = cap(*args, **kwargs)
             ctx.flush_all()
         self._last_segments = ctx.segments_flushed
+        self._last_break_sites = list(ctx.break_sites)
         # swap concrete buffers into EVERY tensor staging created (params
         # mutated mid-call included) — a LazyData leaking into later eager
         # code would defeat the compiled-eager cache's dynamic-arg check

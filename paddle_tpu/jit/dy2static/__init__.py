@@ -1,0 +1,29 @@
+"""paddle_tpu.jit.dy2static — dynamic-to-static control-flow capture.
+
+Closes the one "partial" in the round-5 layer verdict: tensor-predicate
+`if`/`while`/`for` used to be a graph break that dropped `to_static` into
+segmented lazy execution; now an AST pass (transformer.py) rewrites them
+into functional `lax.cond`/`lax.while_loop`/`lax.scan` calls
+(control_flow.py) at capture time, so data-dependent control flow stays
+inside ONE XLA computation — no host round-trips, no per-segment dispatch.
+
+Reference parity: python/paddle/jit/dy2static/ (ProgramTranslator + the
+convert_* operators), re-imagined JAX-natively — no bytecode interpreter,
+no ProgramDesc; AST → functional control flow with branch-output pytree /
+dtype unification and explicit diagnostics when paths disagree
+(diagnostics.py). Unsupported constructs stay ordinary Python and fall
+back to the segmented-lazy executor with a one-line reason.
+"""
+from .control_flow import (case, cond, convert_for, convert_if,
+                           convert_range, convert_while, switch_case,
+                           while_loop)
+from .diagnostics import (Dy2StFallback, Site, TransformReport,
+                          UndefinedVarError, classify_graph_break)
+from .transformer import convert_to_static
+
+__all__ = [
+    "convert_to_static", "convert_if", "convert_while", "convert_for",
+    "convert_range", "cond", "while_loop", "case", "switch_case",
+    "Dy2StFallback", "TransformReport", "Site", "UndefinedVarError",
+    "classify_graph_break",
+]

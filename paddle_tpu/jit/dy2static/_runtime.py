@@ -1,0 +1,5 @@
+"""The `__dy2s` namespace injected into transformed functions — exactly the
+names generated code may reference, nothing else."""
+from .control_flow import (convert_for, convert_if, convert_range,  # noqa: F401
+                           convert_while)
+from .diagnostics import is_undef, undef  # noqa: F401

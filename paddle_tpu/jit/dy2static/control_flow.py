@@ -1,0 +1,857 @@
+"""Runtime control-flow conversion: Python `if`/`while`/`for` → functional
+`lax.cond` / `lax.while_loop` / `lax.scan`, with eager passthrough.
+
+This is the execution half of the dy2static subsystem (the AST half is
+transformer.py). The transformer rewrites every supported construct into a
+call of `convert_if`/`convert_while`/`convert_for` carrying explicit state:
+
+    (i, s, x) = __dy2s.convert_if(pred, true_fn, false_fn, (i, s, x),
+                                  ('i', 's', 'x'), n_stores, 'f.py:12')
+
+The threaded state is the STORED names (names the branch/body assigns);
+read-only values resolve through the branch-fn closures. At lowering time
+the preflight additionally DISCOVERS every externally-created tensor the
+body reads (including attribute reads like `self.weight` and module
+globals, which no name analysis can see) and threads those as extra op
+operands too — so the autograd tape attributes gradients through the
+captured construct exactly as it would through the equivalent eager ops.
+Only stored names are rebound from the op outputs.
+
+Dispatch per call:
+  * predicate is a concrete value (plain Python, eager Tensor, segmented
+    LazyData): plain Python control flow — `bool()` picks the branch /
+    drives the loop exactly as before. During the to_static DISCOVERY pass
+    the untaken `if` branch is additionally traced abstractly so tensors it
+    reads are still recorded as captures (both branches execute for real
+    once the program is traced).
+  * predicate is a jax tracer (to_static capture, or any enclosing jax
+    trace): the construct lowers to one `lax.cond`/`while_loop`/`scan`
+    through `op_call`, so it is ONE op on the tape and ONE region in the
+    jaxpr — no graph break.
+
+Anything unprovable raises `Dy2StFallback` with a one-line reason;
+jit/api.py turns that into the segmented-lazy fallback.
+
+Reference parity: python/paddle/jit/dy2static/convert_operators.py
+(convert_ifelse / convert_while_loop / convert_for), re-imagined on lax
+instead of ConditionalBlock/While program ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import (TraceContext, _DIFF_DTYPES, current_trace,
+                              grad_enabled, op_call, trace_context)
+from ...core.tensor import Tensor
+from .diagnostics import Dy2StFallback, UndefinedVarError, is_undef
+
+__all__ = ["convert_if", "convert_while", "convert_for", "convert_range",
+           "cond", "while_loop", "case", "switch_case"]
+
+
+# --------------------------------------------------------------- state trees
+# one pytree flattener for the whole jit package: Tensor leaves -> markers
+# (static leaves — numbers, None, modules, self — stay in the struct)
+from ..api import _TensorLeaf as _Leaf  # noqa: E402
+from ..api import _flatten as _flatten_state  # noqa: E402
+from ..api import _unflatten as _unflatten_state  # noqa: E402
+
+
+class _TSpec:
+    """Unified tensor-leaf spec of a construct output."""
+
+    __slots__ = ("shape", "dtype", "stop")
+
+    def __init__(self, shape, dtype, stop):
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.stop = stop
+
+    def __repr__(self):
+        return f"Tensor[{self.dtype.name}{list(self.shape)}]"
+
+
+def _strip_weak(d):
+    """Canonicalize a carry element: drop jax weak_type so loop carries
+    compare equal across iterations (weak-typed `x + 1` vs strong input)."""
+    return jax.lax.convert_element_type(d, d.dtype)
+
+
+def _is_traced_data(d) -> bool:
+    return isinstance(d, jax.core.Tracer)
+
+
+def _is_traced_value(v) -> bool:
+    if isinstance(v, Tensor):
+        return _is_traced_data(v._data)
+    return _is_traced_data(v)
+
+
+def _to_bool(pred) -> bool:
+    return bool(pred)
+
+
+def _wrap(d, like) -> Tensor:
+    return Tensor(d, _internal=True, stop_gradient=like.stop_gradient)
+
+
+def _pred_data(pred, loc, kind):
+    """Scalar bool data for a traced predicate (or a clear diagnostic)."""
+    d = pred._data if isinstance(pred, Tensor) else pred
+    if int(np.prod(d.shape)) != 1:
+        raise Dy2StFallback(
+            f"`{kind}` predicate has shape {list(d.shape)} — reduce it to a "
+            "scalar with .any()/.all() before branching", loc, kind,
+            "non-scalar-predicate")
+    d = d.reshape(())
+    if np.dtype(d.dtype) != np.dtype(bool):
+        d = d != 0
+    return d
+
+
+def _spec_leaves(spec, out: list):
+    if isinstance(spec, _TSpec):
+        out.append(spec)
+    elif isinstance(spec, (list, tuple)):
+        for v in spec:
+            _spec_leaves(v, out)
+    elif isinstance(spec, dict):
+        for v in spec.values():
+            _spec_leaves(v, out)
+    return out
+
+
+def _emit(spec, value, out: list):
+    """Collect raw output datas for every _TSpec position of `spec` from a
+    branch's actual output `value` (runtime, inside the lax trace)."""
+    if isinstance(spec, _TSpec):
+        d = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        if np.dtype(d.dtype) != spec.dtype:
+            d = d.astype(spec.dtype)
+        out.append(_strip_weak(d))
+    elif isinstance(spec, (list, tuple)):
+        for s, v in zip(spec, value):
+            _emit(s, v, out)
+    elif isinstance(spec, dict):
+        for k in spec:
+            _emit(spec[k], value[k], out)
+    return out
+
+
+def _rebuild(spec, it):
+    """Rebuild the Python state from a unified spec + an iterator over the
+    op's output Tensors (statics come from the spec itself)."""
+    if isinstance(spec, _TSpec):
+        return next(it)
+    if isinstance(spec, list):
+        return [_rebuild(v, it) for v in spec]
+    if isinstance(spec, tuple):
+        return tuple(_rebuild(v, it) for v in spec)
+    if isinstance(spec, dict):
+        return {k: _rebuild(v, it) for k, v in spec.items()}
+    return spec
+
+
+def _as_tuple_outs(out, n):
+    if n == 0:
+        return ()
+    if n == 1 and isinstance(out, Tensor):
+        return (out,)
+    return tuple(out)
+
+
+def _diffable(t: Tensor) -> bool:
+    return (not t.stop_gradient
+            and getattr(t._data, "dtype", None) in _DIFF_DTYPES)
+
+
+# ------------------------------------------------------- abstract preflight
+class _GuardCtx(TraceContext):
+    """Installed while a branch/body is traced abstractly. Three jobs:
+
+    * delegate reads to the ambient trace (folded-constant bookkeeping);
+    * DISCOVER external tensor reads: every tensor that existed before the
+      branch ran (creation stamp `_seq`) and holds an enclosing-trace
+      tracer is collected — the lowering threads these as explicit op
+      operands (buffer-swapped in during branch tracing) so the autograd
+      tape attributes gradients through the captured region even for
+      closure/attribute reads like `self.weight`;
+    * convert in-place tensor mutation — a side effect the functional
+      rewrite cannot thread — into a diagnostic, rolling the buffer back.
+    """
+
+    def __init__(self, ambient, loc, kind, seq0):
+        super().__init__("trace")
+        self.ambient = ambient
+        self.loc = loc
+        self.kind = kind
+        self.seq0 = seq0
+        self.reads: dict[int, Tensor] = {}
+        # id(tensor) -> (tensor, ORIGINAL buffer): only the first snapshot
+        # per tensor matters — restoring a later one would leave an
+        # intermediate (tracer) buffer behind
+        self.snap: dict[int, tuple] = {}
+
+    def on_read(self, tensor):
+        if _is_traced_data(tensor._data) and tensor._seq <= self.seq0:
+            self.reads.setdefault(id(tensor), tensor)
+        if self.ambient is not None:
+            self.ambient.on_read(tensor)
+
+    def on_mutate(self, tensor):
+        self.snap.setdefault(id(tensor), (tensor, tensor._data))
+        raise Dy2StFallback(
+            "in-place tensor update inside a converted "
+            f"`{self.kind}` body (e.g. add_/set_value/backward) — both "
+            "paths execute when captured, so the side effect cannot be "
+            "made conditional", self.loc, self.kind, "in-place-mutation")
+
+    def rollback(self):
+        for t, d in self.snap.values():
+            t._data = d
+        self.snap.clear()
+
+
+def _abstract_out(run, in_leaves, loc, kind, extra_avals=()):
+    """Trace `run(list-of-wrapped-leaf-tensors, *extra_datas)` abstractly.
+    Returns (output with tensor leaves replaced by _TSpec,
+    list-of-externally-read tensors)."""
+    box = {}
+    n_extra = len(extra_avals)
+
+    def absfn(*datas):
+        extras = datas[:n_extra]
+        ts = [_wrap(d, l) for d, l in zip(datas[n_extra:], in_leaves)]
+        out = run(ts, *extras)
+        ol: list = []
+        os = _flatten_state(out, ol)
+        box["struct"] = os
+        box["stops"] = [t.stop_gradient for t in ol]
+        return [t._data for t in ol]
+
+    guard = _GuardCtx(current_trace(), loc, kind, Tensor._iid)
+    try:
+        with trace_context(guard):
+            avals = jax.eval_shape(
+                absfn, *extra_avals,
+                *[jax.ShapeDtypeStruct(t._data.shape, t._data.dtype)
+                  for t in in_leaves])
+    except UndefinedVarError as e:
+        raise Dy2StFallback(str(e), loc, kind, "undefined-variable") from e
+    finally:
+        guard.rollback()
+
+    specs = [_TSpec(a.shape, a.dtype, s)
+             for a, s in zip(avals, box["stops"])]
+    return (_unflatten_state(box["struct"], specs),
+            list(guard.reads.values()))
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def _swapped(tensors, datas):
+    """Temporarily bind operand datas into externally-read tensors while a
+    branch/body is traced, so closure/attribute reads see the lax-region
+    tracers (the same pattern jit/api.py `pure` uses for captures)."""
+    saved = [(t, t._data) for t in tensors]
+    for t, d in zip(tensors, datas):
+        t._data = d
+    try:
+        yield
+    finally:
+        for t, d in saved:
+            t._data = d
+
+
+def _merge_reads(in_leaves, *read_lists):
+    seen = {id(t) for t in in_leaves}
+    out = []
+    for rl in read_lists:
+        for t in rl:
+            if id(t) not in seen:
+                seen.add(id(t))
+                out.append(t)
+    return out
+
+
+_PROMOTABLE = (int, float)
+
+
+def _unify(a, b, path, loc, kind):
+    """Merge two abstract branch outputs into one spec; mismatch raises a
+    Dy2StFallback naming the offending state variable."""
+    if is_undef(a) or is_undef(b):
+        if is_undef(a) and is_undef(b):
+            return a
+        u = a if is_undef(a) else b
+        raise Dy2StFallback(
+            f"'{u.name}' is assigned on only one path of the `{kind}` — "
+            "bind it on both paths (or before the statement)", loc, kind,
+            "one-sided-assignment")
+    ta, tb = isinstance(a, _TSpec), isinstance(b, _TSpec)
+    if ta and tb:
+        if a.shape != b.shape:
+            raise Dy2StFallback(
+                f"'{path}' has shape {list(a.shape)} on one path and "
+                f"{list(b.shape)} on the other — both paths of a captured "
+                f"`{kind}` must produce the same shape", loc, kind,
+                "shape-mismatch")
+        dt = jnp.promote_types(a.dtype, b.dtype)
+        return _TSpec(a.shape, dt, a.stop and b.stop)
+    if ta or tb:
+        spec, other = (a, b) if ta else (b, a)
+        if isinstance(other, _PROMOTABLE) and not isinstance(other, bool) \
+                and spec.shape == ():
+            dt = jnp.promote_types(spec.dtype, jnp.result_type(other))
+            return _TSpec((), dt, spec.stop)
+        raise Dy2StFallback(
+            f"'{path}' is a {spec!r} on one path and {type(other).__name__} "
+            f"({other!r}) on the other — wrap the non-tensor value with "
+            "paddle.to_tensor, or keep the variable the same kind on both "
+            f"paths of the `{kind}`", loc, kind, "tensor-vs-python-mismatch")
+    if (type(a) is tuple and type(b) is tuple) or \
+            (type(a) is list and type(b) is list):
+        if len(a) != len(b):
+            raise Dy2StFallback(
+                f"'{path}' has {len(a)} element(s) on one path and "
+                f"{len(b)} on the other", loc, kind, "structure-mismatch")
+        out = [_unify(x, y, f"{path}[{i}]", loc, kind)
+               for i, (x, y) in enumerate(zip(a, b))]
+        return tuple(out) if type(a) is tuple else out
+    if isinstance(a, dict) and isinstance(b, dict):
+        if set(a) != set(b):
+            raise Dy2StFallback(
+                f"'{path}' has keys {sorted(map(str, a))} on one path and "
+                f"{sorted(map(str, b))} on the other", loc, kind,
+                "structure-mismatch")
+        return {k: _unify(a[k], b[k], f"{path}[{k!r}]", loc, kind)
+                for k in a}
+    eq = a is b
+    if not eq:
+        try:
+            eq = type(a) is type(b) and bool(a == b)
+        except Exception:
+            eq = False
+    if not eq:
+        raise Dy2StFallback(
+            f"non-tensor '{path}' differs across paths of the captured "
+            f"`{kind}` ({a!r} vs {b!r}) — make it a tensor "
+            "(paddle.to_tensor) so the chosen value can live in the "
+            "compiled program", loc, kind, "python-value-divergence")
+    return a
+
+
+# --------------------------------------------------- speculative discovery
+def _speculate(run, state):
+    """During the to_static DISCOVERY pass, trace the UNTAKEN branch (or a
+    zero-iteration loop body) abstractly so tensors it reads are recorded
+    as captures — once compiled, both paths execute, and a parameter read
+    only by the untaken path must be a live program input, not a baked
+    constant. Buffer mutations are rolled back; all errors are swallowed
+    (this run is advisory)."""
+    from ...core.flags import flag
+
+    ambient = current_trace()
+    if ambient is None or ambient.phase != "discover" \
+            or not flag("FLAGS_dy2static_speculate"):
+        return
+
+    class _Spec(TraceContext):
+        def __init__(self):
+            super().__init__("discover")
+            # first snapshot per tensor = its pre-branch buffer; a tensor
+            # mutated twice must NOT be restored to the intermediate value
+            self.snap = {}
+
+        def on_read(self, tensor):
+            if not _is_traced_data(tensor._data):
+                ambient.captures.setdefault(id(tensor), tensor)
+
+        def on_mutate(self, tensor):
+            self.snap.setdefault(id(tensor), (tensor, tensor._data))
+
+    ctx = _Spec()
+    leaves: list = []
+    struct = _flatten_state(state, leaves)
+
+    def absfn(*datas):
+        ts = [_wrap(d, l) for d, l in zip(datas, leaves)]
+        run(_unflatten_state(struct, ts))
+        return 0
+
+    try:
+        with trace_context(ctx):
+            jax.eval_shape(
+                absfn, *[jax.ShapeDtypeStruct(t._data.shape, t._data.dtype)
+                         for t in leaves])
+    except Exception:
+        pass
+    finally:
+        for t, d in ctx.snap.values():
+            t._data = d
+
+
+# ------------------------------------------------------------------ if/else
+def convert_if(pred, true_fn, false_fn, state, names, n_stores, loc=None):
+    """Functionalized `if`: branch fns take and return the full state tuple.
+    Concrete predicate → plain Python; traced predicate → one lax.cond."""
+    if not _is_traced_value(pred):
+        taken, other = (true_fn, false_fn) if _to_bool(pred) \
+            else (false_fn, true_fn)
+        _speculate(other, state)
+        return tuple(taken(state))
+    return _lower_cond(pred, true_fn, false_fn, tuple(state), names,
+                       n_stores, loc)
+
+
+def _lower_cond(pred, true_fn, false_fn, state, names, n_stores, loc):
+    in_leaves: list = []
+    in_struct = _flatten_state(state, in_leaves)
+
+    def runner(branch_fn):
+        def run(ts):
+            out = branch_fn(_unflatten_state(in_struct, ts))
+            return tuple(out)[:n_stores]
+        return run
+
+    t_spec, t_reads = _abstract_out(runner(true_fn), in_leaves, loc, "if")
+    f_spec, f_reads = _abstract_out(runner(false_fn), in_leaves, loc, "if")
+    ext = _merge_reads(in_leaves, t_reads, f_reads)
+    uspec = tuple(
+        _unify(t, f, names[i], loc, "if")
+        for i, (t, f) in enumerate(zip(t_spec, f_spec)))
+    n_out = len(_spec_leaves(uspec, []))
+
+    read_state = state[n_stores:]
+    if n_out == 0:
+        # both paths only (re)bind equal non-tensor values — nothing to
+        # lower; the unified statics ARE the result
+        return _rebuild(uspec, iter(())) + read_state
+
+    pd = _pred_data(pred, loc, "if")
+    n_in = len(in_leaves)
+
+    def impl(pred_d, *datas):
+        state_d, ext_d = datas[:n_in], datas[n_in:]
+
+        def br(branch_fn):
+            def run(ops):
+                sd, ed = ops[:n_in], ops[n_in:]
+                ts = [_wrap(d, l) for d, l in zip(sd, in_leaves)]
+                with _swapped(ext, ed):
+                    out = runner(branch_fn)(ts)
+                    return tuple(_emit(uspec, out, []))
+            return run
+
+        return jax.lax.cond(pred_d, br(true_fn), br(false_fn),
+                            tuple(state_d) + tuple(ext_d))
+
+    outs = op_call(impl, Tensor(pd, _internal=True), *in_leaves, *ext,
+                   name="dy2st_cond")
+    outs = _as_tuple_outs(outs, n_out)
+    return _rebuild(uspec, iter(outs)) + read_state
+
+
+# -------------------------------------------------------------------- while
+def convert_while(cond_fn, body_fn, state, names, n_stores, loc=None):
+    """Functionalized `while`: cond_fn(state)->predicate,
+    body_fn(state)->state."""
+    state = tuple(state)
+    c = cond_fn(state)
+    if not _is_traced_value(c):
+        ran = 0
+        while _to_bool(c):
+            state = tuple(body_fn(state))
+            ran += 1
+            c = cond_fn(state)
+        if ran == 0:
+            _speculate(body_fn, state)
+        return state
+    return _lower_while(cond_fn, body_fn, state, names, n_stores, loc)
+
+
+def _lower_while(cond_fn, body_fn, state, names, n_stores, loc,
+                 allow_undef=False, kind="while"):
+    """allow_undef: permit loop variables unbound before the loop (carry
+    initialized with zeros of the body-output aval). Only sound when the
+    body provably assigns them before reading — which the UNDEF-propagating
+    preflight verifies — so it is enabled for the `for range(tensor)`
+    lowering (the loop target is assigned each iteration) and kept off for
+    raw `while`, where a zero-iteration run would expose the zeros."""
+    in_leaves: list = []
+    in_struct = _flatten_state(state, in_leaves)
+
+    def body_runner(ts):
+        out = body_fn(_unflatten_state(in_struct, ts))
+        return tuple(out)[:n_stores]
+
+    def cond_runner(ts):
+        return (cond_fn(_unflatten_state(in_struct, ts)),)
+
+    out_spec, body_reads = _abstract_out(body_runner, in_leaves, loc, kind)
+    _, cond_reads = _abstract_out(cond_runner, in_leaves, loc, kind)
+    ext = _merge_reads(in_leaves, body_reads, cond_reads)
+    flat_out = _spec_leaves(tuple(out_spec), [])
+
+    # carry init per stored name: while semantics demand out == in exactly
+    init_ts: list = []
+    for pos in range(n_stores):
+        v = state[pos]
+        specs = _spec_leaves(out_spec[pos], [])
+        if is_undef(v):
+            if not allow_undef:
+                raise Dy2StFallback(
+                    f"loop-carried variable '{names[pos]}' is not defined "
+                    "before the `while` — initialize it before the loop "
+                    "(the captured loop may run zero iterations)", loc,
+                    kind, "undefined-carry")
+            init_ts.extend(
+                Tensor(jnp.zeros(s.shape, s.dtype), _internal=True)
+                for s in specs)
+            continue
+        vl: list = []
+        _flatten_state(v, vl)
+        if len(vl) != len(specs):
+            raise Dy2StFallback(
+                f"loop variable '{names[pos]}' changes between tensor and "
+                f"non-tensor across `{kind}` iterations — keep loop state "
+                "tensors", loc, kind, "carry-mismatch")
+        # structural + static-value agreement (e.g. a python flag flipped
+        # inside the loop body gets its own diagnostic here)
+        _unify(_value_spec(v), out_spec[pos], names[pos], loc, kind)
+        for t, s in zip(vl, specs):
+            if tuple(t._data.shape) != s.shape or \
+                    np.dtype(t._data.dtype) != s.dtype:
+                raise Dy2StFallback(
+                    f"loop variable '{names[pos]}' changes from "
+                    f"Tensor[{np.dtype(t._data.dtype).name}"
+                    f"{list(t._data.shape)}] to {s!r} across `{kind}` "
+                    "iterations — a captured loop carry must keep its "
+                    "shape and dtype (cast/pad explicitly inside the "
+                    "loop)", loc, kind, "carry-mismatch")
+            init_ts.append(t)
+    n_carry = len(init_ts)
+
+    any_float_carry = any(jnp.issubdtype(s.dtype, jnp.floating) or
+                          jnp.issubdtype(s.dtype, jnp.complexfloating)
+                          for s in flat_out)
+    if any_float_carry and grad_enabled() \
+            and any(_diffable(t) for t in in_leaves + ext):
+        raise Dy2StFallback(
+            f"reverse-mode gradient through a tensor-predicate `{kind}` is "
+            "not supported (lax.while_loop has no static trip count to "
+            "checkpoint); run the loop under paddle.no_grad(), mark the "
+            "carried/read tensors stop_gradient, or let it fall back to "
+            "segmented execution", loc, kind, "grad-through-while")
+
+    rest_state = state[n_stores:]
+
+    def impl(*datas):
+        carry0 = tuple(_strip_weak(d) for d in datas[:n_carry])
+        ext_d = datas[n_carry:]
+
+        def full(carry):
+            ts = [Tensor(d, _internal=True, stop_gradient=s.stop)
+                  for d, s in zip(carry, flat_out)]
+            it = iter(ts)
+            stored = tuple(_rebuild(out_spec[i], it)
+                           for i in range(n_stores))
+            return stored + rest_state
+
+        def c(carry):
+            with _swapped(ext, ext_d):
+                out = cond_fn(full(carry))
+                return _pred_data(out, loc, kind)
+
+        def b(carry):
+            with _swapped(ext, ext_d):
+                out = tuple(body_fn(full(carry)))[:n_stores]
+                return tuple(_emit(out_spec, out, []))
+
+        return jax.lax.while_loop(c, b, carry0)
+
+    kw = {} if any_float_carry else {"n_diff": 0}
+    outs = op_call(impl, *init_ts, *ext, name="dy2st_while", **kw)
+    outs = _as_tuple_outs(outs, n_carry)
+    it = iter(outs)
+    new_stored = tuple(_rebuild(out_spec[pos], it)
+                       for pos in range(n_stores))
+    return new_stored + rest_state
+
+
+def _value_spec(v):
+    """State value → spec form (tensors become _TSpec) for _unify checks."""
+    if isinstance(v, Tensor):
+        return _TSpec(v._data.shape, v._data.dtype, v.stop_gradient)
+    if isinstance(v, list):
+        return [_value_spec(x) for x in v]
+    if isinstance(v, tuple):
+        return tuple(_value_spec(x) for x in v)
+    if isinstance(v, dict):
+        return {k: _value_spec(x) for k, x in v.items()}
+    return v
+
+
+# ---------------------------------------------------------------------- for
+class _TensorRange:
+    """range(...) whose bounds involve Tensors (built by convert_range)."""
+
+    __slots__ = ("start", "stop", "step")
+
+    def __init__(self, start, stop, step):
+        self.start = start
+        self.stop = stop
+        self.step = step
+
+    def traced(self):
+        return any(_is_traced_value(v) for v in
+                   (self.start, self.stop, self.step))
+
+    def dtype(self):
+        for v in (self.stop, self.start, self.step):
+            if isinstance(v, Tensor):
+                return v._data.dtype
+        return jnp.int64
+
+    def concrete(self):
+        """Eager iteration — yields TENSOR indices (same as the traced
+        lowering, so warm-up/discovery and the compiled program agree)."""
+        def ival(v):
+            return int(v._data) if isinstance(v, Tensor) else int(v)
+
+        dt = self.dtype()
+        for v in range(ival(self.start), ival(self.stop), ival(self.step)):
+            yield Tensor(jnp.asarray(v, dt), _internal=True)
+
+
+def convert_range(*args):
+    """`range(...)` in a converted `for`-iterable position: keeps builtins
+    semantics for plain ints, returns a _TensorRange when any bound is a
+    Tensor so the loop can lower instead of concretizing."""
+    if not any(isinstance(a, Tensor) for a in args):
+        return range(*args)
+    if len(args) == 1:
+        start, stop, step = 0, args[0], 1
+    elif len(args) == 2:
+        (start, stop), step = args, 1
+    else:
+        start, stop, step = args
+    return _TensorRange(start, stop, step)
+
+
+def convert_for(iterable, body_fn, state, names, n_stores, loc=None):
+    """Functionalized `for`: body_fn(state, item)->state. Traced tensor
+    iterables lower to lax.scan (differentiable); dynamic `range(tensor)`
+    lowers to a counted lax.while_loop; everything else runs as a plain
+    Python loop (unrolled under trace — no graph break either way)."""
+    state = tuple(state)
+    if isinstance(iterable, _TensorRange):
+        if iterable.traced():
+            return _lower_dynamic_range(iterable, body_fn, state, names,
+                                        n_stores, loc)
+        iterable = iterable.concrete()
+    elif isinstance(iterable, Tensor) and _is_traced_value(iterable):
+        return _lower_scan(iterable, body_fn, state, names, n_stores, loc)
+    for item in iterable:
+        state = tuple(body_fn(state, item))
+    return state
+
+
+def _lower_scan(xs: Tensor, body_fn, state, names, n_stores, loc):
+    if xs.ndim == 0:
+        raise Dy2StFallback(
+            "iterating a 0-d tensor in a captured `for`", loc, "for",
+            "scalar-iterable")
+    length = int(xs._data.shape[0])
+    if length == 0:
+        return state
+
+    in_leaves: list = []
+    in_struct = _flatten_state(state, in_leaves)
+    row_aval = jax.ShapeDtypeStruct(xs._data.shape[1:], xs._data.dtype)
+
+    def body_runner(ts, x_d):
+        item = Tensor(x_d, _internal=True, stop_gradient=xs.stop_gradient)
+        out = body_fn(_unflatten_state(in_struct, ts), item)
+        return tuple(out)[:n_stores]
+
+    out_spec, ext = _abstract_out(body_runner, in_leaves, loc, "for",
+                                  extra_avals=(row_aval,))
+    ext = _merge_reads(in_leaves + [xs], ext)
+    flat_out = _spec_leaves(tuple(out_spec), [])
+
+    # carry init per stored name: the OUT spec defines the carry; a name
+    # undefined before the loop (typically the loop target) starts as zeros
+    # — the body assigns it before any read, or the preflight above failed
+    init_ts: list = []
+    for pos in range(n_stores):
+        v = state[pos]
+        specs = _spec_leaves(out_spec[pos], [])
+        if is_undef(v):
+            init_ts.extend(
+                Tensor(jnp.zeros(s.shape, s.dtype), _internal=True)
+                for s in specs)
+            continue
+        vl: list = []
+        _flatten_state(v, vl)
+        if len(vl) != len(specs):
+            raise Dy2StFallback(
+                f"loop variable '{names[pos]}' changes structure across "
+                "`for` iterations", loc, "for", "carry-mismatch")
+        for t, s in zip(vl, specs):
+            if tuple(t._data.shape) != s.shape:
+                raise Dy2StFallback(
+                    f"loop variable '{names[pos]}' changes shape across "
+                    f"`for` iterations ({list(t._data.shape)} → "
+                    f"{list(s.shape)})", loc, "for", "carry-mismatch")
+            d = t._data
+            if np.dtype(d.dtype) != s.dtype:
+                d = d.astype(s.dtype)
+            init_ts.append(Tensor(d, _internal=True,
+                                  stop_gradient=t.stop_gradient))
+    n_init = len(init_ts)
+    rest_state = state[n_stores:]
+
+    def impl(xs_d, *datas):
+        carry0 = tuple(_strip_weak(d) for d in datas[:n_init])
+        ext_d = datas[n_init:]
+
+        def b(carry, x_d):
+            ts = [Tensor(d, _internal=True, stop_gradient=s.stop)
+                  for d, s in zip(carry, flat_out)]
+            it = iter(ts)
+            stored = tuple(_rebuild(out_spec[i], it)
+                           for i in range(n_stores))
+            with _swapped(ext, ext_d):
+                out = tuple(body_fn(
+                    stored + rest_state,
+                    Tensor(x_d, _internal=True,
+                           stop_gradient=xs.stop_gradient)))[:n_stores]
+                return tuple(_emit(out_spec, out, [])), None
+
+        final, _ = jax.lax.scan(b, carry0, xs_d)
+        return final
+
+    outs = op_call(impl, xs, *init_ts, *ext, name="dy2st_scan")
+    outs = _as_tuple_outs(outs, n_init)
+    it = iter(outs)
+    new_stored = tuple(_rebuild(out_spec[pos], it)
+                       for pos in range(n_stores))
+    return new_stored + rest_state
+
+
+def _lower_dynamic_range(rng: _TensorRange, body_fn, state, names, n_stores,
+                         loc):
+    """`for i in range(t)` with traced bounds → counted lax.while_loop (the
+    trip count is data-dependent, so scan cannot apply; same no-reverse-AD
+    constraint as `while`)."""
+    def as_t(v):
+        if isinstance(v, Tensor):
+            return v
+        return Tensor(jnp.asarray(v, jnp.int32), _internal=True)
+
+    start, stop, step = as_t(rng.start), as_t(rng.stop), as_t(rng.step)
+    # Python range() raises on step == 0; a traced zero step can't raise
+    # data-dependently, but the predicate below at least terminates (0
+    # iterations) instead of spinning the device forever
+    if not _is_traced_value(step) and int(step._data) == 0:
+        raise ValueError("range() arg 3 must not be zero")
+
+    def cond_fn(st):
+        i = st[0]
+        d = jnp.where(step._data > 0, i._data < stop._data,
+                      (step._data < 0) & (i._data > stop._data))
+        return Tensor(d, _internal=True)
+
+    def body_fn2(st):
+        i = st[0]
+        inner = tuple(body_fn(tuple(st[1:]), i))
+        ni = Tensor(i._data + step._data, _internal=True)
+        return (ni,) + inner
+
+    wstate = (start,) + tuple(state)
+    wnames = ("<range counter>",) + tuple(names)
+    out = _lower_while(cond_fn, body_fn2, wstate, wnames, n_stores + 1, loc,
+                       allow_undef=True, kind="for")
+    return tuple(out[1:])
+
+
+# ------------------------------------------------------ functional parity
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """paddle.static.nn.cond: runs true_fn()/false_fn() by `pred`. Eager
+    for concrete predicates; ONE lax.cond under capture. Both callables
+    must return matching pytrees (clear diagnostics otherwise). Tensors the
+    callables close over are discovered at lowering time and threaded as
+    operands, so gradients flow through the captured branch."""
+    tf = true_fn if true_fn is not None else (lambda: None)
+    ff = false_fn if false_fn is not None else (lambda: None)
+    if not _is_traced_value(pred):
+        taken, other = (tf, ff) if _to_bool(pred) else (ff, tf)
+        _speculate(lambda s: other(), ())
+        return taken()
+    out = convert_if(pred, lambda s: (tf(),), lambda s: (ff(),), (),
+                     ("<cond output>",), 1, name or "static.nn.cond")
+    return out[0]
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop: functional while over explicit
+    loop_vars (list/tuple). Traced predicates capture as ONE
+    lax.while_loop; concrete ones run eagerly."""
+    loop_vars = tuple(loop_vars)
+    names = tuple(f"loop_vars[{i}]" for i in range(len(loop_vars)))
+    out = convert_while(lambda s: cond(*s), lambda s: tuple(body(*s)),
+                        loop_vars, names, len(loop_vars),
+                        name or "static.nn.while_loop")
+    return list(out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """paddle.static.nn.case: the first predicate that holds wins; the last
+    fn doubles as the default when none is given."""
+    pairs = list(pred_fn_pairs)
+    if not pairs:
+        raise ValueError("case: pred_fn_pairs must be non-empty")
+    pred, fn = pairs[0]
+    rest = pairs[1:]
+    if not rest:
+        tail = default if default is not None else fn
+        return cond(pred, fn, tail, name=name)
+    return cond(pred, fn, lambda: case(rest, default, name), name=name)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """paddle.static.nn.switch_case: dispatch on an integer index/tensor."""
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        pairs = sorted((int(k), f) for k, f in branch_fns)
+    else:
+        pairs = list(enumerate(branch_fns))
+    if not pairs:
+        raise ValueError("switch_case: branch_fns must be non-empty")
+    tail = default if default is not None else pairs[-1][1]
+
+    if not _is_traced_value(branch_index):
+        idx = int(branch_index._data) if isinstance(branch_index, Tensor) \
+            else int(branch_index)
+        for k, fn in pairs:
+            if k == idx:
+                return fn()
+        return tail()
+
+    idx_d = branch_index._data if isinstance(branch_index, Tensor) \
+        else jnp.asarray(branch_index)
+
+    def chain(left):
+        if not left:
+            return tail
+        k, fn = left[0]
+        eq = Tensor(idx_d == k, _internal=True)
+        return lambda: cond(eq, fn, chain(left[1:]), name=name)
+
+    return chain(pairs)()

@@ -1,0 +1,157 @@
+"""dy2static diagnostics: fallback reasons, per-function transform reports.
+
+Reference parity: the reference's dy2static error module
+(python/paddle/jit/dy2static/error.py) attaches original source locations to
+transform/trace failures; SOT reports BreakGraphError reasons. Here every
+decision NOT to capture a control-flow construct — at AST-transform time or
+at trace time — is recorded as a `Site` with file:line + category, and
+`Dy2StFallback` carries the one-line reason that jit/api.py surfaces in its
+graph-break warning (and that tools/report_graph_breaks.py aggregates).
+"""
+from __future__ import annotations
+
+
+class Site:
+    """One control-flow site that could not be (or was not) captured."""
+
+    __slots__ = ("kind", "loc", "category", "reason")
+
+    def __init__(self, kind: str, loc: str, category: str, reason: str):
+        self.kind = kind          # 'if' | 'while' | 'for' | 'function'
+        self.loc = loc            # "file.py:123"
+        self.category = category  # short machine-ish tag
+        self.reason = reason      # human sentence
+
+    def __repr__(self):
+        return f"{self.loc} [{self.kind}/{self.category}] {self.reason}"
+
+
+class TransformReport:
+    """Per-function record of what the AST pass did.
+
+    `sites` lists constructs left UN-transformed (each a potential graph
+    break if its predicate turns out tensor-dependent); `converted` counts
+    constructs rewritten to functional form; `skip_reason` is set when the
+    whole function could not be transformed at all.
+    """
+
+    def __init__(self, fn_name: str = "<unknown>"):
+        self.fn_name = fn_name
+        self.transformed = False
+        self.converted = 0           # constructs rewritten
+        self.sites: list[Site] = []  # constructs left as-is (with reasons)
+        self.skip_reason: str | None = None
+        # trace-time fallbacks (filled by control_flow/api when a converted
+        # construct still couldn't lower — e.g. branch pytree mismatch)
+        self.trace_sites: list[Site] = []
+
+    def add(self, kind, loc, category, reason):
+        self.sites.append(Site(kind, loc, category, reason))
+
+    def add_trace(self, kind, loc, category, reason):
+        self.trace_sites.append(Site(kind, loc, category, reason))
+
+    def summary(self) -> str:
+        lines = [f"dy2static[{self.fn_name}]: "
+                 f"{'transformed' if self.transformed else 'NOT transformed'}"
+                 f" ({self.converted} construct(s) converted)"]
+        if self.skip_reason:
+            lines.append(f"  skip: {self.skip_reason}")
+        for s in self.sites:
+            lines.append(f"  untransformed: {s!r}")
+        for s in self.trace_sites:
+            lines.append(f"  trace fallback: {s!r}")
+        return "\n".join(lines)
+
+
+class Dy2StFallback(Exception):
+    """Raised by the lowering when a converted construct can't be captured
+    (branch disagreement, diff-through-while, ...). jit/api.py treats it
+    like an SOT graph break: warn with the reason, run segmented."""
+
+    def __init__(self, reason: str, loc: str | None = None,
+                 kind: str = "control-flow", category: str = "lowering"):
+        self.reason = reason
+        self.loc = loc
+        self.kind = kind
+        self.category = category
+        super().__init__(f"{loc + ': ' if loc else ''}{reason}")
+
+
+class UndefinedVarError(UnboundLocalError):
+    """A name bound in only some paths of a converted construct was read.
+    Subclasses UnboundLocalError so eager behavior matches plain Python."""
+
+
+class _Undefined:
+    """Placeholder bound to names a converted branch/loop may leave unset
+    (the reference's dy2static UndefinedVar). Any meaningful use raises."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def _raise(self, *a, **k):
+        raise UndefinedVarError(
+            f"local variable '{self.name}' was read before being assigned "
+            "on every path of a converted if/while/for (dy2static); assign "
+            "it before the control-flow statement")
+
+    def __getattr__(self, attr):
+        if attr.startswith("__") and attr.endswith("__"):
+            raise AttributeError(attr)
+        self._raise()
+
+    def __repr__(self):
+        return f"<undefined '{self.name}'>"
+
+    def __deepcopy__(self, memo):
+        return self
+
+    def __copy__(self):
+        return self
+
+
+for _n in ("__add__", "__radd__", "__sub__", "__rsub__", "__mul__",
+           "__rmul__", "__truediv__", "__rtruediv__", "__floordiv__",
+           "__rfloordiv__", "__mod__", "__rmod__", "__pow__", "__rpow__",
+           "__matmul__", "__rmatmul__", "__neg__", "__pos__", "__abs__",
+           "__getitem__", "__setitem__", "__len__", "__iter__", "__call__",
+           "__float__", "__int__", "__bool__", "__index__", "__lt__",
+           "__le__", "__gt__", "__ge__", "__and__", "__or__", "__xor__",
+           "__invert__", "__contains__"):
+    setattr(_Undefined, _n, _Undefined._raise)
+
+
+def undef(name: str) -> _Undefined:
+    return _Undefined(name)
+
+
+def is_undef(v) -> bool:
+    return type(v) is _Undefined
+
+
+def classify_graph_break(exc: BaseException) -> str:
+    """One-line category for a raw jax concretization error (the non-dy2st
+    graph breaks: float()/bool()/.numpy() on a traced value)."""
+    import jax
+
+    if isinstance(exc, Dy2StFallback):
+        return exc.reason
+    name = type(exc).__name__
+    hints = {
+        jax.errors.TracerBoolConversionError:
+            "bool() of a traced tensor (untransformed data-dependent "
+            "control flow, or one inside a nested call)",
+        jax.errors.TracerIntegerConversionError:
+            "int() / index use of a traced tensor",
+        jax.errors.TracerArrayConversionError:
+            ".numpy() / np.asarray() of a traced tensor",
+    }
+    for t, msg in hints.items():
+        if isinstance(exc, t):
+            return msg
+    if isinstance(exc, jax.errors.ConcretizationTypeError):
+        return "concrete value of a traced tensor required"
+    return f"trace failure ({name})"

@@ -1,0 +1,278 @@
+"""Name/scope analysis for the dy2static AST pass.
+
+Computes, for a statement list, the set of names it BINDS at the current
+function scope (the loop-carried / branch-merged state the functional
+rewrite must thread explicitly), plus the structural screens that decide
+whether a construct is provably safe to functionalize (no `return`/`break`
+escaping the body, no attribute/subscript stores, no `global`/`nonlocal`,
+no `raise`). CPython scoping rules are followed: nested function/class
+bodies and comprehension targets bind their own scope and are excluded;
+walrus (`:=`) targets bind the function scope and are included.
+"""
+from __future__ import annotations
+
+import ast
+
+#: prefix of every name the transformer itself generates; excluded from
+#: state analysis so nested conversions don't leak scaffolding into the
+#: enclosing construct's carried state
+GEN_PREFIX = "__dy2s"
+
+_OWN_SCOPE = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+_COMPS = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+def _target_names(node, out: set):
+    if isinstance(node, ast.Name):
+        out.add(node.id)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            _target_names(e, out)
+    elif isinstance(node, ast.Starred):
+        _target_names(node.value, out)
+    # Attribute/Subscript targets mutate objects, not names — handled by the
+    # safety screen, not the state set.
+
+
+class _StoreScan(ast.NodeVisitor):
+    """Names bound at the CURRENT function scope by a statement list."""
+
+    def __init__(self):
+        self.stores: set[str] = set()
+
+    # -- scope boundaries: the def/class NAME binds here; the body does not
+    def visit_FunctionDef(self, node):
+        self.stores.add(node.name)
+        for d in node.decorator_list:
+            self.visit(d)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self.stores.add(node.name)
+        for d in node.decorator_list:
+            self.visit(d)
+
+    def visit_Lambda(self, node):
+        pass
+
+    def _comp(self, node):
+        # comprehension targets bind the comprehension's own scope (py3);
+        # only walrus assignments inside leak to the function scope
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.NamedExpr):
+                _target_names(sub.target, self.stores)
+            elif isinstance(sub, _OWN_SCOPE):
+                pass
+
+    visit_ListComp = visit_SetComp = visit_DictComp = visit_GeneratorExp = _comp
+
+    # -- binders
+    def visit_Assign(self, node):
+        for t in node.targets:
+            _target_names(t, self.stores)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node):
+        _target_names(node.target, self.stores)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            _target_names(node.target, self.stores)
+            self.visit(node.value)
+
+    def visit_NamedExpr(self, node):
+        _target_names(node.target, self.stores)
+        self.visit(node.value)
+
+    def visit_For(self, node):
+        _target_names(node.target, self.stores)
+        self.generic_visit(node)
+
+    visit_AsyncFor = visit_For
+
+    def visit_With(self, node):
+        for item in node.items:
+            if item.optional_vars is not None:
+                _target_names(item.optional_vars, self.stores)
+        self.generic_visit(node)
+
+    visit_AsyncWith = visit_With
+
+    def visit_ExceptHandler(self, node):
+        # `except E as e:` — e is unbound again after the handler; keeping it
+        # out of the carried state matches post-construct visibility
+        for s in node.body:
+            self.visit(s)
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self.stores.add((a.asname or a.name).split(".")[0])
+
+    visit_ImportFrom = visit_Import
+
+
+def stores(stmts) -> set[str]:
+    sc = _StoreScan()
+    for s in stmts:
+        sc.visit(s)
+    return {n for n in sc.stores if not n.startswith(GEN_PREFIX)}
+
+
+def loads(nodes) -> set[str]:
+    """All names READ anywhere in `nodes` (statements or expressions),
+    including inside nested functions/comprehensions — over-inclusion is
+    safe here (a read-only name just rides along in the threaded state)."""
+    out: set[str] = set()
+    for root in nodes:
+        for n in ast.walk(root):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and not n.id.startswith(GEN_PREFIX):
+                out.add(n.id)
+    return out
+
+
+def arg_names(fdef) -> set[str]:
+    a = fdef.args
+    out = {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        out.add(a.vararg.arg)
+    if a.kwarg:
+        out.add(a.kwarg.arg)
+    return out
+
+
+class _EscapeScan(ast.NodeVisitor):
+    """Finds statements that cannot move into a nested function: `return`
+    always; `break`/`continue` when they'd bind to a loop OUTSIDE the body
+    being extracted; `raise` (would fire during both-branch tracing);
+    `global`/`nonlocal`; `del`; attribute/subscript/in-place stores (object
+    mutation the functional rewrite can't thread); `match` (untested
+    binding semantics)."""
+
+    def __init__(self, loop_body: bool):
+        # loop_body=True: the body IS a loop body, so top-level break/
+        # continue would escape; inside further nested loops they're fine
+        self.reason: str | None = None
+        self._loop_depth = 0 if loop_body else None
+
+    def _flag(self, reason):
+        if self.reason is None:
+            self.reason = reason
+
+    def visit(self, node):
+        if getattr(node, "_dy2s_gen", False):
+            return  # transformer-generated scaffolding (undef guards)
+        if self.reason is None:
+            super().visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass  # its own scope: return/break inside are fine
+
+    visit_AsyncFunctionDef = visit_Lambda = visit_FunctionDef
+
+    def visit_Return(self, node):
+        self._flag("`return` inside the body")
+
+    def visit_Yield(self, node):
+        self._flag("`yield` inside the body")
+
+    visit_YieldFrom = visit_Await = visit_Yield
+
+    def _loop(self, node):
+        if self._loop_depth is not None:
+            self._loop_depth += 1
+        self.generic_visit(node)
+        if self._loop_depth is not None:
+            self._loop_depth -= 1
+
+    visit_For = visit_While = visit_AsyncFor = _loop
+
+    def visit_Break(self, node):
+        if self._loop_depth is not None and self._loop_depth == 0:
+            self._flag("`break` inside the loop body")
+        elif self._loop_depth is None:
+            self._flag("`break` targeting a loop outside the branch")
+
+    def visit_Continue(self, node):
+        if self._loop_depth is not None and self._loop_depth == 0:
+            self._flag("`continue` inside the loop body")
+        elif self._loop_depth is None:
+            self._flag("`continue` targeting a loop outside the branch")
+
+    def visit_Raise(self, node):
+        self._flag("`raise` inside the body (both branches execute when "
+                   "traced, so a data-dependent raise cannot be captured)")
+
+    def visit_Global(self, node):
+        self._flag("`global` declaration inside the body")
+
+    def visit_Nonlocal(self, node):
+        self._flag("`nonlocal` declaration inside the body")
+
+    def visit_Delete(self, node):
+        self._flag("`del` inside the body")
+
+    def visit_Match(self, node):
+        self._flag("`match` statement inside the body")
+
+    def _store_target(self, t):
+        if isinstance(t, (ast.Attribute, ast.Subscript)):
+            self._flag("attribute/subscript assignment inside the body "
+                       "(object mutation cannot be threaded functionally)")
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._store_target(e)
+        elif isinstance(t, ast.Starred):
+            self._store_target(t.value)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._store_target(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._store_target(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        self._store_target(node.target)
+        self.generic_visit(node)
+
+
+def unsafe_reason(stmts, loop_body: bool) -> str | None:
+    """None if `stmts` may move into a nested function, else the reason."""
+    sc = _EscapeScan(loop_body)
+    for s in stmts:
+        sc.visit(s)
+        if sc.reason:
+            break
+    return sc.reason
+
+
+def mangled_names(tree) -> bool:
+    """True if the tree references class-private (`__x`) names, which would
+    have been name-mangled in their original class context — re-compiling
+    outside the class would silently change what they resolve to."""
+    def priv(n: str) -> bool:
+        return n.startswith("__") and not n.endswith("__")
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and priv(node.attr):
+            return True
+        if isinstance(node, ast.Name) and priv(node.id) \
+                and not node.id.startswith(GEN_PREFIX):
+            return True
+    return False
+
+
+def calls_zero_arg_super(tree) -> bool:
+    """Zero-argument super() needs the __class__ cell only class bodies
+    create; a re-compiled function can't provide it."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "super" and not node.args \
+                and not node.keywords:
+            return True
+    return False

@@ -1,0 +1,400 @@
+"""AST pass: rewrite `if`/`while`/`for` into functional convert_* calls.
+
+The TPU-native analog of the reference's dy2static program translator
+(python/paddle/jit/dy2static/transformers/): instead of generating
+ConditionalBlock/While program ops, each supported construct is rewritten
+into a call of `__dy2s.convert_if/while/for` (control_flow.py) carrying the
+construct's state explicitly, so that a tensor-dependent predicate lowers
+to `lax.cond`/`while_loop`/`scan` at capture time while Python-valued
+predicates keep exact eager semantics. Example:
+
+    if x.sum() > 0:            def __dy2s_t0(__dy2s_s):
+        y = x * 2                  (y, x) = __dy2s_s
+    else:            ──────▶       y = x * 2
+        y = x * 3                  return (y, x)
+                               ... (false fn alike)
+                               (y, x) = __dy2s.convert_if(x.sum() > 0,
+                                   __dy2s_t0, __dy2s_f0, (y, x),
+                                   ('y', 'x'), 1, 'model.py:12')
+
+State = the names the construct ASSIGNS (rebound from the lowered op's
+outputs); values it only READS resolve through the branch-fn closures, and
+the lowering discovers externally-read tensors at trace time to thread
+them as op operands (so autograd flows through the captured region).
+Constructs the pass cannot prove safe to functionalize (`return`/`break`
+in the body, attribute stores, `raise`, ...) are left untouched and
+recorded in the TransformReport — if their predicate turns out
+tensor-dependent they fall back to segmented execution with that reason.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import linecache
+import textwrap
+import types
+
+from . import names as na
+from .diagnostics import TransformReport
+
+_SVAR = na.GEN_PREFIX + "_s"
+_XVAR = na.GEN_PREFIX + "_x"
+_RUNTIME = na.GEN_PREFIX  # the injected runtime namespace ("__dy2s")
+_MAKER = na.GEN_PREFIX + "_make"
+
+
+def _name(n, ctx=ast.Load):
+    return ast.Name(id=n, ctx=ctx())
+
+def _names_tuple(ns, ctx=ast.Load):
+    return ast.Tuple(elts=[_name(n, ctx) for n in ns], ctx=ctx())
+
+
+def _rt(attr):
+    return ast.Attribute(value=_name(_RUNTIME), attr=attr, ctx=ast.Load())
+
+
+def _preamble(ns):
+    """`try: n\nexcept NameError: n = __dy2s.undef('n')` per state name —
+    binds possibly-unbound names to the UNDEF sentinel so state tuples can
+    always be built (the sentinel errors informatively on real use)."""
+    out = []
+    for n in ns:
+        out.append(ast.Try(
+            body=[ast.Expr(value=_name(n))],
+            handlers=[ast.ExceptHandler(
+                type=_name("NameError"), name=None,
+                body=[ast.Assign(
+                    targets=[_name(n, ast.Store)],
+                    value=ast.Call(func=_rt("undef"),
+                                   args=[ast.Constant(n)], keywords=[]))])],
+            orelse=[], finalbody=[]))
+    return out
+
+
+def _strip_gen(stmts):
+    """Drop generated undef-guards from a body that is moving into a branch
+    fn: inside the functional rewrite the UNDEF sentinel travels through
+    the threaded state (unify handles it), and a `del` there would leave
+    the state-tuple return reading an unbound name."""
+    out = []
+    for s in stmts:
+        if getattr(s, "_dy2s_gen", False):
+            continue
+        for field in ("body", "orelse", "finalbody"):
+            if hasattr(s, field) and isinstance(getattr(s, field), list):
+                setattr(s, field, _strip_gen(getattr(s, field)))
+        if hasattr(s, "handlers"):
+            for h in s.handlers:
+                h.body = _strip_gen(h.body)
+        out.append(s)
+    return out
+
+
+def _state_fn(fname, ns, body, extra_arg=None, ret_expr=None):
+    """def fname(__dy2s_s[, extra]): (ns) = __dy2s_s; <body>; return ..."""
+    args = [ast.arg(arg=_SVAR)]
+    if extra_arg:
+        args.append(ast.arg(arg=extra_arg))
+    stmts = [ast.Assign(targets=[_names_tuple(ns, ast.Store)],
+                        value=_name(_SVAR))]
+    body = _strip_gen(list(body))
+    stmts += body if body else [ast.Pass()]
+    stmts.append(ast.Return(value=ret_expr if ret_expr is not None
+                            else _names_tuple(ns)))
+    return ast.FunctionDef(
+        name=fname,
+        args=ast.arguments(posonlyargs=[], args=args, vararg=None,
+                           kwonlyargs=[], kw_defaults=[], kwarg=None,
+                           defaults=[]),
+        body=stmts, decorator_list=[])
+
+
+class _CFTransformer(ast.NodeTransformer):
+    def __init__(self, report: TransformReport, fn_locals: set,
+                 filename: str, root):
+        self.report = report
+        self.locals = fn_locals
+        self.filename = filename
+        self.root = root
+        self.n = 0
+
+    # nested defs/classes have their own scopes; their control flow is not
+    # converted (a tensor predicate there still falls back cleanly)
+    def visit_FunctionDef(self, node):
+        if node is self.root:
+            self.generic_visit(node)
+            return node
+        return node
+
+    visit_AsyncFunctionDef = visit_ClassDef = visit_FunctionDef
+
+    def _loc(self, node):
+        return f"{self.filename}:{node.lineno}"
+
+    def _fresh(self, tag):
+        self.n += 1
+        return f"{na.GEN_PREFIX}_{tag}{self.n}"
+
+    def _state(self, stored: set):
+        """(names, n_stores): the threaded state is the STORED names only —
+        read-only locals resolve through the branch-fn closures, and the
+        lowering discovers externally-read tensors at trace time (including
+        attribute reads like self.weight) to thread them as op operands."""
+        stored = {s for s in stored if not s.startswith(na.GEN_PREFIX)}
+        ns = sorted(stored)
+        return ns, len(ns)
+
+    def _emit(self, node, defs, call_value, ns, n_stores):
+        out = _preamble(ns) + defs + [ast.Assign(
+            targets=[_names_tuple(ns, ast.Store)], value=call_value)]
+        # a name no path assigned comes back as the UNDEF sentinel — delete
+        # it again so later reads raise UnboundLocalError exactly like the
+        # original Python (the sentinel must never escape the construct)
+        for n in ns[:n_stores]:
+            guard = ast.If(
+                test=ast.Call(func=_rt("is_undef"), args=[_name(n)],
+                              keywords=[]),
+                body=[ast.Delete(targets=[ast.Name(id=n, ctx=ast.Del())])],
+                orelse=[])
+            guard._dy2s_gen = True  # see names._EscapeScan / _strip_gen
+            out.append(guard)
+        for s in out:
+            ast.copy_location(s, node)
+            for sub in ast.walk(s):
+                ast.copy_location(sub, node)
+        self.report.converted += 1
+        return out
+
+    # ------------------------------------------------------------------ if
+    def visit_If(self, node):
+        if getattr(node, "_dy2s_gen", False):
+            return node  # generated undef guard — not user control flow
+        self.generic_visit(node)
+        for branch, tag in ((node.body, "true"), (node.orelse, "false")):
+            r = na.unsafe_reason(branch, loop_body=False)
+            if r:
+                self.report.add("if", self._loc(node), "unsupported-body",
+                                f"{r} ({tag} branch)")
+                return node
+        stored = na.stores(node.body) | na.stores(node.orelse)
+        if not stored:
+            self.report.add("if", self._loc(node), "side-effect-only",
+                            "branch binds no variables — left as Python "
+                            "(falls back if the predicate is a traced "
+                            "tensor)")
+            return node
+        ns, n_stores = self._state(stored)
+        tname, fname = self._fresh("t"), self._fresh("f")
+        defs = [_state_fn(tname, ns, node.body),
+                _state_fn(fname, ns, node.orelse)]
+        call = ast.Call(
+            func=_rt("convert_if"),
+            args=[node.test, _name(tname), _name(fname), _names_tuple(ns),
+                  ast.Tuple(elts=[ast.Constant(n) for n in ns],
+                            ctx=ast.Load()),
+                  ast.Constant(n_stores), ast.Constant(self._loc(node))],
+            keywords=[])
+        return self._emit(node, defs, call, ns, n_stores)
+
+    # --------------------------------------------------------------- while
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            self.report.add("while", self._loc(node), "loop-else",
+                            "`while ... else` is not converted")
+            return node
+        r = na.unsafe_reason(node.body, loop_body=True)
+        if r:
+            self.report.add("while", self._loc(node), "unsupported-body", r)
+            return node
+        stored = na.stores(node.body)
+        if not stored:
+            self.report.add("while", self._loc(node), "side-effect-only",
+                            "loop body binds no variables — left as Python")
+            return node
+        ns, n_stores = self._state(stored)
+        cname, bname = self._fresh("c"), self._fresh("b")
+        defs = [_state_fn(cname, ns, [], ret_expr=node.test),
+                _state_fn(bname, ns, node.body)]
+        call = ast.Call(
+            func=_rt("convert_while"),
+            args=[_name(cname), _name(bname), _names_tuple(ns),
+                  ast.Tuple(elts=[ast.Constant(n) for n in ns],
+                            ctx=ast.Load()),
+                  ast.Constant(n_stores), ast.Constant(self._loc(node))],
+            keywords=[])
+        return self._emit(node, defs, call, ns, n_stores)
+
+    # ----------------------------------------------------------------- for
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            self.report.add("for", self._loc(node), "loop-else",
+                            "`for ... else` is not converted")
+            return node
+        r = na.unsafe_reason(node.body, loop_body=True)
+        if r:
+            self.report.add("for", self._loc(node), "unsupported-body", r)
+            return node
+        tgt: set = set()
+        na._target_names(node.target, tgt)
+        if not tgt or not _plain_target(node.target):
+            self.report.add("for", self._loc(node), "complex-target",
+                            "loop target is not a plain name/tuple")
+            return node
+        stored = na.stores(node.body) | tgt
+        ns, n_stores = self._state(stored)
+        bname = self._fresh("b")
+        body = [ast.Assign(targets=[node.target], value=_name(_XVAR))] \
+            + node.body
+        defs = [_state_fn(bname, ns, body, extra_arg=_XVAR)]
+        it = node.iter
+        # `range(...)` in iterable position: route through convert_range so
+        # Tensor bounds become a lowerable _TensorRange instead of
+        # concretizing via __index__ (skips user-shadowed `range`)
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id == "range" and "range" not in self.locals \
+                and not it.keywords:
+            it = ast.Call(func=_rt("convert_range"), args=it.args,
+                          keywords=[])
+        call = ast.Call(
+            func=_rt("convert_for"),
+            args=[it, _name(bname), _names_tuple(ns),
+                  ast.Tuple(elts=[ast.Constant(n) for n in ns],
+                            ctx=ast.Load()),
+                  ast.Constant(n_stores), ast.Constant(self._loc(node))],
+            keywords=[])
+        return self._emit(node, defs, call, ns, n_stores)
+
+
+def _plain_target(t):
+    if isinstance(t, ast.Name):
+        return True
+    if isinstance(t, (ast.Tuple, ast.List)):
+        return all(_plain_target(e) for e in t.elts)
+    if isinstance(t, ast.Starred):
+        return _plain_target(t.value)
+    return False
+
+
+def convert_to_static(fn):
+    """Rewrite `fn`'s tensor-convertible control flow into functional form.
+
+    Returns (callable, TransformReport). On any screen failing, the
+    ORIGINAL callable is returned with the skip reason recorded — capture
+    then proceeds exactly as before the dy2static subsystem existed.
+    """
+    report = TransformReport(getattr(fn, "__name__", "<callable>"))
+    self_obj = None
+    f = fn
+    if inspect.ismethod(fn):
+        self_obj = fn.__self__
+        f = fn.__func__
+    if not inspect.isfunction(f):
+        report.skip_reason = "not a plain Python function"
+        return fn, report
+
+    try:
+        src = textwrap.dedent(inspect.getsource(f))
+        tree = ast.parse(src)
+        # report sites in real file coordinates, not def-relative ones
+        ast.increment_lineno(tree, f.__code__.co_firstlineno - 1)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        report.skip_reason = "source unavailable/unparseable"
+        return fn, report
+    if not tree.body or not isinstance(tree.body[0], ast.FunctionDef):
+        report.skip_reason = "not a plain `def` (lambda or expression)"
+        return fn, report
+    fdef = tree.body[0]
+
+    for n in ast.walk(fdef):
+        if isinstance(n, (ast.Yield, ast.YieldFrom, ast.Await)):
+            report.skip_reason = "generator/async function"
+            return fn, report
+    if na.mangled_names(fdef):
+        report.skip_reason = ("class-private (__name) references would "
+                              "lose name mangling when re-compiled")
+        return fn, report
+    if na.calls_zero_arg_super(fdef):
+        report.skip_reason = ("zero-argument super() needs the __class__ "
+                              "cell only class bodies provide")
+        return fn, report
+    if not any(isinstance(n, (ast.If, ast.While, ast.For))
+               for n in ast.walk(fdef)):
+        report.skip_reason = "no control flow to convert"
+        return fn, report
+
+    closure = f.__closure__ or ()
+    try:
+        freevals = [c.cell_contents for c in closure]
+    except ValueError:
+        report.skip_reason = "unset closure cell"
+        return fn, report
+
+    fdef.decorator_list = []
+    fn_locals = na.arg_names(fdef) | na.stores(fdef.body)
+    short = f.__code__.co_filename.rsplit("/", 1)[-1]
+    tr = _CFTransformer(report, fn_locals, short, fdef)
+    tr.visit(fdef)
+    if report.converted == 0:
+        if report.skip_reason is None:
+            report.skip_reason = "no convertible construct (see sites)"
+        return fn, report
+
+    # maker wrapper: re-establishes the original free variables as closure
+    # cells and injects the __dy2s runtime namespace
+    maker = ast.FunctionDef(
+        name=_MAKER,
+        args=ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=_RUNTIME)]
+            + [ast.arg(arg=v) for v in f.__code__.co_freevars],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[]),
+        body=[fdef, ast.Return(value=_name(fdef.name))],
+        decorator_list=[])
+    mod = ast.Module(body=[maker], type_ignores=[])
+    ast.fix_missing_locations(mod)
+    try:
+        new_src = ast.unparse(mod)
+        filename = (f"<dy2static {f.__code__.co_filename}:"
+                    f"{f.__code__.co_firstlineno}>")
+        code = compile(new_src, filename, "exec")
+    except Exception as e:  # pragma: no cover — codegen bug safety net
+        report.skip_reason = f"codegen failed ({type(e).__name__}: {e})"
+        return fn, report
+    linecache.cache[filename] = (len(new_src), None,
+                                 new_src.splitlines(True), filename)
+
+    from . import _runtime
+    g = f.__globals__
+    exec(code, g)
+    maker_fn = g.pop(_MAKER)
+    new_f = maker_fn(_runtime, *freevals)
+    # re-bind onto the ORIGINAL closure cells (the maker's parameters made
+    # fresh cells holding snapshots): a later `nonlocal` rebind in the
+    # enclosing scope must stay visible, exactly as in the untransformed
+    # function
+    cellmap = dict(zip(f.__code__.co_freevars, closure))
+    cellmap[_RUNTIME] = types.CellType(_runtime)
+    try:
+        new_closure = tuple(cellmap[n]
+                            for n in new_f.__code__.co_freevars)
+    except KeyError:  # pragma: no cover — codegen invariant safety net
+        report.skip_reason = "closure rebinding failed"
+        return fn, report
+    new_f = types.FunctionType(new_f.__code__, g, f.__name__,
+                               f.__defaults__, new_closure)
+    new_f.__defaults__ = f.__defaults__
+    new_f.__kwdefaults__ = f.__kwdefaults__
+    new_f.__name__ = f.__name__
+    new_f.__qualname__ = f.__qualname__
+    new_f.__doc__ = f.__doc__
+    new_f.__module__ = f.__module__
+    new_f.__wrapped__ = f
+    new_f.__dy2st_report__ = report
+    report.transformed = True
+    if self_obj is not None:
+        return types.MethodType(new_f, self_obj), report
+    return new_f, report

@@ -1,9 +1,18 @@
 """paddle.static.nn (≙ python/paddle/static/nn/): the static-graph layer
-builders map onto the functional nn surface in eager/XLA execution."""
+builders map onto the functional nn surface in eager/XLA execution.
+
+Control flow (`cond`/`while_loop`/`case`/`switch_case`) is real: eager for
+concrete predicates, and the SAME lax lowering the dy2static transformer
+uses when the predicate is traced under `paddle.jit.to_static` — one
+`lax.cond`/`lax.while_loop` region, no graph break (jit/dy2static)."""
 from ..nn import functional as F  # noqa: F401
 
 from ..nn.functional import (  # noqa: F401
     conv2d, conv3d, batch_norm, layer_norm, group_norm, embedding,
+)
+
+from ..jit.dy2static.control_flow import (  # noqa: F401
+    case, cond, switch_case, while_loop,
 )
 
 
