@@ -13,10 +13,14 @@ Ladder (BASELINE.json configs, honestly named):
   5 llama_168m_train[,_bf16]   — decoder pretrain proxy (Pallas flash path)
   5b llama_1b_train_bf16       — REAL ~1.1B-param config (bf16 params +
                                  bf16 moments + recompute fit one v5e)
+  5b' llama_1b_resid_bf16      — same config, bf16 residual-stream policy
+                                 ON (FLAGS_residual_dtype, round 8 A/B)
   5c llama_1b_bf16_s4096/s8192 — long-context rungs (full remat)
   5d flashmask_s8192/s16384    — block-sparse fwd+bwd vs causal flash
   5e llama_1b_bf16_decode      — flagship-scale KV-cached generation
-  + eager dispatch micro-bench, chained + single-op int8 vs bf16,
+  + fused_micro (round 8): norm/rotary/SwiGLU/dropout-add Pallas kernels
+    vs the XLA compositions at the 1B geometry (ops/pallas_norm.py),
+    eager dispatch micro-bench, chained + single-op int8 vs bf16,
     fused multi-tensor adam vs per-param
 
 The ladder is TIME-BOXED (BENCH_BUDGET_S, default 1500 s): flagship rows
@@ -535,6 +539,99 @@ def bench_decode_1b(batch=4, prompt=128, new_tokens=128):
             "n_params": n_params, "wall_total_s": round(t_long, 2)}
 
 
+def bench_fused_elementwise(iters=20, rows=4096, h=2048, inter=5504,
+                            heads=16, dh=128, seq=1024):
+    """Round-8 micro-rung: the bandwidth-bound elementwise chains at the 1B
+    flagship geometry (rows = b4 x s1024, h 2048) — Pallas fused kernel vs
+    the unfused XLA composition, fwd+bwd, bf16 operands. On this device
+    every one of these chains is HBM-bound (PERF.md round 4: ~103 GB/s
+    effective), so ms here IS bytes moved."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import pallas_norm as pn
+
+    rs = np.random.RandomState(0)
+    bf = jnp.bfloat16
+    x = jnp.asarray(rs.randn(rows, h).astype("float32"), bf)
+    r = jnp.asarray(rs.randn(rows, h).astype("float32"), bf)
+    w = jnp.asarray(rs.randn(h).astype("float32"), bf)
+    g1 = jnp.asarray(rs.randn(rows, inter).astype("float32"), bf)
+    u1 = jnp.asarray(rs.randn(rows, inter).astype("float32"), bf)
+    b4 = rows // seq
+    q = jnp.asarray(rs.randn(b4, seq, heads, dh).astype("float32"), bf)
+    k = jnp.asarray(rs.randn(b4, seq, heads, dh).astype("float32"), bf)
+    emb = np.concatenate([np.outer(np.arange(seq),
+                                   1.0 / 10000.0 ** (np.arange(0, dh, 2) / dh))] * 2,
+                         -1)
+    cos = jnp.asarray(np.cos(emb)[None, :, None, :].astype("float32"), bf)
+    sin = jnp.asarray(np.sin(emb)[None, :, None, :].astype("float32"), bf)
+    mask = jnp.asarray((rs.rand(rows, h) > 0.1).astype("float32"), bf)
+
+    def fwdbwd(loss_fn, *args):
+        f = jax.jit(jax.grad(loss_fn, argnums=tuple(range(len(args)))))
+        return _timeit(lambda: f(*args)[0], iters=iters, warmup=3)
+
+    def l_sum(y):
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    pairs = {
+        "add_rms_norm": (
+            lambda a, b, ww: (lambda yz: l_sum(yz[0]) + l_sum(yz[1]))(
+                pn.add_rms_norm_raw(a, b, ww)),
+            lambda a, b, ww: (lambda s: l_sum(
+                (s.astype(jnp.float32)
+                 * jax.lax.rsqrt(jnp.mean(jnp.square(s.astype(jnp.float32)),
+                                          -1, keepdims=True) + 1e-6)
+                 ).astype(a.dtype) * ww) + l_sum(s))(a + b),
+            (x, r, w)),
+        "swiglu": (
+            lambda a, b: l_sum(pn.swiglu_fused(a, b)),
+            lambda a, b: l_sum(jax.nn.silu(a) * b),
+            (g1, u1)),
+        "rope_qk": (
+            lambda a, b: (lambda qk: l_sum(qk[0]) + l_sum(qk[1]))(
+                pn.rope_qk_fused(a, b, cos, sin)),
+            lambda a, b: (lambda rot: l_sum(rot(a)) + l_sum(rot(b)))(
+                lambda t: t * cos + jnp.concatenate(
+                    [-t[..., dh // 2:], t[..., :dh // 2]], -1) * sin),
+            (q, k)),
+        "dropout_add": (
+            lambda a, b: l_sum(pn.dropout_add_fused(a, b, mask,
+                                                    1.0 / 0.9)),
+            lambda a, b: l_sum(jnp.where(mask != 0,
+                                         a * jnp.asarray(1.0 / 0.9, bf),
+                                         jnp.zeros((), bf)) + b),
+            (x, r)),
+    }
+    out = {"name": "fused_elementwise_micro", "rows": rows, "h": h,
+           "inter": inter, "dtype": "bfloat16"}
+    for nm, (fused, unfused, args) in pairs.items():
+        dt_f = fwdbwd(fused, *args)
+        dt_u = fwdbwd(unfused, *args)
+        out[f"{nm}_fused_ms"] = round(dt_f * 1e3, 3)
+        out[f"{nm}_xla_ms"] = round(dt_u * 1e3, 3)
+        out[f"{nm}_speedup"] = round(dt_u / dt_f, 2)
+    return out
+
+
+def bench_llama_1b_resid_bf16(iters=4, batch=4, seq=1024):
+    """The 1B flagship row with the bf16 residual-stream policy ON
+    (FLAGS_residual_dtype=bfloat16): A/B against the plain llama_1b row —
+    the round-8 bandwidth lever (fused norm kernels keep f32 inside VMEM,
+    the stream crosses HBM in bf16)."""
+    import paddle_tpu as paddle
+
+    paddle.set_flags({"FLAGS_residual_dtype": "bfloat16"})
+    try:
+        res = bench_llama_1b(iters=iters, batch=batch, seq=seq)
+    finally:
+        paddle.set_flags({"FLAGS_residual_dtype": "float32"})
+    res["name"] = "llama_1b_train_bf16_resid_bf16"
+    res["residual_dtype"] = "bfloat16"
+    return res
+
+
 def bench_int8_chain(iters=8, m=2048, k=4096, n=4096, depth=12):
     """Honest int8-vs-bf16 measurement (VERDICT r4 Weak #3): `depth` GEMMs
     chained under lax.scan inside ONE compiled program, so the 13-17 ms
@@ -787,6 +884,8 @@ ALL = {
     "llama": lambda: bench_llama_train(batch=8, amp=False),
     "llama_bf16": bench_llama_train,
     "llama_1b": bench_llama_1b,
+    "llama_1b_resid_bf16": bench_llama_1b_resid_bf16,
+    "fused_micro": bench_fused_elementwise,
     "longctx_4k": bench_llama_longctx,
     "longctx_8k": lambda: bench_llama_longctx(batch=2, seq=8192),
     "flashmask_8k": bench_flashmask_longctx,
@@ -874,7 +973,8 @@ def _headline(results):
 #: long sequence); only used to decide whether a config still fits the
 #: remaining budget — the subprocess timeout enforces the hard cap
 _COST_EST = {
-    "llama_1b": 300, "longctx_4k": 350, "longctx_8k": 400,
+    "llama_1b": 300, "llama_1b_resid_bf16": 300, "fused_micro": 90,
+    "longctx_4k": 350, "longctx_8k": 400,
     "flashmask_8k": 120, "flashmask_16k": 200, "llama_bf16": 130,
     "llama": 120, "gpt_sharding": 220, "bert_bf16": 200, "bert": 200,
     "resnet50_bf16": 250, "resnet50": 340, "lenet": 50, "decode": 70,
@@ -896,7 +996,8 @@ def main(argv):
     # smallest-first and the llama rows never executed. The flagship rows run
     # first and the headline JSON is re-printed after EVERY config, so a
     # timeout's captured tail still carries the best-so-far headline.
-    default = ["llama_1b", "longctx_8k", "flashmask_16k", "longctx_4k",
+    default = ["llama_1b", "llama_1b_resid_bf16", "fused_micro",
+               "longctx_8k", "flashmask_16k", "longctx_4k",
                "flashmask_8k", "llama_bf16", "gpt_sharding", "bert_bf16",
                "llama", "lenet", "decode_1b", "resnet50_bf16", "bert",
                "decode", "int8_chain", "resnet50", "int8", "eager",

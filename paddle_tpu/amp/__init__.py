@@ -50,11 +50,29 @@ def amp_state() -> AmpState | None:
     return getattr(_tls, "amp", None)
 
 
+#: last-axis norms whose implementations accumulate in f32 INTERNALLY
+#: (Pallas kernels / the f32-accumulating XLA chains in nn.functional):
+#: under FLAGS_residual_dtype=bfloat16 their INPUTS stay bf16 — upcasting
+#: at dispatch would re-materialize the f32 residual stream the policy
+#: exists to remove (PERF.md round 8). The fused_add_* ops never upcast:
+#: they ARE the bf16-stream entry points.
+_F32_INTERNAL_NORMS = {"rms_norm", "layer_norm"}
+
+
+def _bf16_residual_stream() -> bool:
+    from ..core.flags import flag
+
+    return str(flag("FLAGS_residual_dtype")).lower() in ("bf16", "bfloat16")
+
+
 def amp_dtype_for(opname) -> "np.dtype | None":
     """Consulted by op_call: returns target compute dtype for this op, or None."""
     st = amp_state()
     if st is None or not st.enable:
         return None
+    if opname in _F32_INTERNAL_NORMS and opname not in st.custom_black \
+            and _bf16_residual_stream():
+        return st.dtype if st.level == "O2" else None
     if st.level == "O2":
         if opname in BLACK_LIST or opname in st.custom_black:
             return dtypes.float32

@@ -168,6 +168,18 @@ define_flag("FLAGS_jit_debug_program", False,
 define_flag("FLAGS_lazy_break_sites", True,
             "record the user file:line that forces each segmented-lazy "
             "flush (graph-break sites, tools/report_graph_breaks.py)")
+define_flag("FLAGS_pallas_fused_ops", True,
+            "route rms/layer norm (+fused residual add), rotary, SwiGLU "
+            "and dropout+add through the Pallas fused kernels on TPU above "
+            "the size threshold (ops/pallas_norm.py); off = the XLA "
+            "compositions everywhere")
+define_flag("FLAGS_residual_dtype", "float32",
+            "dtype of the transformer residual stream in text/models "
+            "(float32 | bfloat16): bfloat16 keeps every inter-kernel "
+            "activation crossing HBM in bf16 — f32 survives only inside "
+            "the norm kernels' accumulation — halving the elementwise "
+            "traffic on this bandwidth-capped device; loss drift is "
+            "bounded by tests/test_pallas_norm.py")
 
 
 # the full reference flag surface (compat entries; must come after the

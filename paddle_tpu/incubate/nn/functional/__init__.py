@@ -3,10 +3,15 @@
 Reference parity: python/paddle/incubate/nn/functional/ (fused_rms_norm.py,
 swiglu.py, fused_rotary_position_embedding.py, fused_moe.py, ...). On the
 reference these bind hand-fused CUDA kernels
-(/root/reference/paddle/phi/kernels/fusion/); here they are the SAME
-computations expressed once in nn.functional — XLA fuses the elementwise
-chains into the surrounding matmuls, and the attention path has its own
-Pallas kernel. The incubate names exist so fused-op user code ports 1:1.
+(/root/reference/paddle/phi/kernels/fusion/); here the bandwidth-bound
+chains bind REAL Pallas TPU kernels (ops/pallas_norm.py: rms/layer norm
+with the preceding residual add fused in, rotary on Q+K in one pass,
+SwiGLU, dropout+add — each one HBM pass fwd and bwd with f32 accumulation
+in VMEM) above a size threshold, and the same computations expressed in
+nn.functional everywhere else (XLA fuses the elementwise chains into the
+surrounding matmuls). The attention path has its own Pallas kernel
+(ops/pallas_attention.py). The incubate names exist so fused-op user code
+ports 1:1; README "Fused ops" has the kernel matrix.
 """
 from __future__ import annotations
 
@@ -14,15 +19,35 @@ from paddle_tpu.nn import functional as F  # noqa: N812
 
 
 def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
-                   begin_norm_axis=-1, **kw):
-    out = F.rms_norm(x, norm_weight, epsilon=epsilon, axis=begin_norm_axis)
+                   begin_norm_axis=-1, residual=None, **kw):
+    """(out, invvar). With `residual`, the residual add fuses into the norm
+    kernel and the return is (out, summed) — the fused_rms_norm_ext
+    contract serving the pre-norm transformer chain."""
+    if begin_norm_axis not in (-1, len(x.shape) - 1):
+        raise NotImplementedError(
+            "fused_rms_norm normalizes the last axis (begin_norm_axis=-1)")
+    if residual is not None:
+        out, summed = F.fused_add_rms_norm(x, residual, norm_weight,
+                                           epsilon=epsilon)
+        if norm_bias is not None:
+            out = out + norm_bias
+        return out, summed
+    out = F.rms_norm(x, norm_weight, epsilon=epsilon)
     if norm_bias is not None:
         out = out + norm_bias
-    return out, None  # reference returns (out, invvar)
+    return out, None  # invvar stays kernel-internal (saved only for bwd)
 
 
 def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
-                     begin_norm_axis=-1, **kw):
+                     begin_norm_axis=-1, residual=None, **kw):
+    """(out, mean, variance) — or (out, summed) with a fused residual."""
+    if residual is not None:
+        if begin_norm_axis not in (-1, len(x.shape) - 1):
+            raise NotImplementedError(
+                "fused_layer_norm(residual=...) normalizes the last axis "
+                "(begin_norm_axis=-1)")
+        return F.fused_add_layer_norm(x, residual, norm_weight, norm_bias,
+                                      epsilon=epsilon)
     shape = x.shape[begin_norm_axis:] if begin_norm_axis >= 0 \
         else x.shape[begin_norm_axis:]
     return F.layer_norm(x, shape, weight=norm_weight, bias=norm_bias,
@@ -35,16 +60,26 @@ def swiglu(x, y=None):
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                                     position_ids=None, use_neox_rotary_style=True):
-    out = F.rotary_position_embedding(q, k, sin=sin, cos=cos,
-                                      position_ids=position_ids,
-                                      use_neox_rotary_style=use_neox_rotary_style)
+    """Rotary embedding on q (and k) — one Pallas kernel for BOTH on TPU.
+    v rides through unrotated (reference contract)."""
+    if not use_neox_rotary_style:
+        raise NotImplementedError(
+            "fused_rotary_position_embedding: interleaved (GPT-J) rotary "
+            "style is not implemented; use_neox_rotary_style=True only")
+    if position_ids is not None:
+        raise NotImplementedError(
+            "fused_rotary_position_embedding: gather the cos/sin tables by "
+            "position_ids before the call (the generation engine does)")
+    if sin is None or cos is None:
+        raise ValueError("fused_rotary_position_embedding needs sin AND cos")
+    qo, ko = F.rotary_position_embedding(q, k, cos, sin)
     if v is not None:
-        return (*out, v)
-    return out
+        return qo, ko, v
+    return (qo, ko) if k is not None else (qo,)
 
 
 def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train"):
-    return F.dropout(x, p=p, training=training, mode=mode) + y
+    return F.fused_dropout_add(x, y, p=p, training=training, mode=mode)
 
 
 def fused_linear(x, weight, bias=None, transpose_weight=False):

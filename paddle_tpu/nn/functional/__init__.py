@@ -187,13 +187,19 @@ def glu(x, axis=-1, name=None):
 
 
 def swiglu(x, y=None, name=None):
-    """Fused SwiGLU (≙ paddle.incubate.nn.functional.swiglu)."""
+    """Fused SwiGLU (≙ paddle.incubate.nn.functional.swiglu). Two-operand
+    form runs the Pallas fused kernel on TPU (silu(gate)*up fwd/bwd in one
+    HBM pass each, f32 math in VMEM); XLA composition elsewhere."""
     if y is None:
         def f(a):
             a1, a2 = jnp.split(a, 2, axis=-1)
             return jax.nn.silu(a1) * a2
 
         return op_call(f, x, name="swiglu")
+    from ...ops import pallas_norm as _pn
+
+    if _pn.use_pallas(x._data if hasattr(x, "_data") else x):
+        return op_call(_pn.swiglu_raw, x, y, name="swiglu")
     return op_call(lambda a, b: jax.nn.silu(a) * b, x, y, name="swiglu")
 
 
@@ -263,6 +269,33 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
     return op_call(f, x, name="dropout")
 
 
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """dropout(x) + y as one op (≙ incubate fused_dropout_add backed by
+    phi fusion/fused_dropout_add_kernel). On TPU the mask-apply + residual
+    add runs as a Pallas kernel (the mask is the only saved state, exactly
+    like the CUDA kernel's mask tensor); elsewhere the XLA composition."""
+    if not training or p == 0.0:
+        return x + y
+    from ...ops import pallas_norm as _pn
+
+    k = next_key()
+    scale = 1.0 / (1.0 - p) if mode == "upscale_in_train" else 1.0
+    if _pn.use_pallas(x._data if hasattr(x, "_data") else x):
+        def fp(a, b):
+            m = jax.random.bernoulli(k, 1.0 - p, a.shape).astype(a.dtype)
+            return _pn.dropout_add_raw(a, b, m, scale)
+
+        return op_call(fp, x, y, name="fused_dropout_add")
+
+    def f(a, b):
+        keep = jax.random.bernoulli(k, 1.0 - p, a.shape)
+        return jnp.where(keep, a * jnp.asarray(scale, a.dtype),
+                         jnp.zeros((), a.dtype)).astype(a.dtype) + b
+
+    return op_call(f, x, y, name="fused_dropout_add")
+
+
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
     axis = [0, 1] if data_format == "NCHW" else [0, 3]
     return dropout(x, p, axis=axis, training=training)
@@ -294,10 +327,30 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
     nshape = (normalized_shape,) if isinstance(normalized_shape, int) else tuple(normalized_shape)
     axes = tuple(range(-len(nshape), 0))
 
+    if len(nshape) == 1:
+        from ...ops import pallas_norm as _pn
+
+        if _pn.use_pallas(x._data if hasattr(x, "_data") else x):
+            def fp(a, *wb):
+                i = 0
+                w = b = None
+                if weight is not None:
+                    w = wb[i]
+                    i += 1
+                if bias is not None:
+                    b = wb[i]
+                return _pn.layer_norm_raw(a, w, b, epsilon)
+
+            args = [x] + [t for t in (weight, bias) if t is not None]
+            return op_call(fp, *args, name="layer_norm")
+
     def f(a, *wb):
-        mean = jnp.mean(a, axis=axes, keepdims=True)
-        var = jnp.var(a, axis=axes, keepdims=True)
-        out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+        # stats accumulate in f32 regardless of activation dtype — the
+        # bf16-residual-stream policy keeps f32 INSIDE the norm only
+        af = a.astype(jnp.float32)
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.var(af, axis=axes, keepdims=True)
+        out = ((af - mean) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
         i = 0
         if weight is not None:
             out = out * wb[i]
@@ -311,7 +364,17 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
 
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
-    """≙ paddle.incubate.nn.functional.fused_rms_norm — XLA fuses this chain."""
+    """≙ paddle.incubate.nn.functional.fused_rms_norm. On TPU above the
+    size threshold this IS a fused Pallas kernel (one HBM pass fwd, one
+    bwd, f32 accumulation, rstd-only residuals); the XLA chain elsewhere."""
+    from ...ops import pallas_norm as _pn
+
+    if _pn.use_pallas(x._data if hasattr(x, "_data") else x):
+        def fp(a, *w):
+            return _pn.rms_norm_raw(a, w[0] if w else None, epsilon)
+
+        args = [x] + ([weight] if weight is not None else [])
+        return op_call(fp, *args, name="rms_norm")
 
     def f(a, *w):
         var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
@@ -320,6 +383,47 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
 
     args = [x] + ([weight] if weight is not None else [])
     return op_call(f, *args, name="rms_norm")
+
+
+def fused_add_rms_norm(x, residual, weight=None, epsilon=1e-6, name=None):
+    """(normed, summed): normed = rmsnorm(x + residual) * weight and
+    summed = x + residual — the pre-norm transformer residual chain as ONE
+    kernel (Pallas on TPU; the same math composed in XLA elsewhere). The
+    summed stream is what the caller threads to the next residual add."""
+    from ...ops import pallas_norm as _pn
+
+    if _pn.use_pallas(x._data if hasattr(x, "_data") else x):
+        def fp(a, r, *w):
+            return _pn.add_rms_norm_raw(a, r, w[0] if w else None, epsilon)
+
+        args = [x, residual] + ([weight] if weight is not None else [])
+        return op_call(fp, *args, name="fused_add_rms_norm")
+    summed = x + residual
+    return rms_norm(summed, weight, epsilon), summed
+
+
+def fused_add_layer_norm(x, residual, weight=None, bias=None, epsilon=1e-5,
+                         name=None):
+    """(normed, summed) for the LayerNorm streams (GPT/BERT blocks); see
+    fused_add_rms_norm."""
+    from ...ops import pallas_norm as _pn
+
+    if _pn.use_pallas(x._data if hasattr(x, "_data") else x):
+        def fp(a, r, *wb):
+            i = 0
+            w = b = None
+            if weight is not None:
+                w = wb[i]
+                i += 1
+            if bias is not None:
+                b = wb[i]
+            return _pn.add_layer_norm_raw(a, r, w, b, epsilon)
+
+        args = [x, residual] + [t for t in (weight, bias) if t is not None]
+        return op_call(fp, *args, name="fused_add_layer_norm")
+    summed = x + residual
+    return layer_norm(summed, summed.shape[-1:], weight, bias,
+                      epsilon), summed
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
@@ -622,14 +726,40 @@ def _pool(x, kernel, stride, padding, nd, kind, data_format, ceil_mode=False,
         if kind == "max":
             init = -jnp.inf if dtypes.is_floating_point(a.dtype) else jnp.iinfo(a.dtype).min
             return jax.lax.reduce_window(a, init, jax.lax.max, window, strides, pads)
-        s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
+        # avg: accumulate window taps in ROW-MAJOR order with a left fold —
+        # reduce_window's reduction order is unspecified, and on
+        # cancellation-heavy windows its f32 rounding differs from the
+        # torch/paddle sequential loop by >1e-5 relative (the seed's
+        # avg-pool parity failures); k^d strided-slice adds fuse into one
+        # XLA kernel and reproduce the reference accumulation bitwise.
+        ap = jnp.pad(a, pads)
+        if exclusive:
+            # count only REAL elements (count_include_pad=False)
+            cnt_src = jnp.pad(jnp.ones_like(a, jnp.float32), pads)
+        else:
+            # count_include_pad=True counts the explicit padding but NOT
+            # the ceil_mode-created extra right padding (torch/paddle rule
+            # for the ceil partial window)
+            expl = [(p[0], min(p[1], pd[i - spatial_first])
+                     if spatial_first <= i < spatial_first + nd else p[1])
+                    for i, p in enumerate(pads)]
+            extra = [(0, p[1] - e[1]) for p, e in zip(pads, expl)]
+            cnt_src = jnp.pad(jnp.pad(jnp.ones_like(a, jnp.float32), expl,
+                                      constant_values=1.0),
+                              extra, constant_values=0.0)
+        outs = [(int(ap.shape[spatial_first + i]) - ks[i]) // st[i] + 1
+                for i in range(nd)]
+        lead = [slice(None)] * spatial_first
+        trail = [slice(None)] * (a.ndim - spatial_first - nd)
+        acc = cnt = None
+        for tap in np.ndindex(*ks):
+            idx = tuple(lead + [slice(tap[i], tap[i] + (outs[i] - 1) * st[i] + 1,
+                                      st[i]) for i in range(nd)] + trail)
+            acc = ap[idx] if acc is None else acc + ap[idx]
+            cnt = cnt_src[idx] if cnt is None else cnt + cnt_src[idx]
         if divisor_override is not None:
-            return s / float(divisor_override)
-        if exclusive and any(p[0] or p[1] for p in pads):
-            ones = jnp.ones_like(a)
-            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
-            return s / cnt
-        return s / float(np.prod(ks))
+            return acc / float(divisor_override)
+        return (acc / cnt.astype(acc.dtype)).astype(a.dtype)
 
     return op_call(f, x, name=f"{kind}_pool{nd}d")
 
@@ -1230,7 +1360,19 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
 
 # ------------------------------------------------------------------ embeddings/rope
 def rotary_position_embedding(q, k, cos, sin, name=None):
-    """≙ paddle.incubate.nn.functional.fused_rotary_position_embedding."""
+    """≙ paddle.incubate.nn.functional.fused_rotary_position_embedding.
+    On TPU above the size threshold Q and K rotate inside ONE Pallas kernel
+    (no materialized rotated halves); XLA composition elsewhere."""
+    from ...ops import pallas_norm as _pn
+
+    qd = q._data if hasattr(q, "_data") else q
+    kd = k._data if (k is not None and hasattr(k, "_data")) else k
+    # the fused kernel processes q and k through the SAME block shapes —
+    # GQA (fewer kv heads) takes the composition path per tensor
+    if k is not None and qd.ndim == 4 and qd.shape[-1] % 2 == 0 \
+            and tuple(qd.shape) == tuple(kd.shape) and _pn.use_pallas(qd):
+        return op_call(_pn.rope_qk_raw, q, k, cos, sin, name="rope_qk",
+                       n_diff=2)
 
     def rot(a, c, s):
         a1, a2 = jnp.split(a, 2, axis=-1)
@@ -1238,6 +1380,8 @@ def rotary_position_embedding(q, k, cos, sin, name=None):
         return a * c + rotated * s
 
     qo = op_call(lambda a, c, s: rot(a, c, s), q, cos, sin, name="rope", n_diff=1)
+    if k is None:
+        return qo, None
     ko = op_call(lambda a, c, s: rot(a, c, s), k, cos, sin, name="rope", n_diff=1)
     return qo, ko
 

@@ -93,6 +93,16 @@ class LayerNorm(Layer):
         return F.layer_norm(x, self._normalized_shape, self.weight, self.bias,
                             self._epsilon)
 
+    def forward_fused_add(self, x, residual):
+        """(normed, summed) with the residual add fused INTO the norm
+        kernel on TPU (F.fused_add_layer_norm): normed = ln(x + residual),
+        summed = x + residual. Exact same math as the unfused chain off the
+        fast path, so callers can thread it unconditionally."""
+        assert len(self._normalized_shape) == 1, \
+            "fused add+LN normalizes the last dim only"
+        return F.fused_add_layer_norm(x, residual, self.weight, self.bias,
+                                      self._epsilon)
+
     def extra_repr(self):
         return f"normalized_shape={self._normalized_shape}, epsilon={self._epsilon}"
 
@@ -108,6 +118,11 @@ class RMSNorm(Layer):
 
     def forward(self, x):
         return F.rms_norm(x, self.weight, self._epsilon)
+
+    def forward_fused_add(self, x, residual):
+        """(normed, summed) via F.fused_add_rms_norm — see
+        LayerNorm.forward_fused_add."""
+        return F.fused_add_rms_norm(x, residual, self.weight, self._epsilon)
 
 
 class GroupNorm(Layer):

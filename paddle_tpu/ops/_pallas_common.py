@@ -1,0 +1,40 @@
+"""Shared plumbing for the Pallas TPU kernel modules
+(pallas_attention.py, pallas_norm.py) — ONE copy of the subtle
+platform/x64 rules so the sibling kernels can never drift apart.
+
+paddle_tpu enables jax x64 globally, and Mosaic cannot legalize stray
+i64/f64 values on real TPUs — so real-TPU traces run with x64 OFF. But
+toggling x64 INSIDE an outer x64 jit trace desynchronizes jnp's internal
+jitted helpers on CPU (jnp.pad's callee traced for i32 shape scalars while
+the caller passes i64 — the seed's sdpa failure, round-8 triage), so
+interpret-mode traces keep the caller's x64 setting.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+try:  # pltpu imports fail cleanly on backends without TPU support
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+try:  # jax >= 0.5 exposes the x64 context manager at top level
+    _enable_x64 = jax.enable_x64
+except AttributeError:  # pragma: no cover — 0.4.x
+    from jax.experimental import enable_x64 as _enable_x64
+
+
+def interpret() -> bool:
+    """True off-TPU: kernels run in the Pallas interpreter (CPU tests)."""
+    return jax.default_backend() != "tpu"
+
+
+def x64_guard():
+    """x64-off context for REAL-TPU traces only (see module docstring)."""
+    return contextlib.nullcontext() if interpret() else _enable_x64(False)
+
+
+def ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m

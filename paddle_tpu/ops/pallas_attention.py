@@ -26,15 +26,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-try:  # pltpu imports fail cleanly on backends without TPU support
-    from jax.experimental.pallas import tpu as pltpu
-except ImportError:  # pragma: no cover
-    pltpu = None
-
-try:  # jax >= 0.5 exposes the x64 context manager at top level
-    _enable_x64 = jax.enable_x64
-except AttributeError:  # pragma: no cover — 0.4.x
-    from jax.experimental import enable_x64 as _enable_x64
+# ONE copy of the platform/x64 rules shared with pallas_norm.py — the
+# x64-toggle behavior is subtle (real-TPU-only; see _pallas_common)
+from ._pallas_common import ceil_to as _ceil_to
+from ._pallas_common import interpret as _interpret
+from ._pallas_common import pltpu
+from ._pallas_common import x64_guard as _x64_guard
 
 # measured on v5e (b8 h16 s1024 d64): 128x128 blocks ran at 3.0 TFLOP/s —
 # grid-overhead/VPU-bound; 512x1024 reached 5.9 before mask specialization
@@ -47,14 +44,6 @@ DEFAULT_BLOCK_K = 1024
 _NEG_INF = np.float32(-1e30)
 _ZERO = np.float32(0.0)
 _ONE = np.float32(1.0)
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
-
-
-def _ceil_to(x: int, m: int) -> int:
-    return (x + m - 1) // m * m
 
 
 def _block_dispatch(compute, *, causal, qi, ki, nk, sq, sk,
@@ -216,7 +205,7 @@ def _flash_forward(q, k, v, causal, block_q, block_k, lens=None):
     # paddle_tpu runs jax with x64 enabled; trace the pallas program with
     # x64 OFF so index-map/kernel literals stay i32/f32 (Mosaic cannot
     # legalize stray i64/f64 values on real TPUs)
-    with _enable_x64(False):
+    with _x64_guard():
         return _flash_forward_x32(q, k, v, causal, block_q, block_k, lens)
 
 
@@ -386,7 +375,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 
 def _flash_backward(q, k, v, o, lse_lanes, do, causal, block_q, block_k,
                     lens=None):
-    with _enable_x64(False):  # see _flash_forward
+    with _x64_guard():  # see _flash_forward
         return _flash_backward_x32(q, k, v, o, lse_lanes, do, causal,
                                    block_q, block_k, lens)
 
@@ -1213,14 +1202,14 @@ def _fm_backward_x32(q, k, v, o, lse_lanes, do, start_rows, causal,
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def _flashmask(q, k, v, start_rows, causal, block_q, block_k,
                bwd_block_q=None, bwd_block_k=None):
-    with _enable_x64(False):
+    with _x64_guard():
         o, _ = _fm_forward_x32(q, k, v, start_rows, causal, block_q, block_k)
     return o
 
 
 def _flashmask_fwd(q, k, v, start_rows, causal, block_q, block_k,
                    bwd_block_q=None, bwd_block_k=None):
-    with _enable_x64(False):
+    with _x64_guard():
         o, lse = _fm_forward_x32(q, k, v, start_rows, causal,
                                  block_q, block_k)
     o, lse = _name_flash_residuals(o, lse)
@@ -1230,7 +1219,7 @@ def _flashmask_fwd(q, k, v, start_rows, causal, block_q, block_k,
 def _flashmask_bwd(causal, block_q, block_k, bwd_block_q, bwd_block_k,
                    res, g):
     q, k, v, o, lse, start_rows = res
-    with _enable_x64(False):
+    with _x64_guard():
         dq, dk, dv = _fm_backward_x32(q, k, v, o, lse, g, start_rows,
                                       causal, bwd_block_q or block_q,
                                       bwd_block_k or block_k)

@@ -1,0 +1,24 @@
+"""Model-agnostic dtype policies shared by the text models (round 8).
+
+FLAGS_residual_dtype=bfloat16 keeps the LLaMA/GPT/BERT residual stream
+(and the rope tables that would otherwise poison the stream back to f32)
+in bf16 between kernels; f32 lives only inside the norm kernels'
+accumulation. ONE definition here so the three models can never drift.
+"""
+from __future__ import annotations
+
+
+def _residual_dtype():
+    """'bfloat16' when the bf16 residual-stream policy is on, else None
+    (f32 stream, the default)."""
+    from ...core.flags import flag
+
+    v = str(flag("FLAGS_residual_dtype")).lower()
+    return "bfloat16" if v in ("bf16", "bfloat16") else None
+
+
+def _cast_residual(x):
+    rd = _residual_dtype()
+    if rd is not None and str(x.dtype) != rd:
+        return x.astype(rd)
+    return x

@@ -45,6 +45,9 @@ class BertEmbeddings(nn.Layer):
         x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
         if token_type_ids is not None:
             x = x + self.token_type_embeddings(token_type_ids)
+        from ._policy import _cast_residual
+
+        x = _cast_residual(x)
         return self.dropout(self.layer_norm(x))
 
 
@@ -81,9 +84,14 @@ class BertLayer(nn.Layer):
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
 
     def forward(self, x, attn_mask=None):
-        x = self.attn_norm(x + self.dropout(self.attention(x, attn_mask)))
+        # post-LN BERT: dropout + residual add run as ONE fused op (Pallas
+        # dropout_add kernel on TPU), the norm kernel takes the second pass
+        h = F.fused_dropout_add(self.attention(x, attn_mask), x,
+                                p=self.dropout.p, training=self.training)
+        x = self.attn_norm(h)
         y = self.output(F.gelu(self.intermediate(x)))
-        return self.out_norm(x + self.dropout(y))
+        return self.out_norm(F.fused_dropout_add(y, x, p=self.dropout.p,
+                                                 training=self.training))
 
 
 class BertModel(nn.Layer):

@@ -58,8 +58,11 @@ class GPTBlock(nn.Layer):
         self.fc_out = row(config.intermediate_size, config.hidden_size)
 
     def forward(self, x):
-        x = x + self.attn(self.ln_1(x))
-        x = x + self.fc_out(F.gelu(self.fc_in(self.ln_2(x))))
+        a = self.attn(self.ln_1(x))
+        # post-attention residual add fused into the LN kernel (one HBM
+        # pass on TPU; identical math off it) — see llama.LlamaDecoderLayer
+        y, x = self.ln_2.forward_fused_add(a, x)
+        x = x + self.fc_out(F.gelu(self.fc_in(y)))
         return x
 
 
@@ -87,9 +90,11 @@ class GPTForCausalLM(nn.Layer):
     def forward(self, input_ids, labels=None):
         import paddle_tpu as paddle
 
+        from ._policy import _cast_residual
+
         s = input_ids.shape[1]
         pos = paddle.arange(s, dtype="int64").unsqueeze(0)
-        x = self.wte(input_ids) + self.wpe(pos)
+        x = _cast_residual(self.wte(input_ids) + self.wpe(pos))
         for blk in self.blocks:
             x = blk(x)
         hidden = self.ln_f(x)

@@ -78,6 +78,8 @@ def llama_tiny_config(**kw) -> LlamaConfig:
     return LlamaConfig(**base)
 
 
+from ._policy import _cast_residual, _residual_dtype  # noqa: E402
+
 _ROPE_CACHE: dict = {}
 
 
@@ -139,6 +141,13 @@ class LlamaAttention(nn.Layer):
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         cos = self.cos[:, :s]
         sin = self.sin[:, :s]
+        rd = _residual_dtype()
+        if rd is not None:
+            # f32 rope tables would promote q/k (and everything downstream
+            # of attention) back to f32 — the single biggest source of f32
+            # elementwise traffic in the bf16 block (PERF.md round 8)
+            cos = cos.astype(rd)
+            sin = sin.astype(rd)
         q, k = F.rotary_position_embedding(q, k, cos, sin)
         out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
                                              is_causal=True)
@@ -177,8 +186,12 @@ class LlamaDecoderLayer(nn.Layer):
             x = x + self.self_attn(self.input_layernorm(x), attn_mask)
             x = x + recompute(self._mlp_branch, x)
             return x
-        x = x + self.self_attn(self.input_layernorm(x), attn_mask)
-        x = x + self.mlp(self.post_attention_layernorm(x))
+        a = self.self_attn(self.input_layernorm(x), attn_mask)
+        # residual add fused INTO the norm kernel: y = norm(x + a) and the
+        # summed stream come out of ONE HBM pass (ops/pallas_norm.py);
+        # exact same math as the x = x + a; norm(x) chain off-TPU
+        y, x = self.post_attention_layernorm.forward_fused_add(a, x)
+        x = x + self.mlp(y)
         return x
 
     def _mlp_branch(self, x):
@@ -196,7 +209,7 @@ class LlamaModel(nn.Layer):
         self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
     def forward(self, input_ids, attn_mask=None):
-        x = self.embed_tokens(input_ids)
+        x = _cast_residual(self.embed_tokens(input_ids))
         if self.config.sequence_parallel:
             # Megatron-SP: activations sequence-sharded between blocks
             # (meta_parallel/sp_utils.py ≙ sequence_parallel_utils.py:429,564)

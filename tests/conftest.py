@@ -53,10 +53,12 @@ def _seed():
 QUICK_MODULES = {
     "test_amp.py", "test_autograd.py", "test_aux_subsystems.py",
     "test_bf16.py", "test_dispatch_cache.py", "test_dist_checkpoint.py",
-    "test_distributed_core.py", "test_dy2static.py", "test_flagship_perf.py",
+    "test_distributed_core.py", "test_dy2static.py", "test_flags_doc.py",
+    "test_flagship_perf.py",
     "test_generation.py", "test_io.py", "test_jit.py", "test_moe.py",
     "test_native.py", "test_new_packages.py", "test_nn.py", "test_ops.py",
-    "test_optimizer.py", "test_pallas_attention.py", "test_passes.py",
+    "test_optimizer.py", "test_pallas_attention.py", "test_pallas_norm.py",
+    "test_passes.py",
     "test_profiler.py", "test_scoreboard.py", "test_segmented.py",
     "test_static_engine.py", "test_vision_ops.py",
 }

@@ -118,9 +118,61 @@ def probe_llama_parts(batch=8, seq=1024):
               flush=True)
 
 
+def probe_residual_policy(batch=8, seq=1024):
+    """Round-8 A/B: the full fwd+bwd step with the f32 vs bf16 residual
+    stream (FLAGS_residual_dtype) — the non-attention bandwidth lever. The
+    fused Pallas norm/rope/swiglu kernels engage on TPU in both rows; only
+    the inter-kernel stream dtype changes."""
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+    for policy in ("float32", "bfloat16"):
+        paddle.set_flags({"FLAGS_residual_dtype": policy})
+        try:
+            paddle.seed(0)
+            cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                              intermediate_size=2816, num_hidden_layers=8,
+                              num_attention_heads=16,
+                              max_position_embeddings=seq)
+            model = LlamaForCausalLM(cfg)
+            model = paddle.amp.decorate(model, level="O2", dtype="bfloat16",
+                                        master_weight=False)
+            rs = np.random.RandomState(0)
+            ids = paddle.to_tensor(
+                rs.randint(0, 32000, (batch, seq)).astype("int64"))
+            small = paddle.to_tensor(
+                rs.randint(0, 32000, (1, 128)).astype("int64"))
+
+            @paddle.jit.to_static(share_discovery=True)
+            def fwd_bwd(x):
+                with paddle.amp.auto_cast(enable=True, dtype="bfloat16",
+                                          level="O2"):
+                    loss = model(x, x)
+                loss.backward()
+                for p in model.parameters():
+                    p.clear_gradient()
+                return loss
+
+            fwd_bwd(small)
+            fwd_bwd(small)
+            dt = timeit(lambda: fwd_bwd(ids), iters=6, warmup=3)
+            n_params = sum(int(np.prod(p.shape))
+                           for p in model.parameters())
+            flops = 3 * 2 * n_params * batch * seq
+            print(json.dumps({"probe": f"fwd_bwd_resid_{policy}",
+                              "ms": round(dt * 1e3, 1),
+                              "tokens_per_sec": round(batch * seq / dt, 1),
+                              "tflops": round(flops / dt / 1e12, 1)}),
+                  flush=True)
+        finally:
+            paddle.set_flags({"FLAGS_residual_dtype": "float32"})
+
+
 if __name__ == "__main__":
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     if which in ("all", "matmul"):
         probe_matmul_peak()
     if which in ("all", "llama"):
         probe_llama_parts()
+    if which in ("all", "resid"):
+        probe_residual_policy()
