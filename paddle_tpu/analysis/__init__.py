@@ -1,0 +1,47 @@
+"""paddle_tpu.analysis — the program auditor + tracer-safety AST lint.
+
+Static-analysis layer over (a) captured jaxprs of `paddle.jit.to_static`
+programs and (b) the framework's own source, emitting structured
+`Finding`s. Driven by `tools/graft_lint.py` (CLI, --json, baseline file)
+and gated in CI via tools/check_scoreboard.py; per-detector fixture tests
+live in tests/test_analysis.py.
+
+Jaxpr detectors (jaxpr_audit, vmem):
+  D1 audit_dtype_stream   f32 residual-stream tensors / silent bf16->f32
+                          promotions under the bf16 stream policy
+  D2 audit_donation       train-step mutated captures not donated (+bytes)
+  D3 audit_host_sync      graph-break flush sites, eager fallbacks, host
+     audit_callbacks      callback primitives inside a compiled step
+  D4 audit_fusion_misses  norm/rotary/swiglu/dropout-add compositions that
+                          did not route to the Pallas fused kernels, with
+                          the gating reason
+  D5 audit_tune_cache     flash autotune entries / norm launch configs
+     audit_norm_config    whose static VMEM estimate busts the per-core
+                          budget
+
+AST rules (ast_lint): x64 toggles outside ops/_pallas_common.py, custom_vjp
+residuals wider than their declared `# vjp-saves:`, flags missing from the
+README table, dy2static-unconvertible constructs in @to_static functions.
+"""
+from .ast_lint import (audit_flags_doc, lint_dy2static, lint_file,
+                       lint_tree, lint_vjp_saves, lint_x64)
+from .findings import (Finding, apply_baseline, format_text, gate_failures,
+                       load_baseline, to_json)
+from .jaxpr_audit import (audit_callbacks, audit_compiled,
+                          audit_donation, audit_dtype_stream,
+                          audit_fusion_misses, audit_host_sync,
+                          infer_stream_shapes, iter_eqns, iter_jaxprs)
+from .vmem import (audit_norm_config, audit_tune_cache, flash_vmem_bytes,
+                   norm_vmem_bytes)
+
+__all__ = [
+    "Finding", "apply_baseline", "format_text", "gate_failures",
+    "load_baseline", "to_json",
+    "audit_callbacks", "audit_compiled", "audit_donation",
+    "audit_dtype_stream", "audit_fusion_misses", "audit_host_sync",
+    "infer_stream_shapes", "iter_eqns", "iter_jaxprs",
+    "audit_norm_config", "audit_tune_cache", "flash_vmem_bytes",
+    "norm_vmem_bytes",
+    "audit_flags_doc", "lint_dy2static", "lint_file", "lint_tree",
+    "lint_vjp_saves", "lint_x64",
+]

@@ -1,0 +1,291 @@
+"""Tracer-safety AST lint over the framework's own source (A1-A4).
+
+The reviews kept re-finding the same framework invariants by hand; each is
+now a static rule over `paddle_tpu/` source, reported before any trace:
+
+  A1 ast-x64        — x64 toggles (jax.enable_x64 / config.update(
+                      "jax_enable_x64")) anywhere but ops/_pallas_common.py.
+                      The x64/interpret rules are subtle (the round-8 sdpa
+                      seed failure was exactly a stray toggle) and live in
+                      ONE place; new toggle sites re-introduce the drift.
+  A2 ast-vjp-saves  — custom_vjp forward rules that declare a reduced
+                      residual save (`# vjp-saves: s, w, rstd`) but return
+                      residuals outside the declaration: the whole-operand
+                      capture silently re-creates the [rows, H] retention
+                      the fused kernels exist to avoid. Opt-in via the
+                      declaration comment (scanned near the def).
+  A3 ast-flags-doc  — flags defined in core/flags.py but missing from the
+                      README Flags table, or defined without a doc string
+                      (the lint-time half of tests/test_flags_doc.py).
+  A4 ast-dy2static  — constructs inside @to_static-decorated functions
+                      that dy2static cannot convert if their predicate
+                      turns out tensor-dependent (`return`/`break`/
+                      `continue` in a controlled body, attribute/subscript
+                      stores): reported statically as notes, before any
+                      trace ever hits the fallback path.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .findings import Finding
+
+#: the one file allowed to touch the x64 switch (see its module docstring)
+_X64_SANCTIONED = ("ops/_pallas_common.py",)
+
+_VJP_DECL = re.compile(r"#\s*vjp-saves:\s*([A-Za-z0-9_,\s]+)")
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _rel(path: str, root: str) -> str:
+    try:
+        return os.path.relpath(path, root)
+    except ValueError:  # pragma: no cover — different drive on win
+        return path
+
+
+# ------------------------------------------------------------------ A1 x64
+
+def _is_x64_touch(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Call):
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else \
+            fn.id if isinstance(fn, ast.Name) else None
+        if name == "enable_x64":
+            return "enable_x64(...) call"
+        if name == "update" and node.args:
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and a0.value == "jax_enable_x64":
+                return 'config.update("jax_enable_x64", ...)'
+    if isinstance(node, ast.ImportFrom) and node.module \
+            and "jax" in node.module:
+        for alias in node.names:
+            if alias.name == "enable_x64":
+                return "import of enable_x64"
+    return None
+
+
+def lint_x64(tree: ast.AST, src: str, relpath: str) -> list[Finding]:
+    if relpath.replace(os.sep, "/").endswith(_X64_SANCTIONED):
+        return []
+    out = []
+    for node in ast.walk(tree):
+        kind = _is_x64_touch(node)
+        if kind:
+            out.append(Finding(
+                "ast-x64", "warning", f"{relpath}:{node.lineno}",
+                f"{kind} outside ops/_pallas_common.py — the x64/interpret "
+                "rules live there (one copy; stray toggles were the "
+                "round-8 sdpa seed failure)", {"kind": kind}))
+    return out
+
+
+# ------------------------------------------------------------ A2 vjp-saves
+
+def _defvjp_fwd_names(tree: ast.AST) -> set[str]:
+    """Names passed as the first argument of any `<prim>.defvjp(fwd, bwd)`
+    call in the module."""
+    fwds = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "defvjp" and node.args \
+                and isinstance(node.args[0], ast.Name):
+            fwds.add(node.args[0].id)
+    return fwds
+
+
+def _declared_saves(fn: ast.FunctionDef, lines: list[str]) -> set[str] | None:
+    """The `# vjp-saves: a, b` declaration near `fn` (the two lines above
+    the def through the end of the function), or None when undeclared."""
+    start = max(0, fn.lineno - 3)
+    end = getattr(fn, "end_lineno", fn.lineno + 20)
+    for ln in lines[start:end]:
+        m = _VJP_DECL.search(ln)
+        if m:
+            return {n.strip() for n in m.group(1).split(",") if n.strip()}
+    return None
+
+
+def _residual_names(fn: ast.FunctionDef) -> list[tuple[int, list[str]]]:
+    """(lineno, [names]) for each `return out, (res...)`-shaped return in
+    `fn` — the residual is the last element of the returned tuple."""
+    out = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Return) \
+                or not isinstance(node.value, ast.Tuple) \
+                or len(node.value.elts) < 2:
+            continue
+        res = node.value.elts[-1]
+        elts = res.elts if isinstance(res, ast.Tuple) else [res]
+        names = [e.id for e in elts if isinstance(e, ast.Name)]
+        out.append((node.lineno, names))
+    return out
+
+
+def lint_vjp_saves(tree: ast.AST, src: str, relpath: str) -> list[Finding]:
+    fwds = _defvjp_fwd_names(tree)
+    if not fwds:
+        return []
+    lines = src.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef) or node.name not in fwds:
+            continue
+        declared = _declared_saves(node, lines)
+        if declared is None:
+            continue
+        for lineno, names in _residual_names(node):
+            extra = [n for n in names if n not in declared]
+            if extra:
+                out.append(Finding(
+                    "ast-vjp-saves", "warning", f"{relpath}:{lineno}",
+                    f"custom_vjp forward '{node.name}' declares "
+                    f"vjp-saves: {sorted(declared)} but its residuals "
+                    f"capture {extra} — a whole-operand save where a "
+                    "reduced save is declared re-creates the activation "
+                    "retention the fused backward avoids",
+                    {"declared": sorted(declared), "extra": extra}))
+    return out
+
+
+# ------------------------------------------------------------ A3 flags-doc
+
+def audit_flags_doc(root: str | None = None) -> list[Finding]:
+    """Repo-level rule: every define_flag in core/flags.py must appear in
+    README.md and carry a non-empty doc string."""
+    root = root or repo_root()
+    flags_path = os.path.join(root, "paddle_tpu", "core", "flags.py")
+    readme_path = os.path.join(root, "README.md")
+    src = open(flags_path).read()
+    tree = ast.parse(src)
+    readme = open(readme_path).read() if os.path.exists(readme_path) else ""
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "define_flag" and node.args
+                and isinstance(node.args[0], ast.Constant)):
+            continue
+        name = node.args[0].value
+        if not name.startswith("FLAGS_"):
+            name = "FLAGS_" + name
+        doc = node.args[2].value if len(node.args) > 2 \
+            and isinstance(node.args[2], ast.Constant) else ""
+        # keyword doc= form
+        for kw in node.keywords:
+            if kw.arg == "doc" and isinstance(kw.value, ast.Constant):
+                doc = kw.value.value
+        loc = f"paddle_tpu/core/flags.py:{node.lineno}"
+        if name not in readme:
+            out.append(Finding(
+                "ast-flags-doc", "warning", loc,
+                f"{name} is defined with real behavior but missing from "
+                "the README Flags table", {"flag": name}))
+        if not doc:
+            out.append(Finding(
+                "ast-flags-doc", "warning", loc,
+                f"{name} is defined without a doc string", {"flag": name}))
+    return out
+
+
+# ----------------------------------------------------------- A4 dy2static
+
+def _is_to_static_decorator(dec: ast.AST) -> bool:
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    if isinstance(target, ast.Name):
+        return target.id == "to_static"
+    if isinstance(target, ast.Attribute):
+        return target.attr == "to_static"
+    return False
+
+
+def _dy2st_hazards(ctl: ast.AST, relpath: str, fn_name: str) -> list[Finding]:
+    """Hazards inside one if/while/for body of a @to_static function."""
+    out = []
+
+    def emit(node, what):
+        out.append(Finding(
+            "ast-dy2static", "note", f"{relpath}:{node.lineno}",
+            f"{what} inside a controlled body of @to_static '{fn_name}' — "
+            "dy2static cannot convert this construct; if the predicate is "
+            "tensor-dependent the step graph-breaks to segmented-lazy "
+            "here (tools/report_graph_breaks.py shows the runtime view)",
+            {"function": fn_name, "construct": what}))
+
+    for node in ast.walk(ctl):
+        if isinstance(node, ast.Return):
+            emit(node, "`return`")
+        elif isinstance(node, (ast.Break, ast.Continue)):
+            kw = "break" if isinstance(node, ast.Break) else "continue"
+            emit(node, f"`{kw}`")
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute):
+                    emit(node, "attribute store (`obj.x = ...`)")
+                elif isinstance(t, ast.Subscript):
+                    emit(node, "subscript store (`t[i] = ...`)")
+    return out
+
+
+def lint_dy2static(tree: ast.AST, src: str, relpath: str) -> list[Finding]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not any(_is_to_static_decorator(d) for d in node.decorator_list):
+            continue
+        for stmt in ast.walk(node):
+            if isinstance(stmt, (ast.If, ast.While, ast.For)) \
+                    and stmt is not node:
+                out.extend(_dy2st_hazards(stmt, relpath, node.name))
+    # de-dup: nested control flow walks the same statement repeatedly
+    seen, uniq = set(), []
+    for f in out:
+        key = (f.loc, f.message)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(f)
+    return uniq
+
+
+# ---------------------------------------------------------------- drivers
+
+_FILE_RULES = (lint_x64, lint_vjp_saves, lint_dy2static)
+
+
+def lint_file(path: str, root: str | None = None) -> list[Finding]:
+    root = root or repo_root()
+    relpath = _rel(path, root)
+    src = open(path).read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding("ast-lint", "error", f"{relpath}:{e.lineno}",
+                        f"syntax error: {e.msg}", {})]
+    out = []
+    for rule in _FILE_RULES:
+        out.extend(rule(tree, src, relpath))
+    return out
+
+
+def lint_tree(root: str | None = None, package: str = "paddle_tpu"
+              ) -> list[Finding]:
+    """Per-file rules over every .py under `package`, plus the repo-level
+    flags-doc rule."""
+    root = root or repo_root()
+    out = []
+    for dirpath, dirnames, filenames in os.walk(os.path.join(root, package)):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.extend(lint_file(os.path.join(dirpath, fn), root))
+    out.extend(audit_flags_doc(root))
+    return out

@@ -1,0 +1,123 @@
+"""Structured findings for the program auditor + AST lint.
+
+Every detector (jaxpr_audit D1-D4, vmem D5, ast_lint A1-A4) emits
+`Finding` records instead of printing or asserting — the same objects feed
+the `tools/graft_lint.py` CLI (text and --json), the CI gate in
+tools/check_scoreboard.py, and the unit tests, so a property proven once
+(e.g. the round-8 "zero f32 stream tensors" jaxpr assertion) is re-checked
+everywhere the detector runs instead of living in one hand-written test.
+
+Severity model:
+  error   — definitely wrong, would misbehave at runtime
+  warning — a perf/correctness hazard the gate fails on
+  note    — informational (e.g. a fusion candidate legitimately gated off
+            on CPU); never fails the gate
+The gate (``gate_failures``) counts unsuppressed error+warning findings.
+
+Baseline/suppression file (JSON, default tools/lint_baseline.json):
+
+    {"suppressions": [
+        {"detector": "ast-x64",
+         "match": "paddle_tpu/__init__.py",
+         "reason": "global x64 enable at import is the sanctioned site"}
+    ]}
+
+A finding is suppressed when `detector` matches exactly and `match` is a
+substring of ``f"{loc} {message}"`` — file-path-ish by convention, so line
+drift does not invalidate entries. Suppressed findings are still reported
+(``suppressed: true`` in --json) for auditability.
+"""
+from __future__ import annotations
+
+import json
+
+SEVERITIES = ("note", "warning", "error")
+
+
+class Finding:
+    """One detector hit: where, what, how bad, plus detector-specific data
+    (shapes, byte counts, gating reasons) for --json consumers."""
+
+    __slots__ = ("detector", "severity", "loc", "message", "data",
+                 "suppressed")
+
+    def __init__(self, detector: str, severity: str, loc: str, message: str,
+                 data: dict | None = None):
+        assert severity in SEVERITIES, severity
+        self.detector = detector
+        self.severity = severity
+        self.loc = loc          # "file.py:123" | "llama/train_step" | ...
+        self.message = message
+        self.data = data or {}
+        self.suppressed = False
+
+    def to_dict(self) -> dict:
+        return {"detector": self.detector, "severity": self.severity,
+                "loc": self.loc, "message": self.message, "data": self.data,
+                "suppressed": self.suppressed}
+
+    def __repr__(self):
+        tag = " [suppressed]" if self.suppressed else ""
+        return (f"[{self.severity}/{self.detector}]{tag} {self.loc}: "
+                f"{self.message}")
+
+
+def load_baseline(path: str) -> list[dict]:
+    """Suppression entries from `path`; missing file = empty baseline. A
+    corrupt file is an error (a silently-ignored baseline would un-suppress
+    everything and fail CI with noise, or worse, a truncated one could hide
+    real findings nondeterministically)."""
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except FileNotFoundError:
+        return []
+    entries = payload.get("suppressions", [])
+    for e in entries:
+        if "detector" not in e or "match" not in e:
+            raise ValueError(
+                f"{path}: each suppression needs 'detector' and 'match' "
+                f"keys, got {e}")
+    return entries
+
+
+def apply_baseline(findings: list[Finding],
+                   baseline: list[dict]) -> list[Finding]:
+    """Mark findings matched by a baseline entry as suppressed (in place);
+    returns the same list for chaining."""
+    for f in findings:
+        hay = f"{f.loc} {f.message}"
+        for e in baseline:
+            if e["detector"] == f.detector and e["match"] in hay:
+                f.suppressed = True
+                break
+    return findings
+
+
+def gate_failures(findings: list[Finding]) -> list[Finding]:
+    """The findings that fail the CI gate: unsuppressed warning/error."""
+    return [f for f in findings
+            if not f.suppressed and f.severity in ("warning", "error")]
+
+
+def to_json(findings: list[Finding]) -> dict:
+    fails = gate_failures(findings)
+    return {
+        "findings": [f.to_dict() for f in findings],
+        "counts": {s: sum(1 for f in findings
+                          if f.severity == s and not f.suppressed)
+                   for s in SEVERITIES},
+        "suppressed": sum(1 for f in findings if f.suppressed),
+        "gate_failures": len(fails),
+        "clean": not fails,
+    }
+
+
+def format_text(findings: list[Finding]) -> str:
+    if not findings:
+        return "graft_lint: clean (0 findings)"
+    lines = [repr(f) for f in findings]
+    fails = gate_failures(findings)
+    lines.append(f"graft_lint: {len(findings)} finding(s), "
+                 f"{len(fails)} gate failure(s)")
+    return "\n".join(lines)

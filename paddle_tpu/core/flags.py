@@ -173,6 +173,14 @@ define_flag("FLAGS_pallas_fused_ops", True,
             "and dropout+add through the Pallas fused kernels on TPU above "
             "the size threshold (ops/pallas_norm.py); off = the XLA "
             "compositions everywhere")
+define_flag("FLAGS_analysis_vmem_limit_mb", 16,
+            "per-core VMEM budget (MiB) the static analyzer checks Pallas "
+            "launch configs against (analysis/vmem.py D5: flash autotune "
+            "entries + norm block configs fail lint, not runtime)")
+define_flag("FLAGS_analysis_fusion_min_elems", 4096,
+            "fusion-miss detector (analysis D4) reporting floor: "
+            "norm/rotary/swiglu/dropout-add compositions smaller than "
+            "this many elements are not worth a finding")
 define_flag("FLAGS_residual_dtype", "float32",
             "dtype of the transformer residual stream in text/models "
             "(float32 | bfloat16): bfloat16 keeps every inter-kernel "

@@ -18,6 +18,21 @@ from ..core.tensor import Tensor
 from .distributions import Distribution, _shape, _t
 
 
+def _binomial_sample(key, n, p, shape):
+    """jax.random.binomial with the sampling dtype matched to the x64 mode.
+
+    paddle_tpu enables jax x64 globally, and this jax's binomial sampler
+    (the btrs/inversion switch in jax._src.random) clamps with PYTHON float
+    literals inside `_stirling_approx_tail` — under x64 those weak-promote
+    to f64 while f32 operands stay f32, and `lax.clamp` raises a dtype
+    mismatch. Sampling in f64 under x64 (f32 otherwise) keeps every operand
+    the same width; the caller casts the counts back down. This was the
+    seed "binomial drift" tier-1 failure: not a distribution drift at all
+    but a dtype crash in the sampler."""
+    dt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    return jax.random.binomial(key, n.astype(dt), p.astype(dt), shape=shape)
+
+
 class ExponentialFamily(Distribution):
     """Base marker for exponential-family distributions (≙ distribution/
     exponential_family.py); entropy via Bregman identity is specialized in
@@ -44,8 +59,7 @@ class Binomial(Distribution):
         shp = _shape(shape, self._batch_shape)
 
         def fn(n, p):
-            return jax.random.binomial(key, n.astype(jnp.float32), p,
-                                       shape=shp).astype(jnp.float32)
+            return _binomial_sample(key, n, p, shp).astype(jnp.float32)
 
         out = op_call(fn, self.total_count, self.probs, name="binomial_sample")
         return out.detach()

@@ -91,7 +91,7 @@ def _struct_key(struct):
 
 class _Specialization:
     __slots__ = ("captures", "ro_caps", "mut_caps", "executable", "out_struct",
-                 "n_out_leaves", "trace_muts", "debug")
+                 "n_out_leaves", "trace_muts", "debug", "donated")
 
 
 #: exception types that mean "this program can't be captured as one graph"
@@ -223,17 +223,24 @@ class CompiledFunction:
         """Jaxpr of a compiled specialization (requires
         FLAGS_jit_debug_program=1 at compile time). For asserting capture
         properties — e.g. that a tensor `if` really lowered to `cond`."""
+        return str(self.program_jaxpr(key))
+
+    def program_jaxpr(self, key: str | None = None):
+        """ClosedJaxpr of a compiled specialization (requires
+        FLAGS_jit_debug_program=1 at compile time) — the object form of
+        program_text(), consumed by paddle_tpu.analysis's jaxpr detectors.
+        """
         if not self._cache:
-            raise RuntimeError("program_text: nothing compiled yet")
+            raise RuntimeError("program_text/jaxpr: nothing compiled yet")
         spec = self._cache[key] if key is not None \
             else next(iter(self._cache.values()))
         dbg = getattr(spec, "debug", None)
         if dbg is None:
             raise RuntimeError(
-                "program_text needs FLAGS_jit_debug_program=1 before the "
-                "compiling call (paddle.set_flags)")
+                "program_text/jaxpr needs FLAGS_jit_debug_program=1 before "
+                "the compiling call (paddle.set_flags)")
         pure, avals = dbg
-        return str(jax.make_jaxpr(pure)(*avals))
+        return jax.make_jaxpr(pure)(*avals)
 
     def __get__(self, instance, owner):
         if instance is None:
@@ -356,6 +363,7 @@ class CompiledFunction:
                     t._data = d
 
         donate = (2,) if (self._donate and mut_caps) else ()
+        spec.donated = bool(donate)   # analysis: donation audit (D2)
         jitted = jax.jit(pure, donate_argnums=donate)
         arg_datas = [t._data for t in leaves]
         ro_datas = [t._data for t in ro_caps]
@@ -495,14 +503,17 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
             return fn
         from ..nn.layer_base import Layer
 
+        donate = kwargs.get("donate_buffers")
         if isinstance(fn, Layer):
             layer = fn
             cf = CompiledFunction(layer.forward, input_spec, build_strategy, backend,
-                                  full_graph, bucket_axes=bucket_axes,
+                                  full_graph, donate_buffers=donate,
+                                  bucket_axes=bucket_axes,
                                   share_discovery=share_discovery)
             layer.forward = cf
             return layer
         return CompiledFunction(fn, input_spec, build_strategy, backend, full_graph,
+                                donate_buffers=donate,
                                 bucket_axes=bucket_axes,
                                 share_discovery=share_discovery)
 

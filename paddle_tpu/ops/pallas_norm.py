@@ -316,6 +316,7 @@ def rms_norm_fused(x, w, eps):
     return y
 
 
+# vjp-saves: x, w, rstd
 def _rms_fwd(x, w, eps):
     y, _, rstd, _ = _norm_forward(x, None, w, None, eps, "rms")
     return y, (x, w, rstd)
@@ -340,6 +341,7 @@ def add_rms_norm_fused(x, res, w, eps):
     return y, s
 
 
+# vjp-saves: s, w, rstd
 def _add_rms_fwd(x, res, w, eps):
     y, s, rstd, _ = _norm_forward(x, res, w, None, eps, "rms")
     return (y, s), (s, w, rstd)
@@ -364,6 +366,7 @@ def layer_norm_fused(x, w, b, eps):
     return y
 
 
+# vjp-saves: x, w, rstd, mean
 def _ln_fwd(x, w, b, eps):
     y, _, rstd, mean = _norm_forward(x, None, w, b, eps, "layer")
     return y, (x, w, rstd, mean)
@@ -384,6 +387,7 @@ def add_layer_norm_fused(x, res, w, b, eps):
     return y, s
 
 
+# vjp-saves: s, w, rstd, mean
 def _add_ln_fwd(x, res, w, b, eps):
     y, s, rstd, mean = _norm_forward(x, res, w, b, eps, "layer")
     return (y, s), (s, w, rstd, mean)
@@ -469,6 +473,7 @@ def rope_qk_fused(q, k, cos, sin):
     return qo, ko
 
 
+# vjp-saves: c2, s2, cos, sin
 def _rope_fwd(q, k, cos, sin):
     c2 = _tables2(cos, q.shape[1], q.shape[3])
     s2 = _tables2(sin, q.shape[1], q.shape[3])
@@ -550,6 +555,7 @@ def _swiglu_call(gate, up, do):
                 du[:rows, :cols].reshape(shape))
 
 
+# vjp-saves: gate, up
 def _swiglu_vjp_fwd(gate, up):
     return _swiglu_call(gate, up, None), (gate, up)
 
@@ -599,6 +605,7 @@ def dropout_add_fused(x, y, mask, scale):
         return out[0][:rows, :cols].reshape(shape)
 
 
+# vjp-saves: mask
 def _dropout_add_vjp_fwd(x, y, mask, scale):
     return dropout_add_fused(x, y, mask, scale), (mask,)
 

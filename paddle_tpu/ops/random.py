@@ -133,7 +133,11 @@ def exponential_(x, lam=1.0, name=None):
 
 def binomial(count, prob, name=None):
     def f(n, p, k):
-        return jax.random.binomial(k, n, p).astype(jnp.int64)
+        # same x64 literal-dtype hazard as distribution/extended.py
+        # _binomial_sample: sample at the x64-consistent width
+        dt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        return jax.random.binomial(k, n.astype(dt),
+                                   p.astype(dt)).astype(jnp.int64)
 
     return op_call(f, count, prob, next_key(), name="binomial", n_diff=0)
 

@@ -51,7 +51,8 @@ def _seed():
 #: cheap; the full suite stays the nightly/tier-1 gate. Membership is
 #: centralized here instead of per-file markers so the set stays auditable.
 QUICK_MODULES = {
-    "test_amp.py", "test_autograd.py", "test_aux_subsystems.py",
+    "test_amp.py", "test_analysis.py", "test_autograd.py",
+    "test_aux_subsystems.py",
     "test_bf16.py", "test_dispatch_cache.py", "test_dist_checkpoint.py",
     "test_distributed_core.py", "test_dy2static.py", "test_flags_doc.py",
     "test_flagship_perf.py",

@@ -1,0 +1,37 @@
+"""The no-fire pair: ordinary code none of the AST rules may flag."""
+import jax
+import jax.numpy as jnp
+
+
+def to_static(fn):
+    return fn
+
+
+@jax.custom_vjp
+def tidy_scale(x, w):
+    return x * w
+
+
+# vjp-saves: x, w
+def _tidy_fwd(x, w):
+    return x * w, (x, w)
+
+
+def _tidy_bwd(res, g):
+    x, w = res
+    return g * w, jnp.sum(g * x)
+
+
+tidy_scale.defvjp(_tidy_fwd, _tidy_bwd)
+
+
+@to_static
+def plain_control_flow(x):
+    y = x
+    if x.sum() > 0:      # convertible: plain threaded state, no escapes
+        y = x * 2
+    else:
+        y = x * 3
+    for _ in range(3):
+        y = y + 1
+    return y

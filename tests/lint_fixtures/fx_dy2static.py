@@ -1,0 +1,27 @@
+"""Intentionally broken: dy2static-unconvertible constructs inside
+@to_static functions — ast-dy2static must fire on each, statically."""
+
+
+def to_static(fn):  # stand-in decorator; the rule matches by name
+    return fn
+
+
+class Counter:
+    def __init__(self):
+        self.hits = 0
+
+
+@to_static
+def early_return(x):
+    if x.sum() > 0:          # tensor predicate: convertible...
+        return x * 2         # ...but `return` in the body is not
+    return x * 3
+
+
+@to_static
+def object_mutation(x, c: Counter):
+    while x.sum() < 10:      # tensor predicate loop
+        x = x + 1
+        c.hits += 1          # attribute store inside the converted body
+        x[0] = 0.0           # subscript store inside the converted body
+    return x

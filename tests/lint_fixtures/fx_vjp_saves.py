@@ -1,0 +1,25 @@
+"""Intentionally broken: the custom_vjp forward declares a reduced residual
+save but captures a whole operand — ast-vjp-saves must fire."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.custom_vjp
+def leaky_norm(x, w):
+    return x * jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6) * w
+
+
+# vjp-saves: w, rstd
+def _leaky_fwd(x, w):
+    rstd = jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6)
+    # BUG the lint must catch: x rides along in the residuals even though
+    # the declaration (and the docstring story) say only w/rstd are saved
+    return x * rstd * w, (x, w, rstd)
+
+
+def _leaky_bwd(res, g):
+    x, w, rstd = res
+    return g * rstd * w, jnp.sum(g * x * rstd, axis=tuple(range(g.ndim - 1)))
+
+
+leaky_norm.defvjp(_leaky_fwd, _leaky_bwd)

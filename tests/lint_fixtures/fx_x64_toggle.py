@@ -1,0 +1,12 @@
+"""Intentionally broken: toggles x64 outside ops/_pallas_common.py — the
+ast-x64 rule must fire on every site here (tests/test_analysis.py)."""
+import contextlib
+
+import jax
+from jax.experimental import enable_x64  # noqa: F401  (import site)
+
+
+def sneaky_toggle():
+    jax.config.update("jax_enable_x64", False)   # config-update site
+    with jax.enable_x64(False):                  # call site
+        return contextlib.nullcontext()

@@ -1,0 +1,434 @@
+"""paddle_tpu.analysis — per-detector fire/no-fire fixture pairs.
+
+Every jaxpr detector (D1 dtype-stream, D2 donation, D3 host-sync, D4
+fusion-miss, D5 vmem-budget) and every AST rule must (a) fire on its
+intentionally-broken fixture and (b) stay silent on the clean twin — the
+proof the ISSUE-9 acceptance demands that the lint gate actually gates.
+Jaxpr fixtures are built directly with jax.make_jaxpr (no model compiles),
+AST fixtures live in tests/lint_fixtures/.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FIXTURES = os.path.join(HERE, "lint_fixtures")
+
+
+def _fx(name):
+    return os.path.join(FIXTURES, name)
+
+
+def _by_detector(findings, det):
+    return [f for f in findings if f.detector == det]
+
+
+# ------------------------------------------------------- D1 dtype-stream
+
+def _stream_chain(x, promote):
+    # bf16 [2,4,256] produced repeatedly = the inferred "residual stream"
+    for _ in range(4):
+        x = x + jnp.ones_like(x)
+    if promote:
+        x = x.astype(jnp.float32) * np.float32(2.0)   # silent re-widening
+        x = x.astype(jnp.bfloat16)
+    return x * 2
+
+
+class TestD1DtypeStream:
+    def _jaxpr(self, promote):
+        x = jnp.ones((2, 4, 256), jnp.bfloat16)
+        return jax.make_jaxpr(lambda a: _stream_chain(a, promote))(x)
+
+    def test_fires_on_silent_promotion(self):
+        fs = analysis.audit_dtype_stream(self._jaxpr(True),
+                                         policy="bfloat16")
+        assert fs, "f32-at-stream-shape must be detected"
+        assert any("promotion" in f.message for f in fs)
+        assert all(f.severity == "warning" for f in fs)
+        assert all(f.data["shape"] == [2, 4, 256] for f in fs)
+
+    def test_silent_on_clean_bf16_stream(self):
+        assert analysis.audit_dtype_stream(self._jaxpr(False),
+                                           policy="bfloat16") == []
+
+    def test_f32_policy_permits_everything(self):
+        assert analysis.audit_dtype_stream(self._jaxpr(True),
+                                           policy="float32") == []
+
+    def test_explicit_stream_shapes_override_inference(self):
+        fs = analysis.audit_dtype_stream(
+            self._jaxpr(True), policy="bfloat16",
+            stream_shapes=[(9, 9, 9)])   # wrong shape: nothing matches
+        assert fs == []
+
+
+# ----------------------------------------------------------- D2 donation
+
+class TestD2Donation:
+    def _train_step(self, donate):
+        paddle.seed(0)
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+
+        net = nn.Linear(8, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        X = paddle.to_tensor(np.random.randn(16, 8).astype("float32"))
+        Y = paddle.to_tensor(np.random.randint(0, 4, (16,)).astype("int64"))
+
+        def step(x, y):
+            loss = F.cross_entropy(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        sf = paddle.jit.to_static(step, **(
+            {} if donate else {"donate_buffers": False}))
+        # donate_buffers is a CompiledFunction ctor arg
+        from paddle_tpu.jit.api import CompiledFunction
+
+        if not isinstance(sf, CompiledFunction):  # pragma: no cover
+            raise AssertionError
+        for _ in range(4):
+            sf(X, Y)
+        return sf
+
+    def test_fires_when_donation_disabled(self):
+        sf = self._train_step(donate=False)
+        fs = analysis.audit_donation(sf)
+        assert len(fs) == 1
+        f = fs[0]
+        assert f.severity == "warning"
+        assert f.data["buffers"] > 0 and f.data["bytes"] > 0
+
+    def test_silent_when_donated(self):
+        sf = self._train_step(donate=True)
+        assert analysis.audit_donation(sf) == []
+
+
+# ---------------------------------------------------------- D3 host-sync
+
+class TestD3HostSync:
+    def test_fires_on_graph_break(self):
+        def breaker(x):
+            if float(x.sum().numpy()) > 0:   # concretization = flush site
+                return x * 2
+            return x * 3
+
+        sf = paddle.jit.to_static(breaker)
+        x = paddle.to_tensor(np.ones((3,), "float32"))
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for _ in range(4):
+                sf(x)
+        fs = analysis.audit_host_sync(sf)
+        assert fs and all(f.detector == "host-sync" for f in fs)
+        assert any("segment" in f.message or "EAGER" in f.message
+                   for f in fs)
+
+    def test_silent_on_compiled_function(self):
+        @paddle.jit.to_static
+        def clean(x):
+            return (x * 2).sum()
+
+        x = paddle.to_tensor(np.ones((3,), "float32"))
+        for _ in range(4):
+            clean(x)
+        assert analysis.audit_host_sync(clean) == []
+
+    def test_callback_primitive_detected(self):
+        def chatty(x):
+            jax.debug.print("x={x}", x=x.sum())
+            return x * 2
+
+        jx = jax.make_jaxpr(chatty)(jnp.ones((4,)))
+        fs = analysis.audit_callbacks(jx)
+        assert fs and fs[0].severity == "warning"
+
+    def test_no_callback_no_finding(self):
+        jx = jax.make_jaxpr(lambda x: x * 2)(jnp.ones((4,)))
+        assert analysis.audit_callbacks(jx) == []
+
+
+# -------------------------------------------------------- D4 fusion-miss
+
+def _rms_composition(x, w):
+    v = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(v + 1e-6)
+            ).astype(x.dtype) * w
+
+
+class TestD4FusionMiss:
+    # 524288 elems: above BOTH the D4 reporting floor and the fused-kernel
+    # routing threshold (1<<18), so "should have routed" is the verdict
+    X = jnp.ones((8, 256, 256), jnp.bfloat16)
+    W = jnp.ones((256,), jnp.bfloat16)
+
+    def test_norm_composition_fires_as_warning_on_tpu(self):
+        jx = jax.make_jaxpr(_rms_composition)(self.X, self.W)
+        fs = _by_detector(
+            analysis.audit_fusion_misses(jx, platform="tpu"), "fusion-miss")
+        assert any(f.data["kind"] == "norm" and f.severity == "warning"
+                   for f in fs), fs
+
+    def test_norm_composition_is_note_off_tpu(self):
+        jx = jax.make_jaxpr(_rms_composition)(self.X, self.W)
+        fs = analysis.audit_fusion_misses(jx, platform="cpu")
+        assert fs and all(f.severity == "note" for f in fs)
+        assert all("not on TPU" in f.data["gate"] for f in fs)
+
+    def test_small_tensor_below_floor_is_silent(self):
+        x = jnp.ones((2, 4, 8), jnp.bfloat16)
+        w = jnp.ones((8,), jnp.bfloat16)
+        jx = jax.make_jaxpr(_rms_composition)(x, w)
+        assert analysis.audit_fusion_misses(jx, platform="tpu") == []
+
+    def test_pallas_routed_program_is_silent(self):
+        from paddle_tpu.ops import pallas_norm as pn
+
+        old = pn.FORCE_PALLAS
+        pn.FORCE_PALLAS = True
+        try:
+            jx = jax.make_jaxpr(
+                lambda a, b: pn.rms_norm_fused(a, b, 1e-6))(
+                    self.X.astype(jnp.float32), self.W.astype(jnp.float32))
+        finally:
+            pn.FORCE_PALLAS = old
+        fs = analysis.audit_fusion_misses(jx, platform="tpu")
+        assert fs == [], ("the fused kernel's own rsqrt (inside "
+                          "pallas_call) must not count as a miss")
+
+    def test_swiglu_composition_fires(self):
+        jx = jax.make_jaxpr(lambda g, u: jax.nn.silu(g) * u)(
+            self.X, self.X)
+        fs = analysis.audit_fusion_misses(jx, platform="tpu")
+        assert any(f.data["kind"] == "swiglu/silu" for f in fs)
+
+    def test_rotary_composition_fires_and_gqa_is_annotated(self):
+        def rope(q, cos, sin):
+            d = q.shape[-1] // 2
+            rot = jnp.concatenate([-q[..., d:], q[..., :d]], axis=-1)
+            return q * cos + rot * sin
+
+        q = jnp.ones((2, 64, 8, 64), jnp.float32)
+        c = jnp.ones((1, 64, 1, 64), jnp.float32)
+        jx = jax.make_jaxpr(rope)(q, c, c)
+        fs = analysis.audit_fusion_misses(jx, platform="tpu")
+        assert any(f.data["kind"] == "rotary" for f in fs)
+
+        # GQA: rotate q and a k with FEWER heads -> mismatch annotation
+        def rope_qk(q, k, cos, sin):
+            return rope(q, cos, sin) + 0 * q.sum(), rope(k, cos, sin)
+
+        k = jnp.ones((2, 64, 2, 64), jnp.float32)
+        jx2 = jax.make_jaxpr(rope_qk)(q, k, c, c)
+        fs2 = analysis.audit_fusion_misses(jx2, platform="tpu")
+        ropes = [f for f in fs2 if f.data["kind"] == "rotary"]
+        assert ropes and all("GQA" in f.data["gate"] for f in ropes)
+
+    def test_dropout_add_composition_fires(self):
+        key = jax.random.PRNGKey(0)
+
+        def dro(x, y):
+            m = (jax.random.uniform(key, x.shape) > 0.1).astype(x.dtype)
+            return x * m * (1 / 0.9) + y
+
+        x = jnp.ones((4, 64, 256), jnp.float32)
+        jx = jax.make_jaxpr(dro)(x, x)
+        fs = analysis.audit_fusion_misses(jx, platform="tpu")
+        assert any(f.data["kind"] == "dropout-add" for f in fs)
+
+
+# -------------------------------------------------------- D5 vmem budget
+
+class TestD5VmemBudget:
+    def test_poisoned_tune_entry_fires(self):
+        entries = {("flash", 8192, 8192, 256, "float32", True):
+                   (4096, 4096, 4096, 4096)}
+        fs = analysis.audit_tune_cache(entries=entries)
+        assert fs and any(f.severity == "warning" for f in fs)
+        assert all(f.detector == "vmem-budget" for f in fs)
+
+    def test_default_blocks_fit(self):
+        entries = {("flash", 1024, 1024, 128, "bfloat16", True):
+                   (512, 1024, 512, 1024)}
+        assert analysis.audit_tune_cache(entries=entries) == []
+
+    def test_malformed_entry_is_a_warning(self):
+        # non-sequence, wrong-arity, and out-of-range values must all be
+        # findings, never unpack crashes (the lint's whole point is that
+        # poisoned entries fail LINT, not a later run)
+        for bad in ({("flash", 1): "junk"},
+                    {("flash", 8192, 8192, 256, "float32", True):
+                     (4096, 4096, 4096)},
+                    {("flash", 8192, 8192, 256, "float32", True): 7},
+                    {("flash", 1024, 1024, 128, "bfloat16", True):
+                     (513, 1024)}):
+            fs = analysis.audit_tune_cache(entries=bad)
+            assert fs and fs[0].severity == "warning", bad
+            assert "malformed" in fs[0].message, bad
+
+    def test_norm_config_width_ladder(self):
+        # flagship widths fit at bf16 with the default 256 block rows;
+        # H=8192 fused-add (4 stream blocks + the f32 copy) does NOT —
+        # the finding tells the caller to shrink block_rows
+        assert analysis.audit_norm_config(4096, itemsize=2) == []
+        fs = analysis.audit_norm_config(8192, itemsize=2)
+        assert fs and fs[0].severity == "warning"
+        assert "block_rows" in fs[0].message
+        assert analysis.audit_norm_config(8192, itemsize=2,
+                                          block_rows=64) == []
+
+    def test_estimator_monotonic(self):
+        a = analysis.flash_vmem_bytes(512, 1024, 128, 2)
+        b = analysis.flash_vmem_bytes(1024, 2048, 128, 2)
+        assert b[0] > a[0] and b[1] > a[1]
+
+
+# ------------------------------------------------------------- AST rules
+
+class TestAstLint:
+    def test_x64_fixture_fires_everywhere(self):
+        fs = _by_detector(analysis.lint_file(_fx("fx_x64_toggle.py")),
+                          "ast-x64")
+        kinds = {f.data["kind"] for f in fs}
+        assert len(fs) >= 3 and {"enable_x64(...) call",
+                                 'config.update("jax_enable_x64", ...)',
+                                 "import of enable_x64"} <= kinds
+
+    def test_vjp_saves_fixture_fires_on_leaked_operand(self):
+        fs = _by_detector(analysis.lint_file(_fx("fx_vjp_saves.py")),
+                          "ast-vjp-saves")
+        assert len(fs) == 1 and fs[0].data["extra"] == ["x"]
+
+    def test_dy2static_fixture_fires_on_each_construct(self):
+        fs = _by_detector(analysis.lint_file(_fx("fx_dy2static.py")),
+                          "ast-dy2static")
+        constructs = {f.data["construct"] for f in fs}
+        assert "`return`" in constructs
+        assert any("attribute store" in c for c in constructs)
+        assert any("subscript store" in c for c in constructs)
+        assert all(f.severity == "note" for f in fs)
+
+    def test_clean_fixture_is_silent(self):
+        assert analysis.lint_file(_fx("fx_clean.py")) == []
+
+    def test_sanctioned_x64_site_exempt(self):
+        path = os.path.join(REPO, "paddle_tpu", "ops", "_pallas_common.py")
+        assert _by_detector(analysis.lint_file(path), "ast-x64") == []
+
+    def test_repo_flags_doc_in_sync(self):
+        assert analysis.audit_flags_doc(REPO) == []
+
+    def test_flags_doc_catches_missing(self, tmp_path):
+        (tmp_path / "paddle_tpu" / "core").mkdir(parents=True)
+        (tmp_path / "paddle_tpu" / "core" / "flags.py").write_text(
+            'define_flag("FLAGS_ghost", True, "undocumented behavior")\n'
+            'define_flag("FLAGS_mute", 1)\n')
+        (tmp_path / "README.md").write_text("# no flags table\nFLAGS_mute\n")
+        fs = analysis.audit_flags_doc(str(tmp_path))
+        msgs = " | ".join(f.message for f in fs)
+        assert "FLAGS_ghost" in msgs and "missing from" in msgs
+        assert "FLAGS_mute" in msgs and "doc string" in msgs
+
+    def test_real_pallas_norm_declarations_hold(self):
+        path = os.path.join(REPO, "paddle_tpu", "ops", "pallas_norm.py")
+        assert _by_detector(analysis.lint_file(path), "ast-vjp-saves") == []
+
+
+# ---------------------------------------------------- baseline + gate
+
+class TestBaselineAndGate:
+    def _mk(self, det, sev, loc="a.py:1", msg="boom"):
+        return analysis.Finding(det, sev, loc, msg)
+
+    def test_gate_counts_warning_and_error_not_notes(self):
+        fs = [self._mk("d", "note"), self._mk("d", "warning"),
+              self._mk("d", "error")]
+        assert len(analysis.gate_failures(fs)) == 2
+
+    def test_baseline_suppresses_by_detector_and_substring(self, tmp_path):
+        p = tmp_path / "base.json"
+        p.write_text(json.dumps({"suppressions": [
+            {"detector": "d1", "match": "a.py", "reason": "known"}]}))
+        fs = [self._mk("d1", "warning", loc="a.py:3"),
+              self._mk("d2", "warning", loc="a.py:3")]
+        analysis.apply_baseline(fs, analysis.load_baseline(str(p)))
+        assert fs[0].suppressed and not fs[1].suppressed
+        assert len(analysis.gate_failures(fs)) == 1
+
+    def test_missing_baseline_is_empty(self, tmp_path):
+        assert analysis.load_baseline(str(tmp_path / "nope.json")) == []
+
+    def test_corrupt_baseline_raises(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text('{"suppressions": [{"detector": "x"}]}')
+        with pytest.raises(ValueError):
+            analysis.load_baseline(str(p))
+
+    def test_json_payload_shape(self):
+        fs = [self._mk("d", "warning")]
+        payload = analysis.to_json(fs)
+        assert payload["gate_failures"] == 1 and not payload["clean"]
+        assert payload["findings"][0]["detector"] == "d"
+
+
+# ------------------------------------------------------------ CLI + gate
+
+@pytest.mark.slow
+def test_cli_full_model_audit_is_clean():
+    """The acceptance command: every smoke config audits clean at default
+    flags through the real CLI (subprocess: own jax session)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graft_lint.py"),
+         "--models", "llama,gpt,bert", "--json"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    payload = json.loads(proc.stdout)
+    assert payload["clean"]
+
+
+def test_cli_ast_and_vmem_clean():
+    """Fast CI shape of the gate: AST lint + tune-cache audit via the CLI."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graft_lint.py"),
+         "--json"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    payload = json.loads(proc.stdout)
+    assert payload["clean"]
+    # the sanctioned x64 site is visibly suppressed, not hidden
+    assert payload["suppressed"] >= 1
+
+
+def test_scoreboard_grew_the_lint_gate():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import check_scoreboard
+
+    assert hasattr(check_scoreboard, "lint_gate")
+    src = open(os.path.join(REPO, "tools", "check_scoreboard.py")).read()
+    assert "lint_gate()" in src.split("def main")[1], \
+        "check_scoreboard.main must run the lint gate"
+
+
+def test_registered_in_quick_tier():
+    src = open(os.path.join(HERE, "conftest.py")).read()
+    assert '"test_analysis.py"' in src.split("QUICK_MODULES")[1], \
+        "tests/test_analysis.py must be registered in QUICK_MODULES"

@@ -164,6 +164,41 @@ def check(repo=REPO, details_path=None, rtol=RTOL):
     return failures
 
 
+def lint_gate(models="llama,gpt,bert", timeout=900):
+    """The graft_lint CI gate (round-9): the AST lint plus the jaxpr
+    program audits over the model smoke configs must come back clean
+    (no unsuppressed warning/error past tools/lint_baseline.json). Runs
+    the CLI in a subprocess so its jax session / flag flips can't leak
+    into the caller. Returns failure strings (empty = clean)."""
+    import subprocess
+
+    cmd = [sys.executable, os.path.join(REPO, "tools", "graft_lint.py"),
+           "--models", models, "--json"]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                              timeout=timeout, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return [f"graft_lint did not finish within {timeout}s — the model "
+                "smoke audits hung or the machine is overloaded; run "
+                "tools/graft_lint.py --models llama,gpt,bert directly"]
+    try:
+        payload = json.loads(proc.stdout)
+    except ValueError:
+        return [f"graft_lint produced no JSON (rc={proc.returncode}): "
+                f"{proc.stderr[-800:] or proc.stdout[-800:]}"]
+    fails = [f for f in payload.get("findings", [])
+             if not f.get("suppressed")
+             and f.get("severity") in ("warning", "error")]
+    out = [f"LINT: [{f['severity']}/{f['detector']}] {f['loc']}: "
+           f"{f['message']}" for f in fails]
+    if proc.returncode != 0 and not out:
+        out.append(f"graft_lint exited {proc.returncode} with no findings "
+                   f"reported: {proc.stderr[-800:]}")
+    return out
+
+
 def main(argv=None):
     failures = check()
     for fl in failures:
@@ -174,6 +209,15 @@ def main(argv=None):
         return 1
     print("scoreboard consistent: every checked doc claim matches "
           "BENCH_DETAILS.json")
+    lint_failures = lint_gate()
+    for fl in lint_failures:
+        print(fl)
+    if lint_failures:
+        print(f"{len(lint_failures)} lint gate failure(s); run "
+              "tools/graft_lint.py --models llama,gpt,bert for details")
+        return 1
+    print("lint gate clean: graft_lint audit of the smoke configs has no "
+          "unsuppressed warnings/errors")
     return 0
 
 

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""graft_lint — program auditor + AST lint CLI (paddle_tpu.analysis).
+
+Runs the tracer-safety AST lint over paddle_tpu/ source and, per model,
+compiles the LLaMA/GPT/BERT smoke configs (forward AND a 2-step AdamW
+train step, the same configs tools/report_graph_breaks.py smokes) with
+FLAGS_jit_debug_program=1 and audits the captured jaxprs:
+
+  D1 dtype-stream (bf16 policy violations / silent promotions)
+  D2 donation (train-step buffers not updated in place, with byte cost)
+  D3 host-sync (graph-break flush sites, eager fallbacks, host callbacks)
+  D4 fusion-miss (unfused norm/rotary/swiglu/dropout-add + gating reason)
+  D5 VMEM budget (flash autotune entries + norm configs vs the per-core
+     limit)
+
+Exit code: 0 when no unsuppressed warning/error finding survives the
+baseline (notes never fail); 1 otherwise. CI runs
+`graft_lint.py --models llama,gpt,bert --json` via tools/check_scoreboard.
+
+Usage:
+    python tools/graft_lint.py                      # AST lint + D5 only
+    python tools/graft_lint.py --models llama,gpt,bert
+    python tools/graft_lint.py --json               # machine output
+    python tools/graft_lint.py --baseline my.json   # suppression file
+    python tools/graft_lint.py --no-ast             # jaxpr audits only
+
+Baseline format: see paddle_tpu/analysis/findings.py (default file
+tools/lint_baseline.json; suppressed findings stay visible in --json).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "lint_baseline.json")
+
+
+def audit_model(name: str) -> list:
+    """Compile the named smoke config (forward + train step) and run every
+    program-level detector. Imports stay inside so `--no-models` runs need
+    no jax session."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis
+    from report_graph_breaks import SMOKES
+
+    fwd_fn, args = SMOKES[name]()
+    model = fwd_fn.__self__
+    findings = []
+
+    paddle.set_flags({"FLAGS_jit_debug_program": True})
+    try:
+        sfwd = paddle.jit.to_static(fwd_fn)
+        for _ in range(3):
+            sfwd(*args)
+        findings += analysis.audit_compiled(sfwd, loc=f"{name}/forward")
+
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+
+        @paddle.jit.to_static
+        def train_step(*a):
+            loss = fwd_fn(*a)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        for _ in range(4):
+            loss = train_step(*args)
+        assert np.isfinite(float(loss)), f"{name} train step diverged"
+        findings += analysis.audit_compiled(train_step,
+                                            loc=f"{name}/train_step")
+
+        # D5 at this model's width (bf16 itemsize: the flagship stream)
+        cfg = getattr(model, "config", None)
+        hidden = getattr(cfg, "hidden_size", None)
+        if hidden:
+            findings += analysis.audit_norm_config(
+                hidden, itemsize=2, loc=f"{name}/norm-config")
+    finally:
+        paddle.set_flags({"FLAGS_jit_debug_program": False})
+    return findings
+
+
+def run(models=(), ast=True, baseline_path=DEFAULT_BASELINE):
+    from paddle_tpu import analysis
+
+    findings = []
+    if ast:
+        findings += analysis.lint_tree(REPO)
+    findings += analysis.audit_tune_cache()
+    for name in models:
+        findings += audit_model(name)
+    analysis.apply_baseline(findings, analysis.load_baseline(baseline_path))
+    return findings
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--models", default="",
+                    help="comma-separated smoke configs to audit "
+                         "(llama,gpt,bert)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help=f"suppression file (default {DEFAULT_BASELINE})")
+    ap.add_argument("--no-ast", action="store_true",
+                    help="skip the AST lint (jaxpr/VMEM audits only)")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    models = [m for m in args.models.split(",") if m]
+    from paddle_tpu import analysis
+
+    findings = run(models=models, ast=not args.no_ast,
+                   baseline_path=args.baseline)
+    if args.as_json:
+        print(json.dumps(analysis.to_json(findings), indent=2))
+    else:
+        print(analysis.format_text(findings))
+    return 1 if analysis.gate_failures(findings) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
