@@ -12,12 +12,12 @@ Jaxpr detectors (jaxpr_audit, vmem):
   D2 audit_donation       train-step mutated captures not donated (+bytes)
   D3 audit_host_sync      graph-break flush sites, eager fallbacks, host
      audit_callbacks      callback primitives inside a compiled step
-  D4 audit_fusion_misses  norm/rotary/swiglu/dropout-add compositions that
-                          did not route to the Pallas fused kernels, with
-                          the gating reason
-  D5 audit_tune_cache     flash autotune entries / norm launch configs
-     audit_norm_config    whose static VMEM estimate busts the per-core
-                          budget
+  D4 audit_fusion_misses  norm/rotary/swiglu/dropout-add/decode-attention
+                          compositions that did not route to the Pallas
+                          fused kernels, with the gating reason
+  D5 audit_tune_cache     flash autotune entries / norm + paged-decode
+     audit_norm_config    launch configs whose static VMEM estimate busts
+     audit_decode_config  the per-core budget
 
 AST rules (ast_lint): x64 toggles outside ops/_pallas_common.py, custom_vjp
 residuals wider than their declared `# vjp-saves:`, flags missing from the
@@ -31,7 +31,8 @@ from .jaxpr_audit import (audit_callbacks, audit_compiled,
                           audit_donation, audit_dtype_stream,
                           audit_fusion_misses, audit_host_sync,
                           infer_stream_shapes, iter_eqns, iter_jaxprs)
-from .vmem import (audit_norm_config, audit_tune_cache, flash_vmem_bytes,
+from .vmem import (audit_decode_config, audit_norm_config,
+                   audit_tune_cache, decode_vmem_bytes, flash_vmem_bytes,
                    norm_vmem_bytes)
 
 __all__ = [
@@ -40,8 +41,8 @@ __all__ = [
     "audit_callbacks", "audit_compiled", "audit_donation",
     "audit_dtype_stream", "audit_fusion_misses", "audit_host_sync",
     "infer_stream_shapes", "iter_eqns", "iter_jaxprs",
-    "audit_norm_config", "audit_tune_cache", "flash_vmem_bytes",
-    "norm_vmem_bytes",
+    "audit_decode_config", "audit_norm_config", "audit_tune_cache",
+    "decode_vmem_bytes", "flash_vmem_bytes", "norm_vmem_bytes",
     "audit_flags_doc", "lint_dy2static", "lint_file", "lint_tree",
     "lint_vjp_saves", "lint_x64",
 ]

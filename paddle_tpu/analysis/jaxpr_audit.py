@@ -21,7 +21,12 @@ earlier round proved with a one-off hand-written assertion:
                      kernels of ops/pallas_norm.py, each annotated with the
                      gating reason (off-TPU, size threshold, dtype, GQA
                      mismatch) — legitimate gates are notes, a composition
-                     that SHOULD have routed is a warning.
+                     that SHOULD have routed is a warning. Round-10 adds
+                     the DECODE-ATTENTION anchor: a gather-over-cache
+                     feeding rank-3 [S, H, T] attention scores that reach a
+                     softmax (the seq-1-query paged decode composition of
+                     ops/pallas_decode.py) — the gating reason is mirrored
+                     from use_pallas_decode's real gates.
 
 Sub-jaxpr recursion covers pjit/cond/while/scan/custom_vjp bodies but stops
 at `pallas_call`: a kernel body is the fused implementation itself — its
@@ -270,6 +275,55 @@ def _chase_to_mul(jaxpr, idx, var, depth=6):
     return None
 
 
+#: consumer plumbing between decode scores and their softmax (scale
+#: divide, length-mask select/where — possibly wrapped in a pjit — dtype
+#: widening); producer plumbing between the cache gather and the score
+#: matmul (layout + GQA head repeat)
+_SOFTMAX_THROUGH = _TRANSPARENT | {"div", "mul", "sub", "max", "min",
+                                   "select_n", "pjit", "stop_gradient",
+                                   "custom_jvp_call",
+                                   "custom_jvp_call_jaxpr"}
+_SOFTMAX_ANCHORS = {"reduce_max", "exp"}
+
+
+def _chase_to_prims(idx, var, targets, through, depth=8):
+    """Follow `var` through `through` ops to the first consumer in
+    `targets`; returns that eqn or None."""
+    frontier = [var]
+    for _ in range(depth):
+        nxt = []
+        for v in frontier:
+            for eqn in idx.get(id(v), []):
+                if eqn.primitive.name in targets:
+                    return eqn
+                if eqn.primitive.name in through:
+                    nxt.extend(eqn.outvars)
+        frontier = nxt
+        if not frontier:
+            break
+    return None
+
+
+def _produced_by(producers, var, targets, through, depth=8):
+    """Walk `var`'s producer chain through `through` ops; True when a
+    producer in `targets` is reached."""
+    frontier = [var]
+    for _ in range(depth):
+        nxt = []
+        for v in frontier:
+            eqn = producers.get(id(v))
+            if eqn is None:
+                continue
+            if eqn.primitive.name in targets:
+                return True
+            if eqn.primitive.name in through:
+                nxt.extend(eqn.invars)
+        frontier = nxt
+        if not frontier:
+            break
+    return False
+
+
 def _gate_reason(n_elems: int, dtype: str, platform: str):
     """Why ops/pallas_norm.use_pallas would decline this tensor — mirrors
     its gate order so the reported reason is the real one."""
@@ -306,6 +360,14 @@ def audit_fusion_misses(closed_jaxpr, platform: str | None = None,
                    rotate-half) feeding `mul`s against cos/sin tables
       dropout-add— RNG bits compared (`lt/gt/ge/le`) then scaled into a
                    stream-size `mul` (mask materialized + separate add)
+      decode-attn— a `dot_general` emitting rank-3 [S, H, T] scores whose
+                   CACHE side comes from a `gather` (the block-table page
+                   gather) and whose output reaches a softmax — the seq-1
+                   paged decode composition that should ride
+                   ops/pallas_decode.py's kernel on TPU; gating reason
+                   mirrored from use_pallas_decode (off-TPU/size/dtype/
+                   head-dim alignment are notes, should-have-routed is a
+                   warning)
     """
     import jax
 
@@ -338,11 +400,48 @@ def audit_fusion_misses(closed_jaxpr, platform: str | None = None,
     has_rng = any(e.primitive.name in ("random_bits", "threefry2x32")
                   for e in iter_eqns(closed_jaxpr))
 
+    def emit_decode(eqn):
+        """The decode-attention anchor's finding: severity from the REAL
+        routing gates of ops/pallas_decode (ONE definition, so the
+        reported reason can never drift from what the router would do)."""
+        from ..ops.pallas_decode import decode_gate_reason
+
+        shape, dtype = _shape_dtype(eqn.outvars[0])
+        if shape is None:
+            return
+        n = _size(shape)
+        if n < min_elems:
+            return
+        (lhs_c, _), _ = eqn.params["dimension_numbers"]
+        lhs_shape = _shape_dtype(eqn.invars[0])[0] or ()
+        head_dim = lhs_shape[lhs_c[0]] if lhs_c else None
+        in_dtype = _shape_dtype(eqn.invars[0])[1]
+        reason, sev = decode_gate_reason(n, in_dtype, platform,
+                                         head_dim=head_dim)
+        findings.append(Finding(
+            "fusion-miss", sev, loc,
+            f"decode-attention composition (gather-over-cache + softmax "
+            f"at seq-1 query scores {in_dtype}{list(shape)}) did not "
+            f"route to the Pallas decode kernel: {reason}",
+            {"kind": "decode-attn", "shape": list(shape),
+             "dtype": in_dtype, "elements": n, "gate": reason}))
+
     for j in iter_jaxprs(closed_jaxpr):
         idx = _consumer_index(j)
         producers = {id(ov): e for e in j.eqns for ov in e.outvars}
         for eqn in j.eqns:
             prim = eqn.primitive.name
+            if prim == "dot_general":
+                shape = _shape_dtype(eqn.outvars[0])[0]
+                if (shape is not None and len(shape) == 3
+                        and _produced_by(producers, eqn.invars[1],
+                                         {"gather"},
+                                         _TRANSPARENT | {"mul"})
+                        and _chase_to_prims(idx, eqn.outvars[0],
+                                            _SOFTMAX_ANCHORS,
+                                            _SOFTMAX_THROUGH) is not None):
+                    emit_decode(eqn)
+                continue
             if prim in ("rsqrt", "logistic"):
                 mul = _chase_to_mul(j, idx, eqn.outvars[0])
                 if mul is None:

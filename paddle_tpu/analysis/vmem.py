@@ -138,6 +138,46 @@ def audit_tune_cache(entries=None, limit_mb=None,
     return findings
 
 
+def decode_vmem_bytes(head_dim: int, block_size: int, group: int = 16,
+                      itemsize: int = 2) -> int:
+    """Working-set estimate for one grid step of the paged flash-decode
+    kernel (ops/pallas_decode._decode_kernel): the GQA-packed query tile
+    q[gp, D] plus one k and one v cache block [block_size, D] streamed
+    per grid step (double-buffered by the pipeline), the o[gp, D] output
+    tile, and the declared f32 scratch acc[gp, D] + m/l[gp, 128]x2."""
+    dp = _ceil128(head_dim)
+    gp = max(16, (int(group) + 15) // 16 * 16)
+    lanes = 128
+    io = (gp * dp                    # q
+          + 2 * block_size * dp      # k, v cache blocks
+          + gp * dp)                 # o
+    scratch = (gp * dp + 2 * gp * lanes) * 4
+    return 2 * io * itemsize + scratch
+
+
+def audit_decode_config(head_dim: int, block_size: int, group: int = 16,
+                        itemsize: int = 2, limit_mb=None,
+                        loc: str = "pallas-decode-config") -> list[Finding]:
+    """D5 for the decode kernel's launch config at a model's head
+    geometry — an oversized kv block (FLAGS_kv_block_size) fails lint
+    here instead of Mosaic at serving time."""
+    limit = _limit_bytes(limit_mb)
+    est = decode_vmem_bytes(head_dim, block_size, group, itemsize)
+    if est <= 0.8 * limit:
+        return []
+    sev = "warning" if est > limit else "note"
+    verdict = "exceeds" if est > limit else "is within 20% of"
+    return [Finding(
+        "vmem-budget", sev, loc,
+        f"paged decode blocks (block_size={block_size}, head_dim="
+        f"{head_dim}, group={group}, itemsize {itemsize}) estimate "
+        f"{est / 2**20:.1f} MiB VMEM — {verdict} the "
+        f"{limit / 2**20:.0f} MiB per-core budget; lower "
+        "FLAGS_kv_block_size for this geometry",
+        {"head_dim": head_dim, "block_size": block_size,
+         "estimate_bytes": est, "limit_bytes": limit})]
+
+
 def audit_norm_config(hidden_size: int, itemsize: int = 2,
                       block_rows: int | None = None, limit_mb=None,
                       loc: str = "pallas-norm-config") -> list[Finding]:

@@ -181,6 +181,24 @@ define_flag("FLAGS_analysis_fusion_min_elems", 4096,
             "fusion-miss detector (analysis D4) reporting floor: "
             "norm/rotary/swiglu/dropout-add compositions smaller than "
             "this many elements are not worth a finding")
+define_flag("FLAGS_pallas_decode", True,
+            "route paged decode attention through the Pallas flash-decode "
+            "kernel (ops/pallas_decode.py) on TPU above the size "
+            "threshold; off = the XLA gather+softmax composition "
+            "everywhere")
+define_flag("FLAGS_kv_block_size", 16,
+            "tokens per KV-cache block in the paged serving engine "
+            "(text/paged_cache.py); must be a multiple of 8 so a "
+            "(block_size, head_dim) cache tile is sublane-aligned")
+define_flag("FLAGS_kv_cache_dtype", "model",
+            "paged KV cache storage dtype: model (match the model's "
+            "compute dtype) | int8 (per-block-scale quantized cache — "
+            "decode reads halve; blocks requantize on append)")
+define_flag("FLAGS_serving_slots", 8,
+            "slot count of the continuous-batching serving engine "
+            "(inference/engine.py): the fixed request-slot array the "
+            "per-step program runs over; requests join freed slots "
+            "mid-flight")
 define_flag("FLAGS_residual_dtype", "float32",
             "dtype of the transformer residual stream in text/models "
             "(float32 | bfloat16): bfloat16 keeps every inter-kernel "

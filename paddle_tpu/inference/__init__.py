@@ -1,4 +1,8 @@
-from .predictor import Config, PrecisionType, Predictor, Tensor as InferTensor, create_predictor
+from .engine import Request, ServingEngine, generate_paged
+from .predictor import (Config, PrecisionType, Predictor,
+                        ServingPredictor, Tensor as InferTensor,
+                        create_predictor, create_serving_predictor)
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
-           "InferTensor"]
+           "InferTensor", "ServingEngine", "ServingPredictor", "Request",
+           "create_serving_predictor", "generate_paged"]

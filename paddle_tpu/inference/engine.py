@@ -1,0 +1,620 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+Reference parity: the serving stack the reference builds around
+block_multihead_attention (paged/block KV) — here grown into the full
+PagedAttention/continuous-batching engine shape (Kwon et al., vLLM): a
+fixed SLOT array, block-granular KV allocation with admission control,
+and requests that join freed slots mid-flight instead of waiting for a
+whole static batch to drain.
+
+TPU-native design:
+  - Per step the scheduler runs at most TWO compiled-program families,
+    both static-shaped: a PREFILL program per joining request (keyed by
+    the prompt-length bucket; rides the Pallas flash kernel on TPU and
+    scatters the prompt's K/V into its pages), and ONE DECODE program
+    advancing every active slot one token (keyed by the active-slot-count
+    bucket — 1/2/4/8/... — so a half-empty engine doesn't pay the full
+    slot array). That is the per-slot prefill-or-decode dispatch: the
+    host decides which program touches each slot, the programs never
+    branch dynamically.
+  - Slot state entering the decode program is COMPACTED: tokens /
+    positions / block-table rows / sampling params of the active slots
+    are gathered into bucket-sized arrays (cheap — the KV pool itself is
+    shared and addressed through the tables, it never moves). Padded rows
+    point at the reserved trash block and their outputs are dropped.
+  - Per-request sampling params thread as BATCHED arrays (temperature /
+    top-k / top-p / greedy mask per slot), so mixed sampling configs share
+    one program.
+  - Cache buffers are DONATED to the step programs on TPU: the pool is
+    updated in place, never copied (a [L, N, Hkv, bs, D] pool is the
+    dominant HBM tenant at serving time).
+
+The scheduler (admission, eos/length finish, block free/reuse, stats) is
+host-side Python — it runs while the device executes, and its decisions
+only ever pick which compiled program to invoke next.
+"""
+from __future__ import annotations
+
+import functools
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops._pallas_common import ceil_to as _ceil_to
+from ..text.generation import (_GenSpec, _gpt_layer_prefill,
+                               _layer_forward_prefill, _layer_norm,
+                               _logits, _mm, _rms_norm, _rope,
+                               _stacked_params, _stacked_params_gpt)
+from ..text.paged_cache import (TRASH_BLOCK, BlockAllocator, PagedKVCache,
+                                append_token, append_token_int8,
+                                blocks_for, scatter_prefill,
+                                scatter_prefill_int8)
+
+
+# ------------------------------------------------------ batched sampling
+
+def _sample_batched(logits, key, do_sample, temperature, top_k, top_p):
+    """Per-slot (greedy | temperature/top-k/top-p) sampling over [B, V]
+    logits with the sampling params as BATCHED arrays — one program serves
+    mixed per-request configs. Greedy rows are exact argmax (token-parity
+    with text/generation._sample_token); top-k is applied before top-p in
+    the same order as the single-program engine."""
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lg = logits.astype(jnp.float32) / jnp.maximum(temperature,
+                                                  1e-6)[:, None]
+    srt = jnp.sort(lg, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(srt, jnp.clip(top_k - 1, 0, v - 1)[:, None],
+                              axis=-1)
+    lg = jnp.where((top_k > 0)[:, None] & (lg < kth), -jnp.inf, lg)
+    srt2 = jnp.sort(lg, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(srt2, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = cum - probs < top_p[:, None]
+    cutoff = jnp.min(jnp.where(keep, srt2, jnp.inf), axis=-1,
+                     keepdims=True)
+    lg = jnp.where((top_p < 1.0)[:, None] & (lg < cutoff), -jnp.inf, lg)
+    sampled = jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+    return jnp.where(do_sample, sampled, greedy)
+
+
+# --------------------------------------------------- paged decode layers
+
+def _paged_attn(hn_q, k_new, v_new, kc, vc, ksc, vsc, tables, pos,
+                block_size, quantized):
+    """Shared append+attend: write this step's K/V through the block
+    table, then paged decode attention over lens = pos + 1 (the just-
+    written token included, matching the single-program engine's
+    `arange <= pos` mask)."""
+    from ..ops.pallas_decode import paged_decode_attention
+
+    b = hn_q.shape[0]
+    blk = tables[jnp.arange(b), pos // block_size]
+    off = (pos % block_size).astype(jnp.int32)
+    if quantized:
+        kc, ksc = append_token_int8(kc, ksc, k_new, blk, off)
+        vc, vsc = append_token_int8(vc, vsc, v_new, blk, off)
+    else:
+        kc = append_token(kc, k_new, blk, off)
+        vc = append_token(vc, v_new, blk, off)
+    out = paged_decode_attention(hn_q, kc, vc, tables, pos + 1, ksc, vsc)
+    return out, kc, vc, ksc, vsc
+
+
+def _paged_layer_llama(x, lw, kc, vc, ksc, vsc, pos, tables, spec,
+                       cos, sin, block_size, quantized):
+    """One LLaMA block for seq-1 queries at PER-SLOT positions against
+    the paged cache. x [B, H]; kc/vc one layer's pool slice."""
+    b, h = x.shape
+    hn = _rms_norm(x, lw["input_ln"], spec.rms_eps)
+    q = _mm(hn, lw["q"]).reshape(b, spec.num_heads, spec.head_dim)
+    k = _mm(hn, lw["k"]).reshape(b, spec.num_kv_heads, spec.head_dim)
+    v = _mm(hn, lw["v"]).reshape(b, spec.num_kv_heads, spec.head_dim)
+    c = cos[pos][:, None]                       # [B, 1, D]
+    sn = sin[pos][:, None]
+    q = _rope(q, c, sn)
+    k = _rope(k, c, sn)
+    out, kc, vc, ksc, vsc = _paged_attn(q, k, v, kc, vc, ksc, vsc,
+                                        tables, pos, block_size, quantized)
+    x = x + _mm(out.reshape(b, spec.num_heads * spec.head_dim), lw["o"])
+    hn = _rms_norm(x, lw["post_ln"], spec.rms_eps)
+    mlp = _mm(jax.nn.silu(_mm(hn, lw["gate"])) * _mm(hn, lw["up"]),
+              lw["down"])
+    return x + mlp, kc, vc, ksc, vsc
+
+
+def _paged_layer_gpt(x, lw, kc, vc, ksc, vsc, pos, tables, spec,
+                     block_size, quantized):
+    """Pre-LN GPT block, paged decode variant."""
+    b, h = x.shape
+    hn = _layer_norm(x, lw["ln1_w"], lw["ln1_b"], spec.rms_eps)
+    qkv = (hn @ lw["qkv"]).reshape(b, 3, spec.num_heads, spec.head_dim)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    out, kc, vc, ksc, vsc = _paged_attn(q, k, v, kc, vc, ksc, vsc,
+                                        tables, pos, block_size, quantized)
+    x = x + out.reshape(b, spec.num_heads * spec.head_dim) @ lw["o"]
+    hn = _layer_norm(x, lw["ln2_w"], lw["ln2_b"], spec.rms_eps)
+    x = x + jax.nn.gelu(hn @ lw["fc_in"], approximate=False) @ lw["fc_out"]
+    return x, kc, vc, ksc, vsc
+
+
+# ------------------------------------------------------- step programs
+
+def _decode_step_impl(spec: _GenSpec, block_size: int, quantized: bool,
+                      any_sample: bool, params, tok, pos, tables, kc, vc,
+                      ksc, vsc, samp, key):
+    """ONE decode step for a compacted slot bucket: every row consumes
+    its token, appends K/V through its block table, attends over its own
+    length, and samples its next token with its own params. Cache pools
+    ride the layer scan as xs/ys exactly like the single-program engine.
+    `any_sample` is STATIC (part of the program key): an all-greedy bucket
+    — the common serving case — compiles to a bare argmax instead of the
+    sort/softmax/cumsum sampling machinery over [B, V] every tick.
+    """
+    gpt = spec.arch == "gpt"
+    dtype = params["embed"].dtype
+    xt = params["embed"][tok].astype(dtype)              # [B, H]
+    if gpt:
+        xt = xt + params["wpe"][pos]
+    else:
+        cos, sin = params["rope_cos"], params["rope_sin"]
+
+    def layer(xc, per_layer):
+        if quantized:
+            lw, kcl, vcl, kscl, vscl = per_layer
+        else:
+            lw, kcl, vcl = per_layer
+            kscl = vscl = None
+        if gpt:
+            xo, kcl, vcl, kscl, vscl = _paged_layer_gpt(
+                xc, lw, kcl, vcl, kscl, vscl, pos, tables, spec,
+                block_size, quantized)
+        else:
+            xo, kcl, vcl, kscl, vscl = _paged_layer_llama(
+                xc, lw, kcl, vcl, kscl, vscl, pos, tables, spec,
+                cos, sin, block_size, quantized)
+        ys = (kcl, vcl, kscl, vscl) if quantized else (kcl, vcl)
+        return xo, ys
+
+    xs = (params["layers"], kc, vc) + ((ksc, vsc) if quantized else ())
+    xt, ys = jax.lax.scan(layer, xt, xs)
+    if quantized:
+        kc, vc, ksc, vsc = ys
+    else:
+        kc, vc = ys
+    lg = _logits(xt, params, spec)                       # [B, V] f32
+    if any_sample:
+        key, sub = jax.random.split(key)
+        nxt = _sample_batched(lg, sub, samp["do_sample"],
+                              samp["temperature"], samp["top_k"],
+                              samp["top_p"])
+    else:
+        nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+    return nxt, kc, vc, ksc, vsc, key
+
+
+def _prefill_impl(spec: _GenSpec, block_size: int, quantized: bool,
+                  any_sample: bool, params, ids, true_len, table_row, kc,
+                  vc, ksc, vsc, samp, key):
+    """Prefill one joining request: full-prompt forward (Pallas flash on
+    TPU), page-scatter the prompt K/V through the slot's block table, and
+    sample the first token from the last REAL prompt position."""
+    gpt = spec.arch == "gpt"
+    b, s = ids.shape
+    if gpt:
+        x = params["embed"][ids] + params["wpe"][None, :s]
+
+        def pre(xc, lw):
+            return _gpt_layer_prefill(xc, lw, spec)
+    else:
+        cos, sin = params["rope_cos"], params["rope_sin"]
+        x = params["embed"][ids]
+
+        def pre(xc, lw):
+            return _layer_forward_prefill(xc, lw, spec, cos, sin)
+
+    x, (ks, vs) = jax.lax.scan(pre, x, params["layers"])
+    ks, vs = ks[:, 0], vs[:, 0]                          # [L, S, Hkv, D]
+    if quantized:
+        kc, ksc = scatter_prefill_int8(kc, ksc, ks, true_len, table_row,
+                                       block_size)
+        vc, vsc = scatter_prefill_int8(vc, vsc, vs, true_len, table_row,
+                                       block_size)
+    else:
+        kc = scatter_prefill(kc, ks, true_len, table_row, block_size)
+        vc = scatter_prefill(vc, vs, true_len, table_row, block_size)
+    x_last = jax.lax.dynamic_slice_in_dim(x, true_len - 1, 1,
+                                          axis=1)[:, 0]
+    lg = _logits(x_last, params, spec)                   # [1, V]
+    if any_sample:
+        key, sub = jax.random.split(key)
+        tok = _sample_batched(lg, sub, samp["do_sample"],
+                              samp["temperature"], samp["top_k"],
+                              samp["top_p"])
+    else:
+        tok = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+    return tok, kc, vc, ksc, vsc, key
+
+
+_decode_step = functools.partial(
+    jax.jit, static_argnums=(0, 1, 2, 3),
+    donate_argnums=(8, 9, 10, 11))(_decode_step_impl)
+_prefill_step = functools.partial(
+    jax.jit, static_argnums=(0, 1, 2, 3),
+    donate_argnums=(8, 9, 10, 11))(_prefill_impl)
+
+
+# ------------------------------------------------------------ scheduler
+
+class Request:
+    """One generation request riding the engine."""
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "do_sample",
+                 "temperature", "top_k", "top_p", "eos_token_id",
+                 "tokens", "arrival_s", "first_token_s", "finished")
+
+    def __init__(self, rid, prompt, max_new_tokens, do_sample, temperature,
+                 top_k, top_p, eos_token_id):
+        self.rid = rid
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.do_sample = bool(do_sample)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.eos_token_id = -1 if eos_token_id is None else int(eos_token_id)
+        self.tokens: list[int] = []
+        self.arrival_s = time.perf_counter()
+        self.first_token_s = None
+        self.finished = False
+
+    @property
+    def ttft_s(self):
+        if self.first_token_s is None:
+            return None
+        return self.first_token_s - self.arrival_s
+
+
+class ServingEngine:
+    """Continuous-batching scheduler over a fixed slot array + paged KV
+    pool. `admission="continuous"` (default) refills freed slots
+    mid-flight; `admission="static"` only admits into an EMPTY engine
+    (whole-batch waves) — the baseline the serving bench compares
+    utilization against."""
+
+    def __init__(self, model, max_slots=None, kv_block_size=None,
+                 num_kv_blocks=None, kv_cache_dtype=None,
+                 max_model_len=None, seed=0, admission="continuous"):
+        from ..core.flags import flag
+
+        cfg = model.config
+        arch = getattr(model, "_gen_arch", "llama")
+        if arch == "gpt":
+            nh = cfg.num_attention_heads
+            self.spec = _GenSpec(
+                num_layers=cfg.num_hidden_layers, num_heads=nh,
+                num_kv_heads=nh, head_dim=cfg.hidden_size // nh,
+                rope_theta=0.0, rms_eps=cfg.layer_norm_eps,
+                max_new_tokens=0, do_sample=False, top_k=0, top_p=1.0,
+                temperature=1.0, eos_token_id=-1, tie_embeddings=False,
+                arch="gpt")
+            self.params = _stacked_params_gpt(model)
+        else:
+            self.spec = _GenSpec(
+                num_layers=cfg.num_hidden_layers,
+                num_heads=cfg.num_attention_heads,
+                num_kv_heads=cfg.num_key_value_heads,
+                head_dim=cfg.head_dim, rope_theta=cfg.rope_theta,
+                rms_eps=cfg.rms_norm_eps, max_new_tokens=0,
+                do_sample=False, top_k=0, top_p=1.0, temperature=1.0,
+                eos_token_id=-1,
+                tie_embeddings=bool(cfg.tie_word_embeddings))
+            self.params = _stacked_params(model)
+        self.block_size = int(kv_block_size or flag("FLAGS_kv_block_size"))
+        self.max_slots = int(max_slots or flag("FLAGS_serving_slots"))
+        if self.max_slots < 1:
+            raise ValueError("need at least one serving slot")
+        mode = str(kv_cache_dtype or flag("FLAGS_kv_cache_dtype"))
+        if mode not in ("model", "int8"):
+            raise ValueError(f"kv_cache_dtype must be 'model' or 'int8', "
+                             f"got {mode!r}")
+        self.quantized = mode == "int8"
+        dtype = self.params["embed"].dtype
+        # usable context rounds DOWN to whole pages (prompt + decode both
+        # address the cache through page-granular tables)
+        max_pos = int(cfg.max_position_embeddings)
+        mml = min(int(max_model_len or max_pos), max_pos)
+        self.max_model_len = (mml // self.block_size) * self.block_size
+        if self.max_model_len < self.block_size:
+            raise ValueError(
+                f"max_model_len {mml} below one kv block ({self.block_size})")
+        self.pages = self.max_model_len // self.block_size
+        # default pool: every slot can hold a full-context sequence (+the
+        # trash block); size it down to exercise admission control
+        if num_kv_blocks is None:
+            num_kv_blocks = 1 + self.max_slots * self.pages
+        self.cache = PagedKVCache(
+            self.spec.num_layers, int(num_kv_blocks),
+            self.spec.num_kv_heads, self.block_size, self.spec.head_dim,
+            "int8" if self.quantized else dtype)
+        self.allocator = BlockAllocator(int(num_kv_blocks))
+        if admission not in ("continuous", "static"):
+            raise ValueError(f"unknown admission mode {admission!r}")
+        self.admission = admission
+        self._tables = np.zeros((self.max_slots, self.pages), np.int32)
+        self._slot_req: list[Request | None] = [None] * self.max_slots
+        self._slot_pos = np.zeros(self.max_slots, np.int64)
+        self._slot_blocks: list[list[int]] = [[] for _ in
+                                              range(self.max_slots)]
+        self._waiting: deque[Request] = deque()
+        self._key = jax.random.PRNGKey(int(seed))
+        self._next_id = 0
+        # stats (the serving bench's raw material); decode/prefill wall
+        # time is split so throughput numbers divide by the right clock
+        self.steps = 0
+        self.active_slot_steps = 0
+        self.decode_tokens = 0
+        self.prefill_tokens = 0
+        self.decode_time_s = 0.0
+        self.prefill_time_s = 0.0
+        self.completed: dict[int, np.ndarray] = {}
+        self.ttfts: list[float] = []
+
+    # ------------------------------------------------------------- API
+    def add_request(self, prompt, max_new_tokens=32, do_sample=False,
+                    temperature=1.0, top_k=0, top_p=1.0,
+                    eos_token_id=None) -> int:
+        """Queue a request. Raises when it could NEVER be served (context
+        or pool too small); otherwise it waits for admission."""
+        prompt = np.asarray(
+            prompt._data if hasattr(prompt, "_data") else prompt,
+            np.int64).reshape(-1).astype(np.int32)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if int(max_new_tokens) < 1:
+            raise ValueError("max_new_tokens must be positive")
+        total = prompt.size + int(max_new_tokens)
+        if total > self.max_model_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) = {total} exceeds the engine context "
+                f"({self.max_model_len} = max_position_embeddings rounded "
+                f"down to whole {self.block_size}-token kv blocks)")
+        need = blocks_for(total, self.block_size)
+        if need > self.allocator.num_blocks - 1:
+            raise ValueError(
+                f"request needs {need} kv blocks but the pool only has "
+                f"{self.allocator.num_blocks - 1}")
+        rid = self._next_id
+        self._next_id += 1
+        self._waiting.append(Request(rid, prompt, max_new_tokens,
+                                     do_sample, temperature, top_k, top_p,
+                                     eos_token_id))
+        return rid
+
+    @property
+    def num_active(self) -> int:
+        return sum(r is not None for r in self._slot_req)
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self._waiting)
+
+    def has_work(self) -> bool:
+        return bool(self._waiting) or self.num_active > 0
+
+    def step(self):
+        """One scheduler tick: admit (prefill) joining requests, then
+        advance every active slot one token. Returns a list of
+        (request_id, token, finished) for tokens emitted this tick."""
+        emitted = list(self._admit())
+        active = [i for i, r in enumerate(self._slot_req) if r is not None]
+        if active:
+            emitted.extend(self._decode(active))
+            self.steps += 1
+            self.active_slot_steps += len(active)
+        return emitted
+
+    def run(self, max_steps=100000):
+        """Drive the engine until every queued request completes; returns
+        {request_id: np.ndarray of generated tokens}."""
+        for _ in range(max_steps):
+            if not self.has_work():
+                break
+            self.step()
+        else:
+            raise RuntimeError("serving engine did not drain (max_steps)")
+        return dict(self.completed)
+
+    def stats(self) -> dict:
+        util = (self.active_slot_steps / (self.steps * self.max_slots)
+                if self.steps else 0.0)
+        return {"steps": self.steps, "decode_tokens": self.decode_tokens,
+                "prefill_tokens": self.prefill_tokens,
+                "decode_time_s": self.decode_time_s,
+                "prefill_time_s": self.prefill_time_s,
+                "slot_utilization": round(util, 4),
+                "ttft_s": list(self.ttfts),
+                "kv_pool_blocks": self.allocator.num_blocks,
+                "kv_pool_free": self.allocator.available,
+                "kv_hbm_bytes": self.cache.hbm_bytes}
+
+    # ------------------------------------------------------- scheduling
+    def _admit(self):
+        """Admission control: head-of-line requests enter freed slots only
+        when the allocator covers their FULL (prompt + max_new) block
+        budget — admitted requests can never OOM mid-flight. Static mode
+        additionally waits for the whole engine to drain (the wave
+        baseline)."""
+        if self.admission == "static" and self.num_active:
+            return
+        for slot in range(self.max_slots):
+            if not self._waiting or self._slot_req[slot] is not None:
+                continue
+            req = self._waiting[0]
+            need = blocks_for(req.prompt.size + req.max_new_tokens,
+                              self.block_size)
+            ids = self.allocator.alloc(need)
+            if ids is None:
+                break                      # pool full: wait for releases
+            self._waiting.popleft()
+            self._slot_req[slot] = req
+            self._slot_blocks[slot] = ids
+            row = np.zeros(self.pages, np.int32)
+            row[:len(ids)] = ids
+            self._tables[slot] = row
+            tok, done = self._prefill(slot, req)
+            yield (req.rid, tok, done)
+            if done:
+                self._finish(slot)
+
+    def _prefill(self, slot, req):
+        from ..jit.api import default_buckets
+
+        t0 = time.perf_counter()
+        s = req.prompt.size
+        bucket = min(_ceil_to(default_buckets(s), self.block_size),
+                     self.max_model_len)
+        bucket = max(bucket, _ceil_to(s, self.block_size))
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :s] = req.prompt
+        samp = self._samp_arrays([req])
+        c = self.cache
+        out = _prefill_step(
+            self.spec, self.block_size, self.quantized, req.do_sample,
+            self.params, jnp.asarray(ids), jnp.int32(s),
+            jnp.asarray(self._tables[slot]), c.k, c.v, c.k_scale,
+            c.v_scale, samp, self._key)
+        tok_arr, c.k, c.v, c.k_scale, c.v_scale, self._key = out
+        tok = int(jax.device_get(tok_arr)[0])
+        req.first_token_s = time.perf_counter()
+        self.prefill_time_s += req.first_token_s - t0
+        self.ttfts.append(req.ttft_s)
+        self.prefill_tokens += s
+        req.tokens.append(tok)
+        self._slot_pos[slot] = s
+        return tok, self._check_done(req, tok)
+
+    def _decode(self, active):
+        from ..jit.api import default_buckets
+
+        t0 = time.perf_counter()
+        bucket = min(default_buckets(len(active)), self.max_slots)
+        reqs = [self._slot_req[i] for i in active]
+        pad = bucket - len(active)
+        tok = np.array([r.tokens[-1] for r in reqs] + [0] * pad, np.int32)
+        pos = np.concatenate([self._slot_pos[active],
+                              np.zeros(pad, np.int64)]).astype(np.int32)
+        tables = np.concatenate(
+            [self._tables[active],
+             np.full((pad, self.pages), TRASH_BLOCK, np.int32)])
+        samp = self._samp_arrays(reqs, pad)
+        any_sample = any(r.do_sample for r in reqs)
+        c = self.cache
+        out = _decode_step(
+            self.spec, self.block_size, self.quantized, any_sample,
+            self.params, jnp.asarray(tok), jnp.asarray(pos),
+            jnp.asarray(tables), c.k, c.v, c.k_scale, c.v_scale, samp,
+            self._key)
+        nxt, c.k, c.v, c.k_scale, c.v_scale, self._key = out
+        nxt = np.asarray(jax.device_get(nxt))
+        self.decode_time_s += time.perf_counter() - t0
+        emitted = []
+        for j, slot in enumerate(active):
+            req = self._slot_req[slot]
+            t = int(nxt[j])
+            req.tokens.append(t)
+            self._slot_pos[slot] += 1
+            self.decode_tokens += 1
+            done = self._check_done(req, t)
+            emitted.append((req.rid, t, done))
+            if done:
+                self._finish(slot)
+        return emitted
+
+    def _samp_arrays(self, reqs, pad=0):
+        """Per-slot sampling params as batched device arrays (padded rows
+        greedy — their tokens are discarded)."""
+        return {
+            "do_sample": jnp.asarray(
+                [r.do_sample for r in reqs] + [False] * pad),
+            "temperature": jnp.asarray(
+                np.array([r.temperature for r in reqs] + [1.0] * pad,
+                         np.float32)),
+            "top_k": jnp.asarray(
+                np.array([r.top_k for r in reqs] + [0] * pad, np.int32)),
+            "top_p": jnp.asarray(
+                np.array([r.top_p for r in reqs] + [1.0] * pad,
+                         np.float32)),
+        }
+
+    def _check_done(self, req, tok) -> bool:
+        if req.eos_token_id >= 0 and tok == req.eos_token_id:
+            return True
+        return len(req.tokens) >= req.max_new_tokens
+
+    def _finish(self, slot):
+        """Copy-free release: return the slot's blocks to the pool (stale
+        contents are never attended to — see paged_cache) and free the
+        slot for the next admission."""
+        req = self._slot_req[slot]
+        req.finished = True
+        self.completed[req.rid] = np.asarray(req.tokens, np.int64)
+        self.allocator.free(self._slot_blocks[slot])
+        self._slot_blocks[slot] = []
+        self._slot_req[slot] = None
+        self._slot_pos[slot] = 0
+        self._tables[slot] = TRASH_BLOCK
+
+    # ------------------------------------------------------- introspection
+    def decode_program_jaxpr(self, bucket=2):
+        """The decode step program's jaxpr at a given slot bucket — the
+        serving analogue of CompiledFunction.program_jaxpr(), consumed by
+        tools/graft_lint.py's paged smoke audit."""
+        bucket = min(bucket, self.max_slots)
+        c = self.cache
+        samp = {"do_sample": jnp.zeros(bucket, bool),
+                "temperature": jnp.ones(bucket, jnp.float32),
+                "top_k": jnp.zeros(bucket, jnp.int32),
+                "top_p": jnp.ones(bucket, jnp.float32)}
+        fn = functools.partial(_decode_step_impl, self.spec,
+                               self.block_size, self.quantized, False)
+        return jax.make_jaxpr(fn)(
+            self.params, jnp.zeros(bucket, jnp.int32),
+            jnp.zeros(bucket, jnp.int32),
+            jnp.full((bucket, self.pages), TRASH_BLOCK, jnp.int32),
+            c.k, c.v, c.k_scale, c.v_scale, samp, self._key)
+
+
+def generate_paged(model, ids, max_new_tokens, do_sample=False,
+                   temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+                   seed=None, **engine_kwargs):
+    """Model.generate(..., engine="paged") entry: run a rectangular batch
+    through a ServingEngine and return tokens [B, max_new_tokens] int64
+    (rows that hit eos early are padded with eos, matching the
+    single-program engine's emit-eos-forever semantics so the shared trim
+    logic applies unchanged). seed=None draws a FRESH seed from the
+    framework rng stream — same semantics as the static engine, so
+    repeated unseeded sampling calls differ."""
+    ids = np.asarray(ids, np.int64)
+    b = ids.shape[0]
+    if seed is None:
+        from ..core.rng import next_key
+
+        seed = int(np.asarray(jax.device_get(next_key()))[-1])
+    eng = ServingEngine(model, max_slots=max(1, b), seed=seed,
+                        **engine_kwargs)
+    order = [eng.add_request(
+        ids[i], max_new_tokens=max_new_tokens, do_sample=do_sample,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        eos_token_id=eos_token_id) for i in range(b)]
+    done = eng.run()
+    pad = -1 if eos_token_id is None else int(eos_token_id)
+    out = np.full((b, int(max_new_tokens)), pad, np.int64)
+    for i, rid in enumerate(order):
+        toks = done[rid]
+        out[i, :len(toks)] = toks
+    return out

@@ -84,6 +84,19 @@ class Config:
         fallback when no serialized StableHLO exists for this artifact."""
         self._network_factory = factory
 
+    def enable_paged_serving(self, slots=None, kv_block_size=None,
+                             kv_cache_dtype=None, num_kv_blocks=None,
+                             max_model_len=None):
+        """Serve generation through the continuous-batching paged-KV
+        engine (inference/engine.py) instead of one-shot Run() calls —
+        consumed by create_serving_predictor. None keeps each knob at its
+        FLAGS_* default (FLAGS_serving_slots, FLAGS_kv_block_size,
+        FLAGS_kv_cache_dtype)."""
+        self._serving = {"max_slots": slots, "kv_block_size": kv_block_size,
+                         "kv_cache_dtype": kv_cache_dtype,
+                         "num_kv_blocks": num_kv_blocks,
+                         "max_model_len": max_model_len}
+
     def enable_memory_optim(self, flag=True):
         """REAL effect on the network-factory path: predictor inputs are
         donated to the compiled program (the XLA analog of the reference's
@@ -131,6 +144,33 @@ class Tensor:
         return np.asarray(jax.device_get(self._value))
 
 
+def _network_from_factory(config: Config):
+    """Shared Predictor/ServingPredictor load path: rebuild the network
+    from the factory, load weights from the artifact prefix (loud
+    FileNotFoundError on a wrong path — never silently serve random
+    init), apply the precision switch."""
+    from ..framework_io import load as _load_obj
+
+    if config.model_dir() is None:
+        raise ValueError("Config has no model path")
+    payload = _load_obj(config.model_dir() + ".pdparams")
+    net = config._network_factory()
+    net.set_state_dict(payload.get("state_dict", payload))
+    net.eval()
+    if config._precision in (PrecisionType.Half, PrecisionType.Bfloat16):
+        # REAL precision switch: serve in bf16 (params cast once at
+        # load — the analog of the reference's fp16 analysis pass)
+        from .. import amp
+
+        net = amp.decorate(net, None, level="O2", dtype="bfloat16")
+    elif config._precision == PrecisionType.Int8:
+        raise NotImplementedError(
+            "Int8 serving needs a quantized export "
+            "(paddle.quantization PTQ) — not an inference-time "
+            "switch on TPU")
+    return net
+
+
 class Predictor:
     def __init__(self, config: Config):
         self.config = config
@@ -147,25 +187,7 @@ class Predictor:
                 self._exported = jexport.deserialize(f.read())
             self._n_inputs = len(self._exported.in_avals)
         elif config._network_factory is not None:
-            from ..framework_io import load as _load_obj
-
-            payload = _load_obj(prefix + ".pdparams")
-            net = config._network_factory()
-            net.set_state_dict(payload.get("state_dict", payload))
-            net.eval()
-            if config._precision in (PrecisionType.Half,
-                                     PrecisionType.Bfloat16):
-                # REAL precision switch: serve in bf16 (params cast once at
-                # load — the analog of the reference's fp16 analysis pass)
-                from .. import amp
-
-                net = amp.decorate(net, None, level="O2", dtype="bfloat16")
-            elif config._precision == PrecisionType.Int8:
-                raise NotImplementedError(
-                    "Int8 serving needs a quantized export "
-                    "(paddle.quantization PTQ) — not an inference-time "
-                    "switch on TPU")
-            self._layer = net
+            self._layer = _network_from_factory(config)
             self._n_inputs = None
         else:
             raise FileNotFoundError(
@@ -278,6 +300,48 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+class ServingPredictor:
+    """paddle_infer-style deployment wrapper over the continuous-batching
+    engine: load the model the same way Predictor's network-factory path
+    does (state_dict at the artifact prefix), then serve generation
+    requests through a shared ServingEngine — the deployment surface of
+    the paged decode stack (engine API itself: inference/engine.py)."""
+
+    def __init__(self, config: Config, model=None):
+        from .engine import ServingEngine
+
+        self.config = config
+        if model is None:
+            if config._network_factory is None:
+                raise ValueError(
+                    "ServingPredictor needs Config.set_network_factory "
+                    "(or an explicit model) to build the network")
+            model = _network_from_factory(config)
+        kw = {k: v for k, v in getattr(config, "_serving", {}).items()
+              if v is not None}
+        self.engine = ServingEngine(model, **kw)
+
+    def add_request(self, prompt, **sampling) -> int:
+        return self.engine.add_request(prompt, **sampling)
+
+    def step(self):
+        return self.engine.step()
+
+    def generate(self, prompts, **sampling):
+        """Batch convenience: queue every prompt, drain the engine, and
+        return a list of generated-token arrays in prompt order."""
+        rids = [self.add_request(p, **sampling) for p in prompts]
+        done = self.engine.run()
+        return [done[r] for r in rids]
+
+    def get_stats(self) -> dict:
+        return self.engine.stats()
+
+
+def create_serving_predictor(config: Config, model=None) -> ServingPredictor:
+    return ServingPredictor(config, model)
 
 
 class PredictorPool:

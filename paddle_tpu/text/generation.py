@@ -455,13 +455,18 @@ def _extract_gpt(cfg, sd):
 
 def generate(model, input_ids, max_new_tokens=32, max_length=None,
              do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-             eos_token_id=None, seed=None, weight_quant="none"):
+             eos_token_id=None, seed=None, weight_quant="none",
+             engine="static"):
     """Autoregressive generation with a static KV cache, greedy or sampled.
 
     Returns a Tensor [B, prompt_len + n_generated] (prompt included, like
-    the reference ecosystem's generate with full-sequence output). The whole
-    loop runs as one compiled XLA program keyed by
-    (batch, prompt bucket, sampling config).
+    the reference ecosystem's generate with full-sequence output).
+
+    engine="static" (default): the whole loop is one compiled XLA program
+    keyed by (batch, prompt bucket, generation-length bucket, sampling
+    config). engine="paged": the continuous-batching serving engine
+    (inference/engine.py) over the block-paged KV cache — same greedy
+    tokens, the serving route for streams of requests.
     """
     from ..core.tensor import Tensor
 
@@ -482,6 +487,9 @@ def generate(model, input_ids, max_new_tokens=32, max_length=None,
             f"prompt ({ids.shape[1]}) + max_new_tokens ({max_new_tokens}) "
             f"= {total} exceeds max_position_embeddings "
             f"({cfg.max_position_embeddings})")
+    if engine not in ("static", "paged"):
+        raise ValueError(f"engine must be 'static' or 'paged', got "
+                         f"{engine!r}")
     # models declare their engine arch; default is the llama layout
     arch = getattr(model, "_gen_arch", "llama")
     if weight_quant not in ("none", "int8"):
@@ -490,13 +498,54 @@ def generate(model, input_ids, max_new_tokens=32, max_length=None,
     if arch == "gpt" and weight_quant != "none":
         raise NotImplementedError(
             "weight-only int8 generation is wired for the llama arch only")
+    mnt = int(max_new_tokens)
+    if engine == "paged":
+        if weight_quant != "none":
+            raise NotImplementedError(
+                "weight-only int8 rides the static engine; the paged "
+                "engine's int8 lever is the KV cache "
+                "(kv_cache_dtype='int8')")
+        # the paged engine addresses context through whole KV blocks, so
+        # its usable length is max_position_embeddings rounded DOWN to the
+        # block size — surface the gap here, at the API boundary, instead
+        # of deep inside the engine's admission check
+        from ..core.flags import flag
+
+        kv_bs = int(flag("FLAGS_kv_block_size"))
+        usable = (int(cfg.max_position_embeddings) // kv_bs) * kv_bs
+        if total > usable:
+            raise ValueError(
+                f"prompt ({ids.shape[1]}) + max_new_tokens "
+                f"({max_new_tokens}) = {total} exceeds the paged engine's "
+                f"usable context ({usable} = max_position_embeddings "
+                f"rounded down to whole {kv_bs}-token kv blocks); use "
+                "engine='static' or a smaller generation budget")
+        from ..inference.engine import generate_paged
+
+        toks = generate_paged(model, ids.astype(np.int64), mnt,
+                              do_sample=bool(do_sample),
+                              temperature=float(temperature),
+                              top_k=int(top_k), top_p=float(top_p),
+                              eos_token_id=eos_token_id,
+                              seed=None if seed is None else int(seed))
+        return _assemble_output(ids, toks, eos_token_id, Tensor)
+    from ..jit.api import default_buckets
+
+    s_true = ids.shape[1]
+    # bucket the GENERATION length too: _GenSpec used to key a fresh
+    # program per exact max_new_tokens — a serving stream of varied
+    # lengths now compiles O(log L) programs, trading ≤2x dead decode
+    # steps (the tail is trimmed below; eos masking is unchanged)
+    mnt_bucket = min(default_buckets(mnt),
+                     int(cfg.max_position_embeddings) - s_true)
+    mnt_bucket = max(mnt_bucket, mnt)
     if arch == "gpt":
         nh = cfg.num_attention_heads
         spec = _GenSpec(
             num_layers=cfg.num_hidden_layers, num_heads=nh, num_kv_heads=nh,
             head_dim=cfg.hidden_size // nh, rope_theta=0.0,
             rms_eps=cfg.layer_norm_eps,
-            max_new_tokens=int(max_new_tokens), do_sample=bool(do_sample),
+            max_new_tokens=mnt_bucket, do_sample=bool(do_sample),
             top_k=int(top_k), top_p=float(top_p),
             temperature=float(temperature),
             eos_token_id=int(eos_token_id if eos_token_id is not None
@@ -509,7 +558,7 @@ def generate(model, input_ids, max_new_tokens=32, max_length=None,
             num_heads=cfg.num_attention_heads,
             num_kv_heads=cfg.num_key_value_heads, head_dim=cfg.head_dim,
             rope_theta=cfg.rope_theta, rms_eps=cfg.rms_norm_eps,
-            max_new_tokens=int(max_new_tokens), do_sample=bool(do_sample),
+            max_new_tokens=mnt_bucket, do_sample=bool(do_sample),
             top_k=int(top_k), top_p=float(top_p),
             temperature=float(temperature),
             eos_token_id=int(eos_token_id if eos_token_id is not None
@@ -526,20 +575,25 @@ def generate(model, input_ids, max_new_tokens=32, max_length=None,
     # pad the prompt up to its bucket so the compiled program is keyed by
     # (bucket, B, spec): O(log S) compilations per serving stream. The
     # bucket is clamped so the padded total still fits the position tables.
-    from ..jit.api import default_buckets
-
-    s_true = ids.shape[1]
     bucket = min(default_buckets(s_true),
-                 int(cfg.max_position_embeddings) - int(max_new_tokens))
+                 int(cfg.max_position_embeddings) - mnt_bucket)
     bucket = max(bucket, s_true)
     ids_padded = np.pad(ids, ((0, 0), (0, bucket - s_true))) \
         if bucket > s_true else ids
     toks = _generate_program(params, jnp.asarray(ids_padded), spec, key,
                              jnp.int32(s_true))
-    toks = np.asarray(jax.device_get(toks))
+    # drop the bucketed tail: tokens [mnt, mnt_bucket) are dead steps the
+    # length bucketing trades for program reuse
+    toks = np.asarray(jax.device_get(toks))[:, :mnt]
+    return _assemble_output(ids, toks, eos_token_id, Tensor)
+
+
+def _assemble_output(ids, toks, eos_token_id, Tensor):
+    """Shared static/paged postprocessing: trim columns past the point
+    where every row finished, prepend the prompt."""
     if eos_token_id is not None:
         # trim columns past the point where every row finished
-        done = (toks == spec.eos_token_id)
+        done = (toks == int(eos_token_id))
         all_done = done.all(axis=0)
         keep = len(all_done)
         first = np.argmax(all_done) if all_done.any() else None

@@ -117,13 +117,15 @@ class GPTForCausalLM(nn.Layer):
 
     def generate(self, input_ids, max_new_tokens=32, max_length=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                 eos_token_id=None, seed=None):
-        """KV-cached decoding as one compiled XLA program (see
-        text/generation.py; gpt arch: LayerNorm + learned positions +
-        fused-qkv pre-LN blocks)."""
+                 eos_token_id=None, seed=None, engine="static"):
+        """KV-cached decoding (see text/generation.py; gpt arch: LayerNorm
+        + learned positions + fused-qkv pre-LN blocks). engine="static":
+        one compiled XLA program; engine="paged": the continuous-batching
+        paged-KV serving engine (inference/engine.py)."""
         from ..generation import generate as _generate
 
         return _generate(self, input_ids, max_new_tokens=max_new_tokens,
                          max_length=max_length, do_sample=do_sample,
                          temperature=temperature, top_k=top_k, top_p=top_p,
-                         eos_token_id=eos_token_id, seed=seed)
+                         eos_token_id=eos_token_id, seed=seed,
+                         engine=engine)

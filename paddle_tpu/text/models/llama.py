@@ -288,19 +288,23 @@ class LlamaForCausalLM(nn.Layer):
 
     def generate(self, input_ids, max_new_tokens=32, max_length=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                 eos_token_id=None, seed=None, weight_quant="none"):
-        """KV-cached autoregressive decoding as ONE compiled XLA program
-        (prefill + lax.scan decode loop) — the role of the reference's
-        masked_multihead_attention decode kernel + PaddleNLP generate
-        (/root/reference/paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu).
-        See text/generation.py for the engine."""
+                 eos_token_id=None, seed=None, weight_quant="none",
+                 engine="static"):
+        """KV-cached autoregressive decoding — the role of the reference's
+        fused decode-attention family + PaddleNLP generate. engine="static"
+        (default): ONE compiled XLA program (prefill + lax.scan decode
+        loop, ≙ masked_multihead_attention's role; text/generation.py).
+        engine="paged": the continuous-batching serving engine over the
+        block-paged KV cache (≙ block_multihead_attention's role;
+        inference/engine.py) — same greedy tokens, built for request
+        streams."""
         from ..generation import generate as _generate
 
         return _generate(self, input_ids, max_new_tokens=max_new_tokens,
                          max_length=max_length, do_sample=do_sample,
                          temperature=temperature, top_k=top_k, top_p=top_p,
                          eos_token_id=eos_token_id, seed=seed,
-                         weight_quant=weight_quant)
+                         weight_quant=weight_quant, engine=engine)
 
 
 class _PipeEmbed(nn.Layer):

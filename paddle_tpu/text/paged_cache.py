@@ -1,0 +1,189 @@
+"""Block-paged KV cache: the allocator + the pure cache-update rules.
+
+Reference parity: the block-table KV management behind
+block_multihead_attention (fusion/gpu/block_multi_head_attention_kernel.cu)
+— PagedAttention's (Kwon et al.) block-granular allocation, so a serving
+engine's HBM footprint tracks the TOKENS ACTUALLY HELD rather than
+max_len * max_batch.
+
+Pieces:
+  * `BlockAllocator` — host-side free list over a fixed block pool.
+    Block 0 is the reserved TRASH block: every in-program write whose
+    destination must be masked out (padded prefill positions, padded
+    decode slots) is routed there instead of carrying a scatter mask —
+    copy-free release is then trivial (free the ids; nothing is zeroed,
+    stale contents are never attended to because the length mask bounds
+    every read and appends overwrite before reads reach them).
+  * `PagedKVCache` — the device arrays: `[L, num_blocks, H_kv,
+    block_size, D]` per k/v (layer axis outermost so the per-step
+    program's `lax.scan` over stacked layer weights threads the matching
+    cache slice), plus per-(layer, block) f32 scales when the storage
+    dtype is int8.
+  * pure jnp functions used INSIDE the compiled step programs: decode
+    append (scatter one token per slot through the block table) and
+    prefill scatter (page-granular), each with an int8 variant that
+    requantizes the touched block against its per-block scale.
+
+Static shapes everywhere: block tables are padded [slots, pages] arrays,
+the trash block absorbs masked writes, and the allocator is the only
+dynamic piece — it lives on the host and never enters a trace.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+#: block id 0 is never allocated — masked writes land there (see module doc)
+TRASH_BLOCK = 0
+
+
+class BlockAllocator:
+    """Free-list allocator over `num_blocks` cache blocks (block 0
+    reserved as trash). Allocation is all-or-nothing: a request either
+    gets its full block budget up front (admission control) or stays
+    queued — no mid-flight OOM/preemption."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is the trash block)")
+        self.num_blocks = int(num_blocks)
+        self._free = list(range(self.num_blocks - 1, 0, -1))  # pop() -> 1..
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int):
+        """n block ids, or None when the pool can't cover them."""
+        if n < 0:
+            raise ValueError(f"negative block count {n}")
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, ids) -> None:
+        for b in ids:
+            b = int(b)
+            if not 0 < b < self.num_blocks:
+                raise ValueError(f"freeing invalid block id {b}")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+            self._free.append(b)
+
+
+def blocks_for(tokens: int, block_size: int) -> int:
+    """Blocks needed to hold `tokens` cache entries."""
+    return -(-int(tokens) // int(block_size))
+
+
+class PagedKVCache:
+    """The pooled cache arrays for every layer of one model.
+
+    dtype: the storage dtype ("int8" adds per-(layer, block) f32 scale
+    arrays; anything else stores k/v directly). Arrays start zeroed —
+    freshly (re)allocated blocks may hold stale data from a finished
+    request, which is fine: reads are bounded by per-sequence lengths and
+    appends overwrite before the length mask ever exposes a slot."""
+
+    def __init__(self, num_layers: int, num_blocks: int, num_kv_heads: int,
+                 block_size: int, head_dim: int, dtype):
+        if int(block_size) % 8:
+            raise ValueError(
+                f"kv block_size {block_size} must be a multiple of 8 "
+                "(sublane alignment of the (block_size, head_dim) tile)")
+        self.num_layers = int(num_layers)
+        self.num_blocks = int(num_blocks)
+        self.num_kv_heads = int(num_kv_heads)
+        self.block_size = int(block_size)
+        self.head_dim = int(head_dim)
+        self.quantized = str(dtype) == "int8"
+        self.dtype = jnp.int8 if self.quantized else dtype
+        shape = (self.num_layers, self.num_blocks, self.num_kv_heads,
+                 self.block_size, self.head_dim)
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+        if self.quantized:
+            self.k_scale = jnp.full((self.num_layers, self.num_blocks),
+                                    1e-8, jnp.float32)
+            self.v_scale = jnp.full((self.num_layers, self.num_blocks),
+                                    1e-8, jnp.float32)
+        else:
+            self.k_scale = self.v_scale = None
+
+    @property
+    def hbm_bytes(self) -> int:
+        per = int(np.prod(self.k.shape)) * self.k.dtype.itemsize
+        scales = 0 if self.k_scale is None else 2 * int(
+            np.prod(self.k_scale.shape)) * 4
+        return 2 * per + scales
+
+
+# ---------------------------------------------------- in-program updates
+# All functions below are pure jnp and run inside the compiled step
+# programs; `cache`/`scale` arguments are ONE layer's slice
+# ([num_blocks, H_kv, block_size, D] / [num_blocks]).
+
+def append_token(cache, kv, block_ids, offsets):
+    """Scatter one token per slot: kv [B, H_kv, D] written at
+    (block_ids[b], :, offsets[b]). Padded slots route block_ids to the
+    trash block; duplicate trash destinations are harmless."""
+    return cache.at[block_ids, :, offsets].set(kv.astype(cache.dtype))
+
+
+def append_token_int8(cache, scale, kv, block_ids, offsets):
+    """Int8 append with per-block requantization: the touched block is
+    dequantized against its current scale, the new token inserted, a new
+    scale taken over the VALID prefix (slots <= offset — stale tail
+    entries never pollute it), and the whole block requantized. Returns
+    (cache, scale)."""
+    b = kv.shape[0]
+    bs = cache.shape[2]
+    old = cache[block_ids].astype(jnp.float32)          # [B, Hkv, bs, D]
+    x = old * scale[block_ids][:, None, None, None]
+    x = x.at[jnp.arange(b), :, offsets].set(kv.astype(jnp.float32))
+    valid = (jnp.arange(bs)[None, :] <= offsets[:, None])  # [B, bs]
+    amax = jnp.max(jnp.abs(x) * valid[:, None, :, None], axis=(1, 2, 3))
+    new_scale = jnp.maximum(amax / 127.0, 1e-8)          # [B]
+    q8 = jnp.clip(jnp.round(x / new_scale[:, None, None, None]),
+                  -127, 127).astype(jnp.int8)
+    return (cache.at[block_ids].set(q8),
+            scale.at[block_ids].set(new_scale))
+
+
+def _prefill_pages(ks, true_len, table_row, block_size):
+    """Shared prefill-scatter prep: ks [L, S, H_kv, D] (S a multiple of
+    block_size) -> per-page tiles [L, P_b, H_kv, bs, D] plus destination
+    block ids [P_b] (invalid pages -> trash) and a per-token validity
+    mask [P_b, bs]."""
+    l, s, hkv, d = ks.shape
+    bs = int(block_size)
+    p_b = s // bs
+    tiles = jnp.swapaxes(ks.reshape(l, p_b, bs, hkv, d), 2, 3)
+    page_valid = (jnp.arange(p_b) * bs) < true_len
+    dest = jnp.where(page_valid, table_row[:p_b], TRASH_BLOCK)
+    tok_valid = (jnp.arange(p_b)[:, None] * bs
+                 + jnp.arange(bs)[None, :]) < true_len   # [P_b, bs]
+    return tiles, dest.astype(jnp.int32), tok_valid
+
+
+def scatter_prefill(cache, ks, true_len, table_row, block_size):
+    """Write a whole prompt's K (or V) into its pages in one scatter.
+    ks [L, S, H_kv, D]; positions >= true_len land in the trash block."""
+    tiles, dest, _ = _prefill_pages(ks, true_len, table_row, block_size)
+    return cache.at[:, dest].set(tiles.astype(cache.dtype))
+
+
+def scatter_prefill_int8(cache, scale, ks, true_len, table_row,
+                         block_size):
+    """Int8 prefill scatter: one scale per (layer, page) over the page's
+    valid tokens, whole-page requantized write. Returns (cache, scale)."""
+    tiles, dest, tok_valid = _prefill_pages(ks, true_len, table_row,
+                                            block_size)
+    tf = tiles.astype(jnp.float32)                 # [L, P_b, Hkv, bs, D]
+    amax = jnp.max(jnp.abs(tf) * tok_valid[None, :, None, :, None],
+                   axis=(2, 3, 4))                 # [L, P_b]
+    new_scale = jnp.maximum(amax / 127.0, 1e-8)
+    q8 = jnp.clip(jnp.round(tf / new_scale[:, :, None, None, None]),
+                  -127, 127).astype(jnp.int8)
+    return (cache.at[:, dest].set(q8),
+            scale.at[:, dest].set(new_scale))

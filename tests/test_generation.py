@@ -270,6 +270,34 @@ class TestBufVersionCache:
             assert out.shape[1] == ln + 2
         assert _generate_program._cache_size() - misses0 <= 1
 
+    def test_generation_length_bucketing_compile_count(self):
+        """Round-10 satellite: _GenSpec used to key a fresh program per
+        EXACT max_new_tokens; generation lengths now bucket via
+        jit.default_buckets (the tail is trimmed), so varied lengths
+        within one bucket share one compiled program."""
+        from paddle_tpu.text.generation import _generate_program
+
+        m = _tiny()
+        rs = np.random.RandomState(13)
+        p = rs.randint(0, 128, (1, 5)).astype("int64")
+        misses0 = _generate_program._cache_size()
+        for mnt in (5, 6, 7, 8):  # all bucket to 8
+            out = m.generate(paddle.to_tensor(p), max_new_tokens=mnt)
+            assert out.shape[1] == 5 + mnt  # exact requested length
+        assert _generate_program._cache_size() - misses0 <= 1
+
+    def test_bucketed_length_prefix_consistent(self):
+        """Tokens [0, mnt) must not change when the program runs extra
+        bucketed steps: a shorter request is a PREFIX of the longer one
+        under greedy decoding."""
+        m = _tiny()
+        p = np.random.RandomState(14).randint(0, 128, (1, 4)).astype("int64")
+        long = np.asarray(m.generate(paddle.to_tensor(p),
+                                     max_new_tokens=8)._data)
+        short = np.asarray(m.generate(paddle.to_tensor(p),
+                                      max_new_tokens=5)._data)
+        np.testing.assert_array_equal(short, long[:, :short.shape[1]])
+
     def test_cache_invalidated_by_to_static_step(self):
         """Code-review r5: to_static's _finish swaps buffers via direct
         `t._data = v` (not _assign_raw); the version counter must bump

@@ -1,0 +1,225 @@
+"""Paged flash-decode kernel tests (round 10).
+
+Interpret-mode parity of ops/pallas_decode.py's Pallas kernel against the
+XLA gather+softmax composition (the numerics oracle) and a dense NumPy
+reference: f32 ≤ 5e-5, bf16 tiered, GQA packing, int8-KV per-block
+scales. Plus the routing gates shared with analysis D4.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle  # noqa: F401  (flag registry + x64 init)
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas_decode import (decode_gate_reason,
+                                          paged_decode_attention,
+                                          paged_decode_attention_raw,
+                                          paged_decode_attention_xla,
+                                          use_pallas_decode)
+
+
+def _setup(s=3, hq=8, hkv=2, d=128, bs=8, pages=4, blocks=16,
+           dtype="float32", lens=None, seed=0):
+    """Random paged cache + disjoint block tables (block 0 left as trash,
+    like the engine allocates)."""
+    rs = np.random.RandomState(seed)
+    q = rs.randn(s, hq, d).astype("float32")
+    kc = rs.randn(blocks, hkv, bs, d).astype("float32")
+    vc = rs.randn(blocks, hkv, bs, d).astype("float32")
+    ids = rs.choice(np.arange(1, blocks), (s * pages,), replace=False)
+    tables = ids.reshape(s, pages).astype("int32")
+    if lens is None:
+        lens = rs.randint(1, pages * bs + 1, (s,))
+    lens = np.asarray(lens, "int32")
+    cast = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    return (jnp.asarray(q, cast), jnp.asarray(kc, cast),
+            jnp.asarray(vc, cast), jnp.asarray(tables), jnp.asarray(lens))
+
+
+def _dense_reference(q, kc, vc, tables, lens):
+    """O(T) NumPy oracle: walk each sequence's block table token by
+    token."""
+    q, kc, vc = (np.asarray(x, "float32") for x in (q, kc, vc))
+    tables, lens = np.asarray(tables), np.asarray(lens)
+    s, hq, d = q.shape
+    _, hkv, bs, _ = kc.shape
+    rep = hq // hkv
+    out = np.zeros((s, hq, d), "float32")
+    for b in range(s):
+        ks, vs = [], []
+        for t in range(lens[b]):
+            blk = tables[b, t // bs]
+            ks.append(kc[blk, :, t % bs])
+            vs.append(vc[blk, :, t % bs])
+        ks = np.repeat(np.stack(ks), rep, axis=1)       # [T, Hq, D]
+        vs = np.repeat(np.stack(vs), rep, axis=1)
+        sc = np.einsum("hd,thd->ht", q[b], ks) / np.sqrt(d)
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        out[b] = np.einsum("ht,thd->hd", p, vs)
+    return out
+
+
+def _quantize_per_block(c):
+    """Per-block symmetric int8, the paged_cache scale convention."""
+    c = np.asarray(c, "float32")
+    scale = np.maximum(np.abs(c).max(axis=(1, 2, 3)) / 127.0, 1e-8)
+    q8 = np.clip(np.round(c / scale[:, None, None, None]), -127,
+                 127).astype("int8")
+    return jnp.asarray(q8), jnp.asarray(scale.astype("float32"))
+
+
+class TestInterpretParity:
+    def test_f32_kernel_matches_xla_and_dense(self):
+        q, kc, vc, tables, lens = _setup()
+        got = np.asarray(paged_decode_attention_raw(q, kc, vc, tables,
+                                                    lens), "float32")
+        xla = np.asarray(paged_decode_attention_xla(q, kc, vc, tables,
+                                                    lens), "float32")
+        np.testing.assert_allclose(got, xla, atol=5e-5, rtol=5e-5)
+        np.testing.assert_allclose(got, _dense_reference(q, kc, vc, tables,
+                                                         lens),
+                                   atol=5e-5, rtol=5e-5)
+
+    def test_bf16_tiered(self):
+        q, kc, vc, tables, lens = _setup(dtype="bfloat16")
+        got = np.asarray(paged_decode_attention_raw(q, kc, vc, tables,
+                                                    lens), "float32")
+        ref = _dense_reference(q, kc, vc, tables, lens)
+        # bf16 inputs, f32 accumulation: bounded by input rounding
+        np.testing.assert_allclose(got, ref, atol=3e-2, rtol=3e-2)
+
+    def test_gqa_packing(self):
+        # 16 query heads over 4 kv heads: one [group, D] MXU tile each
+        q, kc, vc, tables, lens = _setup(hq=16, hkv=4)
+        got = np.asarray(paged_decode_attention_raw(q, kc, vc, tables,
+                                                    lens), "float32")
+        np.testing.assert_allclose(got, _dense_reference(q, kc, vc, tables,
+                                                         lens),
+                                   atol=5e-5, rtol=5e-5)
+
+    def test_mha_group_of_one(self):
+        q, kc, vc, tables, lens = _setup(hq=4, hkv=4)
+        got = np.asarray(paged_decode_attention_raw(q, kc, vc, tables,
+                                                    lens), "float32")
+        np.testing.assert_allclose(got, _dense_reference(q, kc, vc, tables,
+                                                         lens),
+                                   atol=5e-5, rtol=5e-5)
+
+    def test_single_token_and_full_cache_lens(self):
+        # boundary lengths: 1 (one masked block) and pages*bs (no mask)
+        q, kc, vc, tables, lens = _setup(lens=[1, 32, 17])
+        got = np.asarray(paged_decode_attention_raw(q, kc, vc, tables,
+                                                    lens), "float32")
+        np.testing.assert_allclose(got, _dense_reference(q, kc, vc, tables,
+                                                         lens),
+                                   atol=5e-5, rtol=5e-5)
+
+    def test_negative_table_padding_tolerated(self):
+        q, kc, vc, tables, lens = _setup(lens=[5, 9, 3])
+        tab = np.asarray(tables).copy()
+        tab[:, 2:] = -1                   # pages past the data: padding
+        got = np.asarray(paged_decode_attention_raw(
+            q, kc, vc, jnp.asarray(tab), lens), "float32")
+        want = np.asarray(paged_decode_attention_raw(q, kc, vc, tables,
+                                                     lens), "float32")
+        np.testing.assert_allclose(got, want, atol=5e-5, rtol=5e-5)
+
+    def test_jit_wrapped(self):
+        q, kc, vc, tables, lens = _setup()
+        got = np.asarray(jax.jit(paged_decode_attention_raw)(
+            q, kc, vc, tables, lens), "float32")
+        np.testing.assert_allclose(got, _dense_reference(q, kc, vc, tables,
+                                                         lens),
+                                   atol=5e-5, rtol=5e-5)
+
+
+class TestInt8KV:
+    def test_int8_kernel_matches_int8_xla(self):
+        q, kc, vc, tables, lens = _setup()
+        k8, ks = _quantize_per_block(kc)
+        v8, vs = _quantize_per_block(vc)
+        got = np.asarray(paged_decode_attention_raw(q, k8, v8, tables,
+                                                    lens, ks, vs),
+                         "float32")
+        xla = np.asarray(paged_decode_attention_xla(q, k8, v8, tables,
+                                                    lens, ks, vs),
+                         "float32")
+        # same dequant math, f32 vs f32: kernel-vs-composition stays tight
+        np.testing.assert_allclose(got, xla, atol=5e-5, rtol=5e-5)
+
+    def test_int8_close_to_f32(self):
+        q, kc, vc, tables, lens = _setup()
+        k8, ks = _quantize_per_block(kc)
+        v8, vs = _quantize_per_block(vc)
+        got = np.asarray(paged_decode_attention_raw(q, k8, v8, tables,
+                                                    lens, ks, vs),
+                         "float32")
+        ref = _dense_reference(q, kc, vc, tables, lens)
+        np.testing.assert_allclose(got, ref, atol=8e-2, rtol=8e-2)
+
+    def test_int8_gqa(self):
+        q, kc, vc, tables, lens = _setup(hq=16, hkv=4)
+        k8, ks = _quantize_per_block(kc)
+        v8, vs = _quantize_per_block(vc)
+        got = np.asarray(paged_decode_attention_raw(q, k8, v8, tables,
+                                                    lens, ks, vs),
+                         "float32")
+        xla = np.asarray(paged_decode_attention_xla(q, k8, v8, tables,
+                                                    lens, ks, vs),
+                         "float32")
+        np.testing.assert_allclose(got, xla, atol=5e-5, rtol=5e-5)
+
+
+class TestRouting:
+    def test_off_tpu_routes_to_xla(self):
+        q, kc, vc, tables, lens = _setup()
+        assert not use_pallas_decode(q, kc, tables)  # CPU test host
+        got = np.asarray(paged_decode_attention(q, kc, vc, tables, lens),
+                         "float32")
+        xla = np.asarray(paged_decode_attention_xla(q, kc, vc, tables,
+                                                    lens), "float32")
+        np.testing.assert_array_equal(got, xla)
+
+    def test_gate_reasons_mirror_router(self):
+        reason, sev = decode_gate_reason(1 << 20, "bfloat16", "cpu")
+        assert sev == "note" and "not on TPU" in reason
+        reason, sev = decode_gate_reason(100, "bfloat16", "tpu")
+        assert sev == "note" and "size threshold" in reason
+        reason, sev = decode_gate_reason(1 << 20, "float64", "tpu")
+        assert sev == "note" and "unsupported" in reason
+        reason, sev = decode_gate_reason(1 << 20, "bfloat16", "tpu",
+                                         head_dim=64)
+        assert sev == "note" and "lane-aligned" in reason
+        reason, sev = decode_gate_reason(1 << 20, "bfloat16", "tpu",
+                                         block_size=12)
+        assert sev == "note" and "block_size" in reason
+        reason, sev = decode_gate_reason(1 << 20, "bfloat16", "tpu",
+                                         head_dim=128, block_size=16)
+        assert sev == "warning"
+
+    def test_flag_kills_kernel(self):
+        paddle.set_flags({"FLAGS_pallas_decode": False})
+        try:
+            reason, sev = decode_gate_reason(1 << 20, "bfloat16", "tpu",
+                                             head_dim=128, block_size=16)
+            assert sev == "note" and "FLAGS_pallas_decode" in reason
+        finally:
+            paddle.set_flags({"FLAGS_pallas_decode": True})
+
+    def test_shape_validation(self):
+        q, kc, vc, tables, lens = _setup()
+        with pytest.raises(ValueError):
+            paged_decode_attention_raw(q[:, :, :64], kc, vc, tables, lens)
+        with pytest.raises(ValueError):
+            paged_decode_attention_raw(q[:, :3], kc, vc, tables, lens)
+
+
+def test_registered_in_quick_tier():
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = open(os.path.join(here, "conftest.py")).read()
+    assert '"test_pallas_decode.py"' in src.split("QUICK_MODULES")[1], \
+        "tests/test_pallas_decode.py must be registered in QUICK_MODULES"

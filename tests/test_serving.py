@@ -1,0 +1,330 @@
+"""Continuous-batching serving engine tests (round 10).
+
+The paged engine (inference/engine.py + text/paged_cache.py) must be
+token-identical to the single-program engine under greedy sampling, and
+the scheduler must actually do continuous batching: freed slots refill
+mid-flight, admission control holds requests the block pool can't cover,
+and blocks come back on finish (copy-free release).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.engine import ServingEngine, generate_paged
+from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.text.paged_cache import (BlockAllocator, PagedKVCache,
+                                         blocks_for)
+
+
+def _tiny(vocab=128, kv_heads=None, max_pos=64):
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=vocab, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=kv_heads,
+                      max_position_embeddings=max_pos)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _tiny_gpt():
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, max_position_embeddings=64)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+class TestAllocator:
+    def test_alloc_free_roundtrip(self):
+        a = BlockAllocator(8)          # block 0 reserved
+        assert a.available == 7
+        ids = a.alloc(3)
+        assert len(ids) == 3 and 0 not in ids
+        assert a.available == 4
+        a.free(ids)
+        assert a.available == 7
+
+    def test_all_or_nothing(self):
+        a = BlockAllocator(4)
+        assert a.alloc(5) is None      # over-ask leaves the pool intact
+        assert a.available == 3
+
+    def test_double_free_and_trash_guard(self):
+        a = BlockAllocator(4)
+        ids = a.alloc(2)
+        a.free(ids)
+        with pytest.raises(ValueError):
+            a.free([ids[0]])
+        with pytest.raises(ValueError):
+            a.free([0])                # the trash block is never yours
+
+    def test_blocks_for(self):
+        assert blocks_for(1, 16) == 1
+        assert blocks_for(16, 16) == 1
+        assert blocks_for(17, 16) == 2
+
+    def test_cache_block_size_alignment(self):
+        with pytest.raises(ValueError):
+            PagedKVCache(1, 4, 2, 12, 16, "float32")
+
+
+class TestPagedEngineParity:
+    """Greedy generations must be TOKEN-IDENTICAL to the single-program
+    engine (acceptance criterion)."""
+
+    def test_llama_greedy_token_identical(self):
+        m = _tiny()
+        prompt = np.random.RandomState(0).randint(0, 128,
+                                                  (2, 5)).astype("int64")
+        out_s = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                      max_new_tokens=6)._data)
+        out_p = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                      max_new_tokens=6,
+                                      engine="paged")._data)
+        np.testing.assert_array_equal(out_s, out_p)
+
+    def test_llama_gqa_greedy_token_identical(self):
+        m = _tiny(vocab=64, kv_heads=2)
+        prompt = np.random.RandomState(1).randint(0, 64,
+                                                  (2, 4)).astype("int64")
+        out_s = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                      max_new_tokens=5)._data)
+        out_p = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                      max_new_tokens=5,
+                                      engine="paged")._data)
+        np.testing.assert_array_equal(out_s, out_p)
+
+    def test_gpt_greedy_token_identical(self):
+        m = _tiny_gpt()
+        prompt = np.random.RandomState(2).randint(0, 96,
+                                                  (2, 5)).astype("int64")
+        out_s = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                      max_new_tokens=6)._data)
+        out_p = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                      max_new_tokens=6,
+                                      engine="paged")._data)
+        np.testing.assert_array_equal(out_s, out_p)
+
+    def test_eos_semantics_match_static(self):
+        m = _tiny()
+        prompt = np.random.RandomState(4).randint(0, 128,
+                                                  (1, 4)).astype("int64")
+        first = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                      max_new_tokens=1)._data)[0, -1]
+        out = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                    max_new_tokens=8, engine="paged",
+                                    eos_token_id=int(first))._data)
+        assert out.shape[1] == prompt.shape[1] + 1
+        assert out[0, -1] == first
+
+    def test_1d_prompt(self):
+        m = _tiny()
+        out = m.generate(paddle.to_tensor(np.array([1, 2, 3], "int64")),
+                         max_new_tokens=3, engine="paged")
+        assert tuple(out.shape) == (1, 6)
+
+    def test_sampling_in_engine_is_deterministic(self):
+        m = _tiny()
+        prompt = np.random.RandomState(5).randint(0, 128,
+                                                  (2, 4)).astype("int64")
+        kw = dict(max_new_tokens=4, do_sample=True, top_k=10, seed=7,
+                  engine="paged")
+        s1 = np.asarray(m.generate(paddle.to_tensor(prompt), **kw)._data)
+        s2 = np.asarray(m.generate(paddle.to_tensor(prompt), **kw)._data)
+        np.testing.assert_array_equal(s1, s2)
+
+    def test_unseeded_sampling_is_fresh(self):
+        """seed=None must draw from the framework rng stream like the
+        static engine — repeated unseeded sampling calls differ."""
+        m = _tiny()
+        prompt = np.random.RandomState(5).randint(0, 128,
+                                                  (2, 6)).astype("int64")
+        kw = dict(max_new_tokens=8, do_sample=True, temperature=1.5,
+                  engine="paged")
+        s1 = np.asarray(m.generate(paddle.to_tensor(prompt), **kw)._data)
+        s2 = np.asarray(m.generate(paddle.to_tensor(prompt), **kw)._data)
+        assert not np.array_equal(s1, s2)
+
+    def test_int8_kv_cache_close(self):
+        m = _tiny()
+        prompt = np.random.RandomState(6).randint(0, 128,
+                                                  (2, 6)).astype("int64")
+        fp = generate_paged(m, prompt, 6)
+        i8 = generate_paged(m, prompt, 6, kv_cache_dtype="int8")
+        assert fp.shape == i8.shape
+        # per-block int8 cache on a tiny random model: most tokens agree
+        assert (fp == i8).mean() > 0.7, (fp, i8)
+
+    def test_weight_quant_rejected_on_paged(self):
+        m = _tiny()
+        with pytest.raises(NotImplementedError):
+            m.generate(paddle.to_tensor(np.zeros((1, 4), "int64")),
+                       max_new_tokens=2, engine="paged",
+                       weight_quant="int8")
+
+    def test_bad_engine_name(self):
+        m = _tiny()
+        with pytest.raises(ValueError):
+            m.generate(paddle.to_tensor(np.zeros((1, 4), "int64")),
+                       max_new_tokens=2, engine="vllm")
+
+    def test_block_rounded_context_gap_raises_at_api(self):
+        """max_position_embeddings=40 rounds to 32 usable paged tokens at
+        block 16: a request in the gap must fail AT generate() with the
+        block-rounding explanation, not deep inside admission (the static
+        engine still serves it)."""
+        m = _tiny(max_pos=40)
+        prompt = np.random.RandomState(11).randint(0, 128,
+                                                   (1, 30)).astype("int64")
+        out = m.generate(paddle.to_tensor(prompt), max_new_tokens=5)
+        assert tuple(out.shape) == (1, 35)
+        with pytest.raises(ValueError, match="usable context"):
+            m.generate(paddle.to_tensor(prompt), max_new_tokens=5,
+                       engine="paged")
+
+
+class TestContinuousBatching:
+    def test_slots_refill_mid_flight(self):
+        """5 mixed-length requests over 2 slots: finished slots must be
+        re-admitted into while others are mid-flight (the continuous-
+        batching property), and every request completes with its exact
+        token budget."""
+        m = _tiny()
+        eng = ServingEngine(m, max_slots=2, kv_block_size=8)
+        rs = np.random.RandomState(3)
+        want = {}
+        for ln, nt in ((3, 4), (7, 6), (2, 9), (5, 3), (4, 5)):
+            rid = eng.add_request(rs.randint(0, 128, (ln,)),
+                                  max_new_tokens=nt)
+            want[rid] = nt
+        saw_mixed_admission = False
+        while eng.has_work():
+            before_active = eng.num_active
+            eng.step()
+            if 0 < before_active < 2 and eng.num_active == 2:
+                saw_mixed_admission = True  # a freed slot was refilled
+        done = {r: len(v) for r, v in eng.completed.items()}
+        assert done == want
+        assert saw_mixed_admission, "no slot was refilled mid-flight"
+        st = eng.stats()
+        assert st["slot_utilization"] > 0.8
+        assert len(st["ttft_s"]) == 5
+
+    def test_admission_control_against_pool(self):
+        """A pool of 5 usable blocks (block_size 8): a 40-token request
+        takes all 5; the second request must WAIT (not crash, not OOM)
+        until the first finishes, then run to completion."""
+        m = _tiny()
+        eng = ServingEngine(m, max_slots=2, kv_block_size=8,
+                            num_kv_blocks=6)
+        rs = np.random.RandomState(4)
+        big = eng.add_request(rs.randint(0, 128, (30,)), max_new_tokens=10)
+        small = eng.add_request(rs.randint(0, 128, (4,)), max_new_tokens=4)
+        eng.step()
+        assert eng.num_active == 1 and eng.num_waiting == 1
+        done = eng.run()
+        assert len(done[big]) == 10 and len(done[small]) == 4
+
+    def test_impossible_request_rejected(self):
+        m = _tiny()
+        eng = ServingEngine(m, max_slots=1, kv_block_size=8,
+                            num_kv_blocks=3)
+        with pytest.raises(ValueError):            # pool can never cover
+            eng.add_request(np.arange(30) % 16, max_new_tokens=10)
+        with pytest.raises(ValueError):            # context too small
+            eng.add_request(np.arange(60) % 16, max_new_tokens=60)
+
+    def test_blocks_released_on_finish(self):
+        m = _tiny()
+        eng = ServingEngine(m, max_slots=2, kv_block_size=8,
+                            num_kv_blocks=9)
+        free0 = eng.allocator.available
+        rs = np.random.RandomState(5)
+        eng.add_request(rs.randint(0, 128, (5,)), max_new_tokens=4)
+        eng.add_request(rs.randint(0, 128, (9,)), max_new_tokens=6)
+        eng.run()
+        assert eng.allocator.available == free0     # copy-free release
+        assert eng.num_active == 0 and eng.num_waiting == 0
+
+    def test_static_admission_is_waves(self):
+        """admission="static" (the bench baseline) must never admit into
+        a partially-busy engine."""
+        m = _tiny()
+        eng = ServingEngine(m, max_slots=2, kv_block_size=8,
+                            admission="static")
+        rs = np.random.RandomState(6)
+        for ln, nt in ((3, 3), (4, 8), (5, 4)):
+            eng.add_request(rs.randint(0, 128, (ln,)), max_new_tokens=nt)
+        max_active_seen = 0
+        admitted_into_busy = False
+        while eng.has_work():
+            before = eng.num_active
+            eng.step()
+            if before not in (0, 2) and eng.num_active > before:
+                admitted_into_busy = True
+            max_active_seen = max(max_active_seen, eng.num_active)
+        assert not admitted_into_busy
+        assert max_active_seen == 2
+        assert len(eng.completed) == 3
+
+    def test_continuous_beats_static_utilization(self):
+        """The acceptance property, in miniature: on a mixed-length
+        stream, continuous batching's slot utilization beats the static-
+        wave baseline."""
+        m = _tiny()
+        rs = np.random.RandomState(7)
+        stream = [(rs.randint(2, 8), rs.randint(2, 12)) for _ in range(6)]
+
+        def run(mode):
+            eng = ServingEngine(m, max_slots=2, kv_block_size=8,
+                                admission=mode)
+            r2 = np.random.RandomState(8)
+            for ln, nt in stream:
+                eng.add_request(r2.randint(0, 128, (ln,)),
+                                max_new_tokens=nt)
+            eng.run()
+            return eng.stats()["slot_utilization"]
+
+        cont, stat = run("continuous"), run("static")
+        assert cont > stat, (cont, stat)
+
+
+class TestServingPredictor:
+    def test_predictor_wraps_engine(self):
+        from paddle_tpu.inference import Config, create_serving_predictor
+
+        m = _tiny()
+        cfg = Config("unused_prefix")
+        cfg.enable_paged_serving(slots=2, kv_block_size=8)
+        pred = create_serving_predictor(cfg, model=m)
+        rs = np.random.RandomState(9)
+        outs = pred.generate([rs.randint(0, 128, (4,)),
+                              rs.randint(0, 128, (6,))],
+                             max_new_tokens=3)
+        assert [len(o) for o in outs] == [3, 3]
+        assert pred.get_stats()["decode_tokens"] > 0
+
+    def test_predictor_matches_direct_engine(self):
+        from paddle_tpu.inference import Config, create_serving_predictor
+
+        m = _tiny()
+        prompt = np.random.RandomState(10).randint(0, 128, (5,))
+        cfg = Config("unused_prefix")
+        cfg.enable_paged_serving(slots=1, kv_block_size=8)
+        pred = create_serving_predictor(cfg, model=m)
+        got = pred.generate([prompt], max_new_tokens=4)[0]
+        want = generate_paged(m, prompt[None], 4)[0]
+        np.testing.assert_array_equal(got, want)
+
+
+def test_registered_in_quick_tier():
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = open(os.path.join(here, "conftest.py")).read()
+    assert '"test_serving.py"' in src.split("QUICK_MODULES")[1], \
+        "tests/test_serving.py must be registered in QUICK_MODULES"

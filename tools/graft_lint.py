@@ -9,17 +9,25 @@ FLAGS_jit_debug_program=1 and audits the captured jaxprs:
   D1 dtype-stream (bf16 policy violations / silent promotions)
   D2 donation (train-step buffers not updated in place, with byte cost)
   D3 host-sync (graph-break flush sites, eager fallbacks, host callbacks)
-  D4 fusion-miss (unfused norm/rotary/swiglu/dropout-add + gating reason)
-  D5 VMEM budget (flash autotune entries + norm configs vs the per-core
-     limit)
+  D4 fusion-miss (unfused norm/rotary/swiglu/dropout-add/decode-attention
+     + gating reason)
+  D5 VMEM budget (flash autotune entries + norm/decode configs vs the
+     per-core limit)
+
+The special model name `paged` audits the SERVING step program instead: a
+tiny-LLaMA 2-slot continuous-batching engine is run through real
+prefill/decode steps and its decode program's jaxpr goes through the
+fusion-miss/callback/dtype detectors plus the D5 decode-config budget at
+default flags.
 
 Exit code: 0 when no unsuppressed warning/error finding survives the
 baseline (notes never fail); 1 otherwise. CI runs
-`graft_lint.py --models llama,gpt,bert --json` via tools/check_scoreboard.
+`graft_lint.py --models llama,gpt,bert,paged --json` via
+tools/check_scoreboard.
 
 Usage:
     python tools/graft_lint.py                      # AST lint + D5 only
-    python tools/graft_lint.py --models llama,gpt,bert
+    python tools/graft_lint.py --models llama,gpt,bert,paged
     python tools/graft_lint.py --json               # machine output
     python tools/graft_lint.py --baseline my.json   # suppression file
     python tools/graft_lint.py --no-ast             # jaxpr audits only
@@ -89,6 +97,45 @@ def audit_model(name: str) -> list:
     return findings
 
 
+def audit_serving() -> list:
+    """The `paged` smoke: drive a tiny-LLaMA 2-slot serving engine through
+    real prefill + decode steps (mixed-length requests, so a slot frees
+    and refills), then audit the decode step program's jaxpr and the
+    decode kernel's launch-config budget at default flags."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis
+    from paddle_tpu.core.flags import flag
+    from paddle_tpu.inference.engine import ServingEngine
+    from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=64)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    eng = ServingEngine(model, max_slots=2)
+    rs = np.random.RandomState(0)
+    for ln, nt in ((3, 2), (6, 5), (4, 3)):
+        eng.add_request(rs.randint(0, 128, (ln,)), max_new_tokens=nt)
+    out = eng.run()
+    assert len(out) == 3 and all(len(v) for v in out.values()), \
+        "paged smoke engine failed to drain"
+    jx = eng.decode_program_jaxpr()
+    findings = analysis.audit_fusion_misses(jx, loc="paged/decode_step")
+    findings += analysis.audit_callbacks(jx, loc="paged/decode_step")
+    findings += analysis.audit_dtype_stream(
+        jx, policy=str(flag("FLAGS_residual_dtype")),
+        loc="paged/decode_step")
+    findings += analysis.audit_decode_config(
+        eng.spec.head_dim, eng.block_size,
+        group=max(1, eng.spec.num_heads // eng.spec.num_kv_heads),
+        itemsize=2, loc="paged/decode-config")
+    return findings
+
+
 def run(models=(), ast=True, baseline_path=DEFAULT_BASELINE):
     from paddle_tpu import analysis
 
@@ -97,7 +144,10 @@ def run(models=(), ast=True, baseline_path=DEFAULT_BASELINE):
         findings += analysis.lint_tree(REPO)
     findings += analysis.audit_tune_cache()
     for name in models:
-        findings += audit_model(name)
+        if name == "paged":
+            findings += audit_serving()
+        else:
+            findings += audit_model(name)
     analysis.apply_baseline(findings, analysis.load_baseline(baseline_path))
     return findings
 
@@ -106,7 +156,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--models", default="",
                     help="comma-separated smoke configs to audit "
-                         "(llama,gpt,bert)")
+                         "(llama,gpt,bert,paged)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
