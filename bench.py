@@ -854,8 +854,12 @@ def bench_llama_serving(n_requests=None):
     prompts = [rs.randint(0, cfg.vocab_size, (ln,)).astype("int64")
                for ln, _ in stream]
 
-    def drive(mode):
+    def drive(mode, warmed=False):
         eng = ServingEngine(model, max_slots=slots, admission=mode)
+        if warmed:
+            # the warmup drive compiled every bucket this stream needs:
+            # a compile during the measured drive is a watchdog finding
+            eng.finish_warmup()
         for p, (_, nt) in zip(prompts, stream):
             eng.add_request(p, max_new_tokens=nt)
         t0 = time.perf_counter()
@@ -864,9 +868,12 @@ def bench_llama_serving(n_requests=None):
         return wall, eng.stats()
 
     drive("continuous")                    # warm the per-bucket programs
-    wall_c, st_c = drive("continuous")
-    wall_s, st_s = drive("static")
+    wall_c, st_c = drive("continuous", warmed=True)
+    wall_s, st_s = drive("static", warmed=True)
     ttfts = sorted(st_c["ttft_s"])
+    qwaits = sorted(st_c["queue_wait_s"])
+    prefills = sorted(x - q for x, q in zip(st_c["ttft_s"],
+                                            st_c["queue_wait_s"]))
     util_c = st_c["slot_utilization"]
     util_s = st_s["slot_utilization"]
     out = {"name": "llama_serving_continuous_batching",
@@ -887,6 +894,14 @@ def bench_llama_serving(n_requests=None):
            "ttft_ms_mean": round(1e3 * sum(ttfts) / len(ttfts), 1),
            "ttft_ms_p95": round(1e3 * ttfts[int(0.95 * (len(ttfts) - 1))],
                                 1),
+           # TTFT decomposition (round 11, satellite 6): p95 TTFT =
+           # queue wait (admission blocked on slots/blocks) + prefill
+           # (the program span) — quoting one number hid which side a
+           # regression lived on
+           "queue_wait_ms_p95": round(
+               1e3 * qwaits[int(0.95 * (len(qwaits) - 1))], 1),
+           "prefill_ms_p95": round(
+               1e3 * prefills[int(0.95 * (len(prefills) - 1))], 1),
            "slot_utilization": util_c,
            "static_slot_utilization": util_s,
            "utilization_gain": round(util_c / max(util_s, 1e-9), 2),
@@ -1086,6 +1101,21 @@ def run_one(name):
     res = ALL[name]()
     res["wall_s"] = round(time.perf_counter() - t0, 1)
     res["platform"] = jax.devices()[0].platform
+    # round 11: every rung's row carries its compile counts + cache hit
+    # rates (obs watchdog + the executable caches) — the scoreboard can
+    # see a retrace regression (e.g. a bucketing change recompiling per
+    # length) right in BENCH_DETAILS.json, next to the tok/s it cost
+    try:
+        from paddle_tpu import obs
+        from paddle_tpu.core.dispatch import eager_cache_info
+        from paddle_tpu.core.lazy import seg_cache_info
+
+        res["obs"] = {"compiles": obs.compile_counts(),
+                      "post_warmup_compiles": obs.post_warmup_compiles(),
+                      "eager_cache": eager_cache_info(),
+                      "seg_cache": seg_cache_info()}
+    except Exception:
+        pass  # a rung that never imported paddle_tpu stays lean
     print("BENCH_RESULT " + json.dumps(res))
 
 

@@ -84,6 +84,7 @@ from . import utils
 from . import fft
 from . import signal
 from . import geometric
+from . import obs
 from . import version
 from . import sysconfig
 from . import hub

@@ -22,6 +22,15 @@ Jaxpr detectors (jaxpr_audit, vmem):
 AST rules (ast_lint): x64 toggles outside ops/_pallas_common.py, custom_vjp
 residuals wider than their declared `# vjp-saves:`, flags missing from the
 README table, dy2static-unconvertible constructs in @to_static functions.
+
+Runtime detector (round 11, implemented in obs/watchdog.py and
+re-exported here because its output is Findings):
+  D6 audit_recompiles    recompile storms (one program family compiling
+                         more distinct keys than
+                         FLAGS_obs_compile_storm_threshold, or one key
+                         repeatedly) and any compile after a
+                         ServingEngine warmup barrier — the graft_lint
+                         `obs` smoke gates on it.
 """
 from .ast_lint import (audit_flags_doc, lint_dy2static, lint_file,
                        lint_tree, lint_vjp_saves, lint_x64)
@@ -35,7 +44,17 @@ from .vmem import (audit_decode_config, audit_norm_config,
                    audit_tune_cache, decode_vmem_bytes, flash_vmem_bytes,
                    norm_vmem_bytes)
 
+
+def audit_recompiles(events=None, threshold=None, loc="obs/watchdog"):
+    """D6: compile-watchdog findings (obs/watchdog.py) — deferred import
+    so `import paddle_tpu.analysis` stays obs-free."""
+    from ..obs.watchdog import audit_recompiles as _impl
+
+    return _impl(events=events, threshold=threshold, loc=loc)
+
+
 __all__ = [
+    "audit_recompiles",
     "Finding", "apply_baseline", "format_text", "gate_failures",
     "load_baseline", "to_json",
     "audit_callbacks", "audit_compiled", "audit_donation",

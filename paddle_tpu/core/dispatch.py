@@ -469,6 +469,12 @@ def _cached_dispatch(fn, fn_id, name, datas, diff_idx, target,
             _eager_cache.pop(next(iter(_eager_cache)))
         entry = _build_entry(fn, datas, diff_idx, dyn_pos)
         _eager_cache[key] = entry
+        # compile watchdog: a miss means a new executable entry — record
+        # it (obs/watchdog.py). Only this cold path pays the event; wall
+        # time is ~0 here because jax.jit traces lazily on first call.
+        # The key is digested: a re-BUILD of the same digest after
+        # eviction is the cache-thrash signal audit_recompiles flags.
+        _record_compile()("eager", name, f"{name}#{hash(key) & 0xffffffff:08x}")
     else:
         _eager_hits += 1
     kind, jitted, *defer = entry
@@ -524,6 +530,18 @@ def _cached_dispatch(fn, fn_id, name, datas, diff_idx, target,
         # fall back for THIS call only — the uncached path raises the same
         # error to the user; valid calls keep using the cached entry
         return None
+
+
+_RECORD_COMPILE = None
+
+
+def _record_compile():
+    # bound lazily like _TENSOR_CLS: obs lives above core in the package
+    # graph and this only runs on the rare miss path
+    global _RECORD_COMPILE
+    if _RECORD_COMPILE is None:
+        from ..obs.watchdog import record_compile as _RECORD_COMPILE  # noqa: F811
+    return _RECORD_COMPILE
 
 
 def eager_cache_info() -> dict:

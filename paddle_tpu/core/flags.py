@@ -206,6 +206,26 @@ define_flag("FLAGS_residual_dtype", "float32",
             "the norm kernels' accumulation — halving the elementwise "
             "traffic on this bandwidth-capped device; loss drift is "
             "bounded by tests/test_pallas_norm.py")
+define_flag("FLAGS_obs_metrics", False,
+            "opt-in for obs registry instrumentation OUTSIDE the serving "
+            "engine (hapi TelemetryCallback auto-attach in fit()); the "
+            "serving engine always records into its own registry and the "
+            "compile watchdog always records compile events — both are "
+            "off the steady-state hot path")
+define_flag("FLAGS_obs_log_path", "",
+            "JSONL event log path (obs/metrics.py): compile events, "
+            "logger records and registry snapshots append here as one "
+            "structured line each; empty = disabled")
+define_flag("FLAGS_obs_compile_storm_threshold", 8,
+            "compile watchdog (obs/watchdog.py): more than this many "
+            "DISTINCT program keys for one (site, family) is a "
+            "recompile-storm warning in audit_recompiles — bucketing "
+            "keeps real ladders O(log L), exact-length keying blows "
+            "past it")
+define_flag("FLAGS_obs_http_port", 0,
+            "when > 0 the ServingEngine exposes its metrics registry at "
+            "http://127.0.0.1:<port>/metrics (Prometheus text "
+            "exposition, stdlib http.server daemon thread); 0 = off")
 
 
 # the full reference flag surface (compat entries; must come after the

@@ -171,11 +171,21 @@ class _OpRecord:
 _seg_cache: dict = {}
 _seg_hits = 0
 _seg_misses = 0
+#: process-wide flush count (every Segment.flush with staged ops): the
+#: graph-break rate the obs train callback reports per step — a step
+#: whose flush count grows is paying host syncs (analysis D3 territory)
+_flushes_total = 0
 
 
 def seg_cache_info():
     return {"entries": len(_seg_cache), "hits": _seg_hits,
             "misses": _seg_misses}
+
+
+def flush_info() -> dict:
+    """Segment-flush telemetry for obs consumers (hapi TelemetryCallback
+    diffs `flushes` across a step to count graph-break syncs)."""
+    return {"flushes": _flushes_total, **seg_cache_info()}
 
 
 def seg_cache_clear():
@@ -233,7 +243,7 @@ class Segment:
 
     # ------------------------------------------------------------ flush
     def flush(self, reason="concretization"):
-        global _seg_hits, _seg_misses
+        global _seg_hits, _seg_misses, _flushes_total
         if self.flushed:
             return
         self.flushed = True  # first, so re-entrant get() can't recurse
@@ -241,6 +251,7 @@ class Segment:
             self.ctx.open_seg = None
         if not self.ops:
             return
+        _flushes_total += 1
         if self.ctx is not None:
             self.ctx.segments_flushed += 1
             from .flags import flag as _flag

@@ -1,7 +1,8 @@
 """paddle.hapi — high-level Model API (≙ python/paddle/hapi)."""
 from . import callbacks
-from .callbacks import Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger
+from .callbacks import (Callback, EarlyStopping, LRScheduler,
+                        ModelCheckpoint, ProgBarLogger, TelemetryCallback)
 from .model import Model
 
 __all__ = ["Model", "callbacks", "Callback", "ProgBarLogger", "ModelCheckpoint",
-           "EarlyStopping", "LRScheduler"]
+           "EarlyStopping", "LRScheduler", "TelemetryCallback"]

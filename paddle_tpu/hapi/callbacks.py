@@ -182,6 +182,79 @@ class LRScheduler(Callback):
                 s.step()
 
 
+class TelemetryCallback(Callback):
+    """Train-loop telemetry into an obs metrics registry (round 11).
+
+    Per train batch: step wall time (histogram), loss (gauge), tokens/s
+    (gauge, when the batch's token count is derivable), and the
+    segmented-lazy flush count this step forced (counter, diffed from
+    core/lazy.py's process total — a step whose flush count grows is
+    paying graph-break host syncs). Per step it also mirrors the compile
+    watchdog's total, so a retrace mid-training shows in the same
+    registry the serving path exports.
+
+    Attach explicitly (``model.fit(..., callbacks=[TelemetryCallback()])``)
+    or globally via ``FLAGS_obs_metrics=1`` (config_callbacks auto-adds
+    one). The callback API surfaces no batch tensors, so token
+    accounting is declared: pass ``batch_tokens`` (tokens per batch, e.g.
+    ``batch * seq_len`` for an LM) or call ``set_batch_tokens``; without
+    it the tokens/s gauge stays unset and step time/loss still record.
+    """
+
+    def __init__(self, registry=None, batch_tokens=None):
+        from .. import obs
+
+        reg = registry if registry is not None else obs.default_registry()
+        self.registry = reg
+        self._m_step = reg.histogram(
+            "train_step_seconds", "one train_batch call (fwd+bwd+opt)")
+        self._m_loss = reg.gauge("train_loss", "last train batch loss")
+        self._m_tps = reg.gauge(
+            "train_tokens_per_sec", "tokens (or rows) / step wall")
+        self._m_steps = reg.counter("train_steps_total", "train batches run")
+        self._m_flushes = reg.counter(
+            "train_lazy_flushes_total",
+            "segmented-lazy segment flushes forced during train steps "
+            "(graph-break host syncs, core/lazy.py)")
+        self._t0 = None
+        self._flush0 = 0
+        self._batch_tokens = None if batch_tokens is None \
+            else int(batch_tokens)
+
+    def _flushes(self):
+        from ..core.lazy import flush_info
+
+        return flush_info()["flushes"]
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t0 = time.time()
+        self._flush0 = self._flushes()
+
+    def set_batch_tokens(self, n):
+        """Override token accounting when inputs aren't id tensors."""
+        self._batch_tokens = int(n)
+        return self
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._t0 is None:
+            return
+        dt = max(time.time() - self._t0, 1e-9)
+        self._t0 = None
+        self._m_step.observe(dt)
+        self._m_steps.inc()
+        self._m_flushes.inc(max(self._flushes() - self._flush0, 0))
+        logs = logs or {}
+        loss = logs.get("loss")
+        if isinstance(loss, (list, tuple)):
+            loss = loss[0] if loss else None
+        if isinstance(loss, (int, float, np.floating)):
+            self._m_loss.set(float(loss))
+        if self._batch_tokens:
+            self._m_tps.set(self._batch_tokens / dt)
+
+    # predict/eval keep the defaults (train is the instrumented loop)
+
+
 def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
                      log_freq=1, verbose=2, save_freq=1, save_dir=None,
                      metrics=None, mode="train"):
@@ -190,6 +263,11 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
         cbks = [ProgBarLogger(log_freq, verbose=verbose)] + cbks
     if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbks):
         cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
+    from .. import obs
+
+    if mode == "train" and obs.metrics_enabled() \
+            and not any(isinstance(c, TelemetryCallback) for c in cbks):
+        cbks = cbks + [TelemetryCallback()]
     params = {"epochs": epochs, "steps": steps, "verbose": verbose,
               "metrics": metrics or []}
     return CallbackList(cbks, model, params)

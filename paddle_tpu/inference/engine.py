@@ -249,12 +249,18 @@ _prefill_step = functools.partial(
 
 # ------------------------------------------------------------ scheduler
 
+#: host-side mirror of the step programs' jit cache keys (shared across
+#: engines, like the executables themselves) — obs compile watchdog
+_SEEN_SERVING_PROGRAMS: set = set()
+
+
 class Request:
     """One generation request riding the engine."""
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "do_sample",
                  "temperature", "top_k", "top_p", "eos_token_id",
-                 "tokens", "arrival_s", "first_token_s", "finished")
+                 "tokens", "arrival_s", "admitted_s", "first_token_s",
+                 "finished")
 
     def __init__(self, rid, prompt, max_new_tokens, do_sample, temperature,
                  top_k, top_p, eos_token_id):
@@ -268,6 +274,7 @@ class Request:
         self.eos_token_id = -1 if eos_token_id is None else int(eos_token_id)
         self.tokens: list[int] = []
         self.arrival_s = time.perf_counter()
+        self.admitted_s = None      # set when a slot + block budget land
         self.first_token_s = None
         self.finished = False
 
@@ -276,6 +283,23 @@ class Request:
         if self.first_token_s is None:
             return None
         return self.first_token_s - self.arrival_s
+
+    @property
+    def queue_wait_s(self):
+        """Host wall spent WAITING for admission (slot + block budget).
+        Split out of TTFT so the prefill span measures prefill — a pool
+        blocking on releases used to inflate 'prefill' p95s."""
+        if self.admitted_s is None:
+            return None
+        return self.admitted_s - self.arrival_s
+
+    @property
+    def prefill_s(self):
+        """Admission → first token: the actual prefill program span.
+        ttft_s == queue_wait_s + prefill_s."""
+        if self.first_token_s is None or self.admitted_s is None:
+            return None
+        return self.first_token_s - self.admitted_s
 
 
 class ServingEngine:
@@ -352,16 +376,86 @@ class ServingEngine:
         self._waiting: deque[Request] = deque()
         self._key = jax.random.PRNGKey(int(seed))
         self._next_id = 0
-        # stats (the serving bench's raw material); decode/prefill wall
-        # time is split so throughput numbers divide by the right clock
+        # scheduler bookkeeping the step logic itself reads
         self.steps = 0
         self.active_slot_steps = 0
-        self.decode_tokens = 0
-        self.prefill_tokens = 0
-        self.decode_time_s = 0.0
-        self.prefill_time_s = 0.0
         self.completed: dict[int, np.ndarray] = {}
         self.ttfts: list[float] = []
+        self.queue_waits: list[float] = []
+        # ---- telemetry (obs): the serving stats ARE a metrics registry
+        # now — stats() is a thin view over it. Per-ENGINE registry so
+        # concurrent engines/tests never share counters; always on (the
+        # per-tick cost is a handful of attribute updates — PERF.md
+        # round 11 measures the overhead under 2% tok/s).
+        from .. import obs
+
+        self.registry = obs.Registry()
+        reg = self.registry
+        self._m_ttft = reg.histogram(
+            "serving_ttft_seconds", "arrival -> first token (= queue wait "
+            "+ prefill)")
+        self._m_queue_wait = reg.histogram(
+            "serving_queue_wait_seconds",
+            "arrival -> admission (slot + full block budget)")
+        self._m_prefill = reg.histogram(
+            "serving_prefill_seconds", "admission -> first token (the "
+            "prefill program span, queue wait excluded)")
+        self._m_decode_step = reg.histogram(
+            "serving_decode_step_seconds", "one decode tick (all active "
+            "slots advance one token)")
+        self._m_tpot = reg.histogram(
+            "serving_tpot_seconds", "time per output token: decode tick "
+            "wall / active slots")
+        self._m_decode_tokens = reg.counter(
+            "serving_decode_tokens_total", "tokens emitted by decode ticks")
+        self._m_prefill_tokens = reg.counter(
+            "serving_prefill_tokens_total", "prompt tokens prefilled")
+        self._m_completed = reg.counter(
+            "serving_requests_completed_total", "requests finished (eos or "
+            "length)")
+        self._m_rejects = reg.counter(
+            "serving_admission_rejects_total", "requests rejected outright "
+            "(could never be served)", ("reason",))
+        self._m_blocked = reg.counter(
+            "serving_admission_blocked_total", "admission attempts that "
+            "waited: head-of-line request's block budget did not fit the "
+            "free pool")
+        self._m_queue_depth = reg.gauge(
+            "serving_queue_depth", "requests waiting for admission")
+        self._m_active = reg.gauge(
+            "serving_active_slots", "slots currently decoding")
+        self._m_pool_free = reg.gauge(
+            "serving_block_pool_free_blocks", "free KV blocks")
+        self._m_pool_used = reg.gauge(
+            "serving_block_pool_used_blocks", "allocated KV blocks")
+        reg.gauge("serving_slots", "engine slot count").set(self.max_slots)
+        reg.gauge("serving_kv_pool_blocks",
+                  "total KV blocks (incl. trash)").set(
+                      self.allocator.num_blocks)
+        self._m_pool_free.set(self.allocator.available)
+        # compile watchdog state: after finish_warmup() any NEW program
+        # key is a steady-state retrace (warm=True -> lint finding).
+        # The static key prefix is prehashed ONCE — _track_program runs
+        # every tick and a frozen dataclass rehashes per lookup
+        self._prog_key_base = hash(
+            (self.spec, self.block_size, self.quantized, self.pages,
+             self.allocator.num_blocks, str(self.cache.k.dtype)))
+        self._warmed = False
+        self._log = obs.get_logger(__name__)
+        self._metrics_server = None
+        port = int(flag("FLAGS_obs_http_port"))
+        if port > 0:
+            try:
+                self._metrics_server = obs.serve_metrics(port, reg)
+            except OSError as e:
+                # a fixed port serves ONE engine per process; later
+                # engines (bench drives, per-call generate_paged) must
+                # not crash on the bind — they just go unscraped
+                self._log.warning(
+                    f"obs metrics endpoint :{port} not started ({e}); "
+                    "another engine already owns it — use "
+                    "obs.serve_metrics(port, engine.registry) to expose "
+                    "this one", key="obs-http-bind")
 
     # ------------------------------------------------------------- API
     def add_request(self, prompt, max_new_tokens=32, do_sample=False,
@@ -373,19 +467,22 @@ class ServingEngine:
             prompt._data if hasattr(prompt, "_data") else prompt,
             np.int64).reshape(-1).astype(np.int32)
         if prompt.size < 1:
-            raise ValueError("empty prompt")
+            self._reject("empty_prompt", "empty prompt")
         if int(max_new_tokens) < 1:
-            raise ValueError("max_new_tokens must be positive")
+            self._reject("bad_max_new_tokens",
+                         "max_new_tokens must be positive")
         total = prompt.size + int(max_new_tokens)
         if total > self.max_model_len:
-            raise ValueError(
+            self._reject(
+                "context_overflow",
                 f"prompt ({prompt.size}) + max_new_tokens "
                 f"({max_new_tokens}) = {total} exceeds the engine context "
                 f"({self.max_model_len} = max_position_embeddings rounded "
                 f"down to whole {self.block_size}-token kv blocks)")
         need = blocks_for(total, self.block_size)
         if need > self.allocator.num_blocks - 1:
-            raise ValueError(
+            self._reject(
+                "pool_too_small",
                 f"request needs {need} kv blocks but the pool only has "
                 f"{self.allocator.num_blocks - 1}")
         rid = self._next_id
@@ -393,7 +490,15 @@ class ServingEngine:
         self._waiting.append(Request(rid, prompt, max_new_tokens,
                                      do_sample, temperature, top_k, top_p,
                                      eos_token_id))
+        self._m_queue_depth.set(len(self._waiting))
         return rid
+
+    def _reject(self, reason: str, msg: str):
+        """Admission reject: count it, log it (rate-limited), raise."""
+        self._m_rejects.labels(reason).inc()
+        self._log.warning(f"admission reject ({reason}): {msg}",
+                          key=f"reject:{reason}")
+        raise ValueError(msg)
 
     @property
     def num_active(self) -> int:
@@ -430,17 +535,88 @@ class ServingEngine:
         return dict(self.completed)
 
     def stats(self) -> dict:
+        """Thin view over the metrics registry (plus the scheduler's own
+        counters) — the pre-obs ad-hoc stats dict, same keys, now derived
+        from the same numbers /metrics exports. New in round 11:
+        `queue_wait_s` / the TTFT decomposition (ttft = queue_wait +
+        prefill, satellite-6 fix)."""
         util = (self.active_slot_steps / (self.steps * self.max_slots)
                 if self.steps else 0.0)
-        return {"steps": self.steps, "decode_tokens": self.decode_tokens,
-                "prefill_tokens": self.prefill_tokens,
-                "decode_time_s": self.decode_time_s,
-                "prefill_time_s": self.prefill_time_s,
+        return {"steps": self.steps,
+                "decode_tokens": int(self._m_decode_tokens.value),
+                "prefill_tokens": int(self._m_prefill_tokens.value),
+                "decode_time_s": self._m_decode_step.sum,
+                "prefill_time_s": self._m_prefill.sum,
+                "queue_wait_time_s": self._m_queue_wait.sum,
                 "slot_utilization": round(util, 4),
                 "ttft_s": list(self.ttfts),
+                "queue_wait_s": list(self.queue_waits),
+                "admission_blocked": int(self._m_blocked.value),
+                "requests_completed": int(self._m_completed.value),
                 "kv_pool_blocks": self.allocator.num_blocks,
                 "kv_pool_free": self.allocator.available,
                 "kv_hbm_bytes": self.cache.hbm_bytes}
+
+    def metrics(self) -> dict:
+        """Registry snapshot (counters/gauges + histogram quantiles) —
+        the machine-readable serving telemetry; render_prometheus() is
+        the scrape body of the same registry."""
+        return self.registry.to_dict()
+
+    def render_prometheus(self) -> str:
+        return self.registry.render_prometheus()
+
+    def finish_warmup(self):
+        """Declare the program ladder warm: every (prefill-bucket,
+        decode-bucket, sampling) program this workload needs has
+        compiled. Any compile recorded after this is tagged warm=True —
+        a steady-state retrace — and fails the obs lint smoke
+        (obs.audit_recompiles post-warmup-compile warning)."""
+        self._warmed = True
+        return self
+
+    @property
+    def warmed(self) -> bool:
+        return self._warmed
+
+    def close(self):
+        """Stop the optional /metrics endpoint (no-op otherwise)."""
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
+
+    def _track_program(self, site: str, bucket: int, any_sample: bool):
+        """Host-side mirror of the step programs' jit cache keys: a NEW
+        key is (to first order) a fresh trace+compile. Returns None for a
+        warm key, else a callback the caller invokes with the measured
+        wall — recording the compile event with the engine's warm flag.
+        The seen-set is MODULE level because _prefill_step/_decode_step
+        executables are shared across engines (same spec + shapes reuse
+        the compiled program, so a second engine genuinely pays no
+        trace)."""
+        key = (site, self._prog_key_base, bool(any_sample), int(bucket))
+        if key in _SEEN_SERVING_PROGRAMS:
+            return None
+        _SEEN_SERVING_PROGRAMS.add(key)
+        warm = self._warmed
+
+        def record(wall_s):
+            from ..obs.watchdog import record_compile
+
+            record_compile(
+                site, f"{site}/L{self.spec.num_layers}"
+                f"h{self.spec.num_heads}d{self.spec.head_dim}",
+                f"bucket{bucket}/sample{int(any_sample)}/"
+                f"q{int(self.quantized)}",
+                bucket=int(bucket), wall_s=wall_s, donated=True,
+                warm=warm)
+            if warm:
+                self._log.warning(
+                    f"post-warmup compile: {site} bucket {bucket} traced "
+                    "after finish_warmup() — steady-state ticks must not "
+                    "compile", key=f"warm-compile:{site}")
+
+        return record
 
     # ------------------------------------------------------- scheduling
     def _admit(self):
@@ -459,10 +635,25 @@ class ServingEngine:
                               self.block_size)
             ids = self.allocator.alloc(need)
             if ids is None:
-                break                      # pool full: wait for releases
+                # pool full: wait for releases. The head-of-line request
+                # keeps QUEUEING (its clock runs in queue_wait, not
+                # prefill — the satellite-6 TTFT decomposition fix)
+                self._m_blocked.inc()
+                self._log.vlog(
+                    2, f"admission blocked: request {req.rid} needs "
+                    f"{need} blocks, {self.allocator.available} free",
+                    key="admission-blocked")
+                break
             self._waiting.popleft()
+            req.admitted_s = time.perf_counter()
+            self.queue_waits.append(req.queue_wait_s)
+            self._m_queue_wait.observe(req.queue_wait_s)
+            self._m_queue_depth.set(len(self._waiting))
             self._slot_req[slot] = req
             self._slot_blocks[slot] = ids
+            self._m_pool_free.set(self.allocator.available)
+            self._m_pool_used.set(self.allocator.num_blocks - 1
+                                  - self.allocator.available)
             row = np.zeros(self.pages, np.int32)
             row[:len(ids)] = ids
             self._tables[slot] = row
@@ -479,21 +670,29 @@ class ServingEngine:
         bucket = min(_ceil_to(default_buckets(s), self.block_size),
                      self.max_model_len)
         bucket = max(bucket, _ceil_to(s, self.block_size))
+        new_prog = self._track_program("serving.prefill", bucket,
+                                       req.do_sample)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :s] = req.prompt
         samp = self._samp_arrays([req])
         c = self.cache
-        out = _prefill_step(
-            self.spec, self.block_size, self.quantized, req.do_sample,
-            self.params, jnp.asarray(ids), jnp.int32(s),
-            jnp.asarray(self._tables[slot]), c.k, c.v, c.k_scale,
-            c.v_scale, samp, self._key)
-        tok_arr, c.k, c.v, c.k_scale, c.v_scale, self._key = out
-        tok = int(jax.device_get(tok_arr)[0])
+        from ..obs import span as _span
+
+        with _span("serving.prefill"):
+            out = _prefill_step(
+                self.spec, self.block_size, self.quantized, req.do_sample,
+                self.params, jnp.asarray(ids), jnp.int32(s),
+                jnp.asarray(self._tables[slot]), c.k, c.v, c.k_scale,
+                c.v_scale, samp, self._key)
+            tok_arr, c.k, c.v, c.k_scale, c.v_scale, self._key = out
+            tok = int(jax.device_get(tok_arr)[0])
         req.first_token_s = time.perf_counter()
-        self.prefill_time_s += req.first_token_s - t0
+        if new_prog is not None:
+            new_prog(wall_s=req.first_token_s - t0)
+        self._m_prefill.observe(req.prefill_s)
+        self._m_ttft.observe(req.ttft_s)
         self.ttfts.append(req.ttft_s)
-        self.prefill_tokens += s
+        self._m_prefill_tokens.inc(s)
         req.tokens.append(tok)
         self._slot_pos[slot] = s
         return tok, self._check_done(req, tok)
@@ -513,6 +712,7 @@ class ServingEngine:
              np.full((pad, self.pages), TRASH_BLOCK, np.int32)])
         samp = self._samp_arrays(reqs, pad)
         any_sample = any(r.do_sample for r in reqs)
+        new_prog = self._track_program("serving.decode", bucket, any_sample)
         c = self.cache
         out = _decode_step(
             self.spec, self.block_size, self.quantized, any_sample,
@@ -521,18 +721,23 @@ class ServingEngine:
             self._key)
         nxt, c.k, c.v, c.k_scale, c.v_scale, self._key = out
         nxt = np.asarray(jax.device_get(nxt))
-        self.decode_time_s += time.perf_counter() - t0
+        step_wall = time.perf_counter() - t0
+        if new_prog is not None:
+            new_prog(wall_s=step_wall)
+        self._m_decode_step.observe(step_wall)
+        self._m_tpot.observe(step_wall / len(active))
+        self._m_active.set(len(active))
         emitted = []
         for j, slot in enumerate(active):
             req = self._slot_req[slot]
             t = int(nxt[j])
             req.tokens.append(t)
             self._slot_pos[slot] += 1
-            self.decode_tokens += 1
             done = self._check_done(req, t)
             emitted.append((req.rid, t, done))
             if done:
                 self._finish(slot)
+        self._m_decode_tokens.inc(len(active))
         return emitted
 
     def _samp_arrays(self, reqs, pad=0):
@@ -568,6 +773,10 @@ class ServingEngine:
         self._slot_req[slot] = None
         self._slot_pos[slot] = 0
         self._tables[slot] = TRASH_BLOCK
+        self._m_completed.inc()
+        self._m_pool_free.set(self.allocator.available)
+        self._m_pool_used.set(self.allocator.num_blocks - 1
+                              - self.allocator.available)
 
     # ------------------------------------------------------- introspection
     def decode_program_jaxpr(self, bucket=2):

@@ -339,6 +339,16 @@ class ServingPredictor:
     def get_stats(self) -> dict:
         return self.engine.stats()
 
+    def metrics(self) -> dict:
+        """The engine's obs registry snapshot (counters/gauges + TTFT /
+        queue-wait / TPOT histogram quantiles) — the machine-readable
+        twin of get_stats(); same numbers the /metrics endpoint
+        (FLAGS_obs_http_port) exposes in Prometheus text form."""
+        return self.engine.metrics()
+
+    def render_prometheus(self) -> str:
+        return self.engine.render_prometheus()
+
 
 def create_serving_predictor(config: Config, model=None) -> ServingPredictor:
     return ServingPredictor(config, model)

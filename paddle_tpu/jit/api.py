@@ -371,7 +371,11 @@ class CompiledFunction:
         from .dy2static.diagnostics import Dy2StFallback, classify_graph_break
 
         try:
+            import time as _time
+
+            _t0 = _time.perf_counter()
             out_datas, mut_out = jitted(arg_datas, ro_datas, mut_datas)
+            _compile_wall = _time.perf_counter() - _t0
         except (Dy2StFallback,) + _GRAPH_BREAK_ERRORS as e:
             fn_name = getattr(self._fn, "__name__", str(self._fn))
             reason = classify_graph_break(e)
@@ -387,27 +391,35 @@ class CompiledFunction:
                     "tools/report_graph_breaks.py for every site), or pass "
                     "full_graph=False to fall back."
                 ) from e
-            import warnings
+            # dy2static fallback messages route through the structured
+            # logger (obs/logging.py: VLOG + rate limit + JSONL); the
+            # Python warning stays emitted (also_warn) because the
+            # graph-break contract is "warns once, then degrades" and
+            # warnings.catch_warnings consumers (tests,
+            # tools/report_graph_breaks.py) assert on it.
+            from ..obs.logging import get_logger
 
+            log = get_logger(__name__)
             if flag("FLAGS_to_static_segmented"):
-                warnings.warn(
+                log.warning(
                     f"to_static: graph break in '{fn_name}' — "
                     f"{self._break_reason}; switching to segmented lazy "
                     "execution — ops run as compiled XLA segments bridged "
                     "eagerly at each concretization point. Python-level side "
                     "effects before the break ran once during capture and "
                     "run again this call.",
+                    key=f"segmented:{fn_name}", also_warn=True,
                     stacklevel=3)
                 self._segmented = True
                 a, k = _unflatten(struct, leaves)
                 return self._run_segmented(a, k)
-            warnings.warn(
+            log.warning(
                 f"to_static: graph break in '{fn_name}' — "
                 f"{self._break_reason}; falling back to eager execution. "
                 "Tensor state from the failed capture was rolled back, but "
                 "Python-level side effects before the break ran once during "
                 "capture and will run again eagerly this call.",
-                stacklevel=3)
+                key=f"eager:{fn_name}", also_warn=True, stacklevel=3)
             self._fallback_eager = True
             a, k = _unflatten(struct, leaves)
             return self._capture_fn()(*a, **k)
@@ -435,6 +447,26 @@ class CompiledFunction:
             spec.debug = (pure, (avals(arg_datas), avals(ro_datas),
                                  avals(mut_datas)))
         self._cache[key] = spec
+        # compile watchdog: one event per specialization (obs/watchdog).
+        # Wall time includes the first execution (trace+compile+run, the
+        # cold-start cost a caller actually feels). jaxpr size only under
+        # FLAGS_jit_debug_program — sizing costs a retrace.
+        from ..obs import watchdog as _watchdog
+
+        fn_name = getattr(self._fn, "__name__", str(self._fn))
+        eqns = None
+        if spec.debug is not None:
+            try:
+                pure_fn, dbg_avals = spec.debug
+                eqns = _watchdog.jaxpr_size(jax.make_jaxpr(pure_fn)(*dbg_avals))
+            except Exception:
+                eqns = None
+        # group per CompiledFunction INSTANCE: distinct wrapped functions
+        # sharing a name (test suites are full of `train_step`s) must not
+        # pool into one fake storm
+        _watchdog.record_compile(
+            "to_static", f"{fn_name}@{id(self) & 0xffff:04x}", key,
+            wall_s=_compile_wall, jaxpr_eqns=eqns, donated=spec.donated)
         return self._finish(spec, out_datas, mut_out)
 
     def _run_segmented(self, args, kwargs):

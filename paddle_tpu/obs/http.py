@@ -1,0 +1,66 @@
+"""Optional stdlib /metrics endpoint for the serving path.
+
+``serve_metrics(port, registry)`` starts a daemon-thread
+``http.server`` exposing:
+
+  * ``/metrics``  — Prometheus text exposition of the registry
+  * ``/healthz``  — 200 "ok" (load-balancer liveness)
+
+No dependencies beyond the stdlib (the container bakes no prometheus
+client), one thread, read-only — good enough for a scrape target, not a
+general web server. The ServingEngine starts one automatically when
+``FLAGS_obs_http_port`` > 0.
+"""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class MetricsServer:
+    def __init__(self, port: int, registry, host: str = "127.0.0.1"):
+        reg = registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                if self.path.split("?")[0] == "/metrics":
+                    body = reg.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                elif self.path.split("?")[0] == "/healthz":
+                    body = b"ok\n"
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                else:
+                    body = b"not found\n"
+                    self.send_response(404)
+                    self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence per-request stderr spam
+                return None
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self.port = self._httpd.server_address[1]  # resolved (port=0 OK)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name=f"obs-metrics-:{self.port}",
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def serve_metrics(port: int, registry=None, host: str = "127.0.0.1"
+                  ) -> MetricsServer:
+    """Start the endpoint; returns the server (``.port`` is the bound
+    port — pass 0 to let the OS pick, handy in tests)."""
+    if registry is None:
+        from . import default_registry
+
+        registry = default_registry()
+    return MetricsServer(port, registry, host=host)

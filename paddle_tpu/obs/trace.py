@@ -1,0 +1,136 @@
+"""Trace spans — named scopes over the device profiler, with a host
+fallback.
+
+On TPU a span wraps ``jax.profiler.TraceAnnotation`` (named scopes in the
+xplane capture; ``step_span`` uses ``StepTraceAnnotation`` so XProf groups
+per-step work), and ``capture_trace(dir)`` is the on-demand profile
+capture — wrap any suspect window and read the xplane in
+TensorBoard/XProf. Off-TPU (the CPU build hosts, CI) the same API records
+wall-clock spans into a bounded host buffer with nesting tracked by a
+thread-local stack, so span-shaped assertions (tests) and span timings
+(the JSONL log) work everywhere the code runs.
+
+Distinct from paddle_tpu.profiler: that module is the reference-parity
+``paddle.profiler`` surface (scheduler states, summary tables, chrome
+trace). ``obs.span`` is the always-available internal instrumentation
+primitive the runtime itself uses — no scheduler, no global recording
+toggle, ~1us per span off-TPU.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+
+_tls = threading.local()
+
+#: host-side span record buffer (off-TPU fallback + tests); bounded so an
+#: instrumented serving loop can run forever
+_SPAN_BUF_CAP = 8192
+_span_buf: deque = deque(maxlen=_SPAN_BUF_CAP)
+
+_backend_memo: str | None = None
+
+
+def _backend() -> str:
+    """jax.default_backend(), memoized — span() must not pay a backend
+    query per call."""
+    global _backend_memo
+    if _backend_memo is None:
+        try:
+            import jax
+
+            _backend_memo = jax.default_backend()
+        except Exception:
+            _backend_memo = "none"
+    return _backend_memo
+
+
+def _stack() -> list:
+    s = getattr(_tls, "span_stack", None)
+    if s is None:
+        s = _tls.span_stack = []
+    return s
+
+
+@contextlib.contextmanager
+def span(name: str, histogram=None):
+    """Named scope: ``with obs.span("prefill"): ...``.
+
+    On TPU, emits a ``TraceAnnotation`` so the scope shows up in xplane
+    captures. Everywhere, records a wall-clock span (qualified with its
+    nesting path, e.g. ``step/prefill``) into the host buffer; when
+    `histogram` (an obs.metrics.Histogram handle) is given, the duration
+    is observed into it — that is how the engine's span timings reach the
+    registry without a second clock read."""
+    stack = _stack()
+    qual = "/".join([*(s for s in stack), name]) if stack else name
+    stack.append(name)
+    ann = None
+    if _backend() == "tpu":
+        import jax.profiler
+
+        ann = jax.profiler.TraceAnnotation(name)
+        ann.__enter__()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        stack.pop()
+        _span_buf.append({"name": name, "path": qual, "seconds": dt,
+                          "depth": len(stack)})
+        if histogram is not None:
+            histogram.observe(dt)
+
+
+@contextlib.contextmanager
+def step_span(step: int, name: str = "train_step"):
+    """Per-step scope: ``StepTraceAnnotation`` on TPU (XProf step
+    grouping), a plain span elsewhere."""
+    if _backend() == "tpu":
+        import jax.profiler
+
+        with jax.profiler.StepTraceAnnotation(name, step_num=int(step)):
+            yield
+        return
+    with span(f"{name}[{int(step)}]"):
+        yield
+
+
+def span_events(clear: bool = False) -> list[dict]:
+    """Snapshot of the host span buffer (newest last)."""
+    out = list(_span_buf)
+    if clear:
+        _span_buf.clear()
+    return out
+
+
+def clear_spans():
+    _span_buf.clear()
+
+
+@contextlib.contextmanager
+def capture_trace(log_dir: str):
+    """On-demand device profile capture around a suspect window:
+
+        with obs.capture_trace("/tmp/xplane"):
+            engine.step()
+
+    Wraps ``jax.profiler.start_trace/stop_trace`` (works on CPU too — the
+    xplane then holds host events only). Refuses to nest with an already
+    running capture (paddle_tpu.profiler's device tracing included):
+    jax allows one active trace per process."""
+    import os
+
+    import jax.profiler
+
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()

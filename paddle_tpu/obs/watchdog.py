@@ -1,0 +1,202 @@
+"""Compile watchdog — every compile/retrace in the process becomes an
+event, and pathological compile behavior becomes a lint Finding.
+
+The round-10 serving push shipped (and satellite-fixed) the classic
+failure this module exists to catch: ``_GenSpec`` keyed one compiled
+program per exact ``max_new_tokens``, so a stream of varied request
+lengths silently compiled O(#distinct lengths) programs — found by
+accident. Now every compile site reports here:
+
+  * ``core/dispatch.py``    eager executable-cache misses  (site "eager")
+  * ``jit/api.py``          to_static specializations      (site "to_static")
+  * ``text/generation.py``  static-engine programs         (site "generate")
+  * ``inference/engine.py`` serving prefill/decode buckets (site
+                            "serving.prefill" / "serving.decode")
+
+Each event carries the program key, its bucket, wall time, donation
+summary and jaxpr size (eqn count, when the site has a cheap jaxpr), and
+increments ``compiles_total{site=...}`` / ``compile_seconds`` in the
+default registry plus the JSONL log. ``audit_recompiles()`` turns the
+event history into ``analysis.Finding``s:
+
+  * RECOMPILE STORM — one (site, group) accumulated more than
+    ``FLAGS_obs_compile_storm_threshold`` distinct program keys, or any
+    single key compiled more than once (an executable cache losing
+    entries mid-run). A generation-length ladder that buckets compiles
+    O(log L) keys and stays under the threshold; exact-length keying
+    blows past it — the fire/no-fire pair in tests/test_obs.py proves
+    both directions.
+  * POST-WARMUP COMPILE — any compile recorded after a ServingEngine
+    declared warmup complete (``finish_warmup()``): a steady-state
+    serving tick must never trace.
+
+Both are warnings, so they fail ``tools/graft_lint.py`` (the ``obs``
+smoke) exactly like dtype regressions do.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from ..core.flags import flag
+
+#: bounded event history (a process compiling >4096 programs has worse
+#: problems than a truncated audit window). Appends/counter bumps rely
+#: on the GIL like the metrics hot path — no lock.
+_EVENT_CAP = 4096
+_events: deque = deque(maxlen=_EVENT_CAP)
+
+#: compiles tagged warm=True by their site (the serving engine tags any
+#: compile after its finish_warmup() barrier) — steady-state retraces
+_post_warmup_total = 0
+
+
+class CompileEvent:
+    """One compile/retrace, as recorded at the site."""
+
+    __slots__ = ("site", "group", "key", "bucket", "wall_s", "jaxpr_eqns",
+                 "donated", "warm", "t")
+
+    def __init__(self, site, group, key, bucket=None, wall_s=0.0,
+                 jaxpr_eqns=None, donated=None, warm=False):
+        self.site = str(site)
+        self.group = str(group)      # program FAMILY (fn/model), storms
+        self.key = str(key)          # exact specialization key
+        self.bucket = bucket
+        self.wall_s = float(wall_s)
+        self.jaxpr_eqns = jaxpr_eqns
+        self.donated = donated
+        self.warm = bool(warm)
+        self.t = time.time()
+
+    def to_dict(self) -> dict:
+        return {"site": self.site, "group": self.group, "key": self.key,
+                "bucket": self.bucket, "wall_s": round(self.wall_s, 4),
+                "jaxpr_eqns": self.jaxpr_eqns, "donated": self.donated,
+                "warm": self.warm, "t": self.t}
+
+
+def record_compile(site: str, group: str, key: str, bucket=None,
+                   wall_s: float = 0.0, jaxpr_eqns=None, donated=None,
+                   warm: bool = False) -> CompileEvent:
+    """Record one compile. Cheap (an append + two counter bumps) and only
+    reached on cache MISSES, so the steady-state hot paths never pay it."""
+    from . import default_registry, metrics
+
+    ev = CompileEvent(site, group, key, bucket=bucket, wall_s=wall_s,
+                      jaxpr_eqns=jaxpr_eqns, donated=donated, warm=warm)
+    _events.append(ev)
+    reg = default_registry()
+    reg.counter("compiles_total", "compiled programs (any site)",
+                ("site",)).labels(site).inc()
+    reg.counter("compile_seconds", "wall seconds spent compiling/tracing",
+                ("site",)).labels(site).inc(max(ev.wall_s, 0.0))
+    if warm:
+        global _post_warmup_total
+        _post_warmup_total += 1
+        reg.counter("post_warmup_compiles_total",
+                    "compiles recorded after a serving warmup barrier",
+                    ("site",)).labels(site).inc()
+    metrics.log_event("compile", **ev.to_dict())
+    return ev
+
+
+def compile_events(site: str | None = None) -> list[CompileEvent]:
+    evs = list(_events)
+    if site is not None:
+        evs = [e for e in evs if e.site == site]
+    return evs
+
+
+def compile_counts() -> dict:
+    """{site: count} over the current event window — what bench rungs and
+    --metrics-json attach to their rows."""
+    out: dict[str, int] = {}
+    for e in _events:
+        out[e.site] = out.get(e.site, 0) + 1
+    return out
+
+
+def post_warmup_compiles() -> int:
+    return _post_warmup_total
+
+
+def clear_events():
+    """Reset the window (tests; bench rungs call it so each row's counts
+    are the rung's own)."""
+    global _post_warmup_total
+    _events.clear()
+    _post_warmup_total = 0
+
+
+def jaxpr_size(jaxpr) -> int:
+    """Eqn count of a ClosedJaxpr incl. sub-jaxprs — the 'program size'
+    a compile event records when the site has a jaxpr in hand."""
+    from ..analysis import iter_eqns
+
+    return sum(1 for _ in iter_eqns(jaxpr))
+
+
+# ---------------------------------------------------------------- audit
+def audit_recompiles(events=None, threshold: int | None = None,
+                     loc: str = "obs/watchdog") -> list:
+    """Recompile-storm + post-warmup-compile Findings over the event
+    window. Notes for healthy sites (visible in --json), warnings for the
+    two failure shapes — the graft_lint ``obs`` smoke gates on these."""
+    from ..analysis import Finding
+
+    if events is None:
+        events = compile_events()
+    if threshold is None:
+        threshold = int(flag("FLAGS_obs_compile_storm_threshold"))
+    findings: list = []
+
+    groups: dict[tuple, list] = {}
+    for e in events:
+        groups.setdefault((e.site, e.group), []).append(e)
+    for (site, group), evs in sorted(groups.items()):
+        keys: dict[str, int] = {}
+        for e in evs:
+            keys[e.key] = keys.get(e.key, 0) + 1
+        distinct = len(keys)
+        repeats = {k: n for k, n in keys.items() if n > 1}
+        # the eager cache specializes per (statics, diff-mask) BY DESIGN —
+        # distinct-key growth there is normal; only a re-BUILD of the
+        # same key (eviction thrash) is pathological
+        if distinct > threshold and site != "eager":
+            findings.append(Finding(
+                "recompile-storm", "warning", f"{loc}:{site}/{group}",
+                f"{distinct} distinct programs compiled for one family "
+                f"(threshold {threshold}) — lengths/shapes are not "
+                f"bucketing (the round-10 exact-max_new_tokens failure "
+                f"shape); keys: "
+                f"{sorted(keys)[:6]}{'...' if distinct > 6 else ''}",
+                data={"site": site, "group": group, "distinct": distinct,
+                      "threshold": threshold,
+                      "total_compiles": len(evs)}))
+        elif repeats:
+            worst = max(repeats.values())
+            findings.append(Finding(
+                "recompile-storm", "warning", f"{loc}:{site}/{group}",
+                f"same program key compiled {worst}x (cache thrash: the "
+                f"executable cache is losing entries mid-run); "
+                f"{len(repeats)} key(s) affected",
+                data={"site": site, "group": group, "repeats": repeats,
+                      "total_compiles": len(evs)}))
+        else:
+            findings.append(Finding(
+                "recompile-storm", "note", f"{loc}:{site}/{group}",
+                f"{distinct} program(s), no retraces",
+                data={"site": site, "group": group, "distinct": distinct}))
+
+    warm = [e for e in events if e.warm]
+    if warm:
+        sites = sorted({f"{e.site}/{e.group}" for e in warm})
+        findings.append(Finding(
+            "post-warmup-compile", "warning", loc,
+            f"{len(warm)} compile(s) recorded AFTER serving warmup "
+            f"completed — steady-state ticks are retracing ({sites}); "
+            f"every serving bucket must compile during warmup",
+            data={"count": len(warm),
+                  "events": [e.to_dict() for e in warm[:8]]}))
+    return findings

@@ -267,6 +267,12 @@ def _logits(x, params, spec: _GenSpec):
     return x.astype(jnp.float32) @ head.astype(jnp.float32)
 
 
+#: host-side mirror of _generate_program's jit cache keys — a NEW key
+#: here is (to first order) a new trace+compile, recorded as a compile
+#: event for the obs watchdog; jax.jit itself stays the source of truth
+_seen_gen_programs: set = set()
+
+
 @functools.partial(jax.jit, static_argnums=(2,), donate_argnums=())
 def _generate_program(params, ids, spec: _GenSpec, rng_key, true_len):
     """The fused prefill+decode program. ids [B, S_bucket] int32, right-
@@ -580,8 +586,30 @@ def generate(model, input_ids, max_new_tokens=32, max_length=None,
     bucket = max(bucket, s_true)
     ids_padded = np.pad(ids, ((0, 0), (0, bucket - s_true))) \
         if bucket > s_true else ids
+    # compile watchdog: _generate_program is keyed by (spec, shapes) —
+    # mirror that key host-side so every NEW specialization records a
+    # compile event (obs/watchdog.py). This is the site whose round-10
+    # failure (a program per exact max_new_tokens) motivated the
+    # watchdog: exact-length keying now shows up as a recompile-storm
+    # finding instead of an accidental discovery.
+    prog_key = (spec, ids_padded.shape, str(params["embed"].dtype))
+    is_new = prog_key not in _seen_gen_programs
+    if is_new:
+        _seen_gen_programs.add(prog_key)
+        import time as _time
+
+        _t0 = _time.perf_counter()
     toks = _generate_program(params, jnp.asarray(ids_padded), spec, key,
                              jnp.int32(s_true))
+    if is_new:
+        from ..obs.watchdog import record_compile
+
+        record_compile(
+            "generate", f"generate/{arch}",
+            f"b{ids_padded.shape[0]}/s{bucket}/g{spec.max_new_tokens}/"
+            f"sample{int(spec.do_sample)}",
+            bucket=(bucket, spec.max_new_tokens),
+            wall_s=_time.perf_counter() - _t0)
     # drop the bucketed tail: tokens [mnt, mnt_bucket) are dead steps the
     # length bucketing trades for program reuse
     toks = np.asarray(jax.device_get(toks))[:, :mnt]

@@ -57,7 +57,8 @@ QUICK_MODULES = {
     "test_distributed_core.py", "test_dy2static.py", "test_flags_doc.py",
     "test_flagship_perf.py",
     "test_generation.py", "test_io.py", "test_jit.py", "test_moe.py",
-    "test_native.py", "test_new_packages.py", "test_nn.py", "test_ops.py",
+    "test_native.py", "test_new_packages.py", "test_nn.py", "test_obs.py",
+    "test_ops.py",
     "test_optimizer.py", "test_pallas_attention.py", "test_pallas_decode.py",
     "test_pallas_norm.py", "test_passes.py",
     "test_profiler.py", "test_scoreboard.py", "test_segmented.py",

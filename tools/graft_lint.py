@@ -20,9 +20,15 @@ prefill/decode steps and its decode program's jaxpr goes through the
 fusion-miss/callback/dtype detectors plus the D5 decode-config budget at
 default flags.
 
+The special model name `obs` (round 11) smokes the telemetry contract: a
+tiny engine runs a warmup pass, declares warmup done, serves steady-state
+requests, and the gate fails if required serving metrics are missing or
+the compile watchdog saw a post-warmup retrace / recompile storm
+(obs/watchdog.py audit_recompiles).
+
 Exit code: 0 when no unsuppressed warning/error finding survives the
 baseline (notes never fail); 1 otherwise. CI runs
-`graft_lint.py --models llama,gpt,bert,paged --json` via
+`graft_lint.py --models llama,gpt,bert,paged,obs --json` via
 tools/check_scoreboard.
 
 Usage:
@@ -136,6 +142,83 @@ def audit_serving() -> list:
     return findings
 
 
+#: metric names the obs smoke requires the serving registry to carry —
+#: the instrumentation contract a refactor must not silently drop
+REQUIRED_SERVING_METRICS = (
+    "serving_ttft_seconds", "serving_queue_wait_seconds",
+    "serving_prefill_seconds", "serving_decode_step_seconds",
+    "serving_tpot_seconds", "serving_decode_tokens_total",
+    "serving_prefill_tokens_total", "serving_requests_completed_total",
+    "serving_admission_rejects_total", "serving_admission_blocked_total",
+    "serving_queue_depth", "serving_active_slots",
+    "serving_block_pool_free_blocks", "serving_block_pool_used_blocks")
+
+#: the subset that MUST have observed/counted after the smoke's drained
+#: runs (rejects/blocked legitimately stay zero on a healthy stream)
+MUST_COUNT_SERVING_METRICS = (
+    "serving_ttft_seconds", "serving_queue_wait_seconds",
+    "serving_prefill_seconds", "serving_decode_step_seconds",
+    "serving_tpot_seconds", "serving_decode_tokens_total",
+    "serving_prefill_tokens_total", "serving_requests_completed_total")
+
+
+def audit_obs() -> list:
+    """The `obs` smoke (round 11): drive a tiny-LLaMA 2-slot engine
+    through a warmup pass, declare warmup done, run a steady-state
+    request at the SAME buckets, then (a) assert the required serving
+    metrics exist and counted, and (b) run the compile watchdog's
+    recompile audit over the serving/generate event window — a
+    post-warmup retrace or a storm fails the gate like a dtype
+    regression."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis, obs
+    from paddle_tpu.inference.engine import ServingEngine
+    from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    obs.clear_events()
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=64)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    eng = ServingEngine(model, max_slots=2)
+    rs = np.random.RandomState(0)
+    for ln, nt in ((3, 3), (6, 4), (4, 3)):     # warm both slot buckets
+        eng.add_request(rs.randint(0, 128, (ln,)), max_new_tokens=nt)
+    eng.run()
+    eng.finish_warmup()
+    for ln, nt in ((5, 3), (3, 4)):             # steady state: same buckets
+        eng.add_request(rs.randint(0, 128, (ln,)), max_new_tokens=nt)
+    out = eng.run()
+    assert out, "obs smoke engine failed to drain"
+
+    findings = []
+    snap = eng.metrics()
+    missing = [m for m in REQUIRED_SERVING_METRICS if m not in snap]
+    zero = [m for m in MUST_COUNT_SERVING_METRICS
+            if m not in missing
+            and not any(s.get("count") or s.get("value")
+                        for s in snap[m]["samples"])]
+    if missing or zero:
+        findings.append(analysis.Finding(
+            "obs-coverage", "error", "obs/serving-smoke",
+            f"serving registry lost required metrics — missing: {missing}, "
+            f"never-observed: {zero}",
+            data={"missing": missing, "zero": zero}))
+    else:
+        findings.append(analysis.Finding(
+            "obs-coverage", "note", "obs/serving-smoke",
+            f"{len(REQUIRED_SERVING_METRICS)} required serving metrics "
+            "present and counting"))
+    evs = [e for e in obs.compile_events()
+           if e.site.startswith("serving") or e.site == "generate"]
+    findings += obs.audit_recompiles(evs, loc="obs/serving-smoke")
+    return findings
+
+
 def run(models=(), ast=True, baseline_path=DEFAULT_BASELINE):
     from paddle_tpu import analysis
 
@@ -146,6 +229,8 @@ def run(models=(), ast=True, baseline_path=DEFAULT_BASELINE):
     for name in models:
         if name == "paged":
             findings += audit_serving()
+        elif name == "obs":
+            findings += audit_obs()
         else:
             findings += audit_model(name)
     analysis.apply_baseline(findings, analysis.load_baseline(baseline_path))
@@ -156,7 +241,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--models", default="",
                     help="comma-separated smoke configs to audit "
-                         "(llama,gpt,bert,paged)")
+                         "(llama,gpt,bert,paged,obs)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,

@@ -15,6 +15,10 @@ with file:line and a category:
 Usage:
     python tools/report_graph_breaks.py demo          # worked examples
     python tools/report_graph_breaks.py llama gpt bert  # model smoke
+    python tools/report_graph_breaks.py --metrics-json llama
+        # append one JSON object: per-model break/segment counts plus the
+        # obs registry snapshot, compile-event counts per site and the
+        # eager/segment cache stats (scoreboard- and dashboard-readable)
     # library:
     from report_graph_breaks import report, format_report
     rep = report(fn, args=(x,))
@@ -162,10 +166,43 @@ def _smoke_demo():
 SMOKES = {"llama": _smoke_llama, "gpt": _smoke_gpt, "bert": _smoke_bert}
 
 
+def metrics_snapshot(reports=None) -> dict:
+    """Registry + watchdog + cache telemetry for --metrics-json: what a
+    dashboard needs to see capture-coverage / retrace regressions
+    without parsing the text report."""
+    import paddle_tpu  # noqa: F401 (registries live under it)
+    from paddle_tpu import obs
+    from paddle_tpu.core.dispatch import eager_cache_info
+    from paddle_tpu.core.lazy import flush_info
+
+    out = {
+        "compile_events": obs.compile_counts(),
+        "post_warmup_compiles": obs.post_warmup_compiles(),
+        "eager_cache": eager_cache_info(),
+        "lazy_segments": flush_info(),
+        "registry": obs.default_registry().to_dict(),
+    }
+    if reports is not None:
+        out["models"] = {
+            name: {"compiled": rep["compiled"],
+                   "segmented": rep["segmented"],
+                   "eager": rep["eager"],
+                   "segments": rep["segments"],
+                   "break_sites": len(rep["break_sites"]),
+                   "untransformed": (len(rep["transform"].sites)
+                                     if rep["transform"] is not None
+                                     else None)}
+            for name, rep in reports.items()}
+    return out
+
+
 def main(argv):
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    as_json = "--metrics-json" in argv
+    argv = [a for a in argv if a != "--metrics-json"]
     names = argv or ["demo", "llama", "gpt", "bert"]
     ok = True
+    reports = {}
     for name in names:
         if name == "demo":
             for tag, fn, args in _smoke_demo():
@@ -174,12 +211,17 @@ def main(argv):
         elif name in SMOKES:
             fn, args = SMOKES[name]()
             rep = report(fn, args)
+            reports[name] = rep
             print(format_report(rep))
             ok = ok and (rep["compiled"] or rep["segmented"])
         else:
             print(f"unknown target '{name}' (choose from demo, "
                   f"{', '.join(SMOKES)})")
             ok = False
+    if as_json:
+        import json
+
+        print("METRICS_JSON " + json.dumps(metrics_snapshot(reports)))
     return 0 if ok else 1
 
 
