@@ -1,0 +1,109 @@
+"""Full resumable train state: ONE capture that makes resume bitwise.
+
+``capture_train_state`` packs everything a training process needs to
+continue as if it never stopped — params, optimizer slots (moments,
+master weights, the device step counter), the global step, BOTH RNG
+streams (the framework's jax key that drives dropout/sampling AND
+numpy's global state that drives DataLoader shuffling), the LR-schedule
+state (inside the optimizer's state dict), and the data-iterator
+position — into one checkpoint tree for ``ckpt.core``.
+
+``restore_train_state`` applies it back and returns the scalar metadata
+(step + data position).  tests/test_ckpt.py proves the contract the
+ISSUE names: a run killed mid-epoch and resumed from the capture
+reproduces the uninterrupted run's loss trajectory **bitwise** on CPU,
+dropout draws and LR schedule included.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+# ------------------------------------------------------- numpy RNG state
+def pack_np_state(state=None) -> dict:
+    """np.random.get_state() tuple -> checkpoint-tree-friendly dict
+    (the MT19937 key vector stays an array shard)."""
+    if state is None:
+        state = np.random.get_state()
+    algo, keys, pos, has_gauss, cached = state
+    return {"algo": str(algo), "keys": np.asarray(keys, np.uint32),
+            "pos": int(pos), "has_gauss": int(has_gauss),
+            "cached_gaussian": float(cached)}
+
+
+def unpack_np_state(packed) -> tuple:
+    return (packed["algo"], np.asarray(packed["keys"], np.uint32),
+            int(packed["pos"]), int(packed["has_gauss"]),
+            float(packed["cached_gaussian"]))
+
+
+def _network_of(model):
+    """Accept a bare nn.Layer or a hapi Model."""
+    return getattr(model, "network", model)
+
+
+def _structured_names(model):
+    """{id(param): model-state-dict key} — raw tensor names come from a
+    process-global counter and do NOT reproduce after a restart, so the
+    optimizer state must key its slots by the model's structured
+    parameter paths to be restorable (Optimizer.state_dict
+    structured_names)."""
+    if model is None:
+        return None
+    return {id(p): k for k, p in _network_of(model).state_dict().items()}
+
+
+def capture_train_state(model=None, optimizer=None, step=0,
+                        data_state=None, extra=None) -> dict:
+    """Snapshot the live training process as one checkpoint tree.  Leaves
+    stay zero-copy references to the live buffers — the device→host copy
+    happens inside the saver (``core.host_copy``), so capturing is
+    cheap enough to do every save interval."""
+    from ..core.rng import get_rng_state
+
+    tree = {"step": int(step)}
+    if model is not None:
+        tree["model"] = dict(_network_of(model).state_dict())
+    if optimizer is not None:
+        try:
+            tree["optimizer"] = dict(optimizer.state_dict(
+                structured_names=_structured_names(model)))
+        except TypeError:   # custom optimizer without the round-12 kwarg
+            tree["optimizer"] = dict(optimizer.state_dict())
+    tree["rng"] = {"paddle": np.asarray(get_rng_state()[0]),
+                   "numpy": pack_np_state()}
+    tree["data"] = dict(data_state or {})
+    if extra:
+        tree["extra"] = dict(extra)
+    return tree
+
+
+def restore_train_state(tree, model=None, optimizer=None,
+                        restore_rng=True) -> dict:
+    """Apply a captured train state back onto live objects.  Returns
+    ``{"step": ..., "data": ...}`` so the loop can fast-forward its
+    data iterator to the captured position."""
+    from ..core.rng import set_rng_state
+    from ..core.tensor import Tensor
+
+    if model is not None and "model" in tree:
+        _network_of(model).set_state_dict(tree["model"])
+    if optimizer is not None and "optimizer" in tree:
+        state = {}
+        for k, v in tree["optimizer"].items():
+            if isinstance(v, np.ndarray):
+                v = Tensor(v)
+            state[k] = v
+        try:
+            optimizer.set_state_dict(
+                state, structured_names=_structured_names(model))
+        except TypeError:
+            optimizer.set_state_dict(state)
+    if restore_rng and "rng" in tree:
+        rng = tree["rng"]
+        if rng.get("paddle") is not None:
+            set_rng_state([np.asarray(rng["paddle"])])
+        if rng.get("numpy") is not None:
+            np.random.set_state(unpack_np_state(rng["numpy"]))
+    return {"step": int(tree.get("step", 0)),
+            "data": dict(tree.get("data", {}))}

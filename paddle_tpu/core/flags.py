@@ -222,6 +222,33 @@ define_flag("FLAGS_obs_compile_storm_threshold", 8,
             "recompile-storm warning in audit_recompiles — bucketing "
             "keeps real ladders O(log L), exact-length keying blows "
             "past it")
+define_flag("FLAGS_ckpt_save_retries", 3,
+            "checkpoint saves retry transient IO errors this many times "
+            "with exponential backoff before surfacing "
+            "CheckpointSaveError (ckpt/core.py); applies to sync and "
+            "async saves alike")
+define_flag("FLAGS_ckpt_retry_backoff_s", 0.05,
+            "base of the checkpoint-save retry backoff: attempt k sleeps "
+            "base * 2^k seconds")
+define_flag("FLAGS_ckpt_async", True,
+            "CheckpointCallback commits checkpoints on the background "
+            "thread (the device->host copy stays synchronous, so the "
+            "next step's donation can't race the bytes being written); "
+            "off = every periodic save blocks the train loop")
+define_flag("FLAGS_ckpt_max_in_flight", 2,
+            "bound on queued async checkpoint saves; AsyncCheckpointer."
+            "save() blocks (backpressure) when this many are already in "
+            "flight instead of accumulating unbounded host copies")
+define_flag("FLAGS_ckpt_keep_last_n", 0,
+            "checkpoint retention: keep only the newest N committed "
+            "checkpoints under a root (0 = keep all); the dir the "
+            "`latest` pointer names is never deleted, deletion is "
+            "strictly oldest-first and only touches fully-committed "
+            "dirs (ckpt/core.py gc_checkpoints)")
+define_flag("FLAGS_ckpt_stall_seconds", 300.0,
+            "checkpoint-stall watchdog: a save whose wall time exceeds "
+            "this becomes an obs.audit_ckpt_stalls warning finding "
+            "(gated by the graft_lint ckpt smoke)")
 define_flag("FLAGS_obs_http_port", 0,
             "when > 0 the ServingEngine exposes its metrics registry at "
             "http://127.0.0.1:<port>/metrics (Prometheus text "

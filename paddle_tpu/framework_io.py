@@ -40,11 +40,18 @@ def _from_serializable(obj, return_numpy=False):
 
 
 def save(obj, path, protocol=4, **configs):
+    from .ckpt.core import atomic_write_stream
+
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+    # route through the crash-consistent core (round 12): pickle STREAMS
+    # into a temp file (no second in-memory copy of a multi-GB state
+    # dict), then fsync + atomic replace — a crash mid-save can no
+    # longer leave a torn pickle where a good file used to be
+    payload = _to_serializable(obj)
+    atomic_write_stream(path,
+                        lambda f: pickle.dump(payload, f, protocol=protocol))
 
 
 def load(path, **configs):

@@ -158,19 +158,39 @@ class Model:
                                 metrics=[m.name() for m in self._metrics])
         self.stop_training = False
         cbks.call("on_train_begin")
+        # preemption-safe resume (round 12): CheckpointCallback(resume=True)
+        # restores model/optimizer/RNG in on_train_begin and leaves the
+        # captured data position here; fit fast-forwards to it — skipped
+        # batches replay through the loader (same shuffle permutation,
+        # numpy state restored below) without any compute
+        resume = self.__dict__.pop("_ckpt_resume", None)
+        start_epoch, skip_batches = 0, 0
+        if resume:
+            start_epoch = int(resume.get("epoch", 0) or 0)
+            skip_batches = int(resume.get("batch", 0) or 0)
+            if resume.get("np_state") is not None:
+                from ..ckpt.train_state import unpack_np_state
+
+                np.random.set_state(unpack_np_state(resume["np_state"]))
         logs = {}
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             cbks.call("on_epoch_begin", epoch)
             for m in self._metrics:
                 m.reset()
             updated = True
             for step, batch in enumerate(loader):
+                if epoch == start_epoch and step < skip_batches:
+                    continue   # resume fast-forward: already-consumed batch
                 cbks.call("on_train_batch_begin", step)
                 ins, labs = self._split_batch(batch)
                 updated = (step + 1) % accumulate_grad_batches == 0
                 result = self.train_batch(ins, labs, update=updated)
                 logs = self._logs(result)
                 cbks.call("on_train_batch_end", step, logs)
+                if self.stop_training:
+                    # a preemption save (CheckpointCallback SIGTERM path)
+                    # must stop MID-epoch, not after the epoch drains
+                    break
                 if num_iters is not None and step + 1 >= num_iters:
                     break
             if not updated and self._optimizer is not None:
@@ -179,6 +199,10 @@ class Model:
                 self._optimizer.step()
                 self._optimizer.clear_grad()
             cbks.call("on_epoch_end", epoch, logs)
+            if self.stop_training:
+                # preemption stopped the epoch mid-flight: exit before a
+                # potentially long eval pass blows the grace window
+                break
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_data, batch_size=batch_size, verbose=0,
                               num_workers=num_workers, callbacks=cbks)

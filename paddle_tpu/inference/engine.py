@@ -260,10 +260,10 @@ class Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "do_sample",
                  "temperature", "top_k", "top_p", "eos_token_id",
                  "tokens", "arrival_s", "admitted_s", "first_token_s",
-                 "finished")
+                 "finished", "max_time_ms", "deadline_s", "finish_reason")
 
     def __init__(self, rid, prompt, max_new_tokens, do_sample, temperature,
-                 top_k, top_p, eos_token_id):
+                 top_k, top_p, eos_token_id, max_time_ms=None):
         self.rid = rid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -277,6 +277,20 @@ class Request:
         self.admitted_s = None      # set when a slot + block budget land
         self.first_token_s = None
         self.finished = False
+        # per-request deadline (robustness round 12): a wall-clock budget
+        # from ARRIVAL; an expired request finishes with reason "timeout"
+        # and releases its blocks — a stuck-long request can't hold a
+        # slot + pool budget forever
+        self.max_time_ms = None if max_time_ms is None else float(max_time_ms)
+        self.deadline_s = None if max_time_ms is None \
+            else self.arrival_s + float(max_time_ms) / 1e3
+        self.finish_reason = None   # "eos" | "length" | "timeout"
+
+    def expired(self, now=None) -> bool:
+        if self.deadline_s is None:
+            return False
+        return (time.perf_counter() if now is None else now) \
+            >= self.deadline_s
 
     @property
     def ttft_s(self):
@@ -380,6 +394,7 @@ class ServingEngine:
         self.steps = 0
         self.active_slot_steps = 0
         self.completed: dict[int, np.ndarray] = {}
+        self.finish_reasons: dict[int, str] = {}
         self.ttfts: list[float] = []
         self.queue_waits: list[float] = []
         # ---- telemetry (obs): the serving stats ARE a metrics registry
@@ -411,8 +426,11 @@ class ServingEngine:
         self._m_prefill_tokens = reg.counter(
             "serving_prefill_tokens_total", "prompt tokens prefilled")
         self._m_completed = reg.counter(
-            "serving_requests_completed_total", "requests finished (eos or "
-            "length)")
+            "serving_requests_completed_total", "requests finished (eos, "
+            "length or timeout)")
+        self._m_timeout = reg.counter(
+            "serving_requests_timeout_total", "requests finished by their "
+            "per-request deadline (max_time_ms) — slots/blocks reclaimed")
         self._m_rejects = reg.counter(
             "serving_admission_rejects_total", "requests rejected outright "
             "(could never be served)", ("reason",))
@@ -460,9 +478,13 @@ class ServingEngine:
     # ------------------------------------------------------------- API
     def add_request(self, prompt, max_new_tokens=32, do_sample=False,
                     temperature=1.0, top_k=0, top_p=1.0,
-                    eos_token_id=None) -> int:
+                    eos_token_id=None, max_time_ms=None) -> int:
         """Queue a request. Raises when it could NEVER be served (context
-        or pool too small); otherwise it waits for admission."""
+        or pool too small); otherwise it waits for admission.
+        `max_time_ms` is a per-request wall-clock deadline from arrival:
+        when it expires the request finishes with reason ``"timeout"``
+        (whatever tokens it produced so far are its result) and its
+        blocks return to the free list."""
         prompt = np.asarray(
             prompt._data if hasattr(prompt, "_data") else prompt,
             np.int64).reshape(-1).astype(np.int32)
@@ -485,11 +507,13 @@ class ServingEngine:
                 "pool_too_small",
                 f"request needs {need} kv blocks but the pool only has "
                 f"{self.allocator.num_blocks - 1}")
+        if max_time_ms is not None and float(max_time_ms) <= 0:
+            self._reject("bad_max_time_ms", "max_time_ms must be positive")
         rid = self._next_id
         self._next_id += 1
         self._waiting.append(Request(rid, prompt, max_new_tokens,
                                      do_sample, temperature, top_k, top_p,
-                                     eos_token_id))
+                                     eos_token_id, max_time_ms=max_time_ms))
         self._m_queue_depth.set(len(self._waiting))
         return rid
 
@@ -512,10 +536,14 @@ class ServingEngine:
         return bool(self._waiting) or self.num_active > 0
 
     def step(self):
-        """One scheduler tick: admit (prefill) joining requests, then
-        advance every active slot one token. Returns a list of
-        (request_id, token, finished) for tokens emitted this tick."""
-        emitted = list(self._admit())
+        """One scheduler tick: expire deadlined requests, admit (prefill)
+        joining requests, then advance every active slot one token.
+        Returns a list of (request_id, token, finished) for tokens
+        emitted this tick; a request finished by its deadline emits a
+        terminal ``(request_id, None, True)`` — streaming consumers see
+        every completion, timeout included."""
+        emitted = self._expire()
+        emitted.extend(self._admit())
         active = [i for i, r in enumerate(self._slot_req) if r is not None]
         if active:
             emitted.extend(self._decode(active))
@@ -619,6 +647,41 @@ class ServingEngine:
         return record
 
     # ------------------------------------------------------- scheduling
+    def _expire(self):
+        """Per-request deadline enforcement: active slots past their
+        `max_time_ms` finish NOW with reason "timeout" (blocks back to
+        the free list — a stuck-long request can't starve the pool), and
+        queued requests whose deadline lapsed before admission finish
+        empty without ever taking a slot.  Returns the terminal
+        ``(rid, None, True)`` events so step() consumers observe every
+        completion."""
+        now = time.perf_counter()
+        emitted = []
+        for slot, req in enumerate(self._slot_req):
+            if req is not None and req.expired(now):
+                req.finish_reason = "timeout"
+                self._m_timeout.inc()
+                self._log.warning(
+                    f"request {req.rid} hit its {req.max_time_ms:.0f}ms "
+                    f"deadline after {len(req.tokens)} token(s); slot "
+                    "and blocks reclaimed", key="request-timeout")
+                self._finish(slot)
+                emitted.append((req.rid, None, True))
+        expired_waiting = [r for r in self._waiting if r.expired(now)]
+        if expired_waiting:
+            self._waiting = deque(r for r in self._waiting
+                                  if not r.expired(now))
+            self._m_queue_depth.set(len(self._waiting))
+            for req in expired_waiting:
+                req.finished = True
+                req.finish_reason = "timeout"
+                self.completed[req.rid] = np.asarray(req.tokens, np.int64)
+                self.finish_reasons[req.rid] = "timeout"
+                self._m_timeout.inc()
+                self._m_completed.inc()
+                emitted.append((req.rid, None, True))
+        return emitted
+
     def _admit(self):
         """Admission control: head-of-line requests enter freed slots only
         when the allocator covers their FULL (prompt + max_new) block
@@ -758,8 +821,12 @@ class ServingEngine:
 
     def _check_done(self, req, tok) -> bool:
         if req.eos_token_id >= 0 and tok == req.eos_token_id:
+            req.finish_reason = "eos"
             return True
-        return len(req.tokens) >= req.max_new_tokens
+        if len(req.tokens) >= req.max_new_tokens:
+            req.finish_reason = "length"
+            return True
+        return False
 
     def _finish(self, slot):
         """Copy-free release: return the slot's blocks to the pool (stale
@@ -768,6 +835,7 @@ class ServingEngine:
         req = self._slot_req[slot]
         req.finished = True
         self.completed[req.rid] = np.asarray(req.tokens, np.int64)
+        self.finish_reasons[req.rid] = req.finish_reason or "length"
         self.allocator.free(self._slot_blocks[slot])
         self._slot_blocks[slot] = []
         self._slot_req[slot] = None

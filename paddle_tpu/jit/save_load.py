@@ -39,9 +39,12 @@ def save(layer, path, input_spec=None, **configs):
                 out = layer(*ts)
                 return out._data if isinstance(out, Tensor) else [o._data for o in out]
 
+            from ..ckpt.core import atomic_write_bytes
+
             exported = jexport.export(jax.jit(pure))(*specs)
-            with open(path + ".stablehlo", "wb") as f:
-                f.write(exported.serialize())
+            # atomic replace (ckpt core): a crash mid-export can't leave
+            # a torn .stablehlo shadowing the still-valid params payload
+            atomic_write_bytes(path + ".stablehlo", exported.serialize())
         except Exception as e:
             # StableHLO export failed — the pickled state_dict payload is
             # still written, so load() works; surface the export failure

@@ -36,9 +36,10 @@ from .metrics import (DEFAULT_BUCKETS, OVERFLOW, Counter, Gauge, Histogram,
                       Registry, dump_registry, log_event)
 from .trace import (capture_trace, clear_spans, span, span_events,
                     step_span)
-from .watchdog import (CompileEvent, audit_recompiles, clear_events,
-                       compile_counts, compile_events, jaxpr_size,
-                       post_warmup_compiles, record_compile)
+from .watchdog import (CompileEvent, audit_ckpt_stalls, audit_recompiles,
+                       ckpt_save_events, clear_events, compile_counts,
+                       compile_events, jaxpr_size, post_warmup_compiles,
+                       record_ckpt_save, record_compile)
 
 #: process-default registry: compile watchdog counters, train-callback
 #: metrics, anything not tied to one engine instance
@@ -72,6 +73,7 @@ __all__ = [
     "CompileEvent", "record_compile", "compile_events", "compile_counts",
     "post_warmup_compiles", "clear_events", "audit_recompiles",
     "jaxpr_size",
+    "record_ckpt_save", "ckpt_save_events", "audit_ckpt_stalls",
     "get_logger", "ObsLogger",
     "serve_metrics", "MetricsServer",
 ]

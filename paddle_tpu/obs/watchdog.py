@@ -127,6 +127,73 @@ def clear_events():
     global _post_warmup_total
     _events.clear()
     _post_warmup_total = 0
+    _ckpt_events.clear()
+
+
+# ------------------------------------------------------- ckpt watchdog
+#: checkpoint save events (round 12) — same bounded-window design as the
+#: compile events; ckpt/core.py reports every save outcome here
+_ckpt_events: deque = deque(maxlen=_EVENT_CAP)
+
+
+def record_ckpt_save(step: int, wall_s: float, nbytes: int, result: str,
+                     attempts: int = 1) -> dict:
+    """One checkpoint-save outcome (ok / retry_ok / error).  Counters
+    live in the default registry (``ckpt_saves_total{result}`` etc.,
+    recorded by ckpt/core); this window feeds ``audit_ckpt_stalls``."""
+    from . import metrics
+
+    ev = {"step": int(step), "wall_s": float(wall_s),
+          "bytes": int(nbytes), "result": str(result),
+          "attempts": int(attempts), "t": time.time()}
+    _ckpt_events.append(ev)
+    metrics.log_event("ckpt_save", **ev)
+    return ev
+
+
+def ckpt_save_events() -> list:
+    return list(_ckpt_events)
+
+
+def audit_ckpt_stalls(events=None, threshold: float | None = None,
+                      loc: str = "obs/ckpt") -> list:
+    """Checkpoint-save health Findings over the event window: a save
+    exceeding ``FLAGS_ckpt_stall_seconds`` wall (the checkpoint path is
+    blocking training far longer than budgeted) or a save that exhausted
+    its retries is a warning; a healthy window is a note.  Gated by the
+    graft_lint ``ckpt`` smoke exactly like recompile storms."""
+    from ..analysis import Finding
+
+    if events is None:
+        events = ckpt_save_events()
+    if threshold is None:
+        threshold = float(flag("FLAGS_ckpt_stall_seconds"))
+    findings: list = []
+    stalls = [e for e in events if e["wall_s"] > threshold]
+    failures = [e for e in events if e["result"] == "error"]
+    if stalls:
+        worst = max(e["wall_s"] for e in stalls)
+        findings.append(Finding(
+            "ckpt-stall", "warning", loc,
+            f"{len(stalls)} checkpoint save(s) exceeded "
+            f"FLAGS_ckpt_stall_seconds={threshold:g} (worst {worst:.1f}s) "
+            "— saves are blocking training; shrink the state, raise "
+            "max_in_flight, or fix the filesystem",
+            data={"threshold": threshold, "stalls": stalls[:8]}))
+    if failures:
+        findings.append(Finding(
+            "ckpt-stall", "warning", loc,
+            f"{len(failures)} checkpoint save(s) FAILED after retries — "
+            "a preemption now loses everything since the last good "
+            "checkpoint",
+            data={"failures": failures[:8]}))
+    if not stalls and not failures:
+        findings.append(Finding(
+            "ckpt-stall", "note", loc,
+            f"{len(events)} checkpoint save(s), none stalled past "
+            f"{threshold:g}s, none failed",
+            data={"count": len(events), "threshold": threshold}))
+    return findings
 
 
 def jaxpr_size(jaxpr) -> int:

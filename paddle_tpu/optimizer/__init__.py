@@ -98,15 +98,47 @@ class Optimizer:
             self._aux_tensors.append(t)
         return self._master_weights[key]
 
-    def state_dict(self):
-        out = dict(self._pending_state)  # restored-but-not-yet-materialized
+    def _structured_maps(self, structured_names):
+        """(id(param) -> structured key, structured key -> raw name) for
+        the params this optimizer owns. `structured_names` is
+        {id(param): model-state-dict key}."""
+        fwd, inv = {}, {}
+        for p in self._parameters:
+            sk = structured_names.get(id(p))
+            if sk is not None:
+                fwd[id(p)] = sk
+                inv[sk] = p.name
+        return fwd, inv
+
+    def state_dict(self, structured_names=None):
+        """Accumulator/master entries key as ``{param_name}_{kind}``.
+        Raw tensor names come from a process-global counter, so they do
+        NOT reproduce in a fresh process — pass `structured_names`
+        ({id(param): model-state-dict key}) to key entries as
+        ``{structured_key}@{kind}`` instead, which is what makes a
+        checkpointed optimizer state restorable after a crash
+        (ckpt/train_state.py does this automatically)."""
+        fwd = {}
+        if structured_names:
+            fwd, inv = self._structured_maps(structured_names)
+
+        def key_of(p, kind):
+            sk = fwd.get(id(p))
+            return f"{sk}@{kind}" if sk is not None else f"{p.name}_{kind}"
+
+        out = {}
+        # restored-but-not-yet-materialized entries pass through; with
+        # structured naming requested, re-translate raw-named ones so a
+        # save-before-first-step round-trips across processes too
+        for k, v in self._pending_state.items():
+            out[self._raw_to_structured(k, fwd) if fwd else k] = v
         for kind, store in self._accumulators.items():
             for p in self._parameters:
                 if id(p) in store:
-                    out[f"{p.name}_{kind}"] = store[id(p)]
+                    out[key_of(p, kind)] = store[id(p)]
         for p in self._parameters:
             if id(p) in self._master_weights:
-                out[f"{p.name}_master"] = self._master_weights[id(p)]
+                out[key_of(p, "master")] = self._master_weights[id(p)]
         # the device-side counter is the truth: compiled train steps advance
         # _step_t inside the XLA program without running this Python method
         dev_step = int(np.asarray(self._step_t._data))
@@ -115,7 +147,30 @@ class Optimizer:
             out["LR_Scheduler"] = self._lr.state_dict()
         return out
 
-    def set_state_dict(self, state):
+    def _raw_to_structured(self, key, fwd):
+        # longest raw name first: names come from a global counter, so
+        # one name + "_" can prefix another ("w" vs "w_1"); the longest
+        # match is the actual owner ("w_1_moment1" must never resolve to
+        # param "w" with kind "1_moment1")
+        for p in sorted(self._parameters, key=lambda q: -len(q.name)):
+            sk = fwd.get(id(p))
+            if sk is not None and key.startswith(p.name + "_"):
+                return f"{sk}@{key[len(p.name) + 1:]}"
+        return key
+
+    def set_state_dict(self, state, structured_names=None):
+        if structured_names:
+            _, inv = self._structured_maps(structured_names)
+            translated = {}
+            for k, v in state.items():
+                if "@" in k:
+                    sk, kind = k.rsplit("@", 1)
+                    raw = inv.get(sk)
+                    if raw is not None:
+                        translated[f"{raw}_{kind}"] = v
+                        continue
+                translated[k] = v
+            state = translated
         consumed = set()
         for kind, store in self._accumulators.items():
             for p in self._parameters:

@@ -1,0 +1,158 @@
+"""Fault-injection harness for the checkpoint subsystem (round 12).
+
+Monkeypatches the ``paddle_tpu.ckpt.core._TEST_HOOKS`` seam to make
+specific failure modes happen at EXACT protocol points, so tests (and
+the ``graft_lint`` ``ckpt`` CI smoke) can prove every injected failure
+ends in either a completed save (via retry) or a verified restore of the
+last good checkpoint — never a crash on restore or a silently-wrong
+train state.
+
+Not a pytest module (no ``test_`` prefix): it is the reusable
+robustness substrate later serving/partitioner work drives too.
+
+Injection points (context managers, composable):
+
+  * :func:`crash_after_shard` — simulated process death right after
+    shard K hits disk (the temp dir stays behind, exactly like a real
+    crash; the commit rename never happens).
+  * :func:`crash_before_latest` — death between the atomic dir rename
+    and the ``latest`` pointer update (committed checkpoint, stale
+    pointer).
+  * :func:`torn_manifest` — a committed checkpoint whose manifest is
+    truncated in place (the lying-filesystem / bit-rot case).
+  * :func:`bit_flip_shard` — one bit flipped inside a committed shard
+    (sha256 must catch it).
+  * :func:`io_errors` — ``OSError`` raised by the first N file writes
+    (transient-IO case the retry/backoff path must absorb).
+  * :func:`slow_io` — every file write sleeps, for async-overlap tests.
+  * :func:`sigterm_self` — deliver a real SIGTERM to this process (the
+    preemption case; pair with ``CheckpointCallback``).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+import time
+
+from paddle_tpu.ckpt import core as ckpt_core
+
+
+class InjectedCrash(BaseException):
+    """Simulated process death.  Derives from BaseException so no
+    ``except Exception`` recovery path in the code under test can
+    swallow it — a real SIGKILL wouldn't be catchable either."""
+
+
+@contextlib.contextmanager
+def _hooks(**points):
+    prev = dict(ckpt_core._TEST_HOOKS)
+    ckpt_core._TEST_HOOKS.update(points)
+    try:
+        yield
+    finally:
+        ckpt_core._TEST_HOOKS.clear()
+        ckpt_core._TEST_HOOKS.update(prev)
+
+
+@contextlib.contextmanager
+def crash_after_shard(k: int):
+    """Die immediately after shard index `k` is written + fsync'd."""
+
+    def on_shard(index, total, path):
+        if index == k:
+            raise InjectedCrash(f"crash after shard {k} ({path})")
+
+    with _hooks(shard_written=on_shard):
+        yield
+
+
+@contextlib.contextmanager
+def crash_before_commit():
+    """Die after the manifest is written but before the atomic rename."""
+
+    def on_pre_commit(tmp, final):
+        raise InjectedCrash(f"crash before commit of {final}")
+
+    with _hooks(pre_commit=on_pre_commit):
+        yield
+
+
+@contextlib.contextmanager
+def crash_before_latest():
+    """Die after the commit rename, before the latest-pointer update."""
+
+    def on_pre_latest(root):
+        raise InjectedCrash(f"crash before latest update in {root}")
+
+    with _hooks(pre_latest=on_pre_latest):
+        yield
+
+
+@contextlib.contextmanager
+def torn_manifest(fraction: float = 0.5):
+    """Truncate the committed checkpoint's manifest in place — models a
+    filesystem that acknowledged a write it never durably finished."""
+
+    def on_committed(path):
+        mpath = os.path.join(path, "manifest.json")
+        data = open(mpath, "rb").read()
+        with open(mpath, "wb") as f:
+            f.write(data[: max(1, int(len(data) * fraction))])
+
+    with _hooks(committed=on_committed):
+        yield
+
+
+@contextlib.contextmanager
+def bit_flip_shard(shard_index: int = 0, byte_offset: int = 0, bit: int = 6):
+    """Flip one bit of one shard of the just-committed checkpoint."""
+
+    def on_committed(path):
+        spath = os.path.join(path, f"shard_{shard_index:05d}.bin")
+        data = bytearray(open(spath, "rb").read())
+        data[byte_offset % len(data)] ^= (1 << bit)
+        with open(spath, "wb") as f:
+            f.write(bytes(data))
+
+    with _hooks(committed=on_committed):
+        yield
+
+
+@contextlib.contextmanager
+def io_errors(times: int, exc: type = OSError):
+    """Raise on the first `times` file writes, then heal — the transient
+    IO failure shape the FLAGS_ckpt_save_retries backoff must absorb.
+    The returned dict counts attempts."""
+    counter = {"failed": 0, "writes": 0}
+
+    def on_io(path):
+        counter["writes"] += 1
+        if counter["failed"] < times:
+            counter["failed"] += 1
+            raise exc(f"injected IO error #{counter['failed']} on {path}")
+
+    with _hooks(io_write=on_io):
+        yield counter
+
+
+@contextlib.contextmanager
+def slow_io(delay_s: float):
+    """Every file write sleeps `delay_s` first — widens the async-save
+    IO window so overlap tests can observe work racing it."""
+
+    def on_io(path):
+        time.sleep(delay_s)
+
+    with _hooks(io_write=on_io):
+        yield
+
+
+@contextlib.contextmanager
+def sigterm_self():
+    """Deliver a real SIGTERM to this process on ENTER — the TPU-pod
+    preemption notice.  The code under test must have installed its
+    handler (CheckpointCallback does in on_train_begin); the context is
+    just scoping sugar so tests read declaratively."""
+    os.kill(os.getpid(), signal.SIGTERM)
+    yield

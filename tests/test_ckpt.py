@@ -1,0 +1,672 @@
+"""Fault-tolerant training tests (round 12, paddle_tpu.ckpt).
+
+Acceptance criteria from the ISSUE: every injected failure —
+crash-after-shard-K for ALL K, torn manifest, bit-flipped shard, raised
+IO error, SIGTERM mid-epoch — ends in either a completed save (via
+retry) or a verified restore of the last good checkpoint, never a crash
+on restore or a silently-wrong train state; and a resumed run reproduces
+the uninterrupted loss trajectory BITWISE on CPU (dropout RNG, shuffle
+order and LR schedule included).
+"""
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import faultinject as fi
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import ckpt
+from paddle_tpu.hapi.callbacks import Callback, CheckpointCallback, \
+    ModelCheckpoint
+from paddle_tpu.io import DataLoader, Dataset
+
+
+# --------------------------------------------------------------- helpers
+class _ToyData(Dataset):
+    def __init__(self, n=16):
+        rs = np.random.RandomState(42)
+        self.x = rs.randn(n, 8).astype("float32")
+        self.y = rs.randn(n, 4).astype("float32")
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _build(seed):
+    """Model with dropout (paddle RNG), AdamW with a stepped LR schedule,
+    and a SHUFFLED resumable loader (numpy RNG) — every stateful thing
+    the resume contract must cover."""
+    paddle.seed(seed)
+    np.random.seed(seed)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Dropout(0.3),
+                          nn.Linear(16, 4))
+    sched = paddle.optimizer.lr.StepDecay(0.01, step_size=3, gamma=0.5)
+    opt = paddle.optimizer.AdamW(learning_rate=sched,
+                                 parameters=model.parameters())
+    loader = ckpt.ResumableLoader(
+        DataLoader(_ToyData(), batch_size=2, shuffle=True))
+    return model, opt, sched, loader, nn.MSELoss()
+
+
+def _stream(loader):
+    while True:
+        yield from loader           # one `yield from` = one epoch
+
+
+def _train(model, opt, sched, loader, loss_fn, n_steps, start_step=0):
+    model.train()
+    losses = []
+    stream = _stream(loader)
+    for _ in range(start_step, n_steps):
+        x, y = next(stream)
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        sched.step()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+def _state_tree(model, opt, loader, step):
+    return ckpt.capture_train_state(model, opt, step=step,
+                                    data_state=loader.state_dict())
+
+
+# ----------------------------------------------------------- atomic core
+class TestAtomicCore:
+    def test_roundtrip_nested_tree(self, tmp_path):
+        import jax.numpy as jnp
+
+        tree = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+                "b": {"c": np.asarray(jnp.ones((3,), jnp.bfloat16)),
+                      "d": [1, 2.5, "s", None, True]},
+                "e": (np.zeros(2, np.int64), 7)}
+        ckpt.save_checkpoint(str(tmp_path), 1, tree)
+        r = ckpt.restore_checkpoint(str(tmp_path))
+        assert r.step == 1 and not r.fallbacks
+        np.testing.assert_array_equal(r.tree["a"], tree["a"])
+        assert str(r.tree["b"]["c"].dtype) == "bfloat16"
+        np.testing.assert_array_equal(
+            r.tree["b"]["c"].astype(np.float32), np.ones(3, np.float32))
+        assert r.tree["b"]["d"] == [1, 2.5, "s", None, True]
+        assert isinstance(r.tree["e"], tuple) and r.tree["e"][1] == 7
+
+    def test_manifest_fields(self, tmp_path):
+        ckpt.save_checkpoint(str(tmp_path), 5, {"w": np.ones(4)})
+        mpath = os.path.join(str(tmp_path), ckpt.step_dir_name(5),
+                             "manifest.json")
+        m = json.load(open(mpath))
+        assert m["step"] == 5 and m["complete"] is True
+        assert m["shard_count"] == 1
+        assert "jax" in m["fingerprint"]
+        shard = m["tree"]["items"]["w"]
+        assert shard["t"] == "shard" and len(shard["sha256"]) == 64
+        assert shard["dtype"] == "float64" and shard["shape"] == [4]
+
+    def test_latest_pointer_tracks_newest(self, tmp_path):
+        root = str(tmp_path)
+        ckpt.save_checkpoint(root, 1, {"x": np.ones(2)})
+        assert ckpt.latest_pointer(root) == ckpt.step_dir_name(1)
+        ckpt.save_checkpoint(root, 2, {"x": np.ones(2)})
+        assert ckpt.latest_pointer(root) == ckpt.step_dir_name(2)
+        assert ckpt.list_checkpoints(root) == [ckpt.step_dir_name(1),
+                                               ckpt.step_dir_name(2)]
+
+    def test_atomic_write_bytes_replace_and_no_debris(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        ckpt.atomic_write_bytes(p, b"first")
+        ckpt.atomic_write_bytes(p, b"second")
+        assert open(p, "rb").read() == b"second"
+        assert os.listdir(str(tmp_path)) == ["f.bin"]
+
+    def test_paddle_save_is_atomic(self, tmp_path):
+        """framework_io.save routes through the core: an IO failure
+        mid-save leaves the previous good file untouched."""
+        p = str(tmp_path / "m.pdparams")
+        paddle.save({"k": paddle.to_tensor(np.ones(3, "float32"))}, p)
+        with fi.io_errors(10):
+            with pytest.raises(OSError):
+                paddle.save({"k": paddle.to_tensor(
+                    np.zeros(3, "float32"))}, p)
+        got = paddle.load(p)
+        np.testing.assert_array_equal(got["k"].numpy(), np.ones(3))
+
+
+# ------------------------------------------------------- fault injection
+class TestFaultInjection:
+    def test_crash_after_every_shard(self, tmp_path):
+        """Crash-after-shard-K for ALL K: the torn temp dir is never
+        mistaken for a checkpoint; restore returns the last good one."""
+        root = str(tmp_path)
+        model, opt, sched, loader, loss_fn = _build(0)
+        _train(model, opt, sched, loader, loss_fn, 2)  # materialize moments
+        tree = _state_tree(model, opt, loader, 2)
+        ckpt.save_checkpoint(root, 1, tree)
+        n = json.load(open(os.path.join(
+            root, ckpt.step_dir_name(1), "manifest.json")))["shard_count"]
+        assert n >= 10   # params + moments + rng + data: a real state
+        for k in range(n):
+            with fi.crash_after_shard(k):
+                with pytest.raises(fi.InjectedCrash):
+                    ckpt.save_checkpoint(root, 2 + k, tree)
+            r = ckpt.restore_checkpoint(root)
+            assert r.step == 1 and not r.fallbacks
+        assert ckpt.list_checkpoints(root) == [ckpt.step_dir_name(1)]
+        # crash debris is swept, committed data untouched
+        removed = ckpt.clean_debris(root)
+        assert len(removed) == n
+        assert ckpt.restore_checkpoint(root).step == 1
+
+    def test_crash_before_commit(self, tmp_path):
+        root = str(tmp_path)
+        ckpt.save_checkpoint(root, 1, {"x": np.ones(2)})
+        with fi.crash_before_commit():
+            with pytest.raises(fi.InjectedCrash):
+                ckpt.save_checkpoint(root, 2, {"x": np.zeros(2)})
+        r = ckpt.restore_checkpoint(root)
+        assert r.step == 1
+        np.testing.assert_array_equal(r.tree["x"], np.ones(2))
+
+    def test_crash_before_latest_update(self, tmp_path):
+        """Death between the commit rename and the pointer update: the
+        pointer is the publication point, so restore keeps returning the
+        last PUBLISHED checkpoint; the next save supersedes cleanly."""
+        root = str(tmp_path)
+        ckpt.save_checkpoint(root, 1, {"x": np.ones(2)})
+        with fi.crash_before_latest():
+            with pytest.raises(fi.InjectedCrash):
+                ckpt.save_checkpoint(root, 2, {"x": np.zeros(2)})
+        assert ckpt.latest_pointer(root) == ckpt.step_dir_name(1)
+        assert ckpt.restore_checkpoint(root).step == 1
+        ckpt.save_checkpoint(root, 3, {"x": np.full(2, 3.0)})
+        assert ckpt.restore_checkpoint(root).step == 3
+
+    def test_torn_manifest_falls_back(self, tmp_path):
+        root = str(tmp_path)
+        ckpt.save_checkpoint(root, 1, {"x": np.ones(2)})
+        with fi.torn_manifest():
+            ckpt.save_checkpoint(root, 2, {"x": np.zeros(2)})
+        r = ckpt.restore_checkpoint(root)
+        assert r.step == 1
+        assert r.fallbacks[0]["reason"] == "torn_manifest"
+        np.testing.assert_array_equal(r.tree["x"], np.ones(2))
+
+    def test_bit_flip_falls_back_with_reason(self, tmp_path):
+        root = str(tmp_path)
+        ckpt.save_checkpoint(root, 1, {"x": np.ones(4)})
+        with fi.bit_flip_shard(0, byte_offset=2):
+            ckpt.save_checkpoint(root, 2, {"x": np.zeros(4)})
+        r = ckpt.restore_checkpoint(root)
+        assert r.step == 1
+        assert r.fallbacks == [{"directory": os.path.join(
+            root, ckpt.step_dir_name(2)), "reason": "checksum_mismatch"}]
+
+    def test_missing_shard_falls_back(self, tmp_path):
+        root = str(tmp_path)
+        ckpt.save_checkpoint(root, 1, {"x": np.ones(4)})
+        ckpt.save_checkpoint(root, 2, {"x": np.zeros(4)})
+        os.unlink(os.path.join(root, ckpt.step_dir_name(2),
+                               "shard_00000.bin"))
+        r = ckpt.restore_checkpoint(root)
+        assert r.step == 1 and r.fallbacks[0]["reason"] == "missing_shard"
+
+    def test_all_candidates_damaged_is_named_error(self, tmp_path):
+        root = str(tmp_path)
+        with fi.torn_manifest():
+            ckpt.save_checkpoint(root, 1, {"x": np.ones(2)})
+        with pytest.raises(ckpt.CheckpointNotFoundError,
+                           match="torn_manifest"):
+            ckpt.restore_checkpoint(root)
+
+    def test_io_error_retries_to_success(self, tmp_path):
+        root = str(tmp_path)
+        with fi.io_errors(2):
+            res = ckpt.save_checkpoint(root, 1, {"x": np.ones(2)})
+        assert res["attempts"] == 3      # 2 failures absorbed by backoff
+        assert ckpt.restore_checkpoint(root).step == 1
+
+    def test_io_error_exhausts_retries_loudly(self, tmp_path):
+        root = str(tmp_path)
+        with fi.io_errors(10 ** 6):
+            with pytest.raises(ckpt.CheckpointSaveError,
+                               match="injected IO error"):
+                ckpt.save_checkpoint(root, 1, {"x": np.ones(2)},
+                                     retries=2)
+
+
+# ------------------------------------------------------------ async saver
+class TestAsyncSaver:
+    def test_overlap_snapshot_isolation(self, tmp_path):
+        """The next train step runs while IO is in flight; the committed
+        bytes are the values AT save() time and the training result is
+        unchanged by the overlap."""
+        root = str(tmp_path)
+        model, opt, sched, loader, loss_fn = _build(0)
+        w0 = model.state_dict()["0.weight"].numpy().copy()
+        saver = ckpt.AsyncCheckpointer(root)
+        with fi.slow_io(0.01):
+            saver.save(1, _state_tree(model, opt, loader, 1))
+            overlapped = _train(model, opt, sched, loader, loss_fn, 3)
+            saver.wait()
+        r = ckpt.restore_checkpoint(root)
+        np.testing.assert_array_equal(r.tree["model"]["0.weight"], w0)
+        assert not np.array_equal(
+            model.state_dict()["0.weight"].numpy(), w0)
+        # identical run with NO save in flight: same losses bitwise
+        model2, opt2, sched2, loader2, loss_fn2 = _build(0)
+        assert _train(model2, opt2, sched2, loader2, loss_fn2,
+                      3) == overlapped
+
+    def test_async_error_surfaces_on_wait(self, tmp_path):
+        saver = ckpt.AsyncCheckpointer(str(tmp_path))
+        with fi.io_errors(10 ** 6):
+            saver.save(1, {"x": np.ones(2)})
+            with pytest.raises(ckpt.CheckpointSaveError):
+                saver.wait()
+
+    def test_async_error_surfaces_on_next_save(self, tmp_path):
+        saver = ckpt.AsyncCheckpointer(str(tmp_path))
+        with fi.io_errors(10 ** 6):
+            saver.save(1, {"x": np.ones(2)})
+            saver._q.join()
+        with pytest.raises(ckpt.CheckpointSaveError):
+            saver.save(2, {"x": np.ones(2)})
+        saver.save(3, {"x": np.ones(2)})     # error consumed; heals
+        saver.wait()
+        assert ckpt.restore_checkpoint(str(tmp_path)).step == 3
+
+    def test_abort_drops_queued_tail(self, tmp_path):
+        root = str(tmp_path)
+        saver = ckpt.AsyncCheckpointer(root, max_in_flight=4)
+        with fi.slow_io(0.02):
+            for s in (1, 2, 3):
+                saver.save(s, {"x": np.full(2, float(s))})
+            saver.abort()
+        committed = ckpt.list_checkpoints(root)
+        assert len(committed) < 3      # the tail was dropped
+        saver.save(9, {"x": np.ones(2)}, block=True)
+        assert ckpt.restore_checkpoint(root).step == 9
+
+    def test_retention_runs_after_async_saves(self, tmp_path):
+        root = str(tmp_path)
+        saver = ckpt.AsyncCheckpointer(root, keep_last_n=2)
+        for s in range(1, 6):
+            saver.save(s, {"x": np.full(2, float(s))})
+        saver.wait()
+        saver.close()
+        assert ckpt.list_checkpoints(root) == [ckpt.step_dir_name(4),
+                                               ckpt.step_dir_name(5)]
+        assert ckpt.restore_checkpoint(root).step == 5
+
+
+class TestReviewRegressions:
+    """Pinned fixes from the round-12 review pass."""
+
+    def test_blocking_save_drains_inflight_async_saves(self, tmp_path):
+        """A blocking (preemption) save must not race a queued async
+        save on the same root — the blocking save's step ends up the
+        published `latest`, always."""
+        root = str(tmp_path)
+        saver = ckpt.AsyncCheckpointer(root, max_in_flight=4)
+        with fi.slow_io(0.02):
+            saver.save(5, {"x": np.full(2, 5.0)})      # queued, slow
+            saver.save(6, {"x": np.full(2, 6.0)}, block=True)
+        assert ckpt.latest_pointer(root) == ckpt.step_dir_name(6)
+        assert ckpt.list_checkpoints(root) == [ckpt.step_dir_name(5),
+                                               ckpt.step_dir_name(6)]
+        saver.close()
+
+    def test_resave_same_step_never_destroys_good_state(self, tmp_path):
+        """Re-saving an existing step displaces the old dir (rename)
+        and deletes it only after the new commit; a crash caught
+        mid-replacement leaves a rescuable copy, not nothing."""
+        root = str(tmp_path)
+        ckpt.save_checkpoint(root, 1, {"x": np.ones(2)})
+        ckpt.save_checkpoint(root, 1, {"x": np.full(2, 2.0)})   # re-save
+        r = ckpt.restore_checkpoint(root)
+        np.testing.assert_array_equal(r.tree["x"], np.full(2, 2.0))
+        # an OLDER committed checkpoint exists alongside
+        ckpt.save_checkpoint(root, 0, {"x": np.zeros(2)})
+        ckpt.atomic_write_bytes(os.path.join(root, "latest"),
+                                ckpt.step_dir_name(1).encode())
+        # simulate the crash window: checkpoint displaced, replacement
+        # never landed
+        src = os.path.join(root, ckpt.step_dir_name(1))
+        os.rename(src, os.path.join(root, ".trash.step_00000001.dead1"))
+        # the displaced NEWER copy outranks the older committed dir...
+        r2 = ckpt.restore_checkpoint(root)
+        assert r2.step == 1
+        np.testing.assert_array_equal(r2.tree["x"], np.full(2, 2.0))
+        # ...and clean_debris RESCUES it instead of deleting it
+        removed = ckpt.clean_debris(root)
+        assert ".trash.step_00000001.dead1" not in os.listdir(root)
+        assert removed == []
+        assert ckpt.list_checkpoints(root) == [ckpt.step_dir_name(0),
+                                               ckpt.step_dir_name(1)]
+        np.testing.assert_array_equal(
+            ckpt.restore_checkpoint(root).tree["x"], np.full(2, 2.0))
+
+    def test_host_copy_copies_plain_ndarrays(self):
+        live = np.ones(4, np.float32)
+        snap = ckpt.host_copy({"a": live, "b": [live]})
+        live[:] = 7.0
+        np.testing.assert_array_equal(snap["a"], np.ones(4))
+        np.testing.assert_array_equal(snap["b"][0], np.ones(4))
+
+    def test_checkpoint_callback_reusable_across_fits(self, tmp_path):
+        """A callback preempted in one fit() must still perform the
+        final save when reused in a second fit (state resets)."""
+        root = str(tmp_path)
+        cb = CheckpointCallback(root, save_freq_steps=0, save_freq_epochs=0)
+        cb._preempted = True
+        cb._preempt_saved = True     # stale state from a previous run
+        m = _hapi_model(0)
+        m.fit(_ToyData(8), batch_size=2, epochs=1, verbose=0,
+              callbacks=[cb, _SigtermAt(2)])
+        assert cb.preempted and cb._preempt_saved
+        assert ckpt.list_checkpoints(root)   # the final save DID land
+
+    def test_preemption_skips_eval_pass(self, tmp_path):
+        """stop_training set mid-epoch must exit before evaluate() —
+        a long eval would blow the preemption grace window."""
+        evals = []
+
+        class EvalSpy(Callback):
+            def on_eval_begin(self, logs=None):
+                evals.append(1)
+
+        m = _hapi_model(0)
+        cb = CheckpointCallback(str(tmp_path), save_freq_steps=0,
+                                save_freq_epochs=0)
+        m.fit(_ToyData(8), eval_data=_ToyData(8), batch_size=2, epochs=2,
+              verbose=0, callbacks=[cb, _SigtermAt(2), EvalSpy()])
+        assert cb.preempted and not evals
+
+
+# -------------------------------------------------------------- retention
+class TestRetention:
+    def test_gc_never_deletes_latest_target(self, tmp_path):
+        root = str(tmp_path)
+        for s in (1, 2, 3, 4):
+            ckpt.save_checkpoint(root, s, {"x": np.ones(2)})
+        # stale pointer (crash-before-latest shape): target must survive
+        ckpt.atomic_write_bytes(os.path.join(root, "latest"),
+                                ckpt.step_dir_name(2).encode())
+        deleted = ckpt.gc_checkpoints(root, keep_last_n=2)
+        assert deleted == [ckpt.step_dir_name(1), ckpt.step_dir_name(3)]
+        assert ckpt.list_checkpoints(root) == [ckpt.step_dir_name(2),
+                                               ckpt.step_dir_name(4)]
+
+    def test_gc_only_touches_committed_dirs(self, tmp_path):
+        root = str(tmp_path)
+        for s in (1, 2, 3):
+            ckpt.save_checkpoint(root, s, {"x": np.ones(2)})
+        os.makedirs(os.path.join(root, "step_00000009"))   # no manifest
+        os.makedirs(os.path.join(root, ".tmp.step_00000007.dead"))
+        os.makedirs(os.path.join(root, "unrelated"))
+        ckpt.gc_checkpoints(root, keep_last_n=1)
+        left = sorted(os.listdir(root))
+        assert "step_00000009" in left          # uncommitted: untouched
+        assert ".tmp.step_00000007.dead" in left
+        assert "unrelated" in left
+        assert ckpt.list_checkpoints(root) == [ckpt.step_dir_name(3)]
+
+    def test_gc_zero_keeps_all(self, tmp_path):
+        root = str(tmp_path)
+        for s in (1, 2, 3):
+            ckpt.save_checkpoint(root, s, {"x": np.ones(2)})
+        assert ckpt.gc_checkpoints(root, keep_last_n=0) == []
+        assert len(ckpt.list_checkpoints(root)) == 3
+
+
+# ------------------------------------------------- bitwise resume parity
+class TestCrashResumeParity:
+    TOTAL = 12   # 16 samples / batch 2 = 8 batches per epoch: crosses one
+    #            # epoch boundary, so the schedule + reshuffle both replay
+
+    @pytest.mark.parametrize("kill_at", [3, 8, 9])
+    def test_bitwise_loss_parity(self, tmp_path, kill_at):
+        """Train TOTAL steps uninterrupted; train kill_at steps, save,
+        'die', restore into FRESH objects (different init seed — restore
+        must do all the work), continue: the loss traces are identical
+        bitwise, dropout RNG, shuffle order and LR schedule included.
+        kill_at=8 is exactly an epoch boundary; 9 is one step past it."""
+        full = _train(*_build(0), self.TOTAL)
+
+        model, opt, sched, loader, loss_fn = _build(0)
+        root = str(tmp_path / "ck")
+        prefix = _train(model, opt, sched, loader, loss_fn, kill_at)
+        assert prefix == full[:kill_at]
+        ckpt.save_checkpoint(
+            root, kill_at, _state_tree(model, opt, loader, kill_at))
+        del model, opt, sched, loader       # the process "dies" here
+
+        model2, opt2, sched2, loader2, loss_fn2 = _build(123)
+        r = ckpt.restore_checkpoint(root)
+        meta = ckpt.restore_train_state(r.tree, model2, opt2)
+        assert meta["step"] == kill_at
+        loader2.set_state_dict(meta["data"])
+        suffix = _train(model2, opt2, sched2, loader2, loss_fn2,
+                        self.TOTAL, start_step=kill_at)
+        assert prefix + suffix == full      # bitwise: float equality
+
+    def test_resume_restores_lr_schedule(self, tmp_path):
+        root = str(tmp_path)
+        model, opt, sched, loader, loss_fn = _build(0)
+        _train(model, opt, sched, loader, loss_fn, 7)
+        lr_at_7 = sched.last_lr
+        ckpt.save_checkpoint(root, 7, _state_tree(model, opt, loader, 7))
+        model2, opt2, sched2, _, _ = _build(1)
+        assert sched2.last_lr != lr_at_7    # fresh schedule differs
+        ckpt.restore_train_state(ckpt.restore_checkpoint(root).tree,
+                                 model2, opt2)
+        assert sched2.last_lr == lr_at_7
+        assert opt2._step_count == opt._step_count
+
+
+# --------------------------------------------------- hapi loop integration
+class _LossRecorder(Callback):
+    def __init__(self):
+        self.losses = []
+
+    def on_train_batch_end(self, step, logs=None):
+        loss = (logs or {}).get("loss")
+        self.losses.append(float(loss[0] if isinstance(loss, (list, tuple))
+                                 else loss))
+
+
+class _SigtermAt(Callback):
+    """Deliver a real SIGTERM at the START of the n-th global batch —
+    the batch still completes, then CheckpointCallback's handler path
+    saves synchronously and stops training (preemption semantics)."""
+
+    def __init__(self, n):
+        self.n = n
+        self.count = 0
+
+    def on_train_batch_begin(self, step, logs=None):
+        self.count += 1
+        if self.count == self.n:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+
+def _hapi_model(seed):
+    paddle.seed(seed)
+    np.random.seed(seed)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Dropout(0.3),
+                        nn.Linear(16, 4))
+    m = paddle.Model(net)
+    m.prepare(paddle.optimizer.AdamW(learning_rate=0.01,
+                                     parameters=net.parameters()),
+              nn.MSELoss())
+    return m
+
+
+class TestHapiCheckpointCallback:
+    EPOCHS = 2
+
+    def _fit(self, model, callbacks):
+        rec = _LossRecorder()
+        model.fit(_ToyData(8), batch_size=2, epochs=self.EPOCHS,
+                  shuffle=True, verbose=0, callbacks=[rec] + callbacks)
+        return rec.losses
+
+    def test_sigterm_mid_epoch_saves_and_resume_is_bitwise(self, tmp_path):
+        root = str(tmp_path / "ck")
+        full = self._fit(_hapi_model(0), [])          # 8 steps, 2 epochs
+
+        # run again, preempted at global batch 3 (mid-epoch 0)
+        cb = CheckpointCallback(root, save_freq_steps=0, save_freq_epochs=0)
+        prefix = self._fit(_hapi_model(0), [cb, _SigtermAt(3)])
+        assert cb.preempted and len(prefix) == 3       # stopped MID-epoch
+        assert prefix == full[:3]
+        assert ckpt.list_checkpoints(root)             # the final sync save
+
+        # fresh process: restore + fast-forward reproduces the trajectory
+        resume_cb = CheckpointCallback(root, save_freq_steps=0,
+                                       save_freq_epochs=0, resume=True)
+        suffix = self._fit(_hapi_model(7), [resume_cb])
+        assert resume_cb.last_restore is not None
+        assert prefix + suffix == full                 # bitwise
+
+    def test_sigterm_handler_restored_after_fit(self, tmp_path):
+        prev = signal.getsignal(signal.SIGTERM)
+        cb = CheckpointCallback(str(tmp_path), save_freq_epochs=0)
+        self._fit(_hapi_model(0), [cb])
+        assert signal.getsignal(signal.SIGTERM) is prev
+
+    def test_periodic_async_saves_land(self, tmp_path):
+        root = str(tmp_path)
+        cb = CheckpointCallback(root, save_freq_steps=3,
+                                save_freq_epochs=0, keep_last_n=2)
+        self._fit(_hapi_model(0), [cb])               # 8 steps: saves 3,6
+        names = ckpt.list_checkpoints(root)
+        assert names == [ckpt.step_dir_name(3), ckpt.step_dir_name(6)]
+        r = ckpt.restore_checkpoint(root)
+        assert r.step == 6 and r.tree["data"]["epoch"] in (0, 1)
+
+    def test_resume_from_empty_dir_is_cold_start(self, tmp_path):
+        cb = CheckpointCallback(str(tmp_path / "none"), resume=True,
+                                save_freq_epochs=0)
+        losses = self._fit(_hapi_model(0), [cb])
+        assert len(losses) == 8 and cb.last_restore is None
+
+
+class TestModelCheckpointRetention:
+    def test_keep_last_n_epoch_checkpoints(self, tmp_path):
+        root = str(tmp_path)
+        m = _hapi_model(0)
+        m.fit(_ToyData(8), batch_size=4, epochs=5, verbose=0,
+              callbacks=[ModelCheckpoint(save_dir=root, keep_last_n=2)])
+        assert ckpt.list_checkpoints(root) == [ckpt.step_dir_name(3),
+                                               ckpt.step_dir_name(4)]
+        assert ckpt.latest_pointer(root) == ckpt.step_dir_name(4)
+        r = ckpt.restore_checkpoint(root)
+        assert r.step == 4 and "model" in r.tree
+
+    def test_final_epochs_saved_with_sparse_save_freq(self, tmp_path):
+        """save_freq > 1 in ckpt mode: on_train_end must checkpoint the
+        last epoch when the periodic schedule missed it (the pickle
+        mode's `final` save analogue)."""
+        root = str(tmp_path)
+        m = _hapi_model(0)
+        m.fit(_ToyData(8), batch_size=4, epochs=5, verbose=0,
+              callbacks=[ModelCheckpoint(save_freq=3, save_dir=root,
+                                         keep_last_n=2)])
+        names = ckpt.list_checkpoints(root)
+        assert ckpt.step_dir_name(4) in names   # the final epoch's state
+        assert ckpt.restore_checkpoint(root).step == 4
+
+    def test_legacy_mode_unchanged(self, tmp_path):
+        root = str(tmp_path)
+        m = _hapi_model(0)
+        m.fit(_ToyData(8), batch_size=4, epochs=2, verbose=0,
+              callbacks=[ModelCheckpoint(save_dir=root)])
+        assert os.path.exists(os.path.join(root, "final.pdparams"))
+        assert os.path.exists(os.path.join(root, "0.pdparams"))
+
+
+class TestOptimizerStructuredState:
+    def test_prefix_colliding_raw_names_round_trip(self):
+        """Raw names where nameA + '_' prefixes nameB ('w' vs 'w_1')
+        must not mis-attribute pending slot entries during structured
+        re-keying (review regression: 'w_1_moment1' resolving to param
+        'w' with kind '1_moment1')."""
+        paddle.seed(0)
+        a = paddle.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+        b = paddle.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+        a.name, b.name = "w", "w_1"
+        structured = {id(a): "layer.a", id(b): "layer.b"}
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[a, b])
+        (a * b).sum().backward()
+        opt.step()
+        state = opt.state_dict(structured_names=structured)
+        assert "layer.a@moment1" in state and "layer.b@moment1" in state
+
+        # fresh optimizer, same raw names: restore BEFORE any step goes
+        # through _pending_state, then re-emit structured keys
+        a2 = paddle.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+        b2 = paddle.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+        a2.name, b2.name = "w", "w_1"
+        structured2 = {id(a2): "layer.a", id(b2): "layer.b"}
+        opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[a2, b2])
+        opt2.set_state_dict(state, structured_names=structured2)
+        out = opt2.state_dict(structured_names=structured2)
+        for k in ("layer.a@moment1", "layer.b@moment1",
+                  "layer.a@moment2", "layer.b@moment2"):
+            assert k in out, (k, sorted(out))
+        np.testing.assert_array_equal(
+            np.asarray(out["layer.b@moment1"].numpy()),
+            np.asarray(state["layer.b@moment1"].numpy()))
+
+
+# ------------------------------------------------------------- watchdog
+class TestCkptWatchdog:
+    def test_stall_fire_no_fire(self):
+        from paddle_tpu import obs
+
+        ok = [{"step": 1, "wall_s": 0.2, "bytes": 10, "result": "ok",
+               "attempts": 1}]
+        f = obs.audit_ckpt_stalls(ok, threshold=1.0)
+        assert [x.severity for x in f] == ["note"]
+
+        stalled = ok + [{"step": 2, "wall_s": 5.0, "bytes": 10,
+                         "result": "ok", "attempts": 1}]
+        f = obs.audit_ckpt_stalls(stalled, threshold=1.0)
+        assert any(x.severity == "warning" and "stall" in x.detector
+                   for x in f)
+
+    def test_failed_save_is_a_warning(self):
+        from paddle_tpu import obs
+
+        evs = [{"step": 1, "wall_s": 0.1, "bytes": 0, "result": "error",
+                "attempts": 4}]
+        f = obs.audit_ckpt_stalls(evs, threshold=1.0)
+        assert any(x.severity == "warning" and "FAILED" in x.message
+                   for x in f)
+
+    def test_saves_record_events_and_metrics(self, tmp_path):
+        from paddle_tpu import obs
+
+        obs.clear_events()
+        ckpt.save_checkpoint(str(tmp_path), 1, {"x": np.ones(2)})
+        evs = obs.ckpt_save_events()
+        assert evs and evs[-1]["result"] == "ok" and evs[-1]["step"] == 1
+        snap = obs.default_registry().to_dict()
+        for name in ("ckpt_save_seconds", "ckpt_saves_total",
+                     "ckpt_bytes_written_total", "ckpt_last_step"):
+            assert name in snap, name
+
+
+def test_registered_in_quick_tier():
+    src = open(os.path.join(os.path.dirname(__file__),
+                            "conftest.py")).read()
+    assert '"test_ckpt.py"' in src.split("QUICK_MODULES")[1], \
+        "tests/test_ckpt.py must be registered in QUICK_MODULES"

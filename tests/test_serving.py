@@ -293,6 +293,83 @@ class TestContinuousBatching:
         assert cont > stat, (cont, stat)
 
 
+class TestRequestDeadline:
+    """Per-request deadline (robustness round 12): an expired request
+    finishes with reason "timeout", releases its blocks to the free
+    list, and counts in serving_requests_timeout_total — a stuck-long
+    request can't hold slots/pool forever."""
+
+    def test_stuck_request_cannot_hold_slot_forever(self):
+        m = _tiny()
+        eng = ServingEngine(m, max_slots=1, kv_block_size=8)
+        rs = np.random.RandomState(0)
+        free0 = eng.allocator.available
+        # a would-run-very-long request with a ~1ms budget, and a normal
+        # one queued behind it on the ONLY slot
+        stuck = eng.add_request(rs.randint(0, 128, (4,)),
+                                max_new_tokens=40, max_time_ms=1.0)
+        quick = eng.add_request(rs.randint(0, 128, (4,)), max_new_tokens=3)
+        out = eng.run()
+        assert eng.finish_reasons[stuck] == "timeout"
+        assert len(out[stuck]) < 40            # cut off by the deadline
+        assert eng.finish_reasons[quick] == "length"
+        assert len(out[quick]) == 3            # the queue drained
+        assert eng.allocator.available == free0    # blocks all released
+        snap = eng.metrics()
+        t = [s for s in snap["serving_requests_timeout_total"]["samples"]]
+        assert t and t[0]["value"] >= 1
+
+    def test_queued_request_can_expire_before_admission(self):
+        m = _tiny()
+        eng = ServingEngine(m, max_slots=1, kv_block_size=8)
+        rs = np.random.RandomState(1)
+        hog = eng.add_request(rs.randint(0, 128, (4,)), max_new_tokens=8)
+        doomed = eng.add_request(rs.randint(0, 128, (4,)),
+                                 max_new_tokens=8, max_time_ms=0.5)
+        import time
+
+        time.sleep(0.002)
+        out = eng.run()
+        assert eng.finish_reasons[doomed] == "timeout"
+        assert len(out[doomed]) == 0           # never admitted
+        assert len(out[hog]) == 8
+
+    def test_timeout_emits_terminal_event_from_step(self):
+        """Streaming consumers track completion via the finished flag;
+        a deadline finish must emit (rid, None, True) from step() like
+        eos/length finishes emit (rid, token, True)."""
+        m = _tiny()
+        eng = ServingEngine(m, max_slots=1, kv_block_size=8)
+        rs = np.random.RandomState(3)
+        rid = eng.add_request(rs.randint(0, 128, (4,)),
+                              max_new_tokens=40, max_time_ms=1.0)
+        events = []
+        for _ in range(200):
+            if not eng.has_work():
+                break
+            events.extend(eng.step())
+        assert (rid, None, True) in events
+        # and every request sees exactly one terminal event
+        finals = [e for e in events if e[2]]
+        assert len(finals) == 1
+
+    def test_no_deadline_is_unchanged(self):
+        m = _tiny()
+        rs = np.random.RandomState(2)
+        prompt = rs.randint(0, 128, (5,))
+        eng = ServingEngine(m, max_slots=2, kv_block_size=8)
+        rid = eng.add_request(prompt, max_new_tokens=4)
+        out = eng.run()
+        np.testing.assert_array_equal(
+            out[rid], generate_paged(_tiny(), prompt[None], 4)[0])
+        assert eng.finish_reasons[rid] == "length"
+
+    def test_bad_deadline_rejected(self):
+        eng = ServingEngine(_tiny(), max_slots=1, kv_block_size=8)
+        with pytest.raises(ValueError, match="max_time_ms"):
+            eng.add_request(np.arange(4), max_new_tokens=2, max_time_ms=0)
+
+
 class TestServingPredictor:
     def test_predictor_wraps_engine(self):
         from paddle_tpu.inference import Config, create_serving_predictor

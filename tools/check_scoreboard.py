@@ -170,12 +170,15 @@ def check(repo=REPO, details_path=None, rtol=RTOL):
     return failures
 
 
-def lint_gate(models="llama,gpt,bert,paged,obs", timeout=900):
+def lint_gate(models="llama,gpt,bert,paged,obs,ckpt", timeout=900):
     """The graft_lint CI gate (round-9; round-10 adds the `paged` serving
     smoke — a tiny-LLaMA 2-slot continuous-batching engine whose decode
     step program is audited at default flags; round-11 adds the `obs`
     telemetry smoke — required serving metrics must exist and the compile
-    watchdog must see zero post-warmup retraces): the AST lint plus the
+    watchdog must see zero post-warmup retraces; round-12 adds the `ckpt`
+    crash-consistency smoke — save → bit-flip → restore must fall back to
+    the last good checkpoint, and the required ckpt metric rows must
+    exist): the AST lint plus the
     jaxpr program audits over the model smoke configs must come back
     clean (no unsuppressed warning/error past tools/lint_baseline.json).
     Runs the CLI in a subprocess so its jax session / flag flips can't

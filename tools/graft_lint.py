@@ -24,11 +24,18 @@ The special model name `obs` (round 11) smokes the telemetry contract: a
 tiny engine runs a warmup pass, declares warmup done, serves steady-state
 requests, and the gate fails if required serving metrics are missing or
 the compile watchdog saw a post-warmup retrace / recompile storm
-(obs/watchdog.py audit_recompiles).
+(obs/watchdog.py audit_recompiles). It also drives one checkpoint
+save/restore cycle and requires the REQUIRED_CKPT_METRICS rows.
+
+The special model name `ckpt` (round 12) smokes crash consistency
+end-to-end: a tiny model + AdamW trains, checkpoints twice, the NEWEST
+checkpoint gets a bit flipped, and restore must fall back to the last
+good one with a named reason and bit-exact state — plus the checkpoint
+stall/failure audit (obs.audit_ckpt_stalls).
 
 Exit code: 0 when no unsuppressed warning/error finding survives the
 baseline (notes never fail); 1 otherwise. CI runs
-`graft_lint.py --models llama,gpt,bert,paged,obs --json` via
+`graft_lint.py --models llama,gpt,bert,paged,obs,ckpt --json` via
 tools/check_scoreboard.
 
 Usage:
@@ -149,9 +156,16 @@ REQUIRED_SERVING_METRICS = (
     "serving_prefill_seconds", "serving_decode_step_seconds",
     "serving_tpot_seconds", "serving_decode_tokens_total",
     "serving_prefill_tokens_total", "serving_requests_completed_total",
+    "serving_requests_timeout_total",
     "serving_admission_rejects_total", "serving_admission_blocked_total",
     "serving_queue_depth", "serving_active_slots",
     "serving_block_pool_free_blocks", "serving_block_pool_used_blocks")
+
+#: checkpoint metric rows the obs smoke requires in the DEFAULT registry
+#: after one save/restore cycle (the round-12 fault-tolerance contract)
+REQUIRED_CKPT_METRICS = (
+    "ckpt_save_seconds", "ckpt_restore_seconds", "ckpt_saves_total",
+    "ckpt_restores_total", "ckpt_bytes_written_total", "ckpt_last_step")
 
 #: the subset that MUST have observed/counted after the smoke's drained
 #: runs (rejects/blocked legitimately stay zero on a healthy stream)
@@ -216,6 +230,101 @@ def audit_obs() -> list:
     evs = [e for e in obs.compile_events()
            if e.site.startswith("serving") or e.site == "generate"]
     findings += obs.audit_recompiles(evs, loc="obs/serving-smoke")
+
+    # the ckpt row (round 12): one save/restore cycle must land every
+    # REQUIRED_CKPT_METRICS entry in the default registry
+    import shutil
+    import tempfile
+
+    from paddle_tpu import ckpt
+
+    root = tempfile.mkdtemp(prefix="graft_lint_obs_ckpt_")
+    try:
+        ckpt.save_checkpoint(root, 1, {"w": np.ones(8, np.float32)})
+        ckpt.restore_checkpoint(root)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    snap = obs.default_registry().to_dict()
+    missing_ckpt = [m for m in REQUIRED_CKPT_METRICS if m not in snap]
+    if missing_ckpt:
+        findings.append(analysis.Finding(
+            "obs-coverage", "error", "obs/ckpt-smoke",
+            f"default registry lost required checkpoint metrics after a "
+            f"save/restore cycle — missing: {missing_ckpt}",
+            data={"missing": missing_ckpt}))
+    else:
+        findings.append(analysis.Finding(
+            "obs-coverage", "note", "obs/ckpt-smoke",
+            f"{len(REQUIRED_CKPT_METRICS)} required ckpt metrics present"))
+    return findings
+
+
+def audit_ckpt() -> list:
+    """The `ckpt` smoke (round 12): save → corrupt → restore-last-good on
+    a tiny model, entirely through the public subsystem.  Proves in CI
+    that (a) two committed checkpoints restore bit-exact, (b) a
+    bit-flipped shard in the NEWEST one is caught by checksum
+    verification and restore falls back to the previous good checkpoint
+    with a named reason, and (c) the save window is stall/failure-free
+    (obs.audit_ckpt_stalls)."""
+    import shutil
+    import sys as _sys
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis, ckpt, obs
+
+    _sys.path.insert(0, os.path.join(REPO, "tests"))
+    import faultinject as fi
+
+    paddle.seed(0)
+    np.random.seed(0)
+    obs.clear_events()
+    model = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                                 paddle.nn.Linear(16, 4))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 8).astype("float32"))
+    findings = []
+    root = tempfile.mkdtemp(prefix="graft_lint_ckpt_")
+    try:
+        for step in (1, 2):
+            loss = (model(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if step == 1:
+                ckpt.save_checkpoint(
+                    root, 1, ckpt.capture_train_state(model, opt, step=1))
+                good = {k: v.numpy().copy()
+                        for k, v in model.state_dict().items()}
+        with fi.bit_flip_shard(0, byte_offset=3):
+            ckpt.save_checkpoint(
+                root, 2, ckpt.capture_train_state(model, opt, step=2))
+        r = ckpt.restore_checkpoint(root)
+        ok = (r.step == 1
+              and r.fallbacks
+              and r.fallbacks[0]["reason"] == "checksum_mismatch"
+              and all(np.array_equal(r.tree["model"][k], good[k])
+                      for k in good))
+        if ok:
+            findings.append(analysis.Finding(
+                "ckpt-smoke", "note", "ckpt/save-corrupt-restore",
+                "bit-flipped newest checkpoint detected "
+                "(checksum_mismatch); restore fell back to the last good "
+                "checkpoint bit-exact"))
+        else:
+            findings.append(analysis.Finding(
+                "ckpt-smoke", "error", "ckpt/save-corrupt-restore",
+                f"restore-last-good contract violated: step={r.step}, "
+                f"fallbacks={r.fallbacks}",
+                data={"step": r.step, "fallbacks": r.fallbacks}))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    findings += obs.audit_ckpt_stalls(loc="ckpt/save-window")
     return findings
 
 
@@ -231,6 +340,8 @@ def run(models=(), ast=True, baseline_path=DEFAULT_BASELINE):
             findings += audit_serving()
         elif name == "obs":
             findings += audit_obs()
+        elif name == "ckpt":
+            findings += audit_ckpt()
         else:
             findings += audit_model(name)
     analysis.apply_baseline(findings, analysis.load_baseline(baseline_path))
@@ -241,7 +352,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--models", default="",
                     help="comma-separated smoke configs to audit "
-                         "(llama,gpt,bert,paged,obs)")
+                         "(llama,gpt,bert,paged,obs,ckpt)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
