@@ -31,6 +31,13 @@ re-exported here because its output is Findings):
                          repeatedly) and any compile after a
                          ServingEngine warmup barrier — the graft_lint
                          `obs` smoke gates on it.
+
+Serving detector (round 13, serving.py):
+  D7 audit_prefix_cache  prefix cache defeated: identical prompts
+                         re-admitted with FLAGS_prefix_cache on but zero
+                         cache hits (namespace mismatch / broken
+                         registration / over-eager eviction) — gated by
+                         the graft_lint `paged` smoke.
 """
 from .ast_lint import (audit_flags_doc, lint_dy2static, lint_file,
                        lint_tree, lint_vjp_saves, lint_x64)
@@ -40,6 +47,7 @@ from .jaxpr_audit import (audit_callbacks, audit_compiled,
                           audit_donation, audit_dtype_stream,
                           audit_fusion_misses, audit_host_sync,
                           infer_stream_shapes, iter_eqns, iter_jaxprs)
+from .serving import audit_prefix_cache
 from .vmem import (audit_decode_config, audit_norm_config,
                    audit_tune_cache, decode_vmem_bytes, flash_vmem_bytes,
                    norm_vmem_bytes)
@@ -54,7 +62,7 @@ def audit_recompiles(events=None, threshold=None, loc="obs/watchdog"):
 
 
 __all__ = [
-    "audit_recompiles",
+    "audit_recompiles", "audit_prefix_cache",
     "Finding", "apply_baseline", "format_text", "gate_failures",
     "load_baseline", "to_json",
     "audit_callbacks", "audit_compiled", "audit_donation",

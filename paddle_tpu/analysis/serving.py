@@ -1,0 +1,58 @@
+"""D7 — serving prefix-cache effectiveness audit.
+
+The prefix cache fails SILENTLY: a hash-namespace mismatch (e.g. keying
+on object identity instead of content, or two engines disagreeing on the
+namespace derivation), a registration path that never publishes blocks,
+or an eviction bug that drops every block immediately all degrade to
+"every request prefills from scratch" — functionally correct, so no test
+fails, while the tok/s-per-user multiplier the cache exists for quietly
+disappears. The detector cross-checks two counters the engine keeps:
+
+  * `prefix_repeat_admissions` — admissions whose FULL prompt was
+    byte-identical to an earlier admission (fingerprinted independently
+    of the cache's own hash chain, so a broken chain can't hide it);
+  * the `serving_prefix_blocks_hit_total` counter.
+
+A stream that re-admitted identical prompts with the cache enabled and
+hit ZERO blocks is a defeated cache — a warning (gated by the graft_lint
+`paged` smoke). Healthy engines get a note with the observed hit rate.
+"""
+from __future__ import annotations
+
+from .findings import Finding
+
+
+def audit_prefix_cache(engine, loc: str = "serving/prefix-cache") -> list:
+    """D7 over a live/drained ServingEngine (duck-typed: needs
+    `prefix_cache_enabled`, `prefix_repeat_admissions` and the
+    `prefix_cache` hits/misses counters)."""
+    if not getattr(engine, "prefix_cache_enabled", False):
+        return [Finding(
+            "prefix-cache", "note", loc,
+            "prefix cache disabled (FLAGS_prefix_cache=0) — every "
+            "request pays full prefill; shared-prompt workloads leave "
+            "the block-reuse multiplier on the table")]
+    pc = engine.prefix_cache
+    repeats = int(getattr(engine, "prefix_repeat_admissions", 0))
+    hits, misses = int(pc.hits), int(pc.misses)
+    if repeats > 0 and hits == 0:
+        return [Finding(
+            "prefix-cache", "warning", loc,
+            f"prefix cache DEFEATED: {repeats} admission(s) repeated a "
+            "byte-identical prompt while FLAGS_prefix_cache is on, yet "
+            "zero blocks were served from cache — the hash chain is not "
+            "matching its own content (namespace mismatch between "
+            "engines, a broken registration path, or eviction dropping "
+            "every block)",
+            {"repeat_admissions": repeats, "hits": hits,
+             "misses": misses, "cached_blocks": pc.cached_blocks,
+             "evictions": pc.evictions})]
+    total = hits + misses
+    rate = hits / total if total else 0.0
+    return [Finding(
+        "prefix-cache", "note", loc,
+        f"prefix cache healthy: {hits}/{total} full prompt blocks served "
+        f"from cache (hit rate {rate:.0%}), {pc.cached_blocks} blocks "
+        f"cached, {pc.evictions} evicted",
+        {"hits": hits, "misses": misses, "hit_rate": rate,
+         "cached_blocks": pc.cached_blocks, "evictions": pc.evictions})]

@@ -157,25 +157,55 @@ def decode_vmem_bytes(head_dim: int, block_size: int, group: int = 16,
 
 def audit_decode_config(head_dim: int, block_size: int, group: int = 16,
                         itemsize: int = 2, limit_mb=None,
+                        pool_blocks=None, slots=None, seq_pages=None,
+                        cached_blocks: int = 0,
                         loc: str = "pallas-decode-config") -> list[Finding]:
     """D5 for the decode kernel's launch config at a model's head
     geometry — an oversized kv block (FLAGS_kv_block_size) fails lint
-    here instead of Mosaic at serving time."""
+    here instead of Mosaic at serving time.
+
+    When `pool_blocks`/`slots`/`seq_pages` are given it also audits the
+    BLOCK-POOL budget: a pool that cannot hold `slots` full-length
+    sequences serializes the engine through admission control.
+    `cached_blocks` (round 13) credits prefix-cache sharing — blocks
+    already holding a reusable prefix are paid once, not per slot, so a
+    pool that is too small for `slots` cold sequences can still be
+    healthy under a shared-prompt workload."""
     limit = _limit_bytes(limit_mb)
     est = decode_vmem_bytes(head_dim, block_size, group, itemsize)
-    if est <= 0.8 * limit:
-        return []
-    sev = "warning" if est > limit else "note"
-    verdict = "exceeds" if est > limit else "is within 20% of"
-    return [Finding(
-        "vmem-budget", sev, loc,
-        f"paged decode blocks (block_size={block_size}, head_dim="
-        f"{head_dim}, group={group}, itemsize {itemsize}) estimate "
-        f"{est / 2**20:.1f} MiB VMEM — {verdict} the "
-        f"{limit / 2**20:.0f} MiB per-core budget; lower "
-        "FLAGS_kv_block_size for this geometry",
-        {"head_dim": head_dim, "block_size": block_size,
-         "estimate_bytes": est, "limit_bytes": limit})]
+    findings = []
+    if est > 0.8 * limit:
+        sev = "warning" if est > limit else "note"
+        verdict = "exceeds" if est > limit else "is within 20% of"
+        findings.append(Finding(
+            "vmem-budget", sev, loc,
+            f"paged decode blocks (block_size={block_size}, head_dim="
+            f"{head_dim}, group={group}, itemsize {itemsize}) estimate "
+            f"{est / 2**20:.1f} MiB VMEM — {verdict} the "
+            f"{limit / 2**20:.0f} MiB per-core budget; lower "
+            "FLAGS_kv_block_size for this geometry",
+            {"head_dim": head_dim, "block_size": block_size,
+             "estimate_bytes": est, "limit_bytes": limit}))
+    if pool_blocks is not None and slots is not None \
+            and seq_pages is not None:
+        cached = max(0, min(int(cached_blocks),
+                            int(slots) * int(seq_pages)))
+        need = int(slots) * int(seq_pages) - cached
+        usable = int(pool_blocks) - 1           # block 0 is trash
+        if need > usable:
+            findings.append(Finding(
+                "vmem-budget", "note", loc,
+                f"kv block pool ({usable} usable blocks) cannot hold "
+                f"{slots} full-context sequences ({slots}x{seq_pages} "
+                f"pages, {cached} credited to shared prefix-cache "
+                f"blocks): worst-case admission serializes at "
+                f"{usable // max(int(seq_pages), 1)} concurrent "
+                "full-length requests — size num_kv_blocks (or rely on "
+                "shorter/shared prompts) accordingly",
+                {"pool_blocks": int(pool_blocks), "slots": int(slots),
+                 "seq_pages": int(seq_pages), "cached_blocks": cached,
+                 "need": need}))
+    return findings
 
 
 def audit_norm_config(hidden_size: int, itemsize: int = 2,

@@ -199,6 +199,22 @@ define_flag("FLAGS_serving_slots", 8,
             "(inference/engine.py): the fixed request-slot array the "
             "per-step program runs over; requests join freed slots "
             "mid-flight")
+define_flag("FLAGS_prefix_cache", True,
+            "content-hash full KV blocks in the paged serving engine and "
+            "serve shared prompt prefixes from cached blocks (zero "
+            "prefill for those pages); finish releases blocks to an LRU "
+            "of refcount-0 cached blocks instead of the free list, "
+            "copy-on-write guards partially-overwritten shared blocks")
+define_flag("FLAGS_chunked_prefill_tokens", 256,
+            "split prompt prefill into chunks of at most this many "
+            "tokens, one chunk per scheduler tick interleaved with "
+            "decode — bounds the head-of-line TTFT/TPOT cost of a long "
+            "prompt on in-flight decodes; 0 = monolithic prefill "
+            "(cache-hit suffixes still ride one chunk program)")
+define_flag("FLAGS_prefix_cache_max_blocks", 0,
+            "cap on refcount-0 cached prefix blocks held in the LRU "
+            "(0 = bounded only by pool pressure); eviction never touches "
+            "a block a live request references")
 define_flag("FLAGS_residual_dtype", "float32",
             "dtype of the transformer residual stream in text/models "
             "(float32 | bfloat16): bfloat16 keeps every inter-kernel "

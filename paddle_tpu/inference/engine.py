@@ -32,12 +32,31 @@ TPU-native design:
 The scheduler (admission, eos/length finish, block free/reuse, stats) is
 host-side Python — it runs while the device executes, and its decisions
 only ever pick which compiled program to invoke next.
+
+Round 13 (serving tier 2) adds two levers on the same substrate:
+
+  - PREFIX CACHING (`FLAGS_prefix_cache`): admission content-hashes the
+    prompt's full KV blocks and points the block table at cached blocks
+    for the shared prefix — zero prefill for those pages. Finish
+    releases through the `PrefixCache` refcounts (a shared block is
+    decref'd, never free-listed out from under another request), and a
+    shared block that a request must partially overwrite (the suffix
+    starts mid-block after a whole-prompt hit) is COPY-ON-WRITE
+    duplicated inside the first chunk program.
+  - CHUNKED PREFILL (`FLAGS_chunked_prefill_tokens`): a long prompt is
+    prefilled `chunk_tokens` at a time, ONE chunk per scheduler tick,
+    interleaved with the decode program — an 8k-token prompt no longer
+    head-of-line blocks every decoding slot for its whole prefill. The
+    same chunk program computes a prefix-cache hit's suffix (its first
+    position starts at cached_len, not 0), so both levers share one
+    program family keyed by (chunk bucket, context-pages bucket).
 """
 from __future__ import annotations
 
 import functools
+import math
 import time
-from collections import deque
+from collections import OrderedDict, deque
 
 import jax
 import jax.numpy as jnp
@@ -46,11 +65,13 @@ import numpy as np
 from ..ops._pallas_common import ceil_to as _ceil_to
 from ..text.generation import (_GenSpec, _gpt_layer_prefill,
                                _layer_forward_prefill, _layer_norm,
-                               _logits, _mm, _rms_norm, _rope,
+                               _logits, _mm, _repeat_kv, _rms_norm, _rope,
                                _stacked_params, _stacked_params_gpt)
 from ..text.paged_cache import (TRASH_BLOCK, BlockAllocator, PagedKVCache,
-                                append_token, append_token_int8,
-                                blocks_for, scatter_prefill,
+                                PrefixCache, append_token,
+                                append_token_int8, blocks_for,
+                                gather_context, hash_blocks, scatter_chunk,
+                                scatter_chunk_int8, scatter_prefill,
                                 scatter_prefill_int8)
 
 
@@ -239,12 +260,133 @@ def _prefill_impl(spec: _GenSpec, block_size: int, quantized: bool,
     return tok, kc, vc, ksc, vsc, key
 
 
+def _chunk_prefill_impl(spec: _GenSpec, block_size: int, quantized: bool,
+                        any_sample: bool, emit_token: bool, ctx_pages: int,
+                        params, ids, start, true_end, last_idx, table_row,
+                        cow_src, cow_dst, kc, vc, ksc, vsc, samp, key):
+    """Prefill ONE chunk of one prompt: compute Q/K/V for positions
+    [start, true_end), scatter the chunk's K/V through the block table
+    (token-granular — a prefix-cache suffix may start mid-block), and
+    attend each chunk position over the WHOLE context so far (cached
+    prefix pages + earlier chunks + this chunk) gathered from the paged
+    cache under a `kv_pos <= q_pos` mask. `emit_token` (static) is True
+    only for the prompt's final chunk: it samples the first token from
+    the chunk-local index `last_idx`; earlier chunks skip the vocab
+    matmul entirely. `cow_src`/`cow_dst` implement copy-on-write: the
+    shared block a whole-prompt cache hit must partially overwrite is
+    duplicated into a private block BEFORE any write (both TRASH_BLOCK
+    = no-op). Context length is static via `ctx_pages` (bucketed): pages
+    past the written watermark gather garbage the causal mask never
+    reaches."""
+    gpt = spec.arch == "gpt"
+    c = ids.shape[1]
+    dtype = params["embed"].dtype
+    kc = kc.at[:, cow_dst].set(kc[:, cow_src])
+    vc = vc.at[:, cow_dst].set(vc[:, cow_src])
+    if quantized:
+        ksc = ksc.at[:, cow_dst].set(ksc[:, cow_src])
+        vsc = vsc.at[:, cow_dst].set(vsc[:, cow_src])
+    pos = start + jnp.arange(c)
+    x = params["embed"][ids[0]].astype(dtype)            # [C, H]
+    if gpt:
+        pos_safe = jnp.clip(pos, 0, params["wpe"].shape[0] - 1)
+        x = x + params["wpe"][pos_safe]
+        cos = sin = None
+    else:
+        pos_safe = jnp.clip(pos, 0, params["rope_cos"].shape[0] - 1)
+        cos = params["rope_cos"][pos_safe][:, None]      # [C, 1, D]
+        sin = params["rope_sin"][pos_safe][:, None]
+    rep = spec.num_heads // spec.num_kv_heads
+    inv_scale = 1.0 / math.sqrt(spec.head_dim)
+    kv_pos = jnp.arange(ctx_pages * block_size)
+    q_mask = kv_pos[None, :] <= pos[:, None]             # [C, T]
+
+    def layer(xc, per_layer):
+        if quantized:
+            lw, kcl, vcl, kscl, vscl = per_layer
+        else:
+            lw, kcl, vcl = per_layer
+            kscl = vscl = None
+        if gpt:
+            hn = _layer_norm(xc, lw["ln1_w"], lw["ln1_b"], spec.rms_eps)
+            qkv = (hn @ lw["qkv"]).reshape(c, 3, spec.num_heads,
+                                           spec.head_dim)
+            q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        else:
+            hn = _rms_norm(xc, lw["input_ln"], spec.rms_eps)
+            q = _mm(hn, lw["q"]).reshape(c, spec.num_heads, spec.head_dim)
+            k = _mm(hn, lw["k"]).reshape(c, spec.num_kv_heads,
+                                         spec.head_dim)
+            v = _mm(hn, lw["v"]).reshape(c, spec.num_kv_heads,
+                                         spec.head_dim)
+            q = _rope(q, cos, sin)
+            k = _rope(k, cos, sin)
+        if quantized:
+            kcl, kscl = scatter_chunk_int8(kcl, kscl, k, start, true_end,
+                                           table_row, block_size)
+            vcl, vscl = scatter_chunk_int8(vcl, vscl, v, start, true_end,
+                                           table_row, block_size)
+        else:
+            kcl = scatter_chunk(kcl, k, start, true_end, table_row,
+                                block_size)
+            vcl = scatter_chunk(vcl, v, start, true_end, table_row,
+                                block_size)
+        kx = gather_context(kcl, kscl, table_row, ctx_pages)
+        vx = gather_context(vcl, vscl, table_row, ctx_pages)
+        kx = _repeat_kv(kx.astype(q.dtype), rep, 1)      # [T, Hq, D]
+        vx = _repeat_kv(vx.astype(q.dtype), rep, 1)
+        # scores stay rank-4 [1, Hq, C, T]: this is a prefill composition,
+        # not the rank-3 seq-1 decode shape D4's decode anchor matches
+        scores = (jnp.einsum("chd,thd->hct", q, kx) * inv_scale)[None]
+        scores = jnp.where(q_mask[None, None], scores,
+                           jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32),
+                               axis=-1).astype(q.dtype)
+        out = jnp.einsum("hct,thd->chd", probs[0], vx)
+        attn = out.reshape(c, spec.num_heads * spec.head_dim)
+        if gpt:
+            xo = xc + attn @ lw["o"]
+            hn2 = _layer_norm(xo, lw["ln2_w"], lw["ln2_b"], spec.rms_eps)
+            xo = xo + jax.nn.gelu(hn2 @ lw["fc_in"],
+                                  approximate=False) @ lw["fc_out"]
+        else:
+            xo = xc + _mm(attn, lw["o"])
+            hn2 = _rms_norm(xo, lw["post_ln"], spec.rms_eps)
+            xo = xo + _mm(jax.nn.silu(_mm(hn2, lw["gate"]))
+                          * _mm(hn2, lw["up"]), lw["down"])
+        ys = (kcl, vcl, kscl, vscl) if quantized else (kcl, vcl)
+        return xo, ys
+
+    xs = (params["layers"], kc, vc) + ((ksc, vsc) if quantized else ())
+    x, ys = jax.lax.scan(layer, x, xs)
+    if quantized:
+        kc, vc, ksc, vsc = ys
+    else:
+        kc, vc = ys
+    if emit_token:
+        x_last = jax.lax.dynamic_slice_in_dim(x, last_idx, 1, axis=0)
+        lg = _logits(x_last, params, spec)               # [1, V]
+        if any_sample:
+            key, sub = jax.random.split(key)
+            tok = _sample_batched(lg, sub, samp["do_sample"],
+                                  samp["temperature"], samp["top_k"],
+                                  samp["top_p"])
+        else:
+            tok = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+    else:
+        tok = jnp.zeros((1,), jnp.int32)
+    return tok, kc, vc, ksc, vsc, key
+
+
 _decode_step = functools.partial(
     jax.jit, static_argnums=(0, 1, 2, 3),
     donate_argnums=(8, 9, 10, 11))(_decode_step_impl)
 _prefill_step = functools.partial(
     jax.jit, static_argnums=(0, 1, 2, 3),
     donate_argnums=(8, 9, 10, 11))(_prefill_impl)
+_chunk_prefill_step = functools.partial(
+    jax.jit, static_argnums=(0, 1, 2, 3, 4, 5),
+    donate_argnums=(14, 15, 16, 17))(_chunk_prefill_impl)
 
 
 # ------------------------------------------------------------ scheduler
@@ -260,7 +402,9 @@ class Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "do_sample",
                  "temperature", "top_k", "top_p", "eos_token_id",
                  "tokens", "arrival_s", "admitted_s", "first_token_s",
-                 "finished", "max_time_ms", "deadline_s", "finish_reason")
+                 "finished", "max_time_ms", "deadline_s", "finish_reason",
+                 "cached_len", "prefill_pos", "prefill_done",
+                 "_hashes", "_hash_ns")
 
     def __init__(self, rid, prompt, max_new_tokens, do_sample, temperature,
                  top_k, top_p, eos_token_id, max_time_ms=None):
@@ -285,6 +429,18 @@ class Request:
         self.deadline_s = None if max_time_ms is None \
             else self.arrival_s + float(max_time_ms) / 1e3
         self.finish_reason = None   # "eos" | "length" | "timeout"
+        # prefix-cache / chunked-prefill progress (set at admission):
+        # positions [0, cached_len) are served from cached blocks, the
+        # suffix [cached_len, prompt) is computed chunk by chunk —
+        # prefill_pos is the next position to compute
+        self.cached_len = 0
+        self.prefill_pos = 0
+        self.prefill_done = False
+        # memoized prefix-block hashes (a pool-blocked head-of-line
+        # request is re-examined every scheduler tick; the sha256 chain
+        # over an 8k prompt must not recompute per tick)
+        self._hashes = None
+        self._hash_ns = None
 
     def expired(self, now=None) -> bool:
         if self.deadline_s is None:
@@ -325,7 +481,9 @@ class ServingEngine:
 
     def __init__(self, model, max_slots=None, kv_block_size=None,
                  num_kv_blocks=None, kv_cache_dtype=None,
-                 max_model_len=None, seed=0, admission="continuous"):
+                 max_model_len=None, seed=0, admission="continuous",
+                 prefix_cache=None, chunked_prefill_tokens=None,
+                 prefix_cache_max_blocks=None):
         from ..core.flags import flag
 
         cfg = model.config
@@ -382,6 +540,37 @@ class ServingEngine:
         if admission not in ("continuous", "static"):
             raise ValueError(f"unknown admission mode {admission!r}")
         self.admission = admission
+        # ---- prefix cache + chunked prefill (round 13). The PrefixCache
+        # wraps the allocator for EVERY alloc/release, cache enabled or
+        # not: with the flag off nothing is ever hash-registered, so
+        # release degenerates to the free list and behavior is identical
+        # to the round-10 engine.
+        self.prefix_cache_enabled = bool(
+            flag("FLAGS_prefix_cache") if prefix_cache is None
+            else prefix_cache)
+        self.chunk_tokens = int(
+            flag("FLAGS_chunked_prefill_tokens")
+            if chunked_prefill_tokens is None else chunked_prefill_tokens)
+        self.prefix_cache = PrefixCache(
+            self.allocator,
+            max_cached_blocks=int(
+                flag("FLAGS_prefix_cache_max_blocks")
+                if prefix_cache_max_blocks is None
+                else prefix_cache_max_blocks))
+        #: seeds the content-hash chain: KV blocks are only interchangeable
+        #: within one (arch, layer geometry, block size, cache dtype)
+        self._prefix_namespace = hash(
+            (self.spec, self.block_size, str(self.cache.k.dtype)))
+        self._slot_chunk: dict[int, dict] = {}   # slot -> chunk progress
+        self._slot_extra_refs: list[list[int]] = [[] for _ in
+                                                  range(self.max_slots)]
+        # D7 (cache-defeated) bookkeeping: identical prompts re-admitted
+        # while the cache is on should be hitting. LRU-capped — a
+        # long-lived engine over mostly-unique prompts must not grow an
+        # unbounded host-side set for a diagnostic
+        self._prompt_fingerprints: OrderedDict = OrderedDict()
+        self._prompt_fingerprints_cap = 4096
+        self.prefix_repeat_admissions = 0
         self._tables = np.zeros((self.max_slots, self.pages), np.int32)
         self._slot_req: list[Request | None] = [None] * self.max_slots
         self._slot_pos = np.zeros(self.max_slots, np.int64)
@@ -438,6 +627,24 @@ class ServingEngine:
             "serving_admission_blocked_total", "admission attempts that "
             "waited: head-of-line request's block budget did not fit the "
             "free pool")
+        self._m_prefix_hit = reg.counter(
+            "serving_prefix_blocks_hit_total", "prompt KV blocks served "
+            "from the prefix cache (zero prefill paid for them)")
+        self._m_prefix_miss = reg.counter(
+            "serving_prefix_blocks_missed_total", "full prompt KV blocks "
+            "that had to be computed (no cached prefix covered them)")
+        self._m_chunks = reg.counter(
+            "serving_prefill_chunks_total", "chunk-prefill program "
+            "invocations (chunked + cache-hit-suffix prefills)")
+        self._m_prefix_evict = reg.counter(
+            "serving_prefix_cache_evictions_total", "cached blocks "
+            "evicted (LRU, refcount-0 only) to satisfy allocations")
+        self._m_cache_blocks = reg.gauge(
+            "serving_prefix_cache_blocks", "blocks addressable by "
+            "content hash (cached prefixes)")
+        self._m_cache_refed = reg.gauge(
+            "serving_prefix_cache_referenced_blocks", "hash-mapped blocks "
+            "live requests still reference (refcount > 0)")
         self._m_queue_depth = reg.gauge(
             "serving_queue_depth", "requests waiting for admission")
         self._m_active = reg.gauge(
@@ -536,15 +743,20 @@ class ServingEngine:
         return bool(self._waiting) or self.num_active > 0
 
     def step(self):
-        """One scheduler tick: expire deadlined requests, admit (prefill)
-        joining requests, then advance every active slot one token.
-        Returns a list of (request_id, token, finished) for tokens
-        emitted this tick; a request finished by its deadline emits a
-        terminal ``(request_id, None, True)`` — streaming consumers see
-        every completion, timeout included."""
+        """One scheduler tick: expire deadlined requests, admit joining
+        requests (small cache-cold prompts prefill whole, long or
+        cache-hit prompts enter the chunk ladder), advance every
+        PREFILLING slot by one chunk, then advance every DECODING slot
+        one token — chunked prefill interleaves with decode instead of
+        head-of-line blocking it. Returns a list of (request_id, token,
+        finished) for tokens emitted this tick; a request finished by
+        its deadline emits a terminal ``(request_id, None, True)`` —
+        streaming consumers see every completion, timeout included."""
         emitted = self._expire()
         emitted.extend(self._admit())
-        active = [i for i, r in enumerate(self._slot_req) if r is not None]
+        emitted.extend(self._chunk_phase())
+        active = [i for i, r in enumerate(self._slot_req)
+                  if r is not None and r.prefill_done]
         if active:
             emitted.extend(self._decode(active))
             self.steps += 1
@@ -583,7 +795,13 @@ class ServingEngine:
                 "requests_completed": int(self._m_completed.value),
                 "kv_pool_blocks": self.allocator.num_blocks,
                 "kv_pool_free": self.allocator.available,
-                "kv_hbm_bytes": self.cache.hbm_bytes}
+                "kv_hbm_bytes": self.cache.hbm_bytes,
+                # round 13: prefix cache + chunked prefill
+                "prefix_blocks_hit": int(self._m_prefix_hit.value),
+                "prefix_blocks_missed": int(self._m_prefix_miss.value),
+                "prefix_cached_blocks": self.prefix_cache.cached_blocks,
+                "prefix_evictions": self.prefix_cache.evictions,
+                "prefill_chunks": int(self._m_chunks.value)}
 
     def metrics(self) -> dict:
         """Registry snapshot (counters/gauges + histogram quantiles) —
@@ -613,7 +831,8 @@ class ServingEngine:
             self._metrics_server.close()
             self._metrics_server = None
 
-    def _track_program(self, site: str, bucket: int, any_sample: bool):
+    def _track_program(self, site: str, bucket: int, any_sample: bool,
+                       extra=()):
         """Host-side mirror of the step programs' jit cache keys: a NEW
         key is (to first order) a fresh trace+compile. Returns None for a
         warm key, else a callback the caller invokes with the measured
@@ -621,8 +840,10 @@ class ServingEngine:
         The seen-set is MODULE level because _prefill_step/_decode_step
         executables are shared across engines (same spec + shapes reuse
         the compiled program, so a second engine genuinely pays no
-        trace)."""
-        key = (site, self._prog_key_base, bool(any_sample), int(bucket))
+        trace). `extra` carries further static key parts (the chunk
+        program's context-pages bucket + emit_token flag)."""
+        key = (site, self._prog_key_base, bool(any_sample), int(bucket),
+               tuple(extra))
         if key in _SEEN_SERVING_PROGRAMS:
             return None
         _SEEN_SERVING_PROGRAMS.add(key)
@@ -635,7 +856,8 @@ class ServingEngine:
                 site, f"{site}/L{self.spec.num_layers}"
                 f"h{self.spec.num_heads}d{self.spec.head_dim}",
                 f"bucket{bucket}/sample{int(any_sample)}/"
-                f"q{int(self.quantized)}",
+                f"q{int(self.quantized)}"
+                + "".join(f"/{x}" for x in extra),
                 bucket=int(bucket), wall_s=wall_s, donated=True,
                 warm=warm)
             if warm:
@@ -684,46 +906,138 @@ class ServingEngine:
 
     def _admit(self):
         """Admission control: head-of-line requests enter freed slots only
-        when the allocator covers their FULL (prompt + max_new) block
-        budget — admitted requests can never OOM mid-flight. Static mode
-        additionally waits for the whole engine to drain (the wave
-        baseline)."""
+        when the pool covers their block budget NET OF cached prefix
+        blocks (a 95%-cached request admits with a tiny budget; evictable
+        refcount-0 cached blocks count as capacity) — admitted requests
+        can never OOM mid-flight. Small cache-cold prompts prefill whole
+        right here (the round-10 fast path); long or cache-hit prompts
+        enter the chunk ladder and emit their first token from a later
+        chunk phase. Static mode additionally waits for the whole engine
+        to drain (the wave baseline)."""
         if self.admission == "static" and self.num_active:
             return
         for slot in range(self.max_slots):
             if not self._waiting or self._slot_req[slot] is not None:
                 continue
             req = self._waiting[0]
-            need = blocks_for(req.prompt.size + req.max_new_tokens,
-                              self.block_size)
-            ids = self.allocator.alloc(need)
+            s = req.prompt.size
+            if not self.prefix_cache_enabled:
+                hashes = []
+            else:
+                # memoized per request, keyed on the namespace so drift
+                # (the D7 fixture) still rehashes
+                if (req._hashes is None
+                        or req._hash_ns != self._prefix_namespace):
+                    req._hashes = hash_blocks(req.prompt, self.block_size,
+                                              self._prefix_namespace)
+                    req._hash_ns = self._prefix_namespace
+                hashes = req._hashes
+            hit = self.prefix_cache.lookup(hashes)
+            hit_blocks = len(hit)
+            # the LAST real prompt position is never served from cache:
+            # its hidden state seeds the first token, so a whole-prompt
+            # hit recomputes the final token into a COPY-ON-WRITE
+            # duplicate of the shared last block
+            cow_src = None
+            cached_len = len(hit) * self.block_size
+            if cached_len > s - 1:
+                cow_src = hit.pop()
+                cached_len = s - 1
+            need = blocks_for(s + req.max_new_tokens,
+                              self.block_size) - len(hit)
+            ids = self.prefix_cache.allocate(need)
             if ids is None:
-                # pool full: wait for releases. The head-of-line request
-                # keeps QUEUEING (its clock runs in queue_wait, not
-                # prefill — the satellite-6 TTFT decomposition fix)
+                # pool full: wait for releases — and UNDO the lookup so
+                # blocked retries neither leak refcounts nor inflate the
+                # hit counters. The head-of-line request keeps QUEUEING
+                # (its clock runs in queue_wait, not prefill — the
+                # satellite-6 TTFT decomposition fix)
+                undo = hit + ([cow_src] if cow_src is not None else [])
+                self.prefix_cache.cancel_lookup(undo, len(hashes))
                 self._m_blocked.inc()
                 self._log.vlog(
                     2, f"admission blocked: request {req.rid} needs "
-                    f"{need} blocks, {self.allocator.available} free",
-                    key="admission-blocked")
+                    f"{need} blocks, {self.prefix_cache.available} "
+                    "available", key="admission-blocked")
                 break
             self._waiting.popleft()
             req.admitted_s = time.perf_counter()
+            req.cached_len = cached_len
+            req.prefill_pos = cached_len
             self.queue_waits.append(req.queue_wait_s)
             self._m_queue_wait.observe(req.queue_wait_s)
             self._m_queue_depth.set(len(self._waiting))
+            self._m_prefix_hit.inc(hit_blocks)
+            self._m_prefix_miss.inc(len(hashes) - hit_blocks)
+            if hashes:
+                # deliberately independent of the cache's hash chain so a
+                # broken chain / namespace drift can't hide from D7
+                fp = hash(tuple(int(t) for t in req.prompt))
+                if fp in self._prompt_fingerprints:
+                    self.prefix_repeat_admissions += 1
+                    self._prompt_fingerprints.move_to_end(fp)
+                self._prompt_fingerprints[fp] = True
+                while (len(self._prompt_fingerprints)
+                       > self._prompt_fingerprints_cap):
+                    self._prompt_fingerprints.popitem(last=False)
             self._slot_req[slot] = req
-            self._slot_blocks[slot] = ids
-            self._m_pool_free.set(self.allocator.available)
-            self._m_pool_used.set(self.allocator.num_blocks - 1
-                                  - self.allocator.available)
+            blocks = hit + ids
+            self._slot_blocks[slot] = blocks
             row = np.zeros(self.pages, np.int32)
-            row[:len(ids)] = ids
+            row[:len(blocks)] = blocks
             self._tables[slot] = row
-            tok, done = self._prefill(slot, req)
-            yield (req.rid, tok, done)
-            if done:
-                self._finish(slot)
+            self._update_pool_gauges()
+            if cached_len == 0 and (self.chunk_tokens <= 0
+                                    or s <= self.chunk_tokens):
+                tok, done = self._prefill(slot, req)
+                self._register_full_blocks(slot)
+                yield (req.rid, tok, done)
+                if done:
+                    self._finish(slot)
+                continue
+            # chunk ladder: one chunk per tick from cached_len. The COW
+            # source ref is held until the first chunk's copy executed
+            state = {"cow": None}
+            if cow_src is not None:
+                # ids[0] occupies page cached_len // block_size — exactly
+                # the page the shared block served
+                state["cow"] = (cow_src, ids[0])
+                self._slot_extra_refs[slot].append(cow_src)
+            self._slot_chunk[slot] = state
+            if self.admission == "static":
+                # waves admit slot-by-slot; chunked members join the same
+                # wave (prefill ticks run before the first decode tick)
+                continue
+
+    def _update_pool_gauges(self):
+        self._m_pool_free.set(self.allocator.available)
+        self._m_pool_used.set(self.allocator.num_blocks - 1
+                              - self.allocator.available)
+        self._m_cache_blocks.set(self.prefix_cache.cached_blocks)
+        self._m_cache_refed.set(self.prefix_cache.referenced_blocks)
+        ev = self.prefix_cache.evictions - self._m_prefix_evict.value
+        if ev > 0:
+            self._m_prefix_evict.inc(ev)
+
+    def _register_full_blocks(self, slot):
+        """Publish this slot's FULLY-WRITTEN blocks into the prefix cache
+        under their content hashes. Written watermark: the whole prompt
+        once prefill finished (plus appended generation tokens — the
+        last sampled token was never consumed, so its K/V is absent),
+        else the chunk ladder's progress."""
+        if not self.prefix_cache_enabled:
+            return
+        req = self._slot_req[slot]
+        if req.prefill_done:
+            content = np.concatenate(
+                [req.prompt, np.asarray(req.tokens[:-1] if req.tokens
+                                        else [], np.int32)])
+        else:
+            content = req.prompt[:req.prefill_pos]
+        hashes = hash_blocks(content, self.block_size,
+                             self._prefix_namespace)
+        self.prefix_cache.register(hashes,
+                                   self._slot_blocks[slot][:len(hashes)])
 
     def _prefill(self, slot, req):
         from ..jit.api import default_buckets
@@ -752,6 +1066,8 @@ class ServingEngine:
         req.first_token_s = time.perf_counter()
         if new_prog is not None:
             new_prog(wall_s=req.first_token_s - t0)
+        req.prefill_pos = s
+        req.prefill_done = True
         self._m_prefill.observe(req.prefill_s)
         self._m_ttft.observe(req.ttft_s)
         self.ttfts.append(req.ttft_s)
@@ -759,6 +1075,90 @@ class ServingEngine:
         req.tokens.append(tok)
         self._slot_pos[slot] = s
         return tok, self._check_done(req, tok)
+
+    def _chunk_phase(self):
+        """Advance every prefilling slot by ONE chunk. A slot whose final
+        chunk completes emits its first token and joins the decode set
+        next tick — chunks and decode ticks share the scheduler loop, so
+        a long prompt costs in-flight decodes one chunk per tick, never
+        its whole prefill."""
+        emitted = []
+        for slot in sorted(self._slot_chunk):
+            req = self._slot_req[slot]
+            tok = self._run_chunk(slot, req, self._slot_chunk[slot])
+            if tok is None:
+                continue
+            del self._slot_chunk[slot]
+            s = req.prompt.size
+            req.prefill_done = True
+            req.first_token_s = time.perf_counter()
+            self._m_prefill.observe(req.prefill_s)
+            self._m_ttft.observe(req.ttft_s)
+            self.ttfts.append(req.ttft_s)
+            req.tokens.append(tok)
+            self._slot_pos[slot] = s
+            self._register_full_blocks(slot)
+            done = self._check_done(req, tok)
+            emitted.append((req.rid, tok, done))
+            if done:
+                self._finish(slot)
+        return emitted
+
+    def _run_chunk(self, slot, req, state):
+        """One chunk-prefill program invocation for one slot. Returns the
+        first token (int) when this was the prompt's final chunk, else
+        None. The chunk program is keyed by (chunk-length bucket,
+        context-pages bucket, emit_token): chunk lengths bucket like
+        prompt lengths, context pages like slot counts, so a stream
+        compiles O(log S * log pages) chunk programs."""
+        from ..jit.api import default_buckets
+
+        t0 = time.perf_counter()
+        s = req.prompt.size
+        start = req.prefill_pos
+        n = s - start if self.chunk_tokens <= 0 \
+            else min(s - start, self.chunk_tokens)
+        is_last = start + n >= s
+        c_bucket = max(8, default_buckets(n))
+        ctx_need = blocks_for(start + n, self.block_size)
+        ctx_pages = min(self.pages, max(default_buckets(ctx_need),
+                                        blocks_for(c_bucket,
+                                                   self.block_size) + 1))
+        cow = state.pop("cow", None)
+        cow_src, cow_dst = cow if cow is not None else (TRASH_BLOCK,
+                                                        TRASH_BLOCK)
+        new_prog = self._track_program(
+            "serving.chunk_prefill", c_bucket, req.do_sample and is_last,
+            extra=(ctx_pages, bool(is_last)))
+        ids = np.zeros((1, c_bucket), np.int32)
+        ids[0, :n] = req.prompt[start:start + n]
+        samp = self._samp_arrays([req])
+        c = self.cache
+        from ..obs import span as _span
+
+        with _span("serving.chunk_prefill"):
+            out = _chunk_prefill_step(
+                self.spec, self.block_size, self.quantized,
+                req.do_sample and is_last, is_last, ctx_pages,
+                self.params, jnp.asarray(ids), jnp.int32(start),
+                jnp.int32(start + n), jnp.int32(s - 1 - start),
+                jnp.asarray(self._tables[slot]), jnp.int32(cow_src),
+                jnp.int32(cow_dst), c.k, c.v, c.k_scale, c.v_scale,
+                samp, self._key)
+            tok_arr, c.k, c.v, c.k_scale, c.v_scale, self._key = out
+            tok = int(jax.device_get(tok_arr)[0]) if is_last else None
+        if new_prog is not None:
+            new_prog(wall_s=time.perf_counter() - t0)
+        if cow is not None:
+            # the copy executed (device order is program order): drop the
+            # admission-time ref that kept the source from being evicted
+            self.prefix_cache.release([cow_src])
+            self._slot_extra_refs[slot].remove(cow_src)
+            self._update_pool_gauges()
+        req.prefill_pos = start + n
+        self._m_chunks.inc()
+        self._m_prefill_tokens.inc(n)
+        return tok
 
     def _decode(self, active):
         from ..jit.api import default_buckets
@@ -829,22 +1229,30 @@ class ServingEngine:
         return False
 
     def _finish(self, slot):
-        """Copy-free release: return the slot's blocks to the pool (stale
-        contents are never attended to — see paged_cache) and free the
-        slot for the next admission."""
+        """Copy-free release THROUGH THE PREFIX CACHE: every fully-written
+        block is first published under its content hash (the next request
+        sharing this prompt — or this prompt plus this completion, the
+        multi-turn shape — hits it), then the slot's blocks are decref'd.
+        Shared blocks other requests still reference survive; hash-mapped
+        blocks at refcount 0 park in the LRU; unmapped blocks free-list.
+        The round-12 timeout path comes through here too — an
+        unconditional allocator.free() would have corrupted any prefix
+        shared with a live request."""
         req = self._slot_req[slot]
         req.finished = True
         self.completed[req.rid] = np.asarray(req.tokens, np.int64)
         self.finish_reasons[req.rid] = req.finish_reason or "length"
-        self.allocator.free(self._slot_blocks[slot])
+        self._register_full_blocks(slot)
+        self.prefix_cache.release(self._slot_blocks[slot]
+                                  + self._slot_extra_refs[slot])
+        self._slot_extra_refs[slot] = []
+        self._slot_chunk.pop(slot, None)
         self._slot_blocks[slot] = []
         self._slot_req[slot] = None
         self._slot_pos[slot] = 0
         self._tables[slot] = TRASH_BLOCK
         self._m_completed.inc()
-        self._m_pool_free.set(self.allocator.available)
-        self._m_pool_used.set(self.allocator.num_blocks - 1
-                              - self.allocator.available)
+        self._update_pool_gauges()
 
     # ------------------------------------------------------- introspection
     def decode_program_jaxpr(self, bucket=2):

@@ -462,7 +462,7 @@ def _extract_gpt(cfg, sd):
 def generate(model, input_ids, max_new_tokens=32, max_length=None,
              do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
              eos_token_id=None, seed=None, weight_quant="none",
-             engine="static"):
+             engine="static", prefix_cache=None):
     """Autoregressive generation with a static KV cache, greedy or sampled.
 
     Returns a Tensor [B, prompt_len + n_generated] (prompt included, like
@@ -472,7 +472,10 @@ def generate(model, input_ids, max_new_tokens=32, max_length=None,
     keyed by (batch, prompt bucket, generation-length bucket, sampling
     config). engine="paged": the continuous-batching serving engine
     (inference/engine.py) over the block-paged KV cache — same greedy
-    tokens, the serving route for streams of requests.
+    tokens, the serving route for streams of requests. `prefix_cache`
+    overrides FLAGS_prefix_cache for the paged engine (shared prompt
+    prefixes across the batch/stream reuse KV blocks; greedy tokens are
+    identical either way).
     """
     from ..core.tensor import Tensor
 
@@ -533,8 +536,12 @@ def generate(model, input_ids, max_new_tokens=32, max_length=None,
                               temperature=float(temperature),
                               top_k=int(top_k), top_p=float(top_p),
                               eos_token_id=eos_token_id,
-                              seed=None if seed is None else int(seed))
+                              seed=None if seed is None else int(seed),
+                              prefix_cache=prefix_cache)
         return _assemble_output(ids, toks, eos_token_id, Tensor)
+    if prefix_cache is not None:
+        raise ValueError("prefix_cache applies to engine='paged' only "
+                         "(the static engine holds no block pool)")
     from ..jit.api import default_buckets
 
     s_true = ids.shape[1]

@@ -117,15 +117,17 @@ class GPTForCausalLM(nn.Layer):
 
     def generate(self, input_ids, max_new_tokens=32, max_length=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                 eos_token_id=None, seed=None, engine="static"):
+                 eos_token_id=None, seed=None, engine="static",
+                 prefix_cache=None):
         """KV-cached decoding (see text/generation.py; gpt arch: LayerNorm
         + learned positions + fused-qkv pre-LN blocks). engine="static":
         one compiled XLA program; engine="paged": the continuous-batching
-        paged-KV serving engine (inference/engine.py)."""
+        paged-KV serving engine (inference/engine.py; `prefix_cache`
+        overrides FLAGS_prefix_cache there)."""
         from ..generation import generate as _generate
 
         return _generate(self, input_ids, max_new_tokens=max_new_tokens,
                          max_length=max_length, do_sample=do_sample,
                          temperature=temperature, top_k=top_k, top_p=top_p,
                          eos_token_id=eos_token_id, seed=seed,
-                         engine=engine)
+                         engine=engine, prefix_cache=prefix_cache)

@@ -289,7 +289,7 @@ class LlamaForCausalLM(nn.Layer):
     def generate(self, input_ids, max_new_tokens=32, max_length=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                  eos_token_id=None, seed=None, weight_quant="none",
-                 engine="static"):
+                 engine="static", prefix_cache=None):
         """KV-cached autoregressive decoding — the role of the reference's
         fused decode-attention family + PaddleNLP generate. engine="static"
         (default): ONE compiled XLA program (prefill + lax.scan decode
@@ -297,14 +297,15 @@ class LlamaForCausalLM(nn.Layer):
         engine="paged": the continuous-batching serving engine over the
         block-paged KV cache (≙ block_multihead_attention's role;
         inference/engine.py) — same greedy tokens, built for request
-        streams."""
+        streams; `prefix_cache` overrides FLAGS_prefix_cache there."""
         from ..generation import generate as _generate
 
         return _generate(self, input_ids, max_new_tokens=max_new_tokens,
                          max_length=max_length, do_sample=do_sample,
                          temperature=temperature, top_k=top_k, top_p=top_p,
                          eos_token_id=eos_token_id, seed=seed,
-                         weight_quant=weight_quant, engine=engine)
+                         weight_quant=weight_quant, engine=engine,
+                         prefix_cache=prefix_cache)
 
 
 class _PipeEmbed(nn.Layer):

@@ -27,8 +27,21 @@ Pieces:
 Static shapes everywhere: block tables are padded [slots, pages] arrays,
 the trash block absorbs masked writes, and the allocator is the only
 dynamic piece — it lives on the host and never enters a trace.
+
+Round 13 adds PREFIX CACHING on top of the same block pool (vLLM's
+block-hash reuse): `PrefixCache` keys FULL blocks by a rolling content
+hash over their token ids (chained, so a block's identity includes its
+whole prefix) and refcounts every block a live request's table holds.
+A request whose prompt shares a cached prefix points its table rows at
+the cached blocks (zero prefill for those pages); `release` returns
+hash-mapped blocks to an LRU of refcount-0 cached blocks instead of the
+free list, and allocation under pressure evicts from that LRU — never
+from a block something still references.
 """
 from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
 
 import jax.numpy as jnp
 import numpy as np
@@ -74,6 +87,188 @@ class BlockAllocator:
 def blocks_for(tokens: int, block_size: int) -> int:
     """Blocks needed to hold `tokens` cache entries."""
     return -(-int(tokens) // int(block_size))
+
+
+# ------------------------------------------------------- prefix caching
+
+def hash_blocks(tokens, block_size: int, namespace: int = 0) -> list:
+    """Chained content hashes for every FULL block of `tokens`: block i's
+    hash covers its own token ids AND (through the chain) every token
+    before it, so equal hashes mean equal whole prefixes — the property
+    that makes hash->block reuse sound. `namespace` seeds the chain: KV
+    content depends on the model weights / layer config / cache dtype,
+    so two engines over different models must never collide (a namespace
+    mismatch shows up as 0% hits on an identical-prompt stream — the D7
+    cache-defeated finding). Hashes are sha256 digests, not Python
+    `hash()`: a 64-bit builtin-hash collision between two different
+    prefixes would silently serve one request's KV content to another
+    (token ids are caller-controlled, so the weak hash is also
+    adversarially reachable — the vLLM CVE-2025-25183 shape)."""
+    bs = int(block_size)
+    toks = np.asarray(tokens).reshape(-1).astype(np.int64)
+    h = hashlib.sha256(
+        b"paddle_tpu.prefix_cache:%d" % int(namespace)).digest()
+    out = []
+    for i in range(len(toks) // bs):
+        h = hashlib.sha256(h + toks[i * bs:(i + 1) * bs].tobytes()).digest()
+        out.append(h)
+    return out
+
+
+class PrefixCache:
+    """Hash->block map + per-block refcounts + LRU over a BlockAllocator.
+
+    Block lifecycle: `allocate` hands out private blocks at refcount 1
+    (evicting refcount-0 cached blocks when the free list runs dry);
+    `register` publishes a computed full block under its content hash;
+    `lookup` serves a new request's shared prefix by bumping refcounts;
+    `release` (the finish path) decrefs — a hash-mapped block at
+    refcount 0 parks in the LRU (its KV stays warm for the next request)
+    while an unmapped block goes straight back to the free list. Only
+    refcount-0 blocks are ever evicted."""
+
+    def __init__(self, allocator: BlockAllocator, max_cached_blocks: int = 0):
+        self.allocator = allocator
+        #: cap on refcount-0 cached blocks (0 = bounded only by the pool)
+        self.max_cached_blocks = int(max_cached_blocks)
+        self._map: dict = {}          # hash -> block id (full blocks only)
+        self._block_hash: dict = {}   # block id -> hash (inverse)
+        self._ref: dict = {}          # block id -> refcount (live blocks)
+        self._lru: OrderedDict = OrderedDict()  # refcount-0 cached blocks
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # ------------------------------------------------------------ queries
+    @property
+    def cached_blocks(self) -> int:
+        """Blocks currently addressable by content hash."""
+        return len(self._map)
+
+    @property
+    def referenced_blocks(self) -> int:
+        """Hash-mapped blocks some live request still references. Mapped
+        refcount-0 blocks are exactly the LRU members (release parks
+        them there, ref() removes them, eviction drops both sides), so
+        this is O(1) — it runs in the pool gauges on every admission and
+        finish."""
+        return len(self._map) - len(self._lru)
+
+    @property
+    def evictable(self) -> int:
+        return len(self._lru)
+
+    @property
+    def available(self) -> int:
+        """Blocks an admission could obtain: free list + evictable LRU."""
+        return self.allocator.available + len(self._lru)
+
+    def refcount(self, block_id: int) -> int:
+        return self._ref.get(int(block_id), 0)
+
+    # ------------------------------------------------------------- alloc
+    def allocate(self, n: int):
+        """All-or-nothing like BlockAllocator.alloc, but refcount-0 cached
+        blocks count as capacity: when the free list can't cover, LRU
+        blocks are evicted (hash entries dropped) to make room. Returns
+        private block ids at refcount 1, or None."""
+        n = int(n)
+        if n < 0:
+            raise ValueError(f"negative block count {n}")
+        if n > self.available:
+            return None
+        while self.allocator.available < n:
+            self._evict_one()
+        ids = self.allocator.alloc(n)
+        for b in ids:
+            self._ref[b] = 1
+        return ids
+
+    def _evict_one(self):
+        blk, _ = self._lru.popitem(last=False)      # least recently used
+        h = self._block_hash.pop(blk)
+        del self._map[h]
+        self._ref.pop(blk, None)
+        self.allocator.free([blk])
+        self.evictions += 1
+
+    # ------------------------------------------------------------ lookup
+    def lookup(self, hashes) -> list:
+        """Longest cached prefix of `hashes`: consecutive from block 0.
+        Found blocks get a refcount bump (and leave the LRU — a
+        referenced block is never eviction-eligible). Counts hits for the
+        found run and misses for the remainder."""
+        found = []
+        for h in hashes:
+            blk = self._map.get(h)
+            if blk is None:
+                break
+            self.ref(blk)
+            found.append(blk)
+        self.hits += len(found)
+        self.misses += len(hashes) - len(found)
+        return found
+
+    def ref(self, block_id: int) -> None:
+        blk = int(block_id)
+        self._ref[blk] = self._ref.get(blk, 0) + 1
+        self._lru.pop(blk, None)
+
+    def cancel_lookup(self, found, n_hashes: int) -> None:
+        """Undo a lookup whose admission could not proceed (pool full):
+        releases the refs it took and rolls the hit/miss counters back so
+        blocked retries don't inflate the hit rate."""
+        self.hits -= len(found)
+        self.misses -= int(n_hashes) - len(found)
+        self.release(found)
+
+    # ---------------------------------------------------------- register
+    def register(self, hashes, block_ids) -> None:
+        """Publish computed full blocks under their content hashes (zip of
+        parallel lists). A hash already mapped to a DIFFERENT block keeps
+        the existing mapping (two concurrent misses computed the same
+        content; the newer copy stays private and free-lists on release).
+        Idempotent for already-registered pairs."""
+        for h, blk in zip(hashes, block_ids):
+            blk = int(blk)
+            if h in self._map:
+                continue
+            old_h = self._block_hash.get(blk)
+            if old_h is not None and old_h != h:
+                # the block's content moved on (it was extended past the
+                # originally registered run) — rekey it
+                del self._map[old_h]
+            self._map[h] = blk
+            self._block_hash[blk] = h
+
+    # ------------------------------------------------------------ release
+    def release(self, block_ids) -> None:
+        """Decref each block; at refcount 0 a hash-mapped block parks in
+        the LRU (release-to-cache) and an unmapped block free-lists. THE
+        round-13 sharing contract: finish/timeout paths must come through
+        here — an unconditional allocator.free() on a shared block would
+        corrupt every other request pointing at it."""
+        for blk in block_ids:
+            blk = int(blk)
+            refs = self._ref.get(blk, 0)
+            if refs <= 0:
+                raise ValueError(f"release of unreferenced block {blk}")
+            if refs > 1:
+                self._ref[blk] = refs - 1
+                continue
+            del self._ref[blk]
+            if blk in self._block_hash:
+                self._lru[blk] = None
+                self._lru.move_to_end(blk)
+                self._trim()
+            else:
+                self.allocator.free([blk])
+
+    def _trim(self):
+        if self.max_cached_blocks <= 0:
+            return
+        while len(self._lru) > self.max_cached_blocks:
+            self._evict_one()
 
 
 class PagedKVCache:
@@ -187,3 +382,73 @@ def scatter_prefill_int8(cache, scale, ks, true_len, table_row,
                   -127, 127).astype(jnp.int8)
     return (cache.at[:, dest].set(q8),
             scale.at[:, dest].set(new_scale))
+
+
+# ------------------------------------------------ chunked-prefill updates
+# One LAYER's cache slice, like the decode appends above — these run
+# inside the chunk-prefill program's layer scan. Unlike scatter_prefill
+# the chunk's first position is NOT page-aligned (a prefix-cache hit can
+# start a suffix mid-block after copy-on-write), so the scatter is
+# token-granular: position p lands at (table_row[p // bs], p % bs).
+
+def scatter_chunk(cache, ks, start, true_end, table_row, block_size):
+    """Write one chunk's K (or V) through the block table. ks [C, H_kv, D]
+    holds positions [start, start + C); positions >= true_end route to
+    the trash block. cache is one layer's [num_blocks, H_kv, bs, D]."""
+    c = ks.shape[0]
+    pos = start + jnp.arange(c)
+    ok = pos < true_end
+    page = jnp.clip(pos // block_size, 0, table_row.shape[0] - 1)
+    blk = jnp.where(ok, table_row[page], TRASH_BLOCK)
+    off = (pos % block_size).astype(jnp.int32)
+    # dims 0 and 2 take advanced indices with a slice between, so the
+    # update value keeps ks's own [C, H_kv, D] layout
+    return cache.at[blk, :, off].set(ks.astype(cache.dtype))
+
+
+def scatter_chunk_int8(cache, scale, ks, start, true_end, table_row,
+                       block_size):
+    """Int8 chunk scatter: every page the chunk touches is dequantized
+    against its current scale (pre-existing content — earlier chunks, a
+    copy-on-write prefix — survives), the chunk tokens inserted, and the
+    page requantized over its valid prefix (positions < true_end).
+    Returns (cache, scale)."""
+    c = ks.shape[0]
+    bs = int(block_size)
+    # a chunk starting mid-block spans up to ceil(c/bs)+1 pages (worst
+    # case: start offset bs-1) — c//bs+1 under-counts whenever c % bs
+    # and the spilled tokens would silently route to the drop index
+    p_t = -(-c // bs) + 1                      # pages a C-chunk can span
+    page0 = start // bs
+    pages = page0 + jnp.arange(p_t)
+    page_ok = (pages * bs < true_end) & (pages < table_row.shape[0])
+    dest = jnp.where(page_ok,
+                     table_row[jnp.clip(pages, 0, table_row.shape[0] - 1)],
+                     TRASH_BLOCK).astype(jnp.int32)
+    old = cache[dest].astype(jnp.float32) \
+        * scale[dest][:, None, None, None]     # [P_t, Hkv, bs, D]
+    pos = start + jnp.arange(c)
+    ok = pos < true_end
+    tok_page = jnp.where(ok, pos // bs - page0, p_t)   # OOB -> dropped
+    off = (pos % bs).astype(jnp.int32)
+    old = old.at[tok_page, :, off].set(ks.astype(jnp.float32),
+                                       mode="drop")
+    valid = (pages[:, None] * bs + jnp.arange(bs)[None, :]) < true_end
+    amax = jnp.max(jnp.abs(old) * valid[:, None, :, None], axis=(1, 2, 3))
+    new_scale = jnp.maximum(amax / 127.0, 1e-8)        # [P_t]
+    q8 = jnp.clip(jnp.round(old / new_scale[:, None, None, None]),
+                  -127, 127).astype(jnp.int8)
+    return (cache.at[dest].set(q8), scale.at[dest].set(new_scale))
+
+
+def gather_context(cache, scale, table_row, ctx_pages):
+    """One layer's context K (or V) for chunk attention: the first
+    `ctx_pages` table entries gathered to [ctx_pages * bs, H_kv, D]
+    (dequantized when `scale` is given). Unwritten/trash pages surface
+    garbage that the caller's `kv_pos <= q_pos` mask never attends."""
+    tiles = cache[table_row[:ctx_pages]]       # [P, Hkv, bs, D]
+    if scale is not None:
+        tiles = tiles.astype(jnp.float32) \
+            * scale[table_row[:ctx_pages]][:, None, None, None]
+    p, hkv, bs, d = tiles.shape
+    return jnp.swapaxes(tiles, 1, 2).reshape(p * bs, hkv, d)

@@ -239,6 +239,9 @@ class TestContinuousBatching:
             eng.add_request(np.arange(60) % 16, max_new_tokens=60)
 
     def test_blocks_released_on_finish(self):
+        """Release is copy-free and leak-free: with the prefix cache on
+        (default), full blocks park REUSABLE in the refcount-0 LRU and
+        the rest free-list — allocatable capacity is fully restored."""
         m = _tiny()
         eng = ServingEngine(m, max_slots=2, kv_block_size=8,
                             num_kv_blocks=9)
@@ -247,8 +250,23 @@ class TestContinuousBatching:
         eng.add_request(rs.randint(0, 128, (5,)), max_new_tokens=4)
         eng.add_request(rs.randint(0, 128, (9,)), max_new_tokens=6)
         eng.run()
-        assert eng.allocator.available == free0     # copy-free release
+        assert eng.prefix_cache.available == free0   # nothing leaked
+        assert eng.prefix_cache.referenced_blocks == 0
         assert eng.num_active == 0 and eng.num_waiting == 0
+
+    def test_blocks_released_to_free_list_when_cache_off(self):
+        """With the prefix cache disabled the round-10 contract holds
+        bit-for-bit: every block returns to the free list."""
+        m = _tiny()
+        eng = ServingEngine(m, max_slots=2, kv_block_size=8,
+                            num_kv_blocks=9, prefix_cache=False)
+        free0 = eng.allocator.available
+        rs = np.random.RandomState(5)
+        eng.add_request(rs.randint(0, 128, (5,)), max_new_tokens=4)
+        eng.add_request(rs.randint(0, 128, (9,)), max_new_tokens=6)
+        eng.run()
+        assert eng.allocator.available == free0
+        assert eng.prefix_cache.cached_blocks == 0
 
     def test_static_admission_is_waves(self):
         """admission="static" (the bench baseline) must never admit into

@@ -114,11 +114,20 @@ def audit_serving() -> list:
     """The `paged` smoke: drive a tiny-LLaMA 2-slot serving engine through
     real prefill + decode steps (mixed-length requests, so a slot frees
     and refills), then audit the decode step program's jaxpr and the
-    decode kernel's launch-config budget at default flags."""
+    decode kernel's launch-config/pool budget at default flags.
+
+    Round 13 extends the smoke with a SHARED-PREFIX stream: after a
+    warmup request computes a multi-block prompt (and a second request
+    warms the cache-hit chunk program), the engine declares warmup done
+    and serves another request sharing the same prefix — the gate then
+    requires (a) at least one prefix-cache block hit (D7: an
+    identical-prefix stream that never hits means the cache is
+    defeated), and (b) ZERO compiles after the warmup barrier (the
+    cache-hit suffix path must ride already-compiled chunk programs)."""
     import numpy as np
 
     import paddle_tpu as paddle
-    from paddle_tpu import analysis
+    from paddle_tpu import analysis, obs
     from paddle_tpu.core.flags import flag
     from paddle_tpu.inference.engine import ServingEngine
     from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
@@ -145,7 +154,41 @@ def audit_serving() -> list:
     findings += analysis.audit_decode_config(
         eng.spec.head_dim, eng.block_size,
         group=max(1, eng.spec.num_heads // eng.spec.num_kv_heads),
-        itemsize=2, loc="paged/decode-config")
+        itemsize=2, pool_blocks=eng.allocator.num_blocks,
+        slots=eng.max_slots, seq_pages=eng.pages,
+        cached_blocks=eng.prefix_cache.cached_blocks,
+        loc="paged/decode-config")
+
+    # ---- shared-prefix stream (round 13): hit + zero-post-warmup gate
+    obs.clear_events()
+    eng2 = ServingEngine(model, max_slots=2)
+    shared = rs.randint(0, 128, (2 * eng2.block_size + 1,))
+    tail = rs.randint(0, 128, (3, 2))
+    # request 1 computes + registers the prefix; request 2 warms the
+    # cache-hit suffix chunk program at the buckets request 3 reuses
+    eng2.add_request(np.concatenate([shared, tail[0]]), max_new_tokens=2)
+    eng2.run()
+    eng2.add_request(np.concatenate([shared, tail[1]]), max_new_tokens=2)
+    eng2.run()
+    eng2.finish_warmup()
+    eng2.add_request(np.concatenate([shared, tail[2]]), max_new_tokens=2)
+    out2 = eng2.run()
+    assert len(out2) == 3, "shared-prefix smoke failed to drain"
+    hits = int(eng2.prefix_cache.hits)
+    if hits < 1:
+        findings.append(analysis.Finding(
+            "prefix-cache", "error", "paged/shared-prefix-smoke",
+            "a 3-request stream sharing a 2-block prompt prefix produced "
+            "ZERO prefix-cache hits at default flags — block reuse is "
+            "not happening", data={"hits": hits}))
+    else:
+        findings.append(analysis.Finding(
+            "prefix-cache", "note", "paged/shared-prefix-smoke",
+            f"shared-prefix stream served {hits} block(s) from cache"))
+    findings += analysis.audit_prefix_cache(
+        eng2, loc="paged/shared-prefix-smoke")
+    evs = [e for e in obs.compile_events() if e.site.startswith("serving")]
+    findings += obs.audit_recompiles(evs, loc="paged/shared-prefix-smoke")
     return findings
 
 
@@ -159,7 +202,12 @@ REQUIRED_SERVING_METRICS = (
     "serving_requests_timeout_total",
     "serving_admission_rejects_total", "serving_admission_blocked_total",
     "serving_queue_depth", "serving_active_slots",
-    "serving_block_pool_free_blocks", "serving_block_pool_used_blocks")
+    "serving_block_pool_free_blocks", "serving_block_pool_used_blocks",
+    # round 13: prefix cache + chunked prefill instrumentation
+    "serving_prefix_blocks_hit_total", "serving_prefix_blocks_missed_total",
+    "serving_prefill_chunks_total", "serving_prefix_cache_blocks",
+    "serving_prefix_cache_referenced_blocks",
+    "serving_prefix_cache_evictions_total")
 
 #: checkpoint metric rows the obs smoke requires in the DEFAULT registry
 #: after one save/restore cycle (the round-12 fault-tolerance contract)
