@@ -38,6 +38,14 @@ Serving detector (round 13, serving.py):
                          cache hits (namespace mismatch / broken
                          registration / over-eager eviction) — gated by
                          the graft_lint `paged` smoke.
+
+Cost detector (round 14, implemented in obs/costs.py and re-exported
+here because its output is Findings):
+  D8 audit_cost_regressions  a compiled program whose XLA bytes-accessed
+                         grew more than FLAGS_obs_cost_regress_pct over
+                         the committed tools/cost_baseline.json — the
+                         HBM-traffic budget regressed; gated by the
+                         graft_lint `obs` smoke like a dtype regression.
 """
 from .ast_lint import (audit_flags_doc, lint_dy2static, lint_file,
                        lint_tree, lint_vjp_saves, lint_x64)
@@ -61,8 +69,18 @@ def audit_recompiles(events=None, threshold=None, loc="obs/watchdog"):
     return _impl(events=events, threshold=threshold, loc=loc)
 
 
+def audit_cost_regressions(baseline, entries=None, threshold_pct=None,
+                           loc="obs/costs"):
+    """D8: compiled-program cost regressions vs a committed baseline
+    (obs/costs.py) — deferred import like D6."""
+    from ..obs.costs import audit_cost_regressions as _impl
+
+    return _impl(baseline, entries=entries, threshold_pct=threshold_pct,
+                 loc=loc)
+
+
 __all__ = [
-    "audit_recompiles", "audit_prefix_cache",
+    "audit_recompiles", "audit_prefix_cache", "audit_cost_regressions",
     "Finding", "apply_baseline", "format_text", "gate_failures",
     "load_baseline", "to_json",
     "audit_callbacks", "audit_compiled", "audit_donation",

@@ -474,7 +474,13 @@ def _cached_dispatch(fn, fn_id, name, datas, diff_idx, target,
         # time is ~0 here because jax.jit traces lazily on first call.
         # The key is digested: a re-BUILD of the same digest after
         # eviction is the cache-thrash signal audit_recompiles flags.
-        _record_compile()("eager", name, f"{name}#{hash(key) & 0xffffffff:08x}")
+        digest = f"{name}#{hash(key) & 0xffffffff:08x}"
+        _record_compile()("eager", name, digest)
+        # cost ledger (obs/costs.py): count-only rows — per-op eager
+        # executables lower lazily inside jax.jit, so no XLA analysis
+        # is reachable without paying one extra compile per op; the
+        # ledger still shows WHERE the eager program population lives
+        _record_cost_program()("eager", name, digest)
     else:
         _eager_hits += 1
     kind, jitted, *defer = entry
@@ -533,6 +539,7 @@ def _cached_dispatch(fn, fn_id, name, datas, diff_idx, target,
 
 
 _RECORD_COMPILE = None
+_RECORD_COST = None
 
 
 def _record_compile():
@@ -542,6 +549,13 @@ def _record_compile():
     if _RECORD_COMPILE is None:
         from ..obs.watchdog import record_compile as _RECORD_COMPILE  # noqa: F811
     return _RECORD_COMPILE
+
+
+def _record_cost_program():
+    global _RECORD_COST
+    if _RECORD_COST is None:
+        from ..obs.costs import record_program as _RECORD_COST  # noqa: F811
+    return _RECORD_COST
 
 
 def eager_cache_info() -> dict:

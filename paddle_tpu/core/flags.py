@@ -269,6 +269,50 @@ define_flag("FLAGS_obs_http_port", 0,
             "when > 0 the ServingEngine exposes its metrics registry at "
             "http://127.0.0.1:<port>/metrics (Prometheus text "
             "exposition, stdlib http.server daemon thread); 0 = off")
+define_flag("FLAGS_obs_log_max_mb", 64,
+            "size cap in MB for the JSONL event log at FLAGS_obs_log_path "
+            "(obs/metrics.py): past the cap the file rotates to "
+            "<path>.1 .. <path>.N between records — a line is never torn "
+            "mid-write; 0 = unbounded (the pre-round-14 behavior)")
+define_flag("FLAGS_obs_log_backups", 3,
+            "rolled JSONL event-log files kept after rotation "
+            "(<path>.1 newest .. <path>.N oldest); the oldest is deleted "
+            "when a rotation would exceed N")
+define_flag("FLAGS_obs_flight_requests", 256,
+            "per-engine flight-recorder ring capacity (obs/flight.py): "
+            "finished request timelines kept for dump_trace(); the "
+            "oldest finished flight is evicted past the cap — active "
+            "requests are never evicted")
+define_flag("FLAGS_obs_flight_dir", "",
+            "anomaly auto-dump directory for the flight recorder: on a "
+            "request timeout, a TTFT SLO breach "
+            "(FLAGS_obs_slo_ttft_ms) or a post-warmup compile the "
+            "engine writes a Chrome-trace JSON postmortem here "
+            "(flight_<trigger>_<n>.json, capped per engine); empty = "
+            "record but never auto-dump")
+define_flag("FLAGS_obs_slo_ttft_ms", 0.0,
+            "TTFT SLO in ms for the flight recorder's anomaly trigger: "
+            "a request whose first token lands later than this "
+            "auto-dumps the flight ring (FLAGS_obs_flight_dir) and "
+            "counts serving_flight_dumps_total{trigger=slo_breach}; "
+            "0 = no SLO trigger")
+define_flag("FLAGS_obs_cost_capture", True,
+            "capture XLA cost_analysis()/memory_analysis() (flops, bytes "
+            "accessed, HBM footprint) into the compile event and the "
+            "per-program cost ledger (obs/costs.py) at the AOT compile "
+            "sites (serving buckets, generation engine; to_static under "
+            "FLAGS_jit_debug_program) — compiled executables carry the "
+            "analysis for free, no extra compile is paid")
+define_flag("FLAGS_obs_peak_gbps", 0.0,
+            "peak HBM bandwidth (GB/s) the roofline_utilization gauges "
+            "divide achieved bytes/s by; 0 = per-backend default (103 "
+            "on this axon-tunnel TPU — the measured round-4 roofline — "
+            "else a nominal host number, do not quote off-chip)")
+define_flag("FLAGS_obs_cost_regress_pct", 25.0,
+            "analysis D8 (audit_cost_regressions) threshold: a compiled "
+            "program whose bytes-accessed grew more than this percent "
+            "over tools/cost_baseline.json fails lint like a dtype "
+            "regression")
 
 
 # the full reference flag surface (compat entries; must come after the

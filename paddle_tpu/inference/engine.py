@@ -391,9 +391,21 @@ _chunk_prefill_step = functools.partial(
 
 # ------------------------------------------------------------ scheduler
 
-#: host-side mirror of the step programs' jit cache keys (shared across
-#: engines, like the executables themselves) — obs compile watchdog
+#: host-side mirror of the step programs' cache keys (shared across
+#: engines, like the executables themselves) — obs compile watchdog.
+#: Kept SEPARATE from the executable cache below so tests can clear the
+#: event mirror (forcing compile events to re-record) without forcing a
+#: real recompile.
 _SEEN_SERVING_PROGRAMS: set = set()
+
+#: round 14: the engine owns its executables via the AOT path
+#: (jitted.lower().compile()) instead of jax.jit's implicit cache —
+#: the compiled object carries XLA cost_analysis()/memory_analysis()
+#: for free (obs/costs.py), the compile wall is measured exactly (not
+#: smeared into the first execution), and dispatch overhead is within
+#: noise of the jit fast path (measured ~2.6us vs ~2.4us per call).
+#: key -> (compiled_executable, obs.costs.ProgramCost entry)
+_SERVING_EXECUTABLES: dict = {}
 
 
 class Request:
@@ -404,7 +416,7 @@ class Request:
                  "tokens", "arrival_s", "admitted_s", "first_token_s",
                  "finished", "max_time_ms", "deadline_s", "finish_reason",
                  "cached_len", "prefill_pos", "prefill_done",
-                 "_hashes", "_hash_ns")
+                 "_hashes", "_hash_ns", "_flight")
 
     def __init__(self, rid, prompt, max_new_tokens, do_sample, temperature,
                  top_k, top_p, eos_token_id, max_time_ms=None):
@@ -441,6 +453,8 @@ class Request:
         # over an 8k prompt must not recompute per tick)
         self._hashes = None
         self._hash_ns = None
+        # flight-recorder timeline (obs/flight.py), set at add_request
+        self._flight = None
 
     def expired(self, now=None) -> bool:
         if self.deadline_s is None:
@@ -653,19 +667,43 @@ class ServingEngine:
             "serving_block_pool_free_blocks", "free KV blocks")
         self._m_pool_used = reg.gauge(
             "serving_block_pool_used_blocks", "allocated KV blocks")
+        # ---- flight recorder (round 14): every request gets a span
+        # timeline; anomalies (timeout / TTFT SLO breach / post-warmup
+        # compile) auto-dump a Chrome-trace postmortem
+        self._m_flight_anomalies = reg.counter(
+            "serving_flight_anomalies_total", "flight-recorder anomaly "
+            "triggers observed (request timeout, TTFT SLO breach, "
+            "post-warmup compile)", ("trigger",))
+        self._m_flight_dumps = reg.counter(
+            "serving_flight_dumps_total", "flight-recorder postmortem "
+            "trace files written to FLAGS_obs_flight_dir", ("trigger",))
+        self._m_flight_requests = reg.gauge(
+            "serving_flight_requests", "request timelines held in the "
+            "flight-recorder ring (active + finished)")
         reg.gauge("serving_slots", "engine slot count").set(self.max_slots)
         reg.gauge("serving_kv_pool_blocks",
                   "total KV blocks (incl. trash)").set(
                       self.allocator.num_blocks)
         self._m_pool_free.set(self.allocator.available)
-        # compile watchdog state: after finish_warmup() any NEW program
-        # key is a steady-state retrace (warm=True -> lint finding).
-        # The static key prefix is prehashed ONCE — _track_program runs
-        # every tick and a frozen dataclass rehashes per lookup
+        # compile watchdog + executable-cache state: after
+        # finish_warmup() any NEW program key is a steady-state retrace
+        # (warm=True -> lint finding). The static key prefix is
+        # prehashed ONCE — _program runs every tick and a frozen
+        # dataclass rehashes per lookup. Round 14: the key now also
+        # fingerprints the param avals — the key addresses REAL
+        # executables (_SERVING_EXECUTABLES), so two models sharing a
+        # _GenSpec but differing in vocab/intermediate width must not
+        # collide onto one compiled program.
+        params_fp = tuple((tuple(p.shape), str(p.dtype))
+                          for p in jax.tree_util.tree_leaves(self.params))
         self._prog_key_base = hash(
             (self.spec, self.block_size, self.quantized, self.pages,
-             self.allocator.num_blocks, str(self.cache.k.dtype)))
+             self.allocator.num_blocks, str(self.cache.k.dtype),
+             params_fp))
         self._warmed = False
+        self.flight = obs.FlightRecorder()
+        slo_ms = float(flag("FLAGS_obs_slo_ttft_ms"))
+        self._slo_ttft_s = slo_ms / 1e3 if slo_ms > 0 else None
         self._log = obs.get_logger(__name__)
         self._metrics_server = None
         port = int(flag("FLAGS_obs_http_port"))
@@ -718,9 +756,13 @@ class ServingEngine:
             self._reject("bad_max_time_ms", "max_time_ms must be positive")
         rid = self._next_id
         self._next_id += 1
-        self._waiting.append(Request(rid, prompt, max_new_tokens,
-                                     do_sample, temperature, top_k, top_p,
-                                     eos_token_id, max_time_ms=max_time_ms))
+        req = Request(rid, prompt, max_new_tokens, do_sample, temperature,
+                      top_k, top_p, eos_token_id, max_time_ms=max_time_ms)
+        req._flight = self.flight.begin(rid, prompt.size,
+                                        int(max_new_tokens),
+                                        req.arrival_s)
+        self._m_flight_requests.set(len(self.flight._flights))
+        self._waiting.append(req)
         self._m_queue_depth.set(len(self._waiting))
         return rid
 
@@ -831,42 +873,82 @@ class ServingEngine:
             self._metrics_server.close()
             self._metrics_server = None
 
-    def _track_program(self, site: str, bucket: int, any_sample: bool,
-                       extra=()):
-        """Host-side mirror of the step programs' jit cache keys: a NEW
-        key is (to first order) a fresh trace+compile. Returns None for a
-        warm key, else a callback the caller invokes with the measured
-        wall — recording the compile event with the engine's warm flag.
-        The seen-set is MODULE level because _prefill_step/_decode_step
-        executables are shared across engines (same spec + shapes reuse
-        the compiled program, so a second engine genuinely pays no
-        trace). `extra` carries further static key parts (the chunk
-        program's context-pages bucket + emit_token flag)."""
+    def _program(self, site: str, jitted, n_static: int, bucket: int,
+                 any_sample: bool, extra, args):
+        """AOT program cache: the engine's step programs compile through
+        ``jitted.lower(*args).compile()`` into a MODULE-level executable
+        cache (shared across engines — same spec + shapes genuinely
+        reuse the compiled program). The compiled object hands XLA
+        cost_analysis()/memory_analysis() to the cost ledger for free
+        (obs/costs.py), and the compile wall is the measured
+        lower+compile time, not the first execution smeared in.
+
+        Returns ``(callable, ProgramCost entry)``; invoke the callable
+        with ``args[n_static:]`` (AOT calls exclude static args).
+        ``_SEEN_SERVING_PROGRAMS`` stays the separate event mirror:
+        clearing it (tests) re-records compile events without forcing a
+        real recompile, exactly the old jit-cache semantics."""
         key = (site, self._prog_key_base, bool(any_sample), int(bucket),
                tuple(extra))
-        if key in _SEEN_SERVING_PROGRAMS:
-            return None
-        _SEEN_SERVING_PROGRAMS.add(key)
-        warm = self._warmed
+        keystr = (f"bucket{bucket}/sample{int(any_sample)}/"
+                  f"q{int(self.quantized)}"
+                  + "".join(f"/{x}" for x in extra))
+        cached = _SERVING_EXECUTABLES.get(key)
+        compile_wall = None
+        if cached is None:
+            from ..obs import costs as _costs
 
-        def record(wall_s):
+            t0 = time.perf_counter()
+            compiled = jitted.lower(*args).compile()
+            compile_wall = time.perf_counter() - t0
+            entry = _costs.record_program(
+                site, self._prog_group(site), keystr,
+                compiled=compiled, wall_s=compile_wall, bucket=int(bucket))
+            cached = (compiled, entry)
+            _SERVING_EXECUTABLES[key] = cached
+        if key not in _SEEN_SERVING_PROGRAMS:
+            _SEEN_SERVING_PROGRAMS.add(key)
             from ..obs.watchdog import record_compile
 
+            entry = cached[1]
             record_compile(
-                site, f"{site}/L{self.spec.num_layers}"
-                f"h{self.spec.num_heads}d{self.spec.head_dim}",
-                f"bucket{bucket}/sample{int(any_sample)}/"
-                f"q{int(self.quantized)}"
-                + "".join(f"/{x}" for x in extra),
-                bucket=int(bucket), wall_s=wall_s, donated=True,
-                warm=warm)
-            if warm:
+                site, self._prog_group(site), keystr, bucket=int(bucket),
+                wall_s=compile_wall or 0.0, donated=True,
+                warm=self._warmed,
+                cost=({"flops": entry.flops,
+                       "bytes_accessed": entry.bytes_accessed,
+                       "peak_hbm_bytes": entry.peak_hbm_bytes}
+                      if entry.analyzed else None))
+            if self._warmed:
                 self._log.warning(
                     f"post-warmup compile: {site} bucket {bucket} traced "
                     "after finish_warmup() — steady-state ticks must not "
                     "compile", key=f"warm-compile:{site}")
+                self._anomaly("post_warmup_compile")
+        return cached
 
-        return record
+    def _prog_group(self, site: str) -> str:
+        return (f"{site}/L{self.spec.num_layers}"
+                f"h{self.spec.num_heads}d{self.spec.head_dim}")
+
+    def _anomaly(self, trigger: str):
+        """One flight-recorder anomaly: count it and (when
+        FLAGS_obs_flight_dir is set) write the postmortem trace."""
+        self._m_flight_anomalies.labels(trigger).inc()
+        path = self.flight.anomaly_dump(trigger)
+        if path is not None:
+            self._m_flight_dumps.labels(trigger).inc()
+            self._log.warning(
+                f"flight recorder postmortem ({trigger}) dumped to "
+                f"{path}", key=f"flight-dump:{trigger}")
+
+    def dump_trace(self, path: str) -> str:
+        """Export the flight-recorder ring as Chrome-trace/Perfetto JSON
+        (load it at ui.perfetto.dev or chrome://tracing). Asserts the
+        TTFT tiling invariant — every finished request's queue_wait +
+        prefill spans sum bitwise to its recorded TTFT — before
+        writing; obs.validate_trace(path) re-checks the dumped file."""
+        return self.flight.dump(path)
 
     # ------------------------------------------------------- scheduling
     def _expire(self):
@@ -888,6 +970,7 @@ class ServingEngine:
                     f"deadline after {len(req.tokens)} token(s); slot "
                     "and blocks reclaimed", key="request-timeout")
                 self._finish(slot)
+                self._anomaly("timeout")
                 emitted.append((req.rid, None, True))
         expired_waiting = [r for r in self._waiting if r.expired(now)]
         if expired_waiting:
@@ -901,6 +984,8 @@ class ServingEngine:
                 self.finish_reasons[req.rid] = "timeout"
                 self._m_timeout.inc()
                 self._m_completed.inc()
+                self.flight.finish(req.rid, now, "timeout")
+                self._anomaly("timeout")
                 emitted.append((req.rid, None, True))
         return emitted
 
@@ -955,6 +1040,13 @@ class ServingEngine:
                 undo = hit + ([cow_src] if cow_src is not None else [])
                 self.prefix_cache.cancel_lookup(undo, len(hashes))
                 self._m_blocked.inc()
+                fl = req._flight
+                if fl.blocked_ticks == 0:
+                    fl.add_mark("admission_blocked", time.perf_counter(),
+                                {"need_blocks": int(need),
+                                 "available":
+                                     int(self.prefix_cache.available)})
+                fl.blocked_ticks += 1
                 self._log.vlog(
                     2, f"admission blocked: request {req.rid} needs "
                     f"{need} blocks, {self.prefix_cache.available} "
@@ -964,6 +1056,14 @@ class ServingEngine:
             req.admitted_s = time.perf_counter()
             req.cached_len = cached_len
             req.prefill_pos = cached_len
+            fl = req._flight
+            fl.admitted_s = req.admitted_s
+            fl.cached_blocks = hit_blocks
+            fl.cow = cow_src is not None
+            fl.add_mark("admitted", req.admitted_s,
+                        {"slot": slot, "cached_blocks": hit_blocks,
+                         "cached_len": int(cached_len),
+                         "need_blocks": int(need)})
             self.queue_waits.append(req.queue_wait_s)
             self._m_queue_wait.observe(req.queue_wait_s)
             self._m_queue_depth.set(len(self._waiting))
@@ -1018,6 +1118,10 @@ class ServingEngine:
         ev = self.prefix_cache.evictions - self._m_prefix_evict.value
         if ev > 0:
             self._m_prefix_evict.inc(ev)
+            # eviction pressure on the flight recorder's engine track:
+            # the LRU gave up warm blocks to satisfy an allocation
+            self.flight.tick_mark("prefix_evictions", time.perf_counter(),
+                                  evicted=int(ev))
 
     def _register_full_blocks(self, slot):
         """Publish this slot's FULLY-WRITTEN blocks into the prefix cache
@@ -1042,30 +1146,29 @@ class ServingEngine:
     def _prefill(self, slot, req):
         from ..jit.api import default_buckets
 
-        t0 = time.perf_counter()
         s = req.prompt.size
         bucket = min(_ceil_to(default_buckets(s), self.block_size),
                      self.max_model_len)
         bucket = max(bucket, _ceil_to(s, self.block_size))
-        new_prog = self._track_program("serving.prefill", bucket,
-                                       req.do_sample)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :s] = req.prompt
         samp = self._samp_arrays([req])
         c = self.cache
         from ..obs import span as _span
 
-        with _span("serving.prefill"):
-            out = _prefill_step(
-                self.spec, self.block_size, self.quantized, req.do_sample,
+        args = (self.spec, self.block_size, self.quantized, req.do_sample,
                 self.params, jnp.asarray(ids), jnp.int32(s),
                 jnp.asarray(self._tables[slot]), c.k, c.v, c.k_scale,
                 c.v_scale, samp, self._key)
+        prog, entry = self._program("serving.prefill", _prefill_step, 4,
+                                    bucket, req.do_sample, (), args)
+        t_run = time.perf_counter()
+        with _span("serving.prefill"):
+            out = prog(*args[4:])
             tok_arr, c.k, c.v, c.k_scale, c.v_scale, self._key = out
             tok = int(jax.device_get(tok_arr)[0])
         req.first_token_s = time.perf_counter()
-        if new_prog is not None:
-            new_prog(wall_s=req.first_token_s - t0)
+        entry.observe(req.first_token_s - t_run)
         req.prefill_pos = s
         req.prefill_done = True
         self._m_prefill.observe(req.prefill_s)
@@ -1074,7 +1177,24 @@ class ServingEngine:
         self._m_prefill_tokens.inc(s)
         req.tokens.append(tok)
         self._slot_pos[slot] = s
+        req._flight.add_span(
+            "prefill_program", t_run, req.first_token_s,
+            {"bucket": bucket, "program": entry.program, "tokens": int(s)})
+        self._first_token(req)
         return tok, self._check_done(req, tok)
+
+    def _first_token(self, req):
+        """Flight-recorder bookkeeping at a request's first token, plus
+        the TTFT SLO anomaly trigger (FLAGS_obs_slo_ttft_ms)."""
+        fl = req._flight
+        fl.first_token_s = req.first_token_s
+        fl.last_token_s = req.first_token_s
+        fl.ttft_s = req.ttft_s
+        fl.tokens += 1
+        if self._slo_ttft_s is not None and req.ttft_s > self._slo_ttft_s:
+            fl.add_mark("slo_breach", req.first_token_s,
+                        {"ttft_s": req.ttft_s, "slo_s": self._slo_ttft_s})
+            self._anomaly("slo_breach")
 
     def _chunk_phase(self):
         """Advance every prefilling slot by ONE chunk. A slot whose final
@@ -1097,6 +1217,7 @@ class ServingEngine:
             self.ttfts.append(req.ttft_s)
             req.tokens.append(tok)
             self._slot_pos[slot] = s
+            self._first_token(req)
             self._register_full_blocks(slot)
             done = self._check_done(req, tok)
             emitted.append((req.rid, tok, done))
@@ -1113,7 +1234,6 @@ class ServingEngine:
         compiles O(log S * log pages) chunk programs."""
         from ..jit.api import default_buckets
 
-        t0 = time.perf_counter()
         s = req.prompt.size
         start = req.prefill_pos
         n = s - start if self.chunk_tokens <= 0 \
@@ -1127,28 +1247,43 @@ class ServingEngine:
         cow = state.pop("cow", None)
         cow_src, cow_dst = cow if cow is not None else (TRASH_BLOCK,
                                                         TRASH_BLOCK)
-        new_prog = self._track_program(
-            "serving.chunk_prefill", c_bucket, req.do_sample and is_last,
-            extra=(ctx_pages, bool(is_last)))
         ids = np.zeros((1, c_bucket), np.int32)
         ids[0, :n] = req.prompt[start:start + n]
         samp = self._samp_arrays([req])
         c = self.cache
         from ..obs import span as _span
 
-        with _span("serving.chunk_prefill"):
-            out = _chunk_prefill_step(
-                self.spec, self.block_size, self.quantized,
+        args = (self.spec, self.block_size, self.quantized,
                 req.do_sample and is_last, is_last, ctx_pages,
                 self.params, jnp.asarray(ids), jnp.int32(start),
                 jnp.int32(start + n), jnp.int32(s - 1 - start),
                 jnp.asarray(self._tables[slot]), jnp.int32(cow_src),
                 jnp.int32(cow_dst), c.k, c.v, c.k_scale, c.v_scale,
                 samp, self._key)
+        prog, entry = self._program(
+            "serving.chunk_prefill", _chunk_prefill_step, 6, c_bucket,
+            req.do_sample and is_last, (ctx_pages, bool(is_last)), args)
+        t_run = time.perf_counter()
+        with _span("serving.chunk_prefill"):
+            out = prog(*args[6:])
             tok_arr, c.k, c.v, c.k_scale, c.v_scale, self._key = out
-            tok = int(jax.device_get(tok_arr)[0]) if is_last else None
-        if new_prog is not None:
-            new_prog(wall_s=time.perf_counter() - t0)
+            if is_last:
+                tok = int(jax.device_get(tok_arr)[0])
+            else:
+                # non-final chunks fetch no token, so without an explicit
+                # barrier t_end is async dispatch's enqueue time — block
+                # on the written cache so the observed wall (roofline
+                # utilization + the prefill_chunk span) is the program's
+                tok = None
+                jax.block_until_ready(c.k)
+        t_end = time.perf_counter()
+        entry.observe(t_end - t_run)
+        fl = req._flight
+        fl.chunks += 1
+        fl.add_span("prefill_chunk", t_run, t_end,
+                    {"start": int(start), "tokens": int(n),
+                     "last": bool(is_last), "cow": cow is not None,
+                     "program": entry.program})
         if cow is not None:
             # the copy executed (device order is program order): drop the
             # admission-time ref that kept the source from being evicted
@@ -1175,18 +1310,23 @@ class ServingEngine:
              np.full((pad, self.pages), TRASH_BLOCK, np.int32)])
         samp = self._samp_arrays(reqs, pad)
         any_sample = any(r.do_sample for r in reqs)
-        new_prog = self._track_program("serving.decode", bucket, any_sample)
         c = self.cache
-        out = _decode_step(
-            self.spec, self.block_size, self.quantized, any_sample,
-            self.params, jnp.asarray(tok), jnp.asarray(pos),
-            jnp.asarray(tables), c.k, c.v, c.k_scale, c.v_scale, samp,
-            self._key)
+        args = (self.spec, self.block_size, self.quantized, any_sample,
+                self.params, jnp.asarray(tok), jnp.asarray(pos),
+                jnp.asarray(tables), c.k, c.v, c.k_scale, c.v_scale, samp,
+                self._key)
+        prog, entry = self._program("serving.decode", _decode_step, 4,
+                                    bucket, any_sample, (), args)
+        t_run = time.perf_counter()
+        out = prog(*args[4:])
         nxt, c.k, c.v, c.k_scale, c.v_scale, self._key = out
         nxt = np.asarray(jax.device_get(nxt))
-        step_wall = time.perf_counter() - t0
-        if new_prog is not None:
-            new_prog(wall_s=step_wall)
+        t_end = time.perf_counter()
+        step_wall = t_end - t0
+        entry.observe(t_end - t_run)
+        self.flight.tick_span("decode_tick", t_run, t_end,
+                              active=len(active), bucket=int(bucket),
+                              program=entry.program)
         self._m_decode_step.observe(step_wall)
         self._m_tpot.observe(step_wall / len(active))
         self._m_active.set(len(active))
@@ -1195,6 +1335,9 @@ class ServingEngine:
             req = self._slot_req[slot]
             t = int(nxt[j])
             req.tokens.append(t)
+            fl = req._flight
+            fl.tokens += 1
+            fl.last_token_s = t_end
             self._slot_pos[slot] += 1
             done = self._check_done(req, t)
             emitted.append((req.rid, t, done))
@@ -1242,6 +1385,9 @@ class ServingEngine:
         req.finished = True
         self.completed[req.rid] = np.asarray(req.tokens, np.int64)
         self.finish_reasons[req.rid] = req.finish_reason or "length"
+        self.flight.finish(req.rid, time.perf_counter(),
+                           self.finish_reasons[req.rid])
+        self._m_flight_requests.set(len(self.flight._flights))
         self._register_full_blocks(slot)
         self.prefix_cache.release(self._slot_blocks[slot]
                                   + self._slot_extra_refs[slot])

@@ -461,12 +461,38 @@ class CompiledFunction:
                 eqns = _watchdog.jaxpr_size(jax.make_jaxpr(pure_fn)(*dbg_avals))
             except Exception:
                 eqns = None
+        # cost attribution (round 14): under FLAGS_jit_debug_program the
+        # retained avals let us AOT-compile the same program and read
+        # XLA cost_analysis()/memory_analysis() into the obs cost
+        # ledger. Debug-flag-only because the jit executable above is
+        # not reachable post-call — the AOT re-lower costs one extra
+        # compile, which the lint/bench smokes pay and production
+        # doesn't.
+        cost = None
+        if spec.debug is not None and flag("FLAGS_obs_cost_capture"):
+            try:
+                import hashlib
+
+                from ..obs import costs as _costs
+
+                compiled = jitted.lower(*spec.debug[1]).compile()
+                digest = hashlib.sha1(key.encode()).hexdigest()[:8]
+                entry = _costs.record_program(
+                    "to_static", fn_name, f"{fn_name}/{digest}",
+                    compiled=compiled, wall_s=_compile_wall)
+                if entry.analyzed:
+                    cost = {"flops": entry.flops,
+                            "bytes_accessed": entry.bytes_accessed,
+                            "peak_hbm_bytes": entry.peak_hbm_bytes}
+            except Exception:
+                cost = None
         # group per CompiledFunction INSTANCE: distinct wrapped functions
         # sharing a name (test suites are full of `train_step`s) must not
         # pool into one fake storm
         _watchdog.record_compile(
             "to_static", f"{fn_name}@{id(self) & 0xffff:04x}", key,
-            wall_s=_compile_wall, jaxpr_eqns=eqns, donated=spec.donated)
+            wall_s=_compile_wall, jaxpr_eqns=eqns, donated=spec.donated,
+            cost=cost)
         return self._finish(spec, out_datas, mut_out)
 
     def _run_segmented(self, args, kwargs):

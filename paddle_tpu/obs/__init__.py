@@ -30,6 +30,10 @@ within 2% tok/s of uninstrumented steady-state decode, PERF.md round 11);
 """
 from __future__ import annotations
 
+from .costs import (ProgramCost, audit_cost_regressions, clear_ledger,
+                    extract_cost, ledger, peak_gbps, record_program,
+                    reset_exec_stats, roofline_rows, write_baseline)
+from .flight import FlightRecorder, RequestFlight, validate_trace
 from .http import MetricsServer, serve_metrics
 from .logging import ObsLogger, get_logger
 from .metrics import (DEFAULT_BUCKETS, OVERFLOW, Counter, Gauge, Histogram,
@@ -76,4 +80,8 @@ __all__ = [
     "record_ckpt_save", "ckpt_save_events", "audit_ckpt_stalls",
     "get_logger", "ObsLogger",
     "serve_metrics", "MetricsServer",
+    "FlightRecorder", "RequestFlight", "validate_trace",
+    "ProgramCost", "record_program", "ledger", "clear_ledger",
+    "reset_exec_stats", "roofline_rows", "extract_cost", "peak_gbps",
+    "write_baseline", "audit_cost_regressions",
 ]

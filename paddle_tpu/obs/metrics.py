@@ -322,7 +322,7 @@ class Registry:
         lines = []
         for name, m in sorted(self._metrics.items()):
             full = f"{ns}_{name}" if ns else name
-            lines.append(f"# HELP {full} {m.doc or name}")
+            lines.append(f"# HELP {full} {_escape_help(m.doc or name)}")
             lines.append(f"# TYPE {full} {m.kind}")
             for labelvalues, child in m.samples():
                 lab = _fmt_labels(m.labelnames, labelvalues)
@@ -343,6 +343,10 @@ class Registry:
 
 def _fmt_float(v: float) -> str:
     f = float(v)
+    if math.isnan(f):
+        return "NaN"           # text-format spec spells the specials
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
     return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
 
 
@@ -354,8 +358,20 @@ def _fmt_labels(names, values, extra=None):
 
 
 def _escape(v: str) -> str:
+    """Label-value escaping per the Prometheus text format 0.0.4: inside
+    double quotes, backslash, double-quote and line feed must escape (in
+    this order — escaping the backslash LAST would re-escape the
+    escapes). Pinned fire/no-fire in tests/test_obs.py."""
     return str(v).replace("\\", r"\\").replace('"', r'\"').replace("\n",
                                                                    r"\n")
+
+
+def _escape_help(v: str) -> str:
+    """HELP-line escaping: backslash and line feed only (quotes are legal
+    there). A metric doc containing a newline used to tear the
+    exposition into an unparseable line — the scrape-side failure mode
+    the round-14 satellite pins."""
+    return str(v).replace("\\", r"\\").replace("\n", r"\n")
 
 
 # ----------------------------------------------------------- JSONL export
@@ -363,12 +379,31 @@ class _JsonlSink:
     """Append-only JSONL event log at FLAGS_obs_log_path. The file handle
     opens lazily on first event and re-opens when the flag changes (tests
     point it at tmp paths); line-buffered so a crashed process leaves
-    whole lines."""
+    whole lines.
+
+    Size-capped rotation (round-14 satellite — the log used to grow
+    without bound under a long-lived serving loop): past
+    ``FLAGS_obs_log_max_mb`` the file rolls to ``<path>.1`` (older rolls
+    shift to ``.2`` .. ``.N``, ``FLAGS_obs_log_backups``; the oldest is
+    deleted). Rotation happens BETWEEN records under the sink lock, so a
+    rollover can never tear a JSON line — every line in every file of
+    the set parses (pinned in tests/test_obs.py)."""
 
     def __init__(self):
         self._fh = None
         self._path = None
+        self._bytes = 0
         self._lock = threading.Lock()
+
+    def _open(self, path):
+        import os
+
+        self._fh = open(path, "a", buffering=1)
+        self._path = path
+        try:
+            self._bytes = os.path.getsize(path)
+        except OSError:
+            self._bytes = 0
 
     def _handle(self):
         from ..core.flags import flag
@@ -383,18 +418,42 @@ class _JsonlSink:
         if path != self._path:
             if self._fh is not None:
                 self._fh.close()
-            self._fh = open(path, "a", buffering=1)
-            self._path = path
+            self._open(path)
         return self._fh
 
+    def _rotate(self):
+        import os
+
+        from ..core.flags import flag
+
+        backups = max(1, int(flag("FLAGS_obs_log_backups")))
+        self._fh.close()
+        oldest = f"{self._path}.{backups}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(backups - 1, 0, -1):
+            src = f"{self._path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self._path}.{i + 1}")
+        os.replace(self._path, f"{self._path}.1")
+        self._open(self._path)
+
     def emit(self, kind: str, payload: dict):
+        from ..core.flags import flag
+
         with self._lock:
             fh = self._handle()
             if fh is None:
                 return False
             rec = {"t": time.time(), "kind": kind}
             rec.update(payload)
-            fh.write(json.dumps(rec) + "\n")
+            line = json.dumps(rec) + "\n"
+            cap = int(flag("FLAGS_obs_log_max_mb")) * 1024 * 1024
+            if cap > 0 and self._bytes and self._bytes + len(line) > cap:
+                self._rotate()
+                fh = self._fh
+            fh.write(line)
+            self._bytes += len(line)
             return True
 
 

@@ -55,10 +55,10 @@ class CompileEvent:
     """One compile/retrace, as recorded at the site."""
 
     __slots__ = ("site", "group", "key", "bucket", "wall_s", "jaxpr_eqns",
-                 "donated", "warm", "t")
+                 "donated", "warm", "cost", "t")
 
     def __init__(self, site, group, key, bucket=None, wall_s=0.0,
-                 jaxpr_eqns=None, donated=None, warm=False):
+                 jaxpr_eqns=None, donated=None, warm=False, cost=None):
         self.site = str(site)
         self.group = str(group)      # program FAMILY (fn/model), storms
         self.key = str(key)          # exact specialization key
@@ -67,24 +67,30 @@ class CompileEvent:
         self.jaxpr_eqns = jaxpr_eqns
         self.donated = donated
         self.warm = bool(warm)
+        # round 14: XLA cost_analysis summary captured at AOT sites
+        # (obs/costs.py extract_cost dict: flops / bytes_accessed / HBM
+        # footprint) — the compile event carries WHAT was compiled, the
+        # cost ledger carries how it performs over time
+        self.cost = cost
         self.t = time.time()
 
     def to_dict(self) -> dict:
         return {"site": self.site, "group": self.group, "key": self.key,
                 "bucket": self.bucket, "wall_s": round(self.wall_s, 4),
                 "jaxpr_eqns": self.jaxpr_eqns, "donated": self.donated,
-                "warm": self.warm, "t": self.t}
+                "warm": self.warm, "cost": self.cost, "t": self.t}
 
 
 def record_compile(site: str, group: str, key: str, bucket=None,
                    wall_s: float = 0.0, jaxpr_eqns=None, donated=None,
-                   warm: bool = False) -> CompileEvent:
+                   warm: bool = False, cost=None) -> CompileEvent:
     """Record one compile. Cheap (an append + two counter bumps) and only
     reached on cache MISSES, so the steady-state hot paths never pay it."""
     from . import default_registry, metrics
 
     ev = CompileEvent(site, group, key, bucket=bucket, wall_s=wall_s,
-                      jaxpr_eqns=jaxpr_eqns, donated=donated, warm=warm)
+                      jaxpr_eqns=jaxpr_eqns, donated=donated, warm=warm,
+                      cost=cost)
     _events.append(ev)
     reg = default_registry()
     reg.counter("compiles_total", "compiled programs (any site)",

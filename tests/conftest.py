@@ -56,7 +56,7 @@ QUICK_MODULES = {
     "test_bf16.py", "test_ckpt.py", "test_dispatch_cache.py",
     "test_dist_checkpoint.py",
     "test_distributed_core.py", "test_dy2static.py", "test_flags_doc.py",
-    "test_flagship_perf.py",
+    "test_flagship_perf.py", "test_flight.py",
     "test_generation.py", "test_io.py", "test_jit.py", "test_moe.py",
     "test_native.py", "test_new_packages.py", "test_nn.py", "test_obs.py",
     "test_ops.py",

@@ -1,0 +1,567 @@
+"""Round-14 observability: request flight recorder + compiled-program
+cost attribution (paddle_tpu.obs.flight / obs.costs).
+
+Covers the tentpole contracts end to end: the Perfetto/Chrome-trace
+round trip (a dumped trace re-parses, spans nest inside their request
+windows, and every request's queue_wait + prefill spans tile its TTFT
+BITWISE against the engine's stats()), flight-ring eviction under load,
+the anomaly auto-dump triggers (request timeout, TTFT SLO breach,
+post-warmup compile), the cost ledger (XLA cost_analysis captured at the
+AOT compile sites, roofline_utilization gauges from measured walls), and
+analysis D8's fire/no-fire pair against a cost baseline. Plus the
+round-14 satellites: JSONL log rotation that never tears a line,
+Prometheus exposition escaping, and the README-metric-catalog /
+REQUIRED_* drift gate.
+"""
+import json
+import os
+import re
+import sys
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import obs
+from paddle_tpu.obs import costs as obs_costs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _tiny_llama():
+    from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, max_position_embeddings=64)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _drive(eng, streams, seed=0):
+    rs = np.random.RandomState(seed)
+    for ln, nt in streams:
+        eng.add_request(rs.randint(0, 128, (ln,)), max_new_tokens=nt)
+    return eng.run()
+
+
+# ---------------------------------------------------------- flight trace
+class TestTraceRoundTrip:
+    def test_dump_reparses_and_validates(self, tmp_path):
+        from paddle_tpu.inference.engine import ServingEngine
+
+        eng = ServingEngine(_tiny_llama(), max_slots=2)
+        _drive(eng, ((3, 2), (6, 4), (4, 3)))
+        path = str(tmp_path / "trace.json")
+        assert eng.dump_trace(path) == path
+        obj = json.load(open(path))              # plain JSON re-parse
+        assert obj["traceEvents"]
+        summary = obs.validate_trace(path)       # structural validation
+        assert summary["requests"] == 3
+        assert summary["tiled_requests"] == 3
+        assert summary["engine_spans"] >= 1      # decode ticks recorded
+
+    def test_spans_tile_ttft_bitwise_vs_stats(self, tmp_path):
+        """THE acceptance invariant: per-request queue_wait + prefill
+        spans reproduce the engine's recorded TTFTs bitwise after a JSON
+        round trip (exact seconds ride the span args; json floats
+        round-trip via repr)."""
+        from paddle_tpu.inference.engine import ServingEngine
+
+        eng = ServingEngine(_tiny_llama(), max_slots=2, kv_block_size=8,
+                            num_kv_blocks=6)
+        # the small pool forces an admission block: queue_wait must
+        # absorb that wall, and the tiling must still be exact
+        _drive(eng, ((30, 8), (4, 4)))
+        path = str(tmp_path / "trace.json")
+        eng.dump_trace(path)
+        obj = json.load(open(path))
+        by_tid = {}
+        for e in obj["traceEvents"]:
+            if e.get("ph") == "X":
+                by_tid.setdefault(e["tid"], {})[e["name"]] = e["args"]
+        trace_ttfts = []
+        for tid, spans in by_tid.items():
+            if "queue_wait" in spans and "prefill" in spans:
+                assert spans["queue_wait"]["t1_s"] == \
+                    spans["prefill"]["t0_s"]          # contiguous
+                trace_ttfts.append(spans["prefill"]["t1_s"]
+                                   - spans["queue_wait"]["t0_s"])
+        st = eng.stats()
+        assert sorted(trace_ttfts) == sorted(st["ttft_s"])  # BITWISE
+        # and the queue-wait spans are the stats() queue waits, bitwise
+        trace_qw = [s["queue_wait"]["t1_s"] - s["queue_wait"]["t0_s"]
+                    for s in by_tid.values() if "queue_wait" in s]
+        assert sorted(trace_qw) == sorted(st["queue_wait_s"])
+
+    def test_chunk_spans_nest_inside_prefill(self, tmp_path):
+        from paddle_tpu.inference.engine import ServingEngine
+
+        eng = ServingEngine(_tiny_llama(), max_slots=2,
+                            chunked_prefill_tokens=8)
+        _drive(eng, ((20, 3),))
+        path = str(tmp_path / "trace.json")
+        eng.dump_trace(path)
+        obj = json.load(open(path))
+        chunks, prefill = [], None
+        for e in obj["traceEvents"]:
+            if e.get("ph") != "X":
+                continue
+            if e["name"] == "prefill_chunk":
+                chunks.append(e["args"])
+            elif e["name"] == "prefill":
+                prefill = e["args"]
+        assert len(chunks) >= 2 and prefill is not None
+        for c in chunks:
+            assert prefill["t0_s"] <= c["t0_s"] and \
+                c["t1_s"] <= prefill["t1_s"]
+        assert prefill["chunks"] == len(chunks)
+
+    def test_tiling_violation_raises_on_dump(self):
+        """'Asserted, not assumed': corrupt one flight's recorded ttft
+        and dump_trace must refuse."""
+        from paddle_tpu.inference.engine import ServingEngine
+
+        eng = ServingEngine(_tiny_llama(), max_slots=1)
+        _drive(eng, ((3, 2),))
+        fl = list(eng.flight._flights.values())[0]
+        fl.ttft_s = fl.ttft_s + 1e-9
+        with pytest.raises(AssertionError):
+            eng.flight.to_chrome()
+
+
+class TestFlightRing:
+    def test_ring_eviction_under_load(self, tmp_path):
+        from paddle_tpu.inference.engine import ServingEngine
+
+        paddle.set_flags({"FLAGS_obs_flight_requests": 4})
+        try:
+            eng = ServingEngine(_tiny_llama(), max_slots=2)
+        finally:
+            paddle.set_flags({"FLAGS_obs_flight_requests": 256})
+        _drive(eng, tuple((3 + (i % 3), 2) for i in range(10)))
+        assert len(eng.completed) == 10
+        held = eng.flight._flights
+        assert len(held) <= 4
+        assert eng.flight.evicted == 10 - len(held)
+        # the SURVIVORS are the newest finishes; rid 0 evicted first
+        assert 0 not in held
+        # the ring still dumps/validates after churn
+        path = str(tmp_path / "trace.json")
+        eng.dump_trace(path)
+        assert obs.validate_trace(path)["requests"] == len(held)
+        # the gauge mirrors the ring
+        snap = eng.metrics()
+        assert snap["serving_flight_requests"]["samples"][0]["value"] \
+            == len(held)
+
+    def test_active_requests_never_evicted(self):
+        rec = obs.FlightRecorder(capacity=2)
+        for rid in range(5):
+            rec.begin(rid, 4, 4, float(rid))
+        for rid in range(3):                 # 3 finish, 2 stay active
+            rec.finish(rid, 10.0 + rid, "length")
+        assert 3 in rec._flights and 4 in rec._flights   # active kept
+        assert len([r for r in rec._flights
+                    if rec._flights[r].finished]) <= 2
+
+    def test_per_flight_span_cap(self):
+        rec = obs.FlightRecorder(capacity=4)
+        fl = rec.begin(0, 4, 4, 0.0)
+        for i in range(700):
+            fl.add_span("s", float(i), float(i) + 0.5)
+        from paddle_tpu.obs.flight import REQUEST_SPAN_CAP
+
+        assert len(fl.spans) == REQUEST_SPAN_CAP
+        assert fl.spans_dropped == 700 - REQUEST_SPAN_CAP
+
+
+# ------------------------------------------------------ anomaly triggers
+class TestAnomalyAutoDump:
+    def _counter(self, eng, name, trigger):
+        snap = eng.metrics()
+        for s in snap[name]["samples"]:
+            if s.get("labels", {}).get("trigger") == trigger:
+                return s["value"]
+        return 0
+
+    def test_timeout_auto_dumps(self, tmp_path):
+        from paddle_tpu.inference.engine import ServingEngine
+
+        d = str(tmp_path / "dumps")
+        paddle.set_flags({"FLAGS_obs_flight_dir": d})
+        try:
+            eng = ServingEngine(_tiny_llama(), max_slots=1)
+            rs = np.random.RandomState(0)
+            # 1ms deadline: even fully warmed, 40 decode ticks cannot
+            # beat it — the timeout is deterministic cold or warm
+            eng.add_request(rs.randint(0, 128, (4,)), max_new_tokens=40,
+                            max_time_ms=1.0)
+            eng.run()
+        finally:
+            paddle.set_flags({"FLAGS_obs_flight_dir": ""})
+        assert eng.finish_reasons[0] == "timeout"
+        assert self._counter(eng, "serving_flight_anomalies_total",
+                             "timeout") >= 1
+        dumps = [f for f in os.listdir(d) if f.startswith("flight_timeout")]
+        assert dumps, "timeout did not auto-dump a postmortem"
+        assert self._counter(eng, "serving_flight_dumps_total",
+                             "timeout") == len(dumps)
+        summary = obs.validate_trace(os.path.join(d, dumps[0]))
+        assert summary["requests"] >= 1
+
+    def test_post_warmup_compile_auto_dumps(self, tmp_path):
+        from paddle_tpu.inference import engine as eng_mod
+        from paddle_tpu.inference.engine import ServingEngine
+
+        d = str(tmp_path / "dumps")
+        paddle.set_flags({"FLAGS_obs_flight_dir": d})
+        try:
+            eng = ServingEngine(_tiny_llama(), max_slots=2)
+            _drive(eng, ((3, 2),))
+            eng.finish_warmup()
+            obs.clear_events()
+            saved = set(eng_mod._SEEN_SERVING_PROGRAMS)
+            eng_mod._SEEN_SERVING_PROGRAMS.clear()
+            try:
+                _drive(eng, ((3, 2),), seed=1)
+            finally:
+                eng_mod._SEEN_SERVING_PROGRAMS.update(saved)
+                obs.clear_events()
+        finally:
+            paddle.set_flags({"FLAGS_obs_flight_dir": ""})
+        assert self._counter(eng, "serving_flight_anomalies_total",
+                             "post_warmup_compile") >= 1
+        assert any(f.startswith("flight_post_warmup_compile")
+                   for f in os.listdir(d))
+
+    def test_slo_breach_counts_and_dumps(self, tmp_path):
+        from paddle_tpu.inference.engine import ServingEngine
+
+        d = str(tmp_path / "dumps")
+        paddle.set_flags({"FLAGS_obs_flight_dir": d,
+                          "FLAGS_obs_slo_ttft_ms": 0.001})
+        try:
+            eng = ServingEngine(_tiny_llama(), max_slots=1)
+            _drive(eng, ((3, 2),))
+        finally:
+            paddle.set_flags({"FLAGS_obs_flight_dir": "",
+                              "FLAGS_obs_slo_ttft_ms": 0.0})
+        assert self._counter(eng, "serving_flight_anomalies_total",
+                             "slo_breach") >= 1
+        assert any(f.startswith("flight_slo_breach")
+                   for f in os.listdir(d))
+
+    def test_no_dump_when_dir_unset(self, tmp_path):
+        """No-fire direction: anomalies count, nothing is written."""
+        from paddle_tpu.inference.engine import ServingEngine
+
+        eng = ServingEngine(_tiny_llama(), max_slots=1)
+        rs = np.random.RandomState(0)
+        eng.add_request(rs.randint(0, 128, (4,)), max_new_tokens=40,
+                        max_time_ms=1.0)
+        eng.run()
+        assert self._counter(eng, "serving_flight_anomalies_total",
+                             "timeout") >= 1
+        assert self._counter(eng, "serving_flight_dumps_total",
+                             "timeout") == 0
+        assert eng.flight.autodumps == 0
+
+
+# ------------------------------------------------------------ cost ledger
+def _stub_compiled(flops=1000.0, bytes_accessed=2000.0, arg=100, out=50,
+                   temp=25, alias=0):
+    return SimpleNamespace(
+        cost_analysis=lambda: [{"flops": flops,
+                                "bytes accessed": bytes_accessed}],
+        memory_analysis=lambda: SimpleNamespace(
+            argument_size_in_bytes=arg, output_size_in_bytes=out,
+            temp_size_in_bytes=temp, alias_size_in_bytes=alias))
+
+
+class TestCostLedger:
+    def test_extract_cost_from_compiled(self):
+        c = obs.extract_cost(_stub_compiled(alias=50))
+        assert c["flops"] == 1000.0 and c["bytes_accessed"] == 2000.0
+        # aliased (donated) output bytes don't double-count in the peak
+        assert c["peak_hbm_bytes"] == 100 + 0 + 25
+
+    def test_record_and_observe_sets_roofline_gauge(self):
+        e = obs_costs.record_program("t14a", "g", "k1",
+                                     compiled=_stub_compiled())
+        assert e.analyzed
+        util = e.observe(wall_s=0.001)
+        assert util == pytest.approx(
+            2000.0 / (0.001 * obs.peak_gbps() * 1e9))
+        g = obs.default_registry().get("roofline_utilization")
+        assert g is not None
+        assert dict(g.samples())[("t14a|k1",)].value == pytest.approx(util)
+        assert e.achieved_gbps() == pytest.approx(2000.0 / 0.001 / 1e9)
+
+    def test_record_idempotent_and_reset(self):
+        e1 = obs_costs.record_program("t14b", "g", "k1",
+                                      compiled=_stub_compiled())
+        e2 = obs_costs.record_program("t14b", "g", "k1")
+        assert e1 is e2                       # analysis survives re-record
+        e1.observe(0.01)
+        assert e1.exec_count == 1
+        obs.reset_exec_stats()
+        assert e1.exec_count == 0 and e1.analyzed
+
+    def test_engine_populates_ledger(self):
+        from paddle_tpu.inference.engine import ServingEngine
+
+        eng = ServingEngine(_tiny_llama(), max_slots=2)
+        _drive(eng, ((3, 2), (6, 3)))
+        dec = [e for e in obs.ledger("serving.decode")
+               if e.exec_count > 0]
+        assert dec, "decode programs missing from the cost ledger"
+        for e in dec:
+            assert e.analyzed and e.bytes_accessed > 0
+            assert e.utilization() is not None
+        # prefill too, and the rows are JSON-able for bench attachment
+        assert any(e.site == "serving.prefill" and e.analyzed
+                   for e in obs.ledger("serving"))
+        json.dumps(obs.roofline_rows("serving"))
+
+    def test_generate_site_captures_costs(self):
+        m = _tiny_llama()
+        ids = paddle.to_tensor(
+            np.random.RandomState(3).randint(0, 128, (1, 5))
+            .astype("int64"))
+        m.generate(ids, max_new_tokens=3)
+        gen = [e for e in obs.ledger("generate") if e.exec_count > 0]
+        assert gen and all(e.analyzed for e in gen)
+
+
+class TestCostRegressionsD8:
+    def _entries(self, bytes_accessed):
+        obs_costs.record_program("t14d8", "g", f"b{bytes_accessed}",
+                                 compiled=_stub_compiled(
+                                     bytes_accessed=bytes_accessed))
+        return [e for e in obs.ledger("t14d8")
+                if e.key == f"b{bytes_accessed}"]
+
+    def test_growth_past_threshold_fires(self):
+        entries = self._entries(1500.0)
+        base = {"threshold_pct": 25.0,
+                "programs": {entries[0].program:
+                             {"bytes_accessed": 1000.0}}}
+        fs = obs.audit_cost_regressions(base, entries=entries)
+        warn = [f for f in fs if f.severity == "warning"]
+        assert len(warn) == 1 and "grew" in warn[0].message
+        assert warn[0].data["growth_pct"] == pytest.approx(50.0)
+
+    def test_within_threshold_no_fire(self):
+        entries = self._entries(1100.0)
+        base = {"threshold_pct": 25.0,
+                "programs": {entries[0].program:
+                             {"bytes_accessed": 1000.0}}}
+        fs = obs.audit_cost_regressions(base, entries=entries)
+        assert not [f for f in fs if f.severity != "note"], fs
+        assert any("within" in f.message for f in fs)
+
+    def test_missing_and_new_programs_are_notes(self):
+        entries = self._entries(500.0)
+        base = {"threshold_pct": 25.0,
+                "programs": {"t14d8|ghost": {"bytes_accessed": 1000.0}}}
+        fs = obs.audit_cost_regressions(base, entries=entries)
+        assert not [f for f in fs if f.severity != "note"]
+        msgs = " ".join(f.message for f in fs)
+        assert "not compiled this run" in msgs
+        assert "not in the baseline" in msgs
+
+    def test_write_load_baseline_round_trip(self, tmp_path):
+        obs_costs.record_program(
+            "serving.test14", "g", "kk",
+            compiled=_stub_compiled(bytes_accessed=4321.0))
+        path = str(tmp_path / "base.json")
+        base = obs.write_baseline(path, site="serving.test14")
+        again = obs_costs.load_baseline(path)
+        assert again["programs"] == base["programs"]
+        assert again["programs"]["serving.test14|kk"]["bytes_accessed"] \
+            == 4321.0
+        # the committed repo baseline parses and gates the serving smoke
+        repo_base = obs_costs.load_baseline(
+            os.path.join(REPO, "tools", "cost_baseline.json"))
+        assert repo_base["programs"], "committed cost baseline is empty"
+        assert all(p.startswith("serving") for p in repo_base["programs"])
+
+
+# -------------------------------------------------- satellite: rotation
+class TestJsonlRotation:
+    def test_rollover_never_tears_a_line(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        paddle.set_flags({"FLAGS_obs_log_path": path,
+                          "FLAGS_obs_log_max_mb": 1,
+                          "FLAGS_obs_log_backups": 2})
+        pad = "x" * 1024
+        try:
+            for i in range(2600):            # ~2.6 MB over a 1 MB cap
+                assert obs.log_event("rot", i=i, pad=pad)
+        finally:
+            paddle.set_flags({"FLAGS_obs_log_path": "",
+                              "FLAGS_obs_log_max_mb": 64,
+                              "FLAGS_obs_log_backups": 3})
+        # oldest-first read order: .2 (oldest roll) -> .1 -> live file
+        files = [path + ".2", path + ".1", path]
+        assert all(os.path.exists(f) for f in files)
+        assert not os.path.exists(path + ".3")   # oldest deleted
+        cap = 1024 * 1024
+        seen = []
+        for f in files:
+            body = open(f).read()
+            assert os.path.getsize(f) <= cap + 2048  # one record of slack
+            for ln in body.splitlines():
+                rec = json.loads(ln)             # NO torn lines anywhere
+                seen.append(rec["i"])
+        # retained records are contiguous-from-the-tail (rotation drops
+        # whole oldest files, never individual or partial lines)
+        assert seen == list(range(2600 - len(seen), 2600))
+
+    def test_cap_zero_never_rotates(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        paddle.set_flags({"FLAGS_obs_log_path": path,
+                          "FLAGS_obs_log_max_mb": 0})
+        try:
+            for i in range(50):
+                obs.log_event("rot", i=i)
+        finally:
+            paddle.set_flags({"FLAGS_obs_log_path": "",
+                              "FLAGS_obs_log_max_mb": 64})
+        assert not os.path.exists(path + ".1")
+        assert len(open(path).readlines()) == 50
+
+
+# ------------------------------------------------- satellite: exposition
+class TestPrometheusEscaping:
+    def test_label_escaping_fires(self):
+        r = obs.Registry("esc")
+        r.counter("c_total", "", ("p",)).labels('a\\b"c\nd').inc()
+        text = r.render_prometheus()
+        # per the text-format spec: \ -> \\, " -> \", newline -> \n,
+        # all on ONE physical line
+        assert r'p="a\\b\"c\nd"' in text
+        assert len([ln for ln in text.splitlines()
+                    if ln.startswith("esc_c_total")]) == 1
+
+    def test_plain_values_untouched(self):
+        r = obs.Registry("esc")
+        r.counter("c_total", "", ("p",)).labels("plain-1.2_x").inc()
+        assert 'p="plain-1.2_x"' in r.render_prometheus()
+
+    def test_help_line_escapes_doc(self):
+        r = obs.Registry("esc")
+        r.counter("c_total", "multi\nline \\ doc").inc()
+        text = r.render_prometheus()
+        help_lines = [ln for ln in text.splitlines()
+                      if ln.startswith("# HELP")]
+        assert help_lines == [r"# HELP esc_c_total multi\nline \\ doc"]
+        # every line of the exposition stays structurally parseable
+        for ln in text.splitlines():
+            assert ln.startswith("#") or re.match(
+                r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? \S+$", ln), ln
+
+    def test_special_float_spelling(self):
+        r = obs.Registry("esc")
+        r.gauge("g", "").set(float("nan"))
+        r.gauge("h", "").set(float("inf"))
+        text = r.render_prometheus()
+        assert "esc_g NaN" in text and "esc_h +Inf" in text
+
+
+# ------------------------------------------- doc/registry drift meta-test
+class TestMetricCatalogDrift:
+    def _catalog_names(self):
+        readme = open(os.path.join(REPO, "README.md")).read()
+        sec = readme.split("## Observability", 1)[1].split("\n## ", 1)[0]
+        names = set()
+        for ln in sec.splitlines():
+            if not ln.startswith("| `"):
+                continue
+            first_cell = ln.split("|")[1]
+            for tok in re.findall(r"`([a-z][a-z0-9_]*)(?:\{[^}]*\})?`",
+                                  first_cell):
+                names.add(tok)
+        return names
+
+    def test_every_catalog_row_is_required(self):
+        """Doc -> registry: a metric the README catalog documents must be
+        in a REQUIRED_* set, so the obs lint smoke enforces its
+        existence — no documented-but-unenforced metrics."""
+        from graft_lint import (REQUIRED_CKPT_METRICS,
+                                REQUIRED_DEFAULT_METRICS,
+                                REQUIRED_SERVING_METRICS)
+
+        known = set(REQUIRED_SERVING_METRICS) \
+            | set(REQUIRED_CKPT_METRICS) | set(REQUIRED_DEFAULT_METRICS)
+        missing = sorted(self._catalog_names() - known)
+        assert not missing, (
+            "README metric catalog documents metrics no REQUIRED_* set "
+            f"enforces: {missing} — add them to the graft_lint contract "
+            "or drop the rows")
+
+    def test_every_required_metric_is_documented(self):
+        """Registry -> doc: the enforced serving/default sets must appear
+        in the catalog (drift in the other direction)."""
+        from graft_lint import (REQUIRED_DEFAULT_METRICS,
+                                REQUIRED_SERVING_METRICS)
+
+        names = self._catalog_names()
+        undocumented = sorted(
+            (set(REQUIRED_SERVING_METRICS)
+             | set(REQUIRED_DEFAULT_METRICS)) - names)
+        assert not undocumented, (
+            f"REQUIRED metrics missing from the README catalog: "
+            f"{undocumented}")
+
+
+class TestReviewRegressions:
+    def test_midflight_dump_window_covers_chunk_spans(self):
+        """A postmortem dumped while a request is still prefilling
+        (admitted, no first token yet) carries chunk spans and marks
+        PAST its last lifecycle timestamp — the request window must
+        stretch to cover them, or validate_trace rejects the recorder's
+        own anomaly dump ("span escapes its request window")."""
+        rec = obs.FlightRecorder(capacity=8)
+        fl = rec.begin(0, 64, 8, 100.0)
+        fl.admitted_s = 100.5
+        fl.add_span("prefill_chunk", 100.6, 101.2, {"start": 0})
+        fl.add_mark("admission_blocked", 101.3)
+        doc = rec.to_chrome()
+        summary = obs.validate_trace(doc)
+        assert summary["requests"] == 1
+        req = [e for e in doc["traceEvents"]
+               if e.get("ph") == "X" and e["name"] == "request"][0]
+        assert req["args"]["t1_s"] >= 101.3
+
+    def test_nonfinal_chunk_wall_is_synced(self, monkeypatch):
+        """Non-final prefill chunks fetch no token, so the chunk wall
+        must block on the written cache before observe() — otherwise
+        async dispatch makes roofline_utilization and the prefill_chunk
+        span durations enqueue-time artifacts."""
+        import jax
+
+        from paddle_tpu.inference.engine import ServingEngine
+
+        calls = []
+        real = jax.block_until_ready
+        monkeypatch.setattr(jax, "block_until_ready",
+                            lambda x: (calls.append(1), real(x))[1])
+        eng = ServingEngine(_tiny_llama(), max_slots=1,
+                            chunked_prefill_tokens=16)
+        p = np.random.RandomState(3).randint(0, 128, (50,))
+        eng.add_request(p, max_new_tokens=2)
+        eng.run()
+        assert eng.stats()["prefill_chunks"] == 4
+        assert len(calls) >= 3      # one barrier per NON-final chunk
+
+
+def test_quick_tier_registration():
+    """test_flight.py must ride the quick tier (conftest QUICK_MODULES)."""
+    import conftest
+
+    assert "test_flight.py" in conftest.QUICK_MODULES

@@ -258,33 +258,35 @@ class TestBufVersionCache:
 
     def test_prompt_bucketing_compile_count(self):
         """ADVICE r4: distinct prompt lengths within one bucket must share
-        one compiled program (docstring contract: O(log S) compiles)."""
-        from paddle_tpu.text.generation import _generate_program
+        one compiled program (docstring contract: O(log S) compiles).
+        Round 14: generate() owns its executables (AOT cache), so the
+        count IS the executable-cache growth."""
+        from paddle_tpu.text.generation import _gen_executables
 
         m = _tiny()
         rs = np.random.RandomState(11)
-        misses0 = _generate_program._cache_size()
+        misses0 = len(_gen_executables)
         for ln in (9, 10, 12, 14):  # all bucket to 16
             p = rs.randint(0, 128, (1, ln)).astype("int64")
             out = m.generate(paddle.to_tensor(p), max_new_tokens=2)
             assert out.shape[1] == ln + 2
-        assert _generate_program._cache_size() - misses0 <= 1
+        assert len(_gen_executables) - misses0 <= 1
 
     def test_generation_length_bucketing_compile_count(self):
         """Round-10 satellite: _GenSpec used to key a fresh program per
         EXACT max_new_tokens; generation lengths now bucket via
         jit.default_buckets (the tail is trimmed), so varied lengths
         within one bucket share one compiled program."""
-        from paddle_tpu.text.generation import _generate_program
+        from paddle_tpu.text.generation import _gen_executables
 
         m = _tiny()
         rs = np.random.RandomState(13)
         p = rs.randint(0, 128, (1, 5)).astype("int64")
-        misses0 = _generate_program._cache_size()
+        misses0 = len(_gen_executables)
         for mnt in (5, 6, 7, 8):  # all bucket to 8
             out = m.generate(paddle.to_tensor(p), max_new_tokens=mnt)
             assert out.shape[1] == 5 + mnt  # exact requested length
-        assert _generate_program._cache_size() - misses0 <= 1
+        assert len(_gen_executables) - misses0 <= 1
 
     def test_bucketed_length_prefix_consistent(self):
         """Tokens [0, mnt) must not change when the program runs extra

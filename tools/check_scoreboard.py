@@ -178,13 +178,28 @@ def lint_gate(models="llama,gpt,bert,paged,obs,ckpt", timeout=900):
     watchdog must see zero post-warmup retraces; round-12 adds the `ckpt`
     crash-consistency smoke — save → bit-flip → restore must fall back to
     the last good checkpoint, and the required ckpt metric rows must
-    exist): the AST lint plus the
+    exist; round-14 extends `obs` with the flight-recorder/cost gate —
+    the warmed engine must dump a valid Perfetto trace whose request
+    spans tile TTFT, every driven decode bucket must carry XLA costs,
+    and analysis D8 gates per-program bytes-accessed against the
+    committed tools/cost_baseline.json): the AST lint plus the
     jaxpr program audits over the model smoke configs must come back
     clean (no unsuppressed warning/error past tools/lint_baseline.json).
     Runs the CLI in a subprocess so its jax session / flag flips can't
     leak into the caller. Returns failure strings (empty = clean)."""
     import subprocess
 
+    # D8 prerequisite: the committed baseline must exist BEFORE the
+    # subprocess runs — a deleted/unparseable baseline is a named gate
+    # failure here, not a confusing downstream lint error
+    baseline = os.path.join(REPO, "tools", "cost_baseline.json")
+    try:
+        with open(baseline) as fh:
+            json.load(fh)
+    except (OSError, ValueError) as e:
+        return [f"LINT: tools/cost_baseline.json missing/unparseable "
+                f"({e}) — analysis D8 cannot gate; regenerate with "
+                "tools/roofline_report.py --write-baseline"]
     cmd = [sys.executable, os.path.join(REPO, "tools", "graft_lint.py"),
            "--models", models, "--json"]
     env = dict(os.environ)

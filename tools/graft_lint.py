@@ -26,6 +26,12 @@ requests, and the gate fails if required serving metrics are missing or
 the compile watchdog saw a post-warmup retrace / recompile storm
 (obs/watchdog.py audit_recompiles). It also drives one checkpoint
 save/restore cycle and requires the REQUIRED_CKPT_METRICS rows.
+Round 14 extends it with the flight-recorder/cost contract: the warmed
+engine must dump a VALID Chrome-trace/Perfetto JSON (per-request spans
+tiling the TTFT decomposition), every decode bucket it drove must have
+an analyzed obs cost-ledger row (XLA bytes/flops + measured walls), and
+analysis D8 (audit_cost_regressions) gates per-program bytes-accessed
+against the committed tools/cost_baseline.json.
 
 The special model name `ckpt` (round 12) smokes crash consistency
 end-to-end: a tiny model + AdamW trains, checkpoints twice, the NEWEST
@@ -207,7 +213,26 @@ REQUIRED_SERVING_METRICS = (
     "serving_prefix_blocks_hit_total", "serving_prefix_blocks_missed_total",
     "serving_prefill_chunks_total", "serving_prefix_cache_blocks",
     "serving_prefix_cache_referenced_blocks",
-    "serving_prefix_cache_evictions_total")
+    "serving_prefix_cache_evictions_total",
+    # round 14: flight recorder
+    "serving_flight_anomalies_total", "serving_flight_dumps_total",
+    "serving_flight_requests")
+
+#: process-default-registry rows the README "process-default registry"
+#: catalog names (compile watchdog + cost attribution). The meta-test in
+#: tests/test_flight.py pins README catalog rows to the REQUIRED_* sets;
+#: post_warmup_compiles_total only materializes on an anomaly, so the
+#: obs smoke's existence check uses the MUST_EXIST subset below.
+REQUIRED_DEFAULT_METRICS = (
+    "compiles_total", "compile_seconds", "post_warmup_compiles_total",
+    "roofline_utilization")
+
+MUST_EXIST_DEFAULT_METRICS = (
+    "compiles_total", "compile_seconds", "roofline_utilization")
+
+#: committed analysis-D8 baseline (per-program bytes-accessed from the
+#: obs smoke's tiny serving engine)
+COST_BASELINE = os.path.join(REPO, "tools", "cost_baseline.json")
 
 #: checkpoint metric rows the obs smoke requires in the DEFAULT registry
 #: after one save/restore cycle (the round-12 fault-tolerance contract)
@@ -278,6 +303,76 @@ def audit_obs() -> list:
     evs = [e for e in obs.compile_events()
            if e.site.startswith("serving") or e.site == "generate"]
     findings += obs.audit_recompiles(evs, loc="obs/serving-smoke")
+
+    # ---- flight recorder + cost attribution (round 14): the warmed run
+    # must dump a VALID Perfetto trace (per-request spans tiling TTFT)
+    # and every decode bucket it drove must have an ANALYZED cost-ledger
+    # row (XLA bytes/flops) with measured execution walls; D8 then gates
+    # those bytes against the committed baseline.
+    import tempfile
+
+    from paddle_tpu.obs import costs as obs_costs
+
+    fd, tpath = tempfile.mkstemp(prefix="graft_lint_trace_",
+                                 suffix=".json")
+    os.close(fd)
+    summary = None
+    try:
+        eng.dump_trace(tpath)
+        summary = obs.validate_trace(tpath)
+    except (AssertionError, ValueError) as e:
+        findings.append(analysis.Finding(
+            "obs-flight", "error", "obs/flight-smoke",
+            f"serving trace dump failed validation: {e}"))
+    finally:
+        os.unlink(tpath)
+    if summary is not None:
+        done = len(eng.completed)
+        if summary["tiled_requests"] < done or not summary["events"]:
+            findings.append(analysis.Finding(
+                "obs-flight", "error", "obs/flight-smoke",
+                f"trace dump degraded: {summary['tiled_requests']} "
+                f"TTFT-tiled request timelines for {done} completed "
+                f"requests ({summary['events']} events)",
+                data=summary))
+        else:
+            findings.append(analysis.Finding(
+                "obs-flight", "note", "obs/flight-smoke",
+                f"trace dump valid: {summary['events']} events, "
+                f"{summary['tiled_requests']}/{done} requests TTFT-tiled",
+                data=summary))
+    driven = [e for e in obs_costs.ledger("serving.decode")
+              if e.exec_count > 0]
+    unanalyzed = [e.program for e in driven if not e.analyzed]
+    if not driven or unanalyzed:
+        findings.append(analysis.Finding(
+            "obs-cost", "error", "obs/cost-smoke",
+            "cost ledger lost decode coverage — "
+            + (f"no measured serving.decode programs" if not driven else
+               f"programs without XLA cost analysis: {unanalyzed}"),
+            data={"driven": [e.program for e in driven],
+                  "unanalyzed": unanalyzed}))
+    else:
+        findings.append(analysis.Finding(
+            "obs-cost", "note", "obs/cost-smoke",
+            f"{len(driven)} decode program(s) carry XLA costs + measured "
+            f"walls (buckets {sorted(e.bucket for e in driven)})"))
+    snap_def = obs.default_registry().to_dict()
+    missing_def = [m for m in MUST_EXIST_DEFAULT_METRICS
+                   if m not in snap_def]
+    if missing_def:
+        findings.append(analysis.Finding(
+            "obs-coverage", "error", "obs/default-registry",
+            f"default registry lost required metrics: {missing_def}",
+            data={"missing": missing_def}))
+    if not os.path.exists(COST_BASELINE):
+        findings.append(analysis.Finding(
+            "cost-regression", "error", "obs/cost-smoke",
+            "tools/cost_baseline.json is missing — D8 cannot gate; "
+            "regenerate with tools/roofline_report.py --write-baseline"))
+    else:
+        findings += analysis.audit_cost_regressions(
+            COST_BASELINE, loc="obs/cost-smoke")
 
     # the ckpt row (round 12): one save/restore cycle must land every
     # REQUIRED_CKPT_METRICS entry in the default registry
