@@ -6,7 +6,14 @@ programs and (b) the framework's own source, emitting structured
 and gated in CI via tools/check_scoreboard.py; per-detector fixture tests
 live in tests/test_analysis.py.
 
-Jaxpr detectors (jaxpr_audit, vmem):
+Round 15: the jaxpr detectors are passes over ONE shared dataflow index
+(`dataflow.ProgramIndex` — a single walk building producer/consumer
+maps, per-var shape/dtype/size/sharding/provenance, meshes, collectives
+and transfers; sub-jaxpr recursion knows pjit/shard_map/scan/cond/
+custom_vjp and deliberately stops at pallas_call bodies). Every detector
+accepts a ClosedJaxpr or a prebuilt ProgramIndex.
+
+Jaxpr detectors (jaxpr_audit, vmem, spmd):
   D1 audit_dtype_stream   f32 residual-stream tensors / silent bf16->f32
                           promotions under the bf16 stream policy
   D2 audit_donation       train-step mutated captures not donated (+bytes)
@@ -18,6 +25,16 @@ Jaxpr detectors (jaxpr_audit, vmem):
   D5 audit_tune_cache     flash autotune entries / norm + paged-decode
      audit_norm_config    launch configs whose static VMEM estimate busts
      audit_decode_config  the per-core budget
+  D9 audit_sharding_coverage  under a declared or jaxpr-recovered mesh,
+                          stream-size tensors unsharded/replicated along
+                          a mesh axis fail lint (spmd.py, round 15)
+  D10 audit_collectives   every jaxpr-level collective attributed to its
+                          mesh axis with byte volume; an all-gather whose
+                          output only feeds elementwise/slice ops is the
+                          "accidental all-gather" warning; per-program
+                          totals land in the obs cost ledger
+  D11 audit_transfers     device_put / host round-trips inside a
+                          compiled program
 
 AST rules (ast_lint): x64 toggles outside ops/_pallas_common.py, custom_vjp
 residuals wider than their declared `# vjp-saves:`, flags missing from the
@@ -49,13 +66,16 @@ here because its output is Findings):
 """
 from .ast_lint import (audit_flags_doc, lint_dy2static, lint_file,
                        lint_tree, lint_vjp_saves, lint_x64)
+from .dataflow import ProgramIndex, build_index
 from .findings import (Finding, apply_baseline, format_text, gate_failures,
-                       load_baseline, to_json)
+                       load_baseline, stale_suppressions, to_json)
 from .jaxpr_audit import (audit_callbacks, audit_compiled,
                           audit_donation, audit_dtype_stream,
                           audit_fusion_misses, audit_host_sync,
                           infer_stream_shapes, iter_eqns, iter_jaxprs)
 from .serving import audit_prefix_cache
+from .spmd import (audit_collectives, audit_sharding_coverage, audit_spmd,
+                   audit_transfers, jaxpr_collective_bytes)
 from .vmem import (audit_decode_config, audit_norm_config,
                    audit_tune_cache, decode_vmem_bytes, flash_vmem_bytes,
                    norm_vmem_bytes)
@@ -82,10 +102,13 @@ def audit_cost_regressions(baseline, entries=None, threshold_pct=None,
 __all__ = [
     "audit_recompiles", "audit_prefix_cache", "audit_cost_regressions",
     "Finding", "apply_baseline", "format_text", "gate_failures",
-    "load_baseline", "to_json",
+    "load_baseline", "stale_suppressions", "to_json",
+    "ProgramIndex", "build_index",
     "audit_callbacks", "audit_compiled", "audit_donation",
     "audit_dtype_stream", "audit_fusion_misses", "audit_host_sync",
     "infer_stream_shapes", "iter_eqns", "iter_jaxprs",
+    "audit_collectives", "audit_sharding_coverage", "audit_spmd",
+    "audit_transfers", "jaxpr_collective_bytes",
     "audit_decode_config", "audit_norm_config", "audit_tune_cache",
     "decode_vmem_bytes", "flash_vmem_bytes", "norm_vmem_bytes",
     "audit_flags_doc", "lint_dy2static", "lint_file", "lint_tree",

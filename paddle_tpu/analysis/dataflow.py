@@ -1,0 +1,392 @@
+"""Unified single-walk jaxpr dataflow framework — the ProgramIndex.
+
+Before round 15 every jaxpr detector re-walked the program privately:
+D1 iterated every eqn to count stream shapes, D4 rebuilt a
+consumer/producer index per sub-jaxpr, the callback scan iterated again,
+and none of them could see shardings or collectives at all. The
+ProgramIndex is ONE pass over a captured program that builds everything
+the detectors ask for:
+
+  * the sub-jaxpr walk (pjit / shard_map / scan / while / cond /
+    custom_vjp / remat bodies, found generically by scanning eqn params
+    for jaxpr-shaped objects) with an EXPLICIT stop-list: `pallas_call`
+    bodies are the fused implementation itself and are never descended
+    into. Which higher-order primitives were entered vs stopped is
+    recorded (``hop_entered`` / ``hop_stopped``) so a meta-test can
+    assert no call-like primitive silently hides eqns from the
+    detectors.
+  * per-level producer/consumer maps (pattern matchers chase dataflow
+    edges within one jaxpr level, exactly the scoping the pre-round-15
+    detectors used) plus a global eqns-by-primitive table.
+  * per-var abstract values — shape / dtype / size / best-known
+    sharding / provenance path — via :meth:`ProgramIndex.var_info`.
+  * SPMD facts: shardings recovered from ``sharding_constraint`` /
+    ``device_put`` / ``shard_map`` eqns, every mesh axis those mention
+    (``mesh_axes``), every collective eqn with its axes and per-device
+    byte volume (``collectives``), and every ``device_put`` site
+    (``transfers``) — the raw material of detectors D9–D11
+    (analysis/spmd.py).
+  * stream-shape inference shared by D1 and D9: repeated (>= 3 times)
+    activation shapes of rank >= 3 per dtype.
+
+Detectors accept either a ClosedJaxpr or an already-built ProgramIndex
+(``ProgramIndex.ensure``), so `audit_compiled` walks each compiled
+specialization ONCE and every pass reads the same index.
+
+The walk order is pinned to the pre-round-15 ``iter_jaxprs`` order
+(DFS, LIFO over each level's eqns) so the refactored detectors emit
+byte-identical findings — tests/test_analysis.py compares them against
+the frozen legacy implementation in tests/_legacy_jaxpr_audit.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+#: primitives whose sub-jaxprs the walk never descends into: a pallas
+#: kernel body is the fused implementation itself — its internal f32
+#: VMEM accumulation is exactly what the bf16-stream policy permits, and
+#: its rsqrt IS the fused norm, not a missed one.
+STOP_PRIMS = frozenset({"pallas_call"})
+
+#: jaxpr-level collective primitives (shard_map / pmap bodies and
+#: explicit lax collectives; GSPMD-inserted collectives live in HLO, not
+#: the jaxpr — D10 documents that boundary)
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "all_gather", "reduce_scatter", "ppermute",
+    "all_to_all", "pgather", "reduce_precision_psum"})
+
+
+def _closed(j):
+    """Normalize Jaxpr/ClosedJaxpr to the raw Jaxpr."""
+    return getattr(j, "jaxpr", j)
+
+
+def _sub_jaxprs(params: dict):
+    """Every jaxpr nested in an eqn's params (pjit jaxpr, cond branches,
+    while cond/body, scan jaxpr, custom_vjp fun_jaxpr, shard_map body,
+    ...) — found generically so a NEW higher-order primitive is
+    traversed by default instead of silently hiding its eqns."""
+    out = []
+    for v in params.values():
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for x in vals:
+            if hasattr(x, "eqns") or hasattr(getattr(x, "jaxpr", None),
+                                             "eqns"):
+                out.append(x)
+    return out
+
+
+def _aval(var):
+    return getattr(var, "aval", None)
+
+
+def _shape_dtype(var):
+    av = _aval(var)
+    if av is None or not hasattr(av, "shape"):
+        return None, None
+    return tuple(av.shape), str(getattr(av, "dtype", ""))
+
+
+def _size(shape) -> int:
+    return int(np.prod(shape)) if shape else 1
+
+
+def _nbytes(var) -> int:
+    av = _aval(var)
+    if av is None or not hasattr(av, "shape"):
+        return 0
+    itemsize = getattr(getattr(av, "dtype", None), "itemsize", 4) or 4
+    return _size(tuple(av.shape)) * int(itemsize)
+
+
+def _mesh_axis_sizes(mesh) -> dict:
+    """{axis_name: size} for a jax Mesh/AbstractMesh (or {} when the
+    object carries no shape)."""
+    shape = getattr(mesh, "shape", None)
+    if shape is None:
+        return {}
+    try:
+        return {str(k): int(v) for k, v in dict(shape).items()}
+    except (TypeError, ValueError):
+        return {}
+
+
+#: per-dim spec sentinel for PartitionSpec.UNCONSTRAINED — the author
+#: declined to pin the dim, so it is neither sharded nor an assertion of
+#: replication (GSPMD propagation decides)
+UNCONSTRAINED = "?"
+
+
+class ShardingInfo:
+    """Best-known placement of one var: which mesh axes each dimension
+    is split over (None = replicated on that dim, dataflow.UNCONSTRAINED
+    = left to GSPMD propagation), plus the mesh axes the annotation's
+    mesh carries. Derived from NamedSharding-bearing eqns; ``axes_used``
+    is the set of mesh axes the spec names at all — empty AND fully
+    pinned means the var is asserted replicated along every mesh axis."""
+
+    __slots__ = ("spec", "mesh_axes", "source")
+
+    def __init__(self, spec, mesh_axes, source):
+        self.spec = spec            # per-dim: tuple[str] | None | "?"
+        self.mesh_axes = mesh_axes  # {axis: size} of the annotating mesh
+        self.source = source        # "constraint" | "device_put" | ...
+
+    @property
+    def axes_used(self) -> frozenset:
+        used = set()
+        for entry in self.spec:
+            if entry and entry != UNCONSTRAINED:
+                used.update(entry)
+        return frozenset(used)
+
+    @property
+    def unconstrained(self) -> bool:
+        return any(entry == UNCONSTRAINED for entry in self.spec)
+
+    @property
+    def replicated(self) -> bool:
+        """True only for an ASSERTED full replication: no axis named and
+        no dim left open to propagation."""
+        return not self.axes_used and not self.unconstrained
+
+    def __repr__(self):
+        return (f"ShardingInfo(spec={self.spec}, "
+                f"mesh={sorted(self.mesh_axes)}, {self.source})")
+
+
+def _named_sharding_info(sh, ndim: int, source: str):
+    """ShardingInfo from a jax NamedSharding(-like) object, or None when
+    the object exposes no named spec (GSPMD/opaque shardings)."""
+    mesh = getattr(sh, "mesh", None)
+    spec = getattr(sh, "spec", None)
+    if mesh is None or spec is None:
+        return None
+    from jax.sharding import PartitionSpec as _P
+
+    entries = []
+    raw = tuple(spec) + (None,) * max(0, ndim - len(tuple(spec)))
+    for entry in raw[:max(ndim, len(tuple(spec)))]:
+        if entry is None:
+            entries.append(None)
+        elif entry is _P.UNCONSTRAINED:
+            entries.append(UNCONSTRAINED)
+        elif isinstance(entry, tuple):
+            entries.append(tuple(str(e) for e in entry))
+        else:
+            entries.append((str(entry),))
+    return ShardingInfo(tuple(entries), _mesh_axis_sizes(mesh), source)
+
+
+class CollectiveSite:
+    """One collective eqn: primitive, mesh axes it moves data over, and
+    the per-device byte volume of its outputs (the received bytes one
+    participant materializes — fabric volume scales this by the axis
+    size)."""
+
+    __slots__ = ("eqn", "prim", "axes", "out_bytes", "level")
+
+    def __init__(self, eqn, axes, out_bytes, level):
+        self.eqn = eqn
+        self.prim = eqn.primitive.name
+        self.axes = axes            # tuple[str] (unnamed axes dropped)
+        self.out_bytes = out_bytes
+        self.level = level
+
+
+class VarInfo:
+    """Per-var abstract value: shape/dtype/size, the best-known
+    sharding, producing eqn (None for level inputs/consts) and the
+    provenance path of the level that owns it."""
+
+    __slots__ = ("var", "shape", "dtype", "size", "sharding", "producer",
+                 "consumers", "path")
+
+    def __init__(self, var, shape, dtype, sharding, producer, consumers,
+                 path):
+        self.var = var
+        self.shape = shape
+        self.dtype = dtype
+        self.size = _size(shape) if shape is not None else 0
+        self.sharding = sharding
+        self.producer = producer
+        self.consumers = consumers
+        self.path = path
+
+
+class Level:
+    """One jaxpr in the walk: its eqns plus the producer/consumer maps
+    pattern matchers chase edges through (scoped to the level, exactly
+    like the pre-round-15 detectors)."""
+
+    __slots__ = ("jaxpr", "path", "producers", "consumers")
+
+    def __init__(self, jaxpr, path):
+        self.jaxpr = jaxpr
+        self.path = path
+        self.producers = {id(ov): e for e in jaxpr.eqns
+                          for ov in e.outvars}
+        cons: dict = {}
+        for eqn in jaxpr.eqns:
+            for iv in eqn.invars:
+                if _aval(iv) is not None and not isinstance(iv,
+                                                            (int, float)):
+                    cons.setdefault(id(iv), []).append(eqn)
+        self.consumers = cons
+
+
+class ProgramIndex:
+    """One walk over a captured program; every detector pass reads this.
+
+    Attributes (all built in the single constructor pass):
+      levels          list[Level] in the pinned DFS order
+      eqns            list[(Level, eqn)] in walk order
+      eqns_by_prim    {prim_name: [(Level, eqn)]}
+      shardings       {id(var): ShardingInfo} best-known placements
+      mesh_axes       {axis: size} union over every mesh seen
+      collectives     list[CollectiveSite]
+      transfers       list[(Level, eqn)] device_put eqns (D11)
+      hop_entered     {prim: count} higher-order prims descended into
+      hop_stopped     {prim: count} prims on STOP_PRIMS with sub-jaxprs
+    """
+
+    def __init__(self, closed_jaxpr, stop_prims=STOP_PRIMS):
+        self.root = closed_jaxpr
+        self.levels: list[Level] = []
+        self.eqns: list = []
+        self.eqns_by_prim: dict = {}
+        self.shardings: dict = {}
+        self.mesh_axes: dict = {}
+        self.collectives: list = []
+        self.transfers: list = []
+        self.hop_entered: dict = {}
+        self.hop_stopped: dict = {}
+        self._var_shapes: dict = {}
+        self._shape_counts: dict = {}   # (dtype, shape) -> produce count
+
+        stack = [(_closed(closed_jaxpr), "root")]
+        while stack:
+            j, path = stack.pop()
+            level = Level(j, path)
+            self.levels.append(level)
+            for eqn in j.eqns:
+                prim = eqn.primitive.name
+                self.eqns.append((level, eqn))
+                self.eqns_by_prim.setdefault(prim, []).append((level, eqn))
+                self._record_facts(level, eqn)
+                subs = _sub_jaxprs(eqn.params)
+                if prim in stop_prims:
+                    if subs:
+                        self.hop_stopped[prim] = \
+                            self.hop_stopped.get(prim, 0) + 1
+                    continue
+                if subs:
+                    self.hop_entered[prim] = \
+                        self.hop_entered.get(prim, 0) + 1
+                stack.extend((_closed(s), f"{path}/{prim}") for s in subs)
+
+    # ------------------------------------------------------ walk facts
+    def _record_facts(self, level, eqn):
+        prim = eqn.primitive.name
+        for ov in eqn.outvars:
+            shape, dt = _shape_dtype(ov)
+            if shape is None:
+                continue
+            self._var_shapes[id(ov)] = (shape, dt)
+            if len(shape) >= 3:
+                key = (dt, shape)
+                self._shape_counts[key] = self._shape_counts.get(key,
+                                                                 0) + 1
+        if prim == "sharding_constraint":
+            info = _named_sharding_info(
+                eqn.params.get("sharding"),
+                len(_shape_dtype(eqn.outvars[0])[0] or ()), "constraint")
+            if info is not None:
+                self._note_sharding(eqn.outvars[0], info)
+                self._note_sharding(eqn.invars[0], info)
+        elif prim == "device_put":
+            self.transfers.append((level, eqn))
+            for var, sh in zip(eqn.outvars,
+                               eqn.params.get("devices") or ()):
+                info = _named_sharding_info(
+                    sh, len(_shape_dtype(var)[0] or ()), "device_put")
+                if info is not None:
+                    self._note_sharding(var, info)
+        elif prim == "shard_map":
+            self.mesh_axes.update(
+                _mesh_axis_sizes(eqn.params.get("mesh")))
+        elif prim in COLLECTIVE_PRIMS:
+            axes = eqn.params.get("axis_name",
+                                  eqn.params.get("axes", ()))
+            if not isinstance(axes, tuple):
+                axes = (axes,)
+            named = tuple(str(a) for a in axes if isinstance(a, str))
+            out_bytes = sum(_nbytes(ov) for ov in eqn.outvars)
+            self.collectives.append(
+                CollectiveSite(eqn, named, out_bytes, level))
+
+    def _note_sharding(self, var, info: ShardingInfo):
+        self.shardings[id(var)] = info
+        self.mesh_axes.update(info.mesh_axes)
+
+    # ------------------------------------------------------- accessors
+    @classmethod
+    def ensure(cls, jx_or_index) -> "ProgramIndex":
+        if isinstance(jx_or_index, cls):
+            return jx_or_index
+        return cls(jx_or_index)
+
+    def jaxprs(self):
+        for level in self.levels:
+            yield level.jaxpr
+
+    def iter_eqns(self):
+        for _level, eqn in self.eqns:
+            yield eqn
+
+    def var_info(self, var, level: Level | None = None) -> VarInfo:
+        shape, dt = _shape_dtype(var)
+        producer = consumers = None
+        path = level.path if level is not None else "root"
+        if level is not None:
+            producer = level.producers.get(id(var))
+            consumers = level.consumers.get(id(var), [])
+        return VarInfo(var, shape, dt, self.shardings.get(id(var)),
+                       producer, consumers, path)
+
+    def var_shape_dtype(self, var_id: int):
+        return self._var_shapes.get(var_id, (None, None))
+
+    def stream_shapes(self, dtypes=("bfloat16",),
+                      min_repeats: int = 3) -> list[tuple]:
+        """Candidate residual-stream shapes: activation shapes of rank
+        >= 3 at one of `dtypes` produced at least `min_repeats` times —
+        the stream re-appears once or more per transformer layer,
+        one-off tensors (logits, embeddings) don't. D1 asks for the
+        bf16 shapes; D9 widens to every float dtype."""
+        dts = set(dtypes)
+        counts: dict = {}
+        for (dt, shape), n in self._shape_counts.items():
+            if dt in dts:
+                counts[shape] = counts.get(shape, 0) + n
+        return sorted(s for s, n in counts.items() if n >= min_repeats)
+
+    def collective_bytes(self) -> dict:
+        """Per-axis / per-primitive / total per-device byte volume of
+        every collective eqn — the number the obs cost ledger carries
+        next to D8's bytes-accessed."""
+        per_axis: dict = {}
+        per_prim: dict = {}
+        total = 0
+        for c in self.collectives:
+            total += c.out_bytes
+            per_prim[c.prim] = per_prim.get(c.prim, 0) + c.out_bytes
+            for ax in (c.axes or ("<unnamed>",)):
+                per_axis[ax] = per_axis.get(ax, 0) + c.out_bytes
+        return {"total": total, "per_axis": per_axis,
+                "per_prim": per_prim, "sites": len(self.collectives)}
+
+
+def build_index(closed_jaxpr, stop_prims=STOP_PRIMS) -> ProgramIndex:
+    """One-pass ProgramIndex over a captured program (see module doc)."""
+    return ProgramIndex(closed_jaxpr, stop_prims=stop_prims)

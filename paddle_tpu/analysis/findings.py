@@ -84,14 +84,29 @@ def load_baseline(path: str) -> list[dict]:
 def apply_baseline(findings: list[Finding],
                    baseline: list[dict]) -> list[Finding]:
     """Mark findings matched by a baseline entry as suppressed (in place);
-    returns the same list for chaining."""
+    returns the same list for chaining. Each baseline entry's match count
+    is recorded on the entry (``_matched``) so ``stale_suppressions`` can
+    report entries that suppressed nothing this run."""
+    for e in baseline:
+        e.setdefault("_matched", 0)
     for f in findings:
         hay = f"{f.loc} {f.message}"
         for e in baseline:
             if e["detector"] == f.detector and e["match"] in hay:
                 f.suppressed = True
+                e["_matched"] += 1
                 break
     return findings
+
+
+def stale_suppressions(baseline: list[dict]) -> list[dict]:
+    """Baseline entries that matched ZERO findings in the
+    ``apply_baseline`` run(s) they were passed through — dead entries
+    that would silently mask a future real finding with the same
+    substring. graft_lint reports them (warning on a full-coverage run,
+    note on a partial one) and ``--prune-baseline`` rewrites the file
+    without them."""
+    return [e for e in baseline if not e.get("_matched")]
 
 
 def gate_failures(findings: list[Finding]) -> list[Finding]:
@@ -102,11 +117,16 @@ def gate_failures(findings: list[Finding]) -> list[Finding]:
 
 def to_json(findings: list[Finding]) -> dict:
     fails = gate_failures(findings)
+    by_detector: dict[str, int] = {}
+    for f in findings:
+        if not f.suppressed:
+            by_detector[f.detector] = by_detector.get(f.detector, 0) + 1
     return {
         "findings": [f.to_dict() for f in findings],
         "counts": {s: sum(1 for f in findings
                           if f.severity == s and not f.suppressed)
                    for s in SEVERITIES},
+        "by_detector": dict(sorted(by_detector.items())),
         "suppressed": sum(1 for f in findings if f.suppressed),
         "gate_failures": len(fails),
         "clean": not fails,

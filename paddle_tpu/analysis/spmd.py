@@ -1,0 +1,280 @@
+"""SPMD sharding auditor — detectors D9-D11 over the ProgramIndex.
+
+ROADMAP item 1 names the contract: "an SPMD detector (unsharded
+stream-size tensors, accidental all-gathers) so sharding regressions
+fail lint like dtype regressions do today." These passes read the SPMD
+facts the dataflow walk already collected — no extra jaxpr traversal.
+
+  D9  audit_sharding_coverage — under a declared or jaxpr-recovered
+      mesh, the residual-stream-size tensors (same shape inference D1
+      uses, widened to every float dtype) must be sharded along every
+      non-trivial mesh axis SOMEWHERE in the program. A mesh axis that
+      no stream-size tensor is ever split over means the model is
+      replicated along it — paying the mesh's HBM without its capacity —
+      and fails lint. Per-site fully-replicated constraints at stream
+      size are surfaced as notes (a gather_output-style local gather is
+      legitimate when a sharded twin exists elsewhere).
+
+  D10 audit_collectives — every jaxpr-level collective eqn (psum /
+      all_gather / reduce_scatter / ppermute / all_to_all, i.e. the
+      shard_map & explicit-lax layer; GSPMD-inserted HLO collectives are
+      out of jaxpr reach and noted as such in the docs) is attributed to
+      its mesh axis with its per-device byte volume. The "accidental
+      all-gather" fires as a warning: an all_gather whose output is
+      consumed ONLY by elementwise/slice plumbing (no contraction,
+      kernel, or sub-call needs the materialized axis) above
+      FLAGS_analysis_collective_min_bytes. A psum of a scalar loss or an
+      FSDP-style reduce_scatter stays a note. Per-program totals are the
+      `collective_bytes` the obs cost ledger carries next to D8's
+      bytes-accessed.
+
+  D11 audit_transfers — `device_put` eqns inside a compiled program:
+      each one forces a transfer/resharding at dispatch (host memory
+      kinds are called out explicitly) where a sharding constraint (or
+      moving the transfer outside the step) was intended.
+"""
+from __future__ import annotations
+
+from .dataflow import ProgramIndex, _mesh_axis_sizes, _shape_dtype, _size
+from .findings import Finding
+
+#: dtypes whose repeated rank>=3 activations count as "the stream" for
+#: D9 (D1 keeps its bf16-only default: it audits the bf16 POLICY, while
+#: D9 audits placement at whatever width the program runs)
+STREAM_DTYPES = ("bfloat16", "float32", "float16")
+
+#: consumers that do NOT justify materializing a gathered axis — pure
+#: elementwise/slice/layout plumbing. Anything outside this set (a
+#: contraction, a kernel, a sub-call whose body we treat as opaque at
+#: this level) is assumed to need the full tensor.
+_ELEMWISE_SLICE = frozenset({
+    "add", "sub", "mul", "div", "neg", "abs", "sign", "exp", "log",
+    "tanh", "logistic", "rsqrt", "sqrt", "pow", "integer_pow", "max",
+    "min", "select_n", "clamp", "floor", "ceil", "round", "sin", "cos",
+    "erf", "expm1", "log1p", "square",
+    "convert_element_type", "broadcast_in_dim", "reshape", "transpose",
+    "copy", "slice", "dynamic_slice", "squeeze", "rev", "pad",
+    "stop_gradient", "reduce_precision",
+    "lt", "le", "gt", "ge", "eq", "ne", "and", "or", "not", "xor",
+})
+
+
+def _declared_axes(mesh) -> dict:
+    """{axis: size} from a declared mesh: a jax Mesh, a {name: size}
+    mapping, or None."""
+    if mesh is None:
+        return {}
+    if isinstance(mesh, dict):
+        return {str(k): int(v) for k, v in mesh.items()}
+    return _mesh_axis_sizes(mesh)
+
+
+# -------------------------------------------------- D9 sharding coverage
+
+def audit_sharding_coverage(closed_jaxpr, mesh=None, stream_shapes=None,
+                            min_repeats: int = 3,
+                            loc: str = "<program>") -> list[Finding]:
+    """D9 (see module doc). `mesh` declares the mesh explicitly (a jax
+    Mesh or {axis: size} dict); otherwise every mesh the jaxpr's own
+    sharding annotations mention is recovered from the index. Axes of
+    size 1 are exempt — there is nothing to shard over."""
+    idx = ProgramIndex.ensure(closed_jaxpr)
+    axes = _declared_axes(mesh) or dict(idx.mesh_axes)
+    axes = {a: s for a, s in axes.items() if s > 1}
+    if not axes:
+        return []
+    if stream_shapes is None:
+        stream_shapes = idx.stream_shapes(dtypes=STREAM_DTYPES,
+                                          min_repeats=min_repeats)
+    targets = {tuple(s) for s in stream_shapes}
+    if not targets:
+        return []
+
+    used: set = set()
+    replicated_sites: dict = {}
+    annotated = 0
+    for var_id, info in idx.shardings.items():
+        shape, _dt = idx.var_shape_dtype(var_id)
+        if shape is None:
+            # level inputs carry annotations too; shape lives on the var
+            continue
+        if shape not in targets:
+            continue
+        annotated += 1
+        names = info.axes_used & set(axes)
+        if names:
+            used |= names
+        elif info.replicated:   # asserted replication, not an open spec
+            replicated_sites[shape] = replicated_sites.get(shape, 0) + 1
+
+    findings = []
+    uncovered = sorted(a for a in axes if a not in used)
+    if uncovered:
+        sites = (f"; {annotated} stream-size sharding annotation(s) seen, "
+                 f"none names {uncovered}" if annotated else
+                 "; the program carries NO sharding annotation on any "
+                 "stream-size tensor")
+        findings.append(Finding(
+            "spmd-coverage", "warning", loc,
+            f"stream-size tensors are unsharded/replicated along mesh "
+            f"ax{'is' if len(uncovered) == 1 else 'es'} "
+            f"{uncovered} (mesh {dict(sorted(axes.items()))}): the "
+            f"activations {[list(s) for s in stream_shapes[:4]]} pay "
+            f"replicated HBM across "
+            f"{max(axes[a] for a in uncovered)} devices{sites} — shard "
+            "the stream (with_sharding_constraint / the mp_layers "
+            "constraints) or shrink the mesh",
+            {"uncovered_axes": uncovered,
+             "mesh": dict(sorted(axes.items())),
+             "stream_shapes": [list(s) for s in stream_shapes],
+             "annotations_seen": annotated}))
+    else:
+        findings.append(Finding(
+            "spmd-coverage", "note", loc,
+            f"stream sharding coverage ok: every mesh axis "
+            f"{sorted(axes)} appears on at least one stream-size "
+            f"tensor's sharding ({annotated} annotation(s) over "
+            f"{len(targets)} stream shape(s))",
+            {"mesh": dict(sorted(axes.items())),
+             "annotations_seen": annotated}))
+    for shape, n in sorted(replicated_sites.items()):
+        findings.append(Finding(
+            "spmd-coverage", "note", loc,
+            f"{n} fully-replicated sharding annotation(s) at stream "
+            f"shape {list(shape)} — a local gather (gather_output-style) "
+            "is legitimate next to a sharded twin, but each one "
+            "materializes the full tensor per device",
+            {"shape": list(shape), "sites": n}))
+    return findings
+
+
+# --------------------------------------------------- D10 collective audit
+
+def _gather_is_accidental(idx: ProgramIndex, site) -> bool:
+    """True when the all_gather's outputs are consumed ONLY by
+    elementwise/slice plumbing within its level — nothing needed the
+    materialized axis, so the op could have stayed shard-local (or been
+    fused into its consumer's collective). A gather with no consumers is
+    the level's output — materializing it IS the point. The traversal is
+    depth-bounded; exhausting the budget with consumers still unexplored
+    means we could NOT prove the gather accidental — that is a False
+    (warnings must never come from giving up early)."""
+    level = site.level
+    frontier = list(site.eqn.outvars)
+    seen: set = set()
+    any_consumer = False
+    for _ in range(16):
+        nxt = []
+        for v in frontier:
+            for eqn in level.consumers.get(id(v), []):
+                if id(eqn) in seen:
+                    continue
+                seen.add(id(eqn))
+                any_consumer = True
+                if eqn.primitive.name not in _ELEMWISE_SLICE:
+                    return False
+                nxt.extend(eqn.outvars)
+        frontier = nxt
+        if not frontier:
+            break
+    if frontier:   # depth budget exhausted before the chain ended
+        return False
+    return any_consumer
+
+
+def audit_collectives(closed_jaxpr, min_bytes: int | None = None,
+                      loc: str = "<program>") -> list[Finding]:
+    """D10 (see module doc). Returns [] for a program with no
+    jaxpr-level collectives; otherwise one attribution note per
+    collective site, the accidental-all-gather warning where it applies,
+    and a per-program byte-volume summary."""
+    from ..core.flags import flag
+
+    idx = ProgramIndex.ensure(closed_jaxpr)
+    if not idx.collectives:
+        return []
+    if min_bytes is None:
+        min_bytes = int(flag("FLAGS_analysis_collective_min_bytes"))
+    findings = []
+    for site in idx.collectives:
+        shape, dtype = _shape_dtype(site.eqn.outvars[0])
+        axes = list(site.axes) or ["<unnamed>"]
+        desc = (f"{site.prim} over mesh ax{'is' if len(axes) == 1 else 'es'} "
+                f"{axes} moving {site.out_bytes} B/device "
+                f"({dtype}{list(shape) if shape is not None else '?'})")
+        if (site.prim == "all_gather" and site.out_bytes >= min_bytes
+                and _gather_is_accidental(idx, site)):
+            findings.append(Finding(
+                "spmd-collective", "warning", loc,
+                f"accidental all-gather: {desc} but its output is "
+                "consumed only by elementwise/slice ops — nothing needs "
+                "the materialized axis; keep the computation shard-local "
+                "and gather (or reduce) the small result instead",
+                {"prim": site.prim, "axes": axes,
+                 "bytes": site.out_bytes,
+                 "shape": list(shape) if shape is not None else None,
+                 "accidental": True}))
+        else:
+            findings.append(Finding(
+                "spmd-collective", "note", loc, desc,
+                {"prim": site.prim, "axes": axes,
+                 "bytes": site.out_bytes,
+                 "shape": list(shape) if shape is not None else None,
+                 "accidental": False}))
+    vol = idx.collective_bytes()
+    findings.append(Finding(
+        "spmd-collective", "note", loc,
+        f"collective volume: {vol['sites']} site(s), {vol['total']} "
+        f"B/device total — per axis {vol['per_axis']}, per primitive "
+        f"{vol['per_prim']} (recorded in the obs cost ledger next to "
+        "bytes-accessed)", dict(vol)))
+    return findings
+
+
+def jaxpr_collective_bytes(closed_jaxpr) -> dict:
+    """Per-program collective byte volume (the obs/costs ledger hook):
+    {"total", "per_axis", "per_prim", "sites"}."""
+    return ProgramIndex.ensure(closed_jaxpr).collective_bytes()
+
+
+# ------------------------------------------------ D11 host-device transfer
+
+def audit_transfers(closed_jaxpr, loc: str = "<program>") -> list[Finding]:
+    """D11 (see module doc)."""
+    idx = ProgramIndex.ensure(closed_jaxpr)
+    findings = []
+    for _level, eqn in idx.transfers:
+        shape, dtype = _shape_dtype(eqn.outvars[0])
+        kinds = []
+        for sh in (eqn.params.get("devices") or ()):
+            mk = getattr(sh, "memory_kind", None)
+            if mk is not None:
+                kinds.append(str(mk))
+        host = any("host" in k for k in kinds)
+        what = ("host round-trip" if host else "device transfer/reshard")
+        findings.append(Finding(
+            "spmd-transfer", "warning", loc,
+            f"device_put inside the compiled program ({what}, "
+            f"{dtype}{list(shape) if shape is not None else '?'}"
+            + (f", memory_kind={kinds}" if kinds else "")
+            + ") — every call pays the copy at this point in the "
+            "program; use with_sharding_constraint for placement hints "
+            "or move the transfer outside the step",
+            {"shape": list(shape) if shape is not None else None,
+             "dtype": dtype, "memory_kinds": kinds, "host": host}))
+    return findings
+
+
+# ---------------------------------------------------------------- umbrella
+
+def audit_spmd(closed_jaxpr, mesh=None, stream_shapes=None,
+               min_bytes: int | None = None,
+               loc: str = "<program>") -> list[Finding]:
+    """D9 + D10 + D11 over one index build."""
+    idx = ProgramIndex.ensure(closed_jaxpr)
+    findings = audit_sharding_coverage(idx, mesh=mesh,
+                                       stream_shapes=stream_shapes,
+                                       loc=loc)
+    findings += audit_collectives(idx, min_bytes=min_bytes, loc=loc)
+    findings += audit_transfers(idx, loc=loc)
+    return findings

@@ -181,6 +181,12 @@ define_flag("FLAGS_analysis_fusion_min_elems", 4096,
             "fusion-miss detector (analysis D4) reporting floor: "
             "norm/rotary/swiglu/dropout-add compositions smaller than "
             "this many elements are not worth a finding")
+define_flag("FLAGS_analysis_collective_min_bytes", 65536,
+            "SPMD collective audit (analysis D10) warning floor: an "
+            "all_gather whose output is consumed only by elementwise/"
+            "slice ops fires the accidental-all-gather warning only at "
+            "or above this per-device byte volume (smaller gathers stay "
+            "attribution notes)")
 define_flag("FLAGS_pallas_decode", True,
             "route paged decode attention through the Pallas flash-decode "
             "kernel (ops/pallas_decode.py) on TPU above the size "

@@ -87,7 +87,7 @@ class SequenceParallelEnd(PlanBase):
 
         def hook(_lyr, _ins, out):
             if hasattr(out, "ndim") and out.ndim >= 2:
-                return _clear_axis(out, "mp")
+                return _clear_axis(out, "mp", dim=1)   # the seq dim
             return out
 
         layer.register_forward_post_hook(hook)
@@ -123,8 +123,8 @@ class SequenceParallelDisable(PlanBase):
 
         def pre(_lyr, ins):
             return tuple(
-                _clear_axis(x, "mp") if hasattr(x, "ndim") and x.ndim >= 2
-                else x for x in ins)
+                _clear_axis(x, "mp", dim=1)   # the seq dim
+                if hasattr(x, "ndim") and x.ndim >= 2 else x for x in ins)
 
         def post(_lyr, _ins, out):
             if hasattr(out, "ndim") and out.ndim >= 2:

@@ -43,7 +43,7 @@ def _constraint(t: Tensor, spec: P) -> Tensor:
     live on the stage's sub-mesh, not the full hybrid mesh)."""
     needed = set()
     for entry in spec:
-        if entry is None:
+        if entry is None or entry is P.UNCONSTRAINED:
             continue
         needed.update(entry if isinstance(entry, tuple) else (entry,))
     mesh = None
@@ -57,7 +57,11 @@ def _constraint(t: Tensor, spec: P) -> Tensor:
     def fn(x):
         if isinstance(x, jax.core.Tracer):
             return jax.lax.with_sharding_constraint(x, sh)
-        return jax.device_put(x, sh)
+        # device_put cannot materialize UNCONSTRAINED dims — concretize
+        # them to replicated for the eager path (the trace path is where
+        # leaving them open matters: GSPMD propagation fills them in)
+        concrete = P(*(None if e is P.UNCONSTRAINED else e for e in sh.spec))
+        return jax.device_put(x, NamedSharding(sh.mesh, concrete))
 
     return op_call(fn, t, name="sharding_constraint")
 
@@ -75,11 +79,25 @@ def _spec_without_axis(cur, ndim: int, axis: str = "mp") -> list:
     return entries
 
 
-def _clear_axis(t: Tensor, axis: str = "mp") -> Tensor:
+def _clear_axis(t: Tensor, axis: str = "mp", dim: int | None = None
+                ) -> Tensor:
     """Gather over one mesh axis only: drop `axis` from the current spec,
-    keeping other placements (dp batch sharding survives an mp-gather)."""
+    keeping other placements (dp batch sharding survives an mp-gather).
+
+    Inside a jit trace the tracer carries no concrete sharding, so the
+    pre-round-15 fallback constrained EVERY dim to None — a fully
+    replicated annotation that forced a dp gather alongside the intended
+    mp one (analysis D9 surfaces these sites as replicated-stream
+    notes). When the caller knows WHICH dim carries `axis` (column
+    outputs: the last; sequence gathers: the sequence dim) it passes
+    `dim`, and only that dim is pinned replicated — every other dim
+    stays P.UNCONSTRAINED for GSPMD propagation to fill in."""
     cur = getattr(t._data, "sharding", None)
-    return _constraint(t, P(*_spec_without_axis(cur, t.ndim, axis)))
+    if isinstance(cur, NamedSharding) or dim is None:
+        return _constraint(t, P(*_spec_without_axis(cur, t.ndim, axis)))
+    spec = [P.UNCONSTRAINED] * t.ndim
+    spec[dim] = None
+    return _constraint(t, P(*spec))
 
 
 class VocabParallelEmbedding(Layer):
@@ -122,7 +140,7 @@ class ColumnParallelLinear(Layer):
     def forward(self, x):
         y = F.linear(x, self.weight, self.bias)
         if self.gather_output:
-            y = _clear_axis(y, "mp")
+            y = _clear_axis(y, "mp", dim=-1)   # mp lives on the out dim
         return y
 
 

@@ -71,7 +71,7 @@ class GatherOp:
 
     @staticmethod
     def apply(x: Tensor, axis: int = 0) -> Tensor:
-        return _clear_axis(x, "mp")
+        return _clear_axis(x, "mp", dim=axis)   # mp lives on the seq dim
 
 
 # paddle exposes these as module-level functions too
@@ -111,7 +111,7 @@ class ColumnSequenceParallelLinear(ColumnParallelLinear):
         x = _seq_constraint(x, 0)
         y = F.linear(x, self.weight, self.bias)
         if self.gather_output:
-            y = _clear_axis(y, "mp")
+            y = _clear_axis(y, "mp", dim=-1)
         return y
 
 

@@ -91,7 +91,8 @@ def _struct_key(struct):
 
 class _Specialization:
     __slots__ = ("captures", "ro_caps", "mut_caps", "executable", "out_struct",
-                 "n_out_leaves", "trace_muts", "debug", "donated")
+                 "n_out_leaves", "trace_muts", "debug", "debug_jaxpr",
+                 "debug_index", "donated")
 
 
 #: exception types that mean "this program can't be captured as one graph"
@@ -229,6 +230,9 @@ class CompiledFunction:
         """ClosedJaxpr of a compiled specialization (requires
         FLAGS_jit_debug_program=1 at compile time) — the object form of
         program_text(), consumed by paddle_tpu.analysis's jaxpr detectors.
+        Cached per specialization (round 15): the compile path stores the
+        jaxpr it already traced (jit .trace()), so repeated audits of the
+        same program cost zero retraces.
         """
         if not self._cache:
             raise RuntimeError("program_text/jaxpr: nothing compiled yet")
@@ -239,8 +243,26 @@ class CompiledFunction:
             raise RuntimeError(
                 "program_text/jaxpr needs FLAGS_jit_debug_program=1 before "
                 "the compiling call (paddle.set_flags)")
-        pure, avals = dbg
-        return jax.make_jaxpr(pure)(*avals)
+        if getattr(spec, "debug_jaxpr", None) is None:
+            pure, avals = dbg
+            spec.debug_jaxpr = jax.make_jaxpr(pure)(*avals)
+        return spec.debug_jaxpr
+
+    def program_index(self, key: str | None = None):
+        """analysis.ProgramIndex over a compiled specialization's jaxpr,
+        built ONCE and cached on the specialization — the compile-site
+        sizing, the collective-bytes ledger hook and every
+        audit_compiled pass read the same walk (the round-15 single-walk
+        property, held end to end)."""
+        if not self._cache:
+            raise RuntimeError("program_index: nothing compiled yet")
+        spec = self._cache[key] if key is not None \
+            else next(iter(self._cache.values()))
+        if getattr(spec, "debug_index", None) is None:
+            from ..analysis import build_index
+
+            spec.debug_index = build_index(self.program_jaxpr(key))
+        return spec.debug_index
 
     def __get__(self, instance, owner):
         if instance is None:
@@ -374,7 +396,29 @@ class CompiledFunction:
             import time as _time
 
             _t0 = _time.perf_counter()
-            out_datas, mut_out = jitted(arg_datas, ro_datas, mut_datas)
+            # Under FLAGS_jit_debug_program + cost capture the program
+            # compiles ONCE through the AOT path: jit(...).trace() gives
+            # the jaxpr (cached for program_jaxpr/the lint auditors) and
+            # the lowering in one trace, .compile() yields the executable
+            # that both runs the step AND feeds XLA cost_analysis() into
+            # the obs ledger. Pre-round-15 the debug path paid a second
+            # full compile (jitted ran the step, lower().compile() redid
+            # it for costs) — the lint smokes' dominant wall cost.
+            _aot = _aot_jaxpr = None
+            if flag("FLAGS_jit_debug_program") \
+                    and flag("FLAGS_obs_cost_capture"):
+                try:
+                    _traced = jitted.trace(arg_datas, ro_datas, mut_datas)
+                    _aot_jaxpr = _traced.jaxpr
+                    _aot = _traced.lower().compile()
+                except (Dy2StFallback,) + _GRAPH_BREAK_ERRORS:
+                    raise
+                except Exception:
+                    _aot = _aot_jaxpr = None  # AOT unsupported: jit path
+            if _aot is not None:
+                out_datas, mut_out = _aot(arg_datas, ro_datas, mut_datas)
+            else:
+                out_datas, mut_out = jitted(arg_datas, ro_datas, mut_datas)
             _compile_wall = _time.perf_counter() - _t0
         except (Dy2StFallback,) + _GRAPH_BREAK_ERRORS as e:
             fn_name = getattr(self._fn, "__name__", str(self._fn))
@@ -436,10 +480,43 @@ class CompiledFunction:
                 "as constants; later updates to them will be ignored. "
                 "Disable share_discovery for this function if these must "
                 "stay live inputs.", stacklevel=3)
-        spec.executable = jitted
+        # the AOT executable (when built) IS the execution path: same
+        # donation, fixed avals per spec key, and it is the retained
+        # object ROADMAP item-5 executable serialization needs. AOT is
+        # stricter than jit about INPUT SHARDINGS: a GSPMD train step's
+        # first execution returns optimizer state sharded by the
+        # partitioner, so call 2 no longer matches the replicated
+        # shardings call 1 compiled for — jit would transparently
+        # recompile, the AOT executable raises. Demote to the jit path
+        # on that mismatch only (ValueError "input sharding(s) does not
+        # match" / TypeError "Argument types differ", both raised at
+        # argument validation BEFORE execution or donation, so the
+        # retry re-reads intact buffers); genuine runtime errors
+        # propagate — retrying them would double host side effects and
+        # mask the real failure behind donated-buffer errors.
+        if _aot is not None:
+            _MISMATCH_MARKS = (
+                "input sharding(s) does not match",
+                "for which this computation was compiled",
+            )
+
+            def _exec_aot(a, r, m, _aot=_aot, _jit=jitted, _spec=spec):
+                try:
+                    return _aot(a, r, m)
+                except (ValueError, TypeError) as e:
+                    msg = str(e)
+                    if not any(mark in msg for mark in _MISMATCH_MARKS):
+                        raise
+                    _spec.executable = _jit
+                    return _jit(a, r, m)
+
+            spec.executable = _exec_aot
+        else:
+            spec.executable = jitted
         spec.out_struct = holder["out_struct"]
         spec.trace_muts = holder["trace_muts"]
         spec.debug = None
+        spec.debug_jaxpr = _aot_jaxpr
         if flag("FLAGS_jit_debug_program"):
             def avals(ds):
                 return [jax.ShapeDtypeStruct(d.shape, d.dtype) for d in ds]
@@ -457,29 +534,37 @@ class CompiledFunction:
         eqns = None
         if spec.debug is not None:
             try:
-                pure_fn, dbg_avals = spec.debug
-                eqns = _watchdog.jaxpr_size(jax.make_jaxpr(pure_fn)(*dbg_avals))
+                # ONE ProgramIndex walk per specialization: sizing here,
+                # collective bytes below, and every audit_compiled pass
+                # later all read the cached index
+                eqns = len(self.program_index(key).eqns)
             except Exception:
                 eqns = None
-        # cost attribution (round 14): under FLAGS_jit_debug_program the
-        # retained avals let us AOT-compile the same program and read
-        # XLA cost_analysis()/memory_analysis() into the obs cost
-        # ledger. Debug-flag-only because the jit executable above is
-        # not reachable post-call — the AOT re-lower costs one extra
-        # compile, which the lint/bench smokes pay and production
-        # doesn't.
+        # cost attribution (round 14, single-compile since round 15):
+        # under FLAGS_jit_debug_program the step already compiled through
+        # the AOT path above, so XLA cost_analysis()/memory_analysis()
+        # ride the SAME executable that runs the program — no re-lower,
+        # no second compile. The ledger row also carries the program's
+        # jaxpr-level collective byte volume (analysis D10) next to
+        # bytes-accessed.
         cost = None
-        if spec.debug is not None and flag("FLAGS_obs_cost_capture"):
+        if _aot is not None and flag("FLAGS_obs_cost_capture"):
             try:
                 import hashlib
 
                 from ..obs import costs as _costs
 
-                compiled = jitted.lower(*spec.debug[1]).compile()
+                coll = 0
+                try:
+                    coll = self.program_index(key).collective_bytes()[
+                        "total"]
+                except Exception:
+                    coll = 0
                 digest = hashlib.sha1(key.encode()).hexdigest()[:8]
                 entry = _costs.record_program(
                     "to_static", fn_name, f"{fn_name}/{digest}",
-                    compiled=compiled, wall_s=_compile_wall)
+                    compiled=_aot, wall_s=_compile_wall,
+                    collective_bytes=coll)
                 if entry.analyzed:
                     cost = {"flops": entry.flops,
                             "bytes_accessed": entry.bytes_accessed,

@@ -98,11 +98,12 @@ class ProgramCost:
 
     __slots__ = ("program", "site", "group", "key", "bucket", "flops",
                  "bytes_accessed", "arg_bytes", "out_bytes", "temp_bytes",
-                 "peak_hbm_bytes", "compile_wall_s", "analyzed",
-                 "exec_count", "exec_wall_s", "last_util", "t", "_gauge")
+                 "peak_hbm_bytes", "collective_bytes", "compile_wall_s",
+                 "analyzed", "exec_count", "exec_wall_s", "last_util", "t",
+                 "_gauge")
 
     def __init__(self, program, site, group, key, bucket=None,
-                 compile_wall_s=0.0, cost=None):
+                 compile_wall_s=0.0, cost=None, collective_bytes=0):
         self.program = program      # stable id: "site|key"
         self.site = site
         self.group = group
@@ -117,6 +118,10 @@ class ProgramCost:
         self.out_bytes = int(cost.get("out_bytes", 0))
         self.temp_bytes = int(cost.get("temp_bytes", 0))
         self.peak_hbm_bytes = int(cost.get("peak_hbm_bytes", 0))
+        # per-device collective byte volume of the program's jaxpr-level
+        # collectives (analysis D10, jaxpr_collective_bytes) — the SPMD
+        # twin of bytes_accessed: HBM traffic vs fabric traffic
+        self.collective_bytes = int(collective_bytes or 0)
         self.exec_count = 0
         self.exec_wall_s = 0.0
         self.last_util = None
@@ -166,6 +171,7 @@ class ProgramCost:
                 "arg_bytes": self.arg_bytes, "out_bytes": self.out_bytes,
                 "temp_bytes": self.temp_bytes,
                 "peak_hbm_bytes": self.peak_hbm_bytes,
+                "collective_bytes": self.collective_bytes,
                 "compile_wall_s": round(self.compile_wall_s, 4),
                 "exec_count": self.exec_count,
                 "exec_wall_s": round(self.exec_wall_s, 6),
@@ -188,14 +194,19 @@ _site_counts: dict[str, int] = {}
 
 
 def record_program(site: str, group: str, key: str, compiled=None,
-                   wall_s: float = 0.0, bucket=None) -> ProgramCost:
+                   wall_s: float = 0.0, bucket=None,
+                   collective_bytes=0) -> ProgramCost:
     """Register one compiled program in the ledger (idempotent per
     program id — a cleared event mirror re-recording an already-compiled
     executable keeps the original analysis). Returns the entry; the
-    caller attaches ``entry.observe(wall)`` per execution."""
+    caller attaches ``entry.observe(wall)`` per execution.
+    `collective_bytes` carries the program's jaxpr-level collective
+    volume (analysis.jaxpr_collective_bytes) next to bytes-accessed."""
     pid = f"{site}|{key}"
     entry = _ledger.get(pid)
     if entry is not None:
+        if collective_bytes and not entry.collective_bytes:
+            entry.collective_bytes = int(collective_bytes)
         return entry
     if site == "eager" and compiled is None \
             and _site_counts.get("eager", 0) >= _EAGER_LEDGER_CAP:
@@ -208,7 +219,8 @@ def record_program(site: str, group: str, key: str, compiled=None,
     if compiled is not None and flag("FLAGS_obs_cost_capture"):
         cost = extract_cost(compiled)
     entry = ProgramCost(pid, site, group, key, bucket=bucket,
-                        compile_wall_s=wall_s, cost=cost)
+                        compile_wall_s=wall_s, cost=cost,
+                        collective_bytes=collective_bytes)
     _ledger[pid] = entry
     _site_counts[site] = _site_counts.get(site, 0) + 1
     from . import metrics

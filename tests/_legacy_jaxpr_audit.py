@@ -1,16 +1,9 @@
-"""Jaxpr-level program auditor (detectors D1-D4 + the SPMD trio D9-D11).
+"""Jaxpr-level program auditor (detectors D1-D4).
 
-Round 15: every jaxpr detector is now a PASS over a shared
-:class:`~paddle_tpu.analysis.dataflow.ProgramIndex` — one walk per
-compiled specialization builds the producer/consumer maps, per-var
-abstract values (shape/dtype/size/sharding/provenance) and SPMD facts
-(meshes, collectives, transfers); the detectors read the index instead
-of privately re-walking the jaxpr. Every detector accepts either a
-ClosedJaxpr or a prebuilt ProgramIndex, and ``audit_compiled`` builds
-the index once and hands it to every pass. (D2 donation and D3
-host-sync read compile-time state off the CompiledFunction, D5 reads
-launch configs, D6-D8 read runtime events — none of those ever walked a
-jaxpr, so "one walk" now holds for the whole detector suite.)
+Walks the jaxpr of a compiled `CompiledFunction` specialization (via
+``program_jaxpr()``, which needs FLAGS_jit_debug_program=1 at compile time)
+and emits structured findings. Each detector generalizes a property an
+earlier round proved with a one-off hand-written assertion:
 
   D1 dtype-stream  — under FLAGS_residual_dtype=bfloat16, no f32 tensor may
                      exist at residual-stream size, and no silent bf16->f32
@@ -34,58 +27,99 @@ jaxpr, so "one walk" now holds for the whole detector suite.)
                      softmax (the seq-1-query paged decode composition of
                      ops/pallas_decode.py) — the gating reason is mirrored
                      from use_pallas_decode's real gates.
-  D9-D11           — SPMD sharding coverage, collective audit and
-                     host-device transfer detectors (analysis/spmd.py),
-                     run over the same index by ``audit_compiled``.
 
-Sub-jaxpr recursion covers pjit/shard_map/cond/while/scan/custom_vjp
-bodies but stops at `pallas_call` (dataflow.STOP_PRIMS): a kernel body is
-the fused implementation itself — its internal f32 VMEM accumulation is
-exactly what the bf16-stream policy permits, and its rsqrt IS the fused
-norm, not a missed one.
+Sub-jaxpr recursion covers pjit/cond/while/scan/custom_vjp bodies but stops
+at `pallas_call`: a kernel body is the fused implementation itself — its
+internal f32 VMEM accumulation is exactly what the bf16-stream policy
+permits, and its rsqrt IS the fused norm, not a missed one.
 """
 from __future__ import annotations
 
-from .dataflow import (ProgramIndex, STOP_PRIMS, _shape_dtype, _size,
-                       build_index)
+import numpy as np
+
 from .findings import Finding
 
-#: primitives whose sub-jaxprs we do NOT descend into (see module doc) —
-#: kept as the historical name; dataflow.STOP_PRIMS is the one source
-_OPAQUE = set(STOP_PRIMS)
+#: primitives whose sub-jaxprs we do NOT descend into (see module doc)
+_OPAQUE = {"pallas_call"}
 
 #: primitives that force a device->host round trip inside a step (D3)
 _HOST_SYNC_PRIMS = ("pure_callback", "io_callback", "debug_callback",
                     "debug_print", "outfeed", "infeed")
 
 
+def _closed(j):
+    """Normalize Jaxpr/ClosedJaxpr to the raw Jaxpr."""
+    return getattr(j, "jaxpr", j)
+
+
+def _sub_jaxprs(params: dict):
+    """Every jaxpr nested in an eqn's params (pjit jaxpr, cond branches,
+    while cond/body, scan jaxpr, custom_vjp fun_jaxpr, ...)."""
+    out = []
+    for v in params.values():
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for x in vals:
+            if hasattr(x, "eqns") or hasattr(getattr(x, "jaxpr", None),
+                                             "eqns"):
+                out.append(x)
+    return out
+
+
 def iter_jaxprs(closed_jaxpr):
     """Yield every (sub-)jaxpr reachable from the root, skipping opaque
-    (pallas kernel) bodies — one ProgramIndex walk. Accepts a
-    ClosedJaxpr or a prebuilt ProgramIndex."""
-    return ProgramIndex.ensure(closed_jaxpr).jaxprs()
+    (pallas kernel) bodies. Each yielded jaxpr is analyzed as one flat
+    level — pattern matchers that chase producer/consumer edges work
+    within a level."""
+    stack = [_closed(closed_jaxpr)]
+    while stack:
+        j = stack.pop()
+        yield j
+        for eqn in j.eqns:
+            if eqn.primitive.name in _OPAQUE:
+                continue
+            stack.extend(_closed(s) for s in _sub_jaxprs(eqn.params))
 
 
 def iter_eqns(closed_jaxpr):
-    return ProgramIndex.ensure(closed_jaxpr).iter_eqns()
+    for j in iter_jaxprs(closed_jaxpr):
+        for eqn in j.eqns:
+            yield eqn
+
+
+def _aval(var):
+    return getattr(var, "aval", None)
+
+
+def _shape_dtype(var):
+    av = _aval(var)
+    if av is None or not hasattr(av, "shape"):
+        return None, None
+    return tuple(av.shape), str(getattr(av, "dtype", ""))
+
+
+def _size(shape) -> int:
+    return int(np.prod(shape)) if shape else 1
 
 
 def has_pallas_call(closed_jaxpr) -> bool:
-    idx = ProgramIndex.ensure(closed_jaxpr)
-    return bool(idx.eqns_by_prim.get("pallas_call"))
+    return any(e.primitive.name == "pallas_call"
+               for e in iter_eqns(closed_jaxpr))
 
 
 # --------------------------------------------------------------- D1 dtype
 
-def infer_stream_shapes(closed_jaxpr, min_repeats: int = 3,
-                        dtypes=("bfloat16",)) -> list[tuple]:
-    """Candidate residual-stream shapes: activation shapes (ndim >= 3) at
-    one of `dtypes` produced at least `min_repeats` times — the stream
-    re-appears once or more per transformer layer, one-off tensors
-    (logits, embeddings) don't. D1 keeps the bf16 default; D9 widens
-    `dtypes` to every float width (the tp x dp dryrun runs f32)."""
-    idx = ProgramIndex.ensure(closed_jaxpr)
-    return idx.stream_shapes(dtypes=dtypes, min_repeats=min_repeats)
+def infer_stream_shapes(closed_jaxpr, min_repeats: int = 3) -> list[tuple]:
+    """Candidate residual-stream shapes: bf16 activation shapes (ndim >= 3)
+    produced at least `min_repeats` times — the stream re-appears once or
+    more per transformer layer, one-off tensors (logits, embeddings) don't.
+    """
+    counts: dict[tuple, int] = {}
+    for eqn in iter_eqns(closed_jaxpr):
+        for ov in eqn.outvars:
+            shape, dt = _shape_dtype(ov)
+            if shape is not None and dt == "bfloat16" and len(shape) >= 3:
+                counts[shape] = counts.get(shape, 0) + 1
+    return sorted(s for s, n in counts.items() if n >= min_repeats)
 
 
 def audit_dtype_stream(closed_jaxpr, policy: str = "bfloat16",
@@ -98,14 +132,13 @@ def audit_dtype_stream(closed_jaxpr, policy: str = "bfloat16",
     re-widening the stream between two fused kernels)."""
     if policy != "bfloat16":
         return []  # the f32-stream policy permits f32 everywhere
-    idx = ProgramIndex.ensure(closed_jaxpr)
     if stream_shapes is None:
-        stream_shapes = idx.stream_shapes()
+        stream_shapes = infer_stream_shapes(closed_jaxpr)
     targets = {tuple(s) for s in stream_shapes}
     if not targets:
         return []
     findings = []
-    for eqn in idx.iter_eqns():
+    for eqn in iter_eqns(closed_jaxpr):
         for ov in eqn.outvars:
             shape, dt = _shape_dtype(ov)
             if shape not in targets or dt != "float32":
@@ -167,7 +200,7 @@ def audit_callbacks(closed_jaxpr, loc: str = "<program>") -> list[Finding]:
     """Host-callback primitives surviving in a compiled step: each is a
     device->host round trip per call."""
     findings = []
-    for eqn in ProgramIndex.ensure(closed_jaxpr).iter_eqns():
+    for eqn in iter_eqns(closed_jaxpr):
         if eqn.primitive.name in _HOST_SYNC_PRIMS:
             findings.append(Finding(
                 "host-sync", "warning", loc,
@@ -215,14 +248,23 @@ _TRANSPARENT = {"convert_element_type", "broadcast_in_dim", "reshape",
                 "transpose", "copy"}
 
 
-def _chase_to_mul(level, var, depth=6):
-    """Follow `var` through transparent ops to the first `mul` consumer
-    within the level; returns that mul eqn or None."""
+def _consumer_index(jaxpr):
+    idx: dict = {}
+    for eqn in jaxpr.eqns:
+        for iv in eqn.invars:
+            if _aval(iv) is not None and not isinstance(iv, (int, float)):
+                idx.setdefault(id(iv), []).append(eqn)
+    return idx
+
+
+def _chase_to_mul(jaxpr, idx, var, depth=6):
+    """Follow `var` through transparent ops to the first `mul` consumer;
+    returns that mul eqn or None."""
     frontier = [var]
     for _ in range(depth):
         nxt = []
         for v in frontier:
-            for eqn in level.consumers.get(id(v), []):
+            for eqn in idx.get(id(v), []):
                 if eqn.primitive.name == "mul":
                     return eqn
                 if eqn.primitive.name in _TRANSPARENT:
@@ -244,14 +286,14 @@ _SOFTMAX_THROUGH = _TRANSPARENT | {"div", "mul", "sub", "max", "min",
 _SOFTMAX_ANCHORS = {"reduce_max", "exp"}
 
 
-def _chase_to_prims(level, var, targets, through, depth=8):
+def _chase_to_prims(idx, var, targets, through, depth=8):
     """Follow `var` through `through` ops to the first consumer in
-    `targets` within the level; returns that eqn or None."""
+    `targets`; returns that eqn or None."""
     frontier = [var]
     for _ in range(depth):
         nxt = []
         for v in frontier:
-            for eqn in level.consumers.get(id(v), []):
+            for eqn in idx.get(id(v), []):
                 if eqn.primitive.name in targets:
                     return eqn
                 if eqn.primitive.name in through:
@@ -262,14 +304,14 @@ def _chase_to_prims(level, var, targets, through, depth=8):
     return None
 
 
-def _produced_by(level, var, targets, through, depth=8):
+def _produced_by(producers, var, targets, through, depth=8):
     """Walk `var`'s producer chain through `through` ops; True when a
     producer in `targets` is reached."""
     frontier = [var]
     for _ in range(depth):
         nxt = []
         for v in frontier:
-            eqn = level.producers.get(id(v))
+            eqn = producers.get(id(v))
             if eqn is None:
                 continue
             if eqn.primitive.name in targets:
@@ -335,7 +377,6 @@ def audit_fusion_misses(closed_jaxpr, platform: str | None = None,
         platform = jax.default_backend()
     if min_elems is None:
         min_elems = int(flag("FLAGS_analysis_fusion_min_elems"))
-    idx = ProgramIndex.ensure(closed_jaxpr)
     findings = []
     rope_head_counts: list[int] = []
     rope_findings: list[Finding] = []
@@ -356,8 +397,8 @@ def audit_fusion_misses(closed_jaxpr, platform: str | None = None,
         findings.append(f)
         return f
 
-    has_rng = any(p in idx.eqns_by_prim
-                  for p in ("random_bits", "threefry2x32"))
+    has_rng = any(e.primitive.name in ("random_bits", "threefry2x32")
+                  for e in iter_eqns(closed_jaxpr))
 
     def emit_decode(eqn):
         """The decode-attention anchor's finding: severity from the REAL
@@ -385,22 +426,24 @@ def audit_fusion_misses(closed_jaxpr, platform: str | None = None,
             {"kind": "decode-attn", "shape": list(shape),
              "dtype": in_dtype, "elements": n, "gate": reason}))
 
-    for level in idx.levels:
-        for eqn in level.jaxpr.eqns:
+    for j in iter_jaxprs(closed_jaxpr):
+        idx = _consumer_index(j)
+        producers = {id(ov): e for e in j.eqns for ov in e.outvars}
+        for eqn in j.eqns:
             prim = eqn.primitive.name
             if prim == "dot_general":
                 shape = _shape_dtype(eqn.outvars[0])[0]
                 if (shape is not None and len(shape) == 3
-                        and _produced_by(level, eqn.invars[1],
+                        and _produced_by(producers, eqn.invars[1],
                                          {"gather"},
                                          _TRANSPARENT | {"mul"})
-                        and _chase_to_prims(level, eqn.outvars[0],
+                        and _chase_to_prims(idx, eqn.outvars[0],
                                             _SOFTMAX_ANCHORS,
                                             _SOFTMAX_THROUGH) is not None):
                     emit_decode(eqn)
                 continue
             if prim in ("rsqrt", "logistic"):
-                mul = _chase_to_mul(level, eqn.outvars[0])
+                mul = _chase_to_mul(j, idx, eqn.outvars[0])
                 if mul is None:
                     continue
                 shape, dtype = _shape_dtype(mul.outvars[0])
@@ -409,12 +452,11 @@ def audit_fusion_misses(closed_jaxpr, platform: str | None = None,
                 emit("norm" if prim == "rsqrt" else "swiglu/silu",
                      shape, dtype)
             elif prim == "concatenate":
-                if not any(level.producers.get(id(iv)) is not None
-                           and level.producers[id(iv)].primitive.name
-                           == "neg"
+                if not any(producers.get(id(iv)) is not None
+                           and producers[id(iv)].primitive.name == "neg"
                            for iv in eqn.invars):
                     continue
-                mul = _chase_to_mul(level, eqn.outvars[0])
+                mul = _chase_to_mul(j, idx, eqn.outvars[0])
                 if mul is None:
                     continue
                 shape, dtype = _shape_dtype(eqn.outvars[0])
@@ -425,7 +467,7 @@ def audit_fusion_misses(closed_jaxpr, platform: str | None = None,
                     rope_head_counts.append(int(shape[2]))
                     rope_findings.append(f)
             elif prim in ("lt", "gt", "ge", "le") and has_rng:
-                mul = _chase_to_mul(level, eqn.outvars[0])
+                mul = _chase_to_mul(j, idx, eqn.outvars[0])
                 if mul is None:
                     continue
                 shape, dtype = _shape_dtype(mul.outvars[0])
@@ -448,15 +490,12 @@ def audit_fusion_misses(closed_jaxpr, platform: str | None = None,
 
 def audit_compiled(cf, policy: str | None = None,
                    platform: str | None = None,
-                   loc: str = "<function>", mesh=None) -> list[Finding]:
+                   loc: str = "<function>") -> list[Finding]:
     """Run every jaxpr/function-level detector over a CompiledFunction:
     D3 on the capture outcome, D2 on the donation state, and (for each
-    compiled specialization whose program was retained) ONE ProgramIndex
-    walk feeding D1/D4, the callback scan, and the SPMD trio D9-D11
-    (`mesh` declares the mesh for D9 when the jaxpr alone can't recover
-    one)."""
+    compiled specialization whose program was retained) D1/D4 plus the
+    callback scan on the jaxpr."""
     from ..core.flags import flag
-    from .spmd import audit_spmd
 
     findings = list(audit_host_sync(cf, loc))
     findings += audit_donation(cf, loc)
@@ -470,10 +509,8 @@ def audit_compiled(cf, policy: str | None = None,
                 "— jaxpr detectors (dtype-stream, fusion-miss, callbacks) "
                 "skipped for it", {"spec_key": str(key)[:80]}))
             continue
-        idx = cf.program_index(key) if hasattr(cf, "program_index") \
-            else build_index(cf.program_jaxpr(key))
-        findings += audit_dtype_stream(idx, policy=policy, loc=loc)
-        findings += audit_fusion_misses(idx, platform=platform, loc=loc)
-        findings += audit_callbacks(idx, loc=loc)
-        findings += audit_spmd(idx, mesh=mesh, loc=loc)
+        jx = cf.program_jaxpr(key)
+        findings += audit_dtype_stream(jx, policy=policy, loc=loc)
+        findings += audit_fusion_misses(jx, platform=platform, loc=loc)
+        findings += audit_callbacks(jx, loc=loc)
     return findings

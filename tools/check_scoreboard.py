@@ -170,7 +170,7 @@ def check(repo=REPO, details_path=None, rtol=RTOL):
     return failures
 
 
-def lint_gate(models="llama,gpt,bert,paged,obs,ckpt", timeout=900):
+def lint_gate(models="llama,gpt,bert,paged,obs,ckpt,spmd", timeout=900):
     """The graft_lint CI gate (round-9; round-10 adds the `paged` serving
     smoke — a tiny-LLaMA 2-slot continuous-batching engine whose decode
     step program is audited at default flags; round-11 adds the `obs`
@@ -182,11 +182,18 @@ def lint_gate(models="llama,gpt,bert,paged,obs,ckpt", timeout=900):
     the warmed engine must dump a valid Perfetto trace whose request
     spans tile TTFT, every driven decode bucket must carry XLA costs,
     and analysis D8 gates per-program bytes-accessed against the
-    committed tools/cost_baseline.json): the AST lint plus the
+    committed tools/cost_baseline.json; round-15 adds the `spmd`
+    sharding smoke — the tp x dp hybrid train step audits clean through
+    D9 sharding-coverage / D10 collective / D11 transfer on the
+    8-device virtual mesh, the D9-D11 fire fixtures must still produce
+    warnings, and stale lint_baseline.json suppressions fail the
+    full-coverage run): the AST lint plus the
     jaxpr program audits over the model smoke configs must come back
     clean (no unsuppressed warning/error past tools/lint_baseline.json).
     Runs the CLI in a subprocess so its jax session / flag flips can't
-    leak into the caller. Returns failure strings (empty = clean)."""
+    leak into the caller. Returns failure strings (empty = clean); also
+    prints the per-detector finding counts so drift between runs is
+    visible in the gate log even when the gate passes."""
     import subprocess
 
     # D8 prerequisite: the committed baseline must exist BEFORE the
@@ -216,6 +223,11 @@ def lint_gate(models="llama,gpt,bert,paged,obs,ckpt", timeout=900):
     except ValueError:
         return [f"graft_lint produced no JSON (rc={proc.returncode}): "
                 f"{proc.stderr[-800:] or proc.stdout[-800:]}"]
+    by_det = payload.get("by_detector", {})
+    print("LINT per-detector findings: "
+          + (", ".join(f"{k}={v}" for k, v in sorted(by_det.items()))
+             or "none")
+          + f" (suppressed={payload.get('suppressed', 0)})")
     fails = [f for f in payload.get("findings", [])
              if not f.get("suppressed")
              and f.get("severity") in ("warning", "error")]

@@ -39,17 +39,36 @@ checkpoint gets a bit flipped, and restore must fall back to the last
 good one with a named reason and bit-exact state — plus the checkpoint
 stall/failure audit (obs.audit_ckpt_stalls).
 
+The special model name `spmd` (round 15) audits the SHARDED surface: the
+tp x dp hybrid train step (the same shape __graft_entry__.dryrun_multichip
+phase A proves) compiles on the 8-device virtual CPU mesh with
+FLAGS_jit_debug_program=1 and runs through the full detector suite
+INCLUDING the SPMD trio — D9 sharding coverage (every non-trivial mesh
+axis must appear on a stream-size tensor's sharding), D10 collective
+audit (jaxpr-level collectives attributed to axes with byte volume;
+accidental all-gathers warn), D11 in-program device_put. The smoke then
+SELF-TESTS the fire fixtures: a deliberately unsharded stream tensor, a
+gratuitous all-gather, and an in-program device_put must each produce an
+unsuppressed warning — a detector that stopped firing fails the gate
+exactly like a detector that started firing falsely. To give the spmd
+smoke its mesh, the CLI forces the same virtual 8-device CPU platform
+tests/conftest.py uses, for every smoke.
+
 Exit code: 0 when no unsuppressed warning/error finding survives the
 baseline (notes never fail); 1 otherwise. CI runs
-`graft_lint.py --models llama,gpt,bert,paged,obs,ckpt --json` via
-tools/check_scoreboard.
+`graft_lint.py --models llama,gpt,bert,paged,obs,ckpt,spmd --json` via
+tools/check_scoreboard. Baseline entries that matched ZERO findings are
+reported as `stale-suppression` (warning on a full-coverage run, note on
+a partial one); `--prune-baseline` rewrites the baseline without them.
 
 Usage:
     python tools/graft_lint.py                      # AST lint + D5 only
-    python tools/graft_lint.py --models llama,gpt,bert,paged
+    python tools/graft_lint.py --models llama,gpt,bert,paged,spmd
     python tools/graft_lint.py --json               # machine output
     python tools/graft_lint.py --baseline my.json   # suppression file
     python tools/graft_lint.py --no-ast             # jaxpr audits only
+    python tools/graft_lint.py --models llama,gpt,bert,paged,obs,ckpt,spmd \
+        --prune-baseline                            # drop stale suppressions
 
 Baseline format: see paddle_tpu/analysis/findings.py (default file
 tools/lint_baseline.json; suppressed findings stay visible in --json).
@@ -65,6 +84,34 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 DEFAULT_BASELINE = os.path.join(REPO, "tools", "lint_baseline.json")
+
+#: the full CI smoke set (check_scoreboard.lint_gate's default): staleness
+#: of baseline entries is only a gate FAILURE when a run covers all of it
+#: — a partial run legitimately leaves model-specific suppressions
+#: unmatched
+CI_MODELS = ("llama", "gpt", "bert", "paged", "obs", "ckpt", "spmd")
+
+#: one tiny-LLaMA shared by the serving-side smokes (`paged`, `obs`): the
+#: engines key their AOT executables on spec + param AVALS, so a shared
+#: instance guarantees every engine in the run rides the round-14
+#: executable cache instead of warming its own programs
+_TINY_MODEL = None
+
+
+def _tiny_llama():
+    global _TINY_MODEL
+    if _TINY_MODEL is None:
+        import paddle_tpu as paddle
+        from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                          intermediate_size=128, num_hidden_layers=2,
+                          num_attention_heads=4,
+                          max_position_embeddings=64)
+        _TINY_MODEL = LlamaForCausalLM(cfg)
+        _TINY_MODEL.eval()
+    return _TINY_MODEL
 
 
 def audit_model(name: str) -> list:
@@ -136,14 +183,9 @@ def audit_serving() -> list:
     from paddle_tpu import analysis, obs
     from paddle_tpu.core.flags import flag
     from paddle_tpu.inference.engine import ServingEngine
-    from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
 
     paddle.seed(0)
-    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
-                      num_hidden_layers=2, num_attention_heads=4,
-                      max_position_embeddings=64)
-    model = LlamaForCausalLM(cfg)
-    model.eval()
+    model = _tiny_llama()
     eng = ServingEngine(model, max_slots=2)
     rs = np.random.RandomState(0)
     for ln, nt in ((3, 2), (6, 5), (4, 3)):
@@ -262,15 +304,10 @@ def audit_obs() -> list:
     import paddle_tpu as paddle
     from paddle_tpu import analysis, obs
     from paddle_tpu.inference.engine import ServingEngine
-    from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
 
     paddle.seed(0)
     obs.clear_events()
-    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
-                      num_hidden_layers=2, num_attention_heads=4,
-                      max_position_embeddings=64)
-    model = LlamaForCausalLM(cfg)
-    model.eval()
+    model = _tiny_llama()
     eng = ServingEngine(model, max_slots=2)
     rs = np.random.RandomState(0)
     for ln, nt in ((3, 3), (6, 4), (4, 3)):     # warm both slot buckets
@@ -471,23 +508,195 @@ def audit_ckpt() -> list:
     return findings
 
 
-def run(models=(), ast=True, baseline_path=DEFAULT_BASELINE):
+def audit_spmd() -> list:
+    """The `spmd` smoke (round 15): compile the tp x dp hybrid train step
+    (phase A of __graft_entry__.dryrun_multichip — fleet GSPMD sharding,
+    tensor+sequence parallel tiny-LLaMA) on the 8-device virtual mesh and
+    run the FULL detector suite over it, mesh-declared so D9 judges
+    coverage even where the jaxpr alone couldn't recover the mesh. Then
+    self-test the fire fixtures: each SPMD detector must still PRODUCE
+    its warning on a deliberately broken program (unsharded stream /
+    gratuitous all-gather / in-program device_put) — a silently-dead
+    detector fails the gate like a falsely-firing one."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny_config
+
+    if len(jax.devices()) < 8:
+        return [analysis.Finding(
+            "spmd-smoke", "error", "spmd/mesh",
+            f"the spmd smoke needs >= 8 devices for the tp x dp mesh, got "
+            f"{len(jax.devices())} — run through tools/graft_lint.py (it "
+            "forces --xla_force_host_platform_device_count=8 before the "
+            "backend initializes) or set XLA_FLAGS yourself")]
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    mesh = fleet.get_hybrid_communicate_group().get_mesh()
+
+    paddle.seed(0)
+    cfg = llama_tiny_config(tensor_parallel=True, sequence_parallel=True)
+    model = LlamaForCausalLM(cfg)
+    model = fleet.distributed_model(model)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+
+    paddle.set_flags({"FLAGS_jit_debug_program": True})
+    try:
+        @paddle.jit.to_static
+        def train_step(ids, labels):
+            loss = model(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        rs = np.random.RandomState(1)
+        batch, seq = 8, 32
+        loss = None
+        for _ in range(4):
+            ids = paddle.to_tensor(
+                rs.randint(0, cfg.vocab_size, (batch, seq)).astype("int64"))
+            labels = paddle.to_tensor(
+                rs.randint(0, cfg.vocab_size, (batch, seq)).astype("int64"))
+            loss = train_step(ids, labels)
+        assert np.isfinite(float(loss)), "spmd train step diverged"
+
+        findings = analysis.audit_compiled(train_step, mesh=mesh,
+                                           loc="spmd/train_step")
+        vol = analysis.jaxpr_collective_bytes(train_step.program_jaxpr())
+        findings.append(analysis.Finding(
+            "spmd-smoke", "note", "spmd/train_step",
+            f"tp x dp train step compiled on mesh "
+            f"{dict(mesh.shape)}; jaxpr-level collective volume "
+            f"{vol['total']} B/device over {vol['sites']} site(s) "
+            "(GSPMD-inserted collectives live in HLO below the jaxpr)",
+            data=vol))
+    finally:
+        paddle.set_flags({"FLAGS_jit_debug_program": False})
+    findings += _audit_spmd_fixtures(mesh)
+    return findings
+
+
+def _audit_spmd_fixtures(mesh) -> list:
+    """Fire-fixture self-test for D9/D10/D11 (see audit_spmd)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu import analysis
+
+    # D9: a residual stream explicitly replicated along every mesh axis
+    def unsharded(x):
+        for _ in range(4):
+            x = jax.lax.with_sharding_constraint(
+                x + 1.0, NamedSharding(mesh, P(None, None, None)))
+        return x
+
+    jx9 = jax.make_jaxpr(unsharded)(jnp.ones((8, 32, 64), jnp.float32))
+    d9 = [f for f in analysis.audit_sharding_coverage(jx9, mesh=mesh)
+          if f.severity == "warning"]
+
+    # D10: an all_gather whose output only feeds elementwise ops —
+    # 128x256 f32 = 131072 B/device, above the default warning floor
+    gather_axis = list(mesh.shape)[-1]
+
+    def gratuitous(x):
+        g = jax.lax.all_gather(x, gather_axis, axis=0, tiled=True)
+        return g * 2.0 + 1.0
+
+    fn = shard_map(gratuitous, mesh=mesh, in_specs=P(gather_axis),
+                   out_specs=P(), check_rep=False)
+    jx10 = jax.make_jaxpr(fn)(jnp.ones((128, 256), jnp.float32))
+    d10 = [f for f in analysis.audit_collectives(jx10)
+           if f.severity == "warning"]
+
+    # D11: a device_put inside the program
+    def putter(x):
+        return jax.device_put(x * 2.0, NamedSharding(mesh, P())) + 1.0
+
+    jx11 = jax.make_jaxpr(putter)(jnp.ones((8, 8), jnp.float32))
+    d11 = [f for f in analysis.audit_transfers(jx11)
+           if f.severity == "warning"]
+
+    findings = []
+    for det, fired in (("D9 spmd-coverage (unsharded stream)", d9),
+                       ("D10 spmd-collective (gratuitous all-gather)", d10),
+                       ("D11 spmd-transfer (in-program device_put)", d11)):
+        if fired:
+            findings.append(analysis.Finding(
+                "spmd-smoke", "note", "spmd/fire-fixtures",
+                f"{det}: fire fixture produced "
+                f"{len(fired)} unsuppressed warning(s) — the detector "
+                "gates", data={"warnings": len(fired)}))
+        else:
+            findings.append(analysis.Finding(
+                "spmd-smoke", "error", "spmd/fire-fixtures",
+                f"{det}: the fire fixture produced NO warning — the "
+                "detector went silently dead and sharding regressions "
+                "would pass lint"))
+    return findings
+
+
+def run(models=(), ast=True, baseline_path=DEFAULT_BASELINE,
+        prune_baseline=False):
     from paddle_tpu import analysis
 
     findings = []
     if ast:
         findings += analysis.lint_tree(REPO)
     findings += analysis.audit_tune_cache()
+    smokes = {"paged": audit_serving, "obs": audit_obs,
+              "ckpt": audit_ckpt, "spmd": audit_spmd}
     for name in models:
-        if name == "paged":
-            findings += audit_serving()
-        elif name == "obs":
-            findings += audit_obs()
-        elif name == "ckpt":
-            findings += audit_ckpt()
+        findings += smokes.get(name, lambda n=name: audit_model(n))()
+    baseline = analysis.load_baseline(baseline_path)
+    analysis.apply_baseline(findings, baseline)
+
+    # stale-suppression detection: an entry that suppressed nothing can
+    # only mask a future real finding. On a FULL-coverage run (AST lint +
+    # every CI smoke) that is a gate failure; on a partial run it is
+    # informational (model-specific entries legitimately go unmatched).
+    stale = analysis.stale_suppressions(baseline)
+    full = ast and set(CI_MODELS) <= set(models)
+    if stale and prune_baseline:
+        if not full:
+            findings.append(analysis.Finding(
+                "stale-suppression", "error", baseline_path,
+                "--prune-baseline requires a full-coverage run (--models "
+                f"{','.join(CI_MODELS)} with the AST lint on): a partial "
+                "run cannot tell a dead suppression from one whose smoke "
+                "did not compile"))
         else:
-            findings += audit_model(name)
-    analysis.apply_baseline(findings, analysis.load_baseline(baseline_path))
+            kept = [{k: v for k, v in e.items() if not k.startswith("_")}
+                    for e in baseline if e.get("_matched")]
+            with open(baseline_path, "w") as fh:
+                json.dump({"suppressions": kept}, fh, indent=2)
+                fh.write("\n")
+            for e in stale:
+                findings.append(analysis.Finding(
+                    "stale-suppression", "note", baseline_path,
+                    f"pruned stale suppression (matched zero findings): "
+                    f"detector={e['detector']!r} match={e['match']!r}",
+                    data={k: v for k, v in e.items()
+                          if not k.startswith("_")}))
+            stale = []
+    for e in stale:
+        findings.append(analysis.Finding(
+            "stale-suppression", "warning" if full else "note",
+            baseline_path,
+            f"suppression matched zero findings this run: "
+            f"detector={e['detector']!r} match={e['match']!r}"
+            + (f" (reason: {e['reason']})" if e.get("reason") else "")
+            + (" — remove it or rerun with --prune-baseline" if full else
+               " — partial run; rerun with the full CI model set to "
+               "confirm staleness"),
+            data={k: v for k, v in e.items() if not k.startswith("_")}))
     return findings
 
 
@@ -495,21 +704,38 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--models", default="",
                     help="comma-separated smoke configs to audit "
-                         "(llama,gpt,bert,paged,obs,ckpt)")
+                         f"({','.join(CI_MODELS)})")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help=f"suppression file (default {DEFAULT_BASELINE})")
     ap.add_argument("--no-ast", action="store_true",
                     help="skip the AST lint (jaxpr/VMEM audits only)")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="rewrite the baseline without entries that "
+                         "matched zero findings (full-coverage runs only)")
     args = ap.parse_args(argv)
 
+    # every smoke runs on the same virtual 8-device CPU platform the test
+    # suite uses (tests/conftest.py): the spmd smoke needs the mesh, the
+    # others behave identically — must happen before the backend
+    # initializes, i.e. before paddle_tpu imports
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = (
+            xla_flags + " --xla_force_host_platform_device_count=8").strip()
+    if os.environ["JAX_PLATFORMS"] == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
     models = [m for m in args.models.split(",") if m]
     from paddle_tpu import analysis
 
     findings = run(models=models, ast=not args.no_ast,
-                   baseline_path=args.baseline)
+                   baseline_path=args.baseline,
+                   prune_baseline=args.prune_baseline)
     if args.as_json:
         print(json.dumps(analysis.to_json(findings), indent=2))
     else:
