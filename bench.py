@@ -31,6 +31,12 @@ The ladder is TIME-BOXED (BENCH_BUDGET_S, default 1500 s): flagship rows
 run first, configs that no longer fit the remaining budget are skipped and
 listed under "skipped" in BENCH_DETAILS.json, and the run exits rc 0.
 
+History (round 16): every completed rung ALSO appends one platform-tagged
+JSONL record to BENCH_HISTORY.jsonl ({run, t, rung, platform, record}),
+so the perf trajectory persists across runs instead of each capture
+overwriting the last — `tools/bench_trend.py` diffs the latest two
+comparable (same rung, same platform) records and flags >10% regressions.
+
 Reference parity: the role of tools/ci_op_benchmark.sh +
 python/paddle/cost_model/static_op_benchmark.json — self-measured A/B
 numbers, since the reference publishes no end-to-end figures (BASELINE.md).
@@ -1438,6 +1444,30 @@ _COST_EST = {
 }
 
 
+#: per-run rung history (round 16): BENCH_DETAILS.json is a merge-on-store
+#: snapshot (a rerun REPLACES a rung's row), so the perf trajectory was
+#: empty — nothing persisted across runs. Each completed rung now also
+#: appends one platform-tagged record here; tools/bench_trend.py diffs
+#: the latest two comparable records per rung.
+HISTORY_PATH = "BENCH_HISTORY.jsonl"
+
+
+def _append_history(run_id, name, res, path=HISTORY_PATH):
+    """One JSONL history line per completed rung. Best-effort: a broken
+    history file must never fail the bench run. Error rows are skipped —
+    a failed rung has no numbers to trend."""
+    if not isinstance(res, dict) or "error" in res:
+        return False
+    try:
+        with open(path, "a") as fh:
+            fh.write(json.dumps(
+                {"run": run_id, "t": time.time(), "rung": name,
+                 "platform": res.get("platform"), "record": res}) + "\n")
+        return True
+    except OSError:
+        return False
+
+
 def main(argv):
     import os
     import subprocess
@@ -1478,6 +1508,9 @@ def main(argv):
     # flagship rows always first in line.
     budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
     t_start = time.perf_counter()
+    # one id per ladder invocation: bench_trend groups history lines by
+    # run so a partial rerun's rows don't pair with themselves
+    run_id = f"{time.strftime('%Y%m%dT%H%M%S')}-{os.getpid()}"
     for name in which:
         remaining = budget - (time.perf_counter() - t_start)
         est = _COST_EST.get(name, 180)
@@ -1509,6 +1542,7 @@ def main(argv):
                 res = json.loads(ln[len("BENCH_RESULT "):])
         if res is not None:
             details["results"][name] = res
+            _append_history(run_id, name, res)
             print(f"[bench] {name}: {res}", file=sys.stderr)
         else:
             tail = ((err or out).strip().splitlines() or ["<no output>"])[-3:]

@@ -63,6 +63,16 @@ here because its output is Findings):
                          the committed tools/cost_baseline.json — the
                          HBM-traffic budget regressed; gated by the
                          graft_lint `obs` smoke like a dtype regression.
+
+Training detector (round 16, implemented in obs/goodput.py and
+re-exported here because its output is Findings):
+  D12 audit_train_steps  training-step health over the train flight
+                         recorder + goodput ledger: a data-starvation
+                         STREAK (consecutive steps blocked on input past
+                         FLAGS_obs_data_wait_ms) and an MFU COLLAPSE
+                         (recent median a fraction of the run median)
+                         are warnings — gated by the graft_lint `obs`
+                         smoke's instrumented Model.fit.
 """
 from .ast_lint import (audit_flags_doc, lint_dy2static, lint_file,
                        lint_tree, lint_vjp_saves, lint_x64)
@@ -99,8 +109,24 @@ def audit_cost_regressions(baseline, entries=None, threshold_pct=None,
                  loc=loc)
 
 
+def audit_train_steps(recorder=None, ledger=None, data_wait_ms=None,
+                      streak=3, collapse_ratio=0.5, min_mfu_steps=16,
+                      loc="obs/train"):
+    """D12: training-step health over the flight recorder's step ring +
+    the goodput ledger's MFU history — data-starvation streaks and MFU
+    collapse become lint findings (obs/goodput.py) — deferred import
+    like D6."""
+    from ..obs.goodput import audit_train_steps as _impl
+
+    return _impl(recorder=recorder, ledger=ledger,
+                 data_wait_ms=data_wait_ms, streak=streak,
+                 collapse_ratio=collapse_ratio,
+                 min_mfu_steps=min_mfu_steps, loc=loc)
+
+
 __all__ = [
     "audit_recompiles", "audit_prefix_cache", "audit_cost_regressions",
+    "audit_train_steps",
     "Finding", "apply_baseline", "format_text", "gate_failures",
     "load_baseline", "stale_suppressions", "to_json",
     "ProgramIndex", "build_index",

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 from .core import (CheckpointSaveError, clean_debris, gc_checkpoints,
                    host_copy, save_checkpoint)
@@ -77,10 +78,21 @@ class AsyncCheckpointer:
                 self._q.task_done()
 
     def _commit(self, step, host_tree):
+        # overlapped-IO span for the training flight recorder (round 16):
+        # the background serialize+fsync+rename lands on the trace's
+        # ckpt-io track so its overlap with train steps is VISIBLE —
+        # it costs goodput nothing, only the blocking host copy does
+        from ..obs.train_flight import current as _tf_current
+
+        rec = _tf_current()
+        t0 = time.perf_counter()
         res = save_checkpoint(self.root, step, host_tree,
                               fingerprint_extra=self.fingerprint_extra,
                               host_copied=True)   # save() snapshotted it
         gc_checkpoints(self.root, self.keep_last_n)
+        if rec is not None:
+            rec.io_span("ckpt_commit", t0, time.perf_counter(),
+                        step=int(step))
         return res
 
     # --------------------------------------------------------------- API
@@ -90,7 +102,18 @@ class AsyncCheckpointer:
         parked :class:`CheckpointSaveError` from an earlier async save
         before accepting new work."""
         self._raise_parked()
+        from ..obs import goodput as _goodput
+        from ..obs.train_flight import current as _tf_current
+
+        rec = _tf_current()
+        t0 = time.perf_counter()
         host = host_copy(tree)
+        t1 = time.perf_counter()
+        if rec is not None:
+            # the BLOCKING half: the device->host snapshot the train
+            # loop waits on (the async commit overlaps on its own track)
+            rec.program_span("ckpt_host_copy", t0, t1, step=int(step))
+        _goodput.note_ckpt(t1 - t0)
         if block:
             # drain in-flight background saves FIRST: two concurrent
             # commits on one root would race the `latest` pointer (a
@@ -99,7 +122,13 @@ class AsyncCheckpointer:
             # renames.  The blocking save is the preemption path — it
             # must end up the newest published state.
             self._q.join()
+            t2 = time.perf_counter()
             res = self._commit(step, host)
+            t3 = time.perf_counter()
+            if rec is not None:
+                rec.program_span("ckpt_blocking_save", t2, t3,
+                                 step=int(step))
+            _goodput.note_ckpt(t3 - t2)
             with self._lock:
                 self._results.append(res)
             return res

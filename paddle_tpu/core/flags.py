@@ -319,6 +319,27 @@ define_flag("FLAGS_obs_cost_regress_pct", 25.0,
             "program whose bytes-accessed grew more than this percent "
             "over tools/cost_baseline.json fails lint like a dtype "
             "regression")
+define_flag("FLAGS_obs_train_flight_steps", 64,
+            "training flight-recorder ring capacity "
+            "(obs/train_flight.py): finished per-step span timelines "
+            "kept for dump_trace(); the oldest finished step is evicted "
+            "past the cap — the active step never is")
+define_flag("FLAGS_obs_data_wait_ms", 100.0,
+            "data-starvation threshold for the training flight recorder "
+            "and analysis D12: a step whose data_wait span (loader "
+            "blocked before the batch arrived) exceeds this many ms "
+            "counts a data_starvation anomaly and auto-dumps the step "
+            "ring (FLAGS_obs_flight_dir); 0 = trigger off")
+define_flag("FLAGS_obs_step_spike_factor", 3.0,
+            "step-time-spike anomaly trigger: a train step whose wall "
+            "exceeds this factor times the rolling median of recent "
+            "steps (min population 8) auto-dumps the step ring; "
+            "0 = trigger off")
+define_flag("FLAGS_obs_peak_tflops", 0.0,
+            "peak device compute (TFLOP/s, bf16) the train_mfu gauges "
+            "divide achieved FLOP/s by; 0 = per-backend default "
+            "(obs/goodput.py PEAK_TFLOPS_DEFAULTS — a nominal host "
+            "number off-chip, do not quote)")
 
 
 # the full reference flag surface (compat entries; must come after the

@@ -177,14 +177,57 @@ _seg_misses = 0
 _flushes_total = 0
 
 
+class FlushScope:
+    """One attribution scope for segment flushes (round 16). Flushes
+    credit the INNERMOST active scope only, so a nested ``Model.fit``
+    (its ``TelemetryCallback`` pushes its own scope) never double-counts
+    into the outer fit's per-step delta, and a callback reattached to a
+    second fit re-baselines by pushing a fresh scope instead of diffing
+    the process-global total (which still carries the prior fit's
+    flushes)."""
+
+    __slots__ = ("count",)
+
+    def __init__(self):
+        self.count = 0
+
+
+#: innermost-active-scope stack; empty = flushes only hit the global
+_flush_scopes: list[FlushScope] = []
+
+
+def push_flush_scope() -> FlushScope:
+    s = FlushScope()
+    _flush_scopes.append(s)
+    return s
+
+
+def pop_flush_scope(scope: FlushScope):
+    """Pop ``scope`` (and anything pushed above it that a non-local exit
+    failed to pop — exception-robust like a context manager)."""
+    if scope in _flush_scopes:
+        while _flush_scopes:
+            if _flush_scopes.pop() is scope:
+                break
+
+
+def _count_flush():
+    global _flushes_total
+    _flushes_total += 1
+    if _flush_scopes:
+        _flush_scopes[-1].count += 1
+
+
 def seg_cache_info():
     return {"entries": len(_seg_cache), "hits": _seg_hits,
             "misses": _seg_misses}
 
 
 def flush_info() -> dict:
-    """Segment-flush telemetry for obs consumers (hapi TelemetryCallback
-    diffs `flushes` across a step to count graph-break syncs)."""
+    """Segment-flush telemetry for obs consumers. NOTE: ``flushes`` is
+    the PROCESS total; per-fit deltas must come from a
+    :class:`FlushScope` (push/pop around the fit) — the round-16 fix for
+    sequential/nested fits re-reporting each other's flushes."""
     return {"flushes": _flushes_total, **seg_cache_info()}
 
 
@@ -243,7 +286,7 @@ class Segment:
 
     # ------------------------------------------------------------ flush
     def flush(self, reason="concretization"):
-        global _seg_hits, _seg_misses, _flushes_total
+        global _seg_hits, _seg_misses
         if self.flushed:
             return
         self.flushed = True  # first, so re-entrant get() can't recurse
@@ -251,7 +294,7 @@ class Segment:
             self.ctx.open_seg = None
         if not self.ops:
             return
-        _flushes_total += 1
+        _count_flush()
         if self.ctx is not None:
             self.ctx.segments_flushed += 1
             from .flags import flag as _flag
@@ -280,12 +323,27 @@ class Segment:
             _seg_cache[sig] = exe
         else:
             _seg_hits += 1
+        # flush-site span for the training flight recorder (round 16):
+        # a graph-break host sync shows up ON the step timeline with its
+        # replay wall — the recorder check is one module attr read, so
+        # uninstrumented flushes pay nothing measurable
+        from ..obs.train_flight import current as _tf_current
+
+        _rec = _tf_current()
+        _n_ops = len(self.ops)
+        if _rec is not None:
+            import time as _time
+
+            _t0 = _time.perf_counter()
         try:
             outs, vjps = exe(self.ext)
         finally:
             ops, self.ops = self.ops, []
             self.ext = []
             self.ext_ids = {}
+        if _rec is not None:
+            _rec.program_span("lazy_flush", _t0, _time.perf_counter(),
+                              reason=reason, ops=_n_ops)
         oi = vi = 0
         for rec, has_vjp in zip(ops, need_vjp):
             for ld in rec.out_lazy:

@@ -269,6 +269,11 @@ class CheckpointCallback(Callback):
         if self._preempted:
             # preemption: final synchronous save, then stop the loop
             # (fit breaks out of the epoch MID-epoch on stop_training)
+            from ..obs.train_flight import current as _tf_current
+
+            rec = _tf_current()
+            if rec is not None:
+                rec.mark("preemption", step=self.global_step)
             self._save(block=True)
             self._preempt_saved = True
             self.model.stop_training = True
@@ -389,15 +394,30 @@ class LRScheduler(Callback):
 
 
 class TelemetryCallback(Callback):
-    """Train-loop telemetry into an obs metrics registry (round 11).
+    """Train-loop telemetry into an obs metrics registry (round 11;
+    recorder-backed since round 16).
 
     Per train batch: step wall time (histogram), loss (gauge), tokens/s
-    (gauge, when the batch's token count is derivable), and the
-    segmented-lazy flush count this step forced (counter, diffed from
-    core/lazy.py's process total — a step whose flush count grows is
-    paying graph-break host syncs). Per step it also mirrors the compile
-    watchdog's total, so a retrace mid-training shows in the same
-    registry the serving path exports.
+    (gauge, when the batch's token count is derivable), the
+    segmented-lazy flush count this step forced (counter, attributed
+    through a per-fit :class:`~paddle_tpu.core.lazy.FlushScope` so
+    sequential/nested fits never re-report each other's flushes), and —
+    new in round 16 — the full flight-recorder/goodput story:
+
+    * a :class:`~paddle_tpu.obs.TrainFlightRecorder` holds every step's
+      span timeline (data wait, h2d, fwd/bwd, optimizer commit, lazy
+      flush sites, compiled-step dispatches, blocking ckpt copies,
+      overlapped async-ckpt IO); ``cb.flight.dump(path)`` exports
+      Chrome-trace JSON and anomalies (data starvation / step spike /
+      ckpt stall) auto-dump postmortems to ``FLAGS_obs_flight_dir``;
+    * a :class:`~paddle_tpu.obs.GoodputLedger` accumulates
+      ``train_goodput_seconds_total{category}`` +
+      ``train_goodput_ratio`` and the MFU gauges
+      (``train_mfu{program}``, ``train_achieved_flops``) — the flops
+      numerator comes from the cost ledger of compiled ``to_static``
+      step programs executed during the step, or is declared via
+      ``step_flops`` (eager steps have no compiled program), the same
+      way token accounting is declared.
 
     Attach explicitly (``model.fit(..., callbacks=[TelemetryCallback()])``)
     or globally via ``FLAGS_obs_metrics=1`` (config_callbacks auto-adds
@@ -407,7 +427,8 @@ class TelemetryCallback(Callback):
     it the tokens/s gauge stays unset and step time/loss still record.
     """
 
-    def __init__(self, registry=None, batch_tokens=None):
+    def __init__(self, registry=None, batch_tokens=None, step_flops=None,
+                 flight=None):
         from .. import obs
 
         reg = registry if registry is not None else obs.default_registry()
@@ -422,41 +443,141 @@ class TelemetryCallback(Callback):
             "train_lazy_flushes_total",
             "segmented-lazy segment flushes forced during train steps "
             "(graph-break host syncs, core/lazy.py)")
+        if flight is False:
+            self.flight = None
+        elif flight is None or flight is True:
+            self.flight = obs.TrainFlightRecorder(registry=reg)
+        else:
+            self.flight = flight
+        self.ledger = obs.GoodputLedger(registry=reg)
         self._t0 = None
+        self._t_prev_end = None
+        self._cur = None
+        self._dw = 0.0
+        self._epoch = 0
+        self._step_index = 0        # monotonic across fits (ring index)
+        self._scope = None
         self._flush0 = 0
+        self._prev_recorder = None
+        self._prev_ledger = None
         self._batch_tokens = None if batch_tokens is None \
             else int(batch_tokens)
-
-    def _flushes(self):
-        from ..core.lazy import flush_info
-
-        return flush_info()["flushes"]
-
-    def on_train_batch_begin(self, step, logs=None):
-        self._t0 = time.time()
-        self._flush0 = self._flushes()
+        self._step_flops = None if step_flops is None else float(step_flops)
 
     def set_batch_tokens(self, n):
         """Override token accounting when inputs aren't id tensors."""
         self._batch_tokens = int(n)
         return self
 
+    def set_step_flops(self, n):
+        """Declare per-step FLOPs for the MFU gauges when the step has
+        no compiled program to read them from (eager training)."""
+        self._step_flops = float(n)
+        return self
+
+    # ------------------------------------------------------------- hooks
+    def on_train_begin(self, logs=None):
+        from ..core import lazy
+        from ..obs import goodput, train_flight
+
+        # re-baseline on (re)attach: a dangling _t0 / stale flush count
+        # from a fit that died mid-batch must not leak into this one
+        self._t0 = None
+        self._cur = None
+        self._scope = lazy.push_flush_scope()
+        self._flush0 = 0
+        if self.flight is not None:
+            self._prev_recorder = train_flight.set_current(self.flight)
+        self._prev_ledger = goodput.activate(self.ledger)
+        self.ledger.start()
+        self._t_prev_end = time.perf_counter()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        # re-anchor the data-wait window: the gap since the last batch
+        # is epoch-boundary work (metric resets, a mid-fit evaluate()
+        # pass) — counting it as a loader stall would fire a spurious
+        # data_starvation postmortem every epoch. Any replay booked
+        # BEFORE this point (a checkpoint at an exact epoch boundary:
+        # the resumed epoch drained without a real step) is also outside
+        # the new window — leaving it pending would subtract it from the
+        # first batch's wait and mask a real loader stall.
+        self._t_prev_end = time.perf_counter()
+        self.ledger.take_window_skip()
+        if self.flight is not None:
+            self.flight.mark("epoch_begin", epoch=epoch)
+
+    def on_train_batch_begin(self, step, logs=None):
+        now = time.perf_counter()
+        # loader stall = time since the previous step ended, net of any
+        # resume-replay wall the goodput ledger just recorded (replay is
+        # its own category, not a data wait)
+        base = self._t_prev_end if self._t_prev_end is not None else now
+        self._dw = max(now - base - self.ledger.take_window_skip(), 0.0)
+        if self.flight is not None:
+            self._cur = self.flight.step_begin(
+                self._step_index, self._epoch, now - self._dw, now)
+        self._t0 = now
+        self._flush0 = self._scope.count if self._scope is not None else 0
+
     def on_train_batch_end(self, step, logs=None):
         if self._t0 is None:
             return
-        dt = max(time.time() - self._t0, 1e-9)
+        end = time.perf_counter()
+        wall = end - self._t0
         self._t0 = None
-        self._m_step.observe(dt)
+        self._step_index += 1
+        self._m_step.observe(wall)
         self._m_steps.inc()
-        self._m_flushes.inc(max(self._flushes() - self._flush0, 0))
+        flushes = (self._scope.count - self._flush0) \
+            if self._scope is not None else 0
+        self._m_flushes.inc(max(flushes, 0))
         logs = logs or {}
         loss = logs.get("loss")
         if isinstance(loss, (list, tuple)):
             loss = loss[0] if loss else None
-        if isinstance(loss, (int, float, np.floating)):
-            self._m_loss.set(float(loss))
+        loss = float(loss) if isinstance(loss, (int, float, np.floating)) \
+            else None
+        if loss is not None:
+            self._m_loss.set(loss)
         if self._batch_tokens:
-            self._m_tps.set(self._batch_tokens / dt)
+            self._m_tps.set(self._batch_tokens / max(wall, 1e-9))
+        cur = self._cur
+        # measured at batch begin — valid with OR without the recorder
+        # (flight=False must still report data waits honestly)
+        dw = self._dw
+        if self._step_flops is not None:
+            flops, programs = self._step_flops, ()
+        elif cur is not None:
+            flops, programs = cur.flops, cur.programs
+        else:
+            flops, programs = 0.0, ()
+        self.ledger.observe_step(wall, data_wait_s=dw, flops=flops,
+                                 programs=programs)
+        if self.flight is not None:
+            # same `end`/`wall` floats the histogram observed — the
+            # dump-time tiling assertion holds bitwise by construction
+            self.flight.step_end(end, wall, loss=loss, flushes=flushes)
+        self._cur = None
+        self._t_prev_end = end
+
+    def on_train_end(self, logs=None):
+        from ..core import lazy
+        from ..obs import goodput, train_flight
+
+        self.ledger.stop()
+        goodput.deactivate(self.ledger)
+        if self._prev_ledger is not None:
+            goodput.activate(self._prev_ledger)
+            self._prev_ledger = None
+        if self.flight is not None:
+            train_flight.set_current(self._prev_recorder)
+            self._prev_recorder = None
+        if self._scope is not None:
+            lazy.pop_flush_scope(self._scope)
+            self._scope = None
+        self._t0 = None
+        self._cur = None
 
     # predict/eval keep the defaults (train is the instrumented loop)
 

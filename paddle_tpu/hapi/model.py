@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import time
 
 import numpy as np
 
@@ -81,10 +82,24 @@ class Model:
     # ------------------------------------------------------------ batches
     def train_batch(self, inputs, labels=None, update=True):
         import paddle_tpu as paddle
+        from ..obs.train_flight import current as _tf_current
 
+        # flight-recorder phase spans (round 16): when a TelemetryCallback
+        # attached its recorder, each train_batch phase — host->device
+        # conversion, forward, backward, optimizer commit, the loss
+        # host-sync — lands on the step timeline. One module-attr read
+        # when uninstrumented; perf_counter pairs only when recording.
+        rec = _tf_current()
+        pc = time.perf_counter if rec is not None else None
         self.network.train()
+        if pc:
+            t0 = pc()
         inputs = [_to_tensor(v) for v in _to_list(inputs)]
         labels = [_to_tensor(v) for v in _to_list(labels)]
+        if pc:
+            rec.program_span("h2d", t0, pc(),
+                             tensors=len(inputs) + len(labels))
+            t0 = pc()
         with self._amp_ctx():
             outputs = self.network(*inputs)
             losses = self._loss(*(_to_list(outputs) + labels)) if self._loss \
@@ -93,13 +108,28 @@ class Model:
         total = loss_list[0]
         for extra in loss_list[1:]:
             total = total + extra
+        if pc:
+            rec.program_span("forward", t0, pc())
+            t0 = pc()
         total.backward()
+        if pc:
+            rec.program_span("backward", t0, pc())
+            t0 = pc()
         if update and self._optimizer is not None:
             self._optimizer.step()
             self._optimizer.clear_grad()
+            if pc:
+                rec.program_span("optimizer_commit", t0, pc())
+        if pc:
+            t0 = pc()
         metrics = self._update_metrics(outputs, labels)
-        return ([float(l.numpy()) for l in loss_list], metrics) if metrics \
-            else [float(l.numpy()) for l in loss_list]
+        result = ([float(l.numpy()) for l in loss_list], metrics) \
+            if metrics else [float(l.numpy()) for l in loss_list]
+        if pc:
+            # float(loss.numpy()) is the host sync point every eager
+            # step pays — the dispatch/execute wall drains here
+            rec.program_span("loss_fetch", t0, pc())
+        return result
 
     def eval_batch(self, inputs, labels=None):
         from ..core.dispatch import no_grad
@@ -163,52 +193,82 @@ class Model:
         # captured data position here; fit fast-forwards to it — skipped
         # batches replay through the loader (same shuffle permutation,
         # numpy state restored below) without any compute
-        resume = self.__dict__.pop("_ckpt_resume", None)
-        start_epoch, skip_batches = 0, 0
-        if resume:
-            start_epoch = int(resume.get("epoch", 0) or 0)
-            skip_batches = int(resume.get("batch", 0) or 0)
-            if resume.get("np_state") is not None:
-                from ..ckpt.train_state import unpack_np_state
-
-                np.random.set_state(unpack_np_state(resume["np_state"]))
         logs = {}
-        for epoch in range(start_epoch, epochs):
-            cbks.call("on_epoch_begin", epoch)
-            for m in self._metrics:
-                m.reset()
-            updated = True
-            for step, batch in enumerate(loader):
-                if epoch == start_epoch and step < skip_batches:
-                    continue   # resume fast-forward: already-consumed batch
-                cbks.call("on_train_batch_begin", step)
-                ins, labs = self._split_batch(batch)
-                updated = (step + 1) % accumulate_grad_batches == 0
-                result = self.train_batch(ins, labs, update=updated)
-                logs = self._logs(result)
-                cbks.call("on_train_batch_end", step, logs)
+        # on_train_end must run once on_train_begin installed callback
+        # state, even when resume parsing or a batch raises: the
+        # round-16 TelemetryCallback installs process-level hooks
+        # (flight recorder, goodput ledger, flush scope) that would
+        # otherwise leak and pollute unrelated later work
+        try:
+            resume = self.__dict__.pop("_ckpt_resume", None)
+            start_epoch, skip_batches = 0, 0
+            if resume:
+                start_epoch = int(resume.get("epoch", 0) or 0)
+                skip_batches = int(resume.get("batch", 0) or 0)
+                if resume.get("np_state") is not None:
+                    from ..ckpt.train_state import unpack_np_state
+
+                    np.random.set_state(unpack_np_state(resume["np_state"]))
+            for epoch in range(start_epoch, epochs):
+                cbks.call("on_epoch_begin", epoch)
+                for m in self._metrics:
+                    m.reset()
+                updated = True
+                # resume replay wall (round 16): batches re-consumed by
+                # the fast-forward count against training GOODPUT
+                # (category "replay"), not against MFU — and the goodput
+                # ledger nets the wall out of the first real step's
+                # data_wait
+                replay_t0 = time.perf_counter() \
+                    if (epoch == start_epoch and skip_batches) else None
+
+                def _book_replay(t0):
+                    from ..obs import goodput as _goodput
+
+                    _goodput.note_replay(time.perf_counter() - t0)
+
+                for step, batch in enumerate(loader):
+                    if epoch == start_epoch and step < skip_batches:
+                        continue   # resume fast-forward: consumed batch
+                    if replay_t0 is not None:
+                        _book_replay(replay_t0)
+                        replay_t0 = None
+                    cbks.call("on_train_batch_begin", step)
+                    ins, labs = self._split_batch(batch)
+                    updated = (step + 1) % accumulate_grad_batches == 0
+                    result = self.train_batch(ins, labs, update=updated)
+                    logs = self._logs(result)
+                    cbks.call("on_train_batch_end", step, logs)
+                    if self.stop_training:
+                        # a preemption save (CheckpointCallback SIGTERM
+                        # path) must stop MID-epoch, not post-drain
+                        break
+                    if num_iters is not None and step + 1 >= num_iters:
+                        break
+                if replay_t0 is not None:
+                    # checkpoint at an exact epoch boundary: every batch
+                    # of start_epoch was skipped and the loop drained
+                    # without a real step to book the replay against
+                    _book_replay(replay_t0)
+                    replay_t0 = None
+                if not updated and self._optimizer is not None:
+                    # flush a trailing partial accumulation group so
+                    # stale grads never leak into the next epoch
+                    self._optimizer.step()
+                    self._optimizer.clear_grad()
+                cbks.call("on_epoch_end", epoch, logs)
                 if self.stop_training:
-                    # a preemption save (CheckpointCallback SIGTERM path)
-                    # must stop MID-epoch, not after the epoch drains
+                    # preemption stopped the epoch mid-flight: exit
+                    # before a long eval pass blows the grace window
                     break
-                if num_iters is not None and step + 1 >= num_iters:
+                if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                    self.evaluate(eval_data, batch_size=batch_size,
+                                  verbose=0, num_workers=num_workers,
+                                  callbacks=cbks)
+                if self.stop_training:
                     break
-            if not updated and self._optimizer is not None:
-                # flush a trailing partial accumulation group so stale grads
-                # never leak into the next epoch
-                self._optimizer.step()
-                self._optimizer.clear_grad()
-            cbks.call("on_epoch_end", epoch, logs)
-            if self.stop_training:
-                # preemption stopped the epoch mid-flight: exit before a
-                # potentially long eval pass blows the grace window
-                break
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size, verbose=0,
-                              num_workers=num_workers, callbacks=cbks)
-            if self.stop_training:
-                break
-        cbks.call("on_train_end", logs)
+        finally:
+            cbks.call("on_train_end", logs)
         return self
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

@@ -398,6 +398,10 @@ _chunk_prefill_step = functools.partial(
 #: real recompile.
 _SEEN_SERVING_PROGRAMS: set = set()
 
+#: monotonically-increasing engine names for the shared /metrics
+#: endpoint's `engine` label (round 16)
+_NEXT_ENGINE_NAME = 0
+
 #: round 14: the engine owns its executables via the AOT path
 #: (jitted.lower().compile()) instead of jax.jit's implicit cache —
 #: the compiled object carries XLA cost_analysis()/memory_analysis()
@@ -706,19 +710,30 @@ class ServingEngine:
         self._slo_ttft_s = slo_ms / 1e3 if slo_ms > 0 else None
         self._log = obs.get_logger(__name__)
         self._metrics_server = None
+        self._engine_name = None
         port = int(flag("FLAGS_obs_http_port"))
         if port > 0:
+            # round 16: engines share ONE endpoint per port — each
+            # registers its registry (exported with an engine="..."
+            # label) and a readiness probe (/healthz flips to 200 only
+            # once every registered engine passed finish_warmup); the
+            # pre-round-16 behavior left every engine after the first
+            # unscraped on a bind failure
             try:
-                self._metrics_server = obs.serve_metrics(port, reg)
+                global _NEXT_ENGINE_NAME
+
+                self._engine_name = f"engine{_NEXT_ENGINE_NAME}"
+                _NEXT_ENGINE_NAME += 1
+                self._metrics_server = obs.shared_server(port)
+                self._metrics_server.register_engine(
+                    self._engine_name, reg, ready=lambda: self._warmed)
             except OSError as e:
-                # a fixed port serves ONE engine per process; later
-                # engines (bench drives, per-call generate_paged) must
-                # not crash on the bind — they just go unscraped
+                self._metrics_server = None
                 self._log.warning(
-                    f"obs metrics endpoint :{port} not started ({e}); "
-                    "another engine already owns it — use "
+                    f"obs metrics endpoint :{port} not started ({e}) — "
+                    "this engine goes unscraped; use "
                     "obs.serve_metrics(port, engine.registry) to expose "
-                    "this one", key="obs-http-bind")
+                    "it elsewhere", key="obs-http-bind")
 
     # ------------------------------------------------------------- API
     def add_request(self, prompt, max_new_tokens=32, do_sample=False,
@@ -868,9 +883,11 @@ class ServingEngine:
         return self._warmed
 
     def close(self):
-        """Stop the optional /metrics endpoint (no-op otherwise)."""
+        """Detach from the shared /metrics endpoint (no-op otherwise).
+        The endpoint itself stays up — other engines may still be
+        registered on it; obs.shared_server(port).close() stops it."""
         if self._metrics_server is not None:
-            self._metrics_server.close()
+            self._metrics_server.unregister_engine(self._engine_name)
             self._metrics_server = None
 
     def _program(self, site: str, jitted, n_static: int, bucket: int,

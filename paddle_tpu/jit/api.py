@@ -92,7 +92,7 @@ def _struct_key(struct):
 class _Specialization:
     __slots__ = ("captures", "ro_caps", "mut_caps", "executable", "out_struct",
                  "n_out_leaves", "trace_muts", "debug", "debug_jaxpr",
-                 "debug_index", "donated")
+                 "debug_index", "donated", "cost_entry")
 
 
 #: exception types that mean "this program can't be captured as one graph"
@@ -353,6 +353,7 @@ class CompiledFunction:
         spec.captures = captures
         spec.ro_caps = ro_caps
         spec.mut_caps = mut_caps
+        spec.cost_entry = None    # set below when the AOT path analyzed
         holder = {}
         cap_fn = self._capture_fn()
 
@@ -565,6 +566,9 @@ class CompiledFunction:
                     "to_static", fn_name, f"{fn_name}/{digest}",
                     compiled=_aot, wall_s=_compile_wall,
                     collective_bytes=coll)
+                # the train flight recorder joins this entry's flops
+                # with measured step walls into train_mfu{program}
+                spec.cost_entry = entry
                 if entry.analyzed:
                     cost = {"flops": entry.flops,
                             "bytes_accessed": entry.bytes_accessed,
@@ -615,7 +619,26 @@ class CompiledFunction:
         arg_datas = [t._data for t in leaves]
         ro_datas = [t._data for t in spec.ro_caps]
         mut_datas = [t._data for t in spec.mut_caps]
-        out_datas, mut_out = spec.executable(arg_datas, ro_datas, mut_datas)
+        # training flight recorder (round 16): a compiled-step dispatch
+        # during an instrumented fit becomes a span on the step timeline
+        # and its ledger flops feed the MFU gauges. One module-attr read
+        # when no recorder is active — per to_static CALL, not per op.
+        from ..obs.train_flight import current as _tf_current
+
+        rec = _tf_current()
+        if rec is None:
+            out_datas, mut_out = spec.executable(arg_datas, ro_datas,
+                                                 mut_datas)
+        else:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            out_datas, mut_out = spec.executable(arg_datas, ro_datas,
+                                                 mut_datas)
+            rec.program_dispatch(
+                getattr(self._fn, "__name__", "to_static"), t0,
+                _time.perf_counter(),
+                entry=getattr(spec, "cost_entry", None))
         return self._finish(spec, out_datas, mut_out)
 
     def _finish(self, spec, out_datas, mut_out):

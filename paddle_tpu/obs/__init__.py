@@ -22,6 +22,14 @@ reference stack, rebuilt serving-grade):
   * **logging**  — module-scoped VLOG driven by ``FLAGS_log_level`` with
     per-message rate limiting; the dy2static fallback + engine admission
     messages route through it.
+  * **train_flight / goodput** (round 16) — the training twins of the
+    request recorder + cost ledger: per-step span timelines (data wait,
+    h2d, fwd/bwd/opt, lazy flushes, compiled dispatches, ckpt IO) with
+    a dump-time wall-tiling assertion and anomaly postmortems
+    (data starvation / step spike / ckpt stall), plus MFU
+    (``train_mfu{program}``) and ML-goodput accounting
+    (``train_goodput_seconds_total{category}``); ``audit_train_steps``
+    (analysis D12) gates starvation streaks and MFU collapse in lint.
 
 Overhead: metrics are OFF by default everywhere except the serving
 engine (whose per-tick cost is a handful of attribute updates — measured
@@ -34,12 +42,15 @@ from .costs import (ProgramCost, audit_cost_regressions, clear_ledger,
                     extract_cost, ledger, peak_gbps, record_program,
                     reset_exec_stats, roofline_rows, write_baseline)
 from .flight import FlightRecorder, RequestFlight, validate_trace
-from .http import MetricsServer, serve_metrics
+from .goodput import (GoodputLedger, audit_train_steps, peak_tflops)
+from .http import MetricsServer, serve_metrics, shared_server
 from .logging import ObsLogger, get_logger
 from .metrics import (DEFAULT_BUCKETS, OVERFLOW, Counter, Gauge, Histogram,
                       Registry, dump_registry, log_event)
 from .trace import (capture_trace, clear_spans, span, span_events,
                     step_span)
+from .train_flight import (StepFlight, TrainFlightRecorder,
+                           validate_train_trace)
 from .watchdog import (CompileEvent, audit_ckpt_stalls, audit_recompiles,
                        ckpt_save_events, clear_events, compile_counts,
                        compile_events, jaxpr_size, post_warmup_compiles,
@@ -79,8 +90,10 @@ __all__ = [
     "jaxpr_size",
     "record_ckpt_save", "ckpt_save_events", "audit_ckpt_stalls",
     "get_logger", "ObsLogger",
-    "serve_metrics", "MetricsServer",
+    "serve_metrics", "MetricsServer", "shared_server",
     "FlightRecorder", "RequestFlight", "validate_trace",
+    "TrainFlightRecorder", "StepFlight", "validate_train_trace",
+    "GoodputLedger", "audit_train_steps", "peak_tflops",
     "ProgramCost", "record_program", "ledger", "clear_ledger",
     "reset_exec_stats", "roofline_rows", "extract_cost", "peak_gbps",
     "write_baseline", "audit_cost_regressions",

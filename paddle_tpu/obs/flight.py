@@ -309,6 +309,13 @@ def validate_trace(obj_or_path) -> dict:
             obj = json.load(fh)
     else:
         obj = obj_or_path
+    source = (obj.get("otherData") or {}).get("source", "")
+    if source.endswith("train_flight"):
+        # training dumps carry step timelines, not request timelines —
+        # same entry point, train-specific invariants (round 16)
+        from .train_flight import validate_train_trace
+
+        return validate_train_trace(obj)
     evs = obj.get("traceEvents")
     if not isinstance(evs, list) or not evs:
         raise ValueError("trace has no traceEvents array")

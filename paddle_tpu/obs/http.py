@@ -1,15 +1,26 @@
-"""Optional stdlib /metrics endpoint for the serving path.
+"""Optional stdlib /metrics + /healthz endpoint for the serving path.
 
 ``serve_metrics(port, registry)`` starts a daemon-thread
 ``http.server`` exposing:
 
-  * ``/metrics``  — Prometheus text exposition of the registry
-  * ``/healthz``  — 200 "ok" (load-balancer liveness)
+  * ``/metrics``  — Prometheus text exposition of the base registry
+    PLUS every registered engine's registry with an ``engine="<name>"``
+    label stamped on its samples (round 16: one scrape target covers N
+    ``ServingEngine`` instances in one process — pre-round-16 only the
+    first engine to bind the port was exported).
+  * ``/healthz``  — readiness, not just liveness: with engines
+    registered it returns 200 ``ready`` only once EVERY registered
+    engine's readiness probe passes (a ``ServingEngine`` flips ready at
+    ``finish_warmup()`` — the health signal a multi-replica router
+    consumes), 503 ``warming`` before that; with none registered it
+    stays the plain 200 ``ok`` liveness check.
 
 No dependencies beyond the stdlib (the container bakes no prometheus
 client), one thread, read-only — good enough for a scrape target, not a
-general web server. The ServingEngine starts one automatically when
-``FLAGS_obs_http_port`` > 0.
+general web server. Engines attach automatically when
+``FLAGS_obs_http_port`` > 0: the first engine creates the shared server
+(``shared_server(port)``), later engines register into it instead of
+failing the bind.
 """
 from __future__ import annotations
 
@@ -19,18 +30,25 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 class MetricsServer:
     def __init__(self, port: int, registry, host: str = "127.0.0.1"):
-        reg = registry
+        self.registry = registry
+        # name -> (registry, ready_fn) — mutated under _lock, read by
+        # the handler thread (dict snapshot per request)
+        self._engines: dict = {}
+        self._lock = threading.Lock()
+        srv = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib API name)
-                if self.path.split("?")[0] == "/metrics":
-                    body = reg.render_prometheus().encode()
+                path = self.path.split("?")[0]
+                if path == "/metrics":
+                    body = srv.render().encode()
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "text/plain; version=0.0.4")
-                elif self.path.split("?")[0] == "/healthz":
-                    body = b"ok\n"
-                    self.send_response(200)
+                elif path == "/healthz":
+                    ready, body = srv.health()
+                    body = body.encode()
+                    self.send_response(200 if ready else 503)
                     self.send_header("Content-Type", "text/plain")
                 else:
                     body = b"not found\n"
@@ -50,9 +68,74 @@ class MetricsServer:
                                         daemon=True)
         self._thread.start()
 
+    # ------------------------------------------------------ multi-engine
+    def register_engine(self, name: str, registry, ready=None):
+        """Attach one engine's registry (exported with
+        ``engine="<name>"`` labels) and its readiness probe (a callable;
+        ``ServingEngine`` passes ``lambda: self.warmed``)."""
+        with self._lock:
+            self._engines[str(name)] = (registry, ready)
+        return self
+
+    def unregister_engine(self, name: str):
+        with self._lock:
+            self._engines.pop(str(name), None)
+
+    def engines(self) -> list[str]:
+        with self._lock:
+            return sorted(self._engines)
+
+    def render(self) -> str:
+        """The /metrics body: base registry samples bare, engine
+        registries with an ``engine`` label — merged PER METRIC NAME so
+        each name gets exactly one HELP/TYPE group (the text format
+        rejects duplicates, which a naive per-registry concatenation
+        produced when two engines shared a metric name)."""
+        with self._lock:
+            engines = dict(self._engines)
+        sources = []
+        if self.registry is not None:
+            sources.append((self.registry, ()))
+        for name in sorted(engines):
+            sources.append((engines[name][0], (("engine", name),)))
+        from .metrics import _escape_help
+
+        # group by FULL (namespaced) metric name: one HELP/TYPE each
+        names: dict = {}          # full name -> (bare name, first reg)
+        for reg, _ in sources:
+            ns = reg.namespace
+            for n in reg.names():
+                names.setdefault(f"{ns}_{n}" if ns else n, (n, reg))
+        lines = []
+        for full in sorted(names):
+            n, first = names[full]
+            m = first.get(n)
+            lines.append(f"# HELP {full} {_escape_help(m.doc or n)}")
+            lines.append(f"# TYPE {full} {m.kind}")
+            for reg, extra in sources:
+                if (f"{reg.namespace}_{n}" if reg.namespace else n) == full:
+                    lines.extend(reg._render_samples(n, extra))
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def health(self) -> tuple[bool, str]:
+        with self._lock:
+            engines = dict(self._engines)
+        if not engines:
+            return True, "ok\n"
+        warming = sorted(name for name, (_, ready) in engines.items()
+                         if ready is not None and not ready())
+        if warming:
+            return False, "warming: " + ",".join(warming) + "\n"
+        return True, "ready\n"
+
     def close(self):
         self._httpd.shutdown()
         self._httpd.server_close()
+        with self._lock:
+            self._engines.clear()
+        with _SERVERS_LOCK:
+            for p in [p for p, s in _SERVERS.items() if s is self]:
+                del _SERVERS[p]
 
 
 def serve_metrics(port: int, registry=None, host: str = "127.0.0.1"
@@ -64,3 +147,24 @@ def serve_metrics(port: int, registry=None, host: str = "127.0.0.1"
 
         registry = default_registry()
     return MetricsServer(port, registry, host=host)
+
+
+#: per-port shared servers (the FLAGS_obs_http_port path): engines in
+#: one process scrape through ONE endpoint instead of fighting the bind
+_SERVERS: dict = {}
+_SERVERS_LOCK = threading.Lock()
+
+
+def shared_server(port: int, host: str = "127.0.0.1") -> MetricsServer:
+    """Get-or-create the process-shared server for ``port`` (base body =
+    the process-default registry; engines register on top). Port 0 means
+    "any free port" and always creates a fresh server — only resolved
+    ports are shared."""
+    with _SERVERS_LOCK:
+        srv = _SERVERS.get(int(port)) if int(port) != 0 else None
+        if srv is None:
+            from . import default_registry
+
+            srv = MetricsServer(port, default_registry(), host=host)
+            _SERVERS[srv.port] = srv
+        return srv

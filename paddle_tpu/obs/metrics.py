@@ -316,28 +316,47 @@ class Registry:
                          "samples": rows}
         return out
 
-    def render_prometheus(self) -> str:
-        """Prometheus text exposition format 0.0.4 (the /metrics body)."""
+    def _render_samples(self, name: str, extra_labels=()) -> list:
+        """Sample lines (no HELP/TYPE) for one metric, with
+        ``extra_labels`` pairs injected — the multi-registry /metrics
+        endpoint merges same-named metrics across engine registries this
+        way (the text format forbids a second HELP/TYPE group for one
+        metric name, so the merge has to happen at the sample level)."""
+        m = self._metrics.get(name)
+        if m is None:
+            return []
+        ns = self.namespace
+        full = f"{ns}_{name}" if ns else name
+        extra = tuple(extra_labels)
+        lines = []
+        for labelvalues, child in m.samples():
+            base = extra + tuple(zip(m.labelnames, labelvalues))
+            lab = _fmt_labels(base)
+            if m.kind == "histogram":
+                cum = 0
+                for b, c in zip(child.buckets, child.bucket_counts):
+                    cum += c
+                    lines.append(
+                        f"{full}_bucket{_fmt_labels(base, ('le', _fmt_float(b)))} {cum}")
+                lines.append(
+                    f"{full}_bucket{_fmt_labels(base, ('le', '+Inf'))} {child.count}")
+                lines.append(f"{full}_sum{lab} {_fmt_float(child.sum)}")
+                lines.append(f"{full}_count{lab} {child.count}")
+            else:
+                lines.append(f"{full}{lab} {_fmt_float(child.value)}")
+        return lines
+
+    def render_prometheus(self, extra_labels=()) -> str:
+        """Prometheus text exposition format 0.0.4 (the /metrics body).
+        ``extra_labels`` — ((name, value), ...) — is injected into every
+        sample line."""
         ns = self.namespace
         lines = []
         for name, m in sorted(self._metrics.items()):
             full = f"{ns}_{name}" if ns else name
             lines.append(f"# HELP {full} {_escape_help(m.doc or name)}")
             lines.append(f"# TYPE {full} {m.kind}")
-            for labelvalues, child in m.samples():
-                lab = _fmt_labels(m.labelnames, labelvalues)
-                if m.kind == "histogram":
-                    cum = 0
-                    for b, c in zip(child.buckets, child.bucket_counts):
-                        cum += c
-                        lines.append(
-                            f"{full}_bucket{_fmt_labels(m.labelnames, labelvalues, ('le', _fmt_float(b)))} {cum}")
-                    lines.append(
-                        f"{full}_bucket{_fmt_labels(m.labelnames, labelvalues, ('le', '+Inf'))} {child.count}")
-                    lines.append(f"{full}_sum{lab} {_fmt_float(child.sum)}")
-                    lines.append(f"{full}_count{lab} {child.count}")
-                else:
-                    lines.append(f"{full}{lab} {_fmt_float(child.value)}")
+            lines.extend(self._render_samples(name, extra_labels))
         return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -350,8 +369,8 @@ def _fmt_float(v: float) -> str:
     return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
 
 
-def _fmt_labels(names, values, extra=None):
-    parts = [f'{n}="{_escape(v)}"' for n, v in zip(names, values)]
+def _fmt_labels(pairs, extra=None):
+    parts = [f'{n}="{_escape(v)}"' for n, v in pairs]
     if extra is not None:
         parts.append(f'{extra[0]}="{extra[1]}"')
     return "{" + ",".join(parts) + "}" if parts else ""

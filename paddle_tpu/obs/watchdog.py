@@ -104,6 +104,11 @@ def record_compile(site: str, group: str, key: str, bucket=None,
                     "compiles recorded after a serving warmup barrier",
                     ("site",)).labels(site).inc()
     metrics.log_event("compile", **ev.to_dict())
+    # training goodput (round 16): a compile wall paid while a fit is
+    # instrumented is non-productive training time (no-op otherwise)
+    from .goodput import note_compile
+
+    note_compile(ev.wall_s)
     return ev
 
 
@@ -154,6 +159,16 @@ def record_ckpt_save(step: int, wall_s: float, nbytes: int, result: str,
           "attempts": int(attempts), "t": time.time()}
     _ckpt_events.append(ev)
     metrics.log_event("ckpt_save", **ev)
+    # ckpt-stall postmortem (round 16): a save blowing its wall budget
+    # (or failing outright) while a training flight recorder is active
+    # auto-dumps the last N step timelines — the trace of the stall
+    if wall_s > float(flag("FLAGS_ckpt_stall_seconds")) \
+            or result == "error":
+        from .train_flight import current as _tf_current
+
+        rec = _tf_current()
+        if rec is not None:
+            rec.anomaly("ckpt_stall")
     return ev
 
 

@@ -494,10 +494,12 @@ class TestMetricCatalogDrift:
         existence — no documented-but-unenforced metrics."""
         from graft_lint import (REQUIRED_CKPT_METRICS,
                                 REQUIRED_DEFAULT_METRICS,
-                                REQUIRED_SERVING_METRICS)
+                                REQUIRED_SERVING_METRICS,
+                                REQUIRED_TRAIN_METRICS)
 
         known = set(REQUIRED_SERVING_METRICS) \
-            | set(REQUIRED_CKPT_METRICS) | set(REQUIRED_DEFAULT_METRICS)
+            | set(REQUIRED_CKPT_METRICS) | set(REQUIRED_DEFAULT_METRICS) \
+            | set(REQUIRED_TRAIN_METRICS)
         missing = sorted(self._catalog_names() - known)
         assert not missing, (
             "README metric catalog documents metrics no REQUIRED_* set "
@@ -505,15 +507,17 @@ class TestMetricCatalogDrift:
             "or drop the rows")
 
     def test_every_required_metric_is_documented(self):
-        """Registry -> doc: the enforced serving/default sets must appear
-        in the catalog (drift in the other direction)."""
+        """Registry -> doc: the enforced serving/default/training sets
+        must appear in the catalog (drift in the other direction)."""
         from graft_lint import (REQUIRED_DEFAULT_METRICS,
-                                REQUIRED_SERVING_METRICS)
+                                REQUIRED_SERVING_METRICS,
+                                REQUIRED_TRAIN_METRICS)
 
         names = self._catalog_names()
         undocumented = sorted(
             (set(REQUIRED_SERVING_METRICS)
-             | set(REQUIRED_DEFAULT_METRICS)) - names)
+             | set(REQUIRED_DEFAULT_METRICS)
+             | set(REQUIRED_TRAIN_METRICS)) - names)
         assert not undocumented, (
             f"REQUIRED metrics missing from the README catalog: "
             f"{undocumented}")

@@ -478,10 +478,13 @@ class TestServingObs:
         finally:
             srv.close()
 
-    def test_second_engine_survives_taken_http_port(self):
-        """FLAGS_obs_http_port names ONE fixed port: the first engine
-        binds it, later engines must degrade (warn, no endpoint) instead
-        of crashing with EADDRINUSE."""
+    def test_engines_share_one_http_port(self):
+        """FLAGS_obs_http_port names ONE fixed port: every engine in
+        the process registers on the SHARED endpoint (round 16) — both
+        registries scrape through /metrics with an engine="..." label
+        instead of the pre-round-16 first-binder-wins behavior — and
+        /healthz is a READINESS probe: 503 while any registered engine
+        has not finished warmup, 200 once all have."""
         from paddle_tpu.inference.engine import ServingEngine
 
         probe = obs.serve_metrics(0, obs.Registry())   # grab a free port
@@ -492,12 +495,36 @@ class TestServingObs:
         try:
             e1 = ServingEngine(model, max_slots=1)
             e2 = ServingEngine(model, max_slots=1)     # must not raise
-            assert e1._metrics_server is not None
-            assert e2._metrics_server is None
+            assert e1._metrics_server is e2._metrics_server
+            srv = e1._metrics_server
+            assert len(srv.engines()) == 2
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics") as resp:
+                body = resp.read().decode()
+            assert f'serving_slots{{engine="{e1._engine_name}"}}' in body
+            assert f'serving_slots{{engine="{e2._engine_name}"}}' in body
+            # readiness: 503 until EVERY engine passed finish_warmup
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz")
+            assert ei.value.code == 503
+            e1.finish_warmup()
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz")
+            e2.finish_warmup()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz") as resp:
+                assert resp.status == 200
+                assert resp.read() == b"ready\n"
+            # close() detaches the engine, not the shared endpoint
+            e2.close()
+            assert srv.engines() == [e1._engine_name]
         finally:
             paddle.set_flags({"FLAGS_obs_http_port": 0})
             e1.close()
             e2.close()
+            srv.close()
 
     def test_serving_predictor_metrics(self):
         from paddle_tpu.inference import Config, create_serving_predictor

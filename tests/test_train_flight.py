@@ -1,0 +1,829 @@
+"""Training flight recorder + MFU/goodput ledger (round 16,
+paddle_tpu/obs/train_flight.py + obs/goodput.py).
+
+Covers: the step-span tiling invariant (fires on violation, bitwise on a
+real instrumented fit), ring eviction, the three anomaly postmortems
+(data starvation / step-time spike / ckpt stall) as fire + no-fire
+pairs, MFU gauge correctness against a hand-computed flops/wall case,
+goodput accounting across a kill->resume cycle (tests/faultinject.py
+SIGTERM preemption), flush-scope attribution across sequential/nested
+fits, the bench history + trend satellite, and the steady-state
+overhead A/B against the round-11 2% bar.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import obs
+from paddle_tpu.hapi.callbacks import (CheckpointCallback,
+                                       TelemetryCallback)
+from paddle_tpu.io import Dataset
+from paddle_tpu.obs.train_flight import (TrainFlightRecorder,
+                                         validate_train_trace)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import faultinject as fi  # noqa: E402  (tests dir is on the path)
+
+
+# --------------------------------------------------------------- helpers
+class _ToyData(Dataset):
+    def __init__(self, n=16, d_in=8, d_out=4):
+        rs = np.random.RandomState(42)
+        self.x = rs.randn(n, d_in).astype("float32")
+        self.y = rs.randn(n, d_out).astype("float32")
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _model(seed=0, d_in=8, hidden=16, d_out=4):
+    paddle.seed(seed)
+    np.random.seed(seed)
+    net = nn.Sequential(nn.Linear(d_in, hidden), nn.ReLU(),
+                        nn.Linear(hidden, d_out))
+    m = paddle.Model(net)
+    m.prepare(paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=net.parameters()),
+              nn.MSELoss())
+    return m
+
+
+def _fit(model, cb, n=16, epochs=1, extra=()):
+    model.fit(_ToyData(n), batch_size=4, epochs=epochs, verbose=0,
+              shuffle=False, callbacks=[cb, *extra])
+    return cb
+
+
+def _flags(**kv):
+    """set_flags + a dict of the old values for restoring."""
+    old = {k: paddle.get_flags(k)[k] for k in kv}
+    paddle.set_flags(kv)
+    return old
+
+
+# ----------------------------------------------------- the tiling invariant
+class TestStepTiling:
+    def test_fit_dump_reparses_and_validates(self, tmp_path):
+        """THE acceptance invariant: an instrumented Model.fit dumps a
+        Chrome-trace JSON whose per-step data_wait+compute spans tile
+        each recorded step wall bitwise, re-checked from the dumped file
+        by obs.validate_trace (round-trip through json floats)."""
+        reg = obs.Registry()
+        cb = TelemetryCallback(registry=reg, batch_tokens=32)
+        _fit(_model(), cb, n=16, epochs=2)            # 8 steps
+        path = str(tmp_path / "train_trace.json")
+        assert cb.flight.dump(path) == path
+        obj = json.load(open(path))                   # plain re-parse
+        assert obj["traceEvents"]
+        assert obj["otherData"]["source"] == "paddle_tpu.obs.train_flight"
+        summary = obs.validate_trace(path)            # dispatches to train
+        assert summary["steps"] == 8
+        assert summary["tiled_steps"] == 8
+        # the bitwise claim, re-derived from the dumped args alone
+        computes = [e for e in obj["traceEvents"]
+                    if e.get("ph") == "X" and e["name"] == "compute"]
+        assert len(computes) == 8
+        for e in computes:
+            a = e["args"]
+            assert (a["t1_s"] - a["t0_s"]) == a["wall_s"]
+        # and the recorder's walls are the histogram's walls
+        hist = reg.get("train_step_seconds")
+        assert hist.count == 8
+        walls = sorted(a["args"]["wall_s"] for a in computes)
+        assert walls == sorted(hist._exact)
+
+    def test_step_phases_recorded(self, tmp_path):
+        """The eager step's phase spans (h2d / forward / backward /
+        optimizer_commit / loss_fetch) nest inside the step window."""
+        cb = TelemetryCallback(registry=obs.Registry())
+        _fit(_model(), cb, n=8)
+        path = str(tmp_path / "t.json")
+        cb.flight.dump(path)
+        obj = json.load(open(path))
+        names = {e["name"] for e in obj["traceEvents"]
+                 if e.get("ph") == "X" and e.get("cat") == "program"}
+        assert {"h2d", "forward", "backward", "optimizer_commit",
+                "loss_fetch"} <= names
+        obs.validate_trace(path)   # nesting is part of validation
+
+    def test_tiling_violation_raises_at_dump(self):
+        """A recorded wall that diverges from the span endpoints — the
+        callback's histogram bookkeeping and the recorder disagreeing —
+        must refuse to dump."""
+        rec = TrainFlightRecorder(capacity=8, registry=obs.Registry())
+        rec.step_begin(0, 0, 10.0, 10.5)
+        rec.step_end(11.0, wall_s=0.4)     # true wall is 0.5
+        with pytest.raises(AssertionError, match="tile the recorded"):
+            rec.to_chrome()
+
+    def test_nonmonotonic_lifecycle_raises(self):
+        rec = TrainFlightRecorder(capacity=8, registry=obs.Registry())
+        rec.step_begin(0, 0, 10.6, 10.5)   # fetch AFTER begin
+        rec.step_end(11.0, wall_s=0.5)
+        with pytest.raises(AssertionError, match="non-monotonic"):
+            rec.to_chrome()
+
+    def test_validate_rejects_corrupted_dump(self, tmp_path):
+        rec = TrainFlightRecorder(capacity=8, registry=obs.Registry())
+        rec.step_begin(0, 0, 10.0, 10.5)
+        rec.step_end(11.0, wall_s=0.5)
+        path = str(tmp_path / "t.json")
+        rec.dump(path)
+        obj = json.load(open(path))
+        for e in obj["traceEvents"]:
+            if e.get("name") == "compute":
+                e["args"]["wall_s"] = 0.123      # lie about the wall
+        with pytest.raises(ValueError, match="tile the recorded"):
+            validate_train_trace(obj)
+        # and a contiguity tear is equally rejected
+        obj2 = json.load(open(path))
+        for e in obj2["traceEvents"]:
+            if e.get("name") == "data_wait":
+                e["args"]["t1_s"] += 1e-9
+        with pytest.raises(ValueError, match="escapes|compute begins"):
+            validate_train_trace(obj2)
+
+    def test_active_step_dumps_without_tiling(self):
+        """A mid-step postmortem (anomaly while the step is computing)
+        includes the ACTIVE step; it has no wall yet so it is exempt
+        from tiling, and the dump must still validate."""
+        rec = TrainFlightRecorder(capacity=8, registry=obs.Registry())
+        rec.step_begin(0, 0, 10.0, 10.5)
+        rec.step_end(11.0, wall_s=0.5)
+        rec.step_begin(1, 0, 11.0, 11.2)
+        rec.program_span("lazy_flush", 11.3, 11.4, reason="backward")
+        doc = rec.to_chrome()
+        summary = validate_train_trace(doc)
+        assert summary["steps"] == 2 and summary["tiled_steps"] == 1
+
+
+# ------------------------------------------------------------------- ring
+class TestRing:
+    def test_eviction_keeps_newest(self):
+        rec = TrainFlightRecorder(capacity=4, registry=obs.Registry())
+        for i in range(10):
+            rec.step_begin(i, 0, float(i), i + 0.25)
+            rec.step_end(i + 1.0, wall_s=0.75)
+        assert rec.evicted == 6
+        idx = [st.index for st in rec.steps()]
+        assert idx == [6, 7, 8, 9]
+        validate_train_trace(rec.to_chrome())
+
+    def test_active_step_never_evicted(self):
+        rec = TrainFlightRecorder(capacity=2, registry=obs.Registry())
+        for i in range(5):
+            rec.step_begin(i, 0, float(i), i + 0.25)
+            rec.step_end(i + 1.0, wall_s=0.75)
+        rec.step_begin(99, 1, 50.0, 50.1)     # active, stays
+        assert [st.index for st in rec.steps()] == [3, 4, 99]
+
+    def test_span_cap_counts_drops(self):
+        from paddle_tpu.obs.train_flight import STEP_SPAN_CAP
+
+        rec = TrainFlightRecorder(capacity=2, registry=obs.Registry())
+        st = rec.step_begin(0, 0, 0.0, 0.1)
+        for i in range(STEP_SPAN_CAP + 50):
+            rec.program_span("lazy_flush", 0.2, 0.3, i=i)
+        assert len(st.spans) == STEP_SPAN_CAP
+        assert st.spans_dropped == 50
+
+
+# -------------------------------------------------------------- anomalies
+class TestAnomalies:
+    def _drive(self, rec, dw, wall, n=1, start=0):
+        for i in range(start, start + n):
+            t0 = 100.0 + i
+            begin, end = t0 + dw, t0 + dw + wall
+            # wall from the same floats — the tiling assertion is bitwise
+            rec.step_begin(i, 0, t0, begin)
+            rec.step_end(end, wall_s=end - begin)
+
+    def _count(self, reg, name, trigger):
+        m = reg.get(name)
+        for labels, child in m.samples():
+            if labels == (trigger,):
+                return child.value
+        return 0.0
+
+    def test_data_starvation_fire_and_no_fire(self, tmp_path):
+        reg = obs.Registry()
+        old = _flags(FLAGS_obs_data_wait_ms=10.0,
+                     FLAGS_obs_flight_dir=str(tmp_path / "dumps"))
+        try:
+            rec = TrainFlightRecorder(capacity=8, registry=reg)
+            self._drive(rec, dw=0.001, wall=0.05)       # healthy: no fire
+            assert self._count(reg, "train_flight_anomalies_total",
+                               "data_starvation") == 0
+            self._drive(rec, dw=0.05, wall=0.05, start=1)   # 50ms > 10ms
+            assert self._count(reg, "train_flight_anomalies_total",
+                               "data_starvation") == 1
+            assert self._count(reg, "train_flight_dumps_total",
+                               "data_starvation") == 1
+            assert len(rec.autodump_paths) == 1
+            validate_train_trace(rec.autodump_paths[0])  # the postmortem
+        finally:
+            _flags(**old)
+
+    def test_data_starvation_disabled_at_zero(self):
+        reg = obs.Registry()
+        old = _flags(FLAGS_obs_data_wait_ms=0.0)
+        try:
+            rec = TrainFlightRecorder(capacity=8, registry=reg)
+            self._drive(rec, dw=5.0, wall=0.05)
+            assert self._count(reg, "train_flight_anomalies_total",
+                               "data_starvation") == 0
+        finally:
+            _flags(**old)
+
+    def test_step_spike_fire_and_no_fire(self):
+        reg = obs.Registry()
+        old = _flags(FLAGS_obs_step_spike_factor=3.0,
+                     FLAGS_obs_data_wait_ms=0.0)
+        try:
+            rec = TrainFlightRecorder(capacity=32, registry=reg)
+            self._drive(rec, dw=0.0, wall=0.01, n=10)   # uniform: no fire
+            assert self._count(reg, "train_flight_anomalies_total",
+                               "step_spike") == 0
+            self._drive(rec, dw=0.0, wall=0.1, n=1, start=10)   # 10x med
+            assert self._count(reg, "train_flight_anomalies_total",
+                               "step_spike") == 1
+            # below the min population nothing fires, however wild
+            rec2 = TrainFlightRecorder(capacity=32, registry=obs.Registry())
+            for i in range(3):
+                rec2.step_begin(i, 0, float(i), float(i))
+                rec2.step_end(i + (10.0 if i == 2 else 0.01),
+                              wall_s=(10.0 if i == 2 else 0.01))
+            assert rec2.autodumps == 0
+        finally:
+            _flags(**old)
+
+    def test_ckpt_stall_fire_and_no_fire(self):
+        """obs.record_ckpt_save routes a stalled (or failed) save into
+        the ACTIVE recorder's ckpt_stall anomaly; healthy saves don't."""
+        from paddle_tpu.obs.train_flight import set_current
+
+        reg = obs.Registry()
+        old = _flags(FLAGS_obs_data_wait_ms=0.0)
+        rec = TrainFlightRecorder(capacity=8, registry=reg)
+        prev = set_current(rec)
+        try:
+            obs.record_ckpt_save(step=1, wall_s=0.01, nbytes=10,
+                                 result="ok")
+            assert self._count(reg, "train_flight_anomalies_total",
+                               "ckpt_stall") == 0
+            stall = paddle.get_flags("FLAGS_ckpt_stall_seconds")[
+                "FLAGS_ckpt_stall_seconds"] + 1.0
+            obs.record_ckpt_save(step=2, wall_s=stall, nbytes=10,
+                                 result="ok")
+            assert self._count(reg, "train_flight_anomalies_total",
+                               "ckpt_stall") == 1
+            obs.record_ckpt_save(step=3, wall_s=0.01, nbytes=10,
+                                 result="error")       # failed save fires
+            assert self._count(reg, "train_flight_anomalies_total",
+                               "ckpt_stall") == 2
+        finally:
+            set_current(prev)
+            _flags(**old)
+            obs.clear_events()
+
+    def test_no_dump_without_dir_and_cap(self, tmp_path):
+        from paddle_tpu.obs.train_flight import AUTODUMP_CAP
+
+        reg = obs.Registry()
+        rec = TrainFlightRecorder(capacity=8, registry=reg)
+        self._drive(rec, dw=0.0, wall=0.01)
+        assert rec.anomaly("step_spike") is None       # dir unset
+        assert rec.autodumps == 0
+        assert self._count(reg, "train_flight_anomalies_total",
+                           "step_spike") == 1          # still counted
+        old = _flags(FLAGS_obs_flight_dir=str(tmp_path / "d"))
+        try:
+            for _ in range(AUTODUMP_CAP + 5):
+                rec.anomaly("step_spike")
+            assert rec.autodumps == AUTODUMP_CAP       # files capped
+            assert self._count(reg, "train_flight_anomalies_total",
+                               "step_spike") == AUTODUMP_CAP + 6
+        finally:
+            _flags(**old)
+
+
+# ------------------------------------------------------------ MFU/goodput
+class TestMfuGoodput:
+    def test_mfu_hand_computed(self):
+        """1 TFLOP/s peak, 1e12 flops in a 2 s step -> 5e11 FLOP/s
+        achieved -> MFU 0.5 exactly; a program contributing half the
+        flops gets its own child at 0.25."""
+        old = _flags(FLAGS_obs_peak_tflops=1.0)
+        try:
+            reg = obs.Registry()
+            led = obs.GoodputLedger(registry=reg)
+            led.start()
+            mfu = led.observe_step(2.0, data_wait_s=0.25, flops=1e12,
+                                   programs=[("to_static|step/abc",
+                                              5e11)])
+            assert mfu == 0.5
+            m = reg.get("train_mfu")
+            vals = {labels[0]: child.value for labels, child in m.samples()}
+            assert vals["step"] == 0.5
+            assert vals["to_static|step/abc"] == 0.25
+            assert reg.get("train_achieved_flops").value == 5e11
+            assert reg.get("train_data_wait_seconds").count == 1
+        finally:
+            _flags(**old)
+
+    def test_goodput_category_accounting(self):
+        reg = obs.Registry()
+        led = obs.GoodputLedger(registry=reg)
+        led.start()
+        led.observe_step(2.0, data_wait_s=0.5)
+        led.observe_step(3.0, data_wait_s=0.0)
+        led.note_compile(1.5)
+        led.note_ckpt(0.25)
+        led.note_replay(0.75)
+        m = reg.get("train_goodput_seconds_total")
+        secs = {labels[0]: child.value for labels, child in m.samples()}
+        assert secs["productive"] == 5.0
+        assert secs["data_wait"] == 0.5
+        assert secs["compile"] == 1.5
+        assert secs["ckpt"] == 0.25
+        assert secs["replay"] == 0.75
+        ratio = reg.get("train_goodput_ratio").value
+        assert 0.0 < ratio <= 1.0
+        d = led.to_dict()
+        assert d["steps"] == 2 and d["seconds"]["productive"] == 5.0
+
+    def test_hooks_only_fire_while_active(self):
+        """Compile walls recorded while NO instrumented fit is running
+        (a serving engine warming in the same process) must not count
+        against training goodput."""
+        from paddle_tpu.obs import goodput
+
+        reg = obs.Registry()
+        led = obs.GoodputLedger(registry=reg)
+        goodput.note_compile(9.0)           # nothing active: dropped
+        assert led.seconds["compile"] == 0.0
+        prev = goodput.activate(led)
+        try:
+            goodput.note_compile(9.0)       # active but not started
+            assert led.seconds["compile"] == 0.0
+            led.start()
+            goodput.note_compile(9.0)
+            assert led.seconds["compile"] == 9.0
+        finally:
+            goodput.deactivate(led)
+            if prev is not None:
+                goodput.activate(prev)
+
+    def test_replay_netted_out_of_data_wait(self):
+        """note_replay's wall is remembered and subtracted from the next
+        data_wait window — replay is its own category, not a loader
+        stall."""
+        led = obs.GoodputLedger(registry=obs.Registry())
+        led.start()
+        led.note_replay(1.25)
+        assert led.take_window_skip() == 1.25
+        assert led.take_window_skip() == 0.0      # consumed once
+        assert led.seconds["replay"] == 1.25
+
+    def test_compiled_step_flops_feed_mfu(self):
+        """A to_static train step compiled under FLAGS_jit_debug_program
+        carries XLA flops in the cost ledger; the recorder's dispatch
+        hook accumulates them per step so the MFU numerator needs no
+        declared step_flops."""
+        from paddle_tpu.obs.train_flight import set_current
+
+        old = _flags(FLAGS_jit_debug_program=True)
+        reg = obs.Registry()
+        rec = TrainFlightRecorder(registry=reg)
+        led = obs.GoodputLedger(registry=reg)
+        prev = set_current(rec)
+        try:
+            paddle.seed(0)
+            net = nn.Linear(8, 4)
+            opt = paddle.optimizer.SGD(learning_rate=1e-3,
+                                       parameters=net.parameters())
+            loss_fn = nn.MSELoss()
+
+            @paddle.jit.to_static
+            def train_step(x, y):
+                loss = loss_fn(net(x), y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return loss
+
+            rs = np.random.RandomState(0)
+            x = paddle.to_tensor(rs.randn(4, 8).astype("float32"))
+            y = paddle.to_tensor(rs.randn(4, 4).astype("float32"))
+            led.start()
+            last = None
+            for i in range(6):      # warmup/discover/compile, then _run
+                t0 = time.perf_counter()
+                rec.step_begin(i, 0, t0, t0)
+                train_step(x, y)
+                end = time.perf_counter()
+                last = rec.step_end(end, end - t0)
+            assert last.flops > 0, "dispatch hook recorded no flops"
+            assert last.programs and \
+                last.programs[0][0].startswith("to_static|")
+            names = {n for n, _, _, _ in last.spans}
+            assert any(n.startswith("dispatch:") for n in names)
+            mfu = led.observe_step(last.wall_s, flops=last.flops,
+                                   programs=last.programs)
+            assert mfu is not None and mfu > 0
+            programs = {labels[0] for labels, _ in
+                        reg.get("train_mfu").samples()}
+            assert "step" in programs
+            assert any(p.startswith("to_static|") for p in programs)
+        finally:
+            set_current(prev)
+            _flags(**old)
+
+
+# ------------------------------------------- goodput across kill -> resume
+class _SigtermAt(paddle.hapi.callbacks.Callback):
+    """Deliver a real SIGTERM at the start of the n-th batch (the
+    faultinject.sigterm_self preemption notice, scheduled mid-fit)."""
+
+    def __init__(self, n):
+        self.n = n
+        self.count = 0
+
+    def on_train_batch_begin(self, step, logs=None):
+        self.count += 1
+        if self.count == self.n:
+            with fi.sigterm_self():
+                pass
+
+
+class TestGoodputAcrossResume:
+    def test_kill_resume_accounts_replay_and_ckpt(self, tmp_path):
+        """Preempt an instrumented fit mid-epoch (SIGTERM via the
+        round-12 CheckpointCallback), resume into a fresh fit sharing
+        the registry: the resume fast-forward lands in
+        train_goodput_seconds_total{replay} (NOT in data_wait or
+        productive), the blocking preemption save lands in {ckpt}, and
+        productive seconds keep growing across the cycle."""
+        root = str(tmp_path / "ck")
+        reg = obs.Registry()
+
+        m1 = _model(0)
+        ck1 = CheckpointCallback(root, save_freq_steps=0,
+                                 save_freq_epochs=0)
+        tel1 = TelemetryCallback(registry=reg)
+        m1.fit(_ToyData(16), batch_size=2, epochs=2, verbose=0,
+               shuffle=True, callbacks=[tel1, ck1, _SigtermAt(3)])
+        assert ck1.preempted
+        secs = {labels[0]: c.value for labels, c in
+                reg.get("train_goodput_seconds_total").samples()}
+        assert secs.get("ckpt", 0) > 0          # blocking preemption save
+        prod_before = secs["productive"]
+        assert reg.get("train_steps_total").value == 3
+        assert secs.get("replay", 0) == 0
+
+        m2 = _model(7)
+        ck2 = CheckpointCallback(root, save_freq_steps=0,
+                                 save_freq_epochs=0, resume=True)
+        tel2 = TelemetryCallback(registry=reg)
+        m2.fit(_ToyData(16), batch_size=2, epochs=2, verbose=0,
+               shuffle=True, callbacks=[tel2, ck2])
+        assert ck2.last_restore is not None
+        secs = {labels[0]: c.value for labels, c in
+                reg.get("train_goodput_seconds_total").samples()}
+        assert secs.get("replay", 0) > 0        # fast-forward accounted
+        assert secs["productive"] > prod_before
+        # 16 total steps of real compute across the cycle: 3 + 13
+        assert reg.get("train_steps_total").value == 16
+        # replay must NOT have been double-counted as the first resumed
+        # step's data wait: that step's wait is bounded by the replay
+        # wall, and the ledger consumed the skip exactly once
+        assert tel2.ledger.take_window_skip() == 0.0
+
+
+# ------------------------------------------------------------ flush scopes
+class TestFlushScopes:
+    def test_sequential_fits_rebaseline(self):
+        """The round-16 satellite: flushes that happened OUTSIDE a fit
+        (or in a prior fit) must not appear in the next fit's
+        train_lazy_flushes_total — the old implementation diffed the
+        process-global counter and re-reported them on reattach."""
+        from paddle_tpu.core import lazy
+
+        reg = obs.Registry()
+        cb = TelemetryCallback(registry=reg)
+        _fit(_model(0), cb, n=8)
+        base = reg.get("train_lazy_flushes_total").value
+        # flushes land between the fits (another subsystem's segments)
+        for _ in range(100):
+            lazy._count_flush()
+        _fit(_model(1), cb, n=8)                 # REATTACH, same callback
+        assert reg.get("train_lazy_flushes_total").value == base
+
+    def test_nested_scopes_attribute_innermost(self):
+        from paddle_tpu.core import lazy
+
+        outer = lazy.push_flush_scope()
+        try:
+            lazy._count_flush()
+            inner = lazy.push_flush_scope()
+            lazy._count_flush()
+            lazy._count_flush()
+            lazy.pop_flush_scope(inner)
+            lazy._count_flush()
+            assert inner.count == 2
+            assert outer.count == 2              # 1 before + 1 after
+        finally:
+            lazy.pop_flush_scope(outer)
+
+    def test_pop_is_exception_robust(self):
+        from paddle_tpu.core import lazy
+
+        a = lazy.push_flush_scope()
+        lazy.push_flush_scope()                  # leaked by a failed fit
+        lazy.pop_flush_scope(a)                  # pops the leak too
+        assert not lazy._flush_scopes
+
+
+# --------------------------------------------------------- bench history
+class TestBenchHistory:
+    def test_append_and_trend(self, tmp_path):
+        import bench
+        import bench_trend
+
+        path = str(tmp_path / "hist.jsonl")
+        bench._append_history("r1", "llama_serving",
+                              {"tokens_per_sec": 100.0,
+                               "ttft_ms_p95": 50.0, "platform": "cpu"},
+                              path=path)
+        bench._append_history("r1", "broken", {"error": "boom"},
+                              path=path)            # error rows skipped
+        bench._append_history("r2", "llama_serving",
+                              {"tokens_per_sec": 85.0,
+                               "ttft_ms_p95": 58.0, "platform": "cpu"},
+                              path=path)
+        rows = bench_trend.load_history(path)
+        assert len(rows) == 2
+        assert all(r["platform"] == "cpu" for r in rows)
+        rep = bench_trend.trend(path)
+        assert len(rep) == 1
+        diffs = {d["metric"]: d for d in rep[0]["diffs"]}
+        assert diffs["tokens_per_sec"]["regression"]          # -15%
+        assert diffs["ttft_ms_p95"]["regression"]             # +16%
+        rep5 = bench_trend.trend(path, threshold_pct=20.0)
+        assert not any(d["regression"]
+                       for d in rep5[0]["diffs"])
+
+    def test_platforms_never_cross_diff(self, tmp_path):
+        import bench
+        import bench_trend
+
+        path = str(tmp_path / "hist.jsonl")
+        bench._append_history("r1", "decode",
+                              {"tokens_per_sec": 900.0,
+                               "platform": "tpu"}, path=path)
+        bench._append_history("r2", "decode",
+                              {"tokens_per_sec": 50.0,
+                               "platform": "cpu"}, path=path)
+        rep = bench_trend.trend(path)
+        assert all(e["status"].startswith("single-run") for e in rep)
+
+
+# ------------------------------------------------------------ overhead A/B
+class _TimedTelemetry(TelemetryCallback):
+    """Measures its own hook walls so the A/B is deterministic: the
+    recorder+ledger cost per step is compared against the step wall
+    itself, not against a second noisy run."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.hook_s = 0.0
+
+    def on_train_batch_begin(self, step, logs=None):
+        t0 = time.perf_counter()
+        super().on_train_batch_begin(step, logs)
+        self.hook_s += time.perf_counter() - t0
+
+    def on_train_batch_end(self, step, logs=None):
+        t0 = time.perf_counter()
+        super().on_train_batch_end(step, logs)
+        self.hook_s += time.perf_counter() - t0
+
+
+class TestOverheadAB:
+    def test_recorder_under_two_percent(self):
+        """The round-11 bar: recorder + ledger bookkeeping per
+        steady-state step stays under 2% of the step wall (a model big
+        enough that the step does real work — a production step is tens
+        of ms to seconds, this one ~5-10 ms; warmup excluded)."""
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(512, 512), nn.ReLU(),
+                            nn.Linear(512, 512))
+        m = paddle.Model(net)
+        m.prepare(paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=net.parameters()),
+                  nn.MSELoss())
+        cb = _TimedTelemetry(registry=obs.Registry(), batch_tokens=32)
+        m.fit(_ToyData(320, d_in=512, d_out=512), batch_size=16, epochs=1,
+              verbose=0, shuffle=False, callbacks=[cb])
+        hist = cb.registry.get("train_step_seconds")
+        assert hist.count == 20
+        steady = sorted(hist._exact)[: hist.count // 2]  # drop warmup tail
+        step_wall = sum(steady) / len(steady)
+        hook_wall = cb.hook_s / hist.count
+        overhead = hook_wall / step_wall
+        assert overhead < 0.02, (
+            f"recorder+ledger hooks cost {hook_wall * 1e6:.1f}us/step = "
+            f"{overhead:.2%} of the {step_wall * 1e3:.2f}ms steady step "
+            "wall — over the round-11 2% bar")
+
+
+# ------------------------------------------------------- review findings
+class TestReviewRegressions:
+    def test_aborted_fit_restores_process_hooks(self):
+        """A batch that raises mid-fit must not leak the round-16
+        process globals: fit's finally still calls on_train_end, which
+        restores the flight recorder, deactivates the goodput ledger
+        (a later serving compile must not book into the dead fit) and
+        pops the flush scope."""
+        from paddle_tpu.core import lazy
+        from paddle_tpu.obs import goodput, train_flight
+
+        class _Boom(paddle.hapi.callbacks.Callback):
+            def on_train_batch_end(self, step, logs=None):
+                if step == 1:
+                    raise RuntimeError("injected mid-fit failure")
+
+        cb = TelemetryCallback(registry=obs.Registry())
+        depth0 = len(lazy._flush_scopes)
+        with pytest.raises(RuntimeError, match="injected"):
+            _fit(_model(), cb, n=16, extra=[_Boom()])
+        assert train_flight.current() is None
+        assert goodput.active_ledger() is None
+        assert not cb.ledger.active
+        assert len(lazy._flush_scopes) == depth0
+
+    def test_epoch_boundary_work_not_counted_as_data_wait(self):
+        """The wall between epochs (metric resets, a mid-fit evaluate()
+        pass) is not a loader stall: on_epoch_begin re-anchors the
+        data-wait window, so the next step cannot fire a spurious
+        data_starvation postmortem."""
+        cb = TelemetryCallback(registry=obs.Registry())
+        cb.on_train_begin()
+        try:
+            cb.on_epoch_begin(0)
+            cb.on_train_batch_begin(0)
+            cb.on_train_batch_end(0, {"loss": 0.1})
+            time.sleep(0.05)          # the eval pass / epoch-end work
+            cb.on_epoch_begin(1)
+            cb.on_train_batch_begin(1)
+            assert cb._cur.data_wait_s < 0.04
+            cb.on_train_batch_end(1, {"loss": 0.1})
+        finally:
+            cb.on_train_end()
+
+    def test_boundary_resume_books_replay(self):
+        """A checkpoint at an exact epoch boundary (skip_batches ==
+        steps-per-epoch) drains the resumed epoch without a real step —
+        the replay wall must still land in the replay category, not in
+        the next epoch's first data_wait."""
+        reg = obs.Registry()
+        cb = TelemetryCallback(registry=reg)
+        m = _model()
+        m._ckpt_resume = {"epoch": 0, "batch": 4}   # == len(loader)
+        m.fit(_ToyData(16), batch_size=4, epochs=2, verbose=0,
+              shuffle=False, callbacks=[cb])
+        secs = {labels[0]: c.value for labels, c in
+                reg.get("train_goodput_seconds_total").samples()}
+        assert secs.get("replay", 0) > 0
+        assert reg.get("train_steps_total").value == 4  # epoch 1 only
+        assert cb.ledger.take_window_skip() == 0.0      # consumed
+
+    def test_shared_server_port_zero_not_cached(self):
+        """shared_server(0) means 'any free port' — two anonymous
+        callers must get DISTINCT servers, not silently merge onto one
+        endpoint whose close() tears both down."""
+        s1 = obs.shared_server(0)
+        s2 = obs.shared_server(0)
+        try:
+            assert s1 is not s2 and s1.port != s2.port
+            assert obs.shared_server(s1.port) is s1   # resolved: shared
+        finally:
+            s1.close()
+            s2.close()
+
+    def test_flight_steps_gauge_counts_active(self):
+        reg = obs.Registry()
+        rec = TrainFlightRecorder(capacity=8, registry=reg)
+        rec.step_begin(0, 0, 1.0, 1.25)
+        assert reg.get("train_flight_steps").value == 1   # active counts
+        rec.step_end(2.0, wall_s=0.75)
+        assert reg.get("train_flight_steps").value == 1   # finished
+        rec.step_begin(1, 0, 2.0, 2.25)
+        assert reg.get("train_flight_steps").value == 2
+
+    def test_shared_metrics_one_help_type_group_per_name(self):
+        """Two engine registries sharing a metric name must merge into
+        ONE HELP/TYPE group on the shared /metrics body — the Prometheus
+        text format rejects duplicate groups, so a naive per-registry
+        concatenation made a 2-engine scrape entirely unparseable."""
+        srv = obs.serve_metrics(0, obs.Registry())
+        try:
+            r1, r2 = obs.Registry(), obs.Registry()
+            r1.gauge("serving_slots", "slots").set(2)
+            r2.gauge("serving_slots", "slots").set(4)
+            srv.register_engine("e0", r1)
+            srv.register_engine("e1", r2)
+            body = srv.render()
+            assert body.count(
+                "# TYPE paddle_tpu_serving_slots gauge") == 1
+            assert 'paddle_tpu_serving_slots{engine="e0"} 2' in body
+            assert 'paddle_tpu_serving_slots{engine="e1"} 4' in body
+        finally:
+            srv.close()
+
+    def test_repeated_program_dispatch_sums_mfu(self):
+        """One compiled program dispatched N times per step (grad
+        accumulation) must report N x its flops in train_mfu{program},
+        matching the aggregate — not the last dispatch's share."""
+        old = _flags(FLAGS_obs_peak_tflops=1.0)
+        try:
+            reg = obs.Registry()
+            led = obs.GoodputLedger(registry=reg)
+            led.start()
+            led.observe_step(1.0, flops=1e12,
+                             programs=[("p", 5e11), ("p", 5e11)])
+            vals = {labels[0]: c.value for labels, c in
+                    reg.get("train_mfu").samples()}
+            assert vals["p"] == vals["step"] == 1.0
+        finally:
+            _flags(**old)
+
+    def test_flight_off_still_reports_data_wait(self):
+        """TelemetryCallback(flight=False): the data wait measured at
+        batch begin must still reach the histogram + goodput category
+        (it used to ride only on the StepFlight, which doesn't exist)."""
+        reg = obs.Registry()
+        cb = TelemetryCallback(registry=reg, flight=False)
+        cb.on_train_begin()
+        try:
+            cb.on_epoch_begin(0)
+            cb.on_train_batch_begin(0)
+            cb.on_train_batch_end(0, {"loss": 0.1})
+            time.sleep(0.03)                 # a real loader stall
+            cb.on_train_batch_begin(1)
+            cb.on_train_batch_end(1, {"loss": 0.1})
+        finally:
+            cb.on_train_end()
+        assert cb.flight is None
+        h = reg.get("train_data_wait_seconds")
+        assert h.count == 2 and max(h._exact) > 0.02
+        secs = {labels[0]: c.value for labels, c in
+                reg.get("train_goodput_seconds_total").samples()}
+        assert secs["data_wait"] > 0.02
+
+    def test_trend_direction_components(self):
+        import bench_trend as bt
+
+        assert bt.lower_is_better("ttft_ms_p95")
+        assert bt.lower_is_better("us_per_op")
+        assert bt.lower_is_better("save_blocking_ms")
+        assert bt.lower_is_better("cache_read_bytes_per_step")
+        assert not bt.lower_is_better("tokens_per_sec")
+        assert not bt.lower_is_better("goodput_rps")
+        assert not bt.lower_is_better("programs")
+        assert not bt.lower_is_better("num_streams")
+        assert not bt.lower_is_better("write_gb_per_s")
+
+
+# ------------------------------------------------------------------ meta
+def test_required_train_metrics_exist_after_instrumented_fit():
+    """The graft_lint REQUIRED_TRAIN_METRICS contract, provable without
+    the CLI: constructing the callback + one fit materializes every
+    row."""
+    from graft_lint import REQUIRED_TRAIN_METRICS
+
+    reg = obs.Registry()
+    cb = TelemetryCallback(registry=reg, batch_tokens=8, step_flops=1e6)
+    _fit(_model(), cb, n=8)
+    snap = reg.to_dict()
+    missing = [m for m in REQUIRED_TRAIN_METRICS if m not in snap]
+    assert not missing, missing
+
+
+def test_quick_tier_registration():
+    """test_train_flight.py must ride the quick tier (conftest
+    QUICK_MODULES)."""
+    import conftest
+
+    assert "test_train_flight.py" in conftest.QUICK_MODULES

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""bench_trend — diff the latest two comparable bench runs per rung.
+
+``bench.py`` appends one platform-tagged JSONL record per completed rung
+to ``BENCH_HISTORY.jsonl`` (round 16 — before that nothing persisted
+across runs and the perf trajectory was empty). This CLI pairs, for each
+(rung, platform), the newest record with the newest EARLIER-run record
+on the SAME platform (a cpu smoke never diffs against a tpu capture),
+diffs every shared numeric metric, and flags moves past the threshold
+(default 10%) in the metric's bad direction:
+
+  * higher-is-better (tok/s, goodput, utilization, hit counts):
+    a drop > threshold is a REGRESSION;
+  * lower-is-better (latency ms/seconds, TTFT, walls, bytes):
+    a rise > threshold is a REGRESSION.
+
+Bookkeeping fields (wall_s, timestamps, compile counts) are skipped —
+they vary run to run by design. Exit code: 0 by default (the trend is a
+report); ``--fail-on-regress`` exits 1 when any regression is flagged
+(the opt-in CI gate shape, like check_scoreboard's).
+
+Usage:
+    python tools/bench_trend.py                      # report all rungs
+    python tools/bench_trend.py --rung llama_serving
+    python tools/bench_trend.py --threshold 5 --fail-on-regress
+    python tools/bench_trend.py --json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_HISTORY = os.path.join(REPO, "BENCH_HISTORY.jsonl")
+
+#: metric-name COMPONENTS (underscore-split) that mean LOWER is better;
+#: everything else numeric defaults to higher-is-better (tok/s, goodput,
+#: utilization). Whole-component match, not substring — "programs" or
+#: "num_streams" must not match "ms"
+LOWER_IS_BETTER = {"ms", "us", "s", "seconds", "latency", "ttft", "tpot",
+                   "wall", "bytes", "stall", "p50", "p95", "p99",
+                   "blocking"}
+
+#: bookkeeping keys never trended (vary run-to-run by design)
+SKIP_KEYS = {"wall_s", "t", "rc", "platform", "note", "steps", "iters",
+             "warmup", "batch", "seq_len", "obs"}
+
+
+def _numeric_metrics(record: dict, prefix="") -> dict:
+    """Flatten one rung record's top-level numeric fields (nested dicts
+    one level deep, e.g. serving stats blocks)."""
+    out = {}
+    for k, v in record.items():
+        if k in SKIP_KEYS:
+            continue
+        name = f"{prefix}{k}"
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[name] = float(v)
+        elif isinstance(v, dict) and not prefix:
+            out.update(_numeric_metrics(v, prefix=f"{k}."))
+    return out
+
+
+def lower_is_better(name: str) -> bool:
+    leaf = name.rsplit(".", 1)[-1].lower()
+    parts = leaf.split("_")
+    if "per" in parts:
+        # a rate: judged by its NUMERATOR — time/bytes per item
+        # ("us_per_op", "ms_per_token_step", "bytes_per_step") is
+        # lower-better, items per time ("tokens_per_sec") higher-better
+        parts = parts[: parts.index("per")]
+    return bool(set(parts) & LOWER_IS_BETTER)
+
+
+def load_history(path):
+    rows = []
+    with open(path) as fh:
+        for ln in fh:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rows.append(json.loads(ln))
+            except json.JSONDecodeError:
+                continue   # a torn tail line must not kill the report
+    return rows
+
+
+def latest_pairs(rows, rung=None):
+    """For each (rung, platform): (previous, latest) records from two
+    DIFFERENT runs, newest first — or None when only one run exists."""
+    by_key: dict = {}
+    for r in rows:
+        if not isinstance(r, dict) or "rung" not in r:
+            continue
+        if rung and r["rung"] != rung:
+            continue
+        by_key.setdefault((r["rung"], r.get("platform")), []).append(r)
+    pairs = {}
+    for key, group in sorted(by_key.items()):
+        group.sort(key=lambda r: r.get("t", 0.0))
+        latest = group[-1]
+        prev = next((r for r in reversed(group[:-1])
+                     if r.get("run") != latest.get("run")), None)
+        pairs[key] = (prev, latest)
+    return pairs
+
+
+def diff_pair(prev, latest, threshold_pct=10.0):
+    """Per-metric deltas between two comparable records. Returns rows of
+    {metric, before, after, delta_pct, direction, regression}."""
+    a = _numeric_metrics(prev["record"])
+    b = _numeric_metrics(latest["record"])
+    out = []
+    for name in sorted(set(a) & set(b)):
+        before, after = a[name], b[name]
+        if before == 0:
+            continue
+        delta = (after - before) / abs(before) * 100.0
+        lib = lower_is_better(name)
+        regressed = (delta > threshold_pct) if lib \
+            else (delta < -threshold_pct)
+        out.append({"metric": name, "before": before, "after": after,
+                    "delta_pct": round(delta, 2),
+                    "direction": "lower-better" if lib else
+                    "higher-better",
+                    "regression": bool(regressed)})
+    return out
+
+
+def trend(path=DEFAULT_HISTORY, rung=None, threshold_pct=10.0):
+    rows = load_history(path)
+    report = []
+    for (name, platform), (prev, latest) in \
+            latest_pairs(rows, rung=rung).items():
+        entry = {"rung": name, "platform": platform,
+                 "latest_run": latest.get("run")}
+        if prev is None:
+            entry["status"] = "single-run (nothing to diff yet)"
+            entry["diffs"] = []
+        else:
+            entry["previous_run"] = prev.get("run")
+            entry["diffs"] = diff_pair(prev, latest,
+                                       threshold_pct=threshold_pct)
+            regs = [d for d in entry["diffs"] if d["regression"]]
+            entry["status"] = (f"{len(regs)} regression(s) past "
+                               f"{threshold_pct:g}%" if regs else "ok")
+        report.append(entry)
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--history", default=DEFAULT_HISTORY,
+                    help=f"history file (default {DEFAULT_HISTORY})")
+    ap.add_argument("--rung", default=None, help="only this rung")
+    ap.add_argument("--threshold", type=float, default=10.0,
+                    help="regression threshold in percent (default 10)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--fail-on-regress", action="store_true",
+                    help="exit 1 when any regression is flagged")
+    args = ap.parse_args(argv)
+
+    if not os.path.exists(args.history):
+        print(f"no history at {args.history} — run bench.py first "
+              "(every completed rung appends a record)")
+        return 0
+    report = trend(args.history, rung=args.rung,
+                   threshold_pct=args.threshold)
+    regressions = sum(
+        1 for e in report for d in e["diffs"] if d["regression"])
+    if args.as_json:
+        print(json.dumps({"threshold_pct": args.threshold,
+                          "regressions": regressions,
+                          "rungs": report}, indent=2))
+    else:
+        for e in report:
+            plat = e["platform"] or "?"
+            print(f"{e['rung']} [{plat}]: {e['status']}")
+            for d in e["diffs"]:
+                flag = " <-- REGRESSION" if d["regression"] else ""
+                print(f"    {d['metric']:<40} {d['before']:>12.4g} -> "
+                      f"{d['after']:>12.4g}  ({d['delta_pct']:+.1f}%, "
+                      f"{d['direction']}){flag}")
+        print(f"\n{regressions} regression(s) past "
+              f"{args.threshold:g}% across {len(report)} rung(s)")
+    return 1 if (args.fail_on_regress and regressions) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

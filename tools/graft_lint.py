@@ -31,7 +31,12 @@ engine must dump a VALID Chrome-trace/Perfetto JSON (per-request spans
 tiling the TTFT decomposition), every decode bucket it drove must have
 an analyzed obs cost-ledger row (XLA bytes/flops + measured walls), and
 analysis D8 (audit_cost_regressions) gates per-program bytes-accessed
-against the committed tools/cost_baseline.json.
+against the committed tools/cost_baseline.json. Round 16 adds the
+TRAINING contract: a short instrumented Model.fit must dump a valid
+training trace (each step's data_wait+compute spans tile the recorded
+step wall), land every REQUIRED_TRAIN_METRICS row (train_mfu, goodput,
+data-wait), and pass analysis D12 (audit_train_steps: starvation
+streaks / MFU collapse) at default flags.
 
 The special model name `ckpt` (round 12) smokes crash consistency
 end-to-end: a tiny model + AdamW trains, checkpoints twice, the NEWEST
@@ -282,6 +287,17 @@ REQUIRED_CKPT_METRICS = (
     "ckpt_save_seconds", "ckpt_restore_seconds", "ckpt_saves_total",
     "ckpt_restores_total", "ckpt_bytes_written_total", "ckpt_last_step")
 
+#: training telemetry rows the obs smoke requires in the DEFAULT registry
+#: after a short instrumented Model.fit (the round-16 training
+#: flight-recorder / MFU / goodput contract)
+REQUIRED_TRAIN_METRICS = (
+    "train_step_seconds", "train_steps_total", "train_loss",
+    "train_tokens_per_sec", "train_lazy_flushes_total",
+    "train_data_wait_seconds", "train_mfu", "train_achieved_flops",
+    "train_goodput_ratio", "train_goodput_seconds_total",
+    "train_flight_steps", "train_flight_anomalies_total",
+    "train_flight_dumps_total")
+
 #: the subset that MUST have observed/counted after the smoke's drained
 #: runs (rejects/blocked legitimately stay zero on a healthy stream)
 MUST_COUNT_SERVING_METRICS = (
@@ -436,6 +452,96 @@ def audit_obs() -> list:
         findings.append(analysis.Finding(
             "obs-coverage", "note", "obs/ckpt-smoke",
             f"{len(REQUIRED_CKPT_METRICS)} required ckpt metrics present"))
+    findings += audit_train_smoke()
+    return findings
+
+
+def audit_train_smoke() -> list:
+    """The training half of the `obs` smoke (round 16): run a short
+    instrumented Model.fit (TelemetryCallback with its flight recorder +
+    goodput ledger on the DEFAULT registry), then require (a) a VALID
+    training trace dump — every step's data_wait+compute spans tile the
+    recorded step wall, re-checked by obs.validate_trace, (b) the
+    REQUIRED_TRAIN_METRICS rows (MFU, goodput, data-wait among them),
+    and (c) a clean analysis D12 (audit_train_steps) at default flags —
+    a starvation streak or MFU collapse in the smoke's in-memory loader
+    would mean the detector itself is miscalibrated."""
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis, obs
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 32), paddle.nn.ReLU(),
+                               paddle.nn.Linear(32, 4))
+    model = paddle.hapi.Model(net)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=net.parameters())
+    model.prepare(opt, paddle.nn.MSELoss())
+    rs = np.random.RandomState(0)
+    data = [(rs.randn(8).astype("float32"), rs.randn(4).astype("float32"))
+            for _ in range(16)]
+    # eager steps have no compiled program to read flops from — declare
+    # them (2 * params * 3 for fwd+bwd is the usual hand estimate; the
+    # exact number only scales the MFU gauge, the smoke checks presence)
+    n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    cb = paddle.hapi.TelemetryCallback(batch_tokens=8 * 4,
+                                       step_flops=6.0 * n_params * 4)
+    model.fit(data, batch_size=4, epochs=2, verbose=0, callbacks=[cb])
+
+    findings = []
+    steps_run = int(cb.ledger.steps)
+    fd, tpath = tempfile.mkstemp(prefix="graft_lint_train_trace_",
+                                 suffix=".json")
+    os.close(fd)
+    summary = None
+    try:
+        cb.flight.dump_trace(tpath)
+        summary = obs.validate_trace(tpath)
+    except (AssertionError, ValueError) as e:
+        findings.append(analysis.Finding(
+            "obs-train-flight", "error", "obs/train-smoke",
+            f"training trace dump failed validation: {e}"))
+    finally:
+        os.unlink(tpath)
+    if summary is not None:
+        if summary["tiled_steps"] < steps_run or not summary["events"]:
+            findings.append(analysis.Finding(
+                "obs-train-flight", "error", "obs/train-smoke",
+                f"training trace degraded: {summary['tiled_steps']} "
+                f"wall-tiled step timelines for {steps_run} steps run "
+                f"({summary['events']} events)", data=summary))
+        else:
+            findings.append(analysis.Finding(
+                "obs-train-flight", "note", "obs/train-smoke",
+                f"training trace valid: {summary['events']} events, "
+                f"{summary['tiled_steps']}/{steps_run} steps tile their "
+                "recorded walls", data=summary))
+    snap = obs.default_registry().to_dict()
+    missing = [m for m in REQUIRED_TRAIN_METRICS if m not in snap]
+    zero = []
+    for m in ("train_step_seconds", "train_steps_total", "train_mfu",
+              "train_goodput_seconds_total", "train_data_wait_seconds"):
+        if m not in missing and not any(
+                s.get("count") or s.get("value")
+                for s in snap[m]["samples"]):
+            zero.append(m)
+    if missing or zero:
+        findings.append(analysis.Finding(
+            "obs-coverage", "error", "obs/train-smoke",
+            f"default registry lost required training metrics after an "
+            f"instrumented fit — missing: {missing}, never-observed: "
+            f"{zero}", data={"missing": missing, "zero": zero}))
+    else:
+        findings.append(analysis.Finding(
+            "obs-coverage", "note", "obs/train-smoke",
+            f"{len(REQUIRED_TRAIN_METRICS)} required training metrics "
+            "present and counting"))
+    findings += analysis.audit_train_steps(recorder=cb.flight,
+                                           ledger=cb.ledger,
+                                           loc="obs/train-smoke")
     return findings
 
 
