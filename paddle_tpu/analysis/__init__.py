@@ -73,9 +73,31 @@ re-exported here because its output is Findings):
                          (recent median a fraction of the run median)
                          are warnings — gated by the graft_lint `obs`
                          smoke's instrumented Model.fit.
+
+Concurrency auditor (round 17, concurrency.py + core/lockdep.py):
+  D13 lint_guarded_by    lock-discipline AST lint: `# guarded-by:`
+      audit_shared_state annotated fields mutated outside `with <lock>`
+                         scopes, and un-annotated module globals mutated
+                         by functions the conservative package call
+                         graph reaches from background thread roots
+                         (Thread targets, HTTP do_* handlers, signal /
+                         atexit hooks)
+  D14 audit_lock_order   runtime lockdep over the tracked-lock held-set
+                         recorded in the multi-threaded `conc` smoke:
+                         lock-ORDER cycles and blocking calls
+                         (fsync/compile) under hot scrape-path locks
+  D15 audit_thread_contracts  the declared single-owner thread contract
+      audit_contract_callsites of ServingEngine / PagedKVCache pool /
+                         PrefixCache: runtime breaches recorded by
+                         core.lockdep.ThreadContract
+                         (FLAGS_debug_thread_checks) plus statically
+                         visible contract-method calls from thread roots
 """
 from .ast_lint import (audit_flags_doc, lint_dy2static, lint_file,
                        lint_tree, lint_vjp_saves, lint_x64)
+from .concurrency import (audit_concurrency, audit_contract_callsites,
+                          audit_lock_order, audit_shared_state,
+                          audit_thread_contracts, lint_guarded_by)
 from .dataflow import ProgramIndex, build_index
 from .findings import (Finding, apply_baseline, format_text, gate_failures,
                        load_baseline, stale_suppressions, to_json)
@@ -139,4 +161,6 @@ __all__ = [
     "decode_vmem_bytes", "flash_vmem_bytes", "norm_vmem_bytes",
     "audit_flags_doc", "lint_dy2static", "lint_file", "lint_tree",
     "lint_vjp_saves", "lint_x64",
+    "audit_concurrency", "audit_contract_callsites", "audit_lock_order",
+    "audit_shared_state", "audit_thread_contracts", "lint_guarded_by",
 ]

@@ -258,7 +258,9 @@ def lint_dy2static(tree: ast.AST, src: str, relpath: str) -> list[Finding]:
 
 # ---------------------------------------------------------------- drivers
 
-_FILE_RULES = (lint_x64, lint_vjp_saves, lint_dy2static)
+from .concurrency import audit_concurrency, lint_guarded_by  # noqa: E402
+
+_FILE_RULES = (lint_x64, lint_vjp_saves, lint_dy2static, lint_guarded_by)
 
 
 def lint_file(path: str, root: str | None = None) -> list[Finding]:
@@ -288,4 +290,5 @@ def lint_tree(root: str | None = None, package: str = "paddle_tpu"
             if fn.endswith(".py"):
                 out.extend(lint_file(os.path.join(dirpath, fn), root))
     out.extend(audit_flags_doc(root))
+    out.extend(audit_concurrency(root, package))
     return out

@@ -1,0 +1,836 @@
+"""Concurrency auditor — lock-discipline lint + lock-order/thread-contract
+findings (D13/D14/D15).
+
+The framework's thread fabric (async ckpt commits, the shared /metrics
+endpoint, comm/compile watchdogs, RPC serve loops, the per-instance
+to_static RLock) had zero static coverage: the last three review passes
+each caught a real race by hand. These detectors make the thread-safety
+contract machine-checked, the same "regressions fail lint" strategy
+D1–D12 apply to dtypes, recompiles, costs and shardings:
+
+  D13 ``conc-guarded-by``    lock-discipline AST lint. Fields declared
+       ``conc-shared-state``  with ``# guarded-by: <lock>`` on their
+                              defining assignment must only be MUTATED
+                              inside a lexical ``with <lock>:`` scope (or
+                              inside a helper declared
+                              ``# requires-lock: <lock>``, whose same-file
+                              call sites must themselves hold the lock).
+                              Separately, an UN-annotated module-level
+                              mutable (dict/list/deque/global rebind)
+                              mutated by any function reachable — over a
+                              conservative package-wide AST call graph —
+                              from two distinct thread roots
+                              (threading.Thread targets, HTTP do_* handler
+                              methods, signal handlers, atexit hooks; the
+                              main thread counts as one root reaching
+                              everything) is a warning: annotate it
+                              ``# guarded-by:`` and lock it, or declare
+                              the deliberate lock-free design with
+                              ``# thread-safe: <reason>``.
+  D14 ``conc-lock-order``    runtime lockdep (core/lockdep.py): the
+       ``conc-blocking-under-lock`` tracked-lock held-set recorded during
+                              the multi-threaded ``conc`` smoke builds the
+                              global lock-ORDER graph — any cycle is a
+                              latent deadlock and fails lint; an
+                              instrumented blocking call (fsync, compile)
+                              made while holding a hot (scrape-path) lock
+                              is a violation.
+  D15 ``conc-thread-contract`` the declared owner-thread contract of the
+                              single-threaded serving objects: runtime
+                              breaches recorded by ThreadContract.check()
+                              (FLAGS_debug_thread_checks) become findings,
+                              and statically, a thread-root function that
+                              drives a contract-declaring class (class
+                              attr ``_thread_contract = (methods...)``)
+                              through a variable the graph can see bound
+                              to its constructor is flagged before any
+                              runtime ever interleaves.
+
+Annotation surface (machine-checked comments):
+
+  # guarded-by: <lock>     on the defining assignment of an instance
+                           attribute or module global
+  # requires-lock: <lock>  on a ``def``: the body counts as holding
+                           <lock>; every same-file call site is checked
+  # thread-safe: <reason>  on a module global: deliberate lock-free
+                           shared state (GIL-atomic bounded-deque
+                           appends, monotonic counters) — exempt from
+                           ``conc-shared-state``, the reason IS the doc
+  # unguarded-ok: <reason> on one mutation line: acknowledged benign
+                           race at that site only
+
+Fire/no-fire fixtures live in tests/lint_fixtures/fx_conc_*.py and are
+self-tested by the graft_lint ``conc`` smoke — a silently-dead detector
+fails the gate exactly like a falsely-firing one.
+"""
+from __future__ import annotations
+
+import ast
+import builtins as _builtins
+import os
+import re
+
+from .findings import Finding
+
+_GUARDED = re.compile(r"#[:\s]*guarded-by:\s*([A-Za-z_][\w.]*)")
+_REQUIRES = re.compile(r"#[:\s]*requires-lock:\s*([A-Za-z_][\w.]*)")
+_THREADSAFE = re.compile(r"#[:\s]*thread-safe:\s*(\S.*)")
+_UNGUARDED_OK = re.compile(r"#[:\s]*unguarded-ok:\s*(\S.*)")
+
+#: method names that mutate their receiver in place
+_MUTATORS = frozenset((
+    "append", "appendleft", "extend", "insert", "remove", "pop", "popleft",
+    "popitem", "clear", "update", "setdefault", "add", "discard", "sort",
+    "put", "put_nowait", "__setitem__", "__delitem__"))
+
+#: HTTP-handler method names that run on server threads
+_HTTP_HANDLERS = frozenset((
+    "do_GET", "do_POST", "do_PUT", "do_DELETE", "do_HEAD", "do_PATCH"))
+
+#: builtin names the call graph must not follow on BARE calls: the
+#: paddle op surface defines `max`/`sum`/`abs`/... twins, but a bare
+#: `max(...)` in framework code is the builtin — following it would pull
+#: the whole op-dispatch world into every closure (`paddle.max` style
+#: module-qualified calls still follow)
+_BUILTIN_NAMES = frozenset(dir(_builtins))
+
+
+def _trailing(node: ast.AST) -> str | None:
+    """The final name component of a Name/Attribute expression —
+    ``self._lock`` → ``_lock``, ``_SERVERS_LOCK`` → ``_SERVERS_LOCK``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _lock_key(spec: str) -> str:
+    return spec.split(".")[-1]
+
+
+def _ann_text(lines: list[str], lineno: int) -> str:
+    """The text searched for annotations at a definition on ``lineno``:
+    the line itself plus the whole CONTIGUOUS block of comment-only
+    lines directly above it (multi-line declarations are the norm — a
+    reason worth writing rarely fits one line; only checking the single
+    line above silently unbound every wrapped annotation)."""
+    parts = [lines[lineno - 1] if lineno <= len(lines) else ""]
+    i = lineno - 2
+    while 0 <= i < len(lines) and lines[i].lstrip().startswith("#"):
+        parts.append(lines[i])
+        i -= 1
+    return "\n".join(parts)
+
+
+# ====================================================== per-file D13 rule
+
+class _GuardInfo:
+    """Annotations extracted from one file's source."""
+
+    def __init__(self, tree: ast.AST, lines: list[str], src: str = ""):
+        self.attrs: dict[str, tuple[str, int]] = {}    # attr -> (lock, line)
+        self.globals: dict[str, tuple[str, int]] = {}  # global -> (lock, line)
+        self.threadsafe: dict[str, str] = {}           # global -> reason
+        self.fn_locks: dict[str, str] = {}             # func name -> lock
+        if src and "guarded-by" not in src and "thread-safe" not in src \
+                and "requires-lock" not in src:
+            return                  # unannotated file: nothing to index
+        module_names = _module_level_names(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                line = _ann_text(lines, node.lineno)
+                g = _GUARDED.search(line)
+                ts = _THREADSAFE.search(line)
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) and g:
+                        self.attrs.setdefault(
+                            t.attr, (g.group(1), node.lineno))
+                    elif isinstance(t, ast.Name) and t.id in module_names:
+                        if g:
+                            self.globals.setdefault(
+                                t.id, (g.group(1), node.lineno))
+                        if ts:
+                            self.threadsafe.setdefault(t.id, ts.group(1))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                m = _REQUIRES.search(_ann_text(lines, node.lineno))
+                if m:
+                    self.fn_locks[node.name] = m.group(1)
+
+
+def _module_level_names(tree: ast.AST) -> set[str]:
+    """Names bound by assignment at module top level."""
+    names: set[str] = set()
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _mutations(stmt: ast.stmt):
+    """(kind, name_node, mutated_expr) triples for the shared-state
+    mutation patterns in one statement: assignment/augassign targets,
+    subscript stores/deletes and in-place mutator calls. ``mutated_expr``
+    is the expression whose *object* is mutated (the attribute or name)."""
+    out = []
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is None:
+            targets = []                       # bare annotation, no write
+        for t in targets:
+            if isinstance(t, (ast.Name, ast.Attribute)):
+                out.append(("assign", t, t))
+            elif isinstance(t, ast.Subscript):
+                out.append(("setitem", t.value, t.value))
+    elif isinstance(stmt, ast.Delete):
+        for t in stmt.targets:
+            if isinstance(t, ast.Subscript):
+                out.append(("delitem", t.value, t.value))
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        fn = stmt.value.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS:
+            out.append((fn.attr, fn.value, fn.value))
+    return out
+
+
+class _GuardChecker(ast.NodeVisitor):
+    """Walks one function tracking the lexically-held lock set."""
+
+    def __init__(self, info: _GuardInfo, lines: list[str], relpath: str,
+                 findings: list):
+        self.info = info
+        self.lines = lines
+        self.relpath = relpath
+        self.findings = findings
+        self.held: list[str] = []
+        self.fname = ""
+        self.global_decls: set[str] = set()
+        self.local_binds: set[str] = set()
+
+    # -- scope management -------------------------------------------------
+    def check_function(self, fn: ast.FunctionDef):
+        self.fname = fn.name
+        self.global_decls = set()
+        self.local_binds = {a.arg for a in fn.args.args}
+        self.local_binds |= {a.arg for a in fn.args.kwonlyargs}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                self.global_decls |= set(node.names)
+        req = self.info.fn_locks.get(fn.name)
+        self.held = [_lock_key(req)] if req else []
+        for stmt in fn.body:
+            self.visit(stmt)
+
+    def visit_With(self, node: ast.With):
+        locks = []
+        for item in node.items:
+            name = _trailing(item.context_expr)
+            if name:
+                locks.append(name)
+        self.held.extend(locks)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in locks:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node):
+        # nested defs get their own checker pass from lint_guarded_by —
+        # their body does NOT inherit this function's lexical lock scope
+        # (they may run later, on another thread)
+        return None
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    # -- checks -----------------------------------------------------------
+    def _line_ok(self, lineno: int) -> bool:
+        line = self.lines[lineno - 1] if lineno <= len(self.lines) else ""
+        return bool(_UNGUARDED_OK.search(line))
+
+    def _check_mut(self, kind: str, expr: ast.AST, lineno: int):
+        name = _trailing(expr)
+        if name is None:
+            return
+        lock = None
+        scope = None
+        if isinstance(expr, ast.Attribute):
+            if self.fname == "__init__":
+                return                      # construction precedes sharing
+            hit = self.info.attrs.get(name)
+            if hit:
+                lock, scope = hit[0], "attribute"
+        else:
+            if name in self.local_binds and name not in self.global_decls:
+                return                      # shadowed local
+            if kind == "assign" and name not in self.global_decls:
+                return                      # plain assign = local binding
+            hit = self.info.globals.get(name)
+            if hit:
+                lock, scope = hit[0], "module global"
+        if lock is None:
+            return
+        if _lock_key(lock) in self.held:
+            return
+        if self._line_ok(lineno):
+            return
+        self.findings.append(Finding(
+            "conc-guarded-by", "warning", f"{self.relpath}:{lineno}",
+            f"{scope} '{name}' is declared `# guarded-by: {lock}` but is "
+            f"mutated ({kind}) outside any `with {lock}:` scope in "
+            f"'{self.fname}' — either take the lock, move the mutation "
+            "into a `# requires-lock:` helper, or mark the line "
+            "`# unguarded-ok: <reason>`",
+            {"name": name, "lock": lock, "kind": kind,
+             "function": self.fname}))
+
+    def _check_requires_call(self, call: ast.Call):
+        name = _trailing(call.func)
+        lock = self.info.fn_locks.get(name or "")
+        if lock is None or name == self.fname:
+            return
+        if _lock_key(lock) in self.held:
+            return
+        if self._line_ok(call.lineno):
+            return
+        self.findings.append(Finding(
+            "conc-guarded-by", "warning", f"{self.relpath}:{call.lineno}",
+            f"call to '{name}' (declared `# requires-lock: {lock}`) "
+            f"without holding {lock} in '{self.fname}'",
+            {"callee": name, "lock": lock, "function": self.fname}))
+
+    def generic_visit(self, node):
+        if isinstance(node, ast.stmt):
+            for kind, expr, _obj in _mutations(node):
+                self._check_mut(kind, expr, node.lineno)
+        if isinstance(node, ast.Call):
+            self._check_requires_call(node)
+        for t in (node.targets if isinstance(node, ast.Assign) else ()):
+            if isinstance(t, ast.Name):
+                self.local_binds.add(t.id)
+        super().generic_visit(node)
+
+
+def lint_guarded_by(tree: ast.AST, src: str, relpath: str) -> list[Finding]:
+    """D13 per-file half: guarded-by discipline over one module."""
+    lines = src.splitlines()
+    info = _GuardInfo(tree, lines, src)
+    if not (info.attrs or info.globals or info.fn_locks):
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _GuardChecker(info, lines, relpath, findings).check_function(
+                node)
+    return findings
+
+
+# ============================================= package-level call graph
+
+class _FileFacts:
+    """Per-file facts feeding the conservative package call graph."""
+
+    def __init__(self, path: str, relpath: str, package: str = "paddle_tpu"):
+        self.path = path
+        self.relpath = relpath
+        self.package = package
+        src = open(path).read()
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src)
+        self.info = _GuardInfo(self.tree, self.lines, src)
+        self.aliases: dict[str, str] = {}     # local alias -> imported name
+        self.funcs: dict[str, ast.AST] = {}   # qualname -> FunctionDef
+        #: defs nested inside another function (incl. methods of classes
+        #: defined in functions): bare name -> FunctionDef. These are NOT
+        #: globally matchable — a nested `fn`/`run` helper is only
+        #: callable from its enclosing scope, and merging such generic
+        #:  names across files would collapse the graph. Their callees
+        #: inline into the enclosing registered function (ast.walk), and
+        #: they keep their own node for thread-root resolution.
+        self.nested: dict[str, ast.AST] = {}
+        self.classes: dict[str, ast.ClassDef] = {}
+        self.roots: list[tuple[str, str]] = []  # (kind, bare function name)
+        #: names usable as call receivers the graph follows: `self`/`cls`
+        #: plus names imported from WITHIN the package — `x.method()` on
+        #: an arbitrary object or an external module (`os.close`,
+        #: `np.clip`) is NOT followed: external calls cannot land on
+        #: package defs, and arbitrary-object edges would collapse the
+        #: graph into "everything reaches everything" through common
+        #: method names like .get/.close
+        self.receivers: set[str] = {"self", "cls"}
+        self._collect()
+
+    def _collect(self):
+        stack: list[tuple[str, str]] = []     # (kind, name) frames
+
+        def scan(child):
+            if isinstance(child, ast.ImportFrom):
+                internal = child.level > 0 or \
+                    (child.module or "").split(".")[0] == self.package
+                for a in child.names:
+                    if a.asname:
+                        self.aliases[a.asname] = a.name
+                    if internal:
+                        self.receivers.add(a.asname or a.name)
+            elif isinstance(child, ast.Import):
+                for a in child.names:
+                    if a.name.split(".")[0] == self.package:
+                        self.receivers.add(a.asname
+                                           or a.name.split(".")[0])
+            elif isinstance(child, ast.Call):
+                callee = _trailing(child.func)
+                if callee == "Thread":
+                    for kw in child.keywords:
+                        if kw.arg == "target":
+                            t = _trailing(kw.value)
+                            if t:
+                                self.roots.append(("thread-target", t))
+                elif callee == "signal" and len(child.args) >= 2:
+                    t = _trailing(child.args[1])
+                    if t:
+                        self.roots.append(("signal-handler", t))
+                elif callee == "register" \
+                        and isinstance(child.func, ast.Attribute) \
+                        and _trailing(child.func.value) == "atexit" \
+                        and child.args:
+                    t = _trailing(child.args[0])
+                    if t:
+                        self.roots.append(("atexit-hook", t))
+
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                scan(child)
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qual = ".".join(n for _k, n in stack + [("f",
+                                                             child.name)])
+                    if any(k == "f" for k, _n in stack):
+                        self.nested.setdefault(child.name, child)
+                    else:
+                        self.funcs[qual] = child
+                    if child.name in _HTTP_HANDLERS:
+                        self.roots.append(("http-handler", child.name))
+                    stack.append(("f", child.name))
+                    walk(child)
+                    stack.pop()
+                elif isinstance(child, ast.ClassDef):
+                    self.classes[child.name] = child
+                    stack.append(("c", child.name))
+                    walk(child)
+                    stack.pop()
+                else:
+                    walk(child)
+
+        walk(self.tree)
+
+    def resolve(self, name: str) -> str:
+        """Import alias -> original bare name (one hop)."""
+        orig = self.aliases.get(name, name)
+        return orig.split(".")[-1]
+
+
+def _called_names(fn: ast.AST, facts: _FileFacts,
+                  class_names: set[str]) -> set[str]:
+    """Bare names this function may call: direct ``f()`` calls,
+    ``self.m()`` / ``module.f()`` calls (receiver in
+    ``facts.receivers``), and constructor calls (mapped to ``__init__``
+    targets via class names). Method calls on arbitrary objects are
+    deliberately not followed — see ``_FileFacts.receivers``."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute):
+            recv = node.func.value
+            if not (isinstance(recv, ast.Name)
+                    and recv.id in facts.receivers):
+                continue
+            name = node.func.attr
+        elif isinstance(node.func, ast.Name):
+            name = node.func.id
+            if name in _BUILTIN_NAMES:
+                continue
+        else:
+            continue
+        name = facts.resolve(name)
+        if name in class_names:
+            out.add(f"{name}.__init__")
+        out.add(name)
+    return out
+
+
+class _PackageGraph:
+    """Conservative name-based call graph over a set of files: an edge
+    follows every call whose bare name matches ANY package-defined
+    function/method (over-approximate by design — reachability must not
+    under-report)."""
+
+    def __init__(self, files: list[_FileFacts]):
+        self.files = files
+        self.class_names = {c for f in files for c in f.classes}
+        #: bare callee name -> set of bare names IT calls (merged over
+        #: every same-named definition — the conservative union)
+        self.calls: dict[str, set[str]] = {}
+        self.defined: set[str] = set()
+        #: nested defs keep a per-(file, name) node for root resolution
+        #: only — never matchable by bare-name edges from other code
+        self.nested_calls: dict[tuple[str, str], set[str]] = {}
+        for f in files:
+            for qual, fn in f.funcs.items():
+                bare = qual.split(".")[-1]
+                owner = qual.split(".")[-2] if "." in qual else None
+                keys = [bare]
+                if bare == "__init__" and owner:
+                    keys.append(f"{owner}.__init__")
+                callees = _called_names(fn, f, self.class_names)
+                for k in keys:
+                    self.defined.add(k)
+                    self.calls.setdefault(k, set()).update(callees)
+            for bare, fn in f.nested.items():
+                self.nested_calls[(f.relpath, bare)] = _called_names(
+                    fn, f, self.class_names)
+
+    def reachable(self, root_bare: str, relpath: str | None = None
+                  ) -> set[str]:
+        seen = {root_bare}
+        frontier = []
+
+        def push(name):
+            if name in self.defined and name not in seen:
+                seen.add(name)
+                frontier.append(name)
+
+        nc = self.nested_calls.get((relpath, root_bare))
+        if nc is not None:
+            # the root is a nested def of this file: its OWN callees
+            # seed the closure — not any same-named method elsewhere
+            for c in nc:
+                push(c)
+        elif root_bare in self.defined:
+            frontier.append(root_bare)
+        while frontier:
+            cur = frontier.pop()
+            for callee in self.calls.get(cur, ()):
+                push(callee)
+        return seen
+
+
+def _load_files(paths: list[str], root: str) -> list[_FileFacts]:
+    out = []
+    for p in paths:
+        rel = os.path.relpath(p, root) if os.path.isabs(p) else p
+        try:
+            out.append(_FileFacts(p, rel))
+        except SyntaxError:
+            continue    # the per-file lint already reports it
+    return out
+
+
+def _package_paths(root: str, package: str = "paddle_tpu") -> list[str]:
+    paths = []
+    for dirpath, dirnames, filenames in os.walk(os.path.join(root, package)):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                paths.append(os.path.join(dirpath, fn))
+    return paths
+
+
+# ================================================ D13 shared-state audit
+
+def audit_shared_state(paths: list[str], root: str | None = None,
+                       files: list[_FileFacts] | None = None
+                       ) -> list[Finding]:
+    """Package half of D13: un-annotated module-level mutable state
+    mutated by a function reachable from a background thread root. The
+    main thread is one root reaching everything, so state a Thread
+    target / HTTP handler / signal or atexit hook can reach is by
+    definition reachable from two roots."""
+    root = root or os.getcwd()
+    files = files if files is not None else _load_files(paths, root)
+    graph = _PackageGraph(files)
+
+    roots: list[tuple[str, str, str]] = []      # (kind, bare, relpath)
+    for f in files:
+        for kind, bare in f.roots:
+            roots.append((kind, f.resolve(bare), f.relpath))
+    closures = {(kind, bare, rel): graph.reachable(bare, rel)
+                for kind, bare, rel in roots}
+
+    findings: list[Finding] = []
+    for f in files:
+        module_names = _module_level_names(f.tree)
+        # global -> [(qualpath, lineno, kind, enclosing-frame names)]:
+        # the FULL function stack rides along so a mutation inside a
+        # nested helper is matched against the closure through ANY
+        # enclosing frame — attributing it to the nested bare name alone
+        # would never intersect (nested defs are not graph-defined)
+        mutated: dict[str, list] = {}
+        stack: list[str] = []
+
+        def walk(node, in_func, scope):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    local = {a.arg for a in child.args.args}
+                    gdecl = {n for nd in ast.walk(child)
+                             if isinstance(nd, ast.Global)
+                             for n in nd.names}
+                    stack.append(child.name)
+                    walk(child, child, (local, gdecl))
+                    stack.pop()
+                    continue
+                if in_func is not None and isinstance(child, ast.stmt):
+                    _scan_stmt(child, scope)
+                walk(child, in_func, scope)
+
+        def _scan_stmt(stmt, scope):
+            local, globals_decl = scope
+            for kind, expr, _obj in _mutations(stmt):
+                if not isinstance(expr, ast.Name):
+                    continue
+                name = expr.id
+                if name not in module_names:
+                    continue
+                if kind == "assign" and name not in globals_decl:
+                    continue                    # local rebinding
+                if name in local and name not in globals_decl:
+                    continue
+                mutated.setdefault(name, []).append(
+                    (".".join(stack) if stack else "<module>",
+                     stmt.lineno, kind, tuple(stack)))
+
+        walk(f.tree, None, (set(), set()))
+        for name, sites in sorted(mutated.items()):
+            if name in f.info.globals or name in f.info.threadsafe:
+                continue                        # annotated: D13a / declared
+            mutators = {s[0] for s in sites}
+            frames = {fr for s in sites for fr in s[3]}
+            hit_roots = sorted({
+                f"{kind}:{rel}:{bare}"
+                for (kind, bare, rel), cl in closures.items()
+                if frames & cl})
+            if not hit_roots:
+                continue                        # main-thread only
+            first = min(s[1] for s in sites)
+            findings.append(Finding(
+                "conc-shared-state", "warning", f"{f.relpath}:{first}",
+                f"module global '{name}' is mutated by "
+                f"{sorted(mutators)} which the call graph reaches from "
+                f"background thread root(s) {hit_roots} as well as the "
+                "main thread, but carries no `# guarded-by:` / "
+                "`# thread-safe:` declaration — lock it or declare the "
+                "lock-free design",
+                {"global": name, "mutators": sorted(mutators),
+                 "roots": hit_roots,
+                 "sites": [list(s[:3]) for s in sites]}))
+    return findings
+
+
+# ============================================ D15 static contract audit
+
+def _contract_classes(files: list[_FileFacts]) -> dict[str, set[str]]:
+    """{class name: guarded method names} for classes declaring
+    ``_thread_contract = ("meth", ...)`` in their body."""
+    out: dict[str, set[str]] = {}
+    for f in files:
+        for cname, cls in f.classes.items():
+            for node in cls.body:
+                if isinstance(node, ast.Assign) \
+                        and any(isinstance(t, ast.Name)
+                                and t.id == "_thread_contract"
+                                for t in node.targets) \
+                        and isinstance(node.value, (ast.Tuple, ast.List)):
+                    meths = {e.value for e in node.value.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, str)}
+                    if meths:
+                        out[cname] = meths
+    return out
+
+
+def audit_contract_callsites(paths: list[str], root: str | None = None,
+                             extra_contracts: dict | None = None,
+                             files: list[_FileFacts] | None = None
+                             ) -> list[Finding]:
+    """Static half of D15: a thread-root function (or a function it
+    calls within the same file) driving a contract-declared class through
+    a variable visibly bound to its constructor."""
+    root = root or os.getcwd()
+    files = files if files is not None else _load_files(paths, root)
+    contracts = _contract_classes(files)
+    if extra_contracts:
+        contracts.update({k: set(v) for k, v in extra_contracts.items()})
+    if not contracts:
+        return []
+    findings: list[Finding] = []
+    for f in files:
+        # same-file closure from this file's roots (bare names; nested
+        # defs participate here — same-file scope keeps them precise)
+        local_calls: dict[str, set[str]] = {}
+        for qual, fn in f.funcs.items():
+            bare = qual.split(".")[-1]
+            local_calls.setdefault(bare, set()).update(
+                _called_names(fn, f, set(f.classes) | set(contracts)))
+        for bare, fn in f.nested.items():
+            local_calls.setdefault(bare, set()).update(
+                _called_names(fn, f, set(f.classes) | set(contracts)))
+        root_funcs: set[str] = set()
+        for _kind, bare in f.roots:
+            bare = f.resolve(bare)
+            frontier = [bare]
+            while frontier:
+                cur = frontier.pop()
+                if cur in root_funcs:
+                    continue
+                root_funcs.add(cur)
+                frontier.extend(c for c in local_calls.get(cur, ())
+                                if c in local_calls)
+        if not root_funcs:
+            continue
+        # module-level contract-instance variables
+        instance_vars: dict[str, str] = {}
+        for node in ast.iter_child_nodes(f.tree):
+            _bind_instances(node, f, contracts, instance_vars)
+        for qual, fn in list(f.funcs.items()) + list(f.nested.items()):
+            if qual.split(".")[-1] not in root_funcs:
+                continue
+            local_vars = dict(instance_vars)
+            for node in ast.walk(fn):
+                _bind_instances(node, f, contracts, local_vars)
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)):
+                    continue
+                var = node.func.value.id
+                cls = local_vars.get(var)
+                if cls and node.func.attr in contracts[cls]:
+                    findings.append(Finding(
+                        "conc-thread-contract", "warning",
+                        f"{f.relpath}:{node.lineno}",
+                        f"'{var}.{node.func.attr}()' is called from code "
+                        f"reachable from a thread root, but {cls} "
+                        "declares a single-owner thread contract "
+                        f"({sorted(contracts[cls])}) — serialize through "
+                        "the owner thread or add an explicit rebind() "
+                        "handoff",
+                        {"class": cls, "method": node.func.attr,
+                         "var": var, "function": qual}))
+    return findings
+
+
+def _bind_instances(node, facts: _FileFacts, contracts: dict,
+                    out: dict[str, str]):
+    if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+        cname = _trailing(node.value.func)
+        if cname:
+            cname = facts.resolve(cname)
+        if cname in contracts:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = cname
+
+
+# ================================================= runtime (D14 + D15b)
+
+def audit_lock_order(loc: str = "conc/lockdep") -> list[Finding]:
+    """D14: findings over the lockdep runtime state — lock-order cycles
+    and blocking-under-hot-lock violations recorded while
+    ``core.lockdep.enable()`` was on. A clean non-empty graph is a note
+    (the evidence the instrumentation ran)."""
+    from ..core import lockdep
+
+    findings: list[Finding] = []
+    edges = lockdep.lock_graph()
+    for cyc in lockdep.find_cycles(edges):
+        detail = " -> ".join(cyc)
+        stacks = {f"{a}->{b}": edges[(a, b)]["stack"]
+                  for a, b in zip(cyc, cyc[1:]) if (a, b) in edges}
+        findings.append(Finding(
+            "conc-lock-order", "warning", loc,
+            f"lock-order cycle {detail}: two threads taking these locks "
+            "in opposite orders deadlock — pick one global order (the "
+            "acquire stacks in data show each edge's site)",
+            {"cycle": cyc, "stacks": stacks}))
+    seen = set()
+    for v in lockdep.blocking_violations():
+        key = (v["kind"], tuple(v["locks"]))
+        if key in seen:
+            continue
+        seen.add(key)
+        findings.append(Finding(
+            "conc-blocking-under-lock", "warning", loc,
+            f"blocking call ({v['kind']}: {v['detail']}) executed while "
+            f"holding hot lock(s) {v['locks']} — every scraper/logger "
+            "contending on that lock stalls behind the IO "
+            f"(thread {v['thread']}, at {v['stack']})", dict(v)))
+    if not findings:
+        n_locks = len(lockdep.locks_seen())
+        findings.append(Finding(
+            "conc-lock-order", "note", loc,
+            f"lock-order graph acyclic: {n_locks} tracked lock(s), "
+            f"{len(edges)} order edge(s), no blocking calls under hot "
+            "locks", {"locks": n_locks, "edges": len(edges)}))
+    return findings
+
+
+def audit_thread_contracts(loc: str = "conc/contracts") -> list[Finding]:
+    """D15 runtime half: ThreadContract violations recorded since the
+    last ``core.lockdep.reset()``."""
+    from ..core import lockdep
+
+    findings = []
+    for v in lockdep.contract_violations():
+        findings.append(Finding(
+            "conc-thread-contract", "warning", loc,
+            f"{v['contract']}.{v['op'] or 'call'} driven from thread "
+            f"{v['caller']!r} while owned by {v['owner']!r} "
+            f"(at {v['stack']}) — the single-owner serving contract "
+            "requires serializing through one thread", dict(v)))
+    if not findings:
+        findings.append(Finding(
+            "conc-thread-contract", "note", loc,
+            "no owner-thread contract violations recorded"))
+    return findings
+
+
+# ======================================================= package driver
+
+#: memo for the package-level pass — lint_tree runs once per graft_lint
+#: invocation but MANY times inside one test/CI process, and the package
+#: source does not change mid-process. Keyed by (root, package).
+_AUDIT_MEMO: dict = {}
+
+
+def audit_concurrency(root: str, package: str = "paddle_tpu"
+                      ) -> list[Finding]:
+    """The package-level concurrency rules (D13 shared-state + D15
+    static call sites) over every module of ``package``; the per-file
+    guarded-by rule rides ast_lint's ``lint_file`` like A1–A4. Results
+    are memoized per (root, package) for the life of the process — call
+    ``audit_concurrency_cache_clear()`` after editing package source."""
+    key = (os.path.abspath(root), package)
+    hit = _AUDIT_MEMO.get(key)
+    if hit is None:
+        paths = _package_paths(root, package)
+        files = _load_files(paths, root)
+        hit = (audit_shared_state(paths, root, files=files)
+               + audit_contract_callsites(paths, root, files=files))
+        _AUDIT_MEMO[key] = hit
+    # fresh Finding objects: apply_baseline mutates suppression state
+    return [Finding(f.detector, f.severity, f.loc, f.message, dict(f.data))
+            for f in hit]
+
+
+def audit_concurrency_cache_clear():
+    _AUDIT_MEMO.clear()

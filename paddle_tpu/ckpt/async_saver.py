@@ -25,6 +25,7 @@ import queue
 import threading
 import time
 
+from ..core import lockdep
 from .core import (CheckpointSaveError, clean_debris, gc_checkpoints,
                    host_copy, save_checkpoint)
 
@@ -44,9 +45,9 @@ class AsyncCheckpointer:
         if max_in_flight is None:
             max_in_flight = int(flag("FLAGS_ckpt_max_in_flight"))
         self._q: queue.Queue = queue.Queue(maxsize=max(int(max_in_flight), 1))
-        self._errors: list = []
-        self._results: list = []
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("ckpt.AsyncCheckpointer._lock")
+        self._errors: list = []       # guarded-by: _lock
+        self._results: list = []      # guarded-by: _lock
         self._thread = None
         self._aborted = threading.Event()
         clean_debris(root)

@@ -229,9 +229,20 @@ def _fsync_dir(path):
     except OSError:
         return
     try:
+        _note_fsync(path)
         os.fsync(fd)
     finally:
         os.close(fd)
+
+
+def _note_fsync(path):
+    """D14 blocking-under-lock probe: an fsync executed while a hot
+    (scrape-path) lock is held stalls every scraper/logger behind
+    millisecond-to-second disk waits (core/lockdep.note_blocking is a
+    no-op unless lockdep recording is enabled)."""
+    from ..core import lockdep
+
+    lockdep.note_blocking("fsync", str(path))
 
 
 def _write_file(path, data: bytes, fsync=True):
@@ -240,6 +251,7 @@ def _write_file(path, data: bytes, fsync=True):
         f.write(data)
         f.flush()
         if fsync:
+            _note_fsync(path)
             os.fsync(f.fileno())
 
 
@@ -264,6 +276,7 @@ def atomic_write_stream(path, write_fn, fsync=True):
             write_fn(f)
             f.flush()
             if fsync:
+                _note_fsync(tmp)
                 os.fsync(f.fileno())
         os.replace(tmp, path)
     except BaseException:

@@ -340,6 +340,16 @@ define_flag("FLAGS_obs_peak_tflops", 0.0,
             "divide achieved FLOP/s by; 0 = per-backend default "
             "(obs/goodput.py PEAK_TFLOPS_DEFAULTS — a nominal host "
             "number off-chip, do not quote)")
+define_flag("FLAGS_debug_thread_checks", False,
+            "owner-thread contract assertions on the deliberately "
+            "single-threaded serving objects (ServingEngine, "
+            "PagedKVCache's block pool, PrefixCache): a call from a "
+            "thread other than the first user raises "
+            "ConcurrencyContractError and records a D15 lint violation "
+            "(core/lockdep.py ThreadContract). Debug mode — the "
+            "graft_lint `conc` smoke and the thread-stress tests enable "
+            "it; production leaves the checks compiled out to one flag "
+            "lookup per engine call")
 
 
 # the full reference flag surface (compat entries; must come after the

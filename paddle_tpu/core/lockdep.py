@@ -1,0 +1,330 @@
+"""Runtime lock-order + thread-contract instrumentation ("lockdep").
+
+The reference's thread-heavy C++ runtime leans on TSAN and years of soak;
+this reproduction's concurrency surface is Python — AsyncCheckpointer
+commit threads, the shared /metrics HTTP server, comm/compile watchdogs,
+RPC serve loops, the per-instance to_static RLock — so it gets the
+kernel-lockdep treatment instead:
+
+  * :class:`TrackedLock` (``make_lock``/``make_rlock``) — a NAMED wrapper
+    over ``threading.Lock``/``RLock``. While ``enable()`` is on, every
+    acquire records the acquiring thread's current HELD-SET and each
+    (held → acquired) pair becomes an edge in a process-global
+    lock-ORDER graph. A cycle in that graph is a latent deadlock even if
+    no actual run ever interleaved badly — the whole point of auditing
+    the order instead of waiting for the hang. Locks are named per
+    class/site (kernel lockdep's "lock classes"), so two Registry
+    instances share one graph node and cross-instance inversions are
+    visible.
+  * :func:`note_blocking` — instrumented blocking sites (fsync in
+    ckpt/core, compile recording in obs/watchdog) report here; holding a
+    ``hot=True`` lock (metrics registry / metric setup / JSONL sink /
+    /metrics endpoint / logging — locks on scrape and instrumentation
+    paths) across one is a violation: a slow fsync under the sink lock
+    stalls every logger in the process.
+  * :class:`ThreadContract` — the declared owner-thread contract of the
+    deliberately single-threaded serving objects (ServingEngine,
+    PagedKVCache's block pool, PrefixCache). The contract binds to the
+    first thread that exercises it; under ``FLAGS_debug_thread_checks``
+    a call from any other thread raises
+    :class:`ConcurrencyContractError` AND records the violation for the
+    lint audit. ``rebind()`` is the explicit handoff for legitimate
+    ownership transfer (a router draining a replica).
+
+``paddle_tpu.analysis.concurrency`` turns this state into Findings (D14
+``conc-lock-order`` / ``conc-blocking-under-lock``, D15
+``conc-thread-contract``); the graft_lint ``conc`` smoke drives a
+multi-threaded serving+scrape+ckpt+watchdog stress with recording on and
+gates on an acyclic graph with zero violations.
+
+Overhead when disabled (the default): one module-bool check per
+acquire/release and one flag lookup per contract check — nothing on the
+per-op hot paths (the metrics observe/inc path takes no lock at all, by
+design; see obs/metrics.py).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+
+from .flags import flag
+
+#: recording switch — enable()/disable(); kept a plain module bool so the
+#: disabled acquire path costs one attribute load
+_enabled = False
+
+#: caps on recorded state (a runaway graph must degrade, not grow)
+_CAP_EDGES = 4096
+_CAP_EVENTS = 1024
+
+#: lockdep's own bookkeeping lock — a RAW threading.Lock on purpose: the
+#: instrumentation must never observe itself
+_meta = threading.Lock()
+
+_edges: dict = {}                # guarded-by: _meta — (held, acquired) -> info
+_locks_seen: dict = {}           # guarded-by: _meta — name -> acquire count
+_blocking: list = []             # guarded-by: _meta — blocking-under-hot-lock
+_contract_violations: list = []  # guarded-by: _meta — ThreadContract breaches
+
+_tls = threading.local()   # per-thread held-set: [[name, hot, depth, id]]
+
+
+def _held() -> list:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _stack_summary(skip: int = 2, depth: int = 5) -> str:
+    frames = traceback.extract_stack()[:-skip][-depth:]
+    return " > ".join(f"{os.path.basename(f.filename)}:{f.lineno}"
+                      for f in frames)
+
+
+class TrackedLock:
+    """Named lock wrapper feeding the order graph. Drop-in for the
+    ``with lock:`` / ``acquire``/``release`` surface the framework uses."""
+
+    __slots__ = ("name", "hot", "_lock")
+
+    def __init__(self, name: str, hot: bool = False, reentrant: bool = False):
+        self.name = str(name)
+        self.hot = bool(hot)
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._lock.acquire(blocking, timeout)
+        if ok and _enabled:
+            held = _held()
+            for entry in held:
+                if entry[3] == id(self):
+                    entry[2] += 1   # reentrant re-acquire (RLock): no edge
+                    return ok
+            with _meta:
+                _locks_seen[self.name] = _locks_seen.get(self.name, 0) + 1
+                for hname, _hot, _n, _hid in held:
+                    # NOTE: a DIFFERENT instance of the same lock class
+                    # deliberately records the (name, name) self-edge —
+                    # kernel-lockdep semantics: same-class nesting is a
+                    # latent inversion unless an explicit order exists,
+                    # and suppressing it would hide A->B/B->A deadlocks
+                    # between two instances of one class
+                    key = (hname, self.name)
+                    e = _edges.get(key)
+                    if e is not None:
+                        e["count"] += 1
+                    elif len(_edges) < _CAP_EDGES:
+                        _edges[key] = {
+                            "count": 1,
+                            "thread": threading.current_thread().name,
+                            "stack": _stack_summary(skip=3)}
+            held.append([self.name, self.hot, 1, id(self)])
+        return ok
+
+    def release(self):
+        # the held-set pop is UNCONDITIONAL (entries are only ever
+        # pushed while enabled): gating it on _enabled left a permanent
+        # phantom entry when recording was disabled between a thread's
+        # acquire and release — every later enable() then fabricated
+        # "stale-lock -> X" order edges from that thread
+        held = getattr(_tls, "held", None)
+        if held:
+            for i in range(len(held) - 1, -1, -1):
+                if held[i][3] == id(self):
+                    held[i][2] -= 1
+                    if held[i][2] == 0:
+                        del held[i]
+                    break
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lock.locked() if hasattr(self._lock, "locked") else None
+
+    def __repr__(self):
+        return f"TrackedLock({self.name!r}, hot={self.hot})"
+
+
+def make_lock(name: str, hot: bool = False) -> TrackedLock:
+    """A tracked ``threading.Lock``. ``hot=True`` marks locks on the
+    scrape/instrumentation paths: blocking work (fsync/compile/HTTP)
+    under a hot lock is a D14 violation."""
+    return TrackedLock(name, hot=hot)
+
+
+def make_rlock(name: str, hot: bool = False) -> TrackedLock:
+    """A tracked ``threading.RLock`` (reentrant re-acquires record no
+    edge)."""
+    return TrackedLock(name, hot=hot, reentrant=True)
+
+
+def note_blocking(kind: str, detail: str = "", allow: tuple = ()):
+    """An instrumented blocking site (fsync, compile, outbound HTTP).
+    Records a violation when the calling thread holds any hot tracked
+    lock not named in ``allow`` (a sink's own lock legitimately guards
+    its own IO)."""
+    if not _enabled:
+        return
+    held = getattr(_tls, "held", None)
+    if not held:
+        return
+    hot = [name for name, is_hot, _n, _hid in held
+           if is_hot and name not in allow]
+    if not hot:
+        return
+    with _meta:
+        if len(_blocking) < _CAP_EVENTS:
+            _blocking.append({
+                "kind": str(kind), "detail": str(detail)[:200],
+                "locks": hot,
+                "thread": threading.current_thread().name,
+                "stack": _stack_summary(skip=3)})
+
+
+# ------------------------------------------------------ thread contracts
+
+class ConcurrencyContractError(AssertionError):
+    """A declared single-owner object was driven from a second thread."""
+
+
+class ThreadContract:
+    """Owner-thread contract: binds to the first checking thread; any
+    other thread fails the check (under FLAGS_debug_thread_checks)."""
+
+    __slots__ = ("name", "_owner", "_owner_name")
+
+    def __init__(self, name: str):
+        self.name = str(name)
+        self._owner = None
+        self._owner_name = ""
+
+    def check(self, op: str = ""):
+        if not flag("FLAGS_debug_thread_checks"):
+            return
+        t = threading.get_ident()
+        if self._owner is None:
+            # bind under the meta lock: two threads racing the FIRST
+            # check is exactly the cross-thread misuse this detector
+            # exists for — an unsynchronized check-then-set would let
+            # both pass and the loser silently steal ownership
+            with _meta:
+                if self._owner is None:
+                    self._owner = t
+                    self._owner_name = threading.current_thread().name
+                    return
+        if t != self._owner:
+            rec = {"contract": self.name, "op": str(op),
+                   "owner": self._owner_name,
+                   "caller": threading.current_thread().name,
+                   "stack": _stack_summary(skip=3)}
+            with _meta:
+                if len(_contract_violations) < _CAP_EVENTS:
+                    _contract_violations.append(rec)
+            raise ConcurrencyContractError(
+                f"{self.name}.{op or 'call'}: owner-thread contract "
+                f"violated — bound to thread {self._owner_name!r}, called "
+                f"from {rec['caller']!r}. This object is deliberately "
+                "single-threaded (README: Serving / thread contract); a "
+                "router or driver must serialize access, or rebind() "
+                "after an explicit ownership handoff.")
+
+    def rebind(self):
+        """Explicit ownership handoff: the next check() rebinds."""
+        self._owner = None
+        self._owner_name = ""
+
+    @property
+    def owner_thread(self) -> str:
+        return self._owner_name
+
+
+# ------------------------------------------------------- state / queries
+
+def enable():
+    global _enabled
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def reset():
+    """Drop the recorded graph/violations (fixture isolation). Held-set
+    entries of threads currently inside a tracked lock are per-thread
+    and survive — call with helper threads joined."""
+    with _meta:
+        _edges.clear()
+        _locks_seen.clear()
+        del _blocking[:]
+        del _contract_violations[:]
+
+
+def lock_graph() -> dict:
+    """{(held_name, acquired_name): {count, thread, stack}} snapshot."""
+    with _meta:
+        return {k: dict(v) for k, v in _edges.items()}
+
+
+def locks_seen() -> dict:
+    with _meta:
+        return dict(_locks_seen)
+
+
+def blocking_violations() -> list:
+    with _meta:
+        return [dict(v) for v in _blocking]
+
+
+def contract_violations() -> list:
+    with _meta:
+        return [dict(v) for v in _contract_violations]
+
+
+def find_cycles(edges: dict | None = None) -> list:
+    """Simple cycles in the lock-order graph, each as a node path
+    ``[a, b, ..., a]``; one representative per distinct node set."""
+    if edges is None:
+        edges = lock_graph()
+    adj: dict = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+    cycles: list = []
+    seen_sets: set = set()
+    color: dict = {}
+    path: list = []
+
+    def visit(u):
+        color[u] = 1
+        path.append(u)
+        for v in sorted(adj.get(u, ())):
+            c = color.get(v)
+            if c == 1:
+                cyc = path[path.index(v):] + [v]
+                key = frozenset(cyc)
+                if key not in seen_sets:
+                    seen_sets.add(key)
+                    cycles.append(cyc)
+            elif c is None:
+                visit(v)
+        path.pop()
+        color[u] = 2
+
+    for n in sorted(adj):
+        if color.get(n) is None:
+            visit(n)
+    return cycles

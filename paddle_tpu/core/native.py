@@ -12,12 +12,15 @@ import ctypes
 import hashlib
 import os
 import subprocess
-import threading
+
+from . import lockdep
 
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "csrc")
 _BUILD = os.path.join(_CSRC, "_build")
-_cache: dict[str, object] = {}
-_lock = threading.Lock()
+# build-under-lock is deliberate (serializes concurrent g++ builds onto
+# the atomic-rename cache), so this lock is NOT marked hot
+_lock = lockdep.make_lock("core.native._lock")
+_cache: dict[str, object] = {}    # guarded-by: _lock
 
 
 def _compile(name: str) -> str | None:

@@ -19,6 +19,8 @@ import threading
 import time
 import traceback
 
+from ..core import lockdep
+
 
 class CommTask:
     """One registered in-flight communication (≙ comm_task.h:36)."""
@@ -55,10 +57,12 @@ class CommTaskManager:
                  default_timeout: float = 600.0):
         self.default_timeout = default_timeout
         self.scan_interval = scan_interval
-        self._tasks: list[CommTask] = []
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("distributed.CommTaskManager._lock")
+        self._tasks: list[CommTask] = []   # guarded-by: _lock
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
+        # appended by the scan thread, read by the main thread:
+        # GIL-atomic list append, readers see whole entries
         self.timeouts: list[str] = []  # diagnostics of flagged hangs
         self.on_timeout = None         # optional callback(task)
 
@@ -123,13 +127,20 @@ class CommTaskManager:
                     self.complete(task)  # flag once, don't spam
 
 
-_manager: CommTaskManager | None = None
+_MANAGER_LOCK = lockdep.make_lock("distributed.comm_watchdog._MANAGER_LOCK")
+_manager: CommTaskManager | None = None   # guarded-by: _MANAGER_LOCK
 
 
 def get_comm_task_manager() -> CommTaskManager:
+    # D13 fix (round 17): the bare check-then-create let two threads
+    # (e.g. a barrier on a helper thread racing the main thread's first
+    # collective) each build a manager — one leaked with its scan thread
+    # running forever against an orphaned task list
     global _manager
     if _manager is None:
-        _manager = CommTaskManager().start()
+        with _MANAGER_LOCK:
+            if _manager is None:
+                _manager = CommTaskManager().start()
     return _manager
 
 

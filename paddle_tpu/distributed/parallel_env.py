@@ -12,14 +12,15 @@ index/count.
 from __future__ import annotations
 
 import os
-import threading
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
-_lock = threading.Lock()
-_state: dict = {
+from ..core import lockdep
+
+_lock = lockdep.make_lock("distributed.parallel_env._lock")
+_state: dict = {             # guarded-by: _lock
     "initialized": False,
     "mesh": None,  # global 1-D Mesh over all devices, axis "world"
 }
@@ -123,9 +124,18 @@ def get_world_size(group=None) -> int:
 
 def global_mesh() -> Mesh:
     """The implicit 1-D mesh over every chip (axis name "world")."""
-    if _state["mesh"] is None or _state["mesh"].size != len(jax.devices()):
-        _state["mesh"] = Mesh(np.array(jax.devices()), (WORLD_AXIS,))
-    return _state["mesh"]
+    # D13 fix (round 17): this rebuilt the memoized mesh outside _lock —
+    # racing init_parallel_env (a comm-watchdog thread resolving the
+    # mesh while the main thread initializes) could publish a mesh built
+    # from a half-initialized device view
+    mesh = _state["mesh"]
+    if mesh is None or mesh.size != len(jax.devices()):
+        with _lock:
+            mesh = _state["mesh"]
+            if mesh is None or mesh.size != len(jax.devices()):
+                mesh = Mesh(np.array(jax.devices()), (WORLD_AXIS,))
+                _state["mesh"] = mesh
+    return mesh
 
 
 def device_count() -> int:

@@ -40,6 +40,9 @@ class WorkerInfo:
     port: int
 
 
+# thread-safe: written only by init_rpc/shutdown on the caller's thread —
+# init_rpc publishes the whole table BEFORE the serve thread starts (and
+# raises on re-init); the serve loop and rpc_sync/async peers only read
 _state = {
     "inited": False,
     "current": None,
@@ -111,8 +114,6 @@ def init_rpc(name: str, rank: int | None = None, world_size: int | None = None,
         listener = Listener(("127.0.0.1", 0), authkey=auth)
         my_ip, my_port = listener.address
         eps = [f"{my_ip}:{my_port}"]
-    t = threading.Thread(target=_serve, args=(listener,), daemon=True)
-    t.start()
 
     # peer names: the launcher/user publishes PADDLE_WORKER_NAMES (comma
     # list aligned with endpoints) so by-name addressing matches what each
@@ -131,9 +132,15 @@ def init_rpc(name: str, rank: int | None = None, world_size: int | None = None,
         _state["workers"].setdefault(f"worker{r}", info)  # rank alias
     _state["current"] = _state["workers"][name]
     _state["listener"] = listener
-    _state["serve_thread"] = t
     _state["pool"] = ThreadPoolExecutor(max_workers=8)
     _state["inited"] = True
+    # the serve thread starts LAST (round-17 race fix): it executes
+    # pickled callables that may read the worker table / pool — starting
+    # it before the table was published let an early inbound RPC observe
+    # a half-initialized registry (pinned by tests/test_concurrency.py)
+    t = threading.Thread(target=_serve, args=(listener,), daemon=True)
+    t.start()
+    _state["serve_thread"] = t
 
 
 def _require_init():

@@ -54,6 +54,7 @@ Round 13 (serving tier 2) adds two levers on the same substrate:
 from __future__ import annotations
 
 import functools
+import itertools
 import math
 import time
 from collections import OrderedDict, deque
@@ -396,11 +397,16 @@ _chunk_prefill_step = functools.partial(
 #: Kept SEPARATE from the executable cache below so tests can clear the
 #: event mirror (forcing compile events to re-record) without forcing a
 #: real recompile.
+# thread-safe: GIL-atomic set adds from contract-owned engine threads;
+# tests clear it between runs with no engine ticking
 _SEEN_SERVING_PROGRAMS: set = set()
 
 #: monotonically-increasing engine names for the shared /metrics
-#: endpoint's `engine` label (round 16)
-_NEXT_ENGINE_NAME = 0
+#: endpoint's `engine` label (round 16).
+# thread-safe: next() on an itertools counter is atomic under the GIL —
+# two engines constructed concurrently can no longer mint one name
+# (round-17 fix; the bare `global n; n += 1` read-modify-write raced)
+_ENGINE_IDS = itertools.count()
 
 #: round 14: the engine owns its executables via the AOT path
 #: (jitted.lower().compile()) instead of jax.jit's implicit cache —
@@ -408,7 +414,9 @@ _NEXT_ENGINE_NAME = 0
 #: for free (obs/costs.py), the compile wall is measured exactly (not
 #: smeared into the first execution), and dispatch overhead is within
 #: noise of the jit fast path (measured ~2.6us vs ~2.4us per call).
-#: key -> (compiled_executable, obs.costs.ProgramCost entry)
+#: key -> (compiled_executable, obs.costs.ProgramCost entry).
+# thread-safe: GIL-atomic dict get/set; a duplicate compile under a
+# concurrent-engines race wastes one compile, last-write-wins on insert
 _SERVING_EXECUTABLES: dict = {}
 
 
@@ -495,7 +503,22 @@ class ServingEngine:
     pool. `admission="continuous"` (default) refills freed slots
     mid-flight; `admission="static"` only admits into an EMPTY engine
     (whole-batch waves) — the baseline the serving bench compares
-    utilization against."""
+    utilization against.
+
+    THREAD CONTRACT (round 17, D15): the engine is deliberately
+    single-threaded — one owner thread drives ``add_request``/``step``/
+    ``run``/``finish_warmup`` (the scheduler state, slot arrays, block
+    pool and prefix cache are mutated without locks by design). The
+    contract binds to the first driving thread; under
+    ``FLAGS_debug_thread_checks`` a call from any other thread raises
+    ``ConcurrencyContractError``. A future router over N engine replicas
+    must serialize each engine's calls onto one thread (or hand off
+    ownership explicitly via ``engine.contract.rebind()`` after
+    draining). Read-only surfaces (``stats()``, ``metrics()``, the
+    /metrics endpoint, ``close()``) stay thread-safe."""
+
+    #: D15 static marker: methods the single-owner contract guards
+    _thread_contract = ("add_request", "step", "run", "finish_warmup")
 
     def __init__(self, model, max_slots=None, kv_block_size=None,
                  num_kv_blocks=None, kv_cache_dtype=None,
@@ -705,6 +728,14 @@ class ServingEngine:
              self.allocator.num_blocks, str(self.cache.k.dtype),
              params_fp))
         self._warmed = False
+        # D15 owner-thread contract (binds on the first driving call,
+        # NOT here — construction may happen on a loader thread)
+        from ..core import lockdep as _lockdep
+
+        self.contract = _lockdep.ThreadContract("ServingEngine")
+        self.cache.contract = self.contract
+        self.prefix_cache.contract = self.contract
+        self.allocator.contract = self.contract
         self.flight = obs.FlightRecorder()
         slo_ms = float(flag("FLAGS_obs_slo_ttft_ms"))
         self._slo_ttft_s = slo_ms / 1e3 if slo_ms > 0 else None
@@ -720,10 +751,7 @@ class ServingEngine:
             # pre-round-16 behavior left every engine after the first
             # unscraped on a bind failure
             try:
-                global _NEXT_ENGINE_NAME
-
-                self._engine_name = f"engine{_NEXT_ENGINE_NAME}"
-                _NEXT_ENGINE_NAME += 1
+                self._engine_name = f"engine{next(_ENGINE_IDS)}"
                 self._metrics_server = obs.shared_server(port)
                 self._metrics_server.register_engine(
                     self._engine_name, reg, ready=lambda: self._warmed)
@@ -745,6 +773,7 @@ class ServingEngine:
         when it expires the request finishes with reason ``"timeout"``
         (whatever tokens it produced so far are its result) and its
         blocks return to the free list."""
+        self.contract.check("add_request")
         prompt = np.asarray(
             prompt._data if hasattr(prompt, "_data") else prompt,
             np.int64).reshape(-1).astype(np.int32)
@@ -809,6 +838,7 @@ class ServingEngine:
         finished) for tokens emitted this tick; a request finished by
         its deadline emits a terminal ``(request_id, None, True)`` —
         streaming consumers see every completion, timeout included."""
+        self.contract.check("step")
         emitted = self._expire()
         emitted.extend(self._admit())
         emitted.extend(self._chunk_phase())
@@ -875,6 +905,7 @@ class ServingEngine:
         compiled. Any compile recorded after this is tagged warm=True —
         a steady-state retrace — and fails the obs lint smoke
         (obs.audit_recompiles post-warmup-compile warning)."""
+        self.contract.check("finish_warmup")
         self._warmed = True
         return self
 
@@ -885,10 +916,16 @@ class ServingEngine:
     def close(self):
         """Detach from the shared /metrics endpoint (no-op otherwise).
         The endpoint itself stays up — other engines may still be
-        registered on it; obs.shared_server(port).close() stops it."""
-        if self._metrics_server is not None:
-            self._metrics_server.unregister_engine(self._engine_name)
-            self._metrics_server = None
+        registered on it; obs.shared_server(port).close() stops it.
+
+        Idempotent under concurrent callers (round-17 satellite) and
+        deliberately OUTSIDE the owner-thread contract: teardown comes
+        from whoever is shutting the process down. The swap-to-local
+        below means a double close can at worst unregister twice (an
+        idempotent pop), never call through None."""
+        srv, self._metrics_server = self._metrics_server, None
+        if srv is not None:
+            srv.unregister_engine(self._engine_name)
 
     def _program(self, site: str, jitted, n_static: int, bucket: int,
                  any_sample: bool, extra, args):
@@ -1182,7 +1219,8 @@ class ServingEngine:
         t_run = time.perf_counter()
         with _span("serving.prefill"):
             out = prog(*args[4:])
-            tok_arr, c.k, c.v, c.k_scale, c.v_scale, self._key = out
+            tok_arr, ck, cv, cks, cvs, self._key = out
+            c.swap(ck, cv, cks, cvs)
             tok = int(jax.device_get(tok_arr)[0])
         req.first_token_s = time.perf_counter()
         entry.observe(req.first_token_s - t_run)
@@ -1283,7 +1321,8 @@ class ServingEngine:
         t_run = time.perf_counter()
         with _span("serving.chunk_prefill"):
             out = prog(*args[6:])
-            tok_arr, c.k, c.v, c.k_scale, c.v_scale, self._key = out
+            tok_arr, ck, cv, cks, cvs, self._key = out
+            c.swap(ck, cv, cks, cvs)
             if is_last:
                 tok = int(jax.device_get(tok_arr)[0])
             else:
@@ -1336,7 +1375,8 @@ class ServingEngine:
                                     bucket, any_sample, (), args)
         t_run = time.perf_counter()
         out = prog(*args[4:])
-        nxt, c.k, c.v, c.k_scale, c.v_scale, self._key = out
+        nxt, ck, cv, cks, cvs, self._key = out
+        c.swap(ck, cv, cks, cvs)
         nxt = np.asarray(jax.device_get(nxt))
         t_end = time.perf_counter()
         step_wall = t_end - t0

@@ -25,8 +25,9 @@ XLA owns fusion/scheduling (the role of CINN + PirInterpreter).
 from __future__ import annotations
 
 import functools
-import threading
 from typing import Any, Callable
+
+from ..core import lockdep
 
 import jax
 import numpy as np
@@ -146,12 +147,17 @@ class CompiledFunction:
                  bucket_axes: dict | None = None, share_discovery=False):
         functools.update_wrapper(self, fn)
         self._fn = fn
-        self._cache: dict[str, Any] = {}
-        self._state: dict[str, int] = {}  # key -> call count (for warmup phases)
-        self._discovered: dict[str, TraceContext] = {}
+        # per-instance RLock serializing specialization bookkeeping:
+        # phase counts, the compiled-spec cache and discovery contexts
+        # (reads stay lock-free — a stale read only re-enters the
+        # compile path, which re-checks under the lock)
+        self._lock = lockdep.make_rlock("jit.CompiledFunction._lock")
+        self._cache: dict[str, Any] = {}              # guarded-by: _lock
+        # key -> call count (for warmup phases)
+        self._state: dict[str, int] = {}              # guarded-by: _lock
+        self._discovered: dict[str, TraceContext] = {}  # guarded-by: _lock
         self._donate = flag("FLAGS_to_static_donate") if donate_buffers is None \
             else donate_buffers
-        self._lock = threading.RLock()
         self._full_graph = full_graph
         self._fallback_eager = False   # whole-function eager (segmented off)
         self._segmented = False        # graph-break → lazy segment mode
@@ -332,7 +338,8 @@ class CompiledFunction:
         cap = self._capture_fn()
         with trace_context(ctx):
             out = cap(*args, **kwargs)
-        self._discovered[key] = ctx
+        with self._lock:
+            self._discovered[key] = ctx
         return out
 
     def _compile_and_run(self, key, struct, leaves, args, kwargs, _retry=0):
@@ -524,7 +531,8 @@ class CompiledFunction:
 
             spec.debug = (pure, (avals(arg_datas), avals(ro_datas),
                                  avals(mut_datas)))
-        self._cache[key] = spec
+        with self._lock:
+            self._cache[key] = spec
         # compile watchdog: one event per specialization (obs/watchdog).
         # Wall time includes the first execution (trace+compile+run, the
         # cold-start cost a caller actually feels). jaxpr size only under

@@ -27,14 +27,17 @@ from __future__ import annotations
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..core import lockdep
+
 
 class MetricsServer:
     def __init__(self, port: int, registry, host: str = "127.0.0.1"):
         self.registry = registry
+        self._lock = lockdep.make_lock("obs.MetricsServer._lock", hot=True)
         # name -> (registry, ready_fn) — mutated under _lock, read by
         # the handler thread (dict snapshot per request)
-        self._engines: dict = {}
-        self._lock = threading.Lock()
+        self._engines: dict = {}      # guarded-by: _lock
+        self._closed = False          # guarded-by: _lock
         srv = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -129,6 +132,15 @@ class MetricsServer:
         return True, "ready\n"
 
     def close(self):
+        """Idempotent under concurrent callers (round-17 satellite): the
+        first caller through the flag tears the server down, every later
+        or concurrent close() is a no-op — two engines shutting down at
+        once must not double-close the socket or race the registry
+        teardown."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
         self._httpd.shutdown()
         self._httpd.server_close()
         with self._lock:
@@ -151,8 +163,8 @@ def serve_metrics(port: int, registry=None, host: str = "127.0.0.1"
 
 #: per-port shared servers (the FLAGS_obs_http_port path): engines in
 #: one process scrape through ONE endpoint instead of fighting the bind
-_SERVERS: dict = {}
-_SERVERS_LOCK = threading.Lock()
+_SERVERS_LOCK = lockdep.make_lock("obs.http._SERVERS_LOCK", hot=True)
+_SERVERS: dict = {}           # guarded-by: _SERVERS_LOCK
 
 
 def shared_server(port: int, host: str = "127.0.0.1") -> MetricsServer:

@@ -25,18 +25,18 @@ module, nothing consumed it — messages came from scattered ``print`` and
 from __future__ import annotations
 
 import sys
-import threading
 import time
 import warnings as _warnings
 
+from ..core import lockdep
 from ..core.flags import flag
 from . import metrics as _metrics
 
 #: default suppression window for repeated messages (seconds)
 RATE_WINDOW_S = 5.0
 
-_loggers: dict[str, "ObsLogger"] = {}
-_lock = threading.Lock()
+_lock = lockdep.make_lock("obs.logging._lock", hot=True)
+_loggers: dict[str, "ObsLogger"] = {}   # guarded-by: _lock
 
 
 def get_logger(module: str) -> "ObsLogger":

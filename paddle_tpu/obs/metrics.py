@@ -37,6 +37,8 @@ import math
 import threading
 import time
 
+from ..core import lockdep
+
 #: reserved child absorbing label sets past the cardinality cap
 OVERFLOW = "__overflow__"
 
@@ -68,11 +70,15 @@ class _Metric:
         self.doc = doc
         self.labelnames = tuple(labelnames)
         self.label_cap = int(label_cap)
-        self.dropped_label_sets = 0
-        self._children: dict[tuple, _Metric] = {}
         # setup-time only (labels() at instrument-site creation); the
         # observe/inc hot path never takes it
-        self._setup_lock = threading.Lock()
+        self._setup_lock = lockdep.make_lock("obs.Metric._setup_lock",
+                                             hot=True)
+        self.dropped_label_sets = 0      # guarded-by: _setup_lock
+        # mutations guarded; the labels() fast path reads lock-free (a
+        # memoized child handle — last-write-wins is the documented
+        # statsd-style contract)
+        self._children: dict[tuple, _Metric] = {}  # guarded-by: _setup_lock
 
     def labels(self, *labelvalues, **labelkv):
         """Resolve (and memoize) the child for one label set. Call this at
@@ -240,8 +246,8 @@ class Registry:
 
     def __init__(self, namespace: str = "paddle_tpu"):
         self.namespace = namespace
-        self._metrics: dict[str, _Metric] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("obs.Registry._lock", hot=True)
+        self._metrics: dict[str, _Metric] = {}   # guarded-by: _lock
 
     def _get_or_make(self, cls, name, doc, labelnames, **kw):
         m = self._metrics.get(name)
@@ -282,10 +288,16 @@ class Registry:
         return sorted(self._metrics)
 
     def unregister(self, name):
-        self._metrics.pop(name, None)
+        # D13 fix (round 17): these mutated the map bare — racing a
+        # concurrent _get_or_make's double-checked insert could publish
+        # a metric into a dict mid-clear (lost unregister, or a reader's
+        # iteration seeing a half-applied reset)
+        with self._lock:
+            self._metrics.pop(name, None)
 
     def clear(self):
-        self._metrics.clear()
+        with self._lock:
+            self._metrics.clear()
 
     # ------------------------------------------------------------ export
     def to_dict(self) -> dict:
@@ -409,12 +421,12 @@ class _JsonlSink:
     the set parses (pinned in tests/test_obs.py)."""
 
     def __init__(self):
-        self._fh = None
-        self._path = None
-        self._bytes = 0
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("obs.JsonlSink._lock", hot=True)
+        self._fh = None       # guarded-by: _lock
+        self._path = None     # guarded-by: _lock
+        self._bytes = 0       # guarded-by: _lock
 
-    def _open(self, path):
+    def _open(self, path):  # requires-lock: _lock
         import os
 
         self._fh = open(path, "a", buffering=1)
@@ -424,7 +436,7 @@ class _JsonlSink:
         except OSError:
             self._bytes = 0
 
-    def _handle(self):
+    def _handle(self):  # requires-lock: _lock
         from ..core.flags import flag
 
         path = str(flag("FLAGS_obs_log_path") or "")
@@ -440,7 +452,7 @@ class _JsonlSink:
             self._open(path)
         return self._fh
 
-    def _rotate(self):
+    def _rotate(self):  # requires-lock: _lock
         import os
 
         from ..core.flags import flag

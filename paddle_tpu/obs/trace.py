@@ -28,8 +28,11 @@ _tls = threading.local()
 #: host-side span record buffer (off-TPU fallback + tests); bounded so an
 #: instrumented serving loop can run forever
 _SPAN_BUF_CAP = 8192
+# thread-safe: GIL-atomic bounded-deque appends; readers snapshot
 _span_buf: deque = deque(maxlen=_SPAN_BUF_CAP)
 
+# thread-safe: idempotent memo — concurrent first calls write the same
+# backend string, last-write-wins
 _backend_memo: str | None = None
 
 

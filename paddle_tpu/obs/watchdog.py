@@ -44,10 +44,14 @@ from ..core.flags import flag
 #: problems than a truncated audit window). Appends/counter bumps rely
 #: on the GIL like the metrics hot path — no lock.
 _EVENT_CAP = 4096
+# thread-safe: GIL-atomic bounded-deque appends; readers take list()
+# snapshots and clear_events is a test/bench barrier run with no recorder
 _events: deque = deque(maxlen=_EVENT_CAP)
 
 #: compiles tagged warm=True by their site (the serving engine tags any
-#: compile after its finish_warmup() barrier) — steady-state retraces
+#: compile after its finish_warmup() barrier) — steady-state retraces.
+# thread-safe: GIL-atomic int bump mirroring post_warmup_compiles_total;
+# the per-event warm flag drives the audit, a lost bump is a lost metric
 _post_warmup_total = 0
 
 
@@ -91,6 +95,12 @@ def record_compile(site: str, group: str, key: str, bucket=None,
     ev = CompileEvent(site, group, key, bucket=bucket, wall_s=wall_s,
                       jaxpr_eqns=jaxpr_eqns, donated=donated, warm=warm,
                       cost=cost)
+    # D14 blocking-under-lock probe: record_compile runs in the same
+    # frame as the compile it reports, so any hot (scrape-path) lock
+    # held here was held across the compile wall
+    from ..core import lockdep
+
+    lockdep.note_blocking("compile", site)
     _events.append(ev)
     reg = default_registry()
     reg.counter("compiles_total", "compiled programs (any site)",
@@ -143,7 +153,9 @@ def clear_events():
 
 # ------------------------------------------------------- ckpt watchdog
 #: checkpoint save events (round 12) — same bounded-window design as the
-#: compile events; ckpt/core.py reports every save outcome here
+#: compile events; ckpt/core.py reports every save outcome here, including
+#: from the AsyncCheckpointer commit thread.
+# thread-safe: GIL-atomic bounded-deque appends; audits read a snapshot
 _ckpt_events: deque = deque(maxlen=_EVENT_CAP)
 
 

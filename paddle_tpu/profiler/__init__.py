@@ -74,10 +74,12 @@ class _HostTracer:
     list otherwise."""
 
     def __init__(self, capacity: int = 1 << 20):
-        self.events: list[_HostEvent] = []
+        from ..core import lockdep
+
+        self._lock = lockdep.make_lock("profiler.HostTracer._lock")
+        self.events: list[_HostEvent] = []     # guarded-by: _lock
         self.capacity = capacity
         self.enabled = False
-        self._lock = threading.Lock()
         from ..core import native
 
         self._native = native.tracer_lib()

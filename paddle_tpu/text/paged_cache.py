@@ -46,6 +46,8 @@ from collections import OrderedDict
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.lockdep import ThreadContract
+
 #: block id 0 is never allocated — masked writes land there (see module doc)
 TRASH_BLOCK = 0
 
@@ -54,12 +56,21 @@ class BlockAllocator:
     """Free-list allocator over `num_blocks` cache blocks (block 0
     reserved as trash). Allocation is all-or-nothing: a request either
     gets its full block budget up front (admission control) or stays
-    queued — no mid-flight OOM/preemption."""
+    queued — no mid-flight OOM/preemption.
+
+    THREAD CONTRACT (D15): single-owner, lock-free by design — the
+    ServingEngine shares its contract object with the pool so one owner
+    thread covers the whole serving object graph
+    (``FLAGS_debug_thread_checks`` asserts it)."""
+
+    #: D15 static marker: methods the single-owner contract guards
+    _thread_contract = ("alloc", "free")
 
     def __init__(self, num_blocks: int):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is the trash block)")
         self.num_blocks = int(num_blocks)
+        self.contract = ThreadContract("BlockAllocator")
         self._free = list(range(self.num_blocks - 1, 0, -1))  # pop() -> 1..
 
     @property
@@ -68,6 +79,7 @@ class BlockAllocator:
 
     def alloc(self, n: int):
         """n block ids, or None when the pool can't cover them."""
+        self.contract.check("alloc")
         if n < 0:
             raise ValueError(f"negative block count {n}")
         if n > len(self._free):
@@ -75,6 +87,7 @@ class BlockAllocator:
         return [self._free.pop() for _ in range(n)]
 
     def free(self, ids) -> None:
+        self.contract.check("free")
         for b in ids:
             b = int(b)
             if not 0 < b < self.num_blocks:
@@ -125,10 +138,20 @@ class PrefixCache:
     `release` (the finish path) decrefs — a hash-mapped block at
     refcount 0 parks in the LRU (its KV stays warm for the next request)
     while an unmapped block goes straight back to the free list. Only
-    refcount-0 blocks are ever evicted."""
+    refcount-0 blocks are ever evicted.
+
+    THREAD CONTRACT (D15): single-owner like the engine that drives it —
+    the hash map / refcounts / LRU mutate lock-free by design; the
+    engine shares its ThreadContract here so one owner thread covers the
+    whole serving object graph."""
+
+    #: D15 static marker: methods the single-owner contract guards
+    _thread_contract = ("allocate", "lookup", "register", "release",
+                        "cancel_lookup")
 
     def __init__(self, allocator: BlockAllocator, max_cached_blocks: int = 0):
         self.allocator = allocator
+        self.contract = ThreadContract("PrefixCache")
         #: cap on refcount-0 cached blocks (0 = bounded only by the pool)
         self.max_cached_blocks = int(max_cached_blocks)
         self._map: dict = {}          # hash -> block id (full blocks only)
@@ -172,6 +195,7 @@ class PrefixCache:
         blocks count as capacity: when the free list can't cover, LRU
         blocks are evicted (hash entries dropped) to make room. Returns
         private block ids at refcount 1, or None."""
+        self.contract.check("allocate")
         n = int(n)
         if n < 0:
             raise ValueError(f"negative block count {n}")
@@ -198,6 +222,7 @@ class PrefixCache:
         Found blocks get a refcount bump (and leave the LRU — a
         referenced block is never eviction-eligible). Counts hits for the
         found run and misses for the remainder."""
+        self.contract.check("lookup")
         found = []
         for h in hashes:
             blk = self._map.get(h)
@@ -229,6 +254,7 @@ class PrefixCache:
         the existing mapping (two concurrent misses computed the same
         content; the newer copy stays private and free-lists on release).
         Idempotent for already-registered pairs."""
+        self.contract.check("register")
         for h, blk in zip(hashes, block_ids):
             blk = int(blk)
             if h in self._map:
@@ -248,6 +274,7 @@ class PrefixCache:
         round-13 sharing contract: finish/timeout paths must come through
         here — an unconditional allocator.free() on a shared block would
         corrupt every other request pointing at it."""
+        self.contract.check("release")
         for blk in block_ids:
             blk = int(blk)
             refs = self._ref.get(blk, 0)
@@ -278,10 +305,20 @@ class PagedKVCache:
     arrays; anything else stores k/v directly). Arrays start zeroed —
     freshly (re)allocated blocks may hold stale data from a finished
     request, which is fine: reads are bounded by per-sequence lengths and
-    appends overwrite before the length mask ever exposes a slot."""
+    appends overwrite before the length mask ever exposes a slot.
+
+    THREAD CONTRACT (D15): single-owner like the engine — the ``k``/``v``
+    array handles are replaced functionally by the owner thread's step
+    programs through :meth:`swap` (the one sanctioned python-side
+    mutation point, contract-checked); the driving engine shares its
+    ThreadContract here."""
+
+    #: D15 static marker: methods the single-owner contract guards
+    _thread_contract = ("swap",)
 
     def __init__(self, num_layers: int, num_blocks: int, num_kv_heads: int,
                  block_size: int, head_dim: int, dtype):
+        self.contract = ThreadContract("PagedKVCache")
         if int(block_size) % 8:
             raise ValueError(
                 f"kv block_size {block_size} must be a multiple of 8 "
@@ -304,6 +341,16 @@ class PagedKVCache:
                                     1e-8, jnp.float32)
         else:
             self.k_scale = self.v_scale = None
+
+    def swap(self, k, v, k_scale=None, v_scale=None):
+        """Install the updated cache buffers a step program returned —
+        the only sanctioned python-side mutation of the pool handles
+        (donated inputs mean the OLD handles are dead the moment the
+        program ran, so a second thread racing this swap would publish
+        a deleted buffer)."""
+        self.contract.check("swap")
+        self.k, self.v = k, v
+        self.k_scale, self.v_scale = k_scale, v_scale
 
     @property
     def hbm_bytes(self) -> int:

@@ -1,0 +1,38 @@
+"""D15 static fire fixture: a Thread target drives a class declaring a
+single-owner `_thread_contract` through a visibly-bound constructor
+variable — conc-thread-contract must warn on `eng.step()` in `_drive`.
+The main-thread `serve` twin must stay silent.
+"""
+import threading
+
+
+class MiniEngine:
+    _thread_contract = ("add", "step")
+
+    def __init__(self):
+        self.queue = []
+
+    def add(self, x):
+        self.queue.append(x)
+
+    def step(self):
+        return self.queue.pop() if self.queue else None
+
+
+_ENGINE = MiniEngine()
+
+
+def _drive():
+    _ENGINE.step()                  # FIRE: contract method from a root
+
+
+def start():
+    t = threading.Thread(target=_drive, daemon=True)
+    t.start()
+    return t
+
+
+def serve():
+    eng = MiniEngine()              # main-thread use: silent
+    eng.add(1)
+    return eng.step()

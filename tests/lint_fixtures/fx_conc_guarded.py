@@ -1,0 +1,45 @@
+"""D13 fire fixture: `# guarded-by:` fields mutated outside their lock.
+
+Expected findings (conc-guarded-by):
+  * `Pool.put` appends to the annotated `_items` without `with _lock`
+  * `drop` mutates the annotated module global `_REGISTRY` bare
+  * `reopen` calls the `# requires-lock:` helper without holding the lock
+The `good_*` twins must stay silent.
+"""
+import threading
+
+_LOCK = threading.Lock()
+_REGISTRY: dict = {}        # guarded-by: _LOCK
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items: list = []      # guarded-by: _lock
+        self._fh = None             # guarded-by: _lock
+
+    def put(self, x):               # FIRE: append outside the lock
+        self._items.append(x)
+
+    def good_put(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def _open(self):                # requires-lock: _lock
+        self._fh = object()
+
+    def reopen(self):               # FIRE: requires-lock callee, no lock
+        self._open()
+
+    def good_reopen(self):
+        with self._lock:
+            self._open()
+
+
+def drop(key):                      # FIRE: bare global mutation
+    _REGISTRY.pop(key, None)
+
+
+def good_drop(key):
+    with _LOCK:
+        _REGISTRY.pop(key, None)

@@ -1,0 +1,27 @@
+"""D13 shared-state fire fixture: an UN-annotated module global mutated
+by a function a background thread root reaches (the `_worker` Thread
+target) — conc-shared-state must warn. `_SAFE_EVENTS` carries the
+`# thread-safe:` declaration and must stay silent.
+"""
+import threading
+
+_PENDING: list = []                 # FIRE: no guarded-by / thread-safe
+
+# thread-safe: GIL-atomic appends, reader snapshots (fixture twin)
+_SAFE_EVENTS: list = []
+
+
+def _record(x):
+    _PENDING.append(x)
+    _SAFE_EVENTS.append(x)
+
+
+def _worker():
+    _record("from-thread")
+
+
+def start():
+    t = threading.Thread(target=_worker, daemon=True)
+    t.start()
+    _record("from-main")
+    return t

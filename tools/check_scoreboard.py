@@ -170,7 +170,23 @@ def check(repo=REPO, details_path=None, rtol=RTOL):
     return failures
 
 
-def lint_gate(models="llama,gpt,bert,paged,obs,ckpt,spmd", timeout=900):
+#: the parallel-gate partition (round 17): each group runs as ONE
+#: graft_lint subprocess so independent smokes overlap on separate
+#: cores and the gate wall stays at max(group) instead of sum(groups)
+#: despite the new `conc` smoke. Grouping rationale: the serving-side
+#: smokes (`paged`,`obs`,`ckpt`) share one tiny-LLaMA + the AOT
+#: executable cache, so they stay in one process; the AST lint rides the
+#: first (cheapest-compile) group; `spmd` (the wall-dominating GSPMD
+#: compile) and `conc` (the multi-threaded stress) get their own
+#: workers. Staleness cannot be judged inside any single partial run, so
+#: workers run --defer-stale and the gate aggregates each baseline
+#: entry's match counts across the union (full coverage restored).
+LINT_GROUPS = (("llama,gpt,bert", True), ("paged,obs,ckpt", False),
+               ("spmd", False), ("conc", False))
+
+
+def lint_gate(models="llama,gpt,bert,paged,obs,ckpt,spmd,conc",
+              timeout=900):
     """The graft_lint CI gate (round-9; round-10 adds the `paged` serving
     smoke — a tiny-LLaMA 2-slot continuous-batching engine whose decode
     step program is audited at default flags; round-11 adds the `obs`
@@ -190,11 +206,17 @@ def lint_gate(models="llama,gpt,bert,paged,obs,ckpt,spmd", timeout=900):
     full-coverage run): the AST lint plus the
     jaxpr program audits over the model smoke configs must come back
     clean (no unsuppressed warning/error past tools/lint_baseline.json).
-    Runs the CLI in a subprocess so its jax session / flag flips can't
-    leak into the caller. Returns failure strings (empty = clean); also
-    prints the per-detector finding counts so drift between runs is
-    visible in the gate log even when the gate passes."""
+    Round 17: the smoke groups run as PARALLEL subprocesses
+    (``LINT_GROUPS``) so the gate wall stays at the slowest group
+    despite the added `conc` smoke; each worker defers stale-suppression
+    judgment (``--defer-stale``) and the gate aggregates every baseline
+    entry's match count across the union of runs — full-coverage
+    staleness detection survives the split. Returns failure strings
+    (empty = clean); also prints the merged per-detector finding counts
+    so drift between runs is visible in the gate log even when the gate
+    passes."""
     import subprocess
+    from concurrent.futures import ThreadPoolExecutor
 
     # D8 prerequisite: the committed baseline must exist BEFORE the
     # subprocess runs — a deleted/unparseable baseline is a named gate
@@ -207,35 +229,97 @@ def lint_gate(models="llama,gpt,bert,paged,obs,ckpt,spmd", timeout=900):
         return [f"LINT: tools/cost_baseline.json missing/unparseable "
                 f"({e}) — analysis D8 cannot gate; regenerate with "
                 "tools/roofline_report.py --write-baseline"]
-    cmd = [sys.executable, os.path.join(REPO, "tools", "graft_lint.py"),
-           "--models", models, "--json"]
+
+    requested = [m for m in models.split(",") if m]
+    grouped: set = set()
+    groups = []           # (models_csv, with_ast)
+    for grp, with_ast in LINT_GROUPS:
+        sel = [m for m in grp.split(",") if m in requested]
+        grouped.update(sel)
+        if sel or with_ast:
+            groups.append((",".join(sel), with_ast))
+    leftover = [m for m in requested if m not in grouped]
+    if leftover:
+        groups.append((",".join(leftover), False))
+
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
-    try:
-        proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
-                              timeout=timeout, cwd=REPO)
-    except subprocess.TimeoutExpired:
-        return [f"graft_lint did not finish within {timeout}s — the model "
-                "smoke audits hung or the machine is overloaded; run "
-                "tools/graft_lint.py --models llama,gpt,bert directly"]
-    try:
-        payload = json.loads(proc.stdout)
-    except ValueError:
-        return [f"graft_lint produced no JSON (rc={proc.returncode}): "
-                f"{proc.stderr[-800:] or proc.stdout[-800:]}"]
-    by_det = payload.get("by_detector", {})
+
+    def run_group(sel, with_ast):
+        cmd = [sys.executable,
+               os.path.join(REPO, "tools", "graft_lint.py"),
+               "--models", sel, "--json", "--defer-stale"]
+        if not with_ast:
+            cmd.append("--no-ast")
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  env=env, timeout=timeout, cwd=REPO)
+        except subprocess.TimeoutExpired:
+            return None, (f"graft_lint group '{sel}' did not finish "
+                          f"within {timeout}s — a smoke hung or the "
+                          "machine is overloaded; run tools/graft_lint.py "
+                          f"--models {sel} directly"), None
+        try:
+            return json.loads(proc.stdout), None, proc.returncode
+        except ValueError:
+            return None, (f"graft_lint group '{sel}' produced no JSON "
+                          f"(rc={proc.returncode}): "
+                          f"{proc.stderr[-800:] or proc.stdout[-800:]}"), \
+                proc.returncode
+
+    with ThreadPoolExecutor(max_workers=len(groups)) as ex:
+        results = list(ex.map(lambda g: run_group(*g), groups))
+
+    out = []
+    by_det: dict = {}
+    suppressed = 0
+    matched: dict = {}          # (detector, match) -> total hits
+    ast_ran = False
+    for (sel, with_ast), (payload, err, rc) in zip(groups, results):
+        if err:
+            out.append(err)
+            continue
+        ast_ran = ast_ran or payload.get("ast", with_ast)
+        for k, v in payload.get("by_detector", {}).items():
+            by_det[k] = by_det.get(k, 0) + v
+        suppressed += payload.get("suppressed", 0)
+        for e in payload.get("baseline", []):
+            key = (e.get("detector"), e.get("match"))
+            matched[key] = matched.get(key, 0) + int(e.get("matched", 0))
+        fails = [f for f in payload.get("findings", [])
+                 if not f.get("suppressed")
+                 and f.get("severity") in ("warning", "error")]
+        out.extend(f"LINT: [{f['severity']}/{f['detector']}] {f['loc']}: "
+                   f"{f['message']}" for f in fails)
+        if rc not in (0, None) and not fails:
+            # the safety net the sequential gate had: graft_lint's own
+            # gating disagreed with this filter — never report clean on
+            # a group that exited nonzero
+            out.append(f"graft_lint group '{sel}' exited {rc} with no "
+                       "findings this gate could extract — gating logic "
+                       "drift between graft_lint and lint_gate")
     print("LINT per-detector findings: "
           + (", ".join(f"{k}={v}" for k, v in sorted(by_det.items()))
              or "none")
-          + f" (suppressed={payload.get('suppressed', 0)})")
-    fails = [f for f in payload.get("findings", [])
-             if not f.get("suppressed")
-             and f.get("severity") in ("warning", "error")]
-    out = [f"LINT: [{f['severity']}/{f['detector']}] {f['loc']}: "
-           f"{f['message']}" for f in fails]
-    if proc.returncode != 0 and not out:
-        out.append(f"graft_lint exited {proc.returncode} with no findings "
-                   f"reported: {proc.stderr[-800:]}")
+          + f" (suppressed={suppressed}, {len(groups)} parallel groups)")
+
+    # aggregated staleness: only a FULL union (every CI smoke + the AST
+    # lint, all groups parsed) may call an entry dead
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import graft_lint as _gl
+
+    full = ast_ran and set(_gl.CI_MODELS) <= set(requested) \
+        and not any(err for _p, err, _rc in results)
+    if full:
+        for (det, match), hits in sorted(matched.items()):
+            if hits == 0:
+                out.append(
+                    f"LINT: [warning/stale-suppression] "
+                    f"tools/lint_baseline.json: suppression matched zero "
+                    f"findings across the full parallel gate: "
+                    f"detector={det!r} match={match!r} — remove it or "
+                    "run tools/graft_lint.py --models "
+                    f"{','.join(_gl.CI_MODELS)} --prune-baseline")
     return out
 
 

@@ -59,12 +59,28 @@ exactly like a detector that started firing falsely. To give the spmd
 smoke its mesh, the CLI forces the same virtual 8-device CPU platform
 tests/conftest.py uses, for every smoke.
 
+The special model name `conc` (round 17) smokes the CONCURRENCY
+contract: a genuinely multi-threaded serving/ckpt/obs stress (engine
+ticks + concurrent /metrics scrapes + overlapped async checkpoint
+commits + a comm-watchdog scan) runs with core/lockdep recording on and
+FLAGS_debug_thread_checks enabled; the D14 audit requires the recorded
+lock-ORDER graph to be acyclic with zero blocking-calls-under-hot-lock,
+D15 requires zero owner-thread contract violations, and the D13/D14/D15
+fire fixtures then self-test (tests/lint_fixtures/fx_conc_*.py + a
+deterministic two-lock cycle + a cross-thread contract breach) — a
+silently-dead detector fails the gate. The D13 lock-discipline AST lint
+itself (guarded-by / shared-state) rides EVERY run's AST pass.
+
 Exit code: 0 when no unsuppressed warning/error finding survives the
 baseline (notes never fail); 1 otherwise. CI runs
-`graft_lint.py --models llama,gpt,bert,paged,obs,ckpt,spmd --json` via
-tools/check_scoreboard. Baseline entries that matched ZERO findings are
-reported as `stale-suppression` (warning on a full-coverage run, note on
-a partial one); `--prune-baseline` rewrites the baseline without them.
+`graft_lint.py --models llama,gpt,bert,paged,obs,ckpt,spmd,conc --json`
+via tools/check_scoreboard — round 17 splits that into PARALLEL
+subprocess groups (check_scoreboard.LINT_GROUPS) so the gate wall stays
+at the slowest group; each worker passes `--defer-stale` and the gate
+aggregates baseline match counts across the union. Baseline entries
+that matched ZERO findings are reported as `stale-suppression` (warning
+on a full-coverage run, note on a partial one); `--prune-baseline`
+rewrites the baseline without them.
 
 Usage:
     python tools/graft_lint.py                      # AST lint + D5 only
@@ -72,7 +88,7 @@ Usage:
     python tools/graft_lint.py --json               # machine output
     python tools/graft_lint.py --baseline my.json   # suppression file
     python tools/graft_lint.py --no-ast             # jaxpr audits only
-    python tools/graft_lint.py --models llama,gpt,bert,paged,obs,ckpt,spmd \
+    python tools/graft_lint.py --models llama,gpt,bert,paged,obs,ckpt,spmd,conc \
         --prune-baseline                            # drop stale suppressions
 
 Baseline format: see paddle_tpu/analysis/findings.py (default file
@@ -94,7 +110,7 @@ DEFAULT_BASELINE = os.path.join(REPO, "tools", "lint_baseline.json")
 #: of baseline entries is only a gate FAILURE when a run covers all of it
 #: — a partial run legitimately leaves model-specific suppressions
 #: unmatched
-CI_MODELS = ("llama", "gpt", "bert", "paged", "obs", "ckpt", "spmd")
+CI_MODELS = ("llama", "gpt", "bert", "paged", "obs", "ckpt", "spmd", "conc")
 
 #: one tiny-LLaMA shared by the serving-side smokes (`paged`, `obs`): the
 #: engines key their AOT executables on spec + param AVALS, so a shared
@@ -749,20 +765,243 @@ def _audit_spmd_fixtures(mesh) -> list:
     return findings
 
 
+def audit_conc() -> list:
+    """The `conc` smoke (round 17): a genuinely multi-threaded
+    serving/ckpt/obs stress with lockdep recording ON — serving ticks on
+    the owner thread, a scraper thread hammering the shared /metrics +
+    /healthz endpoint, overlapped async checkpoint commits on the saver
+    thread, and a comm-watchdog scan loop — then the D14 audit requires
+    the recorded lock-ORDER graph to be ACYCLIC with zero
+    blocking-under-hot-lock events, and the D15 audit requires zero
+    owner-thread contract violations (FLAGS_debug_thread_checks is on
+    for the whole stress). Afterwards the fire fixtures self-test every
+    detector: a silently-dead detector fails the gate exactly like a
+    falsely-firing one (the spmd-smoke rule)."""
+    import http.client
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis, ckpt, obs
+    from paddle_tpu.core import lockdep
+    from paddle_tpu.distributed.comm_watchdog import CommTaskManager
+    from paddle_tpu.inference.engine import ServingEngine
+
+    findings = []
+    paddle.seed(0)
+    model = _tiny_llama()
+    lockdep.reset()
+    lockdep.enable()
+    paddle.set_flags({"FLAGS_debug_thread_checks": True})
+    root = tempfile.mkdtemp(prefix="graft_lint_conc_")
+    saver = srv = mgr = None
+    try:
+        eng = ServingEngine(model, max_slots=2)
+        srv = obs.shared_server(0)
+        srv.register_engine("conc0", eng.registry,
+                            ready=lambda: eng.warmed)
+        mgr = CommTaskManager(scan_interval=0.01,
+                              default_timeout=60.0).start()
+        saver = ckpt.AsyncCheckpointer(root)
+        stop = threading.Event()
+        scrape_errors: list = []
+        scrapes = [0]
+
+        def scrape():
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=10)
+            try:
+                while not stop.is_set():
+                    for path in ("/metrics", "/healthz"):
+                        conn.request("GET", path)
+                        conn.getresponse().read()
+                        scrapes[0] += 1
+            except Exception as e:       # surfaced as a gate error below
+                scrape_errors.append(e)
+            finally:
+                conn.close()
+
+        scraper = threading.Thread(target=scrape, name="conc-scraper",
+                                   daemon=True)
+        scraper.start()
+        rs = np.random.RandomState(0)
+        tree = {"w": rs.randn(64).astype("float32")}
+        with mgr.watch("conc-smoke"):
+            for i, (ln, nt) in enumerate(((3, 2), (6, 4), (4, 3), (5, 2))):
+                eng.add_request(rs.randint(0, 128, (ln,)),
+                                max_new_tokens=nt)
+                while eng.has_work():
+                    eng.step()
+                saver.save(i + 1, tree)   # overlapped background commit
+        saver.wait()
+        stop.set()
+        scraper.join(timeout=15)
+        if scrape_errors:
+            findings.append(analysis.Finding(
+                "conc-smoke", "error", "conc/stress",
+                f"/metrics scraper thread failed mid-stress: "
+                f"{scrape_errors[0]!r}"))
+        elif scrapes[0] < 2:
+            findings.append(analysis.Finding(
+                "conc-smoke", "error", "conc/stress",
+                "the scraper thread never completed a scrape — the "
+                "stress did not actually exercise concurrent reads"))
+    finally:
+        lockdep.disable()
+        paddle.set_flags({"FLAGS_debug_thread_checks": False})
+        if saver is not None:
+            saver.close()
+        if mgr is not None:
+            mgr.shutdown()
+        if srv is not None:
+            srv.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+    seen = lockdep.locks_seen()
+    if len(seen) < 3:
+        findings.append(analysis.Finding(
+            "conc-smoke", "error", "conc/stress",
+            f"lockdep instrumentation looks dead: only {sorted(seen)} "
+            "tracked lock(s) recorded across a serving+scrape+ckpt+"
+            "watchdog stress — the wrappers lost their recording hook"))
+    else:
+        findings.append(analysis.Finding(
+            "conc-smoke", "note", "conc/stress",
+            f"stress recorded {len(seen)} tracked locks, "
+            f"{len(lockdep.lock_graph())} order edge(s), "
+            f"{scrapes[0]} concurrent scrapes",
+            data={"locks": sorted(seen)}))
+    findings += analysis.audit_lock_order(loc="conc/stress")
+    findings += analysis.audit_thread_contracts(loc="conc/stress")
+    lockdep.reset()
+    findings += _audit_conc_fixtures()
+    return findings
+
+
+def _audit_conc_fixtures() -> list:
+    """Fire-fixture self-test for D13/D14/D15 (see audit_conc)."""
+    import ast as ast_mod
+    import threading
+
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis
+    from paddle_tpu.core import lockdep
+
+    fx = os.path.join(REPO, "tests", "lint_fixtures")
+
+    def _warns(findings):
+        return [f for f in findings if f.severity == "warning"]
+
+    p13 = os.path.join(fx, "fx_conc_guarded.py")
+    src = open(p13).read()
+    d13a = _warns(analysis.lint_guarded_by(
+        ast_mod.parse(src), src, "fx_conc_guarded.py"))
+    d13b = _warns(analysis.audit_shared_state(
+        [os.path.join(fx, "fx_conc_shared.py")], fx))
+    d15s = _warns(analysis.audit_contract_callsites(
+        [os.path.join(fx, "fx_conc_contract.py")], fx))
+
+    # D14: deterministic two-lock cycle + a blocking call under a hot
+    # lock, on scratch lockdep state
+    lockdep.reset()
+    lockdep.enable()
+    la = lockdep.make_lock("fx.A")
+    lb = lockdep.make_lock("fx.B", hot=True)
+    with la:
+        with lb:
+            pass
+    with lb:
+        with la:
+            pass
+        lockdep.note_blocking("fsync", "fx_conc")
+    lockdep.disable()
+    d14 = _warns(analysis.audit_lock_order(loc="conc/fire-fixtures"))
+    d14_cycle = [f for f in d14 if f.detector == "conc-lock-order"]
+    d14_block = [f for f in d14 if f.detector == "conc-blocking-under-lock"]
+    lockdep.reset()
+
+    # D15 runtime: a second thread driving a bound contract must BOTH
+    # raise ConcurrencyContractError and record an auditable violation
+    paddle.set_flags({"FLAGS_debug_thread_checks": True})
+    try:
+        contract = lockdep.ThreadContract("fx.Engine")
+        contract.check("step")              # binds this (owner) thread
+        raised: list = []
+
+        def violate():
+            try:
+                contract.check("step")
+            except lockdep.ConcurrencyContractError as e:
+                raised.append(e)
+
+        t = threading.Thread(target=violate, name="conc-violator")
+        t.start()
+        t.join()
+        d15r = _warns(analysis.audit_thread_contracts(
+            loc="conc/fire-fixtures")) if raised else []
+    finally:
+        paddle.set_flags({"FLAGS_debug_thread_checks": False})
+        lockdep.reset()
+
+    findings = []
+    for det, fired in (
+            ("D13 conc-guarded-by (unlocked mutations)", d13a),
+            ("D13 conc-shared-state (thread-root global)", d13b),
+            ("D14 conc-lock-order (two-lock cycle)", d14_cycle),
+            ("D14 conc-blocking-under-lock (fsync under hot lock)",
+             d14_block),
+            ("D15 conc-thread-contract static (root drives engine)",
+             d15s),
+            ("D15 conc-thread-contract runtime (second thread)", d15r)):
+        if fired:
+            findings.append(analysis.Finding(
+                "conc-smoke", "note", "conc/fire-fixtures",
+                f"{det}: fire fixture produced {len(fired)} unsuppressed "
+                "warning(s) — the detector gates",
+                data={"warnings": len(fired)}))
+        else:
+            findings.append(analysis.Finding(
+                "conc-smoke", "error", "conc/fire-fixtures",
+                f"{det}: the fire fixture produced NO warning — the "
+                "detector went silently dead and concurrency regressions "
+                "would pass lint"))
+    return findings
+
+
+#: the baseline entries (with their `_matched` counts) of the most
+#: recent run() — the --json payload exposes them so a PARALLEL gate
+#: (check_scoreboard.lint_gate round 17: one subprocess per smoke group)
+#: can aggregate staleness across partial runs instead of losing it
+LAST_BASELINE: list = []
+
+
 def run(models=(), ast=True, baseline_path=DEFAULT_BASELINE,
-        prune_baseline=False):
+        prune_baseline=False, defer_stale=False):
+    global LAST_BASELINE
+
     from paddle_tpu import analysis
 
     findings = []
     if ast:
+        # the static (no-trace) audits ride the AST pass: in the
+        # parallel CI gate exactly ONE group runs them, so a tune-cache
+        # warning is reported once, not once per worker
         findings += analysis.lint_tree(REPO)
-    findings += analysis.audit_tune_cache()
+        findings += analysis.audit_tune_cache()
     smokes = {"paged": audit_serving, "obs": audit_obs,
-              "ckpt": audit_ckpt, "spmd": audit_spmd}
+              "ckpt": audit_ckpt, "spmd": audit_spmd, "conc": audit_conc}
     for name in models:
         findings += smokes.get(name, lambda n=name: audit_model(n))()
     baseline = analysis.load_baseline(baseline_path)
     analysis.apply_baseline(findings, baseline)
+    LAST_BASELINE = baseline
+    if defer_stale:
+        # the caller (the parallel CI gate) aggregates staleness over
+        # the union of its partial runs via the --json baseline counts
+        return findings
 
     # stale-suppression detection: an entry that suppressed nothing can
     # only mask a future real finding. On a FULL-coverage run (AST lint +
@@ -816,10 +1055,16 @@ def main(argv=None):
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help=f"suppression file (default {DEFAULT_BASELINE})")
     ap.add_argument("--no-ast", action="store_true",
-                    help="skip the AST lint (jaxpr/VMEM audits only)")
+                    help="skip the static audits (AST lint + tune-cache "
+                         "scan) — model/jaxpr audits only")
     ap.add_argument("--prune-baseline", action="store_true",
                     help="rewrite the baseline without entries that "
                          "matched zero findings (full-coverage runs only)")
+    ap.add_argument("--defer-stale", action="store_true",
+                    help="emit no stale-suppression findings; the --json "
+                         "payload carries per-entry match counts so a "
+                         "parallel caller can aggregate staleness over "
+                         "the union of partial runs")
     args = ap.parse_args(argv)
 
     # every smoke runs on the same virtual 8-device CPU platform the test
@@ -841,9 +1086,16 @@ def main(argv=None):
 
     findings = run(models=models, ast=not args.no_ast,
                    baseline_path=args.baseline,
-                   prune_baseline=args.prune_baseline)
+                   prune_baseline=args.prune_baseline,
+                   defer_stale=args.defer_stale)
     if args.as_json:
-        print(json.dumps(analysis.to_json(findings), indent=2))
+        payload = analysis.to_json(findings)
+        payload["baseline"] = [
+            {"detector": e.get("detector"), "match": e.get("match"),
+             "matched": e.get("_matched", 0)} for e in LAST_BASELINE]
+        payload["models"] = models
+        payload["ast"] = not args.no_ast
+        print(json.dumps(payload, indent=2))
     else:
         print(analysis.format_text(findings))
     return 1 if analysis.gate_failures(findings) else 0
