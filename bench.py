@@ -1290,6 +1290,77 @@ def bench_ckpt(iters=3):
     return out
 
 
+def bench_partitioner_scaling(iters=4, batch=8, seq=128):
+    """Round-18 declarative-partitioner rung: the SAME unmodified
+    tiny-LLaMA train step compiled from three MeshConfigs on the
+    8-device virtual mesh — pure data parallel, data×tp, and a sep
+    (ring-attention context-parallel) config — reporting tok/s per
+    config next to the D10 per-axis jaxpr-level collective-byte ledger
+    (ppermute bytes for the sep config; GSPMD's own collectives live in
+    HLO below the jaxpr and are noted as such). Off-chip this is a
+    placement/compile-health probe on virtual CPU devices
+    (platform:"cpu", excluded from README claims by check_scoreboard);
+    the relative tok/s ordering is NOT an ICI scaling claim."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis
+    from paddle_tpu.distributed.partitioner import MeshConfig, partition
+    from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny_config
+
+    paddle.set_flags({"FLAGS_jit_debug_program": True})
+    configs = [MeshConfig(data=8), MeshConfig(data=4, tp=2),
+               MeshConfig(data=2, sep=4)]
+    rows = {}
+    for mc in configs:
+        paddle.seed(0)
+        cfg = llama_tiny_config(hidden_size=128, intermediate_size=256,
+                                num_hidden_layers=4,
+                                max_position_embeddings=seq)
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+
+        def step(ids, labels, model=model, opt=opt):
+            loss = model(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        pstep = partition(step, mc, model=model)
+        rs = np.random.RandomState(0)
+
+        def batch_pair():
+            return (paddle.to_tensor(rs.randint(
+                        0, cfg.vocab_size, (batch, seq)).astype("int64")),
+                    paddle.to_tensor(rs.randint(
+                        0, cfg.vocab_size, (batch, seq)).astype("int64")))
+
+        for _ in range(3):                     # eager/discovery/compile
+            float(pstep(*batch_pair()))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = float(pstep(*batch_pair()))  # host sync per step
+        wall = time.perf_counter() - t0
+        vol = analysis.jaxpr_collective_bytes(pstep.program_jaxpr())
+        rows[mc.describe()] = {
+            "tokens_per_sec": round(iters * batch * seq / wall, 1),
+            "step_ms": round(wall / iters * 1e3, 2),
+            "loss": round(loss, 4),
+            "sharded_params": pstep.plan.summary()["sharded"],
+            "collective_bytes_total": vol["total"],
+            "collective_bytes_per_axis": vol["per_axis"],
+            "collective_sites": vol["sites"],
+        }
+    return {"name": "partitioner_scaling", "configs": rows,
+            "note": ("virtual-mesh placement probe (one host, 8 XLA CPU "
+                     "devices) — config-relative tok/s is not an ICI "
+                     "scaling claim; GSPMD collectives live below the "
+                     "jaxpr, only shard_map-level (sep/ring) bytes are "
+                     "in the ledger")}
+
+
 def bench_eager_host(iters=50):
     """bench_eager_dispatch on the host CPU backend (no tunnel RTT), with
     tiny operands so compute is negligible: the framework's own per-op
@@ -1322,6 +1393,7 @@ ALL = {
     "llama_serving": bench_llama_serving,
     "llama_serving_slo": bench_llama_serving_slo,
     "ckpt": bench_ckpt,
+    "partitioner_scaling": bench_partitioner_scaling,
     "int8": bench_int8,
     "int8_chain": bench_int8_chain,
     "eager": bench_eager_dispatch,
@@ -1340,9 +1412,17 @@ def run_one(name):
         # FRAMEWORK's own overhead (SURVEY §7 hard-part (1) quantified)
         os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
         os.environ["JAX_PLATFORMS"] = "cpu"
+    elif name == "partitioner_scaling":
+        # the partitioner rung needs the 8-device virtual mesh (same
+        # platform tests/conftest.py and the spmd lint smoke force);
+        # rows land platform:"cpu" = excluded from README claims
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
-    if name == "eager_host":
+    if name in ("eager_host", "partitioner_scaling"):
         jax.config.update("jax_platforms", "cpu")
 
     # persistent compile cache: subprocess isolation must not mean
@@ -1438,7 +1518,7 @@ _COST_EST = {
     "llama": 120, "gpt_sharding": 220, "bert_bf16": 200, "bert": 200,
     "resnet50_bf16": 250, "resnet50": 340, "lenet": 50, "decode": 70,
     "decode_1b": 190, "decode_micro": 90, "llama_serving": 180,
-    "llama_serving_slo": 200, "ckpt": 150,
+    "llama_serving_slo": 200, "ckpt": 150, "partitioner_scaling": 150,
     "int8_chain": 70, "int8": 60, "eager": 25,
     "eager_host": 15, "fused_adam": 170,
 }
@@ -1482,7 +1562,8 @@ def main(argv):
     # first and the headline JSON is re-printed after EVERY config, so a
     # timeout's captured tail still carries the best-so-far headline.
     default = ["llama_1b", "llama_1b_resid_bf16", "decode_micro",
-               "llama_serving", "llama_serving_slo", "ckpt", "fused_micro",
+               "llama_serving", "llama_serving_slo", "ckpt",
+               "partitioner_scaling", "fused_micro",
                "longctx_8k", "flashmask_16k", "longctx_4k",
                "flashmask_8k", "llama_bf16", "gpt_sharding", "bert_bf16",
                "llama", "lenet", "decode_1b", "resnet50_bf16", "bert",

@@ -25,9 +25,10 @@ from __future__ import annotations
 
 from .async_saver import AsyncCheckpointer
 from .core import (CheckpointError, CheckpointNotFoundError,
-                   CheckpointSaveError, RestoreResult, atomic_write_bytes,
-                   atomic_write_stream, clean_debris, gc_checkpoints,
-                   host_copy, latest_pointer, list_checkpoints,
+                   CheckpointSaveError, RestoreResult, ShardedLeaf,
+                   atomic_write_bytes, atomic_write_stream, clean_debris,
+                   gc_checkpoints, host_copy, latest_pointer,
+                   list_checkpoints, manifest_shardings,
                    restore_checkpoint, save_checkpoint, step_dir_name,
                    verify_checkpoint)
 from .data import ResumableLoader
@@ -38,7 +39,7 @@ __all__ = [
     "save_checkpoint", "restore_checkpoint", "verify_checkpoint",
     "list_checkpoints", "latest_pointer", "gc_checkpoints",
     "clean_debris", "atomic_write_bytes", "atomic_write_stream",
-    "host_copy", "step_dir_name",
+    "host_copy", "step_dir_name", "manifest_shardings", "ShardedLeaf",
     "RestoreResult", "CheckpointError", "CheckpointSaveError",
     "CheckpointNotFoundError",
     "AsyncCheckpointer",

@@ -36,12 +36,16 @@ class AsyncCheckpointer:
     _STOP = object()
 
     def __init__(self, root, keep_last_n=None, max_in_flight=None,
-                 fingerprint_extra=None):
+                 fingerprint_extra=None, sharded=False):
         from ..core.flags import flag
 
         self.root = root
         self.keep_last_n = keep_last_n
         self.fingerprint_extra = fingerprint_extra
+        #: sharded=True: mesh-sharded leaves snapshot per addressable
+        #: shard (ckpt.core.host_copy sharded path) — the partitioner's
+        #: sharding-aware save rides the same async machinery
+        self.sharded = bool(sharded)
         if max_in_flight is None:
             max_in_flight = int(flag("FLAGS_ckpt_max_in_flight"))
         self._q: queue.Queue = queue.Queue(maxsize=max(int(max_in_flight), 1))
@@ -108,7 +112,7 @@ class AsyncCheckpointer:
 
         rec = _tf_current()
         t0 = time.perf_counter()
-        host = host_copy(tree)
+        host = host_copy(tree, sharded=self.sharded)
         t1 = time.perf_counter()
         if rec is not None:
             # the BLOCKING half: the device->host snapshot the train

@@ -340,6 +340,21 @@ define_flag("FLAGS_obs_peak_tflops", 0.0,
             "divide achieved FLOP/s by; 0 = per-backend default "
             "(obs/goodput.py PEAK_TFLOPS_DEFAULTS — a nominal host "
             "number off-chip, do not quote)")
+define_flag("FLAGS_partitioner_heuristics", True,
+            "declarative partitioner (distributed/partitioner): "
+            "rule-match UNANNOTATED parameters by shape/name heuristics "
+            "(2D up/down projections, embedding-shaped tables) instead "
+            "of leaving them replicated; every guess is a named note in "
+            "the PartitionPlan surfaced by the graft_lint spmd smoke")
+define_flag("FLAGS_partitioner_sep_impl", "ring",
+            "attention exchange for sep-axis (context-parallel) "
+            "partitioner configs: ring (lax.ppermute K/V rotation, any "
+            "head count) | ulysses (all-to-all seq<->head transpose, "
+            "needs heads % sep == 0 — falls back to ring otherwise)")
+define_flag("FLAGS_partitioner_fsdp_min_size", 1024,
+            "parameters with fewer elements than this stay replicated "
+            "instead of ZeRO-3 fsdp-sharded (tiny tensors pay the "
+            "per-use all-gather latency without meaningful HBM savings)")
 define_flag("FLAGS_debug_thread_checks", False,
             "owner-thread contract assertions on the deliberately "
             "single-threaded serving objects (ServingEngine, "

@@ -1,0 +1,33 @@
+"""paddle_tpu.distributed.partitioner — declarative pod-scale sharding.
+
+One `MeshConfig` (axis degrees + a logical-axis rule table) shards ANY
+`to_static` train step: `partition(step_fn, config, model=m)` places the
+parameters (ZeRO-3 fsdp + tensor-parallel per the rules), constrains the
+batch/sequence stream, routes `sep`-axis attention through the
+ring/ulysses kernels, and compiles one GSPMD program — no per-model
+mp_layers wiring (the t5x/GSPMD shape, SNIPPETS.md [1]-[3]).
+
+Sharding-aware checkpoints ride `ckpt` manifest v2: per-shard save keyed
+by Shard.index (`save_partitioned`), resharding-on-restore via
+`restore_partitioned` (restore a data4×tp2 run onto data2×tp4 — or onto
+one device).
+"""
+from __future__ import annotations
+
+from .api import (active_config, annotate, maybe_sep_attention, partition,
+                  place_plan, shard_model)
+from .checkpoint import (PartitionedRestore, restore_partitioned,
+                         save_partitioned)
+from .mesh import AXIS_NAMES, MeshConfig
+from .rules import (DEFAULT_RULES, REPLICATED_RULES, ParamDecision,
+                    PartitionPlan, infer_logical_axes, spec_for_param)
+
+__all__ = [
+    "MeshConfig", "AXIS_NAMES",
+    "DEFAULT_RULES", "REPLICATED_RULES",
+    "PartitionPlan", "ParamDecision",
+    "partition", "shard_model", "place_plan", "annotate",
+    "active_config", "maybe_sep_attention",
+    "save_partitioned", "restore_partitioned", "PartitionedRestore",
+    "infer_logical_axes", "spec_for_param",
+]

@@ -1,0 +1,196 @@
+"""Logical-axis rules: how parameter dims map onto mesh axes.
+
+t5x-style (SNIPPETS.md [1]-[2] LogicalAxisRules): a parameter carries
+LOGICAL axis names (`param.logical_axes = ("embed", "heads")` — the
+annotation hook is `nn.Layer.shard_annotate`, and llama/gpt/bert
+annotate once at construction), and the rule table maps each logical
+name to a mesh axis (or None = replicated). Changing parallelism means
+changing the RULE TABLE or the MeshConfig degrees — never the model.
+
+Unannotated parameters are rule-matched by shape/name heuristics
+(`infer_logical_axes`) under FLAGS_partitioner_heuristics; every
+heuristic decision lands in the PartitionPlan as a named note so the
+graft_lint spmd smoke (analysis D9's per-config evidence) can surface
+what was guessed rather than declared.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+#: logical axis -> mesh axis (str), mesh axes (tuple), or None
+#: (replicated). `embed` riding `fsdp` IS ZeRO-3: parameters live
+#: sharded along their embed dim; GSPMD all-gathers them at use and
+#: reduce-scatters the grads — the gather/scatter "around the step"
+#: without a wrapper optimizer.
+DEFAULT_RULES = (
+    ("batch", ("data", "fsdp")),
+    ("seq", "sep"),
+    ("vocab", "tp"),
+    ("heads", "tp"),
+    ("kv", "tp"),
+    ("mlp", "tp"),
+    ("embed", "fsdp"),
+    ("pos", None),
+    ("type", None),
+    ("norm", None),
+    ("classes", None),
+)
+
+#: a rule table that shards NOTHING — the graft_lint fire fixture uses
+#: it to prove D9 still catches a partitioner config whose rules went
+#: dead (and it is a handy debugging escape: same code path, all
+#: placement off)
+REPLICATED_RULES = tuple((name, None) for name, _ in DEFAULT_RULES)
+
+
+def resolve_rule(logical_name: str, rules) -> tuple:
+    """Mesh axes for one logical axis name: () when replicated."""
+    for name, target in rules:
+        if name == logical_name:
+            if target is None:
+                return ()
+            return tuple(target) if isinstance(target, (tuple, list)) \
+                else (target,)
+    return ()
+
+
+@dataclass
+class ParamDecision:
+    """One parameter's placement decision (PartitionPlan row)."""
+
+    name: str
+    shape: tuple
+    logical_axes: tuple | None      # None = no annotation, heuristics ran
+    spec: tuple = ()                # PartitionSpec entries, post-guards
+    heuristic: bool = False
+    notes: list = field(default_factory=list)
+
+
+class PartitionPlan:
+    """Every placement decision `shard_model` made for one (model,
+    config) pair: per-param specs, which came from heuristics, and which
+    rule assignments were dropped by divisibility guards. `to_findings()`
+    renders the plan as analysis notes — the "named D9 note" contract
+    for rule-matched unannotated models."""
+
+    def __init__(self, config, mesh):
+        self.config = config
+        self.mesh = mesh
+        self.decisions: list[ParamDecision] = []
+
+    def add(self, d: ParamDecision):
+        self.decisions.append(d)
+
+    @property
+    def heuristic_params(self) -> list:
+        return [d for d in self.decisions if d.heuristic]
+
+    @property
+    def sharded_params(self) -> list:
+        return [d for d in self.decisions if any(d.spec)]
+
+    def summary(self) -> dict:
+        return {"config": self.config.describe(),
+                "params": len(self.decisions),
+                "sharded": len(self.sharded_params),
+                "heuristic": len(self.heuristic_params),
+                "dropped": sum(len(d.notes) for d in self.decisions)}
+
+    def to_findings(self, loc="partitioner/plan") -> list:
+        from ...analysis import Finding
+
+        findings = []
+        heur = self.heuristic_params
+        if heur:
+            findings.append(Finding(
+                "partitioner-heuristic", "note", loc,
+                f"{len(heur)} unannotated parameter(s) were rule-matched "
+                "by shape/name heuristics (annotate with "
+                "Layer.shard_annotate to make the placement declarative): "
+                f"{[d.name for d in heur[:6]]}"
+                + ("..." if len(heur) > 6 else ""),
+                {"params": [d.name for d in heur]}))
+        dropped = [(d.name, n) for d in self.decisions for n in d.notes]
+        if dropped:
+            findings.append(Finding(
+                "partitioner-heuristic", "note", loc,
+                f"{len(dropped)} rule assignment(s) dropped by "
+                "divisibility/size guards (those dims stay replicated): "
+                f"{dropped[:4]}" + ("..." if len(dropped) > 4 else ""),
+                {"dropped": [f"{n}: {note}" for n, note in dropped]}))
+        return findings
+
+
+def infer_logical_axes(name: str, shape, config) -> tuple | None:
+    """Shape/name heuristics for a parameter with no annotation.
+
+    Conservative by construction: a guess can only ever place a dim on
+    an axis the divisibility guards accept, and every guess is a named
+    plan note. Returns None for params heuristics cannot read (stays
+    replicated)."""
+    shape = tuple(int(s) for s in shape)
+    lname = name.lower()
+    if len(shape) == 1:
+        return ("norm",)               # biases/norm scales: replicated
+    if len(shape) == 2:
+        d0, d1 = shape
+        if any(k in lname for k in ("embed", "wte", "wpe", "token",
+                                    "position", "word")):
+            # [vocab, embed]-shaped lookup table
+            return ("vocab", "embed") if d0 >= d1 else ("embed", "vocab")
+        if d1 > d0:
+            return ("embed", "mlp")    # up-projection
+        if d0 > d1:
+            return ("mlp", "embed")    # down-projection
+        return ("embed", "heads")      # square: qkv/out-style
+    return None
+
+
+def spec_for_param(name: str, shape, logical_axes, config, min_shard_size=None):
+    """(spec_entries, notes): map one param's logical axes through the
+    rule table with divisibility + size guards. A mesh axis whose size
+    does not divide the dim is DROPPED with a note (never a crash: one
+    config must run every model). An axis already used by an earlier dim
+    is dropped too (a PartitionSpec may not repeat a mesh axis)."""
+    from ...core.flags import flag
+
+    rules = config.rules or DEFAULT_RULES
+    sizes = config.axis_sizes
+    if min_shard_size is None:
+        min_shard_size = int(flag("FLAGS_partitioner_fsdp_min_size"))
+    shape = tuple(int(s) for s in shape)
+    total = int(np.prod(shape)) if shape else 1
+    entries: list = []
+    notes: list = []
+    used: set = set()
+    for dim, logical in enumerate(logical_axes or ()):
+        if dim >= len(shape):
+            break
+        axes = [a for a in resolve_rule(logical, rules) if a in sizes]
+        kept = []
+        for a in axes:
+            size = sizes[a]
+            if size <= 1:
+                continue
+            if a in used:
+                notes.append(f"dim {dim} ({logical!r}): mesh axis {a!r} "
+                             "already used by an earlier dim")
+                continue
+            if shape[dim] % (size * int(np.prod([sizes[x] for x in kept]))):
+                notes.append(f"dim {dim} ({logical!r}): {shape[dim]} not "
+                             f"divisible by {a}={size}")
+                continue
+            if a == "fsdp" and total < min_shard_size:
+                notes.append(f"dim {dim} ({logical!r}): {total} elems "
+                             f"under FLAGS_partitioner_fsdp_min_size="
+                             f"{min_shard_size}, kept replicated")
+                continue
+            kept.append(a)
+            used.add(a)
+        entries.append(tuple(kept) if len(kept) > 1
+                       else (kept[0] if kept else None))
+    while len(entries) < len(shape):
+        entries.append(None)
+    return tuple(entries), notes

@@ -47,10 +47,18 @@ class Model:
         self._metrics = []
         self.stop_training = False
         self._save_dir = None
+        # declarative partitioner (distributed/partitioner): prepare()/
+        # fit() accept a MeshConfig; params are placed once, inputs are
+        # batch-sharded per step
+        self._mesh_config = None
+        self._mesh_plan = None
 
     # ------------------------------------------------------------ setup
-    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None,
+                mesh=None):
         self._optimizer = optimizer
+        if mesh is not None:
+            self._apply_mesh(mesh)
         if loss is not None and not callable(loss):
             raise TypeError("loss must be callable (a Loss layer or function)")
         self._loss = loss
@@ -79,6 +87,52 @@ class Model:
     def parameters(self, include_sublayers=True):
         return self.network.parameters(include_sublayers=include_sublayers)
 
+    def _apply_mesh(self, mesh):
+        """Place the network per a declarative MeshConfig (ZeRO-3 fsdp +
+        tensor axes from the logical-axis rules); training inputs get
+        batch-sharded in train_batch. CPU-virtual fallback: a host too
+        small for the config trains unsharded with a named warning."""
+        from ..distributed.partitioner import MeshConfig, shard_model
+
+        if not isinstance(mesh, MeshConfig):
+            raise TypeError(
+                f"mesh must be a distributed.partitioner.MeshConfig, got "
+                f"{type(mesh).__name__}")
+        self._mesh_config = mesh
+        m = mesh.maybe_mesh()
+        if m is None:
+            import warnings
+
+            warnings.warn(
+                f"Model.prepare/fit(mesh=...): MeshConfig "
+                f"{mesh.describe()} needs {mesh.num_devices} devices — "
+                "running unsharded (cpu-virtual fallback)")
+            self._mesh_plan = None
+            return
+        self._mesh_plan = shard_model(self.network, mesh, mesh=m)
+
+    def _mesh_place_input(self, t):
+        """Shard one training input onto the prepared mesh — the SAME
+        batch/sequence placement rule partition() applies to step args
+        (partitioner.api._stream_spec), concretized for eager
+        device_put."""
+        plan = self._mesh_plan
+        if plan is None or not isinstance(t, Tensor) or t.ndim < 1:
+            return t
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..distributed.partitioner.api import _stream_spec
+
+        spec = _stream_spec(self._mesh_config, plan.mesh, tuple(t.shape))
+        if spec is None:
+            return t
+        concrete = P(*(None if e is P.UNCONSTRAINED else e
+                       for e in spec))
+        t._assign_raw(jax.device_put(
+            t._data, NamedSharding(plan.mesh, concrete)))
+        return t
+
     # ------------------------------------------------------------ batches
     def train_batch(self, inputs, labels=None, update=True):
         import paddle_tpu as paddle
@@ -96,6 +150,9 @@ class Model:
             t0 = pc()
         inputs = [_to_tensor(v) for v in _to_list(inputs)]
         labels = [_to_tensor(v) for v in _to_list(labels)]
+        if self._mesh_plan is not None:
+            inputs = [self._mesh_place_input(v) for v in inputs]
+            labels = [self._mesh_place_input(v) for v in labels]
         if pc:
             rec.program_span("h2d", t0, pc(),
                              tensors=len(inputs) + len(labels))
@@ -177,7 +234,9 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, mesh=None):
+        if mesh is not None:
+            self._apply_mesh(mesh)
         loader = self._loader(train_data, batch_size, shuffle, num_workers,
                               drop_last)
         steps = len(loader) if hasattr(loader, "__len__") else None

@@ -144,7 +144,8 @@ class BucketAxis:
 class CompiledFunction:
     def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
                  backend=None, full_graph=False, donate_buffers=None,
-                 bucket_axes: dict | None = None, share_discovery=False):
+                 bucket_axes: dict | None = None, share_discovery=False,
+                 in_shardings=None):
         functools.update_wrapper(self, fn)
         self._fn = fn
         # per-instance RLock serializing specialization bookkeeping:
@@ -174,6 +175,12 @@ class CompiledFunction:
         # warm-up at full batch can exceed HBM long before the compiled,
         # donated program does). Prime with a tiny batch, then run big.
         self._share_discovery = share_discovery
+        # in-spec plumb-through (the declarative partitioner rides this):
+        # {arg_leaf_position: jax Sharding} or callable(leaves) -> list of
+        # per-leaf Shardings/None, resolved once per specialization and
+        # applied as with_sharding_constraint on the traced arg inputs —
+        # the compiled program's in-specs without a wrapper function
+        self._in_shardings = in_shardings
         # dy2static: the AST-rewritten capture function (lazily built) and
         # its transform report; _break_reason records why capture fell back
         self._cap_fn = None
@@ -275,6 +282,19 @@ class CompiledFunction:
             return self
         return functools.partial(self.__call__, instance)
 
+    def _leaf_shardings(self, leaves):
+        """Per-arg-leaf Shardings from the `in_shardings` spec (None when
+        unset or nothing resolves)."""
+        if self._in_shardings is None:
+            return None
+        if callable(self._in_shardings):
+            out = list(self._in_shardings(leaves) or ())
+        else:
+            out = [self._in_shardings.get(i)
+                   for i in range(len(leaves))]
+        out += [None] * (len(leaves) - len(out))
+        return out if any(s is not None for s in out) else None
+
     def _key(self, struct, leaves):
         spec = ";".join(f"{tuple(t.shape)}|{t.dtype.name}|{t.stop_gradient}"
                         for t in leaves)
@@ -363,8 +383,15 @@ class CompiledFunction:
         spec.cost_entry = None    # set below when the AOT path analyzed
         holder = {}
         cap_fn = self._capture_fn()
+        arg_shards = self._leaf_shardings(leaves)
 
         def pure(arg_datas, ro_datas, mut_datas):
+            if arg_shards:
+                arg_datas = [
+                    jax.lax.with_sharding_constraint(d, sh)
+                    if sh is not None and isinstance(d, jax.core.Tracer)
+                    else d
+                    for d, sh in zip(arg_datas, arg_shards)]
             tctx = TraceContext("trace", borrowed=borrowed)
             holder["tctx"] = tctx
             saved = [(t, t._data) for t in ro_caps + mut_caps]
@@ -658,7 +685,7 @@ class CompiledFunction:
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
               full_graph=False, bucket_axes=None, share_discovery=False,
-              **kwargs):
+              in_shardings=None, **kwargs):
     """Decorator/wrapper compiling a dygraph callable into one XLA program.
 
     full_graph=False (default, ≙ SOT): a trace failure (data-dependent Python
@@ -670,6 +697,12 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
     boundaries before cache lookup, so N distinct lengths compile O(log N)
     specializations instead of N (SURVEY §7 hard-part (3); the role of the
     reference's varlen flash-attention + SOT dynamic-shape guards).
+
+    in_shardings: {tensor_leaf_position: jax Sharding} or
+    callable(leaves) -> per-leaf Sharding list — applied as
+    with_sharding_constraint on the traced arg inputs, so the compiled
+    program carries real GSPMD in-specs (the declarative partitioner's
+    plumb-through; distributed/partitioner).
     """
 
     def wrap(fn):
@@ -683,13 +716,15 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
             cf = CompiledFunction(layer.forward, input_spec, build_strategy, backend,
                                   full_graph, donate_buffers=donate,
                                   bucket_axes=bucket_axes,
-                                  share_discovery=share_discovery)
+                                  share_discovery=share_discovery,
+                                  in_shardings=in_shardings)
             layer.forward = cf
             return layer
         return CompiledFunction(fn, input_spec, build_strategy, backend, full_graph,
                                 donate_buffers=donate,
                                 bucket_axes=bucket_axes,
-                                share_discovery=share_discovery)
+                                share_discovery=share_discovery,
+                                in_shardings=in_shardings)
 
     if function is not None:
         return wrap(function)

@@ -75,6 +75,20 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     dk = next_key() if (dropout_p > 0.0 and training) else None
     p = dropout_p if training else 0.0
 
+    # context-parallel routing: inside a partitioned step whose
+    # MeshConfig has sep > 1, the seq-sharded exchange rides the
+    # ring/ulysses kernels (distributed/partitioner). The hook is one
+    # list-peek when no partitioned step is active.
+    from ...distributed.partitioner.api import _ACTIVE as _part_active
+
+    if _part_active:
+        from ...distributed.partitioner.api import maybe_sep_attention
+
+        out = maybe_sep_attention(query, key, value, is_causal,
+                                  attn_mask=attn_mask, dropout_p=p)
+        if out is not None:
+            return out
+
     if attn_mask is None and p == 0.0 and _use_pallas(query):
         from ...ops.pallas_attention import flash_attention_op
 

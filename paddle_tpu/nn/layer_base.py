@@ -125,6 +125,22 @@ class Layer:
                 p.regularizer = attr.regularizer
         return p
 
+    def shard_annotate(self, **param_axes):
+        """Attach LOGICAL axis names to this layer's parameters for the
+        declarative partitioner (distributed/partitioner): e.g.
+        ``linear.shard_annotate(weight=("embed", "heads"))``. The rule
+        table of a MeshConfig maps logical names to mesh axes at
+        partition time — the model itself stays mesh-agnostic. Pass
+        None to mark a parameter explicitly replicated."""
+        for name, axes in param_axes.items():
+            p = self._parameters.get(name)
+            if p is None:
+                raise KeyError(
+                    f"shard_annotate: {type(self).__name__} has no "
+                    f"parameter {name!r}")
+            p.logical_axes = tuple(axes) if axes else None
+        return self
+
     # ------------------------------------------------------------ iteration
     def named_parameters(self, prefix="", include_sublayers=True) -> Iterator:
         memo = set()

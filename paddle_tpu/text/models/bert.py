@@ -33,6 +33,9 @@ class BertEmbeddings(nn.Layer):
                                                 config.hidden_size)
         self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
                                                   config.hidden_size)
+        self.word_embeddings.shard_annotate(weight=("vocab", "embed"))
+        self.position_embeddings.shard_annotate(weight=("pos", "embed"))
+        self.token_type_embeddings.shard_annotate(weight=("type", "embed"))
         self.layer_norm = nn.LayerNorm(config.hidden_size,
                                        epsilon=config.layer_norm_eps)
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
@@ -61,6 +64,10 @@ class BertSelfAttention(nn.Layer):
         self.key = nn.Linear(h, h)
         self.value = nn.Linear(h, h)
         self.out = nn.Linear(h, h)
+        # declarative-partitioner logical axes (distributed/partitioner)
+        for lin in (self.query, self.key, self.value):
+            lin.shard_annotate(weight=("embed", "heads"), bias=("heads",))
+        self.out.shard_annotate(weight=("heads", "embed"), bias=("norm",))
 
     def forward(self, x, attn_mask=None):
         b, s, h = x.shape
@@ -79,6 +86,9 @@ class BertLayer(nn.Layer):
                                       epsilon=config.layer_norm_eps)
         self.intermediate = nn.Linear(config.hidden_size, config.intermediate_size)
         self.output = nn.Linear(config.intermediate_size, config.hidden_size)
+        self.intermediate.shard_annotate(weight=("embed", "mlp"),
+                                         bias=("mlp",))
+        self.output.shard_annotate(weight=("mlp", "embed"), bias=("norm",))
         self.out_norm = nn.LayerNorm(config.hidden_size,
                                      epsilon=config.layer_norm_eps)
         self.dropout = nn.Dropout(config.hidden_dropout_prob)

@@ -38,6 +38,14 @@ class GPTAttention(nn.Layer):
         h = config.hidden_size
         self.qkv_proj = col(h, 3 * h)
         self.out_proj = row(h, h)
+        # declarative-partitioner logical axes (distributed/partitioner);
+        # the fused qkv out-dim is 3*heads*head_dim — still head-granular
+        self.qkv_proj.shard_annotate(weight=("embed", "heads"))
+        self.out_proj.shard_annotate(weight=("heads", "embed"))
+        if getattr(self.qkv_proj, "bias", None) is not None:
+            self.qkv_proj.shard_annotate(bias=("heads",))
+        if getattr(self.out_proj, "bias", None) is not None:
+            self.out_proj.shard_annotate(bias=("norm",))
 
     def forward(self, x):
         b, s, h = x.shape
@@ -56,6 +64,12 @@ class GPTBlock(nn.Layer):
         self.ln_2 = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
         self.fc_in = col(config.hidden_size, config.intermediate_size)
         self.fc_out = row(config.intermediate_size, config.hidden_size)
+        self.fc_in.shard_annotate(weight=("embed", "mlp"))
+        self.fc_out.shard_annotate(weight=("mlp", "embed"))
+        if getattr(self.fc_in, "bias", None) is not None:
+            self.fc_in.shard_annotate(bias=("mlp",))
+        if getattr(self.fc_out, "bias", None) is not None:
+            self.fc_out.shard_annotate(bias=("norm",))
 
     def forward(self, x):
         a = self.attn(self.ln_1(x))
@@ -86,6 +100,9 @@ class GPTForCausalLM(nn.Layer):
         else:
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
                                      bias_attr=False)
+        self.wte.shard_annotate(weight=("vocab", "embed"))
+        self.wpe.shard_annotate(weight=("pos", "embed"))
+        self.lm_head.shard_annotate(weight=("embed", "vocab"))
 
     def forward(self, input_ids, labels=None):
         import paddle_tpu as paddle

@@ -130,6 +130,13 @@ class LlamaAttention(nn.Layer):
         self.k_proj = col(h, self.num_kv_heads * self.head_dim)
         self.v_proj = col(h, self.num_kv_heads * self.head_dim)
         self.o_proj = row(self.num_heads * self.head_dim, h)
+        # declarative-partitioner logical axes (distributed/partitioner):
+        # the rule table maps heads/kv -> tp and embed -> fsdp at
+        # partition time; the hand-wired tensor_parallel path ignores it
+        self.q_proj.shard_annotate(weight=("embed", "heads"))
+        self.k_proj.shard_annotate(weight=("embed", "kv"))
+        self.v_proj.shard_annotate(weight=("embed", "kv"))
+        self.o_proj.shard_annotate(weight=("heads", "embed"))
         # rope tables are shared non-trainable buffers (one copy per process)
         self.cos, self.sin = _rope_tables(
             config.max_position_embeddings, self.head_dim, config.rope_theta)
@@ -162,6 +169,9 @@ class LlamaMLP(nn.Layer):
         self.gate_proj = col(config.hidden_size, config.intermediate_size)
         self.up_proj = col(config.hidden_size, config.intermediate_size)
         self.down_proj = row(config.intermediate_size, config.hidden_size)
+        self.gate_proj.shard_annotate(weight=("embed", "mlp"))
+        self.up_proj.shard_annotate(weight=("embed", "mlp"))
+        self.down_proj.shard_annotate(weight=("mlp", "embed"))
 
     def forward(self, x):
         return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
@@ -177,6 +187,8 @@ class LlamaDecoderLayer(nn.Layer):
                                           epsilon=config.rms_norm_eps)
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
                                                    epsilon=config.rms_norm_eps)
+        self.input_layernorm.shard_annotate(weight=("norm",))
+        self.post_attention_layernorm.shard_annotate(weight=("norm",))
 
     def forward(self, x, attn_mask=None):
         if self.config.use_recompute and \
@@ -207,6 +219,8 @@ class LlamaModel(nn.Layer):
         self.layers = nn.LayerList(
             [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
         self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.embed_tokens.shard_annotate(weight=("vocab", "embed"))
+        self.norm.shard_annotate(weight=("norm",))
 
     def forward(self, input_ids, attn_mask=None):
         x = _cast_residual(self.embed_tokens(input_ids))
@@ -254,6 +268,8 @@ class LlamaForCausalLM(nn.Layer):
         else:
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
                                      bias_attr=False)
+        if self.lm_head is not None:
+            self.lm_head.shard_annotate(weight=("embed", "vocab"))
 
     def forward(self, input_ids, labels=None, attn_mask=None):
         import paddle_tpu as paddle

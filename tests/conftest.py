@@ -62,6 +62,7 @@ QUICK_MODULES = {
     "test_native.py", "test_new_packages.py", "test_nn.py", "test_obs.py",
     "test_ops.py",
     "test_optimizer.py", "test_pallas_attention.py", "test_pallas_decode.py",
+    "test_partitioner.py",
     "test_pallas_norm.py", "test_passes.py", "test_prefix_cache.py",
     "test_profiler.py", "test_scoreboard.py", "test_segmented.py",
     "test_serving.py", "test_static_engine.py", "test_train_flight.py",

@@ -1131,8 +1131,13 @@ def test_spmd_smoke_audits_clean():
     findings = graft_lint.audit_spmd()
     bad = [f for f in findings if f.severity in ("warning", "error")]
     assert bad == [], bad
+    # round 18: a 4th fixture — D9 through the declarative-partitioner
+    # path (all-replicated rule table must still warn)
     fired = [f for f in findings if f.loc == "spmd/fire-fixtures"]
-    assert len(fired) == 3 and all(f.severity == "note" for f in fired)
+    assert len(fired) == 4 and all(f.severity == "note" for f in fired)
+    part = [f for f in findings if f.loc == "spmd/partitioner_step"]
+    assert part and not [f for f in part
+                         if f.severity in ("warning", "error")]
 
 
 def test_lint_gate_model_list_includes_spmd():

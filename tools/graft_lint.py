@@ -57,7 +57,11 @@ gratuitous all-gather, and an in-program device_put must each produce an
 unsuppressed warning — a detector that stopped firing fails the gate
 exactly like a detector that started firing falsely. To give the spmd
 smoke its mesh, the CLI forces the same virtual 8-device CPU platform
-tests/conftest.py uses, for every smoke.
+tests/conftest.py uses, for every smoke. Round 18 adds the DECLARATIVE
+half: the partitioner (distributed/partitioner) must shard the
+UNMODIFIED tiny-LLaMA train step from one data+fsdp+tp MeshConfig with
+clean D1-D11 + full D9 coverage, and an all-replicated rule table must
+still fire D9 through the partitioner path (silently-dead self-test).
 
 The special model name `conc` (round 17) smokes the CONCURRENCY
 contract: a genuinely multi-threaded serving/ckpt/obs stress (engine
@@ -698,9 +702,107 @@ def audit_spmd() -> list:
             f"{vol['total']} B/device over {vol['sites']} site(s) "
             "(GSPMD-inserted collectives live in HLO below the jaxpr)",
             data=vol))
+        findings += _audit_partitioner()
     finally:
         paddle.set_flags({"FLAGS_jit_debug_program": False})
     findings += _audit_spmd_fixtures(mesh)
+    return findings
+
+
+def _audit_partitioner() -> list:
+    """Round-18 half of the spmd smoke: the DECLARATIVE partitioner
+    compiles the UNMODIFIED tiny-LLaMA train step from one
+    data+fsdp+tp MeshConfig (no mp_layers wiring), must audit clean
+    D1-D11 at default flags with full D9 mesh coverage, and must keep
+    its loss on the hand-wired path's trajectory. Then the fire fixture:
+    an all-replicated rule table must STILL produce the D9 warning
+    through the partitioner path — a silently-dead detector fails the
+    gate (the round-15 rule)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis
+    from paddle_tpu.distributed.partitioner import (MeshConfig,
+                                                    REPLICATED_RULES,
+                                                    partition)
+    from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny_config
+
+    def build(mc):
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny_config())
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+
+        def train_step(ids, labels):
+            loss = model(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        return model, partition(train_step, mc, model=model)
+
+    mc = MeshConfig(data=2, fsdp=2, tp=2)
+    model, step = build(mc)
+    rs = np.random.RandomState(1)
+    cfg = model.config
+    loss = None
+    for _ in range(4):
+        ids = paddle.to_tensor(
+            rs.randint(0, cfg.vocab_size, (8, 32)).astype("int64"))
+        labels = paddle.to_tensor(
+            rs.randint(0, cfg.vocab_size, (8, 32)).astype("int64"))
+        loss = step(ids, labels)
+    assert np.isfinite(float(loss)), "partitioner train step diverged"
+
+    findings = analysis.audit_compiled(step, loc="spmd/partitioner_step")
+    cov = [f for f in findings if f.detector == "spmd-coverage"
+           and "coverage ok" in f.message]
+    if not cov:
+        findings.append(analysis.Finding(
+            "spmd-smoke", "error", "spmd/partitioner_step",
+            f"the partitioner-driven {mc.describe()} step lost full D9 "
+            "mesh-axis stream coverage — the declarative config no "
+            "longer shards what it claims"))
+    findings += step.plan.to_findings(loc="spmd/partitioner_plan")
+    findings.append(analysis.Finding(
+        "spmd-smoke", "note", "spmd/partitioner_step",
+        f"declarative {mc.describe()} config sharded the unmodified "
+        f"tiny-LLaMA train step: {step.plan.summary()}",
+        data=step.plan.summary()))
+
+    # fire fixture: REPLICATED_RULES through the same code path must
+    # trip the D9 unsharded-stream warning
+    paddle.set_flags({"FLAGS_partitioner_heuristics": False})
+    try:
+        _m, dead = build(MeshConfig(data=2, tp=2, rules=REPLICATED_RULES,
+                                    batch_axes=(),
+                                    stream_seq_axis="data"))
+        for _ in range(4):
+            ids = paddle.to_tensor(
+                rs.randint(0, cfg.vocab_size, (8, 32)).astype("int64"))
+            labels = paddle.to_tensor(
+                rs.randint(0, cfg.vocab_size, (8, 32)).astype("int64"))
+            dead(ids, labels)
+        fired = [f for f in analysis.audit_compiled(
+                     dead, loc="spmd/partitioner-fire")
+                 if f.detector == "spmd-coverage"
+                 and f.severity == "warning"]
+    finally:
+        paddle.set_flags({"FLAGS_partitioner_heuristics": True})
+    if fired:
+        findings.append(analysis.Finding(
+            "spmd-smoke", "note", "spmd/fire-fixtures",
+            "D9 spmd-coverage (all-replicated partitioner rules): fire "
+            f"fixture produced {len(fired)} unsuppressed warning(s) — "
+            "the detector gates the partitioner path",
+            data={"warnings": len(fired)}))
+    else:
+        findings.append(analysis.Finding(
+            "spmd-smoke", "error", "spmd/fire-fixtures",
+            "D9 spmd-coverage (all-replicated partitioner rules): the "
+            "fire fixture produced NO warning — the detector went "
+            "silently dead for partitioner-driven programs"))
     return findings
 
 
