@@ -92,6 +92,12 @@ Concurrency auditor (round 17, concurrency.py + core/lockdep.py):
                          core.lockdep.ThreadContract
                          (FLAGS_debug_thread_checks) plus statically
                          visible contract-method calls from thread roots
+  D16 audit_spec_decode  speculative decoding health: greedy parity
+                         oracle mismatch vs the non-speculative engine
+                         = error; acceptance rate collapsing below
+                         FLAGS_spec_min_accept on a warmed engine =
+                         warning (verify windows burn K+1-wide passes
+                         for ~1 token — slower than not speculating)
 """
 from .ast_lint import (audit_flags_doc, lint_dy2static, lint_file,
                        lint_tree, lint_vjp_saves, lint_x64)
@@ -105,7 +111,7 @@ from .jaxpr_audit import (audit_callbacks, audit_compiled,
                           audit_donation, audit_dtype_stream,
                           audit_fusion_misses, audit_host_sync,
                           infer_stream_shapes, iter_eqns, iter_jaxprs)
-from .serving import audit_prefix_cache
+from .serving import audit_prefix_cache, audit_spec_decode
 from .spmd import (audit_collectives, audit_sharding_coverage, audit_spmd,
                    audit_transfers, jaxpr_collective_bytes)
 from .vmem import (audit_decode_config, audit_norm_config,
@@ -147,8 +153,8 @@ def audit_train_steps(recorder=None, ledger=None, data_wait_ms=None,
 
 
 __all__ = [
-    "audit_recompiles", "audit_prefix_cache", "audit_cost_regressions",
-    "audit_train_steps",
+    "audit_recompiles", "audit_prefix_cache", "audit_spec_decode",
+    "audit_cost_regressions", "audit_train_steps",
     "Finding", "apply_baseline", "format_text", "gate_failures",
     "load_baseline", "stale_suppressions", "to_json",
     "ProgramIndex", "build_index",

@@ -56,3 +56,68 @@ def audit_prefix_cache(engine, loc: str = "serving/prefix-cache") -> list:
         f"cached, {pc.evictions} evicted",
         {"hits": hits, "misses": misses, "hit_rate": rate,
          "cached_blocks": pc.cached_blocks, "evictions": pc.evictions})]
+
+
+def audit_spec_decode(engine, parity: bool | None = None,
+                      loc: str = "serving/spec-decode",
+                      min_accept: float | None = None) -> list:
+    """D16 over a live/drained ServingEngine running speculative decode.
+
+    Speculative decoding fails in two silent modes. A CORRECTNESS bug
+    (verify program scoring the wrong positions, rollback advancing
+    kv_len past the accepted prefix, accept rule off-by-one) changes
+    emitted tokens — the caller runs the greedy parity oracle (same
+    prompts through a non-speculative engine) and passes the verdict as
+    ``parity``; a mismatch is an ERROR. A PERFORMANCE bug (proposer
+    degenerating, draft state desyncing from the target) keeps outputs
+    correct while acceptance collapses, so every verify window burns a
+    K+1-wide pass to emit one token — decode gets SLOWER than the
+    non-speculative baseline, and no test fails. On a warmed engine
+    that ran verify windows, overall acceptance below ``min_accept``
+    (default FLAGS_spec_min_accept) is a warning."""
+    stats = engine.spec_stats()
+    if not stats["enabled"]:
+        return [Finding(
+            "spec-decode", "note", loc,
+            "speculative decoding disabled (FLAGS_spec_decode=off) — "
+            "decode pays one full weight+KV sweep per token; repetitive "
+            "or draftable streams leave the acceptance multiplier on "
+            "the table")]
+    if parity is False:
+        return [Finding(
+            "spec-decode", "error", loc,
+            "greedy parity oracle FAILED: the speculative engine emitted "
+            "different tokens than the non-speculative engine on the "
+            "same greedy stream — the verify program, accept rule, or "
+            "kv_len rollback is corrupting the output distribution",
+            dict(stats))]
+    if stats["windows"] == 0:
+        return [Finding(
+            "spec-decode", "note", loc,
+            "speculative decoding enabled but no verify windows ran "
+            "(proposer never produced candidates, or the engine only "
+            "prefilled) — acceptance health not measurable",
+            dict(stats))]
+    if min_accept is None:
+        from ..core.flags import flag
+        min_accept = float(flag("FLAGS_spec_min_accept"))
+    rate = stats["accept_rate"]
+    if getattr(engine, "warmed", False) and rate < min_accept:
+        return [Finding(
+            "spec-decode", "warning", loc,
+            f"acceptance collapsed: {stats['accepted_tokens']}/"
+            f"{stats['proposed_tokens']} proposed tokens accepted "
+            f"({rate:.0%}) across {stats['windows']} verify windows on a "
+            f"warmed engine, below the {min_accept:.0%} floor "
+            "(FLAGS_spec_min_accept) — every window burns a K+1-wide "
+            "verify pass to emit ~1 token, so speculative decode is "
+            "SLOWING this stream down; fix or disable the proposer",
+            {**stats, "min_accept": min_accept})]
+    extra = " (greedy parity oracle passed)" if parity else ""
+    return [Finding(
+        "spec-decode", "note", loc,
+        f"speculative decode healthy: {stats['accepted_tokens']}/"
+        f"{stats['proposed_tokens']} proposed tokens accepted "
+        f"({rate:.0%}) across {stats['windows']} verify windows at "
+        f"K={stats['k']}{extra}",
+        dict(stats))]

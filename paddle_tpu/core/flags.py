@@ -355,6 +355,26 @@ define_flag("FLAGS_partitioner_fsdp_min_size", 1024,
             "parameters with fewer elements than this stay replicated "
             "instead of ZeRO-3 fsdp-sharded (tiny tensors pay the "
             "per-use all-gather latency without meaningful HBM savings)")
+define_flag("FLAGS_spec_decode", "off",
+            "speculative decoding on the paged serving engine "
+            "(inference/speculative.py): off | ngram (model-free "
+            "prompt-lookup proposer — the tail of prompt+generation is "
+            "matched against earlier history and the continuation "
+            "proposed) | draft (a small draft model proposes; pass it "
+            "via SpecConfig(draft_model=...)). Proposed tokens are "
+            "verified K+1 at a time in ONE batched paged-attention "
+            "pass; greedy outputs stay token-identical to the "
+            "non-speculative engine")
+define_flag("FLAGS_spec_k", 4,
+            "speculation depth: tokens proposed per verify window "
+            "(each window scores K+1 candidate positions in one pass "
+            "and emits 1..K+1 tokens depending on acceptance)")
+define_flag("FLAGS_spec_min_accept", 0.1,
+            "D16 audit_spec_decode acceptance floor: a WARMED engine "
+            "whose overall speculative acceptance rate falls below "
+            "this fraction is burning verify FLOPs for no goodput — "
+            "lint warning (graft_lint `paged` smoke fire-fixture "
+            "self-tests the detector)")
 define_flag("FLAGS_debug_thread_checks", False,
             "owner-thread contract assertions on the deliberately "
             "single-threaded serving objects (ServingEngine, "

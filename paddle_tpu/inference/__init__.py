@@ -2,7 +2,9 @@ from .engine import Request, ServingEngine, generate_paged
 from .predictor import (Config, PrecisionType, Predictor,
                         ServingPredictor, Tensor as InferTensor,
                         create_predictor, create_serving_predictor)
+from .speculative import NgramProposer, Proposer, SpecConfig, propose_ngram
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "InferTensor", "ServingEngine", "ServingPredictor", "Request",
-           "create_serving_predictor", "generate_paged"]
+           "create_serving_predictor", "generate_paged",
+           "SpecConfig", "Proposer", "NgramProposer", "propose_ngram"]

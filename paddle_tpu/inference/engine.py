@@ -78,14 +78,15 @@ from ..text.paged_cache import (TRASH_BLOCK, BlockAllocator, PagedKVCache,
 
 # ------------------------------------------------------ batched sampling
 
-def _sample_batched(logits, key, do_sample, temperature, top_k, top_p):
-    """Per-slot (greedy | temperature/top-k/top-p) sampling over [B, V]
-    logits with the sampling params as BATCHED arrays — one program serves
-    mixed per-request configs. Greedy rows are exact argmax (token-parity
-    with text/generation._sample_token); top-k is applied before top-p in
-    the same order as the single-program engine."""
+def _filter_logits(logits, temperature, top_k, top_p):
+    """The (temperature, top-k, top-p) logit filter over [B, V] with the
+    sampling params as BATCHED arrays — top-k before top-p, same order
+    as the single-program engine. Categorical over the result IS the
+    request's sampling distribution, which is exactly what speculative
+    verification needs per candidate position (accept with prob p(x),
+    resample from the residual), so the filter is shared between
+    _sample_batched and _verify_tokens — the two can never drift."""
     v = logits.shape[-1]
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     lg = logits.astype(jnp.float32) / jnp.maximum(temperature,
                                                   1e-6)[:, None]
     srt = jnp.sort(lg, axis=-1)[:, ::-1]
@@ -98,7 +99,16 @@ def _sample_batched(logits, key, do_sample, temperature, top_k, top_p):
     keep = cum - probs < top_p[:, None]
     cutoff = jnp.min(jnp.where(keep, srt2, jnp.inf), axis=-1,
                      keepdims=True)
-    lg = jnp.where((top_p < 1.0)[:, None] & (lg < cutoff), -jnp.inf, lg)
+    return jnp.where((top_p < 1.0)[:, None] & (lg < cutoff), -jnp.inf, lg)
+
+
+def _sample_batched(logits, key, do_sample, temperature, top_k, top_p):
+    """Per-slot (greedy | temperature/top-k/top-p) sampling over [B, V]
+    logits with the sampling params as BATCHED arrays — one program serves
+    mixed per-request configs. Greedy rows are exact argmax (token-parity
+    with text/generation._sample_token)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lg = _filter_logits(logits, temperature, top_k, top_p)
     sampled = jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
     return jnp.where(do_sample, sampled, greedy)
 
@@ -379,6 +389,168 @@ def _chunk_prefill_impl(spec: _GenSpec, block_size: int, quantized: bool,
     return tok, kc, vc, ksc, vsc, key
 
 
+def _verify_tokens(lg, proposed, samp, key, any_sample):
+    """Speculative accept/emit over the verify program's [B, C, V]
+    logits (C = K+1 candidate positions; `proposed` [B, K] = candidates
+    1..K). Greedy rows accept while each proposal matches the verifier's
+    own argmax (accept-longest-prefix — token parity with the
+    non-speculative engine by construction). Sampling rows run
+    Leviathan-style rejection sampling against the row's FILTERED
+    distribution p (the draft proposes deterministically, a point-mass
+    q): accept x with probability p(x); a rejection resamples from the
+    residual normalize(max(p - q, 0)) = p with x zeroed; position K's
+    draw is the all-accepted bonus token. The emitted marginal is
+    exactly p at every position. Returns (acc [B, K] bool, tgt [B, C]
+    int32, key): tgt[:, j] is the token to emit when acceptance stops
+    at position j (correction for j < K, bonus at K)."""
+    b, c, v = lg.shape
+    kk = c - 1
+    greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)       # [B, C]
+    acc = proposed == greedy[:, :kk]
+    if not any_sample:
+        return acc, greedy, key
+    flat = lg.reshape(b * c, v)
+    filt = _filter_logits(flat, jnp.repeat(samp["temperature"], c),
+                          jnp.repeat(samp["top_k"], c),
+                          jnp.repeat(samp["top_p"], c)).reshape(b, c, v)
+    probs = jax.nn.softmax(filt, axis=-1)
+    key, k_acc, k_res, k_bonus = jax.random.split(key, 4)
+    u = jax.random.uniform(k_acc, (b, kk))
+    p_prop = jnp.take_along_axis(probs[:, :kk], proposed[..., None],
+                                 axis=-1)[..., 0]
+    acc_s = u < p_prop    # p(x)=1 always accepts: the residual is empty
+    res = jnp.where(jax.nn.one_hot(proposed, v, dtype=bool), -jnp.inf,
+                    filt[:, :kk])
+    resample = jax.random.categorical(
+        k_res, res.reshape(b * kk, v), axis=-1).reshape(b, kk)
+    bonus = jax.random.categorical(k_bonus, filt[:, kk], axis=-1)
+    tgt_s = jnp.concatenate([resample, bonus[:, None]],
+                            axis=1).astype(jnp.int32)
+    ds = samp["do_sample"][:, None]
+    return (jnp.where(ds, acc_s, acc), jnp.where(ds, tgt_s, greedy), key)
+
+
+def _spec_verify_impl(spec: _GenSpec, block_size: int, quantized: bool,
+                      any_sample: bool, params, toks, pos, tables, limit,
+                      kc, vc, ksc, vsc, samp, key):
+    """Score C = K+1 candidate positions per slot in ONE paged-attention
+    pass — the verify half of speculative decoding, costing the same
+    weight sweep as a single decode tick. toks[:, 0] is each slot's last
+    emitted (not yet consumed) token, toks[:, 1:] its K proposals; row b
+    writes candidate K/V at positions pos[b] + [0, C) through its block
+    table (positions >= limit[b], the slot's allocated-token watermark,
+    route to the trash block — candidates past the block budget are
+    never emitted, their garbage context never feeds an emitted token)
+    and attends each candidate over `kv_pos <= q_pos`. Scores stay the
+    chunk program's rank-4 multi-query-over-pages shape, NOT the rank-3
+    seq-1 shape D4's decode anchor matches. Rollback of rejected
+    candidates is the host simply not advancing kv_len past the
+    accepted prefix: the cache's stale-data contract (reads bounded by
+    length masks, appends overwrite before the mask exposes a slot)
+    makes leftover K/V unreachable, and the next window's writes at the
+    same positions are idempotent re-derivations. The accept/emit split
+    lives in _verify_tokens; this returns (acc [B, K], tgt [B, C],
+    caches..., key)."""
+    gpt = spec.arch == "gpt"
+    b, c = toks.shape
+    dtype = params["embed"].dtype
+    qpos = pos[:, None] + jnp.arange(c)[None, :]          # [B, C]
+    x = params["embed"][toks].astype(dtype)               # [B, C, H]
+    if gpt:
+        x = x + params["wpe"][jnp.clip(qpos, 0,
+                                       params["wpe"].shape[0] - 1)]
+        cos = sin = None
+    else:
+        ps = jnp.clip(qpos, 0, params["rope_cos"].shape[0] - 1)
+        cos = params["rope_cos"][ps][:, :, None]          # [B, C, 1, D]
+        sin = params["rope_sin"][ps][:, :, None]
+    rep = spec.num_heads // spec.num_kv_heads
+    inv_scale = 1.0 / math.sqrt(spec.head_dim)
+    pages = tables.shape[1]
+    end = jnp.minimum(pos + c, limit)
+    kv_pos = jnp.arange(pages * block_size)
+    q_mask = kv_pos[None, None, :] <= qpos[:, :, None]    # [B, C, T]
+    nh, nkv, hd = spec.num_heads, spec.num_kv_heads, spec.head_dim
+
+    def layer(xc, per_layer):
+        if quantized:
+            lw, kcl, vcl, kscl, vscl = per_layer
+        else:
+            lw, kcl, vcl = per_layer
+            kscl = vscl = None
+        if gpt:
+            hn = _layer_norm(xc, lw["ln1_w"], lw["ln1_b"], spec.rms_eps)
+            qkv = (hn.reshape(b * c, -1) @ lw["qkv"]).reshape(
+                b, c, 3, nh, hd)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        else:
+            hn = _rms_norm(xc, lw["input_ln"],
+                           spec.rms_eps).reshape(b * c, -1)
+            q = _mm(hn, lw["q"]).reshape(b, c, nh, hd)
+            k = _mm(hn, lw["k"]).reshape(b, c, nkv, hd)
+            v = _mm(hn, lw["v"]).reshape(b, c, nkv, hd)
+            q = _rope(q, cos, sin)
+            k = _rope(k, cos, sin)
+        # per-row window scatter: the slot bucket is small, so the
+        # unrolled loop reuses the chunk programs' token-granular
+        # scatter (+ its int8 self-healing requantization) unchanged
+        for bi in range(b):
+            if quantized:
+                kcl, kscl = scatter_chunk_int8(
+                    kcl, kscl, k[bi], pos[bi], end[bi], tables[bi],
+                    block_size)
+                vcl, vscl = scatter_chunk_int8(
+                    vcl, vscl, v[bi], pos[bi], end[bi], tables[bi],
+                    block_size)
+            else:
+                kcl = scatter_chunk(kcl, k[bi], pos[bi], end[bi],
+                                    tables[bi], block_size)
+                vcl = scatter_chunk(vcl, v[bi], pos[bi], end[bi],
+                                    tables[bi], block_size)
+        kx = jax.vmap(
+            lambda tr: gather_context(kcl, kscl, tr, pages))(tables)
+        vx = jax.vmap(
+            lambda tr: gather_context(vcl, vscl, tr, pages))(tables)
+        kx = _repeat_kv(kx.astype(q.dtype), rep, 2)       # [B, T, Hq, D]
+        vx = _repeat_kv(vx.astype(q.dtype), rep, 2)
+        scores = jnp.einsum("bchd,bthd->bhct", q, kx) * inv_scale
+        scores = jnp.where(q_mask[:, None], scores,
+                           jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32),
+                               axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhct,bthd->bchd", probs, vx)
+        attn = out.reshape(b, c, nh * hd)
+        if gpt:
+            xo = xc + (attn.reshape(b * c, -1) @ lw["o"]).reshape(
+                b, c, -1)
+            hn2 = _layer_norm(xo, lw["ln2_w"], lw["ln2_b"], spec.rms_eps)
+            xo = xo + (jax.nn.gelu(hn2.reshape(b * c, -1) @ lw["fc_in"],
+                                   approximate=False)
+                       @ lw["fc_out"]).reshape(b, c, -1)
+        else:
+            xo = xc + _mm(attn.reshape(b * c, -1),
+                          lw["o"]).reshape(b, c, -1)
+            hn2 = _rms_norm(xo, lw["post_ln"],
+                            spec.rms_eps).reshape(b * c, -1)
+            xo = xo + _mm(jax.nn.silu(_mm(hn2, lw["gate"]))
+                          * _mm(hn2, lw["up"]),
+                          lw["down"]).reshape(b, c, -1)
+        ys = (kcl, vcl, kscl, vscl) if quantized else (kcl, vcl)
+        return xo, ys
+
+    xs = (params["layers"], kc, vc) + ((ksc, vsc) if quantized else ())
+    x, ys = jax.lax.scan(layer, x, xs)
+    if quantized:
+        kc, vc, ksc, vsc = ys
+    else:
+        kc, vc = ys
+    lg = _logits(x.reshape(b * c, -1), params, spec).reshape(
+        b, c, -1)                                          # [B, C, V] f32
+    acc, tgt, key = _verify_tokens(lg, toks[:, 1:], samp, key,
+                                   any_sample)
+    return acc, tgt, kc, vc, ksc, vsc, key
+
+
 _decode_step = functools.partial(
     jax.jit, static_argnums=(0, 1, 2, 3),
     donate_argnums=(8, 9, 10, 11))(_decode_step_impl)
@@ -388,6 +560,9 @@ _prefill_step = functools.partial(
 _chunk_prefill_step = functools.partial(
     jax.jit, static_argnums=(0, 1, 2, 3, 4, 5),
     donate_argnums=(14, 15, 16, 17))(_chunk_prefill_impl)
+_spec_verify_step = functools.partial(
+    jax.jit, static_argnums=(0, 1, 2, 3),
+    donate_argnums=(9, 10, 11, 12))(_spec_verify_impl)
 
 
 # ------------------------------------------------------------ scheduler
@@ -428,10 +603,11 @@ class Request:
                  "tokens", "arrival_s", "admitted_s", "first_token_s",
                  "finished", "max_time_ms", "deadline_s", "finish_reason",
                  "cached_len", "prefill_pos", "prefill_done",
-                 "_hashes", "_hash_ns", "_flight")
+                 "speculative", "_hashes", "_hash_ns", "_flight")
 
     def __init__(self, rid, prompt, max_new_tokens, do_sample, temperature,
-                 top_k, top_p, eos_token_id, max_time_ms=None):
+                 top_k, top_p, eos_token_id, max_time_ms=None,
+                 speculative=None):
         self.rid = rid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -453,6 +629,9 @@ class Request:
         self.deadline_s = None if max_time_ms is None \
             else self.arrival_s + float(max_time_ms) / 1e3
         self.finish_reason = None   # "eos" | "length" | "timeout"
+        # per-request speculative opt-out (round 18): None follows the
+        # engine config; False decodes normally even on a spec engine
+        self.speculative = speculative
         # prefix-cache / chunked-prefill progress (set at admission):
         # positions [0, cached_len) are served from cached blocks, the
         # suffix [cached_len, prompt) is computed chunk by chunk —
@@ -524,7 +703,7 @@ class ServingEngine:
                  num_kv_blocks=None, kv_cache_dtype=None,
                  max_model_len=None, seed=0, admission="continuous",
                  prefix_cache=None, chunked_prefill_tokens=None,
-                 prefix_cache_max_blocks=None):
+                 prefix_cache_max_blocks=None, spec_decode=None):
         from ..core.flags import flag
 
         cfg = model.config
@@ -649,8 +828,11 @@ class ServingEngine:
             "serving_decode_step_seconds", "one decode tick (all active "
             "slots advance one token)")
         self._m_tpot = reg.histogram(
-            "serving_tpot_seconds", "time per output token: decode tick "
-            "wall / active slots")
+            "serving_tpot_seconds", "time per output token, observed "
+            "ONCE PER EMITTED TOKEN: tick wall / tokens the tick "
+            "emitted (a speculative verify window divides by its "
+            "accepted count — multi-token ticks report real TPOT, not "
+            "a fake per-tick win)")
         self._m_decode_tokens = reg.counter(
             "serving_decode_tokens_total", "tokens emitted by decode ticks")
         self._m_prefill_tokens = reg.counter(
@@ -707,6 +889,40 @@ class ServingEngine:
         self._m_flight_requests = reg.gauge(
             "serving_flight_requests", "request timelines held in the "
             "flight-recorder ring (active + finished)")
+        # ---- speculative decoding (round 18): metrics exist whether or
+        # not the engine speculates — the catalog contract is
+        # unconditional, a non-spec engine just never observes them
+        self._m_spec_windows = reg.counter(
+            "serving_spec_windows_total", "speculative verify windows "
+            "executed (one K+1-candidate batched scoring pass per "
+            "speculating slot per tick)")
+        self._m_spec_proposed = reg.counter(
+            "serving_spec_proposed_tokens_total", "draft tokens proposed "
+            "into verify windows")
+        self._m_spec_accepted = reg.counter(
+            "serving_spec_accepted_tokens_total", "proposed tokens the "
+            "verify oracle accepted (emitted without their own decode "
+            "tick — the speculative goodput)")
+        self._m_spec_accept_rate = reg.histogram(
+            "serving_spec_accept_rate", "per-window acceptance fraction "
+            "(accepted / proposed)")
+        self._m_spec_emitted = reg.histogram(
+            "serving_spec_accepted_per_window", "tokens emitted per "
+            "verify window: accepted prefix + the correction/bonus "
+            "token (1..K+1)")
+        # config: explicit arg wins; the FLAGS_spec_decode string is the
+        # flag-surface shorthand ("off" | "ngram" | "draft")
+        from .speculative import SpecConfig, make_proposer
+
+        if spec_decode is None:
+            m = str(flag("FLAGS_spec_decode"))
+            spec_decode = None if m == "off" else SpecConfig(method=m)
+        elif isinstance(spec_decode, str):
+            spec_decode = None if spec_decode == "off" \
+                else SpecConfig(method=spec_decode)
+        self.spec_config = spec_decode
+        self.proposer = (make_proposer(spec_decode)
+                         if spec_decode is not None else None)
         reg.gauge("serving_slots", "engine slot count").set(self.max_slots)
         reg.gauge("serving_kv_pool_blocks",
                   "total KV blocks (incl. trash)").set(
@@ -766,13 +982,17 @@ class ServingEngine:
     # ------------------------------------------------------------- API
     def add_request(self, prompt, max_new_tokens=32, do_sample=False,
                     temperature=1.0, top_k=0, top_p=1.0,
-                    eos_token_id=None, max_time_ms=None) -> int:
+                    eos_token_id=None, max_time_ms=None,
+                    speculative=None) -> int:
         """Queue a request. Raises when it could NEVER be served (context
         or pool too small); otherwise it waits for admission.
         `max_time_ms` is a per-request wall-clock deadline from arrival:
         when it expires the request finishes with reason ``"timeout"``
         (whatever tokens it produced so far are its result) and its
-        blocks return to the free list."""
+        blocks return to the free list. `speculative=False` opts this
+        request out of speculative decoding on a spec-enabled engine
+        (it decodes one token per tick, coexisting with speculating
+        slots in the same tick); None follows the engine config."""
         self.contract.check("add_request")
         prompt = np.asarray(
             prompt._data if hasattr(prompt, "_data") else prompt,
@@ -801,7 +1021,8 @@ class ServingEngine:
         rid = self._next_id
         self._next_id += 1
         req = Request(rid, prompt, max_new_tokens, do_sample, temperature,
-                      top_k, top_p, eos_token_id, max_time_ms=max_time_ms)
+                      top_k, top_p, eos_token_id, max_time_ms=max_time_ms,
+                      speculative=speculative)
         req._flight = self.flight.begin(rid, prompt.size,
                                         int(max_new_tokens),
                                         req.arrival_s)
@@ -845,9 +1066,22 @@ class ServingEngine:
         active = [i for i, r in enumerate(self._slot_req)
                   if r is not None and r.prefill_done]
         if active:
-            emitted.extend(self._decode(active))
+            # partition: speculating slots ride the verify window, the
+            # rest (opt-outs, empty proposals, non-spec engine) take the
+            # ordinary one-token decode — both in the same tick
+            spec_slots, props = self._spec_proposals(active)
+            if spec_slots:
+                in_spec = set(spec_slots)
+                plain = [i for i in active if i not in in_spec]
+            else:
+                plain = active
+            if plain:
+                emitted.extend(self._decode(plain))
+            if spec_slots:
+                emitted.extend(self._spec_decode(spec_slots, props))
             self.steps += 1
             self.active_slot_steps += len(active)
+            self._m_active.set(len(active))
         return emitted
 
     def run(self, max_steps=100000):
@@ -888,7 +1122,26 @@ class ServingEngine:
                 "prefix_blocks_missed": int(self._m_prefix_miss.value),
                 "prefix_cached_blocks": self.prefix_cache.cached_blocks,
                 "prefix_evictions": self.prefix_cache.evictions,
-                "prefill_chunks": int(self._m_chunks.value)}
+                "prefill_chunks": int(self._m_chunks.value),
+                # round 18: speculative decoding
+                "spec_windows": int(self._m_spec_windows.value),
+                "spec_proposed_tokens": int(self._m_spec_proposed.value),
+                "spec_accepted_tokens": int(self._m_spec_accepted.value),
+                "spec_accept_rate": round(
+                    int(self._m_spec_accepted.value)
+                    / max(int(self._m_spec_proposed.value), 1), 4)}
+
+    def spec_stats(self) -> dict:
+        """Speculative-decoding summary for D16 (audit_spec_decode):
+        overall acceptance across every verify window this engine ran."""
+        proposed = int(self._m_spec_proposed.value)
+        return {"enabled": self.proposer is not None,
+                "k": int(getattr(self.proposer, "k", 0) or 0),
+                "windows": int(self._m_spec_windows.value),
+                "proposed_tokens": proposed,
+                "accepted_tokens": int(self._m_spec_accepted.value),
+                "accept_rate": (int(self._m_spec_accepted.value)
+                                / proposed if proposed else 0.0)}
 
     def metrics(self) -> dict:
         """Registry snapshot (counters/gauges + histogram quantiles) —
@@ -1385,8 +1638,12 @@ class ServingEngine:
                               active=len(active), bucket=int(bucket),
                               program=entry.program)
         self._m_decode_step.observe(step_wall)
-        self._m_tpot.observe(step_wall / len(active))
-        self._m_active.set(len(active))
+        # TPOT is a PER-TOKEN distribution: one observation per emitted
+        # token (count == tokens, sum == tick wall), so mixed spec /
+        # non-spec streams aggregate correctly
+        tpot = step_wall / len(active)
+        for _ in active:
+            self._m_tpot.observe(tpot)
         emitted = []
         for j, slot in enumerate(active):
             req = self._slot_req[slot]
@@ -1401,6 +1658,130 @@ class ServingEngine:
             if done:
                 self._finish(slot)
         self._m_decode_tokens.inc(len(active))
+        return emitted
+
+    def _spec_proposals(self, active):
+        """Ask the proposer for candidate continuations of every
+        opted-in active slot. Returns (spec_slots, proposals) — only
+        slots with a NON-EMPTY proposal speculate this tick; the rest
+        fall back to the ordinary decode (an n-gram miss costs
+        nothing, it just decodes normally)."""
+        if self.proposer is None:
+            return [], []
+        cand = [i for i in active
+                if self._slot_req[i].speculative is not False]
+        if not cand:
+            return [], []
+        reqs = [self._slot_req[i] for i in cand]
+        props = self.proposer.proposals(self, cand, reqs)
+        spec_slots, out = [], []
+        for slot, p in zip(cand, props):
+            p = np.asarray(p, np.int64).reshape(-1)
+            if p.size:
+                spec_slots.append(slot)
+                out.append(p)
+        return spec_slots, out
+
+    def _spec_decode(self, slots, proposals):
+        """One verify window for every speculating slot: score each
+        slot's K+1 candidate positions in ONE batched paged-attention
+        pass, then emit its accepted prefix + the correction/bonus
+        token. Rollback is pure bookkeeping — `_slot_pos` only advances
+        past what was emitted, so rejected candidates' K/V is stale
+        data the length masks never expose and the next window
+        overwrites. eos/length finish honors mid-window acceptance
+        (tokens after an accepted eos are dropped), and the per-request
+        deadline path is untouched (_expire runs at tick start)."""
+        from ..jit.api import default_buckets
+
+        t0 = time.perf_counter()
+        k = self.proposer.k
+        width = k + 1
+        bucket = min(default_buckets(len(slots)), self.max_slots)
+        reqs = [self._slot_req[i] for i in slots]
+        pad = bucket - len(slots)
+        toks = np.zeros((bucket, width), np.int32)
+        limit = np.zeros(bucket, np.int32)
+        for j, (slot, req, prop) in enumerate(zip(slots, reqs,
+                                                  proposals)):
+            toks[j, 0] = req.tokens[-1]
+            n = min(len(prop), k)
+            toks[j, 1:1 + n] = prop[:n]
+            if n < k:    # short proposal: pad by repeating (auto-reject)
+                toks[j, 1 + n:] = toks[j, n]
+            limit[j] = len(self._slot_blocks[slot]) * self.block_size
+        pos = np.concatenate([self._slot_pos[slots],
+                              np.zeros(pad, np.int64)]).astype(np.int32)
+        tables = np.concatenate(
+            [self._tables[slots],
+             np.full((pad, self.pages), TRASH_BLOCK, np.int32)])
+        samp = self._samp_arrays(reqs, pad)
+        any_sample = any(r.do_sample for r in reqs)
+        c = self.cache
+        args = (self.spec, self.block_size, self.quantized, any_sample,
+                self.params, jnp.asarray(toks), jnp.asarray(pos),
+                jnp.asarray(tables), jnp.asarray(limit), c.k, c.v,
+                c.k_scale, c.v_scale, samp, self._key)
+        prog, entry = self._program("serving.spec_verify",
+                                    _spec_verify_step, 4, bucket,
+                                    any_sample, (k,), args)
+        t_run = time.perf_counter()
+        out = prog(*args[4:])
+        acc, tgt, ck, cv, cks, cvs, self._key = out
+        c.swap(ck, cv, cks, cvs)
+        acc = np.asarray(jax.device_get(acc))
+        tgt = np.asarray(jax.device_get(tgt))
+        t_end = time.perf_counter()
+        step_wall = t_end - t0
+        entry.observe(t_end - t_run)
+        self._m_decode_step.observe(step_wall)
+        emitted = []
+        n_windows = len(slots)
+        n_tokens = 0
+        n_accepted = 0
+        for j, slot in enumerate(slots):
+            req = self._slot_req[slot]
+            prop = proposals[j]
+            plen = min(len(prop), k)
+            a = 0
+            while a < plen and acc[j, a]:
+                a += 1
+            new = [int(t) for t in prop[:a]] + [int(tgt[j, a])]
+            new = new[: req.max_new_tokens - len(req.tokens)]
+            if req.eos_token_id >= 0:
+                for i, t in enumerate(new):
+                    if t == req.eos_token_id:
+                        new = new[: i + 1]
+                        break
+            fl = req._flight
+            done = False
+            for t in new:
+                req.tokens.append(t)
+                done = self._check_done(req, t)
+            self._slot_pos[slot] += len(new)
+            fl.tokens += len(new)
+            fl.last_token_s = t_end
+            n_tokens += len(new)
+            n_accepted += a
+            self._m_spec_accept_rate.observe(a / plen if plen else 0.0)
+            self._m_spec_emitted.observe(len(new))
+            emitted.extend((req.rid, t, done and i == len(new) - 1)
+                           for i, t in enumerate(new))
+            if done:
+                self._finish(slot)
+        self._m_spec_windows.inc(n_windows)
+        self._m_spec_proposed.inc(sum(min(len(p), k)
+                                      for p in proposals))
+        self._m_spec_accepted.inc(n_accepted)
+        self._m_decode_tokens.inc(n_tokens)
+        tpot = step_wall / max(n_tokens, 1)
+        for _ in range(n_tokens):
+            self._m_tpot.observe(tpot)
+        self.flight.tick_span("verify_window", t_run, t_end,
+                              active=n_windows, k=int(k),
+                              accepted=int(n_accepted),
+                              emitted=int(n_tokens), bucket=int(bucket),
+                              program=entry.program)
         return emitted
 
     def _samp_arrays(self, reqs, pad=0):
@@ -1455,6 +1836,8 @@ class ServingEngine:
         self._slot_pos[slot] = 0
         self._tables[slot] = TRASH_BLOCK
         self._m_completed.inc()
+        if self.proposer is not None:
+            self.proposer.finish(slot)
         self._update_pool_gauges()
 
     # ------------------------------------------------------- introspection
@@ -1474,6 +1857,25 @@ class ServingEngine:
             self.params, jnp.zeros(bucket, jnp.int32),
             jnp.zeros(bucket, jnp.int32),
             jnp.full((bucket, self.pages), TRASH_BLOCK, jnp.int32),
+            c.k, c.v, c.k_scale, c.v_scale, samp, self._key)
+
+    def verify_program_jaxpr(self, bucket=2, k=4):
+        """The speculative verify program's jaxpr at a given (slot
+        bucket, K) — same D4/D5/dtype-stream audit surface as
+        decode_program_jaxpr, for the verify half of spec decoding."""
+        bucket = min(bucket, self.max_slots)
+        c = self.cache
+        samp = {"do_sample": jnp.zeros(bucket, bool),
+                "temperature": jnp.ones(bucket, jnp.float32),
+                "top_k": jnp.zeros(bucket, jnp.int32),
+                "top_p": jnp.ones(bucket, jnp.float32)}
+        fn = functools.partial(_spec_verify_impl, self.spec,
+                               self.block_size, self.quantized, False)
+        return jax.make_jaxpr(fn)(
+            self.params, jnp.zeros((bucket, int(k) + 1), jnp.int32),
+            jnp.zeros(bucket, jnp.int32),
+            jnp.full((bucket, self.pages), TRASH_BLOCK, jnp.int32),
+            jnp.zeros(bucket, jnp.int32),
             c.k, c.v, c.k_scale, c.v_scale, samp, self._key)
 
 

@@ -357,4 +357,5 @@ def validate_trace(obj_or_path) -> dict:
             tiled += 1
     return {"events": len(evs), "requests": requests,
             "tiled_requests": tiled,
-            "engine_spans": len(by_tid.get(0, {}).get("decode_tick", []))}
+            "engine_spans": len(by_tid.get(0, {}).get("decode_tick", [])),
+            "verify_spans": len(by_tid.get(0, {}).get("verify_window", []))}

@@ -470,7 +470,7 @@ def _extract_gpt(cfg, sd):
 def generate(model, input_ids, max_new_tokens=32, max_length=None,
              do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
              eos_token_id=None, seed=None, weight_quant="none",
-             engine="static", prefix_cache=None):
+             engine="static", prefix_cache=None, spec_decode=None):
     """Autoregressive generation with a static KV cache, greedy or sampled.
 
     Returns a Tensor [B, prompt_len + n_generated] (prompt included, like
@@ -483,7 +483,11 @@ def generate(model, input_ids, max_new_tokens=32, max_length=None,
     tokens, the serving route for streams of requests. `prefix_cache`
     overrides FLAGS_prefix_cache for the paged engine (shared prompt
     prefixes across the batch/stream reuse KV blocks; greedy tokens are
-    identical either way).
+    identical either way). `spec_decode` turns on speculative decoding
+    (inference/speculative.py): for engine="paged" it is forwarded to
+    the ServingEngine (string or SpecConfig); for engine="static" only
+    the greedy n-gram proposer is wired ("ngram" | SpecConfig) — tokens
+    stay identical to the non-speculative run either way.
     """
     from ..core.tensor import Tensor
 
@@ -545,11 +549,35 @@ def generate(model, input_ids, max_new_tokens=32, max_length=None,
                               top_k=int(top_k), top_p=float(top_p),
                               eos_token_id=eos_token_id,
                               seed=None if seed is None else int(seed),
-                              prefix_cache=prefix_cache)
+                              prefix_cache=prefix_cache,
+                              spec_decode=spec_decode)
         return _assemble_output(ids, toks, eos_token_id, Tensor)
     if prefix_cache is not None:
         raise ValueError("prefix_cache applies to engine='paged' only "
                          "(the static engine holds no block pool)")
+    if spec_decode not in (None, "off"):
+        if do_sample:
+            raise NotImplementedError(
+                "static-engine speculative decoding is greedy-only; "
+                "rejection sampling rides engine='paged'")
+        if weight_quant != "none":
+            raise NotImplementedError(
+                "static-engine speculative decoding runs unquantized "
+                "weights")
+        # deferred import: inference.speculative imports from this module
+        from ..inference.speculative import (SpecConfig,
+                                             generate_static_spec)
+
+        sc = spec_decode if isinstance(spec_decode, SpecConfig) \
+            else SpecConfig(method=str(spec_decode))
+        if sc.method != "ngram" or sc.proposer is not None:
+            raise NotImplementedError(
+                "the static engine wires the n-gram proposer only; "
+                "draft-model speculation rides engine='paged'")
+        toks = generate_static_spec(model, ids, mnt,
+                                    eos_token_id=eos_token_id, k=sc.k,
+                                    max_ngram=sc.max_ngram)
+        return _assemble_output(ids, toks, eos_token_id, Tensor)
     from ..jit.api import default_buckets
 
     s_true = ids.shape[1]

@@ -135,7 +135,7 @@ class GPTForCausalLM(nn.Layer):
     def generate(self, input_ids, max_new_tokens=32, max_length=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                  eos_token_id=None, seed=None, engine="static",
-                 prefix_cache=None):
+                 prefix_cache=None, spec_decode=None):
         """KV-cached decoding (see text/generation.py; gpt arch: LayerNorm
         + learned positions + fused-qkv pre-LN blocks). engine="static":
         one compiled XLA program; engine="paged": the continuous-batching
@@ -147,4 +147,5 @@ class GPTForCausalLM(nn.Layer):
                          max_length=max_length, do_sample=do_sample,
                          temperature=temperature, top_k=top_k, top_p=top_p,
                          eos_token_id=eos_token_id, seed=seed,
-                         engine=engine, prefix_cache=prefix_cache)
+                         engine=engine, prefix_cache=prefix_cache,
+                         spec_decode=spec_decode)

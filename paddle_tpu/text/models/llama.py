@@ -305,7 +305,7 @@ class LlamaForCausalLM(nn.Layer):
     def generate(self, input_ids, max_new_tokens=32, max_length=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                  eos_token_id=None, seed=None, weight_quant="none",
-                 engine="static", prefix_cache=None):
+                 engine="static", prefix_cache=None, spec_decode=None):
         """KV-cached autoregressive decoding — the role of the reference's
         fused decode-attention family + PaddleNLP generate. engine="static"
         (default): ONE compiled XLA program (prefill + lax.scan decode
@@ -321,7 +321,7 @@ class LlamaForCausalLM(nn.Layer):
                          temperature=temperature, top_k=top_k, top_p=top_p,
                          eos_token_id=eos_token_id, seed=seed,
                          weight_quant=weight_quant, engine=engine,
-                         prefix_cache=prefix_cache)
+                         prefix_cache=prefix_cache, spec_decode=spec_decode)
 
 
 class _PipeEmbed(nn.Layer):

@@ -441,7 +441,14 @@ def scatter_prefill_int8(cache, scale, ks, true_len, table_row,
 def scatter_chunk(cache, ks, start, true_end, table_row, block_size):
     """Write one chunk's K (or V) through the block table. ks [C, H_kv, D]
     holds positions [start, start + C); positions >= true_end route to
-    the trash block. cache is one layer's [num_blocks, H_kv, bs, D]."""
+    the trash block. cache is one layer's [num_blocks, H_kv, bs, D].
+
+    Speculative verify windows (round 16) reuse this scatter with
+    chunk = K+1 candidate tokens. Rollback of rejected candidates is
+    NOT an erase: the host simply does not advance the slot's kv_len
+    past the accepted prefix, so the stale-data contract above makes
+    the rejected K/V unreachable (length masks bound every read), and
+    the next window idempotently overwrites the same positions."""
     c = ks.shape[0]
     pos = start + jnp.arange(c)
     ok = pos < true_end

@@ -43,6 +43,11 @@ LOWER_IS_BETTER = {"ms", "us", "s", "seconds", "latency", "ttft", "tpot",
                    "wall", "bytes", "stall", "p50", "p95", "p99",
                    "blocking"}
 
+#: components that FORCE higher-is-better even next to a lower-better
+#: component (round 16: speculative acceptance rate — a metric like
+#: "accept" must trend up no matter how a rung spells its neighbors)
+HIGHER_IS_BETTER = {"accept", "goodput"}
+
 #: bookkeeping keys never trended (vary run-to-run by design)
 SKIP_KEYS = {"wall_s", "t", "rc", "platform", "note", "steps", "iters",
              "warmup", "batch", "seq_len", "obs"}
@@ -68,6 +73,8 @@ def _numeric_metrics(record: dict, prefix="") -> dict:
 def lower_is_better(name: str) -> bool:
     leaf = name.rsplit(".", 1)[-1].lower()
     parts = leaf.split("_")
+    if set(parts) & HIGHER_IS_BETTER:
+        return False
     if "per" in parts:
         # a rate: judged by its NUMERATOR — time/bytes per item
         # ("us_per_op", "ms_per_token_step", "bytes_per_step") is

@@ -262,6 +262,98 @@ def audit_serving() -> list:
         eng2, loc="paged/shared-prefix-smoke")
     evs = [e for e in obs.compile_events() if e.site.startswith("serving")]
     findings += obs.audit_recompiles(evs, loc="paged/shared-prefix-smoke")
+
+    # ---- speculative decode smoke (round 16): a 2-slot n-gram
+    # speculating engine warms every program the steady stream rides
+    # (spec-verify at buckets 1 and 2, plain decode for the mixed tick
+    # and the empty-proposal fallback), declares warmup done, then
+    # serves a repetitive-prompt request for ≥8 verify windows. Gates:
+    # (a) ZERO post-warmup compiles on the verify family, (b) the
+    # flight trace validates with verify-window spans covering the
+    # steady run, (c) D4-family audits are clean on the verify
+    # program's jaxpr, (d) the D16 greedy parity oracle vs a
+    # non-speculative A/B engine on the same prompt.
+    import tempfile
+
+    from paddle_tpu.inference.speculative import AlwaysRejectProposer, \
+        SpecConfig
+
+    obs.clear_events()
+    eng3 = ServingEngine(model, max_slots=2, spec_decode="ngram")
+    base = np.tile(rs.randint(0, 128, (4,)), 5)     # repetitive stream
+    eng3.add_request(base, max_new_tokens=6)        # spec bucket 1
+    eng3.run()
+    eng3.add_request(np.roll(base, 2), max_new_tokens=6)
+    eng3.add_request(base, max_new_tokens=6, speculative=False)
+    eng3.run()                                      # mixed spec/plain tick
+    eng3.add_request(base, max_new_tokens=6)
+    eng3.add_request(np.roll(base, 2), max_new_tokens=6)
+    eng3.run()                                      # spec bucket 2
+    eng3.finish_warmup()
+    rid_s = eng3.add_request(base, max_new_tokens=24)
+    out3 = eng3.run()
+    eng_ab = ServingEngine(model, max_slots=2)
+    rid_b = eng_ab.add_request(base, max_new_tokens=24)
+    out_ab = eng_ab.run()
+    parity = bool(np.array_equal(out3[rid_s], out_ab[rid_b]))
+    findings += analysis.audit_spec_decode(
+        eng3, parity=parity, loc="paged/spec-smoke")
+    evs = [e for e in obs.compile_events() if e.site.startswith("serving")]
+    findings += obs.audit_recompiles(evs, loc="paged/spec-smoke")
+
+    fd, tpath = tempfile.mkstemp(prefix="graft_lint_spec_trace_",
+                                 suffix=".json")
+    os.close(fd)
+    try:
+        eng3.dump_trace(tpath)
+        summary = obs.validate_trace(tpath)
+        if summary["verify_spans"] < 8:
+            findings.append(analysis.Finding(
+                "spec-decode", "error", "paged/spec-smoke",
+                "speculative smoke recorded fewer than 8 verify-window "
+                "spans — the engine is not actually speculating tick "
+                "over tick", data=dict(summary)))
+    except (AssertionError, ValueError) as e:
+        findings.append(analysis.Finding(
+            "spec-decode", "error", "paged/spec-smoke",
+            f"speculative trace dump failed validation: {e}"))
+    finally:
+        os.unlink(tpath)
+
+    jxv = eng3.verify_program_jaxpr()
+    findings += analysis.audit_fusion_misses(jxv, loc="paged/spec_verify")
+    findings += analysis.audit_callbacks(jxv, loc="paged/spec_verify")
+    findings += analysis.audit_dtype_stream(
+        jxv, policy=str(flag("FLAGS_residual_dtype")),
+        loc="paged/spec_verify")
+
+    # ---- D16 fire-fixture self-test: a proposer that NEVER matches the
+    # target must trip the acceptance-collapse warning on a warmed
+    # engine. The warning is consumed here (it is the fixture working,
+    # not a defect); a detector that stays silent is itself the gate
+    # failure.
+    eng4 = ServingEngine(
+        model, max_slots=2,
+        spec_decode=SpecConfig(proposer=AlwaysRejectProposer(4)))
+    eng4.add_request(base, max_new_tokens=6)
+    eng4.run()
+    eng4.finish_warmup()
+    eng4.add_request(np.roll(base, 1), max_new_tokens=6)
+    eng4.run()
+    fire = analysis.audit_spec_decode(eng4, loc="paged/spec-fire-fixture")
+    if any(f.detector == "spec-decode" and f.severity == "warning"
+           for f in fire):
+        findings.append(analysis.Finding(
+            "spec-decode", "note", "paged/spec-fire-fixture",
+            "D16 fire fixture verified: the always-reject proposer "
+            "tripped the acceptance-collapse warning",
+            data={"accept_rate": eng4.spec_stats()["accept_rate"]}))
+    else:
+        findings.append(analysis.Finding(
+            "spec-decode", "error", "paged/spec-fire-fixture",
+            "D16 detector is SILENTLY DEAD: a warmed engine driven by "
+            "an always-reject proposer produced no acceptance-collapse "
+            "warning", data={"findings": [f.to_dict() for f in fire]}))
     return findings
 
 
@@ -283,7 +375,12 @@ REQUIRED_SERVING_METRICS = (
     "serving_prefix_cache_evictions_total",
     # round 14: flight recorder
     "serving_flight_anomalies_total", "serving_flight_dumps_total",
-    "serving_flight_requests")
+    "serving_flight_requests",
+    # round 16: speculative decoding (NOT in MUST_COUNT — a non-spec
+    # stream legitimately leaves them at zero)
+    "serving_spec_windows_total", "serving_spec_proposed_tokens_total",
+    "serving_spec_accepted_tokens_total", "serving_spec_accept_rate",
+    "serving_spec_accepted_per_window")
 
 #: process-default-registry rows the README "process-default registry"
 #: catalog names (compile watchdog + cost attribution). The meta-test in
