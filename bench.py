@@ -1087,6 +1087,186 @@ def bench_llama_serving_slo(n_requests=None, rate=None, ttft_slo_ms=None):
     return out
 
 
+def bench_llama_fleet_slo(n_requests=None, rate=None, ttft_slo_ms=None):
+    """Round-20 FLEET rung: the same Poisson-arrival MULTI-TENANT
+    stream (4 prefix families, 95% shared within a family — distinct
+    system prompts) offered to multi-replica fleets behind the serving
+    Router, swept over replica count 1 / 2 / 4 at a FIXED TTFT budget,
+    with a prefix_affine vs round_robin placement A/B at each
+    multi-replica point. Goodput (requests whose engine-side TTFT met
+    the SLO, per second of drive wall) is the headline — the number a
+    fleet-sizing claim needs: `goodput_scaling_2rep` (2-replica affine
+    over 1-replica) and `affinity_goodput_gain_2rep` (affine over
+    round_robin on the SAME arrival schedule — round_robin scatters
+    every family across every replica's cache, paying each family's
+    cold prefill N times, where affinity gives each family a home
+    replica). Off-chip rows carry platform:"cpu" per house rules."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import ServingEngine
+    from paddle_tpu.serving import Router
+    from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = jax.default_backend() == "tpu"
+    paddle.seed(0)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=8,
+                          num_attention_heads=16,
+                          max_position_embeddings=1024)
+        slots, n_req = 4, int(n_requests or 24)
+        prompt_len, g_lo, g_hi = 512, 16, 48
+        rate = float(rate or 24.0)
+        slo_ms = float(ttft_slo_ms or 250.0)
+        pool_blocks = None  # default slots*pages+1 = 257 already fits
+    else:
+        cfg = LlamaConfig(vocab_size=256, hidden_size=128,
+                          intermediate_size=352, num_hidden_layers=2,
+                          num_attention_heads=4,
+                          max_position_embeddings=128)
+        slots, n_req = 2, int(n_requests or 16)
+        # offered rate well past one replica's service rate, so the
+        # sweep is CAPACITY-bound and replica scaling is visible
+        prompt_len, g_lo, g_hi = 96, 4, 8
+        rate = float(rate or 400.0)
+        slo_ms = float(ttft_slo_ms or 60.0)
+        # the tiny model's default pool (slots*pages+1 = 17 blocks) can't
+        # hold 4 family prefixes (24 blocks) — size it so eviction
+        # pressure doesn't drown the placement signal being measured
+        pool_blocks = 64
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model = paddle.amp.decorate(model, level="O2", dtype="bfloat16",
+                                    master_weight=False)
+    model.eval()
+
+    def make_stream(n_families, shared_frac, seed):
+        rs = np.random.RandomState(seed)
+        fams = [rs.randint(0, cfg.vocab_size,
+                           (int(prompt_len * shared_frac),))
+                for _ in range(n_families)]
+        # balanced but SHUFFLED family order — a strided i%n_families
+        # sequence resonates with round_robin's stride and hands it
+        # perfect affinity by accident
+        order = rs.permutation(np.arange(n_req) % n_families)
+        prompts, gens = [], []
+        for i in range(n_req):
+            shared = fams[order[i]]
+            uniq = rs.randint(0, cfg.vocab_size,
+                              (prompt_len - shared.size,))
+            prompts.append(np.concatenate([shared, uniq]).astype("int64"))
+            gens.append(int(rs.randint(g_lo, g_hi)))
+        gaps = rs.exponential(1.0 / rate, size=n_req)
+        return prompts, gens, np.cumsum(gaps)
+
+    # warm prompts share their own prefix (NOT the measured stream's, so
+    # the drive starts cache-cold) at the stream's shapes; the ladder
+    # admits exactly k requests per decode bucket k like the SLO rung
+    wrs = np.random.RandomState(5)
+    warm_shared = wrs.randint(0, cfg.vocab_size,
+                              (int(prompt_len * 0.95),))
+    warm_prompts = [np.concatenate(
+        [warm_shared,
+         wrs.randint(0, cfg.vocab_size, (prompt_len - warm_shared.size,))
+         ]).astype("int64") for _ in range(max(slots, 2) + 1)]
+
+    def _warm(eng):
+        k = 1
+        while True:
+            for j in range(k):
+                eng.add_request(warm_prompts[(k + j) % len(warm_prompts)],
+                                max_new_tokens=4)
+            eng.run()
+            if k >= slots:
+                break
+            k = min(2 * k, slots)
+
+    def drive_fleet(n_rep, policy, stream):
+        prompts, gens, arrivals = stream
+        engines = [ServingEngine(model, max_slots=slots,
+                                 num_kv_blocks=pool_blocks)
+                   for _ in range(n_rep)]
+        router = Router(engines, policy=policy, warmup=_warm)
+        try:
+            if not router.wait_ready(900):
+                raise RuntimeError("fleet warmup timed out")
+            t0 = time.perf_counter()
+            futs, i = [], 0
+            while i < len(prompts):
+                now = time.perf_counter() - t0
+                if arrivals[i] <= now:
+                    futs.append(router.submit(prompts[i],
+                                              max_new_tokens=gens[i]))
+                    i += 1
+                else:
+                    time.sleep(min(arrivals[i] - now, 0.002))
+            for f in futs:
+                f.result(900)
+            wall = time.perf_counter() - t0
+            assert all(f.completions == 1 for f in futs), \
+                "fleet drive duplicated a completion"
+            ttfts, hit, miss = [], 0, 0
+            for eng in engines:
+                st = eng.stats()
+                ttfts += list(st["ttft_s"])
+                hit += st["prefix_blocks_hit"]
+                miss += st["prefix_blocks_missed"]
+            fstats = router.fleet_stats()
+            ttfts.sort()
+            met = sum(1 for t in ttfts if t * 1e3 <= slo_ms)
+            return {
+                "replicas": n_rep, "policy": policy,
+                "offered_rps": round(rate, 1),
+                "goodput_rps": round(met / wall, 1),
+                "slo_met_frac": round(met / len(ttfts), 3),
+                "ttft_ms_p50": round(1e3 * ttfts[len(ttfts) // 2], 1),
+                "ttft_ms_p95": round(
+                    1e3 * ttfts[int(0.95 * (len(ttfts) - 1))], 1),
+                "fleet_prefix_hit_rate": round(
+                    hit / max(hit + miss, 1), 3),
+                "affinity_hits": fstats["affinity_hits"],
+                "wall_s": round(wall, 2)}
+        finally:
+            router.close()
+
+    stream = make_stream(4, 0.95, seed=23)
+    sweep = {"rep1": drive_fleet(1, "prefix_affine", stream)}
+    for n in (2, 4):
+        sweep[f"rep{n}_affine"] = drive_fleet(n, "prefix_affine", stream)
+        sweep[f"rep{n}_rr"] = drive_fleet(n, "round_robin", stream)
+    out = {"name": "llama_fleet_slo_goodput",
+           "slots": slots, "requests": n_req, "prompt_len": prompt_len,
+           "gen_range": [g_lo, g_hi], "ttft_slo_ms": slo_ms,
+           "sweep": sweep,
+           "goodput_rps_1rep": sweep["rep1"]["goodput_rps"],
+           "goodput_rps_2rep": sweep["rep2_affine"]["goodput_rps"],
+           "goodput_rps_4rep": sweep["rep4_affine"]["goodput_rps"],
+           "goodput_scaling_2rep": round(
+               sweep["rep2_affine"]["goodput_rps"]
+               / max(sweep["rep1"]["goodput_rps"], 1e-9), 2),
+           "affinity_goodput_gain_2rep": round(
+               sweep["rep2_affine"]["goodput_rps"]
+               / max(sweep["rep2_rr"]["goodput_rps"], 1e-9), 2),
+           "affinity_hit_rate_gain_2rep": round(
+               sweep["rep2_affine"]["fleet_prefix_hit_rate"]
+               / max(sweep["rep2_rr"]["fleet_prefix_hit_rate"], 1e-9),
+               2),
+           # affinity's edge widens with fleet size — round_robin pays
+           # each family's cold prefill on every replica it touches
+           "affinity_goodput_gain_4rep": round(
+               sweep["rep4_affine"]["goodput_rps"]
+               / max(sweep["rep4_rr"]["goodput_rps"], 1e-9), 2),
+           "affinity_hit_rate_gain_4rep": round(
+               sweep["rep4_affine"]["fleet_prefix_hit_rate"]
+               / max(sweep["rep4_rr"]["fleet_prefix_hit_rate"], 1e-9),
+               2)}
+    if not on_tpu:
+        out["note"] = ("cpu run at reduced geometry — throughput not "
+                       "meaningful off-chip; do not quote")
+    return out
+
+
 def bench_llama_spec_decode(n_requests=None):
     """Round-16 speculative-decoding rung: greedy decode tok/s and
     acceptance rate for the n-gram and draft-model proposers at
@@ -1500,6 +1680,7 @@ ALL = {
     "decode_micro": bench_decode_micro,
     "llama_serving": bench_llama_serving,
     "llama_serving_slo": bench_llama_serving_slo,
+    "llama_fleet_slo": bench_llama_fleet_slo,
     "llama_spec_decode": bench_llama_spec_decode,
     "ckpt": bench_ckpt,
     "partitioner_scaling": bench_partitioner_scaling,
@@ -1628,6 +1809,7 @@ _COST_EST = {
     "resnet50_bf16": 250, "resnet50": 340, "lenet": 50, "decode": 70,
     "decode_1b": 190, "decode_micro": 90, "llama_serving": 180,
     "llama_serving_slo": 200, "llama_spec_decode": 220,
+    "llama_fleet_slo": 240,
     "ckpt": 150, "partitioner_scaling": 150,
     "int8_chain": 70, "int8": 60, "eager": 25,
     "eager_host": 15, "fused_adam": 170,
@@ -1673,6 +1855,7 @@ def main(argv):
     # timeout's captured tail still carries the best-so-far headline.
     default = ["llama_1b", "llama_1b_resid_bf16", "decode_micro",
                "llama_serving", "llama_serving_slo", "llama_spec_decode",
+               "llama_fleet_slo",
                "ckpt",
                "partitioner_scaling", "fused_micro",
                "longctx_8k", "flashmask_16k", "longctx_4k",

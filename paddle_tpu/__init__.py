@@ -78,6 +78,7 @@ from . import static
 from . import device
 from . import text
 from . import inference
+from . import serving
 from . import ckpt
 from . import audio
 from . import onnx

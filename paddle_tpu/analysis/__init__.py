@@ -98,6 +98,18 @@ Concurrency auditor (round 17, concurrency.py + core/lockdep.py):
                          FLAGS_spec_min_accept on a warmed engine =
                          warning (verify windows burn K+1-wide passes
                          for ~1 token — slower than not speculating)
+
+Fleet detector (round 20, serving.py):
+  D17 audit_fleet        multi-replica router health over
+                         Router.fleet_stats(): placement skew (one
+                         replica above FLAGS_router_skew_pct of
+                         placements while another ready replica idles),
+                         dead-replica routing (placements rescued off a
+                         corpse), and prefix-affinity defeat (repeated
+                         prompts — tracked by an independent digest —
+                         scattered across replicas with zero fingerprint
+                         matches) — gated by the graft_lint `router`
+                         smoke.
 """
 from .ast_lint import (audit_flags_doc, lint_dy2static, lint_file,
                        lint_tree, lint_vjp_saves, lint_x64)
@@ -111,7 +123,8 @@ from .jaxpr_audit import (audit_callbacks, audit_compiled,
                           audit_donation, audit_dtype_stream,
                           audit_fusion_misses, audit_host_sync,
                           infer_stream_shapes, iter_eqns, iter_jaxprs)
-from .serving import audit_prefix_cache, audit_spec_decode
+from .serving import (audit_fleet, audit_prefix_cache,
+                      audit_spec_decode)
 from .spmd import (audit_collectives, audit_sharding_coverage, audit_spmd,
                    audit_transfers, jaxpr_collective_bytes)
 from .vmem import (audit_decode_config, audit_norm_config,
@@ -154,6 +167,7 @@ def audit_train_steps(recorder=None, ledger=None, data_wait_ms=None,
 
 __all__ = [
     "audit_recompiles", "audit_prefix_cache", "audit_spec_decode",
+    "audit_fleet",
     "audit_cost_regressions", "audit_train_steps",
     "Finding", "apply_baseline", "format_text", "gate_failures",
     "load_baseline", "stale_suppressions", "to_json",

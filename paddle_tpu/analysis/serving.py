@@ -121,3 +121,104 @@ def audit_spec_decode(engine, parity: bool | None = None,
         f"({rate:.0%}) across {stats['windows']} verify windows at "
         f"K={stats['k']}{extra}",
         dict(stats))]
+
+
+def audit_fleet(router, loc: str = "serving/fleet",
+                skew_pct: float | None = None,
+                min_routed: int = 8) -> list:
+    """D17 over a multi-replica Router (round 20; duck-typed — accepts
+    the router or its ``fleet_stats()`` dict directly).
+
+    The fabric fails in three SILENT modes, all functionally correct:
+
+      * placement SKEW — a broken policy or load signal concentrates
+        more than ``skew_pct`` (FLAGS_router_skew_pct) of placements on
+        one replica while another ready replica took NONE: the fleet
+        pays for N replicas and serves on one;
+      * DEAD-replica routing — placements kept landing on a replica
+        already marked dead/stopped (a stale pin or a policy holding a
+        corpse reference): every one costs a rescue round-trip and says
+        failure detection is lagging the policy layer;
+      * prefix-affinity DEFEAT — byte-identical prompts (tracked by an
+        independent sha256 digest, NOT the hash_blocks chain, so a
+        broken/drifting fingerprint cannot hide itself — the D7 trick)
+        were SCATTERED across replicas while the prefix_affine policy
+        never scored a single index match: every repeat pays full
+        prefill somewhere cold and the affinity multiplier is gone.
+
+    Healthy fleets get a note with the placement spread; a fleet of one
+    replica gets a note (nothing to skew or scatter)."""
+    stats = router.fleet_stats() if hasattr(router, "fleet_stats") \
+        else dict(router)
+    if stats["replica_count"] < 2:
+        return [Finding(
+            "fleet", "note", loc,
+            "single-replica fleet — placement detectors idle (nothing "
+            "to skew or scatter); run N>=2 replicas to buy the "
+            "affinity/failover multipliers", dict(stats))]
+    findings: list = []
+    dead_routes = int(stats.get("dead_replica_routes", 0))
+    if dead_routes > 0:
+        findings.append(Finding(
+            "fleet", "warning", loc,
+            f"dead-replica routing: {dead_routes} placement(s) chose a "
+            "replica already marked dead/stopped and had to be rescued "
+            "by fallback — a policy or session pin is holding a corpse "
+            "reference, or failure detection lags placement",
+            {"dead_replica_routes": dead_routes,
+             "dead": stats.get("dead", 0),
+             "rerouted": stats.get("rerouted", 0)}))
+    if skew_pct is None:
+        from ..core.flags import flag
+        skew_pct = float(flag("FLAGS_router_skew_pct"))
+    ready = {name: rep for name, rep in stats["replicas"].items()
+             if rep["state"] == "ready"}
+    routed = {name: int(rep["routed"]) for name, rep in ready.items()}
+    total = sum(routed.values())
+    if len(ready) >= 2 and total >= min_routed:
+        top_name, top = max(routed.items(), key=lambda kv: kv[1])
+        idle = sorted(n for n, c in routed.items() if c == 0)
+        # prefix_affine concentrating a shared-prefix stream is the
+        # MULTIPLIER, not a defect: exempt skew that fingerprint
+        # matches explain (at least half the top replica's placements)
+        affine_by_design = (stats.get("policy") == "prefix_affine"
+                            and int(stats.get("affinity_hits", 0)) * 2
+                            >= top)
+        if top / total > skew_pct and idle and not affine_by_design:
+            findings.append(Finding(
+                "fleet", "warning", loc,
+                f"placement skew: replica {top_name} took {top}/{total} "
+                f"placements ({top / total:.0%}, above the "
+                f"{skew_pct:.0%} FLAGS_router_skew_pct threshold) while "
+                f"ready replica(s) {idle} took none — the fleet pays "
+                "for every replica and serves on one (broken policy or "
+                "load signal)",
+                {"routed": routed, "top": top_name,
+                 "share": round(top / total, 4),
+                 "idle": idle, "skew_pct": skew_pct}))
+    repeats = int(stats.get("repeat_submissions", 0))
+    scattered = int(stats.get("scattered_repeats", 0))
+    if stats.get("policy") == "prefix_affine" and repeats > 0 \
+            and scattered > 0 and int(stats.get("affinity_hits", 0)) == 0:
+        findings.append(Finding(
+            "fleet", "warning", loc,
+            f"prefix affinity DEFEATED: {repeats} submission(s) repeated "
+            f"a byte-identical prompt and {scattered} of those prompts "
+            "scattered across multiple replicas, yet the prefix_affine "
+            "policy never matched its fingerprint index once — the "
+            "router's hash chain is not matching its own content "
+            "(namespace drift vs the engines, or a disabled index), so "
+            "shared-prefix traffic lands on cold replicas",
+            {"repeat_submissions": repeats, "scattered_repeats": scattered,
+             "affinity_hits": int(stats.get("affinity_hits", 0)),
+             "fleet_prefix_hits": stats.get("fleet_prefix_hits", 0)}))
+    if findings:
+        return findings
+    return [Finding(
+        "fleet", "note", loc,
+        f"fleet healthy: {stats['routed_total']} placement(s) over "
+        f"{stats['ready']}/{stats['replica_count']} ready replicas "
+        f"(policy {stats['policy']}), {stats['affinity_hits']} affinity "
+        f"hit(s), {stats['session_hits']} session pin(s), "
+        f"{stats['rerouted']} rerouted, {stats['fleet_prefix_hits']} "
+        "fleet prefix block(s) served from cache", dict(stats))]

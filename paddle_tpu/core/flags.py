@@ -375,6 +375,33 @@ define_flag("FLAGS_spec_min_accept", 0.1,
             "this fraction is burning verify FLOPs for no goodput — "
             "lint warning (graft_lint `paged` smoke fire-fixture "
             "self-tests the detector)")
+define_flag("FLAGS_router_policy", "prefix_affine",
+            "placement policy of the multi-replica serving router "
+            "(serving/router.py): prefix_affine (route by prompt "
+            "fingerprint to the replica whose prefix cache already "
+            "holds the blocks, falling back to least_loaded) | "
+            "least_loaded (queue depth + free-block budget from "
+            "stats()) | round_robin")
+define_flag("FLAGS_router_fingerprint_blocks", 1024,
+            "per-replica bound on the router's prefix fingerprint "
+            "index: block hashes remembered per replica for "
+            "prefix_affine placement (LRU beyond the cap; 0 disables "
+            "fingerprint tracking and prefix_affine degrades to "
+            "least_loaded)")
+define_flag("FLAGS_router_sessions_max", 4096,
+            "session-affinity map bound: session IDs the router pins "
+            "to their replica (LRU beyond the cap — an evicted session "
+            "re-pins via the placement policy on its next turn)")
+define_flag("FLAGS_router_drain_ms", 10000.0,
+            "default drain deadline for router.drain(): in-flight "
+            "requests on the draining replica get at most this many "
+            "ms to finish before the round-12 per-request deadline "
+            "path timeout-finishes them (0 = wait forever)")
+define_flag("FLAGS_router_skew_pct", 0.9,
+            "D17 audit_fleet placement-skew threshold: one replica "
+            "taking more than this fraction of routed requests while "
+            "another ready replica got none is a lint warning "
+            "(graft_lint `router` smoke self-tests the detector)")
 define_flag("FLAGS_debug_thread_checks", False,
             "owner-thread contract assertions on the deliberately "
             "single-threaded serving objects (ServingEngine, "

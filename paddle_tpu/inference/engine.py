@@ -697,7 +697,8 @@ class ServingEngine:
     /metrics endpoint, ``close()``) stay thread-safe."""
 
     #: D15 static marker: methods the single-owner contract guards
-    _thread_contract = ("add_request", "step", "run", "finish_warmup")
+    _thread_contract = ("add_request", "step", "run", "finish_warmup",
+                        "drain")
 
     def __init__(self, model, max_slots=None, kv_block_size=None,
                  num_kv_blocks=None, kv_cache_dtype=None,
@@ -846,6 +847,11 @@ class ServingEngine:
         self._m_rejects = reg.counter(
             "serving_admission_rejects_total", "requests rejected outright "
             "(could never be served)", ("reason",))
+        self._m_drained = reg.counter(
+            "serving_drained_requests_total", "requests that finished "
+            "while the engine was draining (router drain/handoff — each "
+            "one completed or timed out in place instead of being "
+            "dropped by the deploy)")
         self._m_blocked = reg.counter(
             "serving_admission_blocked_total", "admission attempts that "
             "waited: head-of-line request's block budget did not fit the "
@@ -944,6 +950,7 @@ class ServingEngine:
              self.allocator.num_blocks, str(self.cache.k.dtype),
              params_fp))
         self._warmed = False
+        self._draining = False
         # D15 owner-thread contract (binds on the first driving call,
         # NOT here — construction may happen on a loader thread)
         from ..core import lockdep as _lockdep
@@ -994,6 +1001,10 @@ class ServingEngine:
         (it decodes one token per tick, coexisting with speculating
         slots in the same tick); None follows the engine config."""
         self.contract.check("add_request")
+        if self._draining:
+            self._reject("draining",
+                         "engine is draining: no new admissions until "
+                         "teardown (route to another replica)")
         prompt = np.asarray(
             prompt._data if hasattr(prompt, "_data") else prompt,
             np.int64).reshape(-1).astype(np.int32)
@@ -1082,6 +1093,10 @@ class ServingEngine:
             self.steps += 1
             self.active_slot_steps += len(active)
             self._m_active.set(len(active))
+        if self._draining:
+            done = sum(1 for _rid, _tok, fin in emitted if fin)
+            if done:
+                self._m_drained.inc(done)
         return emitted
 
     def run(self, max_steps=100000):
@@ -1114,6 +1129,9 @@ class ServingEngine:
                 "queue_wait_s": list(self.queue_waits),
                 "admission_blocked": int(self._m_blocked.value),
                 "requests_completed": int(self._m_completed.value),
+                # round 20: drain/handoff (router rolling restarts)
+                "draining": self._draining,
+                "drained_requests": int(self._m_drained.value),
                 "kv_pool_blocks": self.allocator.num_blocks,
                 "kv_pool_free": self.allocator.available,
                 "kv_hbm_bytes": self.cache.hbm_bytes,
@@ -1165,6 +1183,43 @@ class ServingEngine:
     @property
     def warmed(self) -> bool:
         return self._warmed
+
+    def drain(self, deadline_ms=None):
+        """Stop admission for handoff (round 20): every add_request from
+        now on rejects with reason ``"draining"``; requests already
+        queued or in flight keep running until they finish. With a
+        ``deadline_ms`` budget each surviving request's per-request
+        deadline (the round-12 timeout path) is CLAMPED to now+budget,
+        so a stuck-long request cannot hold the replica open forever —
+        it timeout-finishes with whatever tokens it produced, blocks
+        reclaimed. ``drained`` flips True once queue+slots are empty;
+        the router then ``contract.rebind()``s the engine for teardown.
+        Completions observed while draining count into the
+        ``serving_drained_requests_total`` metric. Idempotent — a
+        second drain() only tightens the deadline."""
+        self.contract.check("drain")
+        self._draining = True
+        if deadline_ms is not None and float(deadline_ms) > 0:
+            now = time.perf_counter()
+            deadline_s = now + float(deadline_ms) / 1e3
+            live = list(self._waiting) + [r for r in self._slot_req
+                                          if r is not None]
+            for req in live:
+                if req.deadline_s is None or req.deadline_s > deadline_s:
+                    req.deadline_s = deadline_s
+                    # keep the timeout log's ms figure meaningful
+                    req.max_time_ms = (deadline_s - req.arrival_s) * 1e3
+        return self
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        """True once a draining engine has no queued or active work —
+        the router's signal that teardown (rebind + close) is safe."""
+        return self._draining and not self.has_work()
 
     def close(self):
         """Detach from the shared /metrics endpoint (no-op otherwise).

@@ -14,6 +14,10 @@
     ``finish_warmup()`` — the health signal a multi-replica router
     consumes), 503 ``warming`` before that; with none registered it
     stays the plain 200 ``ok`` liveness check.
+  * ``/healthz?engine=NAME``  — per-replica readiness (round 20): the
+    named engine's probe alone, so a router can admit replica B while
+    replica A is still warming. 404 ``unknown engine`` when NAME is not
+    registered. The bare-path aggregate contract is unchanged.
 
 No dependencies beyond the stdlib (the container bakes no prometheus
 client), one thread, read-only — good enough for a scrape target, not a
@@ -49,9 +53,17 @@ class MetricsServer:
                     self.send_header("Content-Type",
                                      "text/plain; version=0.0.4")
                 elif path == "/healthz":
-                    ready, body = srv.health()
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    name = q.get("engine", [None])[0]
+                    ready, body = srv.health(engine=name)
                     body = body.encode()
-                    self.send_response(200 if ready else 503)
+                    if ready:
+                        code = 200
+                    else:
+                        code = 404 if body.startswith(b"unknown") else 503
+                    self.send_response(code)
                     self.send_header("Content-Type", "text/plain")
                 else:
                     body = b"not found\n"
@@ -120,9 +132,19 @@ class MetricsServer:
                     lines.extend(reg._render_samples(n, extra))
         return "\n".join(lines) + ("\n" if lines else "")
 
-    def health(self) -> tuple[bool, str]:
+    def health(self, engine: str | None = None) -> tuple[bool, str]:
+        """Aggregate readiness, or — with ``engine`` (round 20, the
+        ``/healthz?engine=NAME`` probe) — the named engine's alone: a
+        router admits a warmed replica while its peers still warm."""
         with self._lock:
             engines = dict(self._engines)
+        if engine is not None:
+            if engine not in engines:
+                return False, f"unknown engine: {engine}\n"
+            _, ready = engines[engine]
+            if ready is not None and not ready():
+                return False, f"warming: {engine}\n"
+            return True, "ready\n"
         if not engines:
             return True, "ok\n"
         warming = sorted(name for name, (_, ready) in engines.items()

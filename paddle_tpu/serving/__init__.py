@@ -1,0 +1,22 @@
+"""Multi-replica serving fabric (round 20).
+
+A ``Router`` owns N paged ``ServingEngine`` replicas — each on its own
+driver thread honoring the engine's single-owner contract — behind one
+``submit(prompt, ...) -> RouterFuture`` API. Placement is prefix-cache
+aware (the router fingerprints prompts with the same chained content
+hashes ``PrefixCache`` keys blocks by), with ``least_loaded`` and
+``round_robin`` as pluggable alternatives; session affinity pins
+multi-turn traffic; ``drain()`` does zero-drop rolling restarts. See
+router.py / replica.py / policy.py and the README "Multi-replica
+serving" section.
+"""
+from .policy import (POLICIES, LeastLoaded, Policy, PrefixAffine,
+                     RoundRobin, make_policy)
+from .replica import Replica, RouterFuture, Submission
+from .router import Router
+
+__all__ = [
+    "Router", "Replica", "RouterFuture", "Submission",
+    "Policy", "PrefixAffine", "LeastLoaded", "RoundRobin",
+    "POLICIES", "make_policy",
+]

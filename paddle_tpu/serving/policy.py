@@ -1,0 +1,119 @@
+"""Placement policies for the multi-replica serving router.
+
+A policy answers one question — "which READY replica takes this
+request?" — from two inputs the router hands it: the candidate replicas
+(never draining/dead; the router filters first) and the request's prefix
+fingerprint (the chained content-hash list ``PrefixCache`` itself keys
+blocks by, so an affinity match predicts real cache hits, not a guess).
+
+Policies are deliberately stateful objects (round-robin keeps a cursor,
+prefix_affine keeps its fallback) but hold NO locks of their own:
+``choose()`` is only ever called under the router's placement lock, so
+one router serializes its policy and two routers never share one
+instance (``make_policy`` constructs fresh).
+
+The registry is pluggable: ``POLICIES`` maps the ``FLAGS_router_policy``
+names to classes, and ``Router(policy=...)`` also accepts any object
+with a ``choose(replicas, fingerprint)`` method — tests and the D17
+fire fixtures inject deliberately-broken placements that way.
+"""
+from __future__ import annotations
+
+
+class Policy:
+    """Base: subclasses implement ``choose`` and set ``name``."""
+
+    name = "base"
+
+    def choose(self, replicas, fingerprint=()):
+        """Pick one replica from ``replicas`` (non-empty list of READY
+        replicas). ``fingerprint`` is the request's prefix block-hash
+        tuple (may be empty). Called under the router's placement lock."""
+        raise NotImplementedError
+
+
+class RoundRobin(Policy):
+    """Cycle through replicas in registration order — the
+    load-oblivious baseline the bench A/Bs affinity against."""
+
+    name = "round_robin"
+
+    def __init__(self):
+        self._cursor = 0
+
+    def choose(self, replicas, fingerprint=()):
+        rep = replicas[self._cursor % len(replicas)]
+        self._cursor += 1
+        return rep
+
+
+class LeastLoaded(Policy):
+    """Lowest queue depth first (inbox + engine queue + active slots),
+    free KV-block budget (from ``stats()``) as the tiebreak — a replica
+    with a near-empty pool is a worse landing spot than its twin."""
+
+    name = "least_loaded"
+
+    def choose(self, replicas, fingerprint=()):
+        return min(replicas, key=lambda r: r.load())
+
+
+class PrefixAffine(Policy):
+    """Route to the replica whose fingerprint index overlaps the
+    request's prefix hashes the most (longest shared block-hash prefix —
+    exactly the blocks its ``PrefixCache`` can serve without prefill);
+    zero overlap anywhere falls back to least-loaded placement.
+
+    Affinity YIELDS under burst: when the affine replica's queue is
+    ``spill_depth`` deeper than the least-loaded candidate's, the
+    request spills there instead — a hot replica serializing the whole
+    fleet costs more than one cold prefill on an idle one, and the
+    spill target learns the prefix, so follow-up traffic load-balances
+    across the (now multiple) warm replicas by the equal-score load
+    tiebreak below."""
+
+    name = "prefix_affine"
+
+    #: queue-depth gap (affine choice vs least-loaded candidate) past
+    #: which affinity yields to load
+    spill_depth = 4
+
+    def __init__(self):
+        self._fallback = LeastLoaded()
+
+    def choose(self, replicas, fingerprint=()):
+        best, best_score = None, 0
+        for rep in replicas:
+            score = rep.fingerprint_score(fingerprint)
+            if score > best_score or (
+                    score == best_score and score > 0
+                    and rep.load() < best.load()):
+                best, best_score = rep, score
+        if best is None:
+            return self._fallback.choose(replicas, fingerprint)
+        least = self._fallback.choose(replicas, fingerprint)
+        if least is not best and \
+                best.load()[0] - least.load()[0] >= self.spill_depth:
+            return least
+        return best
+
+
+#: name -> class; ``FLAGS_router_policy`` picks from here
+POLICIES = {
+    "round_robin": RoundRobin,
+    "least_loaded": LeastLoaded,
+    "prefix_affine": PrefixAffine,
+}
+
+
+def make_policy(policy):
+    """Policy instance from a name, class, or ready-made instance."""
+    if isinstance(policy, str):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"unknown router policy {policy!r}; known: "
+                f"{sorted(POLICIES)}")
+        return POLICIES[policy]()
+    if isinstance(policy, type):
+        return policy()
+    return policy

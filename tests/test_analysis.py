@@ -1068,7 +1068,7 @@ class TestStaleSuppressions:
         import graft_lint
 
         for name in ("audit_serving", "audit_obs", "audit_ckpt",
-                     "audit_spmd", "audit_conc"):
+                     "audit_spmd", "audit_conc", "audit_router"):
             monkeypatch.setattr(graft_lint, name, lambda: [])
         monkeypatch.setattr(graft_lint, "audit_model", lambda n: [])
         fs = graft_lint.run(models=graft_lint.CI_MODELS, ast=True,
@@ -1083,7 +1083,7 @@ class TestStaleSuppressions:
         import graft_lint
 
         for name in ("audit_serving", "audit_obs", "audit_ckpt",
-                     "audit_spmd", "audit_conc"):
+                     "audit_spmd", "audit_conc", "audit_router"):
             monkeypatch.setattr(graft_lint, name, lambda: [])
         monkeypatch.setattr(graft_lint, "audit_model", lambda n: [])
         path = self._baseline_file(tmp_path)

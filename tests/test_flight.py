@@ -494,12 +494,13 @@ class TestMetricCatalogDrift:
         existence — no documented-but-unenforced metrics."""
         from graft_lint import (REQUIRED_CKPT_METRICS,
                                 REQUIRED_DEFAULT_METRICS,
+                                REQUIRED_FLEET_METRICS,
                                 REQUIRED_SERVING_METRICS,
                                 REQUIRED_TRAIN_METRICS)
 
         known = set(REQUIRED_SERVING_METRICS) \
             | set(REQUIRED_CKPT_METRICS) | set(REQUIRED_DEFAULT_METRICS) \
-            | set(REQUIRED_TRAIN_METRICS)
+            | set(REQUIRED_TRAIN_METRICS) | set(REQUIRED_FLEET_METRICS)
         missing = sorted(self._catalog_names() - known)
         assert not missing, (
             "README metric catalog documents metrics no REQUIRED_* set "
@@ -510,6 +511,7 @@ class TestMetricCatalogDrift:
         """Registry -> doc: the enforced serving/default/training sets
         must appear in the catalog (drift in the other direction)."""
         from graft_lint import (REQUIRED_DEFAULT_METRICS,
+                                REQUIRED_FLEET_METRICS,
                                 REQUIRED_SERVING_METRICS,
                                 REQUIRED_TRAIN_METRICS)
 
@@ -517,7 +519,8 @@ class TestMetricCatalogDrift:
         undocumented = sorted(
             (set(REQUIRED_SERVING_METRICS)
              | set(REQUIRED_DEFAULT_METRICS)
-             | set(REQUIRED_TRAIN_METRICS)) - names)
+             | set(REQUIRED_TRAIN_METRICS)
+             | set(REQUIRED_FLEET_METRICS)) - names)
         assert not undocumented, (
             f"REQUIRED metrics missing from the README catalog: "
             f"{undocumented}")

@@ -512,6 +512,23 @@ class TestServingObs:
             with pytest.raises(urllib.error.HTTPError):
                 urllib.request.urlopen(
                     f"http://127.0.0.1:{port}/healthz")
+            # round 20: PER-ENGINE readiness — ?engine=NAME answers for
+            # that replica alone (a router admits warmed replica A while
+            # B still warms), aggregate contract above unchanged
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz"
+                    f"?engine={e1._engine_name}") as resp:
+                assert resp.status == 200
+                assert resp.read() == b"ready\n"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz"
+                    f"?engine={e2._engine_name}")
+            assert ei.value.code == 503
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz?engine=nope")
+            assert ei.value.code == 404
             e2.finish_warmup()
             with urllib.request.urlopen(
                     f"http://127.0.0.1:{port}/healthz") as resp:

@@ -1,0 +1,466 @@
+"""Multi-replica serving fabric tests (round 20).
+
+The Router (paddle_tpu/serving/) must be a correctness no-op over the
+engines it fronts — a 1-replica router is token-identical to a bare
+``ServingEngine`` under greedy sampling — while buying the fleet
+properties: prefix-affine placement concentrates shared-prefix traffic
+(strictly more fleet prefix-cache hits than round_robin on the same 95%-
+shared stream), session affinity pins multi-turn sessions, a rolling
+drain/replace cycle drops and duplicates ZERO requests, a dead replica
+fails over, and D17 ``audit_fleet`` fires on the silent failure modes.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis
+from paddle_tpu.inference.engine import ServingEngine
+from paddle_tpu.serving import Policy, Router
+from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+_MODEL = None
+
+
+def _tiny():
+    global _MODEL
+    if _MODEL is None:
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=128, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=4,
+                          max_position_embeddings=64)
+        _MODEL = LlamaForCausalLM(cfg)
+        _MODEL.eval()
+    return _MODEL
+
+
+def _engine(**kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("kv_block_size", 8)
+    kw.setdefault("seed", 0)
+    return ServingEngine(_tiny(), **kw)
+
+
+def _shared_stream(n=16, shared_frac=0.95, seed=0, prefix_len=32):
+    """95%-shared-prefix request stream: the fleet workload prefix
+    affinity exists for. Deterministic per seed."""
+    rs = np.random.RandomState(seed)
+    shared = rs.randint(1, 128, (prefix_len,)).astype(np.int32)
+    prompts = []
+    for _ in range(n):
+        if rs.rand() < shared_frac:
+            p = np.concatenate([shared, rs.randint(1, 128, (2,))])
+        else:
+            p = rs.randint(1, 128, (prefix_len + 2,))
+        prompts.append(p.astype(np.int32))
+    return prompts
+
+
+class TestRouterParity:
+    def test_one_replica_router_token_identical_to_bare_engine(self):
+        rs = np.random.RandomState(3)
+        prompts = [rs.randint(1, 128, (int(n),)).astype(np.int32)
+                   for n in rs.randint(4, 20, (8,))]
+        bare = _engine()
+        rids = [bare.add_request(p, max_new_tokens=6) for p in prompts]
+        expected = bare.run()
+        bare.close()
+
+        router = Router([_engine()], policy="least_loaded")
+        try:
+            assert router.wait_ready(120)
+            futs = [router.submit(p, max_new_tokens=6) for p in prompts]
+            for rid, fut in zip(rids, futs):
+                np.testing.assert_array_equal(fut.result(60),
+                                              expected[rid])
+                assert fut.completions == 1
+        finally:
+            router.close()
+
+    def test_submit_rejects_propagate(self):
+        router = Router([_engine()])
+        try:
+            assert router.wait_ready(120)
+            fut = router.submit(np.arange(1, 60, dtype=np.int32),
+                                max_new_tokens=60)   # context overflow
+            with pytest.raises(ValueError):
+                fut.result(60)
+        finally:
+            router.close()
+
+
+class TestPlacement:
+    def _drive(self, policy, prompts):
+        router = Router([_engine(), _engine()], policy=policy)
+        try:
+            assert router.wait_ready(120)
+            for p in prompts:
+                fut = router.submit(p, max_new_tokens=4)
+                fut.result(60)          # sequential: deterministic hits
+            return router.fleet_stats()
+        finally:
+            router.close()
+
+    def test_prefix_affine_beats_round_robin_on_shared_stream(self):
+        """Acceptance criterion: same 95%-shared stream, fleet-wide
+        prefix-hit counters A/B — affinity must win strictly."""
+        prompts = _shared_stream(n=16, shared_frac=0.95, seed=7)
+        affine = self._drive("prefix_affine", prompts)
+        rr = self._drive("round_robin", prompts)
+        assert affine["fleet_prefix_hits"] > rr["fleet_prefix_hits"], (
+            affine["fleet_prefix_hits"], rr["fleet_prefix_hits"])
+        assert affine["affinity_hits"] > 0
+
+    def test_session_affinity_pins_follow_up_turns(self):
+        """Under round_robin (which would alternate), a session's later
+        turns still land on its first replica — the pin overrides."""
+        router = Router([_engine(), _engine()], policy="round_robin")
+        try:
+            assert router.wait_ready(120)
+            rs = np.random.RandomState(5)
+            first = {}
+            for turn in range(3):
+                for sess in ("alice", "bob", "carol"):
+                    p = rs.randint(1, 128, (6 + 4 * turn,))
+                    fut = router.submit(p.astype(np.int32),
+                                        max_new_tokens=3, session=sess)
+                    fut.result(60)
+                    if sess not in first:
+                        first[sess] = fut.replica
+                    assert fut.replica == first[sess]
+            assert router.fleet_stats()["session_hits"] == 6
+        finally:
+            router.close()
+
+
+class TestRollingRestart:
+    def test_drain_replace_drops_and_duplicates_nothing(self):
+        """Acceptance criterion: a deploy never drops a request. Drain
+        one replica with work in flight, swap in a replacement gated on
+        warmup+/healthz — every future completes exactly once with a
+        real finish reason, and traffic keeps flowing after."""
+        router = Router([_engine(), _engine()], policy="round_robin")
+        try:
+            assert router.wait_ready(120)
+            rs = np.random.RandomState(11)
+            futs = [router.submit(rs.randint(1, 128, (8,)),
+                                  max_new_tokens=24) for _ in range(12)]
+            drained = router.replica("r0")
+            new_name = router.drain("r0", replacement=_engine())
+            assert new_name is not None
+            assert "r0" not in router.replicas
+            assert new_name in router.replicas
+            # zero dropped, zero duplicated, no timeouts
+            for fut in futs:
+                toks = fut.result(120)
+                assert fut.completions == 1
+                assert fut.finish_reason in ("eos", "length")
+                assert toks.size > 0
+            # the drained engine really went through the drain path
+            st = drained.engine.stats()
+            assert st["draining"] is True
+            assert st["drained_requests"] >= 1
+            assert drained.state == "stopped"
+            stats = router.fleet_stats()
+            assert stats["drains"] == 1
+            assert stats["ready"] == 2
+            # fleet still serves
+            after = [router.submit(rs.randint(1, 128, (8,)),
+                                   max_new_tokens=3) for _ in range(4)]
+            for fut in after:
+                fut.result(60)
+                assert fut.completions == 1
+        finally:
+            router.close()
+
+    def test_drain_deadline_bounds_stuck_requests(self):
+        """A request that would outlive the drain budget is finished by
+        the round-12 deadline path, not waited on forever."""
+        router = Router([_engine()], policy="least_loaded")
+        try:
+            assert router.wait_ready(120)
+            fut = router.submit(np.arange(1, 9, dtype=np.int32),
+                                max_new_tokens=40)
+            time.sleep(0.05)            # let it admit
+            t0 = time.perf_counter()
+            router.drain("r0", deadline_ms=150.0)
+            assert time.perf_counter() - t0 < 30.0
+            fut.result(60)
+            assert fut.completions == 1
+            assert fut.finish_reason in ("eos", "length", "timeout")
+        finally:
+            router.close()
+
+
+class TestFailover:
+    def test_dead_replica_fails_over(self):
+        router = Router([_engine(), _engine()], policy="round_robin")
+        try:
+            assert router.wait_ready(120)
+
+            def _boom():
+                raise RuntimeError("injected replica death")
+
+            router.replica("r0").engine.step = _boom
+            rs = np.random.RandomState(13)
+            futs = [router.submit(rs.randint(1, 128, (8,)),
+                                  max_new_tokens=4) for _ in range(8)]
+            for fut in futs:
+                toks = fut.result(120)
+                assert fut.completions == 1
+                assert toks.size > 0
+                assert fut.replica == "r1"   # survivors served everyone
+            stats = router.fleet_stats()
+            assert stats["dead"] == 1
+            assert stats["rerouted"] >= 1
+            # later traffic routes around the corpse
+            fut = router.submit(rs.randint(1, 128, (8,)),
+                                max_new_tokens=3)
+            fut.result(60)
+            assert fut.replica == "r1"
+        finally:
+            router.close()
+
+    def test_no_ready_replicas_raises(self):
+        router = Router([_engine()])
+        try:
+            assert router.wait_ready(120)
+            router.replica("r0").engine.step = lambda: (_ for _ in ())\
+                .throw(RuntimeError("dead"))
+            fut = router.submit(np.arange(1, 9, dtype=np.int32),
+                                max_new_tokens=4)
+            with pytest.raises(RuntimeError):
+                fut.result(60)
+            with pytest.raises(RuntimeError):
+                router.submit(np.arange(1, 9, dtype=np.int32))
+        finally:
+            router.close()
+
+
+class TestEngineDrain:
+    """Satellite: the first-class ServingEngine.drain() contract."""
+
+    def test_drain_rejects_new_admissions_with_named_reason(self):
+        eng = _engine()
+        eng.add_request(np.arange(1, 9, dtype=np.int32), max_new_tokens=4)
+        eng.drain()
+        with pytest.raises(ValueError, match="draining"):
+            eng.add_request(np.arange(1, 9, dtype=np.int32))
+        rejects = eng.metrics()["serving_admission_rejects_total"]
+        assert any(s.get("labels", {}).get("reason") == "draining"
+                   and s["value"] >= 1 for s in rejects["samples"])
+        assert eng.draining and not eng.drained
+        eng.run()
+        assert eng.drained
+        assert eng.stats()["drained_requests"] == 1
+        eng.close()
+
+    def test_drain_deadline_rides_timeout_path(self):
+        eng = _engine()
+        eng.add_request(np.arange(1, 9, dtype=np.int32),
+                        max_new_tokens=40)       # would decode for a while
+        eng.step()
+        eng.drain(deadline_ms=10.0)
+        time.sleep(0.05)
+        emitted = eng.step()
+        assert any(fin for _r, _t, fin in emitted)
+        assert eng.finish_reasons[0] == "timeout"
+        assert eng.drained
+        eng.close()
+
+
+class TestAuditFleet:
+    """D17 fire / no-fire / disabled fixtures."""
+
+    def _warn(self, findings):
+        return [f for f in findings if f.severity == "warning"]
+
+    def test_healthy_fleet_is_a_note(self):
+        prompts = _shared_stream(n=10, seed=3)
+        router = Router([_engine(), _engine()], policy="prefix_affine")
+        try:
+            assert router.wait_ready(120)
+            for p in prompts:
+                router.submit(p, max_new_tokens=3).result(60)
+            fs = analysis.audit_fleet(router)
+            assert all(f.severity == "note" for f in fs), fs
+            assert all(f.detector == "fleet" for f in fs)
+        finally:
+            router.close()
+
+    def test_single_replica_is_disabled_note(self):
+        router = Router([_engine()])
+        try:
+            assert router.wait_ready(120)
+            (f,) = analysis.audit_fleet(router)
+            assert f.severity == "note"
+            assert "single-replica" in f.message
+        finally:
+            router.close()
+
+    def test_placement_skew_fires(self):
+        class _FirstOnly(Policy):
+            name = "first_only"
+
+            def choose(self, replicas, fingerprint=()):
+                return replicas[0]
+
+        router = Router([_engine(), _engine()], policy=_FirstOnly())
+        try:
+            assert router.wait_ready(120)
+            rs = np.random.RandomState(17)
+            for _ in range(10):
+                router.submit(rs.randint(1, 128, (8,)),
+                              max_new_tokens=2).result(60)
+            warns = self._warn(analysis.audit_fleet(router))
+            assert len(warns) == 1
+            assert "placement skew" in warns[0].message
+        finally:
+            router.close()
+
+    def test_affine_concentration_is_not_skew(self):
+        """prefix_affine concentrating a shared stream on one replica
+        is the multiplier working, not a defect."""
+        prompts = _shared_stream(n=12, shared_frac=1.0, seed=19)
+        router = Router([_engine(), _engine()], policy="prefix_affine")
+        try:
+            assert router.wait_ready(120)
+            for p in prompts:
+                router.submit(p, max_new_tokens=2).result(60)
+            stats = router.fleet_stats()
+            routed = [r["routed"] for r in stats["replicas"].values()]
+            assert 0 in routed          # it DID concentrate
+            assert not self._warn(analysis.audit_fleet(router))
+        finally:
+            router.close()
+
+    def test_dead_replica_routing_fires(self):
+        router = Router([_engine(), _engine()], policy="round_robin")
+        try:
+            assert router.wait_ready(120)
+            corpse = router.replica("r0")
+            corpse.engine.step = lambda: (_ for _ in ())\
+                .throw(RuntimeError("dead"))
+            # kill r0 via one routed request, then keep a policy that
+            # stubbornly returns the corpse
+            router.submit(np.arange(1, 9, dtype=np.int32),
+                          max_new_tokens=2).result(60)
+            assert corpse.state == "dead"
+
+            class _Corpse(Policy):
+                name = "corpse_pin"
+
+                def choose(self, replicas, fingerprint=()):
+                    return corpse
+
+            router._policy = _Corpse()
+            rs = np.random.RandomState(23)
+            for _ in range(3):
+                router.submit(rs.randint(1, 128, (8,)),
+                              max_new_tokens=2).result(60)
+            warns = self._warn(analysis.audit_fleet(router))
+            assert any("dead-replica routing" in w.message
+                       for w in warns)
+        finally:
+            router.close()
+
+    def test_affinity_defeat_fires(self):
+        """Drifting fingerprint (the D7 namespace-mismatch analogue):
+        repeated prompts scatter with zero index matches — warning."""
+        router = Router([_engine(), _engine()], policy="prefix_affine")
+        try:
+            assert router.wait_ready(120)
+            drift = iter(range(10**6))
+            router._fingerprint = lambda arr: (next(drift),)
+            prompt = np.arange(1, 25, dtype=np.int32)
+            for _ in range(6):
+                router.submit(prompt, max_new_tokens=2).result(60)
+            stats = router.fleet_stats()
+            assert stats["repeat_submissions"] >= 5
+            assert stats["scattered_repeats"] >= 1, stats
+            assert stats["affinity_hits"] == 0
+            warns = self._warn(analysis.audit_fleet(router))
+            assert any("prefix affinity DEFEATED" in w.message
+                       for w in warns)
+        finally:
+            router.close()
+
+    def test_audit_accepts_stats_dict(self):
+        stats = {
+            "policy": "least_loaded", "replica_count": 2, "ready": 2,
+            "dead": 0, "routed_total": 20, "affinity_hits": 0,
+            "session_hits": 0, "rerouted": 0, "dead_replica_routes": 3,
+            "drains": 0, "repeat_submissions": 0, "scattered_repeats": 0,
+            "fleet_prefix_hits": 0, "fleet_prefix_misses": 0,
+            "replicas": {
+                "r0": {"state": "ready", "routed": 10, "queue_depth": 0,
+                       "kv_pool_free": 8, "prefix_hits": 0,
+                       "drained_requests": 0},
+                "r1": {"state": "ready", "routed": 10, "queue_depth": 0,
+                       "kv_pool_free": 8, "prefix_hits": 0,
+                       "drained_requests": 0}}}
+        warns = [f for f in analysis.audit_fleet(stats)
+                 if f.severity == "warning"]
+        assert len(warns) == 1 and "dead-replica" in warns[0].message
+
+
+class TestThreadDiscipline:
+    def test_router_honors_engine_contract_under_debug_checks(self):
+        """With FLAGS_debug_thread_checks on, any driving call off the
+        driver thread would raise inside the loop, kill the replica and
+        fail the future — completing cleanly IS the assertion."""
+        paddle.set_flags({"FLAGS_debug_thread_checks": True})
+        try:
+            router = Router([_engine()], policy="least_loaded")
+            try:
+                assert router.wait_ready(120)
+                fut = router.submit(np.arange(1, 9, dtype=np.int32),
+                                    max_new_tokens=4)
+                assert fut.result(60).size > 0
+                assert fut.completions == 1
+            finally:
+                router.close()
+        finally:
+            paddle.set_flags({"FLAGS_debug_thread_checks": False})
+
+    def test_concurrent_submitters_one_fleet(self):
+        """submit() is callable from many client threads at once."""
+        router = Router([_engine(), _engine()])
+        try:
+            assert router.wait_ready(120)
+            results = []
+            mu = threading.Lock()
+
+            def client(seed):
+                rs = np.random.RandomState(seed)
+                futs = [router.submit(rs.randint(1, 128, (8,)),
+                                      max_new_tokens=3)
+                        for _ in range(4)]
+                got = [f.result(120) for f in futs]
+                with mu:
+                    results.extend(
+                        (f.completions, g.size) for f, g in
+                        zip(futs, got))
+
+            threads = [threading.Thread(target=client, args=(s,))
+                       for s in (31, 37, 41)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(180)
+            assert len(results) == 12
+            assert all(c == 1 and n > 0 for c, n in results)
+        finally:
+            router.close()
+
+
+def test_registered_in_quick_tier():
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = open(os.path.join(here, "conftest.py")).read()
+    assert '"test_router.py"' in src.split("QUICK_MODULES")[1], \
+        "tests/test_router.py must be registered in QUICK_MODULES"

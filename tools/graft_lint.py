@@ -75,9 +75,21 @@ deterministic two-lock cycle + a cross-thread contract breach) — a
 silently-dead detector fails the gate. The D13 lock-discipline AST lint
 itself (guarded-by / shared-state) rides EVERY run's AST pass.
 
+The special model name `router` (round 20) smokes the MULTI-REPLICA
+serving fabric: a real 2-replica tiny-LLaMA fleet behind
+paddle_tpu.serving.Router with owner-thread contracts enforced — the
+prefix_affine policy must concentrate a shared-prefix stream (≥1 router
+affinity hit, ≥1 fleet prefix-cache hit), a drain/handoff rolling
+restart mid-stream must complete every future exactly once (replacement
+admitted only after warmup + readiness), zero compiles may land after
+any replica's warmup barrier, D17 audit_fleet must come back clean, the
+REQUIRED_FLEET_METRICS rows must exist in the router registry, and the
+D17 affinity-defeat fire fixture (a drifting fingerprint scattering
+byte-identical prompts) must still trip its warning.
+
 Exit code: 0 when no unsuppressed warning/error finding survives the
 baseline (notes never fail); 1 otherwise. CI runs
-`graft_lint.py --models llama,gpt,bert,paged,obs,ckpt,spmd,conc --json`
+`graft_lint.py --models llama,gpt,bert,paged,obs,ckpt,spmd,conc,router --json`
 via tools/check_scoreboard — round 17 splits that into PARALLEL
 subprocess groups (check_scoreboard.LINT_GROUPS) so the gate wall stays
 at the slowest group; each worker passes `--defer-stale` and the gate
@@ -114,7 +126,8 @@ DEFAULT_BASELINE = os.path.join(REPO, "tools", "lint_baseline.json")
 #: of baseline entries is only a gate FAILURE when a run covers all of it
 #: — a partial run legitimately leaves model-specific suppressions
 #: unmatched
-CI_MODELS = ("llama", "gpt", "bert", "paged", "obs", "ckpt", "spmd", "conc")
+CI_MODELS = ("llama", "gpt", "bert", "paged", "obs", "ckpt", "spmd",
+             "conc", "router")
 
 #: one tiny-LLaMA shared by the serving-side smokes (`paged`, `obs`): the
 #: engines key their AOT executables on spec + param AVALS, so a shared
@@ -376,6 +389,9 @@ REQUIRED_SERVING_METRICS = (
     # round 14: flight recorder
     "serving_flight_anomalies_total", "serving_flight_dumps_total",
     "serving_flight_requests",
+    # round 20: drain/handoff (router rolling restarts; zero on an
+    # engine that never drained, so NOT in MUST_COUNT)
+    "serving_drained_requests_total",
     # round 16: speculative decoding (NOT in MUST_COUNT — a non-spec
     # stream legitimately leaves them at zero)
     "serving_spec_windows_total", "serving_spec_proposed_tokens_total",
@@ -422,6 +438,15 @@ MUST_COUNT_SERVING_METRICS = (
     "serving_prefill_seconds", "serving_decode_step_seconds",
     "serving_tpot_seconds", "serving_decode_tokens_total",
     "serving_prefill_tokens_total", "serving_requests_completed_total")
+
+#: fleet telemetry rows the `router` smoke requires in the Router's
+#: registry (round 20) — the multi-replica placement/failover contract;
+#: tests/test_flight.py pins the README catalog rows to this set too
+REQUIRED_FLEET_METRICS = (
+    "router_requests_total", "router_prefix_affinity_hits_total",
+    "router_session_affinity_hits_total", "router_rerouted_requests_total",
+    "router_dead_replica_routes_total", "router_drains_total",
+    "router_ready_replicas", "router_dead_replicas")
 
 
 def audit_obs() -> list:
@@ -1170,6 +1195,197 @@ def _audit_conc_fixtures() -> list:
     return findings
 
 
+def audit_router() -> list:
+    """The `router` smoke (round 20): a REAL 2-replica tiny-LLaMA fleet
+    behind the multi-replica Router, with the engines' owner-thread
+    contracts enforced (FLAGS_debug_thread_checks on for the whole
+    smoke — each replica's driver thread is the only thing allowed to
+    drive its engine, and a violation kills the replica, which fails the
+    gate below).
+
+    Sequence: both replicas warm through the router's warmup ladder
+    (whole-prefill, cache-hit chunk and decode programs at the buckets
+    the traffic uses, ending in ``finish_warmup()``) → a shared-prefix
+    stream routed by ``prefix_affine`` must concentrate on one replica
+    and record fleet prefix-cache hits + ≥1 router affinity hit → a
+    drain/handoff ROLLING RESTART mid-stream (replacement admitted only
+    after warmup + readiness) with every future completing exactly once
+    → gates: ZERO compiles after any replica's warmup barrier (the
+    shared AOT executable cache means the replacement warms off r0's
+    programs), a clean D17 ``audit_fleet``, every REQUIRED_FLEET_METRICS
+    row present in the router's registry, and the affinity-defeat fire
+    fixture (a drifting fingerprint on a second fleet) must trip the D17
+    warning — a silently-dead detector fails the gate like a falsely
+    firing one."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis, obs
+    from paddle_tpu.inference.engine import ServingEngine
+    from paddle_tpu.serving import Router
+
+    findings = []
+    paddle.seed(0)
+    model = _tiny_llama()
+    warm_rs = np.random.RandomState(1)
+
+    def _mk():
+        return ServingEngine(model, max_slots=2)
+
+    probe = _mk()
+    bs = probe.block_size
+    probe.close()
+    # warmup prompts share a 2-block prefix of their OWN (distinct from
+    # the traffic prefix, so the stream starts cache-cold) but the SAME
+    # shapes: request 1 warms the whole-prefill + decode programs,
+    # request 2 the cache-hit suffix chunk ladder
+    warm_shared = warm_rs.randint(0, 128, (2 * bs + 1,))
+    warm_tails = warm_rs.randint(0, 128, (3, 2))
+
+    def _warm(eng):
+        # request 1 alone: whole-prefill + single-slot decode buckets
+        eng.add_request(np.concatenate([warm_shared, warm_tails[0]]),
+                        max_new_tokens=2)
+        eng.run()
+        # requests 2+3 TOGETHER: the cache-hit suffix chunk ladder and
+        # the 2-slot decode bucket the concurrent traffic phase rides
+        eng.add_request(np.concatenate([warm_shared, warm_tails[1]]),
+                        max_new_tokens=8)
+        eng.add_request(np.concatenate([warm_shared, warm_tails[2]]),
+                        max_new_tokens=8)
+        eng.run()
+
+    paddle.set_flags({"FLAGS_debug_thread_checks": True})
+    obs.clear_events()
+    router = None
+    try:
+        router = Router([_mk(), _mk()], policy="prefix_affine",
+                        warmup=_warm)
+        if not router.wait_ready(300):
+            findings.append(analysis.Finding(
+                "fleet", "error", "router/fleet-smoke",
+                "fleet never became ready: "
+                + repr([(n, router.replica(n).state,
+                         router.replica(n).error)
+                        for n in router.replicas])))
+            return findings
+        rs = np.random.RandomState(0)
+        shared = rs.randint(0, 128, (2 * bs + 1,))
+        futs = []
+        # phase 1: sequential shared-prefix stream — prefix_affine must
+        # concentrate it (deterministic placement, deterministic hits)
+        for i in range(6):
+            fut = router.submit(
+                np.concatenate([shared, rs.randint(0, 128, (2,))]),
+                max_new_tokens=2)
+            fut.result(120)
+            futs.append(fut)
+        # phase 2: rolling restart mid-stream — requests in flight on
+        # the hot replica finish in place, nothing drops or duplicates
+        hot = futs[-1].replica
+        for _ in range(4):
+            futs.append(router.submit(
+                np.concatenate([shared, rs.randint(0, 128, (2,))]),
+                max_new_tokens=8))
+        new_name = router.drain(hot, replacement=_mk())
+        for _ in range(4):
+            futs.append(router.submit(
+                np.concatenate([shared, rs.randint(0, 128, (2,))]),
+                max_new_tokens=2))
+        bad = []
+        for fut in futs:
+            try:
+                fut.result(120)
+            except Exception as e:      # noqa: BLE001 — gate evidence
+                bad.append(repr(e))
+            if fut.completions != 1:
+                bad.append(f"completions={fut.completions}")
+        stats = router.fleet_stats()
+        if bad:
+            findings.append(analysis.Finding(
+                "fleet", "error", "router/fleet-smoke",
+                f"rolling restart dropped or duplicated requests: {bad}",
+                data={"bad": bad, "stats": stats}))
+        else:
+            findings.append(analysis.Finding(
+                "fleet", "note", "router/fleet-smoke",
+                f"14-request shared-prefix stream + drain/handoff of "
+                f"{hot} (replacement {new_name}) completed every future "
+                "exactly once"))
+        if stats["affinity_hits"] < 1 or stats["fleet_prefix_hits"] < 1:
+            findings.append(analysis.Finding(
+                "fleet", "error", "router/fleet-smoke",
+                "prefix_affine routed a shared-prefix stream with "
+                f"{stats['affinity_hits']} affinity hit(s) and "
+                f"{stats['fleet_prefix_hits']} fleet prefix-cache "
+                "hit(s) — affinity placement is not concentrating "
+                "shared traffic", data=dict(stats)))
+        findings += analysis.audit_fleet(router, loc="router/fleet-smoke")
+        snap = router.registry.to_dict()
+        missing = [m for m in REQUIRED_FLEET_METRICS if m not in snap]
+        if missing:
+            findings.append(analysis.Finding(
+                "fleet", "error", "router/fleet-smoke",
+                f"router registry is missing required fleet metrics: "
+                f"{missing}"))
+        else:
+            findings.append(analysis.Finding(
+                "fleet", "note", "router/fleet-smoke",
+                f"all {len(REQUIRED_FLEET_METRICS)} required fleet "
+                "metrics present"))
+        # zero post-warmup compiles per replica: traffic and the
+        # replacement's warmup must ride programs the ladder compiled
+        evs = [e for e in obs.compile_events()
+               if e.site.startswith("serving")]
+        findings += obs.audit_recompiles(evs, loc="router/fleet-smoke")
+    finally:
+        if router is not None:
+            router.close()
+        paddle.set_flags({"FLAGS_debug_thread_checks": False})
+
+    # ---- D17 affinity-defeat fire fixture: a fleet whose router-side
+    # fingerprint DRIFTS (unique hashes for byte-identical prompts —
+    # the namespace-mismatch failure mode) must trip the defeat warning
+    # through the real counter plumbing. Consumed here as the fixture
+    # working; silence is the gate failure.
+    fire_router = Router([_mk(), _mk()], policy="prefix_affine",
+                         warmup=_warm)
+    try:
+        if not fire_router.wait_ready(300):
+            findings.append(analysis.Finding(
+                "fleet", "error", "router/fire-fixture",
+                "fire-fixture fleet never became ready"))
+            return findings
+        drift = iter(range(10 ** 6))
+        fire_router._fingerprint = lambda arr: (next(drift),)
+        # same shape as the warmup prompts, so the fixture stream rides
+        # already-compiled buckets
+        prompt = np.random.RandomState(2).randint(
+            0, 128, (2 * bs + 3,)).astype(np.int32)
+        for _ in range(6):
+            fire_router.submit(prompt, max_new_tokens=2).result(120)
+        fire = analysis.audit_fleet(fire_router,
+                                    loc="router/fire-fixture")
+        if any(f.severity == "warning" and "DEFEATED" in f.message
+               for f in fire):
+            findings.append(analysis.Finding(
+                "fleet", "note", "router/fire-fixture",
+                "D17 fire fixture verified: a drifting fingerprint "
+                "scattered byte-identical prompts and tripped the "
+                "affinity-defeat warning"))
+        else:
+            findings.append(analysis.Finding(
+                "fleet", "error", "router/fire-fixture",
+                "D17 detector is SILENTLY DEAD: a drifting router "
+                "fingerprint scattered repeated prompts with zero "
+                "affinity hits and produced no defeat warning",
+                data={"findings": [f.to_dict() for f in fire],
+                      "stats": fire_router.fleet_stats()}))
+    finally:
+        fire_router.close()
+    return findings
+
+
 #: the baseline entries (with their `_matched` counts) of the most
 #: recent run() — the --json payload exposes them so a PARALLEL gate
 #: (check_scoreboard.lint_gate round 17: one subprocess per smoke group)
@@ -1191,7 +1407,8 @@ def run(models=(), ast=True, baseline_path=DEFAULT_BASELINE,
         findings += analysis.lint_tree(REPO)
         findings += analysis.audit_tune_cache()
     smokes = {"paged": audit_serving, "obs": audit_obs,
-              "ckpt": audit_ckpt, "spmd": audit_spmd, "conc": audit_conc}
+              "ckpt": audit_ckpt, "spmd": audit_spmd, "conc": audit_conc,
+              "router": audit_router}
     for name in models:
         findings += smokes.get(name, lambda n=name: audit_model(n))()
     baseline = analysis.load_baseline(baseline_path)
