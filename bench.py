@@ -1649,6 +1649,95 @@ def bench_partitioner_scaling(iters=4, batch=8, seq=128):
                      "in the ledger")}
 
 
+def bench_autoplan(iters=4, batch=8, seq=128):
+    """Round-21 auto-plan rung: `autoplan.search` ranks every valid
+    MeshConfig for the partitioner_scaling tiny-LLaMA statically (one
+    abstract lowering, nothing executes), then the predicted top-3 are
+    ACTUALLY compiled and measured on the 8-device virtual mesh — the
+    row is the cost model's report card: predicted step_ms next to
+    measured step_ms per config, plus D19 calibration over the measured
+    set. Flat numeric keys on purpose: bench_trend flattens one dict
+    level, and predicted/measured walls must trend (lower-better via
+    the ms/mb components)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis
+    from paddle_tpu.distributed.partitioner import autoplan, partition
+    from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny_config
+
+    cfg = llama_tiny_config(hidden_size=128, intermediate_size=256,
+                            num_hidden_layers=4,
+                            max_position_embeddings=seq)
+    paddle.seed(0)
+    t0 = time.perf_counter()
+    report = autoplan.search(LlamaForCausalLM(cfg), 8, batch=batch,
+                             seq=seq)
+    search_wall = time.perf_counter() - t0
+
+    measured = {}
+    rows = {}
+    for cand in report.top(3):
+        mc = cand.config
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+
+        def step(ids, labels, model=model, opt=opt):
+            loss = model(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        pstep = partition(step, mc, model=model)
+        rs = np.random.RandomState(0)
+
+        def batch_pair():
+            return (paddle.to_tensor(rs.randint(
+                        0, cfg.vocab_size, (batch, seq)).astype("int64")),
+                    paddle.to_tensor(rs.randint(
+                        0, cfg.vocab_size, (batch, seq)).astype("int64")))
+
+        for _ in range(3):                     # eager/discovery/compile
+            float(pstep(*batch_pair()))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            float(pstep(*batch_pair()))
+        wall = time.perf_counter() - t0
+        measured[mc.describe()] = iters * batch * seq / wall
+        rows[mc.describe()] = {
+            "predicted_step_ms": round(cand.prediction.step_ms, 3),
+            "measured_step_ms": round(wall / iters * 1e3, 2),
+            "peak_hbm_mb": round(cand.prediction.peak_hbm_mb, 1),
+            "tokens_per_sec": round(measured[mc.describe()], 1),
+        }
+    cal = analysis.audit_cost_model_calibration(report, measured,
+                                                loc="bench/autoplan")
+    top1 = report.candidates[0]
+    top1_row = rows[top1.describe]
+    return {"name": "autoplan",
+            "valid_candidates": len(report.candidates),
+            "rejected_candidates": len(report.rejected),
+            "search_wall_s": round(search_wall, 2),
+            "top1_config": top1.describe,
+            "top1_predicted_step_ms": top1_row["predicted_step_ms"],
+            "top1_measured_step_ms": top1_row["measured_step_ms"],
+            "top1_tokens_per_sec": top1_row["tokens_per_sec"],
+            "peak_hbm_mb": top1_row["peak_hbm_mb"],
+            "predicted_measured_ratio": round(
+                top1_row["predicted_step_ms"]
+                / top1_row["measured_step_ms"], 4),
+            "calibration_errors": sum(1 for f in cal
+                                      if f.severity == "error"),
+            "configs": rows,
+            "note": ("virtual-mesh report card (one host, 8 XLA CPU "
+                     "devices): predicted/measured RATIO is meaningless "
+                     "off-chip (CPU peaks), only the predicted ORDERING "
+                     "vs measured tok/s is gated — D19")}
+
+
 def bench_eager_host(iters=50):
     """bench_eager_dispatch on the host CPU backend (no tunnel RTT), with
     tiny operands so compute is negligible: the framework's own per-op
@@ -1684,6 +1773,7 @@ ALL = {
     "llama_spec_decode": bench_llama_spec_decode,
     "ckpt": bench_ckpt,
     "partitioner_scaling": bench_partitioner_scaling,
+    "autoplan": bench_autoplan,
     "int8": bench_int8,
     "int8_chain": bench_int8_chain,
     "eager": bench_eager_dispatch,
@@ -1702,17 +1792,17 @@ def run_one(name):
         # FRAMEWORK's own overhead (SURVEY §7 hard-part (1) quantified)
         os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
         os.environ["JAX_PLATFORMS"] = "cpu"
-    elif name == "partitioner_scaling":
-        # the partitioner rung needs the 8-device virtual mesh (same
-        # platform tests/conftest.py and the spmd lint smoke force);
-        # rows land platform:"cpu" = excluded from README claims
+    elif name in ("partitioner_scaling", "autoplan"):
+        # the partitioner/auto-plan rungs need the 8-device virtual mesh
+        # (same platform tests/conftest.py and the spmd lint smoke
+        # force); rows land platform:"cpu" = excluded from README claims
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
             + " --xla_force_host_platform_device_count=8").strip()
         os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
-    if name in ("eager_host", "partitioner_scaling"):
+    if name in ("eager_host", "partitioner_scaling", "autoplan"):
         jax.config.update("jax_platforms", "cpu")
 
     # persistent compile cache: subprocess isolation must not mean
@@ -1810,7 +1900,7 @@ _COST_EST = {
     "decode_1b": 190, "decode_micro": 90, "llama_serving": 180,
     "llama_serving_slo": 200, "llama_spec_decode": 220,
     "llama_fleet_slo": 240,
-    "ckpt": 150, "partitioner_scaling": 150,
+    "ckpt": 150, "partitioner_scaling": 150, "autoplan": 150,
     "int8_chain": 70, "int8": 60, "eager": 25,
     "eager_host": 15, "fused_adam": 170,
 }
@@ -1857,7 +1947,7 @@ def main(argv):
                "llama_serving", "llama_serving_slo", "llama_spec_decode",
                "llama_fleet_slo",
                "ckpt",
-               "partitioner_scaling", "fused_micro",
+               "partitioner_scaling", "autoplan", "fused_micro",
                "longctx_8k", "flashmask_16k", "longctx_4k",
                "flashmask_8k", "llama_bf16", "gpt_sharding", "bert_bf16",
                "llama", "lenet", "decode_1b", "resnet50_bf16", "bert",

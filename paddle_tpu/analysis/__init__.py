@@ -110,12 +110,36 @@ Fleet detector (round 20, serving.py):
                          scattered across replicas with zero fingerprint
                          matches) — gated by the graft_lint `router`
                          smoke.
+
+Plan detectors (round 21, costmodel.py — the static cost model over the
+ProgramIndex: per-eqn flops/bytes rooflines, alpha-beta ICI/DCN
+collective model, liveness peak-HBM; distributed/partitioner/autoplan.py
+enumerates + ranks MeshConfigs with it):
+  D18 audit_plan         the deployed MeshConfig predicted
+                         >= FLAGS_analysis_plan_regress_pct slower than
+                         the best valid candidate in its PlanReport is
+                         a warning; predicted peak HBM over
+                         FLAGS_analysis_hbm_limit_mb (or a chosen config
+                         the search rejected) is an error — an OOM
+                         caught by lint, never by the runtime
+  D19 audit_cost_model_calibration  the predicted top-k ordering must
+                         match MEASURED partitioner_scaling tok/s
+                         ordering (within the
+                         FLAGS_analysis_calibration_tol_pct tie band) —
+                         a cost model that misorders real configs is a
+                         silently-dead analysis and fails the gate
+                         (graft_lint `plan` smoke + bench `autoplan`
+                         rung)
 """
 from .ast_lint import (audit_flags_doc, lint_dy2static, lint_file,
                        lint_tree, lint_vjp_saves, lint_x64)
 from .concurrency import (audit_concurrency, audit_contract_callsites,
                           audit_lock_order, audit_shared_state,
                           audit_thread_contracts, lint_guarded_by)
+from .costmodel import (CostPrediction, audit_cost_model_calibration,
+                        audit_plan, collective_time, collective_time_us,
+                        estimate_bytes, estimate_flops,
+                        liveness_peak_bytes, predict_step)
 from .dataflow import ProgramIndex, build_index
 from .findings import (Finding, apply_baseline, format_text, gate_failures,
                        load_baseline, stale_suppressions, to_json)
@@ -183,4 +207,7 @@ __all__ = [
     "lint_vjp_saves", "lint_x64",
     "audit_concurrency", "audit_contract_callsites", "audit_lock_order",
     "audit_shared_state", "audit_thread_contracts", "lint_guarded_by",
+    "CostPrediction", "audit_plan", "audit_cost_model_calibration",
+    "collective_time", "collective_time_us", "estimate_bytes",
+    "estimate_flops", "liveness_peak_bytes", "predict_step",
 ]

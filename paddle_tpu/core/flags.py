@@ -187,6 +187,35 @@ define_flag("FLAGS_analysis_collective_min_bytes", 65536,
             "slice ops fires the accidental-all-gather warning only at "
             "or above this per-device byte volume (smaller gathers stay "
             "attribution notes)")
+define_flag("FLAGS_analysis_ici_gbps", 90.0,
+            "per-link ICI bandwidth (GB/s) the static cost model "
+            "(analysis/costmodel.py) charges collectives on intra-slice "
+            "mesh axes against in its alpha-beta model")
+define_flag("FLAGS_analysis_dcn_gbps", 12.5,
+            "per-host DCN bandwidth (GB/s) for collectives on mesh axes "
+            "a MeshConfig maps to the data-center network "
+            "(MeshConfig.dcn_axes — the hybrid-mesh fabric split)")
+define_flag("FLAGS_analysis_ici_alpha_us", 1.0,
+            "per-hop ICI latency (microseconds) — the alpha term of the "
+            "static cost model's alpha-beta collective estimate")
+define_flag("FLAGS_analysis_dcn_alpha_us", 25.0,
+            "per-hop DCN latency (microseconds) — the alpha term for "
+            "collectives on dcn-mapped mesh axes")
+define_flag("FLAGS_analysis_plan_regress_pct", 20.0,
+            "D18 audit_plan threshold: the chosen MeshConfig predicted "
+            "at least this percent slower than the best valid candidate "
+            "in the same PlanReport is a lint warning")
+define_flag("FLAGS_analysis_hbm_limit_mb", 0.0,
+            "per-device HBM budget (MiB) for the static liveness pass: "
+            "a candidate plan whose predicted peak exceeds it is "
+            "rejected in autoplan.search and is a D18 error for the "
+            "chosen config (0 = no budget check)")
+define_flag("FLAGS_analysis_calibration_tol_pct", 10.0,
+            "D19 audit_cost_model_calibration tie tolerance: a "
+            "predicted-order pair only counts as a misprediction when "
+            "the measured tok/s of the predicted-slower config beats "
+            "the predicted-faster one by more than this percent "
+            "(virtual-mesh walls are noisy; near-ties are not signal)")
 define_flag("FLAGS_pallas_decode", True,
             "route paged decode attention through the Pallas flash-decode "
             "kernel (ops/pallas_decode.py) on TPU above the size "

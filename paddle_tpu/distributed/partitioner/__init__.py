@@ -11,23 +11,35 @@ Sharding-aware checkpoints ride `ckpt` manifest v2: per-shard save keyed
 by Shard.index (`save_partitioned`), resharding-on-restore via
 `restore_partitioned` (restore a data4×tp2 run onto data2×tp4 — or onto
 one device).
+
+`autoplan.search(model, pod_shape)` closes the choose-the-config loop:
+enumerate every valid MeshConfig through the rule-table guards, score
+each against the static cost model (analysis/costmodel.py — roofline
+compute/HBM + alpha-beta ICI/DCN collectives + liveness peak-HBM), and
+return a ranked PlanReport that D18/D19 gate in CI.
 """
 from __future__ import annotations
 
-from .api import (active_config, annotate, maybe_sep_attention, partition,
-                  place_plan, shard_model)
+from .api import (active_config, annotate, build_plan,
+                  maybe_sep_attention, partition, place_plan,
+                  shard_model)
+from .autoplan import (PlanCandidate, PlanReport, enumerate_configs,
+                       search)
 from .checkpoint import (PartitionedRestore, restore_partitioned,
                          save_partitioned)
 from .mesh import AXIS_NAMES, MeshConfig
 from .rules import (DEFAULT_RULES, REPLICATED_RULES, ParamDecision,
                     PartitionPlan, infer_logical_axes, spec_for_param)
+from . import autoplan
 
 __all__ = [
     "MeshConfig", "AXIS_NAMES",
     "DEFAULT_RULES", "REPLICATED_RULES",
     "PartitionPlan", "ParamDecision",
-    "partition", "shard_model", "place_plan", "annotate",
+    "partition", "shard_model", "build_plan", "place_plan", "annotate",
     "active_config", "maybe_sep_attention",
     "save_partitioned", "restore_partitioned", "PartitionedRestore",
     "infer_logical_axes", "spec_for_param",
+    "autoplan", "PlanCandidate", "PlanReport", "enumerate_configs",
+    "search",
 ]

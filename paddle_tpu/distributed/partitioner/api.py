@@ -147,13 +147,12 @@ def annotate(param, axes) -> None:
 
 
 # --------------------------------------------------------- shard_model
-def shard_model(model, config: MeshConfig, mesh=None) -> PartitionPlan:
-    """Place every parameter of `model` per the config's rule table and
-    install the stream-constraint hooks. Idempotent: re-running on a new
-    config re-places (the resharding-on-restore path re-uses it)."""
+def build_plan(model, config: MeshConfig, mesh=None) -> PartitionPlan:
+    """Every placement decision the rule table makes for (model, config)
+    WITHOUT touching a device buffer — the abstract half of
+    `shard_model`. The autoplan scorer ranks candidate configs with it
+    (mesh may be None: no devices are required to decide specs)."""
     network = getattr(model, "network", model)   # accept hapi Model
-    if mesh is None:
-        mesh = config.build_mesh()
     plan = PartitionPlan(config, mesh)
     use_heuristics = bool(flag("FLAGS_partitioner_heuristics"))
     for name, p in network.named_parameters():
@@ -167,6 +166,20 @@ def shard_model(model, config: MeshConfig, mesh=None) -> PartitionPlan:
         if axes is not None:
             d.spec, d.notes = spec_for_param(name, p.shape, axes, config)
         plan.add(d)
+    return plan
+
+
+def shard_model(model, config: MeshConfig, mesh=None) -> PartitionPlan:
+    """Place every parameter of `model` per the config's rule table and
+    install the stream-constraint hooks. Idempotent: re-running on a new
+    config re-places (the resharding-on-restore path re-uses it)."""
+    network = getattr(model, "network", model)   # accept hapi Model
+    if mesh is None:
+        mesh = config.build_mesh()
+    plan = build_plan(model, config, mesh)
+    by_name = {d.name: d for d in plan.decisions}
+    for name, p in network.named_parameters():
+        d = by_name[name]
         spec = P(*d.spec) if d.spec else P(*([None] * p.ndim))
         p._assign_raw(jax.device_put(p._data, NamedSharding(mesh, spec)))
     for _lname, layer in network.named_sublayers(include_self=True):

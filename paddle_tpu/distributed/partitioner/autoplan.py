@@ -1,0 +1,427 @@
+"""autoplan — enumerate and rank MeshConfigs before anything runs.
+
+ROADMAP item 4: "which mesh should I use for this model on this pod?"
+as an analysis pass. `search(model, pod_shape)` —
+
+  1. ENUMERATES every (data, fsdp, tp, sep) factorization of the pod
+     through the round-18 rule-table guards: batch/seq divisibility,
+     and no DEAD axis (a mesh axis of size > 1 that no parameter spec,
+     batch placement or stream-seq placement uses would fail D9's
+     coverage audit at runtime — here it is rejected statically with
+     the guard's own divisibility notes).
+  2. LOWERS the train step abstractly ONCE: `jax.make_jaxpr` over the
+     model's forward + `jax.value_and_grad` — no eager step, no
+     compile, no devices touched. The eqn structure is shared across
+     candidates; what differs per candidate is the PLAN (`build_plan`,
+     the no-placement half of shard_model) and everything derived from
+     it.
+  3. SCORES each candidate with analysis/costmodel.predict_step:
+     compute/HBM divided by the plan's parallelism (batch shards ×
+     sep × an Amdahl term for the tp-sharded matmul fraction), an
+     alpha-beta collective bill derived from the plan (grad psum over
+     `data`, ZeRO all-gather/reduce-scatter over `fsdp`, per-block
+     activation psums over `tp`, ring-attention ppermutes over `sep` —
+     GSPMD inserts these in HLO below the jaxpr, the D10 boundary, so
+     the plan is the only static source), and a liveness peak-HBM pass
+     with per-device shard bytes and donated params (the step donates
+     its mut captures — D2's records).
+  4. Returns a ranked `PlanReport`. Candidates whose predicted peak
+     HBM exceeds `FLAGS_analysis_hbm_limit_mb` are REJECTED with a
+     named `plan-hbm` Finding — an OOM caught by lint, not by the
+     runtime.
+
+The report feeds two gated detectors (analysis/costmodel.py): D18
+`audit_plan` (is the config you deployed defensible against the
+search?) and D19 `audit_cost_model_calibration` (does the predicted
+top-k ordering match measured partitioner_scaling tok/s? — a
+mispredicting model fails the gate). `tools/autoplan_report.py` is the
+CLI; the graft_lint `plan` smoke and the bench `autoplan` rung wire
+both detectors into CI.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ...analysis import costmodel
+from ...analysis.dataflow import ProgramIndex, _nbytes, _shape_dtype
+from ...analysis.findings import Finding
+from ...core.flags import flag
+from .api import build_plan
+from .mesh import MeshConfig
+
+#: bytes of AdamW optimizer state per parameter byte (m + v moments,
+#: fp32 like the params) — the traced jaxpr sees only fwd+bwd, the
+#: update's footprint is charged analytically
+_OPT_STATE_FACTOR = 2.0
+
+
+# ---------------------------------------------------------- enumerate
+def _factorizations(n: int) -> list:
+    """Every (data, fsdp, tp, sep) with product exactly n, sorted for a
+    deterministic candidate order."""
+    divs = [d for d in range(1, n + 1) if n % d == 0]
+    out = []
+    for d in divs:
+        for f in divs:
+            if n % (d * f):
+                continue
+            for t in divs:
+                if n % (d * f * t):
+                    continue
+                out.append((d, f, t, n // (d * f * t)))
+    return sorted(out)
+
+
+def _spec_axes(spec_entry) -> tuple:
+    if not spec_entry:
+        return ()
+    if isinstance(spec_entry, str):
+        return (spec_entry,)
+    return tuple(spec_entry)
+
+
+def enumerate_configs(num_devices: int, *, model=None, batch=None,
+                      seq=None, include_sep=True, dcn_axes=(),
+                      rules=None) -> tuple:
+    """(valid, rejected): every factorization of `num_devices` that
+    passes the rule-table guards, plus the drops with NAMED reasons.
+
+    Validity: the batch must divide over data×fsdp, the sequence over
+    sep, and every mesh axis of size > 1 must be USED — by the batch
+    placement, the stream-seq placement, or at least one parameter's
+    post-guard spec (`build_plan` runs the real spec_for_param guards,
+    so a 4-head model offered tp=8 rejects with the guard's own
+    divisibility notes)."""
+    valid, rejected = [], []
+    for d, f, t, s in _factorizations(int(num_devices)):
+        if s > 1 and not include_sep:
+            continue
+        mc = MeshConfig(data=d, fsdp=f, tp=t, sep=s,
+                        dcn_axes=tuple(dcn_axes),
+                        **({"rules": rules} if rules else {}))
+        sizes = mc.axis_sizes
+        reasons = []
+        batch_shard = d * f
+        if batch is not None and batch_shard > 1 and batch % batch_shard:
+            reasons.append(f"batch {batch} not divisible by "
+                           f"data*fsdp={batch_shard}")
+        if s > 1 and seq is not None and seq % s:
+            reasons.append(f"seq {seq} not divisible by sep={s}")
+        if model is not None and not reasons:
+            plan = build_plan(model, mc)
+            used = set()
+            if batch_shard > 1 and (batch is None
+                                    or batch % batch_shard == 0):
+                used.update(a for a in mc.batch_axes
+                            if sizes.get(a, 1) > 1)
+            sa = mc.seq_axis
+            if sizes.get(sa, 1) > 1 and seq is not None \
+                    and seq % sizes[sa] == 0:
+                used.add(sa)
+            for dec in plan.decisions:
+                for entry in dec.spec:
+                    used.update(_spec_axes(entry))
+            for a, sz in sizes.items():
+                if sz > 1 and a not in used:
+                    notes = [n for dec in plan.decisions
+                             for n in dec.notes
+                             if f"by {a}=" in n][:2]
+                    reasons.append(
+                        f"mesh axis {a!r}={sz} used by no parameter, "
+                        "batch or stream placement (dead axis — would "
+                        "fail D9 coverage)"
+                        + (f"; guard notes: {notes}" if notes else ""))
+        if reasons:
+            rejected.append({"config": mc.describe(), "reasons": reasons})
+        else:
+            valid.append(mc)
+    return valid, rejected
+
+
+# ------------------------------------------------------ abstract trace
+def _trace_step(model, batch: int, seq: int):
+    """ONE abstract lowering of the model's train step: jax.make_jaxpr
+    over forward + value_and_grad. Nothing executes — the returned
+    ClosedJaxpr, the invar→param-name map (for shard-aware liveness)
+    and the donated invar positions (params are the step's mut
+    captures) are all the scorer needs."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as _paddle
+
+    network = getattr(model, "network", model)
+    diff = [(n, p) for n, p in network.named_parameters()
+            if np.issubdtype(np.dtype(str(p._data.dtype)), np.floating)]
+    if not diff:
+        raise ValueError("autoplan.search: model has no floating-point "
+                         "parameters to differentiate")
+    ids = jnp.zeros((int(batch), int(seq)), dtype=jnp.int64)
+    labels = jnp.zeros((int(batch), int(seq)), dtype=jnp.int64)
+
+    def _wrap(x):
+        t = _paddle.Tensor(np.zeros((), dtype=np.int64),
+                           stop_gradient=True)
+        t._data = x
+        return t
+
+    def run(datas, ids_, labels_):
+        saved = [p._data for _, p in diff]
+        try:
+            for (_, p), dnew in zip(diff, datas):
+                p._data = dnew
+            out = model(_wrap(ids_), _wrap(labels_))
+            if isinstance(out, (tuple, list)):
+                out = out[0]
+            return out._data if hasattr(out, "_data") else out
+        finally:
+            for (_, p), sold in zip(diff, saved):
+                p._data = sold
+
+    def fwd_bwd(datas, ids_, labels_):
+        return jax.value_and_grad(run)(datas, ids_, labels_)
+
+    closed = jax.make_jaxpr(fwd_bwd)([p._data for _, p in diff],
+                                     ids, labels)
+    n = len(diff)
+    invar_param = {id(v): name
+                   for v, (name, _p) in zip(closed.jaxpr.invars[:n], diff)}
+    return closed, invar_param, tuple(range(n)), diff
+
+
+def _model_dims(model, diff) -> tuple:
+    """(hidden, layers) from the model config when it carries one, else
+    shape heuristics (widest square-ish dim; rank>=2 params / 6)."""
+    cfg = getattr(model, "config", None) \
+        or getattr(getattr(model, "network", model), "config", None)
+    hidden = int(getattr(cfg, "hidden_size", 0) or 0)
+    layers = int(getattr(cfg, "num_hidden_layers", 0)
+                 or getattr(cfg, "num_layers", 0) or 0)
+    mats = [p.shape for _n, p in diff if len(p.shape) >= 2]
+    if not hidden:
+        hidden = max((min(int(s) for s in sh) for sh in mats), default=1)
+    if not layers:
+        layers = max(len(mats) // 6, 1)
+    return hidden, layers
+
+
+# --------------------------------------------------------------- score
+def _param_stats(model, plan, config) -> dict:
+    """Plan-derived byte volumes the collective/liveness models need."""
+    network = getattr(model, "network", model)
+    by_name = {d.name: d for d in plan.decisions}
+    sizes = config.axis_sizes
+    div_by_name: dict = {}
+    shape_div: dict = {}
+    p_dev = fsdp_gather = mat_total = mat_tp = 0.0
+    for name, p in network.named_parameters():
+        d = by_name.get(name)
+        item = np.dtype(str(p._data.dtype)).itemsize
+        nbytes = float(np.prod(p.shape)) * item if len(p.shape) else item
+        axes: set = set()
+        if d is not None:
+            for entry in d.spec:
+                axes.update(_spec_axes(entry))
+        div = float(np.prod([sizes.get(a, 1) for a in axes])) or 1.0
+        div_by_name[name] = div
+        sh = tuple(int(s) for s in p.shape)
+        shape_div[sh] = max(shape_div.get(sh, 1.0), div)
+        p_dev += nbytes / div
+        if "fsdp" in axes:
+            # the per-use ZeRO all-gather materializes the param minus
+            # its OTHER shard axes (tp stays sharded through the gather)
+            fsdp_gather += nbytes / (div / sizes.get("fsdp", 1))
+        if len(p.shape) >= 2:
+            mat_total += nbytes
+            if "tp" in axes:
+                mat_tp += nbytes
+    return {"p_dev": p_dev, "fsdp_gather": fsdp_gather,
+            "f_tp": (mat_tp / mat_total) if mat_total else 0.0,
+            "div_by_name": div_by_name, "shape_div": shape_div}
+
+
+def _score(index, config, plan, stats, *, batch, seq, hidden, layers,
+           invar_param, donated) -> costmodel.CostPrediction:
+    sizes = config.axis_sizes
+    batch_shard = sizes.get("data", 1) * sizes.get("fsdp", 1)
+    tp, sep = sizes.get("tp", 1), sizes.get("sep", 1)
+    f_tp = stats["f_tp"]
+    amdahl = 1.0 / ((1.0 - f_tp) + f_tp / tp) if tp > 1 else 1.0
+    divisor = max(batch_shard * sep * amdahl, 1.0)
+    act_item = 4.0                          # fp32 residual stream
+    extra = []
+    if sizes.get("data", 1) > 1:
+        extra.append(("psum", "data", stats["p_dev"], 1))
+    if sizes.get("fsdp", 1) > 1 and stats["fsdp_gather"] > 0:
+        extra.append(("all_gather", "fsdp", stats["fsdp_gather"], 2))
+        extra.append(("reduce_scatter", "fsdp", stats["fsdp_gather"], 1))
+    if tp > 1:
+        stream = batch * seq * hidden * act_item / batch_shard
+        extra.append(("psum", "tp", stream, 4 * layers))
+    ring_hbm = 0.0
+    if sep > 1:
+        kv = 2.0 * batch * seq * hidden * act_item / (batch_shard * sep)
+        hops = 2 * layers * (sep - 1)
+        extra.append(("ppermute", "sep", kv, hops))
+        # Each ring stage is a DEPENDENT step: re-read the arriving K/V
+        # chunk and rescale the output accumulator before the next hop
+        # can start — serial HBM traffic the roofline max can't hide.
+        ring_hbm = hops * (kv + kv / 2.0)
+
+    shape_div = stats["shape_div"]
+    div_by_name = stats["div_by_name"]
+
+    def live_bytes(var):
+        nb = _nbytes(var)
+        name = invar_param.get(id(var))
+        if name is not None:
+            return nb / div_by_name.get(name, 1.0)
+        shape, _dt = _shape_dtype(var)
+        if shape in shape_div:              # grads/updates mirror params
+            return nb / shape_div[shape]
+        if shape and len(shape) >= 2 and shape[0] == batch \
+                and batch_shard > 1 and batch % batch_shard == 0:
+            div = float(batch_shard)
+            if len(shape) >= 3 and shape[1] == seq and sep > 1:
+                div *= sep
+            return nb / div
+        return nb
+
+    notes = []
+    if f_tp and tp > 1:
+        notes.append(f"tp shards {f_tp:.0%} of matmul weight bytes "
+                     f"(Amdahl compute factor {amdahl:.2f})")
+    return costmodel.predict_step(
+        index, config, compute_divisor=divisor, hbm_divisor=divisor,
+        donated=donated, live_bytes=live_bytes, extra_collectives=extra,
+        extra_hbm_bytes=int(_OPT_STATE_FACTOR * stats["p_dev"]),
+        extra_serial_bytes=int(ring_hbm), notes=notes)
+
+
+# -------------------------------------------------------------- report
+@dataclass
+class PlanCandidate:
+    """One ranked candidate: the config, its prediction, and the plan's
+    shape (sharded/heuristic/dropped counts)."""
+
+    config: MeshConfig
+    prediction: costmodel.CostPrediction
+    plan_summary: dict = field(default_factory=dict)
+    notes: tuple = ()
+
+    @property
+    def describe(self) -> str:
+        return self.config.describe()
+
+    def to_dict(self) -> dict:
+        return {"config": self.describe,
+                "prediction": self.prediction.to_dict(),
+                "plan": self.plan_summary, "notes": list(self.notes)}
+
+
+@dataclass
+class PlanReport:
+    """Ranked output of `search`: `candidates` best-first (predicted
+    step_ms), `rejected` with named reasons, `findings` (plan-hbm
+    rejections) for the Finding/baseline machinery."""
+
+    model: str
+    num_devices: int
+    batch: int
+    seq: int
+    candidates: list = field(default_factory=list)
+    rejected: list = field(default_factory=list)
+    findings: list = field(default_factory=list)
+
+    @property
+    def chosen(self) -> str | None:
+        return self.candidates[0].describe if self.candidates else None
+
+    def top(self, n: int = 3) -> list:
+        return self.candidates[:max(int(n), 0)]
+
+    def to_dict(self) -> dict:
+        return {"model": self.model, "num_devices": self.num_devices,
+                "batch": self.batch, "seq": self.seq,
+                "chosen": self.chosen,
+                "candidates": [c.to_dict() for c in self.candidates],
+                "rejected": list(self.rejected)}
+
+    def format_text(self) -> str:
+        lines = [f"autoplan: {self.model} on {self.num_devices} devices "
+                 f"(batch={self.batch}, seq={self.seq}) — "
+                 f"{len(self.candidates)} valid, "
+                 f"{len(self.rejected)} rejected"]
+        hdr = (f"{'rank':>4}  {'config':<28} {'pred ms':>9} "
+               f"{'compute':>9} {'hbm':>9} {'coll':>9} {'peak MiB':>9} "
+               f"{'sharded':>8}")
+        lines += [hdr, "-" * len(hdr)]
+        for i, c in enumerate(self.candidates):
+            p = c.prediction
+            lines.append(
+                f"{i + 1:>4}  {c.describe:<28} {p.step_ms:>9.3f} "
+                f"{p.compute_ms:>9.3f} {p.hbm_ms:>9.3f} "
+                f"{p.collective_ms:>9.3f} {p.peak_hbm_mb:>9.1f} "
+                f"{c.plan_summary.get('sharded', 0):>8}")
+        for r in self.rejected:
+            lines.append(f"  rejected {r['config']}: "
+                         f"{'; '.join(r['reasons'])}")
+        return "\n".join(lines)
+
+
+# -------------------------------------------------------------- search
+def search(model, pod_shape, *, batch: int = 8, seq: int = 128,
+           include_sep: bool = True, hbm_limit_mb: float | None = None,
+           dcn_axes=(), candidates=None, rules=None) -> PlanReport:
+    """Rank every valid MeshConfig for `model` on a pod of `pod_shape`
+    devices (int or dim tuple) — statically, before anything runs.
+
+    `candidates` overrides enumeration with an explicit config list
+    (the calibration fire-fixture rigs fabrics this way); candidates
+    whose predicted peak HBM exceeds `hbm_limit_mb`
+    (FLAGS_analysis_hbm_limit_mb; 0 = off) are rejected with a named
+    `plan-hbm` Finding instead of ranked."""
+    num_devices = int(np.prod(pod_shape)) \
+        if isinstance(pod_shape, (tuple, list)) else int(pod_shape)
+    if hbm_limit_mb is None:
+        hbm_limit_mb = float(flag("FLAGS_analysis_hbm_limit_mb"))
+    if candidates is None:
+        cands, rejected = enumerate_configs(
+            num_devices, model=model, batch=batch, seq=seq,
+            include_sep=include_sep, dcn_axes=dcn_axes, rules=rules)
+    else:
+        cands, rejected = list(candidates), []
+    closed, invar_param, donated, diff = _trace_step(model, batch, seq)
+    index = ProgramIndex(closed)
+    hidden, layers = _model_dims(model, diff)
+    name = type(getattr(model, "network", model)).__name__
+    report = PlanReport(model=name, num_devices=num_devices,
+                        batch=int(batch), seq=int(seq),
+                        rejected=rejected)
+    for mc in cands:
+        plan = build_plan(model, mc)
+        stats = _param_stats(model, plan, mc)
+        pred = _score(index, mc, plan, stats, batch=int(batch),
+                      seq=int(seq), hidden=hidden, layers=layers,
+                      invar_param=invar_param, donated=donated)
+        if hbm_limit_mb > 0 and pred.peak_hbm_mb > hbm_limit_mb:
+            reason = (f"predicted peak HBM {pred.peak_hbm_mb:.1f} MiB "
+                      f"over the {hbm_limit_mb:g} MiB budget")
+            report.rejected.append({"config": mc.describe(),
+                                    "reasons": [reason]})
+            report.findings.append(Finding(
+                "plan-hbm", "note", f"autoplan:{mc.describe()}",
+                f"candidate {mc.describe()} rejected statically: "
+                f"{reason} (FLAGS_analysis_hbm_limit_mb) — this plan "
+                "would OOM at runtime; the liveness pass caught it at "
+                "lint time",
+                data={"config": mc.describe(),
+                      "peak_hbm_mb": round(pred.peak_hbm_mb, 2),
+                      "hbm_limit_mb": hbm_limit_mb}))
+            continue
+        report.candidates.append(PlanCandidate(
+            config=mc, prediction=pred, plan_summary=plan.summary()))
+    report.candidates.sort(key=lambda c: c.prediction.step_ms)
+    return report

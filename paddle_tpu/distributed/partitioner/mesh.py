@@ -51,6 +51,11 @@ class MeshConfig:
     rules: tuple | None = None
     batch_axes: tuple = ("data", "fsdp")
     stream_seq_axis: str | None = None
+    #: mesh axes whose collectives cross the data-center network instead
+    #: of ICI (the ROADMAP hybrid-mesh split: dp over DCN, everything
+    #: else intra-slice). The static cost model charges these axes at
+    #: FLAGS_analysis_dcn_gbps / _dcn_alpha_us.
+    dcn_axes: tuple = ()
 
     def __post_init__(self):
         for name in AXIS_NAMES:
@@ -62,6 +67,11 @@ class MeshConfig:
         if bad:
             raise ValueError(
                 f"MeshConfig.batch_axes names unknown mesh axes {bad} "
+                f"(known: {AXIS_NAMES})")
+        bad = [a for a in self.dcn_axes if a not in AXIS_NAMES]
+        if bad:
+            raise ValueError(
+                f"MeshConfig.dcn_axes names unknown mesh axes {bad} "
                 f"(known: {AXIS_NAMES})")
         if self.stream_seq_axis is not None \
                 and self.stream_seq_axis not in AXIS_NAMES:
@@ -81,6 +91,11 @@ class MeshConfig:
     @property
     def num_devices(self) -> int:
         return int(np.prod(list(self.axis_sizes.values())))
+
+    def fabric(self, axis: str) -> str:
+        """Which interconnect a collective over `axis` rides: "dcn" when
+        the config maps the axis across hosts, else "ici"."""
+        return "dcn" if axis in self.dcn_axes else "ici"
 
     @property
     def seq_axis(self) -> str:
@@ -125,10 +140,15 @@ class MeshConfig:
 
     # ------------------------------------------------------ serialization
     def to_dict(self) -> dict:
-        return {"axes": self.axis_sizes}
+        d = {"axes": self.axis_sizes}
+        if self.dcn_axes:
+            d["dcn_axes"] = list(self.dcn_axes)
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "MeshConfig":
         axes = dict(d.get("axes", d))
-        return cls(**{k: int(v) for k, v in axes.items()
-                      if k in AXIS_NAMES})
+        kw = {k: int(v) for k, v in axes.items() if k in AXIS_NAMES}
+        if isinstance(d, dict) and d.get("dcn_axes"):
+            kw["dcn_axes"] = tuple(d["dcn_axes"])
+        return cls(**kw)

@@ -162,8 +162,27 @@ class ProgramCost:
         g = self.achieved_gbps()
         return None if g is None else g / peak_gbps()
 
+    def predicted(self) -> tuple[float | None, float | None]:
+        """(predicted_step_ms, collective_time_ms) for this program from
+        the static cost model (analysis/costmodel.py): roofline
+        max(compute, HBM) at the obs peaks plus the program's D10
+        collective volume billed at the ICI line rate. None when XLA
+        never analyzed the executable."""
+        if not self.analyzed:
+            return None, None
+        from .goodput import peak_tflops
+
+        coll_ms = 0.0
+        if self.collective_bytes:
+            coll_ms = self.collective_bytes \
+                / (float(flag("FLAGS_analysis_ici_gbps")) * 1e9) * 1e3
+        compute_ms = self.flops / (peak_tflops() * 1e12) * 1e3
+        hbm_ms = self.bytes_accessed / (peak_gbps() * 1e9) * 1e3
+        return max(compute_ms, hbm_ms) + coll_ms, coll_ms
+
     def to_dict(self) -> dict:
         g = self.achieved_gbps()
+        pred_ms, coll_ms = self.predicted()
         return {"program": self.program, "site": self.site,
                 "group": self.group, "key": self.key, "bucket": self.bucket,
                 "analyzed": self.analyzed, "flops": self.flops,
@@ -172,6 +191,10 @@ class ProgramCost:
                 "temp_bytes": self.temp_bytes,
                 "peak_hbm_bytes": self.peak_hbm_bytes,
                 "collective_bytes": self.collective_bytes,
+                "predicted_step_ms": (None if pred_ms is None
+                                      else round(pred_ms, 4)),
+                "collective_time_ms": (None if coll_ms is None
+                                       else round(coll_ms, 4)),
                 "compile_wall_s": round(self.compile_wall_s, 4),
                 "exec_count": self.exec_count,
                 "exec_wall_s": round(self.exec_wall_s, 6),
@@ -248,6 +271,7 @@ def clear_ledger():
 
     _ledger.clear()
     _site_counts.clear()
+    _baselined_this_run.clear()
     eager_rows_dropped = 0
 
 
@@ -270,6 +294,13 @@ def roofline_rows(site: str | None = None, measured_only: bool = False
 
 
 # -------------------------------------------------------------- baseline
+#: programs committed by write_baseline() IN THIS PROCESS — D8 skips its
+#: "new unbaselined program" note for them, so `roofline_report
+#: --write-baseline` followed by an audit in the same run doesn't nag
+#: about rows it just wrote to disk itself
+_baselined_this_run: set = set()
+
+
 def write_baseline(path: str, site: str = "serving",
                    threshold_pct: float | None = None) -> dict:
     """Commit the current ledger's analyzed programs as the D8 baseline.
@@ -282,6 +313,7 @@ def write_baseline(path: str, site: str = "serving",
                          "flops": e.flops,
                          "peak_hbm_bytes": e.peak_hbm_bytes}
              for e in ledger(site) if e.analyzed}
+    _baselined_this_run.update(progs)
     base = {"_comment": "analysis D8 baseline: per-program XLA "
                         "bytes-accessed/flops from the graft_lint obs "
                         "smoke (tiny-LLaMA serving engine). Regenerate "
@@ -368,7 +400,8 @@ def audit_cost_regressions(baseline, entries=None,
             f"{'...' if len(missing) > 4 else ''}",
             data={"missing": missing}))
     new = sorted(pid for pid, e in cur.items()
-                 if e.analyzed and pid not in base.get("programs", {}))
+                 if e.analyzed and pid not in base.get("programs", {})
+                 and pid not in _baselined_this_run)
     if new:
         findings.append(Finding(
             "cost-regression", "note", loc,

@@ -54,7 +54,7 @@ QUICK_MODULES = {
     "test_amp.py", "test_analysis.py", "test_autograd.py",
     "test_aux_subsystems.py",
     "test_bf16.py", "test_ckpt.py", "test_concurrency.py",
-    "test_dispatch_cache.py",
+    "test_costmodel.py", "test_dispatch_cache.py",
     "test_dist_checkpoint.py",
     "test_distributed_core.py", "test_dy2static.py", "test_flags_doc.py",
     "test_flagship_perf.py", "test_flight.py",

@@ -1,0 +1,414 @@
+"""Static cost model + auto-plan search (round 21).
+
+The contract under test is the ISSUE acceptance line: `autoplan.search`
+ranks >= 6 valid MeshConfigs for tiny-LLaMA on the 8-device virtual
+mesh from ONE abstract lowering (nothing executes), the alpha-beta
+collective model reproduces hand-computed numbers exactly, the
+liveness pass prices donation (3N vs 2N on a 3-op chain), an over-HBM
+plan is rejected statically with a named `plan-hbm` Finding, and the
+D18/D19 detectors each have a fire + no-fire pair.
+"""
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis
+from paddle_tpu.analysis import costmodel
+from paddle_tpu.distributed.partitioner import (MeshConfig, autoplan,
+                                                enumerate_configs)
+from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _severities(findings):
+    return [f.severity for f in findings]
+
+
+def _gate(findings):
+    return [f for f in findings if f.severity in ("warning", "error")]
+
+
+# ----------------------------------------------------- alpha-beta model
+class TestCollectiveModel:
+    def test_all_gather_hand_check(self):
+        # 1 MB over a 2-device axis at 1 GB/s with 1 us alpha:
+        # (2-1) * (1 + (1e6/2)/1e3) = 501 us, exactly
+        us = costmodel.collective_time_us("all_gather", 1e6, 2,
+                                          gbps=1.0, alpha_us=1.0)
+        assert us == pytest.approx(501.0)
+
+    def test_psum_is_reduce_scatter_plus_all_gather(self):
+        us = costmodel.collective_time_us("psum", 1e6, 2,
+                                          gbps=1.0, alpha_us=1.0)
+        assert us == pytest.approx(1002.0)
+
+    def test_ppermute_single_hop_full_payload(self):
+        us = costmodel.collective_time_us("ppermute", 1e6, 2,
+                                          gbps=1.0, alpha_us=1.0)
+        assert us == pytest.approx(1001.0)
+
+    def test_degenerate_axis_is_free(self):
+        assert costmodel.collective_time_us("psum", 1e6, 1,
+                                            gbps=1.0, alpha_us=1.0) == 0.0
+        assert costmodel.collective_time_us("psum", 0, 4,
+                                            gbps=1.0, alpha_us=1.0) == 0.0
+
+    def test_fabric_rates_follow_flags(self):
+        saved = paddle.get_flags(["FLAGS_analysis_dcn_gbps",
+                                  "FLAGS_analysis_dcn_alpha_us"])
+        paddle.set_flags({"FLAGS_analysis_dcn_gbps": 1.0,
+                          "FLAGS_analysis_dcn_alpha_us": 7.0})
+        try:
+            # ppermute on the DCN fabric: 7 + 1e6/1e3 = 1007 us
+            us = costmodel.collective_time_us("ppermute", 1e6, 2,
+                                              fabric="dcn")
+            assert us == pytest.approx(1007.0)
+        finally:
+            paddle.set_flags(saved)
+
+    def test_mesh_config_axis_fabric(self):
+        mc = MeshConfig(data=2, tp=2, sep=2, dcn_axes=("data",))
+        assert mc.fabric("data") == "dcn"
+        assert mc.fabric("tp") == "ici"
+        assert mc.fabric("sep") == "ici"
+
+    def test_dcn_axes_dict_round_trip(self):
+        mc = MeshConfig(data=2, tp=2, sep=2, dcn_axes=("data", "sep"))
+        back = MeshConfig.from_dict(mc.to_dict())
+        assert tuple(back.dcn_axes) == ("data", "sep")
+        assert MeshConfig(data=8).to_dict().get("dcn_axes") in (None, [])
+
+    def test_dcn_axes_validated(self):
+        with pytest.raises(ValueError):
+            MeshConfig(data=8, dcn_axes=("bogus",))
+
+
+# ------------------------------------------------------------- liveness
+class TestLiveness:
+    def test_three_op_chain_donation(self):
+        # a 3-op elementwise chain of N-byte buffers: without donation
+        # the input is pinned for the whole program (peak 3N: input +
+        # the two live intermediates at the second op); donating the
+        # input lets it die at its only use (peak 2N)
+        def chain(x):
+            a = x * x
+            b = a * a
+            return b * b
+
+        closed = jax.make_jaxpr(chain)(jnp.zeros((1024,), jnp.float32))
+        n = 1024 * 4
+        assert costmodel.liveness_peak_bytes(closed) == 3 * n
+        assert costmodel.liveness_peak_bytes(closed, donated=(0,)) == 2 * n
+
+    def test_live_bytes_override_scales_shards(self):
+        def chain(x):
+            a = x * x
+            return a * a
+
+        closed = jax.make_jaxpr(chain)(jnp.zeros((1024,), jnp.float32))
+        full = costmodel.liveness_peak_bytes(closed)
+        halved = costmodel.liveness_peak_bytes(
+            closed, live_bytes=lambda v: costmodel._nbytes(v) / 2)
+        assert halved == full // 2
+
+    def test_predict_step_serial_bytes_add_to_step(self):
+        def chain(x):
+            return x * x
+
+        closed = jax.make_jaxpr(chain)(jnp.zeros((1024,), jnp.float32))
+        base = costmodel.predict_step(closed)
+        serial = costmodel.predict_step(closed,
+                                        extra_serial_bytes=10 ** 9)
+        assert serial.step_ms > base.step_ms
+        assert serial.collective_ms > base.collective_ms
+        # flops/bytes are the jaxpr's own — unchanged by the serial bill
+        assert serial.flops == base.flops
+        assert serial.bytes_accessed == base.bytes_accessed
+
+
+# ----------------------------------------------------------- enumerator
+class TestEnumerator:
+    def test_valid_configs_cover_rule_guards(self):
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny_config())
+        valid, rejected = enumerate_configs(8, model=model, batch=8,
+                                            seq=64)
+        assert len(valid) >= 6
+        assert all(mc.num_devices == 8 for mc in valid)
+        descs = [mc.describe() for mc in valid]
+        assert len(set(descs)) == len(descs)
+
+    def test_rejections_carry_named_reasons(self):
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny_config())
+        valid, rejected = enumerate_configs(8, model=model, batch=4,
+                                            seq=64)
+        # batch 4 cannot shard over data*fsdp=8
+        assert any("batch 4 not divisible" in r
+                   for rej in rejected for r in rej["reasons"])
+        assert all(rej["reasons"] for rej in rejected)
+        assert not any(mc.describe() == "data8xfsdp1xtp1" for mc in valid)
+
+    def test_seq_guard_rejects_ragged_sep(self):
+        _valid, rejected = enumerate_configs(8, batch=8, seq=63)
+        assert any("seq 63 not divisible" in r
+                   for rej in rejected for r in rej["reasons"])
+
+
+# ------------------------------------------------- search (abstract)
+@pytest.fixture(scope="module")
+def report():
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny_config(
+        max_position_embeddings=128))
+    return autoplan.search(model, 8, batch=8, seq=64)
+
+
+class TestSearch:
+    def test_ranks_at_least_six_candidates(self, report):
+        assert len(report.candidates) >= 6
+        steps = [c.prediction.step_ms for c in report.candidates]
+        assert steps == sorted(steps)
+        assert report.chosen == report.candidates[0].describe
+
+    def test_predictions_are_populated(self, report):
+        for c in report.candidates:
+            p = c.prediction
+            assert p.flops > 0 and p.bytes_accessed > 0
+            assert p.step_ms > 0 and p.peak_hbm_bytes > 0
+            assert p.step_ms >= max(p.compute_ms, p.hbm_ms)
+        d = report.to_dict()
+        assert d["chosen"] == report.chosen
+        assert "predicted_step_ms" in \
+            d["candidates"][0]["prediction"]
+
+    def test_format_text_table(self, report):
+        txt = report.format_text()
+        assert report.chosen in txt
+        assert "pred ms" in txt
+
+    def test_over_hbm_plan_rejected_statically(self):
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny_config(
+            max_position_embeddings=128))
+        tight = autoplan.search(model, 8, batch=8, seq=64,
+                                hbm_limit_mb=0.001)
+        assert not tight.candidates
+        assert tight.rejected
+        assert tight.findings
+        assert all(f.detector == "plan-hbm" for f in tight.findings)
+        assert any("rejected statically" in f.message
+                   for f in tight.findings)
+
+
+# ----------------------------------------------------------- D18 / D19
+def _fake_prediction(step_ms, peak_mb):
+    return costmodel.CostPrediction(
+        flops=1e9, bytes_accessed=1e8, compute_ms=step_ms / 2,
+        hbm_ms=step_ms / 2, collective_ms=step_ms / 2, step_ms=step_ms,
+        peak_hbm_bytes=int(peak_mb * 2 ** 20), num_devices=8)
+
+
+def _fake_report(order):
+    """PlanReport over the three partitioner_scaling configs with given
+    (config, step_ms, peak_mb) rows — already sorted best-first."""
+    rep = autoplan.PlanReport(model="fake", num_devices=8, batch=8,
+                              seq=64)
+    for mc, step_ms, peak_mb in order:
+        rep.candidates.append(autoplan.PlanCandidate(
+            config=mc, prediction=_fake_prediction(step_ms, peak_mb)))
+    return rep
+
+
+_TRIO = (MeshConfig(data=8), MeshConfig(data=4, tp=2),
+         MeshConfig(data=2, sep=4))
+
+
+class TestAuditPlan:
+    def test_clean_on_own_top1(self):
+        rep = _fake_report([(_TRIO[0], 1.0, 10), (_TRIO[1], 1.1, 10)])
+        out = analysis.audit_plan(rep)
+        assert not _gate(out)
+        assert any("plan ok" in f.message for f in out)
+
+    def test_fires_on_regressed_chosen(self):
+        rep = _fake_report([(_TRIO[0], 1.0, 10), (_TRIO[1], 2.0, 10)])
+        out = analysis.audit_plan(rep, chosen=_TRIO[1],
+                                  regress_pct=20.0)
+        assert any(f.severity == "warning" and f.detector == "plan"
+                   for f in out)
+
+    def test_fires_error_over_hbm_budget(self):
+        rep = _fake_report([(_TRIO[0], 1.0, 128.0)])
+        out = analysis.audit_plan(rep, hbm_limit_mb=64.0)
+        assert any(f.severity == "error" for f in out)
+        # and no-fire when the budget fits
+        assert not _gate(analysis.audit_plan(rep, hbm_limit_mb=256.0))
+
+    def test_fires_error_on_unknown_chosen(self):
+        rep = _fake_report([(_TRIO[0], 1.0, 10)])
+        rep.rejected.append({"config": _TRIO[2].describe(),
+                             "reasons": ["seq 63 not divisible by sep=4"]})
+        out = analysis.audit_plan(rep, chosen=_TRIO[2])
+        assert any(f.severity == "error" for f in out)
+
+    def test_empty_report_warns(self):
+        rep = autoplan.PlanReport(model="fake", num_devices=8, batch=8,
+                                  seq=64)
+        assert any(f.severity == "warning"
+                   for f in analysis.audit_plan(rep))
+
+
+class TestCalibration:
+    def _rep(self):
+        return _fake_report([(_TRIO[0], 1.0, 10), (_TRIO[1], 1.5, 10),
+                             (_TRIO[2], 2.0, 10)])
+
+    def test_clean_when_orderings_agree(self):
+        measured = {_TRIO[0].describe(): 900.0,
+                    _TRIO[1].describe(): 800.0,
+                    _TRIO[2].describe(): 700.0}
+        out = analysis.audit_cost_model_calibration(self._rep(), measured)
+        assert not _gate(out)
+        assert any("calibration ok" in f.message for f in out)
+
+    def test_fires_on_misordered_prediction(self):
+        measured = {_TRIO[0].describe(): 700.0,   # predicted fastest,
+                    _TRIO[1].describe(): 800.0,   # measured slowest
+                    _TRIO[2].describe(): 900.0}
+        out = analysis.audit_cost_model_calibration(self._rep(), measured,
+                                                    tol_pct=0.0)
+        assert any(f.severity == "error"
+                   and f.detector == "cost-model-calibration"
+                   for f in out)
+
+    def test_tie_band_forgives_small_inversions(self):
+        measured = {_TRIO[0].describe(): 792.0,   # 1% slower than #2:
+                    _TRIO[1].describe(): 800.0,   # inside the 10% band
+                    _TRIO[2].describe(): 700.0}
+        out = analysis.audit_cost_model_calibration(self._rep(), measured,
+                                                    tol_pct=10.0)
+        assert not _gate(out)
+
+    def test_insufficient_overlap_skips(self):
+        out = analysis.audit_cost_model_calibration(
+            self._rep(), {_TRIO[0].describe(): 900.0})
+        assert not _gate(out)
+        assert any("skipped" in f.message for f in out)
+
+    def test_rigged_fabrics_flip_ranking(self, report):
+        """The D19 fire-fixture physics: tp collectives on a free DCN
+        with ICI throttled must re-rank the candidates (the graft_lint
+        `plan` smoke then requires the detector to catch it against
+        measured tok/s)."""
+        rig = {"FLAGS_analysis_ici_gbps": 1e-4,
+               "FLAGS_analysis_dcn_gbps": 1e6,
+               "FLAGS_analysis_dcn_alpha_us": 0.0}
+        saved = paddle.get_flags(list(rig))
+        paddle.set_flags(rig)
+        try:
+            paddle.seed(0)
+            model = LlamaForCausalLM(llama_tiny_config(
+                max_position_embeddings=128))
+            rigged = autoplan.search(
+                model, 8, batch=8, seq=64,
+                candidates=[MeshConfig(data=8, dcn_axes=("tp", "sep")),
+                            MeshConfig(data=4, tp=2,
+                                       dcn_axes=("tp", "sep")),
+                            MeshConfig(data=2, sep=4,
+                                       dcn_axes=("tp", "sep"))])
+        finally:
+            paddle.set_flags(saved)
+        assert rigged.chosen != report.chosen
+        # and the flipped ordering fires against ground truth where the
+        # unrigged ordering is the measured one
+        measured = {"data8xfsdp1xtp1": 900.0, "data4xfsdp1xtp2": 750.0,
+                    "data2xfsdp1xtp1xsep4": 600.0}
+        out = analysis.audit_cost_model_calibration(rigged, measured,
+                                                    tol_pct=0.0)
+        assert any(f.severity == "error" for f in out)
+
+
+# -------------------------------------------------- bench_trend wiring
+class TestTrendDirections:
+    def setup_method(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+
+    def test_predicted_and_hbm_columns_trend_down(self):
+        import bench_trend
+
+        assert bench_trend.lower_is_better("top1_predicted_step_ms")
+        assert bench_trend.lower_is_better("top1_measured_step_ms")
+        assert bench_trend.lower_is_better("peak_hbm_mb")
+
+    def test_rates_and_counts_still_trend_up(self):
+        import bench_trend
+
+        assert not bench_trend.lower_is_better("top1_tokens_per_sec")
+        assert not bench_trend.lower_is_better("valid_candidates")
+        # "mb"/"hbm" are whole-component matches — no substring bleed
+        assert not bench_trend.lower_is_better("mbps_goodput")
+
+
+# ---------------------------------------------------- D8 dedup (obs)
+class TestBaselineDedup:
+    def test_write_baseline_suppresses_new_program_note(self, tmp_path):
+        from paddle_tpu.obs import costs
+
+        class _FakeCompiled:
+            def cost_analysis(self):
+                return [{"flops": 1e6, "bytes accessed": 1e6}]
+
+            def memory_analysis(self):
+                return None
+
+        paddle.set_flags({"FLAGS_obs_cost_capture": True})
+        costs.clear_ledger()
+        try:
+            costs.record_program("serving.test", "g", "k0",
+                                 compiled=_FakeCompiled())
+            base = str(tmp_path / "cost_baseline.json")
+            # BEFORE write_baseline: the program is a "new unbaselined"
+            # note against an empty baseline
+            empty = {"programs": {}, "threshold_pct": 10.0}
+            notes = costs.audit_cost_regressions(empty)
+            assert any("not in the baseline" in f.message for f in notes)
+            # AFTER write_baseline in the same process: deduped
+            costs.write_baseline(base, site="serving.test")
+            notes = costs.audit_cost_regressions(empty)
+            assert not any("not in the baseline" in f.message
+                           for f in notes)
+            # and the committed file itself audits clean
+            assert not _gate(costs.audit_cost_regressions(base))
+        finally:
+            costs.clear_ledger()
+
+    def test_ledger_rows_carry_predicted_columns(self):
+        from paddle_tpu.obs import costs
+
+        class _FakeCompiled:
+            def cost_analysis(self):
+                return [{"flops": 1e9, "bytes accessed": 1e8}]
+
+            def memory_analysis(self):
+                return None
+
+        paddle.set_flags({"FLAGS_obs_cost_capture": True})
+        costs.clear_ledger()
+        try:
+            e = costs.record_program("serving.test", "g", "k1",
+                                     compiled=_FakeCompiled(),
+                                     collective_bytes=10 ** 6)
+            row = e.to_dict()
+            assert row["predicted_step_ms"] > 0
+            assert row["collective_time_ms"] > 0
+            # unanalyzed rows stay None, not 0 (None = not analyzed)
+            e2 = costs.record_program("eager", "g", "k2")
+            assert e2.to_dict()["predicted_step_ms"] is None
+        finally:
+            costs.clear_ledger()

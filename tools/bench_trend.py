@@ -41,7 +41,7 @@ DEFAULT_HISTORY = os.path.join(REPO, "BENCH_HISTORY.jsonl")
 #: "num_streams" must not match "ms"
 LOWER_IS_BETTER = {"ms", "us", "s", "seconds", "latency", "ttft", "tpot",
                    "wall", "bytes", "stall", "p50", "p95", "p99",
-                   "blocking"}
+                   "blocking", "mb", "hbm"}
 
 #: components that FORCE higher-is-better even next to a lower-better
 #: component (round 16: speculative acceptance rate — a metric like

@@ -182,10 +182,11 @@ def check(repo=REPO, details_path=None, rtol=RTOL):
 #: workers run --defer-stale and the gate aggregates each baseline
 #: entry's match counts across the union (full coverage restored).
 LINT_GROUPS = (("llama,gpt,bert", True), ("paged,obs,ckpt", False),
-               ("spmd", False), ("conc", False), ("router", False))
+               ("spmd", False), ("conc", False), ("router", False),
+               ("plan", False))
 
 
-def lint_gate(models="llama,gpt,bert,paged,obs,ckpt,spmd,conc,router",
+def lint_gate(models="llama,gpt,bert,paged,obs,ckpt,spmd,conc,router,plan",
               timeout=900):
     """The graft_lint CI gate (round-9; round-10 adds the `paged` serving
     smoke — a tiny-LLaMA 2-slot continuous-batching engine whose decode
@@ -211,7 +212,10 @@ def lint_gate(models="llama,gpt,bert,paged,obs,ckpt,spmd,conc,router",
     despite the added `conc` smoke; each worker defers stale-suppression
     judgment (``--defer-stale``) and the gate aggregates every baseline
     entry's match count across the union of runs — full-coverage
-    staleness detection survives the split. Returns failure strings
+    staleness detection survives the split. Round 21 adds the `plan`
+    cost-model smoke (D18 auto-plan regression + D19 predicted-vs-
+    measured calibration, with their fire fixtures) as its own
+    worker. Returns failure strings
     (empty = clean); also prints the merged per-detector finding counts
     so drift between runs is visible in the gate log even when the gate
     passes."""

@@ -87,9 +87,18 @@ REQUIRED_FLEET_METRICS rows must exist in the router registry, and the
 D17 affinity-defeat fire fixture (a drifting fingerprint scattering
 byte-identical prompts) must still trip its warning.
 
+The special model name `plan` (round 21) smokes the STATIC COST MODEL:
+`autoplan.search` must rank ≥6 valid MeshConfigs for tiny-LLaMA on the
+8-device virtual mesh from one abstract lowering (nothing executes),
+D18 audit_plan must be clean on the search's own top-1, D19
+audit_cost_model_calibration gates the predicted ordering against
+MEASURED tok/s of the three partitioner_scaling configs, and the D18
+(worst-candidate deploy + rigged HBM budget) and D19 (rigged-fabric
+ranking flip) fire fixtures must trip — silence fails the gate.
+
 Exit code: 0 when no unsuppressed warning/error finding survives the
 baseline (notes never fail); 1 otherwise. CI runs
-`graft_lint.py --models llama,gpt,bert,paged,obs,ckpt,spmd,conc,router --json`
+`graft_lint.py --models llama,gpt,bert,paged,obs,ckpt,spmd,conc,router,plan --json`
 via tools/check_scoreboard — round 17 splits that into PARALLEL
 subprocess groups (check_scoreboard.LINT_GROUPS) so the gate wall stays
 at the slowest group; each worker passes `--defer-stale` and the gate
@@ -127,7 +136,7 @@ DEFAULT_BASELINE = os.path.join(REPO, "tools", "lint_baseline.json")
 #: — a partial run legitimately leaves model-specific suppressions
 #: unmatched
 CI_MODELS = ("llama", "gpt", "bert", "paged", "obs", "ckpt", "spmd",
-             "conc", "router")
+             "conc", "router", "plan")
 
 #: one tiny-LLaMA shared by the serving-side smokes (`paged`, `obs`): the
 #: engines key their AOT executables on spec + param AVALS, so a shared
@@ -1386,6 +1395,166 @@ def audit_router() -> list:
     return findings
 
 
+def audit_plan_smoke() -> list:
+    """The `plan` smoke (round 21): the static cost model + auto-plan
+    search gated end-to-end on the 8-device virtual mesh.
+
+    Sequence: `autoplan.search` enumerates + ranks every valid
+    MeshConfig for a tiny-LLaMA train step from ONE abstract lowering
+    (nothing executes) — fewer than 6 valid candidates is a gate error
+    → D18 ``audit_plan`` must be clean on the report's own top-1 →
+    the three partitioner_scaling configs (data8 / data4×tp2 /
+    data2×sep4) are ACTUALLY measured (3 warmup + 2 timed steps each)
+    and D19 ``audit_cost_model_calibration`` gates the predicted
+    ordering against measured tok/s at default tolerance → fire
+    fixtures: D18 must warn when the WORST candidate is deployed and
+    error on a rigged HBM budget, and D19 must fire on a rigged-fabric
+    search (tp/sep on a free DCN, ICI throttled to nothing) that flips
+    the predicted ranking against the same measurements — a silently
+    dead detector fails the gate like a falsely firing one."""
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis
+    from paddle_tpu.distributed.partitioner import (MeshConfig, autoplan,
+                                                    partition)
+    from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny_config
+
+    findings = []
+    batch, seq = 8, 64
+    paddle.seed(0)
+    cfg = llama_tiny_config(max_position_embeddings=128)
+    report = autoplan.search(LlamaForCausalLM(cfg), 8, batch=batch,
+                             seq=seq)
+    findings += report.findings
+    if len(report.candidates) < 6:
+        findings.append(analysis.Finding(
+            "plan", "error", "plan/search",
+            f"auto-plan search found only {len(report.candidates)} valid "
+            "candidate(s) on the 8-device virtual mesh (>= 6 expected "
+            "for tiny-LLaMA) — the enumerator or the rule-table guards "
+            "regressed",
+            data={"rejected": report.rejected}))
+        return findings
+    findings.append(analysis.Finding(
+        "plan", "note", "plan/search",
+        f"ranked {len(report.candidates)} valid candidate(s) "
+        f"({len(report.rejected)} rejected) from one abstract lowering; "
+        f"top-1 {report.chosen}"))
+    findings += analysis.audit_plan(report, loc="plan/search")
+
+    # ---- measure the three partitioner_scaling configs (the bench
+    # rung's well-separated trio) so D19 compares prediction against
+    # REAL steps, not against another model
+    measured = {}
+    for mc in (MeshConfig(data=8), MeshConfig(data=4, tp=2),
+               MeshConfig(data=2, sep=4)):
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+
+        def step(ids, labels, model=model, opt=opt):
+            loss = model(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        pstep = partition(step, mc, model=model)
+        rs = np.random.RandomState(0)
+
+        def batch_pair():
+            return (paddle.to_tensor(rs.randint(
+                        0, cfg.vocab_size,
+                        (batch, seq)).astype("int64")),
+                    paddle.to_tensor(rs.randint(
+                        0, cfg.vocab_size,
+                        (batch, seq)).astype("int64")))
+
+        for _ in range(3):                 # eager/discovery/compile
+            float(pstep(*batch_pair()))
+        t0 = time.perf_counter()
+        for _ in range(2):
+            float(pstep(*batch_pair()))
+        wall = time.perf_counter() - t0
+        measured[mc.describe()] = 2 * batch * seq / wall
+    findings += analysis.audit_cost_model_calibration(
+        report, measured, loc="plan/calibration")
+
+    # ---- D18 fire fixtures through the REAL report: deploying the
+    # worst-ranked candidate must warn, a rigged HBM budget must error
+    worst = report.candidates[-1].config
+    fire = analysis.audit_plan(report, chosen=worst, regress_pct=5.0,
+                               loc="plan/fire-d18")
+    if any(f.severity == "warning" for f in fire):
+        findings.append(analysis.Finding(
+            "plan", "note", "plan/fire-d18",
+            f"D18 fire fixture verified: deploying the worst candidate "
+            f"({worst.describe()}) tripped the plan-regression warning"))
+    else:
+        findings.append(analysis.Finding(
+            "plan", "error", "plan/fire-d18",
+            "D18 detector is SILENTLY DEAD: the worst-ranked candidate "
+            "deployed against a 5% regression budget produced no "
+            "warning",
+            data={"findings": [f.to_dict() for f in fire]}))
+    fire = analysis.audit_plan(report, hbm_limit_mb=0.001,
+                               loc="plan/fire-d18")
+    if not any(f.severity == "error" for f in fire):
+        findings.append(analysis.Finding(
+            "plan", "error", "plan/fire-d18",
+            "D18 detector is SILENTLY DEAD: a 0.001 MiB HBM budget "
+            "produced no over-budget error",
+            data={"findings": [f.to_dict() for f in fire]}))
+
+    # ---- D19 fire fixture: rig the fabrics (tp/sep collectives on a
+    # free DCN, ICI throttled to nothing) so the grad psum dominates
+    # and the predicted ranking FLIPS among the measured trio — the
+    # calibration detector must catch the misordering
+    rig = {"FLAGS_analysis_ici_gbps": 1e-4,
+           "FLAGS_analysis_dcn_gbps": 1e6,
+           "FLAGS_analysis_dcn_alpha_us": 0.0}
+    saved = paddle.get_flags(list(rig))
+    paddle.set_flags(rig)
+    try:
+        paddle.seed(0)
+        rigged = autoplan.search(
+            LlamaForCausalLM(cfg), 8, batch=batch, seq=seq,
+            candidates=[MeshConfig(data=8, dcn_axes=("tp", "sep")),
+                        MeshConfig(data=4, tp=2, dcn_axes=("tp", "sep")),
+                        MeshConfig(data=2, sep=4,
+                                   dcn_axes=("tp", "sep"))])
+    finally:
+        paddle.set_flags(saved)
+    fire = analysis.audit_cost_model_calibration(
+        rigged, measured, tol_pct=0.0, loc="plan/fire-d19")
+    if rigged.chosen == report.chosen:
+        findings.append(analysis.Finding(
+            "plan", "error", "plan/fire-d19",
+            f"rigged fabrics did not flip the predicted ranking (top-1 "
+            f"still {rigged.chosen}) — the alpha-beta model is not "
+            "reading the axis->fabric mapping",
+            data={"rigged": [c.describe for c in rigged.candidates]}))
+    elif any(f.severity == "error" for f in fire):
+        findings.append(analysis.Finding(
+            "plan", "note", "plan/fire-d19",
+            f"D19 fire fixture verified: rigged fabrics flipped the "
+            f"predicted top-1 to {rigged.chosen} and the calibration "
+            "detector caught the misordering against measured tok/s"))
+    else:
+        findings.append(analysis.Finding(
+            "plan", "error", "plan/fire-d19",
+            "D19 detector is SILENTLY DEAD: a rigged-fabric search "
+            "misordered the measured configs and the calibration audit "
+            "stayed clean",
+            data={"rigged_top1": rigged.chosen,
+                  "findings": [f.to_dict() for f in fire]}))
+    return findings
+
+
 #: the baseline entries (with their `_matched` counts) of the most
 #: recent run() — the --json payload exposes them so a PARALLEL gate
 #: (check_scoreboard.lint_gate round 17: one subprocess per smoke group)
@@ -1408,7 +1577,7 @@ def run(models=(), ast=True, baseline_path=DEFAULT_BASELINE,
         findings += analysis.audit_tune_cache()
     smokes = {"paged": audit_serving, "obs": audit_obs,
               "ckpt": audit_ckpt, "spmd": audit_spmd, "conc": audit_conc,
-              "router": audit_router}
+              "router": audit_router, "plan": audit_plan_smoke}
     for name in models:
         findings += smokes.get(name, lambda n=name: audit_model(n))()
     baseline = analysis.load_baseline(baseline_path)

@@ -6,7 +6,12 @@ Reads the obs cost ledger (paddle_tpu.obs.costs): per program, XLA
 compile wall, and — for programs that executed — mean execution wall,
 achieved GB/s and roofline utilization (achieved / FLAGS_obs_peak_gbps).
 This is the "~103 GB/s roofline" story from PERF.md as continuously
-measured data instead of a per-round hand computation.
+measured data instead of a per-round hand computation.  Rows also carry
+`predicted_step_ms` / `collective_time_ms` — the static cost model's
+estimate (analysis/costmodel.py: roofline max of compute at
+FLAGS_obs_peak_tflops and HBM at FLAGS_obs_peak_gbps, plus the D10
+collective volume billed at FLAGS_analysis_ici_gbps) — so predicted vs
+measured sits in one table.
 
 The ledger is per-process, so by default this tool drives the same tiny
 serving smokes `tools/graft_lint.py` gates on (`--smoke`; implied by
@@ -52,6 +57,7 @@ def _fmt_bytes(b):
 
 def render_table(rows) -> str:
     head = (f"{'program':<52} {'flops':>12} {'bytes':>10} {'hbm':>10} "
+            f"{'pred_ms':>8} {'coll_ms':>8} "
             f"{'compile_s':>9} {'execs':>6} {'wall_ms':>8} {'GB/s':>8} "
             f"{'util':>6}")
     lines = [head, "-" * len(head)]
@@ -64,13 +70,18 @@ def render_table(rows) -> str:
                 if r["exec_count"] else None)
         gbps = r["achieved_gbps"]
         util = r["roofline_utilization"]
+        pred = r.get("predicted_step_ms")
+        coll = r.get("collective_time_ms")
         wall_s = f"{wall:.2f}" if wall is not None else "-"
         gbps_s = f"{gbps:.2f}" if gbps is not None else "-"
         util_s = f"{util:.1%}" if util is not None else "-"
+        pred_s = f"{pred:.3f}" if pred is not None else "-"
+        coll_s = f"{coll:.3f}" if coll is not None else "-"
         lines.append(
             f"{r['program']:<52} {r['flops']:>12.3g} "
             f"{_fmt_bytes(r['bytes_accessed']):>10} "
             f"{_fmt_bytes(r['peak_hbm_bytes']):>10} "
+            f"{pred_s:>8} {coll_s:>8} "
             f"{r['compile_wall_s']:>9.3f} {r['exec_count']:>6} "
             f"{wall_s:>8} {gbps_s:>8} {util_s:>6}")
     return "\n".join(lines)
