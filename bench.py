@@ -1375,6 +1375,108 @@ def bench_llama_spec_decode(n_requests=None):
     return out
 
 
+def bench_quant_decode(n_requests=None, new_tokens=None):
+    """Round-20 quantization rung: the bandwidth-bound decode matrix —
+    weight storage {bf16, int8, int4} × KV cache {model, int8, int4} on
+    the paged ServingEngine, each cell a warmed greedy-decode drive on
+    the SAME request stream. Alongside tok/s every cell reports the
+    RATIOS the quantization claims: engine.param_bytes vs the bf16 twin
+    (storage actually packed, scales included) and the decode program's
+    D8-ledger bytes-accessed vs the (bf16, model-KV) twin (traffic
+    actually saved — the number D20 audit_quantized_bytes budgets).
+    Key naming rides tools/bench_trend.py's direction rules:
+    *_tokens_per_sec higher-better, *bytes* lower-better."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import ServingEngine
+    from paddle_tpu.obs import costs as _costs
+    from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = jax.default_backend() == "tpu"
+    paddle.seed(0)
+    if on_tpu:
+        # the 1B decode geometry (bench_decode_1b) — big enough that the
+        # weight stream dominates decode HBM traffic, i.e. the regime
+        # where weight-only quantization is supposed to pay
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=20,
+                          num_attention_heads=16,
+                          max_position_embeddings=512)
+        slots, n_req = 4, int(n_requests or 4)
+        prompt_len, gen = 128, int(new_tokens or 48)
+    else:
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                          intermediate_size=256, num_hidden_layers=2,
+                          num_attention_heads=4,
+                          max_position_embeddings=128)
+        slots, n_req = 2, int(n_requests or 2)
+        prompt_len, gen = 12, int(new_tokens or 8)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model = paddle.amp.decorate(model, level="O2", dtype="bfloat16",
+                                    master_weight=False)
+    model.eval()
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, cfg.vocab_size, (prompt_len,)).astype("int64")
+               for _ in range(n_req)]
+
+    def drive(wq, kv):
+        def build():
+            return ServingEngine(model, max_slots=slots, weight_quant=wq,
+                                 kv_cache_dtype=kv)
+
+        eng = build()
+        for p in prompts:
+            eng.add_request(p, max_new_tokens=gen)
+        eng.run()                       # warm every program this cell rides
+        eng = build()
+        eng.finish_warmup()
+        for p in prompts:
+            eng.add_request(p, max_new_tokens=gen)
+        eng.run()
+        st = eng.stats()
+        # the decode program's ledger rows are keyed by the engine's
+        # kv{mode}/w{quant} program keystr — the same rows D20 audits
+        rows = [e for e in _costs.ledger("serving.decode")
+                if f"/kv{kv}/w{wq}" in e.program and e.analyzed]
+        dec_bytes = max((e.bytes_accessed for e in rows), default=0)
+        return (round(st["decode_tokens"]
+                      / max(st["decode_time_s"], 1e-9), 1),
+                int(eng.param_bytes), int(st["kv_hbm_bytes"]),
+                int(dec_bytes))
+
+    out = {"name": "quant_decode", "slots": slots, "requests": n_req,
+           "prompt_len": prompt_len, "gen": gen,
+           "hidden": cfg.hidden_size, "layers": cfg.num_hidden_layers}
+    base = {}
+    for wq in ("none", "int8", "int4"):
+        for kv in ("model", "int8", "int4"):
+            tok_s, pbytes, kv_bytes, dec_bytes = drive(wq, kv)
+            out[f"w{wq}_kv{kv}_tokens_per_sec"] = tok_s
+            if wq == "none" and kv == "model":
+                base = {"p": pbytes, "kv": kv_bytes, "dec": dec_bytes}
+                out["bf16_param_bytes"] = pbytes
+                out["model_kv_hbm_bytes"] = kv_bytes
+            if kv == "model":
+                # storage side of the claim: packed weights + scales
+                # over the bf16 stack (int8 ≈ 0.5, int4 ≈ 0.25)
+                out[f"w{wq}_weight_bytes_ratio"] = round(
+                    pbytes / max(base["p"], 1), 3)
+            if wq == "none":
+                out[f"kv{kv}_kv_hbm_bytes_ratio"] = round(
+                    kv_bytes / max(base["kv"], 1), 3)
+            if dec_bytes and base.get("dec"):
+                # traffic side: XLA bytes-accessed of the decode program
+                # vs the full-precision twin — what D20 budgets
+                out[f"w{wq}_kv{kv}_decode_bytes_ratio"] = round(
+                    dec_bytes / base["dec"], 3)
+    if not on_tpu:
+        out["note"] = ("cpu run at reduced geometry — throughput not "
+                       "meaningful off-chip; do not quote")
+    return out
+
+
 def bench_int8(iters=30, m=2048, k=4096, n=4096):
     """Int8 quantized execution ON THE CHIP (VERDICT r3 Weak #6): the PTQ
     QuantizedLinear full int8×int8→int32 MXU path vs the same GEMM in bf16.
@@ -1771,6 +1873,7 @@ ALL = {
     "llama_serving_slo": bench_llama_serving_slo,
     "llama_fleet_slo": bench_llama_fleet_slo,
     "llama_spec_decode": bench_llama_spec_decode,
+    "quant_decode": bench_quant_decode,
     "ckpt": bench_ckpt,
     "partitioner_scaling": bench_partitioner_scaling,
     "autoplan": bench_autoplan,
@@ -1899,7 +2002,7 @@ _COST_EST = {
     "resnet50_bf16": 250, "resnet50": 340, "lenet": 50, "decode": 70,
     "decode_1b": 190, "decode_micro": 90, "llama_serving": 180,
     "llama_serving_slo": 200, "llama_spec_decode": 220,
-    "llama_fleet_slo": 240,
+    "llama_fleet_slo": 240, "quant_decode": 260,
     "ckpt": 150, "partitioner_scaling": 150, "autoplan": 150,
     "int8_chain": 70, "int8": 60, "eager": 25,
     "eager_host": 15, "fused_adam": 170,
@@ -1945,7 +2048,7 @@ def main(argv):
     # timeout's captured tail still carries the best-so-far headline.
     default = ["llama_1b", "llama_1b_resid_bf16", "decode_micro",
                "llama_serving", "llama_serving_slo", "llama_spec_decode",
-               "llama_fleet_slo",
+               "llama_fleet_slo", "quant_decode",
                "ckpt",
                "partitioner_scaling", "autoplan", "fused_micro",
                "longctx_8k", "flashmask_16k", "longctx_4k",

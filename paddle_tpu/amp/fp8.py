@@ -1,0 +1,174 @@
+"""paddle.amp.fp8 — fp8 GEMM training with delayed scaling (round 20).
+
+The bandwidth/compute story: the MXU runs fp8 × fp8 at 2× the bf16 rate and
+the operands move half the bytes. Numerics follow the Transformer-Engine
+recipe: forward operands (activations AND weights) cast to float8_e4m3fn
+(max 448, 3 mantissa bits), backward cotangents to float8_e5m2 (max 57344 —
+gradients need range, not precision), every cast through a per-tensor scale
+so the fp8 window tracks the live amplitude.
+
+Scaling is DELAYED: each GEMM site keeps an amax-history ring per forward
+operand (length FLAGS_fp8_amax_history) and derives this step's scale from
+the ring max of PREVIOUS steps — no jnp.max -> host sync on the critical
+path. The rings live in Tensors mutated in-place under no_grad, exactly the
+GradScaler pattern (amp/__init__.py), so compiled to_static train steps
+thread them through as program inputs/outputs instead of baking them in as
+constants. Gradient casts can't be delayed that way (a custom_vjp backward
+has no state hook), so the e5m2 scale is computed just-in-time from the
+cotangent itself inside the backward — one fused amax reduction, still
+on-device.
+
+Usage: flip FLAGS_amp_fp8 and the LLaMA decoder-block projections
+(q/k/v/o, gate/up/down) route through `linear()` below; everything else
+(norms, attention softmax, residual stream, lm_head/CE) keeps its existing
+bf16/f32 policy.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import current_trace, no_grad, op_call
+from ..core.tensor import Tensor
+
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+
+
+def enabled() -> bool:
+    from ..core.flags import flag
+
+    return bool(flag("FLAGS_amp_fp8"))
+
+
+def _tracked(t: Tensor):
+    """Read a state Tensor's buffer, notifying any active to_static trace —
+    a bare ._data read bypasses capture discovery and the ring would be
+    silently baked into the compiled program as a constant."""
+    tr = current_trace()
+    if tr is not None:
+        tr.on_read(t)
+    return t._data
+
+
+class _DelayedScale:
+    """amax-history ring + derived scale for one operand of one GEMM site."""
+
+    __slots__ = ("hist", "fp8_max")
+
+    def __init__(self, length: int, fp8_max: float):
+        self.hist = Tensor(jnp.zeros((max(int(length), 1),), jnp.float32),
+                           _internal=True)
+        self.fp8_max = float(fp8_max)
+
+    def scale(self):
+        """fp8_max / max(history); 1.0 until the first amax lands (the
+        first step quantizes unscaled — clipping in the cast bounds it)."""
+        amax = jnp.max(_tracked(self.hist))
+        return jnp.where(amax > 0.0,
+                         self.fp8_max / jnp.maximum(amax, 1e-12),
+                         1.0).astype(jnp.float32)
+
+    def push(self, value):
+        """Shift this step's amax into the ring (under no_grad — pure state,
+        not tape)."""
+        h = _tracked(self.hist)
+        amax = jnp.max(jnp.abs(value)).astype(jnp.float32)
+        self.hist._assign_raw(jnp.concatenate([amax[None], h[:-1]]))
+
+
+class Fp8State:
+    """Per-GEMM-site delayed-scaling state: one ring for the activation, one
+    for the weight. Created lazily at the to_static warm-up call (phase
+    n==0 runs eager), so discovery sees pre-existing Tensors and records
+    them as captures."""
+
+    __slots__ = ("x", "w")
+
+    def __init__(self, history: int | None = None):
+        from ..core.flags import flag
+
+        n = int(flag("FLAGS_fp8_amax_history")) if history is None else int(history)
+        self.x = _DelayedScale(n, E4M3_MAX)
+        self.w = _DelayedScale(n, E4M3_MAX)
+
+
+def _cast_e4m3(a, s):
+    # overflow in the f32->fp8 convert is NaN (e4m3fn has no inf): clip at
+    # the representable edge so a stale delayed scale degrades to
+    # saturation, not poison
+    return jnp.clip(a.astype(jnp.float32) * s,
+                    -E4M3_MAX, E4M3_MAX).astype(jnp.float8_e4m3fn)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _fp8_mm(x, w, sx, sw, xdt, wdt):
+    y, _ = _fp8_mm_fwd(x, w, sx, sw, xdt, wdt)
+    return y
+
+
+def _fp8_mm_fwd(x, w, sx, sw, xdt, wdt):
+    qx = _cast_e4m3(x, sx)
+    qw = _cast_e4m3(w, sw)
+    y = jax.lax.dot_general(qx, qw, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    y = y / (sx * sw)
+    # residuals are the fp8 operands — half the bf16 activation residency
+    return y.astype(xdt), (qx, qw, sx, sw)
+
+
+def _fp8_mm_bwd(xdt, wdt, res, g):
+    qx, qw, sx, sw = res
+    gf = g.astype(jnp.float32)
+    # just-in-time e5m2 scale: custom_vjp backward can't reach the delayed
+    # rings, and gradients swing orders of magnitude step-to-step anyway
+    amax_g = jnp.max(jnp.abs(gf))
+    sg = jnp.where(amax_g > 0.0, E5M2_MAX / jnp.maximum(amax_g, 1e-12), 1.0)
+    qg = jnp.clip(gf * sg, -E5M2_MAX, E5M2_MAX).astype(jnp.float8_e5m2)
+    dx = jax.lax.dot_general(qg, qw, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32) / (sg * sw)
+    dw = jax.lax.dot_general(qx, qg, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32) / (sx * sg)
+    return (dx.astype(xdt), dw.astype(wdt),
+            jnp.zeros_like(sx), jnp.zeros_like(sw))
+
+
+_fp8_mm.defvjp(_fp8_mm_fwd, _fp8_mm_bwd)
+
+
+def fp8_matmul(x, w, state: Fp8State, name: str = "fp8_matmul"):
+    """y = x @ w through the fp8 MXU path. x [..., K] Tensor, w [K, N]
+    Tensor, state the site's Fp8State. Reads this step's scales from the
+    rings BEFORE pushing this step's amaxes — that ordering IS the delayed
+    part of delayed scaling."""
+    sx = state.x.scale()
+    sw = state.w.scale()
+
+    def fn(xd, wd, sxd, swd):
+        k, n = wd.shape
+        y = _fp8_mm(xd.reshape(-1, k), wd, sxd, swd,
+                    str(xd.dtype), str(wd.dtype))
+        return y.reshape(xd.shape[:-1] + (n,))
+
+    y = op_call(fn, x, w, sx, sw, name=name, n_diff=2)
+    with no_grad():
+        state.x.push(x._data)
+        state.w.push(w._data)
+    return y
+
+
+def linear(layer, x):
+    """Run a Linear-like layer (anything exposing .weight [K, N]) through
+    fp8_matmul, lazily caching an Fp8State on the layer instance. The
+    caller checks `enabled()` — this helper assumes fp8 is on."""
+    st = layer.__dict__.get("_fp8_state")
+    if st is None:
+        st = Fp8State()
+        layer.__dict__["_fp8_state"] = st
+    y = fp8_matmul(x, layer.weight, st)
+    b = getattr(layer, "bias", None)
+    if b is not None:
+        y = y + b
+    return y

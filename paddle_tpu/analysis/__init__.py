@@ -111,6 +111,18 @@ Fleet detector (round 20, serving.py):
                          matches) — gated by the graft_lint `router`
                          smoke.
 
+Quantization detector (round 20, quantized.py):
+  D20 audit_quantized_bytes  every declared-quantized program's D8 ledger
+                         bytes-accessed, minus the non-weight traffic its
+                         full-precision twin charges, must shrink by the
+                         claimed storage factor (int8 >= 1.8x, int4 >=
+                         3.4x) — quantization that keeps moving bf16
+                         weight bytes is an error, and
+      audit_silent_dequant   weight-sized int8->f32 convert_element_type
+                         in the jaxpr (dequantize to f32 instead of the
+                         bf16 compute dtype) is the jaxpr-side anchor —
+                         gated by the graft_lint `quant` smoke.
+
 Plan detectors (round 21, costmodel.py — the static cost model over the
 ProgramIndex: per-eqn flops/bytes rooflines, alpha-beta ICI/DCN
 collective model, liveness peak-HBM; distributed/partitioner/autoplan.py
@@ -143,6 +155,7 @@ from .costmodel import (CostPrediction, audit_cost_model_calibration,
 from .dataflow import ProgramIndex, build_index
 from .findings import (Finding, apply_baseline, format_text, gate_failures,
                        load_baseline, stale_suppressions, to_json)
+from .quantized import audit_quantized_bytes, audit_silent_dequant
 from .jaxpr_audit import (audit_callbacks, audit_compiled,
                           audit_donation, audit_dtype_stream,
                           audit_fusion_misses, audit_host_sync,
@@ -191,7 +204,7 @@ def audit_train_steps(recorder=None, ledger=None, data_wait_ms=None,
 
 __all__ = [
     "audit_recompiles", "audit_prefix_cache", "audit_spec_decode",
-    "audit_fleet",
+    "audit_fleet", "audit_quantized_bytes", "audit_silent_dequant",
     "audit_cost_regressions", "audit_train_steps",
     "Finding", "apply_baseline", "format_text", "gate_failures",
     "load_baseline", "stale_suppressions", "to_json",

@@ -1,0 +1,138 @@
+"""D20 — quantization byte-budget audit over the obs cost ledger.
+
+A model can CLAIM int4 weights while every byte of the win leaks away: a
+stray astype re-materializes the bf16 weight per step, a cache keyed
+without the quant mode serves the bf16 program, the packed tensor gets
+stored next to a dequantized copy. None of that is visible in greedy-token
+parity tests — the tokens match either way. What can't lie is the D8
+ledger: XLA's bytes-accessed for the compiled program.
+
+`audit_quantized_bytes` takes DECLARATIONS — "program P is the
+weight-quantized (mode) twin of program T, whose full-precision weights
+weigh `weight_bytes_full`" — and checks the arithmetic the claim implies:
+
+    measured_weight_q  =  bytes(P) - (bytes(T) - weight_bytes_full)
+
+i.e. every non-weight byte (activations, KV, logits) is charged identically
+to both programs, so the difference isolates the weight traffic. A
+declared int4 program must show measured_weight_q at most
+weight_bytes_full / 3.4 (int8: / 1.8 — both factors leave headroom under
+the ideal 4x/2x for scales, padding and cost-model noise). A budget miss
+is an **error**: the quantization is declared, benchmarked and priced, so
+silently serving full-width weights is wrong, not slow.
+
+`audit_silent_dequant` is the jaxpr-side anchor: an int8-storage weight
+that gets convert_element_type'd to f32 (instead of the bf16 compute
+dtype) inside a quantized program doubles the very traffic the ledger
+check budgets for. Gated next to D1/D4 in the graft_lint `quant` smoke.
+"""
+from __future__ import annotations
+
+from .findings import Finding
+
+#: minimum bytes-shrink factors a declared mode must demonstrate on its
+#: measured weight traffic (ideal 2x / 4x, minus scale vectors + padding)
+MIN_FACTORS = {"int8": 1.8, "int4": 3.4}
+
+#: ignore int->f32 converts below this size — index math, scales and other
+#: scalar-ish tensors legitimately widen (1 MiB, far below any weight)
+_DEQUANT_MIN_BYTES = 1 << 20
+
+
+def audit_quantized_bytes(declarations, entries=None,
+                          loc: str = "analysis/quantized") -> list:
+    """D20 — verify each declared-quantized program actually moves fewer
+    weight bytes than its full-precision twin.
+
+    declarations: iterable of dicts with keys
+      program            ledger program id of the quantized program
+      twin               ledger program id of the full-precision twin
+      mode               "int8" | "int4"
+      weight_bytes_full  bytes of the twin's full-precision weights
+    entries: ProgramCost rows (default: the live obs.costs ledger).
+    """
+    if entries is None:
+        from ..obs.costs import ledger
+
+        entries = ledger()
+    by_id = {e.program: e for e in entries}
+    findings: list[Finding] = []
+    for d in declarations:
+        prog, twin = d["program"], d["twin"]
+        mode = str(d["mode"])
+        wfull = float(d["weight_bytes_full"])
+        if mode not in MIN_FACTORS:
+            findings.append(Finding(
+                "D20-quant-bytes", "error", loc,
+                f"declaration for {prog}: unknown quant mode {mode!r} "
+                f"(expected one of {sorted(MIN_FACTORS)})", dict(d)))
+            continue
+        missing = [p for p in (prog, twin)
+                   if p not in by_id or not by_id[p].analyzed]
+        if missing:
+            # a declaration pointing at nothing is a silently-dead audit,
+            # not a pass — same contract as the detector fire-fixtures
+            findings.append(Finding(
+                "D20-quant-bytes", "error", loc,
+                f"declared-quantized program pair never analyzed: "
+                f"{', '.join(missing)} absent from the cost ledger "
+                f"(program never compiled, or FLAGS_obs_cost_capture off)",
+                {"program": prog, "twin": twin, "missing": missing}))
+            continue
+        bq = by_id[prog].bytes_accessed
+        bt = by_id[twin].bytes_accessed
+        factor = MIN_FACTORS[mode]
+        budget = wfull / factor
+        measured = bq - (bt - wfull)
+        if measured > budget:
+            findings.append(Finding(
+                "D20-quant-bytes", "error", loc,
+                f"{prog} declares {mode} weights but its measured weight "
+                f"traffic is {measured / 1e6:.2f} MB — over the "
+                f"{budget / 1e6:.2f} MB budget (full weights "
+                f"{wfull / 1e6:.2f} MB / required factor {factor}; twin "
+                f"{twin} bytes {bt / 1e6:.2f} MB, quantized program bytes "
+                f"{bq / 1e6:.2f} MB). The quantization is declared but the "
+                f"bytes never left.",
+                {"program": prog, "twin": twin, "mode": mode,
+                 "bytes_q": bq, "bytes_twin": bt,
+                 "weight_bytes_full": wfull,
+                 "measured_weight_bytes": measured,
+                 "budget_bytes": budget, "factor": factor}))
+    return findings
+
+
+def audit_silent_dequant(closed_jaxpr, min_bytes: int | None = None,
+                         loc: str = "<program>") -> list:
+    """D20b — int-storage tensors dequantized to f32 inside a program.
+
+    Quantized weights / KV blocks must dequantize to the COMPUTE dtype
+    (bf16 under the amp policy); a weight-sized convert_element_type
+    int8 -> float32 re-buys the full-width traffic AND doubles it over
+    bf16. Every such convert at or above `min_bytes` output size is an
+    error."""
+    from .jaxpr_audit import iter_eqns
+
+    lim = _DEQUANT_MIN_BYTES if min_bytes is None else int(min_bytes)
+    findings: list[Finding] = []
+    for eqn in iter_eqns(closed_jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        iv = eqn.invars[0].aval
+        ov = eqn.outvars[0].aval
+        if str(iv.dtype) not in ("int8", "int4", "uint8"):
+            continue
+        if str(ov.dtype) != "float32":
+            continue
+        nbytes = int(ov.size) * 4
+        if nbytes < lim:
+            continue
+        findings.append(Finding(
+            "D20-silent-dequant", "error", loc,
+            f"convert_element_type {iv.dtype} -> float32 at shape "
+            f"{tuple(ov.shape)} ({nbytes / 1e6:.2f} MB): quantized storage "
+            f"dequantized to full f32 width instead of the bf16 compute "
+            f"dtype",
+            {"shape": tuple(int(s) for s in ov.shape),
+             "src_dtype": str(iv.dtype), "bytes": nbytes}))
+    return findings

@@ -431,6 +431,28 @@ define_flag("FLAGS_router_skew_pct", 0.9,
             "taking more than this fraction of routed requests while "
             "another ready replica got none is a lint warning "
             "(graft_lint `router` smoke self-tests the detector)")
+define_flag("FLAGS_weight_only_dtype", "none",
+            "default weight-only quantization of the serving engines' "
+            "decode matmuls + lm_head (text/generation.py, "
+            "inference/engine.py): none | int8 (per-channel scales, the "
+            "round-5 1.67× bandwidth win) | int4 (true 2-nibbles-per-byte "
+            "packed storage, ops/quantized.py — packed bytes are the only "
+            "HBM weight traffic); per-call weight_quant= overrides")
+define_flag("FLAGS_pallas_quant_matmul", True,
+            "route int4 weight-only matmuls through the Pallas fused "
+            "dequant-matmul kernel (ops/quantized.py: unpack + scale in "
+            "VMEM) on TPU above the size threshold; off = the XLA "
+            "take-bits composition everywhere (the parity oracle)")
+define_flag("FLAGS_amp_fp8", False,
+            "fp8 GEMM training leg of the amp policy (amp/fp8.py): the "
+            "decoder-block projections (qkv/o/gate/up/down) run "
+            "e4m3-forward / e5m2-gradient matmuls with delayed scaling — "
+            "per-tensor amax history rings threaded as state through "
+            "to_static, never host round-trips; loss parity vs bf16 is "
+            "bounded by tests/test_quantized.py")
+define_flag("FLAGS_fp8_amax_history", 16,
+            "length of the per-tensor amax history ring delayed fp8 "
+            "scaling maxes over (amp/fp8.py Fp8State)")
 define_flag("FLAGS_debug_thread_checks", False,
             "owner-thread contract assertions on the deliberately "
             "single-threaded serving objects (ServingEngine, "

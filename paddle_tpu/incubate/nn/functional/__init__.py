@@ -515,21 +515,33 @@ from .fused_loss import fused_linear_cross_entropy  # noqa: E402,F401
 
 def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1,
                     name=None):
-    """Quantize a weight matrix to int8 with per-out-channel absmax scales
+    """Quantize a weight matrix with per-out-channel absmax scales
     (≙ phi weight_quantize_kernel,
     /root/reference/paddle/phi/kernels/gpu/weight_quantize_kernel.cu).
-    Returns (int8 weight, fp scales). int4 packs two nibbles per byte on
-    CUDA; on TPU int4 storage has no MXU path, so int4 requests quantize
-    at int8 resolution with the int4 value range."""
-    import jax.numpy as jnp
-
+    Returns (quantized weight, fp scales). weight_only_int4 stores TRUE
+    packed int4 — two nibbles per byte, [ceil(K/2), N] int8 storage
+    (ops/quantized.py split-half layout) — with optional group-wise scales
+    along K (`group_size` > 0 -> scale [K//group_size, N]). Unsupported
+    packing requests (group_size not dividing K, group_size with int8)
+    raise instead of quietly widening."""
     from paddle_tpu.core.dispatch import op_call
+    from paddle_tpu.ops.quantized import quantize_int4
 
     if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
         raise ValueError(f"weight_quantize: unknown algo {algo!r}")
-    qmax = 7.0 if algo == "weight_only_int4" else 127.0
-
-    return op_call(lambda w: weight_quantize_raw(w, qmax), x,
+    if algo == "weight_only_int4":
+        k = int(x.shape[-2]) if x.ndim >= 2 else int(x.shape[0])
+        if group_size and group_size > 0 and k % group_size:
+            raise ValueError(
+                f"weight_quantize: group_size {group_size} does not divide "
+                f"K={k} — int4 packing refuses to quietly widen")
+        return op_call(lambda w: quantize_int4(w, group_size), x,
+                       name="weight_quantize", n_diff=0)
+    if group_size and group_size > 0:
+        raise ValueError(
+            f"weight_quantize: group_size is an int4 packing knob; "
+            f"{algo} stores per-out-channel scales only")
+    return op_call(lambda w: weight_quantize_raw(w, 127.0), x,
                    name="weight_quantize", n_diff=0)
 
 
@@ -547,14 +559,22 @@ def weight_quantize_raw(w, qmax=127.0):
 
 
 def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16",
-                      name=None):
-    """int8 weight + scales -> float weight (≙ phi weight_dequantize)."""
+                      k=None, name=None):
+    """quantized weight + scales -> float weight (≙ phi weight_dequantize).
+    For weight_only_int4 `x` is the packed [ceil(K/2), N] storage; pass
+    `k` to recover an odd logical K (defaults to 2 * packed rows)."""
     import jax.numpy as jnp
 
     from paddle_tpu.core import dtype as dtypes
     from paddle_tpu.core.dispatch import op_call
+    from paddle_tpu.ops.quantized import dequant_int4
 
     dt = dtypes.convert_dtype(out_dtype)
+
+    if algo == "weight_only_int4":
+        kk = int(k) if k is not None else 2 * int(x.shape[-2])
+        return op_call(lambda q, s: dequant_int4(q, s, kk, dt), x, scale,
+                       name="weight_dequantize", n_diff=0)
 
     def f(q, s):
         return (q.astype(jnp.float32) * s[None, :]).astype(dt)
@@ -565,20 +585,27 @@ def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16",
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", arch=None, group_size=-1,
                        name=None):
-    """y = x @ dequant(weight) + bias with int8-stored weights
+    """y = x @ dequant(weight) + bias with quantized-stored weights
     (≙ phi weight_only_linear_kernel — the serving memory-bound GEMM).
-    The weight dequant fuses into the GEMM under XLA; activations stay in
-    their original float dtype."""
-    import jax.numpy as jnp
-
+    weight_dtype="int8": weight [K, N] int8, per-channel scales; the
+    dequant fuses into the GEMM under XLA. weight_dtype="int4": weight is
+    the TRUE packed [ceil(K/2), N] storage from
+    weight_quantize(algo="weight_only_int4") (per-channel [N] or grouped
+    [G, N] scales) — routed through ops/quantized.quant_matmul, whose
+    Pallas path unpacks + scales in VMEM so packed bytes are the only HBM
+    weight traffic. Activations stay in their original float dtype."""
     from paddle_tpu.core.dispatch import op_call
+    from paddle_tpu.ops.quantized import quant_matmul
 
     if weight_scale is None:
         raise ValueError("weight_only_linear requires weight_scale")
+    if weight_dtype not in ("int8", "int4"):
+        raise ValueError(
+            f"weight_only_linear: unsupported weight_dtype {weight_dtype!r}"
+            " (int8 | int4)")
 
     def f(a, w, s, *b):
-        wf = w.astype(a.dtype) * s[None, :].astype(a.dtype)
-        out = a @ wf
+        out = quant_matmul(a, w, s)
         if b:
             out = out + b[0]
         return out

@@ -201,6 +201,97 @@ def _flce_tok_bwd(chunk_n, ignore_index, res, g):
 _flce_tok.defvjp(_flce_tok_fwd, _flce_tok_bwd)
 
 
+# --------------------------------------------- quantized-head variant (r20)
+
+def _dequant_head_cols(wq, ws, k, j, chunk):
+    """Dequantize vocab columns [j*chunk, (j+1)*chunk) of a weight-only-
+    quantized lm_head to f32. wq is the int8 tensor OR the int4 nibble-pack
+    ([K, V] vs [ceil(K/2), V] — shape-dispatched exactly like
+    ops.quantized.quant_matmul); ws is the per-out-channel scale [V]."""
+    from ....ops.quantized import int4_unpack, packed_rows
+
+    wc = jax.lax.dynamic_slice(wq, (0, j * chunk), (wq.shape[0], chunk))
+    sc = jax.lax.dynamic_slice(ws, (j * chunk,), (chunk,))
+    if wq.shape[0] != k and wq.shape[0] == packed_rows(k):
+        wc = int4_unpack(wc, k, axis=0)
+    return wc.astype(jnp.float32) * sc.astype(jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flce_q(h, wq, ws, labels, chunk, ignore_index, k):
+    loss, _ = _flce_q_fwd_impl(h, wq, ws, labels, chunk, ignore_index, k)
+    return loss
+
+
+def _flce_q_fwd_impl(h, wq, ws, labels, chunk, ignore_index, k):
+    n = h.shape[0]
+    v = ws.shape[0]
+    nchunks = v // chunk
+    hf = h.astype(jnp.float32)
+
+    def step(carry, i):
+        m, s, lab_logit = carry
+        logits = hf @ _dequant_head_cols(wq, ws, k, i, chunk)   # [N, chunk]
+        cm = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, cm)
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        local = labels - i * chunk
+        inside = (local >= 0) & (local < chunk)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, chunk - 1)[:, None], axis=1)[:, 0]
+        lab_logit = jnp.where(inside, picked, lab_logit)
+        return (m_new, s, lab_logit), None
+
+    m0 = jnp.full((n,), -jnp.inf, jnp.float32)
+    s0 = jnp.zeros((n,), jnp.float32)
+    (m, s, lab_logit), _ = jax.lax.scan(
+        step, (m0, s0, jnp.zeros((n,), jnp.float32)), jnp.arange(nchunks))
+    lse = m + jnp.log(s)
+    valid = _valid_mask(labels, ignore_index)
+    count = jnp.maximum(jnp.sum(valid), 1).astype(jnp.float32)
+    loss = jnp.sum(jnp.where(valid, lse - lab_logit, 0.0)) / count
+    return loss, (h, wq, ws, labels, lse)
+
+
+def _flce_q_fwd(h, wq, ws, labels, chunk, ignore_index, k):
+    return _flce_q_fwd_impl(h, wq, ws, labels, chunk, ignore_index, k)
+
+
+def _flce_q_bwd(chunk, ignore_index, k, res, g):
+    h, wq, ws, labels, lse = res
+    n, hid = h.shape
+    v = ws.shape[0]
+    nchunks = v // chunk
+    hf = h.astype(jnp.float32)
+    valid = _valid_mask(labels, ignore_index)
+    count = jnp.maximum(jnp.sum(valid), 1).astype(jnp.float32)
+    scale = (g / count) * valid.astype(jnp.float32)        # [N]
+
+    def step(dh, i):
+        wcf = _dequant_head_cols(wq, ws, k, i, chunk)      # recompute [K, c]
+        logits = hf @ wcf
+        p = jnp.exp(logits - lse[:, None])
+        local = labels - i * chunk
+        inside = (local >= 0) & (local < chunk)
+        onehot = (jax.nn.one_hot(jnp.clip(local, 0, chunk - 1), chunk,
+                                 dtype=jnp.float32)
+                  * inside[:, None].astype(jnp.float32))
+        dlog = (p - onehot) * scale[:, None]               # [N, chunk]
+        dh = dh + dlog @ wcf.T
+        return dh, None
+
+    dh, _ = jax.lax.scan(step, jnp.zeros((n, hid), jnp.float32),
+                         jnp.arange(nchunks))
+    # the quantized head is FROZEN (a PTQ artifact): no dw — the int
+    # nibble-pack has no meaningful cotangent and the scales are calibration
+    # constants
+    return dh.astype(h.dtype), None, jnp.zeros_like(ws), None
+
+
+_flce_q.defvjp(_flce_q_fwd, _flce_q_bwd)
+
+
 def _best_chunk(v, chunk_size):
     """Pick the vocab chunk: the requested chunk_size when it divides v
     exactly; otherwise the largest multiple-of-128 (TPU lane width) divisor
@@ -226,7 +317,10 @@ def fused_linear_cross_entropy(hidden, weight, labels, chunk_size=8192,
     """loss = mean CE(softmax(hidden @ weight), labels) without ever
     materializing the [tokens, vocab] logits, excluding ignore_index (and
     any negative) labels from both the loss mean and the gradient. hidden
-    [..., H] flattens to [N, H]; weight [H, V]; labels [...] int.
+    [..., H] flattens to [N, H]; weight [H, V]; labels [...] int. weight
+    may also be a weight_quantize (q, scale) pair (int8 or packed int4,
+    per-channel scale): the head then dequantizes chunk-by-chunk inside the
+    scan and is treated as frozen (dh only, no dw).
 
     chunk_axis: "vocab" (online-lse over vocab slices), "tokens" (full-
     vocab GEMM per token slice), or None/"auto" — FLAGS_flce_chunk_axis
@@ -239,6 +333,41 @@ def fused_linear_cross_entropy(hidden, weight, labels, chunk_size=8192,
     from ....core.dispatch import op_call
     from ....core.flags import flag
     from ....nn import functional as F
+
+    if isinstance(weight, (tuple, list)):
+        # weight-only-quantized head (round 20): weight is the
+        # weight_quantize pair (int8 [K, V] or int4 nibble-pack
+        # [ceil(K/2), V], per-out-channel scale [V]). The vocab-chunked
+        # scan dequantizes ONE [K, chunk] slice at a time — the full-size
+        # bf16/f32 head never materializes in HBM and the stored bytes stay
+        # 1/4 (int4) of the bf16 head the D8 ledger charges the twin for.
+        wq, ws = weight
+        if int(getattr(ws, "ndim", ws.ndim)) != 1:
+            raise NotImplementedError(
+                "fused_linear_cross_entropy: group-wise scales are not "
+                "supported for the quantized head (per-channel [V] only)")
+        k = int(hidden.shape[-1])
+        v = int(ws.shape[-1])
+        chunk = _best_chunk(v, chunk_size)
+        if chunk:
+            def fn_q(h2, wqd, wsd, lab):
+                hh = h2.reshape(-1, h2.shape[-1])
+                return _flce_q(hh, wqd, wsd,
+                               lab.reshape(-1).astype(jnp.int32), chunk,
+                               int(ignore_index), k)
+
+            return op_call(fn_q, hidden, wq, ws, labels,
+                           name="fused_linear_cross_entropy", n_diff=1)
+        # no usable multiple-of-128 vocab divisor (GPT's 50304): dequantize
+        # the head once (transient) and take the regular token-chunked path
+        from ....ops.quantized import dequant_int4, packed_rows
+
+        def fn_dq(wqd, wsd):
+            if wqd.shape[0] != k and wqd.shape[0] == packed_rows(k):
+                return dequant_int4(wqd, wsd, k, dtype=jnp.float32)
+            return wqd.astype(jnp.float32) * wsd.astype(jnp.float32)
+
+        weight = op_call(fn_dq, wq, ws, name="dequant_head", n_diff=0)
 
     v = int(weight.shape[-1])
     axis = chunk_axis or str(flag("FLAGS_flce_chunk_axis"))

@@ -70,10 +70,20 @@ from ..text.generation import (_GenSpec, _gpt_layer_prefill,
                                _stacked_params, _stacked_params_gpt)
 from ..text.paged_cache import (TRASH_BLOCK, BlockAllocator, PagedKVCache,
                                 PrefixCache, append_token,
-                                append_token_int8, blocks_for,
-                                gather_context, hash_blocks, scatter_chunk,
+                                append_token_int4, append_token_int8,
+                                blocks_for, gather_context, hash_blocks,
+                                scatter_chunk, scatter_chunk_int4,
                                 scatter_chunk_int8, scatter_prefill,
-                                scatter_prefill_int8)
+                                scatter_prefill_int4, scatter_prefill_int8)
+
+#: quantized KV-cache modes and their (append, scatter_prefill,
+#: scatter_chunk) triples — the step programs dispatch on the STATIC
+#: kv_mode string ("model" | "int8" | "int4"), so each mode compiles its
+#: own program and the scan carries (ksc, vsc) only when quantized.
+_KV_FNS = {
+    "int8": (append_token_int8, scatter_prefill_int8, scatter_chunk_int8),
+    "int4": (append_token_int4, scatter_prefill_int4, scatter_chunk_int4),
+}
 
 
 # ------------------------------------------------------ batched sampling
@@ -116,28 +126,31 @@ def _sample_batched(logits, key, do_sample, temperature, top_k, top_p):
 # --------------------------------------------------- paged decode layers
 
 def _paged_attn(hn_q, k_new, v_new, kc, vc, ksc, vsc, tables, pos,
-                block_size, quantized):
+                block_size, kv_mode):
     """Shared append+attend: write this step's K/V through the block
     table, then paged decode attention over lens = pos + 1 (the just-
     written token included, matching the single-program engine's
-    `arange <= pos` mask)."""
+    `arange <= pos` mask). kv_mode is the STATIC cache mode string
+    ("model" | "int8" | "int4")."""
     from ..ops.pallas_decode import paged_decode_attention
 
     b = hn_q.shape[0]
     blk = tables[jnp.arange(b), pos // block_size]
     off = (pos % block_size).astype(jnp.int32)
-    if quantized:
-        kc, ksc = append_token_int8(kc, ksc, k_new, blk, off)
-        vc, vsc = append_token_int8(vc, vsc, v_new, blk, off)
+    if kv_mode != "model":
+        app = _KV_FNS[kv_mode][0]
+        kc, ksc = app(kc, ksc, k_new, blk, off)
+        vc, vsc = app(vc, vsc, v_new, blk, off)
     else:
         kc = append_token(kc, k_new, blk, off)
         vc = append_token(vc, v_new, blk, off)
-    out = paged_decode_attention(hn_q, kc, vc, tables, pos + 1, ksc, vsc)
+    out = paged_decode_attention(hn_q, kc, vc, tables, pos + 1, ksc, vsc,
+                                 kv_int4=kv_mode == "int4")
     return out, kc, vc, ksc, vsc
 
 
 def _paged_layer_llama(x, lw, kc, vc, ksc, vsc, pos, tables, spec,
-                       cos, sin, block_size, quantized):
+                       cos, sin, block_size, kv_mode):
     """One LLaMA block for seq-1 queries at PER-SLOT positions against
     the paged cache. x [B, H]; kc/vc one layer's pool slice."""
     b, h = x.shape
@@ -150,7 +163,7 @@ def _paged_layer_llama(x, lw, kc, vc, ksc, vsc, pos, tables, spec,
     q = _rope(q, c, sn)
     k = _rope(k, c, sn)
     out, kc, vc, ksc, vsc = _paged_attn(q, k, v, kc, vc, ksc, vsc,
-                                        tables, pos, block_size, quantized)
+                                        tables, pos, block_size, kv_mode)
     x = x + _mm(out.reshape(b, spec.num_heads * spec.head_dim), lw["o"])
     hn = _rms_norm(x, lw["post_ln"], spec.rms_eps)
     mlp = _mm(jax.nn.silu(_mm(hn, lw["gate"])) * _mm(hn, lw["up"]),
@@ -159,23 +172,24 @@ def _paged_layer_llama(x, lw, kc, vc, ksc, vsc, pos, tables, spec,
 
 
 def _paged_layer_gpt(x, lw, kc, vc, ksc, vsc, pos, tables, spec,
-                     block_size, quantized):
+                     block_size, kv_mode):
     """Pre-LN GPT block, paged decode variant."""
     b, h = x.shape
     hn = _layer_norm(x, lw["ln1_w"], lw["ln1_b"], spec.rms_eps)
-    qkv = (hn @ lw["qkv"]).reshape(b, 3, spec.num_heads, spec.head_dim)
+    qkv = _mm(hn, lw["qkv"]).reshape(b, 3, spec.num_heads, spec.head_dim)
     q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
     out, kc, vc, ksc, vsc = _paged_attn(q, k, v, kc, vc, ksc, vsc,
-                                        tables, pos, block_size, quantized)
-    x = x + out.reshape(b, spec.num_heads * spec.head_dim) @ lw["o"]
+                                        tables, pos, block_size, kv_mode)
+    x = x + _mm(out.reshape(b, spec.num_heads * spec.head_dim), lw["o"])
     hn = _layer_norm(x, lw["ln2_w"], lw["ln2_b"], spec.rms_eps)
-    x = x + jax.nn.gelu(hn @ lw["fc_in"], approximate=False) @ lw["fc_out"]
+    x = x + _mm(jax.nn.gelu(_mm(hn, lw["fc_in"]), approximate=False),
+                lw["fc_out"])
     return x, kc, vc, ksc, vsc
 
 
 # ------------------------------------------------------- step programs
 
-def _decode_step_impl(spec: _GenSpec, block_size: int, quantized: bool,
+def _decode_step_impl(spec: _GenSpec, block_size: int, kv_mode: str,
                       any_sample: bool, params, tok, pos, tables, kc, vc,
                       ksc, vsc, samp, key):
     """ONE decode step for a compacted slot bucket: every row consumes
@@ -187,6 +201,7 @@ def _decode_step_impl(spec: _GenSpec, block_size: int, quantized: bool,
     sort/softmax/cumsum sampling machinery over [B, V] every tick.
     """
     gpt = spec.arch == "gpt"
+    quantized = kv_mode != "model"
     dtype = params["embed"].dtype
     xt = params["embed"][tok].astype(dtype)              # [B, H]
     if gpt:
@@ -203,11 +218,11 @@ def _decode_step_impl(spec: _GenSpec, block_size: int, quantized: bool,
         if gpt:
             xo, kcl, vcl, kscl, vscl = _paged_layer_gpt(
                 xc, lw, kcl, vcl, kscl, vscl, pos, tables, spec,
-                block_size, quantized)
+                block_size, kv_mode)
         else:
             xo, kcl, vcl, kscl, vscl = _paged_layer_llama(
                 xc, lw, kcl, vcl, kscl, vscl, pos, tables, spec,
-                cos, sin, block_size, quantized)
+                cos, sin, block_size, kv_mode)
         ys = (kcl, vcl, kscl, vscl) if quantized else (kcl, vcl)
         return xo, ys
 
@@ -228,13 +243,14 @@ def _decode_step_impl(spec: _GenSpec, block_size: int, quantized: bool,
     return nxt, kc, vc, ksc, vsc, key
 
 
-def _prefill_impl(spec: _GenSpec, block_size: int, quantized: bool,
+def _prefill_impl(spec: _GenSpec, block_size: int, kv_mode: str,
                   any_sample: bool, params, ids, true_len, table_row, kc,
                   vc, ksc, vsc, samp, key):
     """Prefill one joining request: full-prompt forward (Pallas flash on
     TPU), page-scatter the prompt K/V through the slot's block table, and
     sample the first token from the last REAL prompt position."""
     gpt = spec.arch == "gpt"
+    quantized = kv_mode != "model"
     b, s = ids.shape
     if gpt:
         x = params["embed"][ids] + params["wpe"][None, :s]
@@ -251,10 +267,9 @@ def _prefill_impl(spec: _GenSpec, block_size: int, quantized: bool,
     x, (ks, vs) = jax.lax.scan(pre, x, params["layers"])
     ks, vs = ks[:, 0], vs[:, 0]                          # [L, S, Hkv, D]
     if quantized:
-        kc, ksc = scatter_prefill_int8(kc, ksc, ks, true_len, table_row,
-                                       block_size)
-        vc, vsc = scatter_prefill_int8(vc, vsc, vs, true_len, table_row,
-                                       block_size)
+        scat = _KV_FNS[kv_mode][1]
+        kc, ksc = scat(kc, ksc, ks, true_len, table_row, block_size)
+        vc, vsc = scat(vc, vsc, vs, true_len, table_row, block_size)
     else:
         kc = scatter_prefill(kc, ks, true_len, table_row, block_size)
         vc = scatter_prefill(vc, vs, true_len, table_row, block_size)
@@ -271,7 +286,7 @@ def _prefill_impl(spec: _GenSpec, block_size: int, quantized: bool,
     return tok, kc, vc, ksc, vsc, key
 
 
-def _chunk_prefill_impl(spec: _GenSpec, block_size: int, quantized: bool,
+def _chunk_prefill_impl(spec: _GenSpec, block_size: int, kv_mode: str,
                         any_sample: bool, emit_token: bool, ctx_pages: int,
                         params, ids, start, true_end, last_idx, table_row,
                         cow_src, cow_dst, kc, vc, ksc, vsc, samp, key):
@@ -290,6 +305,7 @@ def _chunk_prefill_impl(spec: _GenSpec, block_size: int, quantized: bool,
     past the written watermark gather garbage the causal mask never
     reaches."""
     gpt = spec.arch == "gpt"
+    quantized = kv_mode != "model"
     c = ids.shape[1]
     dtype = params["embed"].dtype
     kc = kc.at[:, cow_dst].set(kc[:, cow_src])
@@ -320,8 +336,8 @@ def _chunk_prefill_impl(spec: _GenSpec, block_size: int, quantized: bool,
             kscl = vscl = None
         if gpt:
             hn = _layer_norm(xc, lw["ln1_w"], lw["ln1_b"], spec.rms_eps)
-            qkv = (hn @ lw["qkv"]).reshape(c, 3, spec.num_heads,
-                                           spec.head_dim)
+            qkv = _mm(hn, lw["qkv"]).reshape(c, 3, spec.num_heads,
+                                             spec.head_dim)
             q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
         else:
             hn = _rms_norm(xc, lw["input_ln"], spec.rms_eps)
@@ -333,17 +349,20 @@ def _chunk_prefill_impl(spec: _GenSpec, block_size: int, quantized: bool,
             q = _rope(q, cos, sin)
             k = _rope(k, cos, sin)
         if quantized:
-            kcl, kscl = scatter_chunk_int8(kcl, kscl, k, start, true_end,
-                                           table_row, block_size)
-            vcl, vscl = scatter_chunk_int8(vcl, vscl, v, start, true_end,
-                                           table_row, block_size)
+            scat = _KV_FNS[kv_mode][2]
+            kcl, kscl = scat(kcl, kscl, k, start, true_end, table_row,
+                             block_size)
+            vcl, vscl = scat(vcl, vscl, v, start, true_end, table_row,
+                             block_size)
         else:
             kcl = scatter_chunk(kcl, k, start, true_end, table_row,
                                 block_size)
             vcl = scatter_chunk(vcl, v, start, true_end, table_row,
                                 block_size)
-        kx = gather_context(kcl, kscl, table_row, ctx_pages)
-        vx = gather_context(vcl, vscl, table_row, ctx_pages)
+        kx = gather_context(kcl, kscl, table_row, ctx_pages,
+                            int4=kv_mode == "int4")
+        vx = gather_context(vcl, vscl, table_row, ctx_pages,
+                            int4=kv_mode == "int4")
         kx = _repeat_kv(kx.astype(q.dtype), rep, 1)      # [T, Hq, D]
         vx = _repeat_kv(vx.astype(q.dtype), rep, 1)
         # scores stay rank-4 [1, Hq, C, T]: this is a prefill composition,
@@ -356,10 +375,10 @@ def _chunk_prefill_impl(spec: _GenSpec, block_size: int, quantized: bool,
         out = jnp.einsum("hct,thd->chd", probs[0], vx)
         attn = out.reshape(c, spec.num_heads * spec.head_dim)
         if gpt:
-            xo = xc + attn @ lw["o"]
+            xo = xc + _mm(attn, lw["o"])
             hn2 = _layer_norm(xo, lw["ln2_w"], lw["ln2_b"], spec.rms_eps)
-            xo = xo + jax.nn.gelu(hn2 @ lw["fc_in"],
-                                  approximate=False) @ lw["fc_out"]
+            xo = xo + _mm(jax.nn.gelu(_mm(hn2, lw["fc_in"]),
+                                      approximate=False), lw["fc_out"])
         else:
             xo = xc + _mm(attn, lw["o"])
             hn2 = _rms_norm(xo, lw["post_ln"], spec.rms_eps)
@@ -430,7 +449,7 @@ def _verify_tokens(lg, proposed, samp, key, any_sample):
     return (jnp.where(ds, acc_s, acc), jnp.where(ds, tgt_s, greedy), key)
 
 
-def _spec_verify_impl(spec: _GenSpec, block_size: int, quantized: bool,
+def _spec_verify_impl(spec: _GenSpec, block_size: int, kv_mode: str,
                       any_sample: bool, params, toks, pos, tables, limit,
                       kc, vc, ksc, vsc, samp, key):
     """Score C = K+1 candidate positions per slot in ONE paged-attention
@@ -452,6 +471,7 @@ def _spec_verify_impl(spec: _GenSpec, block_size: int, quantized: bool,
     lives in _verify_tokens; this returns (acc [B, K], tgt [B, C],
     caches..., key)."""
     gpt = spec.arch == "gpt"
+    quantized = kv_mode != "model"
     b, c = toks.shape
     dtype = params["embed"].dtype
     qpos = pos[:, None] + jnp.arange(c)[None, :]          # [B, C]
@@ -480,7 +500,7 @@ def _spec_verify_impl(spec: _GenSpec, block_size: int, quantized: bool,
             kscl = vscl = None
         if gpt:
             hn = _layer_norm(xc, lw["ln1_w"], lw["ln1_b"], spec.rms_eps)
-            qkv = (hn.reshape(b * c, -1) @ lw["qkv"]).reshape(
+            qkv = _mm(hn.reshape(b * c, -1), lw["qkv"]).reshape(
                 b, c, 3, nh, hd)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         else:
@@ -496,21 +516,23 @@ def _spec_verify_impl(spec: _GenSpec, block_size: int, quantized: bool,
         # scatter (+ its int8 self-healing requantization) unchanged
         for bi in range(b):
             if quantized:
-                kcl, kscl = scatter_chunk_int8(
-                    kcl, kscl, k[bi], pos[bi], end[bi], tables[bi],
-                    block_size)
-                vcl, vscl = scatter_chunk_int8(
-                    vcl, vscl, v[bi], pos[bi], end[bi], tables[bi],
-                    block_size)
+                scat = _KV_FNS[kv_mode][2]
+                kcl, kscl = scat(kcl, kscl, k[bi], pos[bi], end[bi],
+                                 tables[bi], block_size)
+                vcl, vscl = scat(vcl, vscl, v[bi], pos[bi], end[bi],
+                                 tables[bi], block_size)
             else:
                 kcl = scatter_chunk(kcl, k[bi], pos[bi], end[bi],
                                     tables[bi], block_size)
                 vcl = scatter_chunk(vcl, v[bi], pos[bi], end[bi],
                                     tables[bi], block_size)
+        i4 = kv_mode == "int4"
         kx = jax.vmap(
-            lambda tr: gather_context(kcl, kscl, tr, pages))(tables)
+            lambda tr: gather_context(kcl, kscl, tr, pages,
+                                      int4=i4))(tables)
         vx = jax.vmap(
-            lambda tr: gather_context(vcl, vscl, tr, pages))(tables)
+            lambda tr: gather_context(vcl, vscl, tr, pages,
+                                      int4=i4))(tables)
         kx = _repeat_kv(kx.astype(q.dtype), rep, 2)       # [B, T, Hq, D]
         vx = _repeat_kv(vx.astype(q.dtype), rep, 2)
         scores = jnp.einsum("bchd,bthd->bhct", q, kx) * inv_scale
@@ -521,12 +543,13 @@ def _spec_verify_impl(spec: _GenSpec, block_size: int, quantized: bool,
         out = jnp.einsum("bhct,bthd->bchd", probs, vx)
         attn = out.reshape(b, c, nh * hd)
         if gpt:
-            xo = xc + (attn.reshape(b * c, -1) @ lw["o"]).reshape(
+            xo = xc + _mm(attn.reshape(b * c, -1), lw["o"]).reshape(
                 b, c, -1)
             hn2 = _layer_norm(xo, lw["ln2_w"], lw["ln2_b"], spec.rms_eps)
-            xo = xo + (jax.nn.gelu(hn2.reshape(b * c, -1) @ lw["fc_in"],
-                                   approximate=False)
-                       @ lw["fc_out"]).reshape(b, c, -1)
+            xo = xo + _mm(
+                jax.nn.gelu(_mm(hn2.reshape(b * c, -1), lw["fc_in"]),
+                            approximate=False),
+                lw["fc_out"]).reshape(b, c, -1)
         else:
             xo = xc + _mm(attn.reshape(b * c, -1),
                           lw["o"]).reshape(b, c, -1)
@@ -704,9 +727,18 @@ class ServingEngine:
                  num_kv_blocks=None, kv_cache_dtype=None,
                  max_model_len=None, seed=0, admission="continuous",
                  prefix_cache=None, chunked_prefill_tokens=None,
-                 prefix_cache_max_blocks=None, spec_decode=None):
+                 prefix_cache_max_blocks=None, spec_decode=None,
+                 weight_quant=None):
         from ..core.flags import flag
 
+        if weight_quant in (None, "none"):
+            # serving-wide default; per-engine weight_quant= overrides
+            weight_quant = str(flag("FLAGS_weight_only_dtype"))
+        if weight_quant not in ("none", "int8", "int4"):
+            raise ValueError(
+                f"weight_quant must be 'none', 'int8' or 'int4', got "
+                f"{weight_quant!r}")
+        self.weight_quant = str(weight_quant)
         cfg = model.config
         arch = getattr(model, "_gen_arch", "llama")
         if arch == "gpt":
@@ -717,8 +749,9 @@ class ServingEngine:
                 rope_theta=0.0, rms_eps=cfg.layer_norm_eps,
                 max_new_tokens=0, do_sample=False, top_k=0, top_p=1.0,
                 temperature=1.0, eos_token_id=-1, tie_embeddings=False,
-                arch="gpt")
-            self.params = _stacked_params_gpt(model)
+                arch="gpt", weight_quant=self.weight_quant)
+            self.params = _stacked_params_gpt(
+                model, weight_quant=self.weight_quant)
         else:
             self.spec = _GenSpec(
                 num_layers=cfg.num_hidden_layers,
@@ -728,17 +761,20 @@ class ServingEngine:
                 rms_eps=cfg.rms_norm_eps, max_new_tokens=0,
                 do_sample=False, top_k=0, top_p=1.0, temperature=1.0,
                 eos_token_id=-1,
-                tie_embeddings=bool(cfg.tie_word_embeddings))
-            self.params = _stacked_params(model)
+                tie_embeddings=bool(cfg.tie_word_embeddings),
+                weight_quant=self.weight_quant)
+            self.params = _stacked_params(
+                model, weight_quant=self.weight_quant)
         self.block_size = int(kv_block_size or flag("FLAGS_kv_block_size"))
         self.max_slots = int(max_slots or flag("FLAGS_serving_slots"))
         if self.max_slots < 1:
             raise ValueError("need at least one serving slot")
         mode = str(kv_cache_dtype or flag("FLAGS_kv_cache_dtype"))
-        if mode not in ("model", "int8"):
-            raise ValueError(f"kv_cache_dtype must be 'model' or 'int8', "
-                             f"got {mode!r}")
-        self.quantized = mode == "int8"
+        if mode not in ("model", "int8", "int4"):
+            raise ValueError(f"kv_cache_dtype must be 'model', 'int8' or "
+                             f"'int4', got {mode!r}")
+        self.kv_mode = mode
+        self.quantized = mode != "model"
         dtype = self.params["embed"].dtype
         # usable context rounds DOWN to whole pages (prompt + decode both
         # address the cache through page-granular tables)
@@ -756,7 +792,7 @@ class ServingEngine:
         self.cache = PagedKVCache(
             self.spec.num_layers, int(num_kv_blocks),
             self.spec.num_kv_heads, self.block_size, self.spec.head_dim,
-            "int8" if self.quantized else dtype)
+            mode if self.quantized else dtype)
         self.allocator = BlockAllocator(int(num_kv_blocks))
         if admission not in ("continuous", "static"):
             raise ValueError(f"unknown admission mode {admission!r}")
@@ -779,9 +815,15 @@ class ServingEngine:
                 if prefix_cache_max_blocks is None
                 else prefix_cache_max_blocks))
         #: seeds the content-hash chain: KV blocks are only interchangeable
-        #: within one (arch, layer geometry, block size, cache dtype)
+        #: within one (arch, layer geometry, block size, cache MODE).
+        #: kv_mode (not the storage dtype) disambiguates int4 from int8 —
+        #: both store int8 arrays, but their block bytes mean different
+        #: things, so cached blocks must never alias across modes. The
+        #: spec carries weight_quant, so differently-quantized weights
+        #: (different K/V numerics) never alias either.
         self._prefix_namespace = hash(
-            (self.spec, self.block_size, str(self.cache.k.dtype)))
+            (self.spec, self.block_size, self.kv_mode,
+             str(self.cache.k.dtype)))
         self._slot_chunk: dict[int, dict] = {}   # slot -> chunk progress
         self._slot_extra_refs: list[list[int]] = [[] for _ in
                                                   range(self.max_slots)]
@@ -946,7 +988,7 @@ class ServingEngine:
         params_fp = tuple((tuple(p.shape), str(p.dtype))
                           for p in jax.tree_util.tree_leaves(self.params))
         self._prog_key_base = hash(
-            (self.spec, self.block_size, self.quantized, self.pages,
+            (self.spec, self.block_size, self.kv_mode, self.pages,
              self.allocator.num_blocks, str(self.cache.k.dtype),
              params_fp))
         self._warmed = False
@@ -1135,6 +1177,10 @@ class ServingEngine:
                 "kv_pool_blocks": self.allocator.num_blocks,
                 "kv_pool_free": self.allocator.available,
                 "kv_hbm_bytes": self.cache.hbm_bytes,
+                # round 20: quantization config (bench/D20 read these)
+                "kv_cache_mode": self.kv_mode,
+                "weight_quant": self.weight_quant,
+                "param_bytes": self.param_bytes,
                 # round 13: prefix cache + chunked prefill
                 "prefix_blocks_hit": int(self._m_prefix_hit.value),
                 "prefix_blocks_missed": int(self._m_prefix_miss.value),
@@ -1253,7 +1299,7 @@ class ServingEngine:
         key = (site, self._prog_key_base, bool(any_sample), int(bucket),
                tuple(extra))
         keystr = (f"bucket{bucket}/sample{int(any_sample)}/"
-                  f"q{int(self.quantized)}"
+                  f"kv{self.kv_mode}/w{self.weight_quant}"
                   + "".join(f"/{x}" for x in extra))
         cached = _SERVING_EXECUTABLES.get(key)
         compile_wall = None
@@ -1268,6 +1314,13 @@ class ServingEngine:
                 compiled=compiled, wall_s=compile_wall, bucket=int(bucket))
             cached = (compiled, entry)
             _SERVING_EXECUTABLES[key] = cached
+        else:
+            # cache hit: the executable (and its ProgramCost) outlived a
+            # clear_ledger() — re-surface the row or this engine's decode
+            # traffic is invisible to the ledger
+            from ..obs import costs as _costs
+
+            _costs.reregister(cached[1])
         if key not in _SEEN_SERVING_PROGRAMS:
             _SEEN_SERVING_PROGRAMS.add(key)
             from ..obs.watchdog import record_compile
@@ -1518,7 +1571,7 @@ class ServingEngine:
         c = self.cache
         from ..obs import span as _span
 
-        args = (self.spec, self.block_size, self.quantized, req.do_sample,
+        args = (self.spec, self.block_size, self.kv_mode, req.do_sample,
                 self.params, jnp.asarray(ids), jnp.int32(s),
                 jnp.asarray(self._tables[slot]), c.k, c.v, c.k_scale,
                 c.v_scale, samp, self._key)
@@ -1616,7 +1669,7 @@ class ServingEngine:
         c = self.cache
         from ..obs import span as _span
 
-        args = (self.spec, self.block_size, self.quantized,
+        args = (self.spec, self.block_size, self.kv_mode,
                 req.do_sample and is_last, is_last, ctx_pages,
                 self.params, jnp.asarray(ids), jnp.int32(start),
                 jnp.int32(start + n), jnp.int32(s - 1 - start),
@@ -1675,7 +1728,7 @@ class ServingEngine:
         samp = self._samp_arrays(reqs, pad)
         any_sample = any(r.do_sample for r in reqs)
         c = self.cache
-        args = (self.spec, self.block_size, self.quantized, any_sample,
+        args = (self.spec, self.block_size, self.kv_mode, any_sample,
                 self.params, jnp.asarray(tok), jnp.asarray(pos),
                 jnp.asarray(tables), c.k, c.v, c.k_scale, c.v_scale, samp,
                 self._key)
@@ -1773,7 +1826,7 @@ class ServingEngine:
         samp = self._samp_arrays(reqs, pad)
         any_sample = any(r.do_sample for r in reqs)
         c = self.cache
-        args = (self.spec, self.block_size, self.quantized, any_sample,
+        args = (self.spec, self.block_size, self.kv_mode, any_sample,
                 self.params, jnp.asarray(toks), jnp.asarray(pos),
                 jnp.asarray(tables), jnp.asarray(limit), c.k, c.v,
                 c.k_scale, c.v_scale, samp, self._key)
@@ -1896,6 +1949,17 @@ class ServingEngine:
         self._update_pool_gauges()
 
     # ------------------------------------------------------- introspection
+    @property
+    def param_bytes(self) -> int:
+        """Total bytes of the stacked serving params AS STORED — packed
+        int4 counts its nibbles-per-byte bytes, int8 its bytes, scales
+        included. The D20 (audit_quantized_bytes) declaration side: a
+        quantized engine claiming a bandwidth win must show this number
+        (and the D8 ledger's measured bytes) actually dropped vs its
+        full-precision twin."""
+        return int(sum(p.nbytes for p in
+                       jax.tree_util.tree_leaves(self.params)))
+
     def decode_program_jaxpr(self, bucket=2):
         """The decode step program's jaxpr at a given slot bucket — the
         serving analogue of CompiledFunction.program_jaxpr(), consumed by
@@ -1907,7 +1971,7 @@ class ServingEngine:
                 "top_k": jnp.zeros(bucket, jnp.int32),
                 "top_p": jnp.ones(bucket, jnp.float32)}
         fn = functools.partial(_decode_step_impl, self.spec,
-                               self.block_size, self.quantized, False)
+                               self.block_size, self.kv_mode, False)
         return jax.make_jaxpr(fn)(
             self.params, jnp.zeros(bucket, jnp.int32),
             jnp.zeros(bucket, jnp.int32),
@@ -1925,7 +1989,7 @@ class ServingEngine:
                 "top_k": jnp.zeros(bucket, jnp.int32),
                 "top_p": jnp.ones(bucket, jnp.float32)}
         fn = functools.partial(_spec_verify_impl, self.spec,
-                               self.block_size, self.quantized, False)
+                               self.block_size, self.kv_mode, False)
         return jax.make_jaxpr(fn)(
             self.params, jnp.zeros((bucket, int(k) + 1), jnp.int32),
             jnp.zeros(bucket, jnp.int32),

@@ -252,6 +252,18 @@ def record_program(site: str, group: str, key: str, compiled=None,
     return entry
 
 
+def reregister(entry: "ProgramCost") -> "ProgramCost":
+    """Re-insert a live ProgramCost whose row was dropped by
+    ``clear_ledger()``. Compiled executables outlive the ledger (the
+    serving engine's module-level AOT cache), so a cache-HIT program
+    must surface its original analysis in the fresh ledger instead of
+    silently vanishing from roofline/bench/D8 views."""
+    if entry.program not in _ledger:
+        _ledger[entry.program] = entry
+        _site_counts[entry.site] = _site_counts.get(entry.site, 0) + 1
+    return entry
+
+
 def get_program(site: str, key: str) -> ProgramCost | None:
     return _ledger.get(f"{site}|{key}")
 

@@ -60,20 +60,26 @@ _ONE = np.float32(1.0)
 #: block_size) below this are launch-overhead-bound — the XLA composition
 #: wins (mirrored by analysis D4's decode gate reason)
 _MIN_ELEMS = 1 << 16
-#: cache dtypes the kernel can stream (int8 needs the per-block scales)
-_SUPPORTED_DTYPES = ("float32", "bfloat16", "float16", "int8")
+#: cache dtypes the kernel can stream (int8 needs the per-block scales;
+#: "int4" is packed int8 storage — two tokens per byte along the token
+#: axis — unpacked inside the kernel)
+_SUPPORTED_DTYPES = ("float32", "bfloat16", "float16", "int8", "int4")
 
 
 # ------------------------------------------------------------------ kernel
 
-def _decode_kernel(tab_ref, len_ref, *rest, scale, block_size, has_scale):
+def _decode_kernel(tab_ref, len_ref, *rest, scale, block_size, has_scale,
+                   packed=False):
     """One (seq, kv_head, page) grid step: the GQA query group attends to
     one cache block, merged into the running flash state.
 
     tab_ref/len_ref (+ ks_ref/vs_ref when has_scale): scalar-prefetch SMEM
     (block table [S, P], kv lengths [S], per-(seq, page) dequant scales).
     q is [1, 1, Gp, D]; k/v blocks are [1, 1, block_size, D] picked by the
-    index_map from the block table.
+    index_map from the block table — or [1, 1, block_size/2, D] int4-packed
+    when `packed` (split-half along tokens: byte t holds token t in the low
+    nibble, token bs/2 + t in the high — unpacked HERE so the packed bytes
+    are the only cache traffic).
     """
     if has_scale:
         ks_ref, vs_ref, q_ref, k_ref, v_ref, o_ref, acc, m_s, l_s = rest
@@ -82,6 +88,11 @@ def _decode_kernel(tab_ref, len_ref, *rest, scale, block_size, has_scale):
     si = pl.program_id(0)
     pi = pl.program_id(2)
     n_p = pl.num_programs(2)
+
+    def unpack(p):
+        lo = jnp.right_shift(jnp.left_shift(p, 4), 4)
+        hi = jnp.right_shift(p, 4)
+        return jnp.concatenate([lo, hi], axis=0)       # [bs, D]
 
     @pl.when(pi == 0)
     def _init():
@@ -95,7 +106,10 @@ def _decode_kernel(tab_ref, len_ref, *rest, scale, block_size, has_scale):
     @pl.when(page_start < seq_len)
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32) * np.float32(scale)  # [Gp, D]
-        k = k_ref[0, 0].astype(jnp.float32)                      # [bs, D]
+        k = k_ref[0, 0]                                          # [bs, D]
+        if packed:
+            k = unpack(k)
+        k = k.astype(jnp.float32)
         if has_scale:
             k = k * ks_ref[si, pi]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -114,7 +128,10 @@ def _decode_kernel(tab_ref, len_ref, *rest, scale, block_size, has_scale):
         p = jnp.exp(s - m_new)
         p = jnp.where(mask, p, _ZERO)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        v = v_ref[0, 0].astype(jnp.float32)                      # [bs, D]
+        v = v_ref[0, 0]                                          # [bs, D]
+        if packed:
+            v = unpack(v)
+        v = v.astype(jnp.float32)
         pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if has_scale:
@@ -131,20 +148,25 @@ def _decode_kernel(tab_ref, len_ref, *rest, scale, block_size, has_scale):
 
 
 def paged_decode_attention_raw(q, k_cache, v_cache, block_tables, seq_lens,
-                               k_scale=None, v_scale=None):
+                               k_scale=None, v_scale=None, kv_int4=False):
     """The Pallas kernel path. q [S, H_q, D]; caches [N, H_kv, bs, D]
-    (int8 when k_scale/v_scale [N] f32 are given); block_tables [S, P]
-    int32 (entries < 0 tolerated as padding); seq_lens [S] valid kv
-    lengths. Returns [S, H_q, D] in q.dtype."""
+    (int8 when k_scale/v_scale [N] f32 are given; int4-packed
+    [N, H_kv, bs/2, D] when kv_int4); block_tables [S, P] int32 (entries
+    < 0 tolerated as padding); seq_lens [S] valid kv lengths. Returns
+    [S, H_q, D] in q.dtype."""
     with _x64_guard():
         return _paged_decode_x32(q, k_cache, v_cache, block_tables,
-                                 seq_lens, k_scale, v_scale)
+                                 seq_lens, k_scale, v_scale, kv_int4)
 
 
 def _paged_decode_x32(q, k_cache, v_cache, block_tables, seq_lens,
-                      k_scale=None, v_scale=None):
+                      k_scale=None, v_scale=None, kv_int4=False):
     s_n, hq, d = q.shape
     n_blocks, hkv, bs, dc = k_cache.shape
+    if kv_int4:
+        if k_scale is None:
+            raise ValueError("int4 KV needs per-block scales")
+        bs = bs * 2          # logical tokens per block (two per byte)
     if d != dc:
         raise ValueError(f"head_dim mismatch: q {d} vs cache {dc}")
     if hq % hkv:
@@ -162,7 +184,7 @@ def _paged_decode_x32(q, k_cache, v_cache, block_tables, seq_lens,
     has_scale = k_scale is not None
 
     kernel = functools.partial(_decode_kernel, scale=scale, block_size=bs,
-                               has_scale=has_scale)
+                               has_scale=has_scale, packed=kv_int4)
 
     # index maps see (grid ids..., *scalar-prefetch refs); the cache block
     # index comes straight from the prefetched block table — the grid
@@ -178,7 +200,7 @@ def _paged_decode_x32(q, k_cache, v_cache, block_tables, seq_lens,
 
     q_spec = pl.BlockSpec((1, 1, gp, d),
                           lambda s, h, p, *refs: (s, h, 0, 0))
-    kv_spec = pl.BlockSpec((1, 1, bs, d), kv_index)
+    kv_spec = pl.BlockSpec((1, 1, k_cache.shape[2], d), kv_index)
     o_spec = pl.BlockSpec((1, 1, gp, d),
                           lambda s, h, p, *refs: (s, h, 0, 0))
     args = [tables, lens]
@@ -210,7 +232,7 @@ def _paged_decode_x32(q, k_cache, v_cache, block_tables, seq_lens,
 # ------------------------------------------------------- XLA composition
 
 def paged_decode_attention_xla(q, k_cache, v_cache, block_tables, seq_lens,
-                               k_scale=None, v_scale=None):
+                               k_scale=None, v_scale=None, kv_int4=False):
     """The gather + masked-softmax composition — the numerics oracle for
     the kernel and the off-TPU / gated-off route. Score/output dtype
     conventions match text/generation.py's dense decode attention so the
@@ -220,8 +242,14 @@ def paged_decode_attention_xla(q, k_cache, v_cache, block_tables, seq_lens,
     n_blocks, hkv, bs, _ = k_cache.shape
     pages = block_tables.shape[1]
     tabs = jnp.maximum(block_tables, 0)
-    k = k_cache[tabs]                        # [S, P, Hkv, bs, D]
+    k = k_cache[tabs]                        # [S, P, Hkv, bs(/2), D]
     v = v_cache[tabs]
+    if kv_int4:
+        from .quantized import int4_unpack
+
+        bs = bs * 2
+        k = int4_unpack(k, bs, axis=-2)
+        v = int4_unpack(v, bs, axis=-2)
     if k_scale is not None:
         k = (k.astype(jnp.float32)
              * k_scale[tabs][:, :, None, None, None]).astype(q.dtype)
@@ -271,28 +299,36 @@ def decode_gate_reason(n_elems, dtype, platform, head_dim=None,
     if block_size is not None and block_size % 8:
         return (f"kv block_size {block_size} not sublane-aligned (8)"), \
             "note"
+    if dtype == "int4" and block_size is not None and block_size % 16:
+        return (f"kv block_size {block_size} not packed-sublane-aligned "
+                "(16: the int4 tile holds block_size/2 bytes)"), "note"
     return ("no gating reason — this composition should have routed to "
             "the Pallas decode kernel"), "warning"
 
 
-def use_pallas_decode(q, k_cache, block_tables) -> bool:
+def use_pallas_decode(q, k_cache, block_tables, kv_int4=False) -> bool:
     """True when the paged decode should ride the Pallas kernel here."""
     s_n, hq, d = q.shape
     _, _, bs, _ = k_cache.shape
+    if kv_int4:
+        bs = bs * 2
     n = s_n * hq * block_tables.shape[1] * bs
-    _, sev = decode_gate_reason(n, str(k_cache.dtype),
+    _, sev = decode_gate_reason(n, "int4" if kv_int4
+                                else str(k_cache.dtype),
                                 jax.default_backend(), head_dim=d,
                                 block_size=bs)
     return sev == "warning"
 
 
 def paged_decode_attention(q, k_cache, v_cache, block_tables, seq_lens,
-                           k_scale=None, v_scale=None):
+                           k_scale=None, v_scale=None, kv_int4=False):
     """Routed paged decode attention (kernel on TPU above threshold, XLA
-    composition everywhere else). Same contract as the _raw kernel."""
-    if use_pallas_decode(q, k_cache, block_tables):
+    composition everywhere else). Same contract as the _raw kernel;
+    `kv_int4=True` declares the caches int4-packed along the token axis
+    (k_scale/v_scale required)."""
+    if use_pallas_decode(q, k_cache, block_tables, kv_int4):
         return paged_decode_attention_raw(q, k_cache, v_cache,
                                           block_tables, seq_lens,
-                                          k_scale, v_scale)
+                                          k_scale, v_scale, kv_int4)
     return paged_decode_attention_xla(q, k_cache, v_cache, block_tables,
-                                      seq_lens, k_scale, v_scale)
+                                      seq_lens, k_scale, v_scale, kv_int4)

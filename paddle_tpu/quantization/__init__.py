@@ -8,10 +8,12 @@ fuses the quant-dequant chain into the surrounding matmul).
 """
 from .config import QuantConfig
 from .observers import AbsmaxObserver, BaseObserver, MinMaxObserver
-from .ptq import PTQ
+from .ptq import PTQ, load_ptq_state_dict, ptq_state_dict
 from .qat import QAT
 from .quanters import BaseQuanter, FakeQuanterWithAbsMax, fake_quant, quanter
 
-__all__ = ["QuantConfig", "PTQ", "QAT", "AbsmaxObserver", "MinMaxObserver",
+__all__ = ["QuantConfig", "PTQ", "QAT", "ptq_state_dict",
+           "load_ptq_state_dict",
+           "AbsmaxObserver", "MinMaxObserver",
            "BaseObserver", "BaseQuanter", "quanter",
            "FakeQuanterWithAbsMax", "fake_quant"]

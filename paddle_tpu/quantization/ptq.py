@@ -137,6 +137,107 @@ class PTQ:
         return model
 
 
+# ------------------------------------------- weight-only PTQ export (r20)
+
+def ptq_state_dict(model: Layer, algo: str = "weight_only_int8",
+                   group_size: int = -1, sample_inputs=None) -> dict:
+    """Calibrate + quantize every Linear weight, returning a flat
+    checkpoint-ready state dict in the `weight_quantize` layout.
+
+    For each Linear sublayer ``n`` the full-precision ``n.weight`` entry is
+    replaced by ``n.weight`` (int8 [K, N], or the int4 nibble-pack
+    [ceil(K/2), N]) plus ``n.weight_scale`` — the exact tensors
+    incubate.nn.functional.weight_quantize produces, i.e. the SAME
+    quantization rule the weight-only serving path applies to live
+    weights. That identity is the round-trip contract: dequantize the
+    artifact back (`load_ptq_state_dict`), serve with
+    ``weight_quant=<mode>``, and the engine re-derives the identical
+    integer lattice — greedy decode is token-identical to serving the
+    original model quantized directly.
+
+    ``sample_inputs`` (iterable of model inputs / input tuples) runs a
+    calibration pass with a forward-pre-hook on every Linear recording the
+    activation absmax; each calibrated layer adds an ``n.act_scale``
+    scalar (absmax / 127) to the dict — the act_scale a full-int8
+    QuantizedLinear wants. Weight-only serving ignores it."""
+    from ..incubate.nn.functional import weight_quantize
+    from ..nn import Linear
+
+    if algo not in ("weight_only_int8", "weight_only_int4"):
+        raise ValueError(f"ptq_state_dict: unknown algo {algo!r}")
+    linears = [(n, l) for n, l in model.named_sublayers()
+               if isinstance(l, Linear)]
+
+    act_amax: dict[str, object] = {}
+    if sample_inputs is not None:
+        hooks = []
+
+        def make_hook(name):
+            def hook(layer, inputs):
+                a = jnp.max(jnp.abs(inputs[0]._data)).astype(jnp.float32)
+                prev = act_amax.get(name)
+                act_amax[name] = a if prev is None else jnp.maximum(prev, a)
+            return hook
+
+        for n, l in linears:
+            hooks.append(l.register_forward_pre_hook(make_hook(n)))
+        try:
+            for batch in sample_inputs:
+                args = batch if isinstance(batch, (tuple, list)) else (batch,)
+                model(*args)
+        finally:
+            for h in hooks:
+                h.remove()
+
+    state = dict(model.state_dict())
+    for n, l in linears:
+        wkey = f"{n}.weight" if n else "weight"
+        if wkey not in state:
+            continue
+        q, scale = weight_quantize(l.weight, algo=algo,
+                                   group_size=group_size)
+        state[wkey] = q
+        state[f"{n}.weight_scale" if n else "weight_scale"] = scale
+        if n in act_amax:
+            state[f"{n}.act_scale"] = Tensor(act_amax[n] / 127.0,
+                                             _internal=True)
+    return state
+
+
+def load_ptq_state_dict(model: Layer, state: dict) -> Layer:
+    """Restore a `ptq_state_dict` artifact into a full-precision model:
+    each (weight, weight_scale) pair dequantizes back into the Linear's
+    weight (int8 vs packed int4 resolved against the layer's logical K),
+    act_scale entries are dropped, everything else routes through
+    set_state_dict. The restored weights ARE the quantization lattice, so
+    re-quantizing at serve time is lossless."""
+    from ..incubate.nn.functional import weight_dequantize
+    from ..nn import Linear
+    from ..ops.quantized import packed_rows
+
+    state = dict(state)
+    for n, l in model.named_sublayers():
+        if not isinstance(l, Linear):
+            continue
+        wkey = f"{n}.weight" if n else "weight"
+        skey = f"{n}.weight_scale" if n else "weight_scale"
+        if skey not in state:
+            continue
+        q = state.pop(wkey)
+        scale = state.pop(skey)
+        state.pop(f"{n}.act_scale", None)
+        k = int(l.weight.shape[0])
+        rows = int(q.shape[-2]) if q.ndim >= 2 else int(q.shape[0])
+        algo = "weight_only_int4" \
+            if rows != k and rows == packed_rows(k) else "weight_only_int8"
+        w = weight_dequantize(q, scale, algo=algo, k=k,
+                              out_dtype=str(l.weight.dtype))
+        state[wkey] = w
+    state = {k: v for k, v in state.items() if not k.endswith(".act_scale")}
+    model.set_state_dict(state)
+    return model
+
+
 def _warn_unsupported(name: str, layer) -> None:
     import warnings
 

@@ -48,10 +48,14 @@ class _GenSpec:
     eos_token_id: int
     tie_embeddings: bool
     arch: str = "llama"  # "llama" (RMSNorm+RoPE+SwiGLU) | "gpt" (LN+wpe+GELU)
-    # "none" | "int8": weight-only per-output-channel int8 on the layer
-    # matmuls + lm_head (≙ weight_only_linear's serving role) — decode is
-    # HBM-bandwidth-bound, so halving weight bytes is the win; activations
-    # stay bf16 and XLA fuses the int8->bf16 convert into the matmul tiles
+    # "none" | "int8" | "int4": weight-only per-output-channel quantization
+    # on the layer matmuls + lm_head (≙ weight_only_linear's serving role) —
+    # decode is HBM-bandwidth-bound, so shrinking weight bytes is the win;
+    # activations stay bf16. int8 stores [K, N] int8 (XLA fuses the
+    # int8->bf16 convert into the matmul tiles); int4 stores TRUE packed
+    # [ceil(K/2), N] nibbles (ops/quantized.py) so the packed bytes are the
+    # only HBM weight traffic — the Pallas fused dequant-matmul unpacks in
+    # VMEM on TPU, the XLA take-bits composition everywhere else
     weight_quant: str = "none"
 
 
@@ -82,12 +86,15 @@ def _repeat_kv(x, rep, axis):
 
 
 def _mm(x, w):
-    """x @ w where w is either a dense array or a weight-only-int8 pair
-    (w8 int8 [K,N], scale f32 [N]); per-output-channel scale commutes with
-    the contraction: x @ (w8*ws) == (x @ w8) * ws."""
+    """x @ w where w is either a dense array or a weight-only pair
+    (int8 [K,N] or packed int4 [ceil(K/2),N], scale f32 [N]) — the pair
+    shape disambiguates, see ops/quantized.quant_matmul (the single shared
+    dequant-matmul behind generation, weight_only_linear and the paged
+    engine)."""
     if isinstance(w, tuple):
-        w8, ws = w
-        return (x @ w8.astype(x.dtype)) * ws.astype(x.dtype)
+        from ..ops.quantized import quant_matmul
+
+        return quant_matmul(x, w[0], w[1])
     return x @ w
 
 
@@ -98,6 +105,16 @@ def _quantize_w(w):
     from ..incubate.nn.functional import weight_quantize_raw
 
     return weight_quantize_raw(w)
+
+
+def _quantize_w4(w):
+    """TRUE packed int4 (two nibbles per byte) with per-output-channel
+    scales — the same rule weight_quantize(algo="weight_only_int4") applies
+    (ops/quantized.quantize_int4 handles stacked [L, K, N] weights
+    directly: every axis rule is relative to the trailing two dims)."""
+    from ..ops.quantized import quantize_int4
+
+    return quantize_int4(w)
 
 
 def _sample_token(logits, key, spec: _GenSpec):
@@ -205,7 +222,7 @@ def _gpt_layer_prefill(x, lw, spec: _GenSpec):
 
     b, s, h = x.shape
     hn = _layer_norm(x, lw["ln1_w"], lw["ln1_b"], spec.rms_eps)
-    qkv = (hn.reshape(b * s, h) @ lw["qkv"]).reshape(
+    qkv = _mm(hn.reshape(b * s, h), lw["qkv"]).reshape(
         b, s, 3, spec.num_heads, spec.head_dim)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     if jax.default_backend() == "tpu" and s >= 128:
@@ -222,10 +239,10 @@ def _gpt_layer_prefill(x, lw, spec: _GenSpec):
         probs = jax.nn.softmax(scores.astype(jnp.float32),
                                axis=-1).astype(q.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-    x = x + (out.reshape(b * s, h) @ lw["o"]).reshape(b, s, h)
+    x = x + _mm(out.reshape(b * s, h), lw["o"]).reshape(b, s, h)
     hn = _layer_norm(x, lw["ln2_w"], lw["ln2_b"], spec.rms_eps)
-    mlp = jax.nn.gelu(hn.reshape(b * s, h) @ lw["fc_in"],
-                      approximate=False) @ lw["fc_out"]
+    mlp = _mm(jax.nn.gelu(_mm(hn.reshape(b * s, h), lw["fc_in"]),
+                          approximate=False), lw["fc_out"])
     return x + mlp.reshape(b, s, h), (k, v)
 
 
@@ -233,7 +250,7 @@ def _gpt_layer_decode(x, lw, kc, vc, pos, spec: _GenSpec):
     """Pre-LN GPT block for a seq-1 query. x [B, H]."""
     b, h = x.shape
     hn = _layer_norm(x, lw["ln1_w"], lw["ln1_b"], spec.rms_eps)
-    qkv = (hn @ lw["qkv"]).reshape(b, 3, spec.num_heads, spec.head_dim)
+    qkv = _mm(hn, lw["qkv"]).reshape(b, 3, spec.num_heads, spec.head_dim)
     q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
     z = jnp.int32(0)
     kc = jax.lax.dynamic_update_slice(kc, k[:, None], (z, pos, z, z))
@@ -244,10 +261,10 @@ def _gpt_layer_decode(x, lw, kc, vc, pos, spec: _GenSpec):
                        jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     out = jnp.einsum("bht,bthd->bhd", probs, vc)
-    x = x + out.reshape(b, h) @ lw["o"]
+    x = x + _mm(out.reshape(b, h), lw["o"])
     hn = _layer_norm(x, lw["ln2_w"], lw["ln2_b"], spec.rms_eps)
-    return x + jax.nn.gelu(hn @ lw["fc_in"],
-                           approximate=False) @ lw["fc_out"], kc, vc
+    return x + _mm(jax.nn.gelu(_mm(hn, lw["fc_in"]),
+                               approximate=False), lw["fc_out"]), kc, vc
 
 
 def _logits(x, params, spec: _GenSpec):
@@ -261,9 +278,9 @@ def _logits(x, params, spec: _GenSpec):
         return x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
     head = params["lm_head"]
     if isinstance(head, tuple):
-        w8, ws = head
-        return (x.astype(jnp.float32) @ w8.astype(jnp.float32)) \
-            * ws.astype(jnp.float32)
+        # f32 activations keep the historical logits numerics: for int8
+        # this is exactly (x_f32 @ w8_f32) * ws_f32; int4 unpacks first
+        return _mm(x.astype(jnp.float32), head)
     return x.astype(jnp.float32) @ head.astype(jnp.float32)
 
 
@@ -381,8 +398,9 @@ def _cached_extract(model, extract_fn, tag=""):
 
 def _stacked_params(model, weight_quant="none"):
     """Extract + stack per-layer weights [L, ...] for lax.scan (cached,
-    see _cached_extract). weight_quant="int8" stores the seven layer
-    matmul weights and lm_head as weight-only int8 pairs (see _mm)."""
+    see _cached_extract). weight_quant="int8"/"int4" stores the seven
+    layer matmul weights and lm_head as weight-only pairs (see _mm; int4
+    is true packed-nibble storage)."""
     cfg = model.config
     return _cached_extract(
         model, lambda sd: _extract_llama(cfg, sd, weight_quant),
@@ -407,13 +425,18 @@ def _extract_llama(cfg, sd, weight_quant="none"):
         layers["down"].append(w(base + "mlp.down_proj.weight"))
         layers["input_ln"].append(w(base + "input_layernorm.weight"))
         layers["post_ln"].append(w(base + "post_attention_layernorm.weight"))
-    quant = weight_quant == "int8"
+    quant = weight_quant in ("int8", "int4")
+    qfn = _quantize_w4 if weight_quant == "int4" else _quantize_w
 
     def stack(k, vals):
         stacked = jnp.stack(vals)
         if quant and k not in ("input_ln", "post_ln"):
+            if weight_quant == "int4":
+                # quantize_int4's axis rules are trailing-dim-relative, so
+                # the stacked [L, K, N] tensor quantizes in one call
+                return qfn(stacked)
             # vmap the per-channel quantizer over the layer axis
-            return jax.vmap(_quantize_w)(stacked)
+            return jax.vmap(qfn)(stacked)
         return stacked
 
     params = {
@@ -423,7 +446,7 @@ def _extract_llama(cfg, sd, weight_quant="none"):
     }
     if not cfg.tie_word_embeddings:
         head = w("lm_head.weight")
-        params["lm_head"] = _quantize_w(head) if quant else head
+        params["lm_head"] = qfn(head) if quant else head
     cos, sin = _rope_tables_np(cfg.max_position_embeddings, cfg.head_dim,
                                cfg.rope_theta,
                                np.dtype(params["embed"].dtype).name
@@ -434,13 +457,17 @@ def _extract_llama(cfg, sd, weight_quant="none"):
     return params
 
 
-def _stacked_params_gpt(model):
-    """GPT-family extraction: LN weights/biases, fused qkv, learned wpe."""
+def _stacked_params_gpt(model, weight_quant="none"):
+    """GPT-family extraction: LN weights/biases, fused qkv, learned wpe.
+    weight_quant="int8"/"int4" stores qkv/o/fc_in/fc_out + lm_head as
+    weight-only pairs (see _mm)."""
     cfg = model.config
-    return _cached_extract(model, lambda sd: _extract_gpt(cfg, sd))
+    return _cached_extract(
+        model, lambda sd: _extract_gpt(cfg, sd, weight_quant),
+        tag=weight_quant)
 
 
-def _extract_gpt(cfg, sd):
+def _extract_gpt(cfg, sd, weight_quant="none"):
     def w(name):
         return sd[name]._data
 
@@ -456,13 +483,25 @@ def _extract_gpt(cfg, sd):
         layers["ln2_b"].append(w(base + "ln_2.bias"))
         layers["fc_in"].append(w(base + "fc_in.weight"))
         layers["fc_out"].append(w(base + "fc_out.weight"))
+    quant = weight_quant in ("int8", "int4")
+    qfn = _quantize_w4 if weight_quant == "int4" else _quantize_w
+    qkeys = ("qkv", "o", "fc_in", "fc_out")
+
+    def stack(k, vals):
+        stacked = jnp.stack(vals)
+        if quant and k in qkeys:
+            return qfn(stacked) if weight_quant == "int4" \
+                else jax.vmap(qfn)(stacked)
+        return stacked
+
+    head = w("lm_head.weight")
     params = {
         "embed": w("wte.weight"),
         "wpe": w("wpe.weight"),
         "final_ln": w("ln_f.weight"),
         "final_ln_b": w("ln_f.bias"),
-        "lm_head": w("lm_head.weight"),
-        "layers": {k: jnp.stack(v) for k, v in layers.items()},
+        "lm_head": qfn(head) if quant else head,
+        "layers": {k: stack(k, v) for k, v in layers.items()},
     }
     return params
 
@@ -513,24 +552,20 @@ def generate(model, input_ids, max_new_tokens=32, max_length=None,
                          f"{engine!r}")
     # models declare their engine arch; default is the llama layout
     arch = getattr(model, "_gen_arch", "llama")
-    if weight_quant not in ("none", "int8"):
-        raise ValueError(f"weight_quant must be 'none' or 'int8', got "
-                         f"{weight_quant!r}")
-    if arch == "gpt" and weight_quant != "none":
-        raise NotImplementedError(
-            "weight-only int8 generation is wired for the llama arch only")
+    from ..core.flags import flag
+
+    if weight_quant in (None, "none"):
+        # the serving-wide default: per-call weight_quant= overrides
+        weight_quant = str(flag("FLAGS_weight_only_dtype"))
+    if weight_quant not in ("none", "int8", "int4"):
+        raise ValueError(f"weight_quant must be 'none', 'int8' or 'int4', "
+                         f"got {weight_quant!r}")
     mnt = int(max_new_tokens)
     if engine == "paged":
-        if weight_quant != "none":
-            raise NotImplementedError(
-                "weight-only int8 rides the static engine; the paged "
-                "engine's int8 lever is the KV cache "
-                "(kv_cache_dtype='int8')")
         # the paged engine addresses context through whole KV blocks, so
         # its usable length is max_position_embeddings rounded DOWN to the
         # block size — surface the gap here, at the API boundary, instead
         # of deep inside the engine's admission check
-        from ..core.flags import flag
 
         kv_bs = int(flag("FLAGS_kv_block_size"))
         usable = (int(cfg.max_position_embeddings) // kv_bs) * kv_bs
@@ -550,7 +585,8 @@ def generate(model, input_ids, max_new_tokens=32, max_length=None,
                               eos_token_id=eos_token_id,
                               seed=None if seed is None else int(seed),
                               prefix_cache=prefix_cache,
-                              spec_decode=spec_decode)
+                              spec_decode=spec_decode,
+                              weight_quant=str(weight_quant))
         return _assemble_output(ids, toks, eos_token_id, Tensor)
     if prefix_cache is not None:
         raise ValueError("prefix_cache applies to engine='paged' only "
@@ -599,8 +635,9 @@ def generate(model, input_ids, max_new_tokens=32, max_length=None,
             temperature=float(temperature),
             eos_token_id=int(eos_token_id if eos_token_id is not None
                              else -1),
-            tie_embeddings=False, arch="gpt")
-        params = _stacked_params_gpt(model)
+            tie_embeddings=False, arch="gpt",
+            weight_quant=str(weight_quant))
+        params = _stacked_params_gpt(model, weight_quant=str(weight_quant))
     else:
         spec = _GenSpec(
             num_layers=cfg.num_hidden_layers,

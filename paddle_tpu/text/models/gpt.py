@@ -135,12 +135,14 @@ class GPTForCausalLM(nn.Layer):
     def generate(self, input_ids, max_new_tokens=32, max_length=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                  eos_token_id=None, seed=None, engine="static",
-                 prefix_cache=None, spec_decode=None):
+                 prefix_cache=None, spec_decode=None, weight_quant="none"):
         """KV-cached decoding (see text/generation.py; gpt arch: LayerNorm
         + learned positions + fused-qkv pre-LN blocks). engine="static":
         one compiled XLA program; engine="paged": the continuous-batching
         paged-KV serving engine (inference/engine.py; `prefix_cache`
-        overrides FLAGS_prefix_cache there)."""
+        overrides FLAGS_prefix_cache there). weight_quant="int8"/"int4"
+        serves weight-only-quantized matmuls (round 20: int4 is true
+        packed storage)."""
         from ..generation import generate as _generate
 
         return _generate(self, input_ids, max_new_tokens=max_new_tokens,
@@ -148,4 +150,5 @@ class GPTForCausalLM(nn.Layer):
                          temperature=temperature, top_k=top_k, top_p=top_p,
                          eos_token_id=eos_token_id, seed=seed,
                          engine=engine, prefix_cache=prefix_cache,
-                         spec_decode=spec_decode)
+                         spec_decode=spec_decode,
+                         weight_quant=weight_quant)

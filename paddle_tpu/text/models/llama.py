@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ... import nn
+from ...amp import fp8
 from ...core.tensor import Tensor
 from ...nn import functional as F
 
@@ -143,9 +144,16 @@ class LlamaAttention(nn.Layer):
 
     def forward(self, x, attn_mask=None):
         b, s, _ = x.shape
-        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
-        k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
-        v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        # FLAGS_amp_fp8: the four attention GEMMs run e4m3-fwd/e5m2-bwd with
+        # delayed per-site scaling (amp/fp8.py); rope/softmax/norms keep
+        # their existing bf16/f32 policy
+        if fp8.enabled():
+            mm = fp8.linear
+        else:
+            mm = lambda lyr, t: lyr(t)
+        q = mm(self.q_proj, x).reshape([b, s, self.num_heads, self.head_dim])
+        k = mm(self.k_proj, x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        v = mm(self.v_proj, x).reshape([b, s, self.num_kv_heads, self.head_dim])
         cos = self.cos[:, :s]
         sin = self.sin[:, :s]
         rd = _residual_dtype()
@@ -159,7 +167,7 @@ class LlamaAttention(nn.Layer):
         out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
                                              is_causal=True)
         out = out.reshape([b, s, self.num_heads * self.head_dim])
-        return self.o_proj(out)
+        return mm(self.o_proj, out)
 
 
 class LlamaMLP(nn.Layer):
@@ -174,6 +182,10 @@ class LlamaMLP(nn.Layer):
         self.down_proj.shard_annotate(weight=("mlp", "embed"))
 
     def forward(self, x):
+        if fp8.enabled():
+            h = F.swiglu(fp8.linear(self.gate_proj, x),
+                         fp8.linear(self.up_proj, x))
+            return fp8.linear(self.down_proj, h)
         return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
 
 

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.lockdep import ThreadContract
+from ..ops.quantized import INT4_QMAX, int4_pack, int4_unpack
 
 #: block id 0 is never allocated — masked writes land there (see module doc)
 TRASH_BLOCK = 0
@@ -301,8 +302,10 @@ class PrefixCache:
 class PagedKVCache:
     """The pooled cache arrays for every layer of one model.
 
-    dtype: the storage dtype ("int8" adds per-(layer, block) f32 scale
-    arrays; anything else stores k/v directly). Arrays start zeroed —
+    dtype: the storage mode ("int8" adds per-(layer, block) f32 scale
+    arrays; "int4" additionally packs two tokens per byte along the
+    block_size axis, halving the cache's HBM footprint again; anything
+    else stores k/v directly). Arrays start zeroed —
     freshly (re)allocated blocks may hold stale data from a finished
     request, which is fine: reads are bounded by per-sequence lengths and
     appends overwrite before the length mask ever exposes a slot.
@@ -328,10 +331,21 @@ class PagedKVCache:
         self.num_kv_heads = int(num_kv_heads)
         self.block_size = int(block_size)
         self.head_dim = int(head_dim)
-        self.quantized = str(dtype) == "int8"
+        #: "model" | "int8" | "int4" — int4 stores int8 ARRAYS too (two
+        #: tokens per byte along the block_size axis), so mode, not the
+        #: array dtype, is what callers key programs/namespaces on
+        self.mode = str(dtype) if str(dtype) in ("int8", "int4") else "model"
+        self.quantized = self.mode != "model"
         self.dtype = jnp.int8 if self.quantized else dtype
+        tok = self.block_size
+        if self.mode == "int4":
+            # split-half packed along the token axis: byte t holds token t
+            # (low nibble) and token bs/2 + t (high nibble); block_size is
+            # a multiple of 8, so the halves are exact
+            tok = self.block_size // 2
+        self.stored_block_size = tok
         shape = (self.num_layers, self.num_blocks, self.num_kv_heads,
-                 self.block_size, self.head_dim)
+                 tok, self.head_dim)
         self.k = jnp.zeros(shape, self.dtype)
         self.v = jnp.zeros(shape, self.dtype)
         if self.quantized:
@@ -495,12 +509,102 @@ def scatter_chunk_int8(cache, scale, ks, start, true_end, table_row,
     return (cache.at[dest].set(q8), scale.at[dest].set(new_scale))
 
 
-def gather_context(cache, scale, table_row, ctx_pages):
+# -------------------------------------------------------- int4-KV updates
+# Same contracts as the int8 variants above, with the block's tokens stored
+# two-per-byte along the block_size axis (split-half: byte t holds token t
+# in the low nibble, token bs/2 + t in the high nibble — ops/quantized's
+# axis-generic rule). Every update dequantizes the touched block (unpack +
+# scale), edits at FULL block_size resolution, requantizes over the valid
+# prefix against the -7..7 range, and repacks — so a block's scale always
+# covers exactly its valid tokens, like int8.
+
+def _unpack_block(packed, bs):
+    """[..., bs/2, D] packed int8 -> [..., bs, D] int4 values (int8)."""
+    return int4_unpack(packed, bs, axis=-2)
+
+
+def _requant_pack_int4(x, new_scale, lead_dims):
+    """Quantize a dequantized block tensor x [..., bs, D] against
+    per-block scales (broadcast over `lead_dims` leading axes) and repack
+    to [..., bs/2, D] int8."""
+    s = new_scale.reshape(new_scale.shape + (1,) * (x.ndim - lead_dims))
+    q = jnp.clip(jnp.round(x / s), -INT4_QMAX, INT4_QMAX).astype(jnp.int8)
+    return int4_pack(q, axis=-2)
+
+
+def append_token_int4(cache, scale, kv, block_ids, offsets):
+    """Int4 decode append: dequantize (unpack + scale) the touched block,
+    insert the new token, rescale over the valid prefix, requantize and
+    REPACK. cache [N, Hkv, bs/2, D] int8-packed; returns (cache, scale)."""
+    b = kv.shape[0]
+    bs = cache.shape[2] * 2
+    old = _unpack_block(cache[block_ids], bs).astype(jnp.float32)
+    x = old * scale[block_ids][:, None, None, None]     # [B, Hkv, bs, D]
+    x = x.at[jnp.arange(b), :, offsets].set(kv.astype(jnp.float32))
+    valid = (jnp.arange(bs)[None, :] <= offsets[:, None])  # [B, bs]
+    amax = jnp.max(jnp.abs(x) * valid[:, None, :, None], axis=(1, 2, 3))
+    new_scale = jnp.maximum(amax / INT4_QMAX, 1e-8)      # [B]
+    packed = _requant_pack_int4(x, new_scale, 1)
+    return (cache.at[block_ids].set(packed),
+            scale.at[block_ids].set(new_scale))
+
+
+def scatter_prefill_int4(cache, scale, ks, true_len, table_row,
+                         block_size):
+    """Int4 prefill scatter: one scale per (layer, page) over the page's
+    valid tokens, whole-page requantized + packed write. Returns
+    (cache, scale)."""
+    tiles, dest, tok_valid = _prefill_pages(ks, true_len, table_row,
+                                            block_size)
+    tf = tiles.astype(jnp.float32)                 # [L, P_b, Hkv, bs, D]
+    amax = jnp.max(jnp.abs(tf) * tok_valid[None, :, None, :, None],
+                   axis=(2, 3, 4))                 # [L, P_b]
+    new_scale = jnp.maximum(amax / INT4_QMAX, 1e-8)
+    packed = _requant_pack_int4(tf, new_scale, 2)
+    return (cache.at[:, dest].set(packed),
+            scale.at[:, dest].set(new_scale))
+
+
+def scatter_chunk_int4(cache, scale, ks, start, true_end, table_row,
+                       block_size):
+    """Int4 chunk scatter: every page the chunk touches is dequantized
+    (unpack + scale — pre-existing content survives), the chunk tokens
+    inserted at full resolution, and the page requantized over its valid
+    prefix and repacked. Same page window as int8: a chunk starting
+    mid-block spans up to ceil(c/bs)+1 pages. Returns (cache, scale)."""
+    c = ks.shape[0]
+    bs = int(block_size)
+    p_t = -(-c // bs) + 1                      # pages a C-chunk can span
+    page0 = start // bs
+    pages = page0 + jnp.arange(p_t)
+    page_ok = (pages * bs < true_end) & (pages < table_row.shape[0])
+    dest = jnp.where(page_ok,
+                     table_row[jnp.clip(pages, 0, table_row.shape[0] - 1)],
+                     TRASH_BLOCK).astype(jnp.int32)
+    old = _unpack_block(cache[dest], bs).astype(jnp.float32) \
+        * scale[dest][:, None, None, None]     # [P_t, Hkv, bs, D]
+    pos = start + jnp.arange(c)
+    ok = pos < true_end
+    tok_page = jnp.where(ok, pos // bs - page0, p_t)   # OOB -> dropped
+    off = (pos % bs).astype(jnp.int32)
+    old = old.at[tok_page, :, off].set(ks.astype(jnp.float32),
+                                       mode="drop")
+    valid = (pages[:, None] * bs + jnp.arange(bs)[None, :]) < true_end
+    amax = jnp.max(jnp.abs(old) * valid[:, None, :, None], axis=(1, 2, 3))
+    new_scale = jnp.maximum(amax / INT4_QMAX, 1e-8)    # [P_t]
+    packed = _requant_pack_int4(old, new_scale, 1)
+    return (cache.at[dest].set(packed), scale.at[dest].set(new_scale))
+
+
+def gather_context(cache, scale, table_row, ctx_pages, int4=False):
     """One layer's context K (or V) for chunk attention: the first
     `ctx_pages` table entries gathered to [ctx_pages * bs, H_kv, D]
-    (dequantized when `scale` is given). Unwritten/trash pages surface
-    garbage that the caller's `kv_pos <= q_pos` mask never attends."""
-    tiles = cache[table_row[:ctx_pages]]       # [P, Hkv, bs, D]
+    (dequantized when `scale` is given; `int4=True` additionally unpacks
+    the token axis first). Unwritten/trash pages surface garbage that the
+    caller's `kv_pos <= q_pos` mask never attends."""
+    tiles = cache[table_row[:ctx_pages]]       # [P, Hkv, bs(/2), D]
+    if int4:
+        tiles = _unpack_block(tiles, tiles.shape[2] * 2)
     if scale is not None:
         tiles = tiles.astype(jnp.float32) \
             * scale[table_row[:ctx_pages]][:, None, None, None]

@@ -64,8 +64,8 @@ QUICK_MODULES = {
     "test_optimizer.py", "test_pallas_attention.py", "test_pallas_decode.py",
     "test_partitioner.py",
     "test_pallas_norm.py", "test_passes.py", "test_prefix_cache.py",
-    "test_profiler.py", "test_router.py", "test_scoreboard.py",
-    "test_segmented.py",
+    "test_profiler.py", "test_quantized.py", "test_router.py",
+    "test_scoreboard.py", "test_segmented.py",
     "test_serving.py", "test_spec_decode.py", "test_static_engine.py",
     "test_train_flight.py",
     "test_vision_ops.py",

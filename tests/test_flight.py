@@ -327,6 +327,23 @@ class TestCostLedger:
                    for e in obs.ledger("serving"))
         json.dumps(obs.roofline_rows("serving"))
 
+    def test_cache_hit_reregisters_after_clear_ledger(self):
+        """Executables outlive the ledger (module-level AOT cache): an
+        engine whose programs are pure cache hits after clear_ledger()
+        must re-surface its rows, not decode invisibly (the cross-module
+        ordering bug: any clear_ledger between two same-spec engines
+        emptied this very test's serving.decode view)."""
+        from paddle_tpu.inference.engine import ServingEngine
+
+        eng = ServingEngine(_tiny_llama(), max_slots=2)
+        _drive(eng, ((3, 2),))
+        obs_costs.clear_ledger()
+        eng2 = ServingEngine(_tiny_llama(), max_slots=2)
+        _drive(eng2, ((3, 2),))
+        dec = [e for e in obs.ledger("serving.decode") if e.exec_count > 0]
+        assert dec, "cache-hit decode rows missing after clear_ledger"
+        assert all(e.analyzed for e in dec)
+
     def test_generate_site_captures_costs(self):
         m = _tiny_llama()
         ids = paddle.to_tensor(

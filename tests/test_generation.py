@@ -236,12 +236,26 @@ class TestWeightOnlyInt8Generate:
         tags = {k[1] for k in g._STACK_CACHE if isinstance(k, tuple)}
         assert {"none", "int8"} <= tags or len(g._STACK_CACHE) >= 2
 
+    def test_int4_close_to_fp(self):
+        """Round 20: int4 (nibble-packed) joins int8 as a static-engine
+        weight-only mode."""
+        m = _tiny()
+        prompt = np.random.RandomState(9).randint(0, 128,
+                                                  (2, 6)).astype("int64")
+        fp = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                   max_new_tokens=6, seed=0)._data)
+        i4 = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                   max_new_tokens=6, seed=0,
+                                   weight_quant="int4")._data)
+        assert fp.shape == i4.shape
+        assert (fp == i4).mean() > 0.6, (fp, i4)
+
     def test_bad_quant_mode_raises(self):
         m = _tiny()
         prompt = np.zeros((1, 4), dtype="int64")
         with pytest.raises(ValueError):
             m.generate(paddle.to_tensor(prompt), max_new_tokens=2,
-                       weight_quant="int4")
+                       weight_quant="int2")
 
 
 class TestBufVersionCache:

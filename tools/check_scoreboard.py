@@ -183,10 +183,11 @@ def check(repo=REPO, details_path=None, rtol=RTOL):
 #: entry's match counts across the union (full coverage restored).
 LINT_GROUPS = (("llama,gpt,bert", True), ("paged,obs,ckpt", False),
                ("spmd", False), ("conc", False), ("router", False),
-               ("plan", False))
+               ("plan", False), ("quant", False))
 
 
-def lint_gate(models="llama,gpt,bert,paged,obs,ckpt,spmd,conc,router,plan",
+def lint_gate(models="llama,gpt,bert,paged,obs,ckpt,spmd,conc,router,plan,"
+                     "quant",
               timeout=900):
     """The graft_lint CI gate (round-9; round-10 adds the `paged` serving
     smoke — a tiny-LLaMA 2-slot continuous-batching engine whose decode

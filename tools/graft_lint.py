@@ -87,6 +87,16 @@ REQUIRED_FLEET_METRICS rows must exist in the router registry, and the
 D17 affinity-defeat fire fixture (a drifting fingerprint scattering
 byte-identical prompts) must still trip its warning.
 
+The special model name `quant` (round 20) smokes the QUANTIZATION
+byte-budget claims: int8/int4 weight-only paged engines plus an int4-KV
+engine drive the same stream as a full-precision twin; D20
+audit_quantized_bytes must verify the live decode-program pairs' ledger
+boundary bytes against the 1.8x/3.4x shrink budgets, D20b
+audit_silent_dequant + D1/D4 must be clean on the quantized decode
+jaxprs, zero compiles may land after any engine's warmup barrier, and
+the D20/D20b fire fixtures (a non-shrinking ledger pair, a weight-sized
+int8->f32 convert) must trip — silence fails the gate.
+
 The special model name `plan` (round 21) smokes the STATIC COST MODEL:
 `autoplan.search` must rank ≥6 valid MeshConfigs for tiny-LLaMA on the
 8-device virtual mesh from one abstract lowering (nothing executes),
@@ -98,7 +108,7 @@ ranking flip) fire fixtures must trip — silence fails the gate.
 
 Exit code: 0 when no unsuppressed warning/error finding survives the
 baseline (notes never fail); 1 otherwise. CI runs
-`graft_lint.py --models llama,gpt,bert,paged,obs,ckpt,spmd,conc,router,plan --json`
+`graft_lint.py --models llama,gpt,bert,paged,obs,ckpt,spmd,conc,router,plan,quant --json`
 via tools/check_scoreboard — round 17 splits that into PARALLEL
 subprocess groups (check_scoreboard.LINT_GROUPS) so the gate wall stays
 at the slowest group; each worker passes `--defer-stale` and the gate
@@ -136,7 +146,7 @@ DEFAULT_BASELINE = os.path.join(REPO, "tools", "lint_baseline.json")
 #: — a partial run legitimately leaves model-specific suppressions
 #: unmatched
 CI_MODELS = ("llama", "gpt", "bert", "paged", "obs", "ckpt", "spmd",
-             "conc", "router", "plan")
+             "conc", "router", "plan", "quant")
 
 #: one tiny-LLaMA shared by the serving-side smokes (`paged`, `obs`): the
 #: engines key their AOT executables on spec + param AVALS, so a shared
@@ -1555,6 +1565,188 @@ def audit_plan_smoke() -> list:
     return findings
 
 
+def audit_quant() -> list:
+    """The `quant` smoke (round 20): drive int8 and int4 weight-only
+    paged engines plus an int4-KV engine against a full-precision twin
+    ON THE SAME STREAM, then gate the quantization claims:
+
+    - D20 audit_quantized_bytes over the REAL decode-program ledger
+      rows: the int8 engine's measured weight traffic must shrink
+      >= 1.8x, the int4 engine's >= 3.4x, vs the twin (weight bytes
+      from engine.param_bytes — the packed stack, scales included);
+    - D20b audit_silent_dequant + D1/D4 on the quantized decode
+      program's jaxpr;
+    - zero compiles after the warmup barrier on every quantized engine
+      (a per-mode cache-key miss recompiling mid-serve is D6);
+    - fire fixtures for both detectors — a rigged non-shrinking ledger
+      pair and a weight-sized int8->f32 convert must each trip an
+      error; silence is the gate failure."""
+    import types
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis, obs
+    from paddle_tpu.core.flags import flag
+    from paddle_tpu.inference.engine import ServingEngine
+    from paddle_tpu.obs import costs as _costs
+
+    paddle.seed(0)
+    model = _tiny_llama()
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, 128, (ln,)) for ln in (5, 9)]
+    findings = []
+
+    obs.clear_events()
+
+    def drive(wq, kv):
+        eng = ServingEngine(model, max_slots=2, weight_quant=wq,
+                            kv_cache_dtype=kv)
+        for p in prompts:
+            eng.add_request(p, max_new_tokens=4)
+        eng.run()                        # warm this mode's programs
+        eng = ServingEngine(model, max_slots=2, weight_quant=wq,
+                            kv_cache_dtype=kv)
+        eng.finish_warmup()
+        for p in prompts:
+            eng.add_request(p, max_new_tokens=4)
+        out = eng.run()
+        assert len(out) == len(prompts) and all(
+            len(v) for v in out.values()), \
+            f"quant smoke engine (w={wq}, kv={kv}) failed to drain"
+        return eng
+
+    eng_full = drive("none", "model")
+    eng_i8 = drive("int8", "model")
+    eng_i4 = drive("int4", "model")
+    eng_kv = drive("int4", "int4")
+
+    # ---- D20: the ledger arithmetic on the real decode programs. The
+    # twin pair shares bucket + sampling + KV mode, so every non-weight
+    # byte cancels and the difference isolates the weight stream. The
+    # audit runs on PROGRAM-BOUNDARY bytes (args + outputs): that is the
+    # HBM traffic a bandwidth-bound decode step must move, and it is
+    # platform-stable — this smoke runs on the CPU XLA fallback, whose
+    # per-instruction bytes_accessed re-buys the materialized dequant
+    # intermediate the fused TPU kernel keeps in VMEM. The failure modes
+    # D20 exists for (a cache keyed without the quant mode serving the
+    # bf16 program; a packed tensor shipped next to its dequantized
+    # copy) all land in the boundary bytes.
+    def decode_row(wq, kv):
+        rows = [e for e in _costs.ledger("serving.decode")
+                if f"/kv{kv}/w{wq}" in e.program and e.analyzed]
+        return max(rows, key=lambda e: e.bytes_accessed, default=None)
+
+    full_row = decode_row("none", "model")
+    decls, boundary = [], []
+    for mode, eng in (("int8", eng_i8), ("int4", eng_i4)):
+        row = decode_row(mode, "model")
+        if row is None or full_row is None:
+            findings.append(analysis.Finding(
+                "quant-bytes", "error", "quant/ledger",
+                f"decode program rows missing from the cost ledger "
+                f"(mode {mode}: {row is not None}, twin: "
+                f"{full_row is not None}) — the engines never recorded "
+                "analyzed programs", data={"mode": mode}))
+            continue
+        decls.append({"program": row.program, "twin": full_row.program,
+                      "mode": mode,
+                      "weight_bytes_full": eng_full.param_bytes})
+        boundary.append(row)
+    if decls:
+        boundary.append(full_row)
+        entries = [types.SimpleNamespace(
+            program=e.program, analyzed=e.analyzed,
+            bytes_accessed=e.arg_bytes + e.out_bytes) for e in boundary]
+        d20 = analysis.audit_quantized_bytes(decls, entries=entries,
+                                             loc="quant/ledger")
+    else:
+        d20 = []
+    findings += d20
+    if decls and not d20:
+        findings.append(analysis.Finding(
+            "quant-bytes", "note", "quant/ledger",
+            f"D20 verified on {len(decls)} live decode-program pair(s): "
+            "int8/int4 weight traffic within budget vs the "
+            "full-precision twin",
+            data={"declarations": [d["program"] for d in decls]}))
+
+    # ---- jaxpr-side audits on the quantized decode program: silent
+    # f32 dequant, fusion misses, host callbacks, stream dtype
+    for tag, eng in (("int4w", eng_i4), ("int4kv", eng_kv)):
+        jx = eng.decode_program_jaxpr()
+        findings += analysis.audit_silent_dequant(
+            jx, loc=f"quant/decode_step[{tag}]")
+        findings += analysis.audit_fusion_misses(
+            jx, loc=f"quant/decode_step[{tag}]")
+        findings += analysis.audit_callbacks(
+            jx, loc=f"quant/decode_step[{tag}]")
+        findings += analysis.audit_dtype_stream(
+            jx, policy=str(flag("FLAGS_residual_dtype")),
+            loc=f"quant/decode_step[{tag}]")
+
+    # ---- D6: the measured drives above ran after finish_warmup() on
+    # engines whose programs the warm drives compiled — any serving
+    # compile after a warmup barrier is a per-mode cache-key bug
+    evs = [e for e in obs.compile_events() if e.site.startswith("serving")]
+    findings += obs.audit_recompiles(evs, loc="quant/post-warmup")
+
+    # ---- D20 fire fixture: a declared-int4 program whose ledger bytes
+    # never shrank must trip the budget error (and a declaration over a
+    # ledger that never analyzed the program must also fail)
+    wfull = 100e6
+    rig = [types.SimpleNamespace(program="fix|decode/q", analyzed=True,
+                                 bytes_accessed=120e6),
+           types.SimpleNamespace(program="fix|decode/full", analyzed=True,
+                                 bytes_accessed=121e6)]
+    fire = analysis.audit_quantized_bytes(
+        [{"program": "fix|decode/q", "twin": "fix|decode/full",
+          "mode": "int4", "weight_bytes_full": wfull}],
+        entries=rig, loc="quant/fire-d20")
+    missing = analysis.audit_quantized_bytes(
+        [{"program": "fix|nowhere", "twin": "fix|decode/full",
+          "mode": "int8", "weight_bytes_full": wfull}],
+        entries=rig, loc="quant/fire-d20")
+    if any(f.severity == "error" for f in fire) and \
+            any(f.severity == "error" for f in missing):
+        findings.append(analysis.Finding(
+            "quant-bytes", "note", "quant/fire-d20",
+            "D20 fire fixtures verified: the non-shrinking ledger pair "
+            "tripped the byte-budget error and the never-analyzed "
+            "declaration tripped the dead-audit error"))
+    else:
+        findings.append(analysis.Finding(
+            "quant-bytes", "error", "quant/fire-d20",
+            "D20 detector is SILENTLY DEAD: a declared-int4 program "
+            "moving full-width bytes (or a declaration over a ledger "
+            "that never saw it) produced no error",
+            data={"fire": [f.to_dict() for f in fire],
+                  "missing": [f.to_dict() for f in missing]}))
+
+    # ---- D20b fire fixture: a weight-sized int8 -> f32 convert inside
+    # a program must trip the silent-dequant error
+    def dequant_to_f32(q, s):
+        return q.astype(jnp.float32) * s
+
+    jx_fire = jax.make_jaxpr(dequant_to_f32)(
+        jnp.zeros((1024, 1024), jnp.int8), jnp.float32(0.1))
+    fire = analysis.audit_silent_dequant(jx_fire, loc="quant/fire-d20b")
+    if any(f.severity == "error" for f in fire):
+        findings.append(analysis.Finding(
+            "quant-bytes", "note", "quant/fire-d20b",
+            "D20b fire fixture verified: a 1M-element int8->f32 "
+            "convert_element_type tripped the silent-dequant error"))
+    else:
+        findings.append(analysis.Finding(
+            "quant-bytes", "error", "quant/fire-d20b",
+            "D20b detector is SILENTLY DEAD: a weight-sized int8->f32 "
+            "convert produced no silent-dequant error",
+            data={"findings": [f.to_dict() for f in fire]}))
+    return findings
+
+
 #: the baseline entries (with their `_matched` counts) of the most
 #: recent run() — the --json payload exposes them so a PARALLEL gate
 #: (check_scoreboard.lint_gate round 17: one subprocess per smoke group)
@@ -1577,7 +1769,8 @@ def run(models=(), ast=True, baseline_path=DEFAULT_BASELINE,
         findings += analysis.audit_tune_cache()
     smokes = {"paged": audit_serving, "obs": audit_obs,
               "ckpt": audit_ckpt, "spmd": audit_spmd, "conc": audit_conc,
-              "router": audit_router, "plan": audit_plan_smoke}
+              "router": audit_router, "plan": audit_plan_smoke,
+              "quant": audit_quant}
     for name in models:
         findings += smokes.get(name, lambda n=name: audit_model(n))()
     baseline = analysis.load_baseline(baseline_path)
